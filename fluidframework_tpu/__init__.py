@@ -1,0 +1,30 @@
+"""fluidframework_tpu — a TPU-native collaborative-data framework.
+
+A from-scratch re-design of the capabilities of Fluid Framework
+(reference: AnthonyYates/FluidFramework): distributed data structures kept
+eventually consistent by total-order broadcast, with summarization,
+reconnect/resubmit, and a partitioned server-side ordering service.
+
+Unlike the reference's pointer-chasing TypeScript merge-tree and Kafka
+lambda pipeline, the hot paths here are structure-of-arrays JAX/XLA
+kernels that apply batches of ops across thousands of documents per
+`jit`/`shard_map` step (see `fluidframework_tpu.mergetree.kernel` and
+`fluidframework_tpu.server.ticket_kernel`).
+
+Layering (mirrors reference layer map, SURVEY.md §1):
+  protocol/   wire types, quorum, protocol state machine   (layers 1-2)
+  core/       collections + utils shared client/server      (layer 2)
+  mergetree/  the sequence engine: oracle, device kernel,
+              client, snapshots                             (layer 6 core)
+  dds/        SharedString/Map/Directory/Matrix/...         (layer 6)
+  runtime/    container+datastore runtime, pending state,
+              summarizer, GC                                (layer 5)
+  loader/     container loader, delta manager, drivers      (layers 3-4)
+  server/     ordering service: deli/scribe/scriptorium/
+              broadcaster lambdas, partition host, storage  (layers S1-S2)
+  parallel/   device mesh, sharding, sequence-parallel scan
+  native/     C++ op-log (librdkafka-equivalent role) + ctypes
+  telemetry/  loggers, traces, perf counters                (§5 aux)
+"""
+
+__version__ = "0.1.0"

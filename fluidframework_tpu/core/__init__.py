@@ -1,0 +1,5 @@
+"""Shared collections + utilities (reference common-utils capability parity)."""
+
+from .collections import Heap, RangeTracker, RedBlackTree, IntervalTree
+from .events import TypedEventEmitter
+from .trace import Trace

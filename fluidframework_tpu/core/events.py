@@ -1,0 +1,32 @@
+"""Minimal typed event emitter (reference common-utils TypedEventEmitter)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+
+class TypedEventEmitter:
+    def __init__(self):
+        self._listeners: Dict[str, List[Callable]] = {}
+
+    def on(self, event: str, fn: Callable) -> Callable:
+        self._listeners.setdefault(event, []).append(fn)
+        return fn
+
+    def once(self, event: str, fn: Callable) -> None:
+        def wrapper(*args, **kwargs):
+            self.off(event, wrapper)
+            fn(*args, **kwargs)
+        self.on(event, wrapper)
+
+    def off(self, event: str, fn: Callable) -> None:
+        listeners = self._listeners.get(event)
+        if listeners and fn in listeners:
+            listeners.remove(fn)
+
+    def emit(self, event: str, *args, **kwargs) -> None:
+        for fn in list(self._listeners.get(event, [])):
+            fn(*args, **kwargs)
+
+    def listener_count(self, event: str) -> int:
+        return len(self._listeners.get(event, []))

@@ -1,0 +1,135 @@
+"""Protocol layer tests: quorum consensus, protocol handler, summary trees.
+
+Models the reference's protocol-base test strategy (SURVEY.md §4.8).
+"""
+
+import json
+
+from fluidframework_tpu.protocol import (
+    MessageType,
+    DocumentMessage,
+    SequencedDocumentMessage,
+    ProtocolOpHandler,
+    Quorum,
+    SummaryTree,
+    summary_tree_to_dict,
+    summary_tree_from_dict,
+)
+
+
+def seq_msg(seq, msn, mtype, contents=None, client_id="A", data=None):
+    return SequencedDocumentMessage(
+        client_id=client_id,
+        sequence_number=seq,
+        minimum_sequence_number=msn,
+        client_sequence_number=seq,
+        reference_sequence_number=0,
+        type=mtype,
+        contents=contents,
+        data=data,
+    )
+
+
+class TestQuorum:
+    def test_membership(self):
+        q = Quorum()
+        q.add_member("A", 1)
+        q.add_member("B", 2)
+        assert q.get_member("A").sequence_number == 1
+        q.remove_member("A")
+        assert q.get_member("A") is None
+        assert len(q.members) == 1
+
+    def test_proposal_approved_when_msn_passes(self):
+        q = Quorum()
+        approved = []
+        q.on("approveProposal", lambda seq, k, v, msn: approved.append((k, v)))
+        q.add_proposal("code", "pkg@1.0", 5)
+        q.update_minimum_sequence_number(4)
+        assert not approved and not q.has("code")
+        q.update_minimum_sequence_number(5)
+        assert approved == [("code", "pkg@1.0")]
+        assert q.get("code") == "pkg@1.0"
+        assert 5 not in q.proposals
+
+    def test_rejected_proposal_dropped(self):
+        q = Quorum()
+        q.add_proposal("code", "pkg@2.0", 7)
+        q.reject_proposal("B", 7)
+        q.update_minimum_sequence_number(10)
+        assert not q.has("code")
+        assert 7 not in q.proposals
+
+    def test_snapshot_roundtrip(self):
+        q = Quorum()
+        q.add_member("A", 1, {"user": "alice"})
+        q.add_proposal("code", "v1", 3)
+        q.values["x"] = 42
+        q2 = Quorum.load(q.snapshot())
+        assert q2.get_member("A").details == {"user": "alice"}
+        assert q2.proposals[3].key == "code"
+        assert q2.get("x") == 42
+
+
+class TestProtocolOpHandler:
+    def test_join_propose_approve_leave(self):
+        h = ProtocolOpHandler()
+        h.process_message(seq_msg(
+            1, 0, MessageType.CLIENT_JOIN,
+            data=json.dumps({"clientId": "A", "detail": {}})))
+        h.process_message(seq_msg(
+            2, 0, MessageType.CLIENT_JOIN,
+            data=json.dumps({"clientId": "B", "detail": {}})))
+        assert set(h.quorum.members) == {"A", "B"}
+
+        h.process_message(seq_msg(
+            3, 1, MessageType.PROPOSE, contents={"key": "code", "value": "v1"}))
+        assert not h.quorum.has("code")
+        # MSN passing the proposal seq approves it.
+        h.process_message(seq_msg(4, 3, MessageType.NO_OP))
+        assert h.quorum.get("code") == "v1"
+
+        h.process_message(seq_msg(
+            5, 3, MessageType.CLIENT_LEAVE, data=json.dumps({"clientId": "A"})))
+        assert set(h.quorum.members) == {"B"}
+        assert h.sequence_number == 5
+
+    def test_duplicate_ops_ignored_and_gap_asserts(self):
+        h = ProtocolOpHandler()
+        h.process_message(seq_msg(1, 0, MessageType.NO_OP))
+        h.process_message(seq_msg(1, 0, MessageType.NO_OP))  # dup: no-op
+        assert h.sequence_number == 1
+        try:
+            h.process_message(seq_msg(5, 0, MessageType.NO_OP))
+            raised = False
+        except AssertionError:
+            raised = True
+        assert raised
+
+    def test_snapshot_load_resume(self):
+        h = ProtocolOpHandler()
+        h.process_message(seq_msg(
+            1, 0, MessageType.CLIENT_JOIN, data=json.dumps({"clientId": "A"})))
+        h2 = ProtocolOpHandler.load(h.snapshot())
+        h2.process_message(seq_msg(2, 1, MessageType.NO_OP))
+        assert h2.sequence_number == 2
+        assert h2.quorum.get_member("A") is not None
+
+
+class TestSummaryTree:
+    def test_roundtrip(self):
+        root = SummaryTree()
+        root.add_blob("header", '{"v":1}')
+        sub = root.add_tree("channels")
+        sub.add_blob("c0", b"\x00\x01")
+        sub.add_handle("c1", "/channels/c1")
+        d = summary_tree_to_dict(root)
+        back = summary_tree_from_dict(d)
+        assert summary_tree_to_dict(back) == d
+
+    def test_message_conversion(self):
+        m = DocumentMessage(client_sequence_number=1, reference_sequence_number=0,
+                            type=MessageType.OPERATION, contents={"x": 1})
+        s = SequencedDocumentMessage.from_document_message(m, "A", 10, 4)
+        assert s.sequence_number == 10 and s.minimum_sequence_number == 4
+        assert s.contents == {"x": 1} and s.client_id == "A"
