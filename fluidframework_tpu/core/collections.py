@@ -18,16 +18,20 @@ T = TypeVar("T")
 
 class Heap(Generic[T]):
     """Min-heap with arbitrary key and lazy removal (reference common-utils Heap;
-    used like deli's ClientSequenceNumberManager heap)."""
+    used like deli's ClientSequenceNumberManager heap). Each item has at most
+    one live entry; remove/update tombstone the current entry by identity so a
+    re-pushed item is never confused with its stale entry."""
 
     def __init__(self, key: Callable[[T], Any] = lambda x: x):
         self._key = key
-        self._heap: List[Tuple[Any, int, T]] = []
+        self._heap: List[List[Any]] = []  # [key, tiebreak, item, live]
         self._counter = itertools.count()
-        self._removed: set = set()
+        self._entries: dict = {}  # id(item) -> live entry
 
     def push(self, item: T) -> None:
-        heapq.heappush(self._heap, (self._key(item), next(self._counter), item))
+        entry = [self._key(item), next(self._counter), item, True]
+        self._entries[id(item)] = entry
+        heapq.heappush(self._heap, entry)
 
     def peek(self) -> Optional[T]:
         self._prune()
@@ -37,23 +41,26 @@ class Heap(Generic[T]):
         self._prune()
         if not self._heap:
             return None
-        return heapq.heappop(self._heap)[2]
+        entry = heapq.heappop(self._heap)
+        self._entries.pop(id(entry[2]), None)
+        return entry[2]
 
     def remove(self, item: T) -> None:
-        self._removed.add(id(item))
+        entry = self._entries.pop(id(item), None)
+        if entry is not None:
+            entry[3] = False
 
     def update(self, item: T) -> None:
-        """Re-key an item: lazy remove + re-push."""
+        """Re-key an item: tombstone its current entry, push a fresh one."""
         self.remove(item)
         self.push(item)
 
     def _prune(self) -> None:
-        while self._heap and id(self._heap[0][2]) in self._removed:
-            self._removed.discard(id(heapq.heappop(self._heap)[2]))
+        while self._heap and not self._heap[0][3]:
+            heapq.heappop(self._heap)
 
     def __len__(self) -> int:
-        self._prune()
-        return sum(1 for _, _, it in self._heap if id(it) not in self._removed)
+        return len(self._entries)
 
 
 @dataclass
@@ -147,7 +154,9 @@ class _Interval:
 class IntervalTree:
     """Interval set with stabbing/overlap queries (reference
     merge-tree/src/collections.ts IntervalTree, backing interval collections).
-    Sorted-by-start array + max-end prefix pruning."""
+    Sorted-by-start array: queries bisect to prune intervals starting after
+    the query range, then filter the prefix by end (O(n) worst case when many
+    intervals start early; fine for interval-collection sizes)."""
 
     def __init__(self):
         self._intervals: List[_Interval] = []
@@ -168,7 +177,9 @@ class IntervalTree:
             i += 1
 
     def overlapping(self, start: int, end: int) -> List[_Interval]:
-        return [iv for iv in self._intervals if iv.start <= end and start <= iv.end]
+        # Prune everything starting after `end`; filter the prefix by end.
+        hi = bisect.bisect_right(self._intervals, _Interval(end, 2**62))
+        return [iv for iv in self._intervals[:hi] if start <= iv.end]
 
     def stab(self, point: int) -> List[_Interval]:
         return self.overlapping(point, point)

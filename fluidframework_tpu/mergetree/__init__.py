@@ -1,0 +1,24 @@
+"""The sequence-CRDT engine (reference: packages/dds/merge-tree).
+
+Two interchangeable engines with identical semantics:
+
+- `oracle`: a scalar, list-of-segments Python engine that mirrors the
+  reference merge-tree semantics (insert tie-breaking, overlapping removes,
+  pending/ack, zamboni). It is the conformance oracle for the device kernel
+  and the measured single-threaded CPU baseline (BASELINE.md).
+
+- `kernel`: the TPU engine — structure-of-arrays segment state, ops applied
+  as masked vectorized updates under `jax.jit`, batched over thousands of
+  documents with `vmap`/`shard_map`. Position resolution is a masked prefix
+  sum under a (refSeq, clientId) visibility predicate instead of a pointer
+  B-tree walk.
+"""
+
+from .constants import (
+    UNASSIGNED_SEQ,
+    UNIVERSAL_SEQ,
+    NON_COLLAB_CLIENT,
+    SEG_TEXT,
+    SEG_MARKER,
+)
+from .oracle import MergeTreeOracle, Segment

@@ -1,0 +1,26 @@
+"""Merge-tree constants (reference packages/dds/merge-tree/src/constants.ts:11-15).
+
+We keep the reference's numbering for UnassignedSequenceNumber/-1 on the host
+side. On device, pending-unassigned is encoded as INT32_MAX so that the
+visibility comparison `ins_seq <= ref_seq` is naturally false for pending
+segments without a special case (kernel.py).
+"""
+
+UNIVERSAL_SEQ = 0       # visible to everyone (snapshot-loaded segments)
+UNASSIGNED_SEQ = -1     # local pending, not yet sequenced
+NON_COLLAB_CLIENT = -2
+LOCAL_CLIENT_ID = -1
+
+# Segment kinds
+SEG_TEXT = 0
+SEG_MARKER = 1
+
+# Device-side sentinels (int32)
+DEV_UNASSIGNED = 2**31 - 1   # pending ins_seq / rem_seq on device
+DEV_NO_REMOVE = 2**31 - 2    # rem_seq sentinel: never removed
+DEV_NO_CLIENT = -1
+
+# Default tuning knobs (reference mergeTree.ts:1050-1068, snapshotV1.ts:40)
+TEXT_SEGMENT_GRANULARITY = 256
+SNAPSHOT_CHUNK_SIZE = 10000
+MAX_OVERLAP_CLIENTS = 3  # device-side overlapping-remove client slots

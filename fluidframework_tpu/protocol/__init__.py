@@ -27,4 +27,4 @@ from .summary import (
     summary_tree_from_dict,
 )
 from .quorum import Quorum, QuorumProposal, SequencedClient
-from .protocol_handler import ProtocolOpHandler, ProtocolState
+from .protocol_handler import ProtocolOpHandler, ProtocolState, ProtocolError

@@ -18,6 +18,11 @@ from .messages import MessageType, SequencedDocumentMessage
 from .quorum import Quorum
 
 
+class ProtocolError(Exception):
+    """Sequenced-stream invariant violation (gap, bad msn) — fatal for the
+    replica; the delta manager must refetch rather than continue."""
+
+
 @dataclass
 class ProtocolState:
     sequence_number: int
@@ -39,9 +44,14 @@ class ProtocolOpHandler:
     def process_message(self, message: SequencedDocumentMessage) -> None:
         if message.sequence_number <= self.sequence_number:
             return  # duplicate / already-processed (idempotent replay)
-        assert message.sequence_number == self.sequence_number + 1, (
-            f"protocol gap: have {self.sequence_number}, got {message.sequence_number}"
-        )
+        if message.sequence_number != self.sequence_number + 1:
+            raise ProtocolError(
+                f"protocol gap: have {self.sequence_number}, "
+                f"got {message.sequence_number}")
+        if message.minimum_sequence_number >= message.sequence_number:
+            raise ProtocolError(
+                f"invalid msn {message.minimum_sequence_number} >= "
+                f"seq {message.sequence_number}")
         self.sequence_number = message.sequence_number
 
         mtype = message.type
@@ -63,7 +73,8 @@ class ProtocolOpHandler:
         elif mtype == MessageType.REJECT:
             self.quorum.reject_proposal(message.client_id, int(message.contents))
 
-        # MSN advance last, so a proposal in this very message can't self-approve.
+        # MSN advance (msn < seq is validated above, so a proposal in this
+        # very message can never self-approve).
         if message.minimum_sequence_number > self.minimum_sequence_number:
             self.minimum_sequence_number = message.minimum_sequence_number
             self.quorum.update_minimum_sequence_number(

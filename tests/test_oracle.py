@@ -1,0 +1,264 @@
+"""Merge-tree oracle tests: semantics + randomized multi-client convergence.
+
+Models the reference's test strategy (SURVEY.md §4.1-4.2): deterministic unit
+tests plus a conflict farm asserting all replicas converge under concurrent
+edits applied through a simulated sequencer.
+"""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.mergetree import (
+    MergeTreeOracle,
+    Segment,
+    UNASSIGNED_SEQ,
+)
+from fluidframework_tpu.mergetree.constants import SEG_TEXT
+
+GOD = -2  # non-collab god view: applies every sequenced op as remote
+
+
+def god_tree():
+    return MergeTreeOracle(local_client=GOD)
+
+
+class TestSequencedApply:
+    """Apply already-sequenced ops in seq order (the server/summarizer view)."""
+
+    def test_basic_insert(self):
+        t = god_tree()
+        t.insert_text(0, "hello", ref_seq=0, client=1, seq=1)
+        t.insert_text(5, " world", ref_seq=1, client=1, seq=2)
+        t.update_seq(2)
+        assert t.get_text() == "hello world"
+
+    def test_insert_splits_segment(self):
+        t = god_tree()
+        t.insert_text(0, "abcd", 0, 1, 1)
+        t.insert_text(2, "XY", 1, 1, 2)
+        t.update_seq(2)
+        assert t.get_text() == "abXYcd"
+        assert len(t.segments) == 3
+
+    def test_concurrent_inserts_same_pos_newer_first(self):
+        # A (seq 1) and B (seq 2) both insert at 0 with refSeq 0.
+        # Reference rule: newer segments come before older (mergeTree.ts:2270).
+        t = god_tree()
+        t.insert_text(0, "AAA", 0, 1, 1)
+        t.insert_text(0, "BBB", 0, 2, 2)
+        t.update_seq(2)
+        assert t.get_text() == "BBBAAA"
+
+    def test_insert_after_acked_tombstone_skips_it(self):
+        t = god_tree()
+        t.insert_text(0, "abcdef", 0, 1, 1)
+        t.remove_range(2, 4, 1, 1, 2)  # "ab|ef", tombstone "cd" at pos 2
+        # Client 2 saw the remove (refSeq 2) and inserts at pos 2.
+        t.insert_text(2, "XX", 2, 2, 3)
+        t.update_seq(3)
+        assert t.get_text() == "abXXef"
+        # Insert must land AFTER the tombstone in segment order.
+        order = [s.text for s in t.segments]
+        assert order.index("cd") < order.index("XX")
+
+    def test_insert_into_concurrently_removed_range_survives(self):
+        t = god_tree()
+        t.insert_text(0, "abcdef", 0, 1, 1)
+        t.remove_range(0, 6, 1, 1, 2)      # client 1 removes everything
+        t.insert_text(3, "XY", 1, 2, 3)    # client 2 concurrently at pos 3
+        t.update_seq(3)
+        assert t.get_text() == "XY"
+
+    def test_remove_spanning_concurrent_insert_leaves_it(self):
+        t = god_tree()
+        t.insert_text(0, "abcdef", 0, 1, 1)
+        t.insert_text(3, "XY", 1, 2, 2)    # client 2 inserts "XY" at 3
+        t.remove_range(1, 5, 1, 1, 3)      # client 1 concurrently removes b..e
+        t.update_seq(3)
+        # Remove was relative to refSeq 1 ("abcdef"): removes bcde, not XY.
+        assert t.get_text() == "aXYf"
+
+    def test_overlapping_removes_earliest_wins(self):
+        t = god_tree()
+        t.insert_text(0, "abcdef", 0, 1, 1)
+        t.remove_range(1, 3, 1, 1, 2)
+        t.remove_range(1, 5, 1, 2, 3)  # overlaps prior remove (refSeq 1)
+        t.update_seq(3)
+        assert t.get_text() == "af"
+        # The overlapped chars keep the earliest removedSeq.
+        tomb = [s for s in t.segments if s.rem_seq is not None]
+        assert min(s.rem_seq for s in tomb) == 2
+        overl = [s for s in tomb if s.rem_overlap]
+        assert overl and overl[0].rem_overlap == [2]
+
+    def test_annotate_lww_in_seq_order(self):
+        t = god_tree()
+        t.insert_text(0, "abcd", 0, 1, 1)
+        t.annotate_range(0, 4, {"bold": True}, 1, 1, 2)
+        t.annotate_range(1, 3, {"bold": None, "em": 1}, 1, 2, 3)
+        t.update_seq(3)
+        props = [s.props for s in t.segments
+                 if t.visible_length(s, 3, GOD) > 0]
+        assert props == [{"bold": True}, {"em": 1}, {"bold": True}]
+
+    def test_marker_occupies_one_position(self):
+        t = god_tree()
+        t.insert_text(0, "ab", 0, 1, 1)
+        t.insert_marker(1, 1, 1, 2, props={"type": "pg"})
+        t.update_seq(2)
+        assert t.get_length() == 3
+        assert t.get_text() == "a￼b"
+
+
+class TestZamboniAndSnapshot:
+    def test_zamboni_frees_old_tombstones_and_merges(self):
+        t = god_tree()
+        t.insert_text(0, "aaa", 0, 1, 1)
+        t.insert_text(3, "bbb", 1, 1, 2)
+        t.remove_range(2, 4, 2, 1, 3)
+        t.update_seq(3)
+        assert t.get_text() == "aabb"
+        t.set_min_seq(3)
+        assert t.get_text() == "aabb"
+        assert all(s.rem_seq is None for s in t.segments)
+        # Fully-acked adjacent segments with equal props coalesce.
+        assert len(t.segments) == 1
+
+    def test_snapshot_roundtrip_preserves_collab_window(self):
+        t = god_tree()
+        t.insert_text(0, "hello", 0, 1, 1)
+        t.insert_text(5, "world", 1, 2, 2)
+        t.remove_range(0, 2, 2, 1, 3)
+        t.update_seq(3)
+        t.set_min_seq(2)  # remove at seq 3 still inside the window
+        snap = t.snapshot_segments()
+        t2 = MergeTreeOracle.load_segments(snap, local_client=GOD,
+                                           min_seq=2, current_seq=3)
+        assert t2.get_text() == t.get_text()
+        # Perspective at refSeq 2 must still see the not-yet-min removed text.
+        assert t2.get_text(ref_seq=2, client=GOD) == "helloworld"
+
+
+# ---------------------------------------------------------------------------
+# Replica + sequencer harness (precursor of mergetree.client / local server)
+# ---------------------------------------------------------------------------
+
+class Replica:
+    def __init__(self, client_id):
+        self.client_id = client_id
+        self.tree = MergeTreeOracle(local_client=client_id)
+        self.outbox = []
+
+    def local_insert(self, pos, text):
+        self.tree.insert_text(pos, text, self.tree.current_seq, self.client_id,
+                              UNASSIGNED_SEQ)
+        self.outbox.append(("insert", pos, text, self.tree.current_seq))
+
+    def local_remove(self, start, end):
+        self.tree.remove_range(start, end, self.tree.current_seq,
+                               self.client_id, UNASSIGNED_SEQ)
+        self.outbox.append(("remove", start, end, self.tree.current_seq))
+
+    def local_annotate(self, start, end, props):
+        self.tree.annotate_range(start, end, props, self.tree.current_seq,
+                                 self.client_id, UNASSIGNED_SEQ)
+        self.outbox.append(("annotate", start, end, props, self.tree.current_seq))
+
+    def apply_sequenced(self, op, seq):
+        kind, client = op[0], op[-1]
+        if client == self.client_id:
+            self.tree.ack(seq)
+            return
+        ref_seq = op[-2]
+        if kind == "insert":
+            _, pos, text, _, _ = op
+            self.tree.insert_text(pos, text, ref_seq, client, seq)
+        elif kind == "remove":
+            _, start, end, _, _ = op
+            self.tree.remove_range(start, end, ref_seq, client, seq)
+        elif kind == "annotate":
+            _, start, end, props, _, _ = op
+            self.tree.annotate_range(start, end, props, ref_seq, client, seq)
+        self.tree.update_seq(seq)
+
+
+def run_farm(n_clients, rounds, ops_per_round, seed, with_annotate=True):
+    rng = random.Random(seed)
+    replicas = [Replica(i) for i in range(n_clients)]
+    seq = 0
+    log = []  # (op_with_client, seq)
+    for _ in range(rounds):
+        # Each client makes local edits against its current view.
+        pending = []
+        for rep in replicas:
+            for _ in range(rng.randint(0, ops_per_round)):
+                length = rep.tree.get_length()
+                choice = rng.random()
+                if length == 0 or choice < 0.55:
+                    pos = rng.randint(0, length)
+                    text = "".join(rng.choice("abcdefgh")
+                                   for _ in range(rng.randint(1, 4)))
+                    rep.local_insert(pos, text)
+                elif choice < 0.85 or not with_annotate:
+                    start = rng.randint(0, length - 1)
+                    end = rng.randint(start + 1, length)
+                    rep.local_remove(start, end)
+                else:
+                    start = rng.randint(0, length - 1)
+                    end = rng.randint(start + 1, length)
+                    key = rng.choice(["a", "b"])
+                    val = rng.choice([1, "x", None])
+                    rep.local_annotate(start, end, {key: val})
+            pending.append([op + (rep.client_id,) for op in rep.outbox])
+            rep.outbox.clear()
+        # Random interleave preserving per-client order (the sequencer keeps
+        # each client's ops in clientSeq order).
+        interleaved = []
+        queues = [q for q in pending if q]
+        while queues:
+            q = rng.choice(queues)
+            interleaved.append(q.pop(0))
+            queues = [q for q in queues if q]
+        for op in interleaved:
+            seq += 1
+            log.append((op, seq))
+            for rep in replicas:
+                rep.apply_sequenced(op, seq)
+    texts = [rep.tree.get_text() for rep in replicas]
+    assert all(tx == texts[0] for tx in texts), (
+        f"divergence (seed {seed}): {texts}")
+    # God-view sequenced replay converges to the same text.
+    god = god_tree()
+    for op, s in log:
+        kind, client, ref_seq = op[0], op[-1], op[-2]
+        if kind == "insert":
+            god.insert_text(op[1], op[2], ref_seq, client, s)
+        elif kind == "remove":
+            god.remove_range(op[1], op[2], ref_seq, client, s)
+        else:
+            god.annotate_range(op[1], op[2], op[3], ref_seq, client, s)
+        god.update_seq(s)
+    assert god.get_text() == texts[0]
+    return replicas, log
+
+
+class TestConflictFarm:
+    @pytest.mark.parametrize("seed", range(12))
+    def test_converges_small(self, seed):
+        run_farm(n_clients=3, rounds=4, ops_per_round=3, seed=seed)
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_converges_more_clients(self, seed):
+        run_farm(n_clients=6, rounds=3, ops_per_round=2, seed=100 + seed)
+
+    def test_props_converge(self):
+        replicas, _ = run_farm(n_clients=3, rounds=5, ops_per_round=3, seed=7)
+        views = []
+        for rep in replicas:
+            view = []
+            for s in rep.tree.segments:
+                if rep.tree.visible_length(s, rep.tree.current_seq, GOD) > 0:
+                    view.append((s.text, s.props))
+            views.append(view)
+        assert views[0] == views[1] == views[2]

@@ -6,6 +6,7 @@ Models the reference's protocol-base test strategy (SURVEY.md §4.8).
 import json
 
 from fluidframework_tpu.protocol import (
+    ProtocolError,
     MessageType,
     DocumentMessage,
     SequencedDocumentMessage,
@@ -102,7 +103,7 @@ class TestProtocolOpHandler:
         try:
             h.process_message(seq_msg(5, 0, MessageType.NO_OP))
             raised = False
-        except AssertionError:
+        except ProtocolError:
             raised = True
         assert raised
 
