@@ -29,6 +29,7 @@ class Heap(Generic[T]):
         self._entries: dict = {}  # id(item) -> live entry
 
     def push(self, item: T) -> None:
+        self.remove(item)  # keep the at-most-one-live-entry invariant
         entry = [self._key(item), next(self._counter), item, True]
         self._entries[id(item)] = entry
         heapq.heappush(self._heap, entry)

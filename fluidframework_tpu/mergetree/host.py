@@ -1,0 +1,216 @@
+"""Host glue for the device kernel: payload tables + state extraction.
+
+Device arrays hold only integers (SURVEY.md §7: "device holds offsets/lengths
+into a host rope, not characters"). The host keeps:
+- an op payload table: op_id -> inserted text / marker / annotate pset;
+- client id interning (wire client ids are strings);
+and reconstructs text and per-segment properties from (origin_op,
+origin_off, length) plus the annotate edge chains.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .constants import DEV_UNASSIGNED, NON_COLLAB_CLIENT, SEG_MARKER, SEG_TEXT
+from .oppack import HostOp, OpKind
+from .state import DocState
+
+GOD_CLIENT = NON_COLLAB_CLIENT  # sees exactly the acked state (ids are >= 0)
+
+PENDING_ORDER_BASE = 2**40  # pending annotates resolve after all acked ones
+
+
+@dataclass
+class InsertPayload:
+    kind: int  # SEG_TEXT | SEG_MARKER
+    text: str = ""
+    props: Optional[dict] = None
+
+
+@dataclass
+class AnnotatePayload:
+    props: Dict[str, Any]
+    seq: int  # updated on ack; pending = DEV_UNASSIGNED
+
+
+@dataclass
+class PayloadTable:
+    """Global op_id -> payload registry shared by a batch of documents."""
+
+    entries: List[Any] = field(default_factory=list)
+
+    def add_insert(self, kind: int, text: str = "",
+                   props: Optional[dict] = None) -> int:
+        self.entries.append(InsertPayload(kind, text, props))
+        return len(self.entries) - 1
+
+    def add_annotate(self, props: Dict[str, Any], seq: int) -> int:
+        self.entries.append(AnnotatePayload(dict(props), seq))
+        return len(self.entries) - 1
+
+    def get(self, op_id: int):
+        return self.entries[op_id]
+
+
+class OpBuilder:
+    """Builds HostOp streams for one document against a shared payload table."""
+
+    def __init__(self, payloads: Optional[PayloadTable] = None):
+        self.payloads = payloads if payloads is not None else PayloadTable()
+        self.local_seq = 0
+
+    def insert_text(self, pos: int, text: str, ref_seq: int, client: int,
+                    seq: int, props: Optional[dict] = None,
+                    msn: int = 0) -> HostOp:
+        op_id = self.payloads.add_insert(SEG_TEXT, text, props)
+        return self._insert(pos, len(text), op_id, ref_seq, client, seq, msn)
+
+    def insert_marker(self, pos: int, ref_seq: int, client: int, seq: int,
+                      props: Optional[dict] = None, msn: int = 0) -> HostOp:
+        op_id = self.payloads.add_insert(SEG_MARKER, "", props)
+        return self._insert(pos, 1, op_id, ref_seq, client, seq, msn)
+
+    def _insert(self, pos, length, op_id, ref_seq, client, seq, msn) -> HostOp:
+        local = 0
+        if seq == DEV_UNASSIGNED:
+            self.local_seq += 1
+            local = self.local_seq
+        return HostOp(kind=OpKind.INSERT, seq=seq, ref_seq=ref_seq,
+                      client=client, pos1=pos, op_id=op_id, new_len=length,
+                      local_seq=local, msn=msn)
+
+    def remove(self, start: int, end: int, ref_seq: int, client: int,
+               seq: int, msn: int = 0) -> HostOp:
+        local = 0
+        if seq == DEV_UNASSIGNED:
+            self.local_seq += 1
+            local = self.local_seq
+        return HostOp(kind=OpKind.REMOVE, seq=seq, ref_seq=ref_seq,
+                      client=client, pos1=start, pos2=end, local_seq=local,
+                      msn=msn)
+
+    def annotate(self, start: int, end: int, props: Dict[str, Any],
+                 ref_seq: int, client: int, seq: int, msn: int = 0) -> HostOp:
+        op_id = self.payloads.add_annotate(props, seq)
+        local = 0
+        if seq == DEV_UNASSIGNED:
+            self.local_seq += 1
+            local = self.local_seq
+        return HostOp(kind=OpKind.ANNOTATE, seq=seq, ref_seq=ref_seq,
+                      client=client, pos1=start, pos2=end, op_id=op_id,
+                      local_seq=local, msn=msn)
+
+    def ack_insert(self, local_seq: int, seq: int, msn: int = 0) -> HostOp:
+        return HostOp(kind=OpKind.ACK_INSERT, seq=seq, ref_seq=0, client=-1,
+                      local_seq=local_seq, msn=msn)
+
+    def ack_remove(self, local_seq: int, seq: int, msn: int = 0) -> HostOp:
+        return HostOp(kind=OpKind.ACK_REMOVE, seq=seq, ref_seq=0, client=-1,
+                      local_seq=local_seq, msn=msn)
+
+    def ack_annotate(self, op_id: int, seq: int, msn: int = 0) -> HostOp:
+        """Annotate acks only stamp the payload's seq (LWW order); device
+        state is unchanged, so the op is a device NOOP carrying the msn."""
+        payload = self.payloads.get(op_id)
+        payload.seq = seq
+        return HostOp(kind=OpKind.NOOP, seq=seq, ref_seq=0, client=-1, msn=msn)
+
+
+# ---------------------------------------------------------------------------
+# extraction
+# ---------------------------------------------------------------------------
+
+def _to_host(state: DocState, doc: Optional[int]) -> dict:
+    cols = {}
+    for name in ("length", "ins_seq", "ins_client", "local_seq", "rem_seq",
+                 "rem_local_seq", "rem_clients", "origin_op", "origin_off",
+                 "anno_head", "edge_op", "edge_prev"):
+        arr = np.asarray(getattr(state, name))
+        cols[name] = arr[doc] if doc is not None else arr
+    for name in ("count", "edge_count", "min_seq", "seq", "overflow"):
+        val = np.asarray(getattr(state, name))
+        cols[name] = int(val[doc]) if doc is not None else int(val)
+    return cols
+
+
+def _visible_host(cols: dict, ref_seq: int, client: int) -> np.ndarray:
+    n = cols["count"]
+    ins_seq = cols["ins_seq"][:n]
+    ins_client = cols["ins_client"][:n]
+    rem_seq = cols["rem_seq"][:n]
+    rem_clients = cols["rem_clients"][:n]
+    inserted = (ins_seq <= ref_seq) | (ins_client == client)
+    removed = (rem_seq <= ref_seq) | (rem_clients == client).any(axis=-1)
+    return inserted & ~removed
+
+
+def extract_text(state: DocState, payloads: PayloadTable,
+                 ref_seq: Optional[int] = None, client: int = GOD_CLIENT,
+                 doc: Optional[int] = None,
+                 marker_char: str = "￼") -> str:
+    """Document text at a perspective (defaults: latest acked, god view)."""
+    cols = _to_host(state, doc)
+    if ref_seq is None:
+        ref_seq = cols["seq"]
+    vis = _visible_host(cols, ref_seq, client)
+    n = cols["count"]
+    parts = []
+    for i in range(n):
+        if not vis[i]:
+            continue
+        payload = payloads.get(int(cols["origin_op"][i]))
+        if payload.kind == SEG_MARKER:
+            parts.append(marker_char)
+        else:
+            off = int(cols["origin_off"][i])
+            parts.append(payload.text[off:off + int(cols["length"][i])])
+    return "".join(parts)
+
+
+def extract_segments(state: DocState, payloads: PayloadTable,
+                     ref_seq: Optional[int] = None, client: int = GOD_CLIENT,
+                     doc: Optional[int] = None) -> List[Tuple[str, Optional[dict]]]:
+    """Visible (text, resolved props) pairs in order, for conformance checks
+    and summaries. Props resolve per key by annotate seq order (pending local
+    annotates count as newest, preserving pending-shadow semantics)."""
+    cols = _to_host(state, doc)
+    if ref_seq is None:
+        ref_seq = cols["seq"]
+    vis = _visible_host(cols, ref_seq, client)
+    out = []
+    for i in range(cols["count"]):
+        if not vis[i]:
+            continue
+        payload = payloads.get(int(cols["origin_op"][i]))
+        if payload.kind == SEG_MARKER:
+            text = "￼"
+        else:
+            off = int(cols["origin_off"][i])
+            text = payload.text[off:off + int(cols["length"][i])]
+        props = dict(payload.props) if payload.props else {}
+        # Collect annotate chain; order by effective seq (pending local
+        # annotates rank after everything acked, in submission order, which
+        # is their op_id creation order — only own pendings can coexist).
+        chain = []
+        edge = int(cols["anno_head"][i])
+        while edge >= 0:
+            op_id = int(cols["edge_op"][edge])
+            ann = payloads.get(op_id)
+            seq = ann.seq
+            if seq == DEV_UNASSIGNED:
+                seq = PENDING_ORDER_BASE + op_id
+            chain.append((seq, ann.props))
+            edge = int(cols["edge_prev"][edge])
+        chain.sort(key=lambda kv: kv[0])  # ascending: later seq wins per key
+        for _, pset in chain:
+            for key, value in pset.items():
+                if value is None:
+                    props.pop(key, None)
+                else:
+                    props[key] = value
+        out.append((text, props or None))
+    return out

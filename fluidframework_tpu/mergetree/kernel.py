@@ -1,0 +1,372 @@
+"""The TPU merge-tree kernel: vectorized op application over segment tables.
+
+This replaces the reference's three hot loops (SURVEY.md §3: insertingWalk +
+blockUpdatePathLengths, ackPendingSegment + zamboni, summary gather —
+mergeTree.ts:2345,2770,1893,1422) with data-parallel array ops:
+
+- position resolution: masked exclusive prefix sum of visible lengths under
+  the op's (refSeq, clientId) perspective — no tree walk, no partial-length
+  caches (the prefix sum IS the partial-length computation, fused);
+- insert/split: shift-gathers over the segment axis;
+- remove/annotate marking: masked column updates;
+- the insert tie-break (mergeTree.ts:2248 breakTie): a vectorized first-true
+  scan over the boundary run — skip acked tombstones, land before visible or
+  concurrent-acked segments, skip unacked foreign segments;
+- zamboni compaction: keep-mask prefix sum + gather.
+
+One `step` applies one op to one document; `lax.scan` over the time axis x
+`vmap` over the document axis yields the batched kernel that applies T ops
+to B documents in one jit. All shapes are static; per-document streams are
+NOOP-padded (oppack.py).
+
+Semantics are conformance-tested against the scalar oracle
+(tests/test_kernel.py) on randomized schedules, the same way the reference
+farms assert convergence (SURVEY.md §4.2).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .constants import DEV_NO_REMOVE, DEV_UNASSIGNED
+from .oppack import OpKind, PackedOps
+from .state import DocState
+
+
+# ---------------------------------------------------------------------------
+# visibility
+# ---------------------------------------------------------------------------
+
+def visibility(s: DocState, ref_seq, client) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                                      jnp.ndarray]:
+    """(vis, vlen, cum): visibility mask, visible lengths, exclusive prefix
+    sum at perspective (ref_seq, client). mergeTree.ts:1586 nodeLength."""
+    c = s.capacity
+    idx = jnp.arange(c, dtype=jnp.int32)
+    valid = idx < s.count
+    inserted = (s.ins_seq <= ref_seq) | (s.ins_client == client)
+    removed = (s.rem_seq <= ref_seq) | jnp.any(
+        s.rem_clients == client, axis=-1)
+    vis = valid & inserted & ~removed
+    vlen = jnp.where(vis, s.length, 0)
+    cum = jnp.cumsum(vlen) - vlen  # exclusive
+    return vis, vlen, cum
+
+
+# ---------------------------------------------------------------------------
+# shift helpers
+# ---------------------------------------------------------------------------
+
+def _gather_segments(s: DocState, src: jnp.ndarray) -> DocState:
+    """Reindex all segment columns by src (clipped gather)."""
+    src = jnp.clip(src, 0, s.capacity - 1)
+    return s._replace(
+        length=s.length[src],
+        ins_seq=s.ins_seq[src],
+        ins_client=s.ins_client[src],
+        local_seq=s.local_seq[src],
+        rem_seq=s.rem_seq[src],
+        rem_local_seq=s.rem_local_seq[src],
+        rem_clients=s.rem_clients[src],
+        origin_op=s.origin_op[src],
+        origin_off=s.origin_off[src],
+        anno_head=s.anno_head[src],
+    )
+
+
+def _select(do, a: DocState, b: DocState) -> DocState:
+    """Per-column where(do, a, b) over segment columns + scalars."""
+    return jax.tree_util.tree_map(lambda x, y: jnp.where(do, x, y), a, b)
+
+
+def _ensure_boundary(s: DocState, pos, ref_seq, client, enabled) -> DocState:
+    """Split the segment containing `pos` (if any) so `pos` falls on a
+    segment boundary (reference ensureIntervalBoundary, mergeTree.ts:2240)."""
+    vis, vlen, cum = visibility(s, ref_seq, client)
+    inside = vis & (cum < pos) & (pos < cum + vlen)
+    do = enabled & jnp.any(inside)
+    idx = jnp.argmax(inside).astype(jnp.int32)
+    off = pos - cum[idx]
+    c = s.capacity
+    j = jnp.arange(c, dtype=jnp.int32)
+    # Shift right of idx by one; idx+1 becomes the right half.
+    src = jnp.where(j <= idx, j, j - 1)
+    g = _gather_segments(s, src)
+    is_left = j == idx
+    is_right = j == idx + 1
+    g = g._replace(
+        length=jnp.where(is_left, off,
+                         jnp.where(is_right, s.length[idx] - off, g.length)),
+        origin_off=jnp.where(is_right, g.origin_off + off, g.origin_off),
+    )
+    g = g._replace(count=s.count + 1)
+    return _select(do, g, s)
+
+
+# ---------------------------------------------------------------------------
+# op phases (single doc)
+# ---------------------------------------------------------------------------
+
+def _insert_phase(s: DocState, op: PackedOps, t, enabled) -> DocState:
+    """Find the insert slot via the breakTie run-scan, shift, write the new
+    segment (boundary already ensured, so the op never lands mid-segment)."""
+    r, cl, p = op.ref_seq[t], op.client[t], op.pos1[t]
+    is_local = op.seq[t] == DEV_UNASSIGNED
+    vis, vlen, cum = visibility(s, r, cl)
+    c = s.capacity
+    j = jnp.arange(c, dtype=jnp.int32)
+    in_run = cum == p
+    tomb = s.rem_seq <= r  # removed at-or-before refSeq: skip over
+    acked_ins = s.ins_seq != DEV_UNASSIGNED
+    stop = in_run & (vis | (~tomb & (is_local | acked_ins)) | (j >= s.count))
+    # pos beyond the visible length leaves no stop slot: flag instead of
+    # silently landing at argmax-of-all-false == 0.
+    found = jnp.any(stop)
+    enabled = enabled & found
+    slot = jnp.argmax(stop).astype(jnp.int32)  # first stop
+    # Shift right of slot by one and write the new segment at slot.
+    src = jnp.where(j < slot, j, j - 1)
+    g = _gather_segments(s, src)
+    here = j == slot
+    new_seq = op.seq[t]
+    g = g._replace(
+        length=jnp.where(here, op.new_len[t], g.length),
+        ins_seq=jnp.where(here, new_seq, g.ins_seq),
+        ins_client=jnp.where(here, cl, g.ins_client),
+        local_seq=jnp.where(here, jnp.where(is_local, op.local_seq[t], 0),
+                            g.local_seq),
+        rem_seq=jnp.where(here, DEV_NO_REMOVE, g.rem_seq),
+        rem_local_seq=jnp.where(here, 0, g.rem_local_seq),
+        rem_clients=jnp.where(here[:, None], -1, g.rem_clients),
+        origin_op=jnp.where(here, op.op_id[t], g.origin_op),
+        origin_off=jnp.where(here, 0, g.origin_off),
+        anno_head=jnp.where(here, -1, g.anno_head),
+        count=s.count + 1,
+    )
+    bad = (op.kind[t] == OpKind.INSERT) & ~found
+    g = g._replace(overflow=g.overflow | bad)
+    s = s._replace(overflow=s.overflow | bad)
+    return _select(enabled, g, s)
+
+
+def _range_targets(s: DocState, op: PackedOps, t):
+    """Visible segments fully inside [pos1, pos2) (boundaries pre-split)."""
+    r, cl = op.ref_seq[t], op.client[t]
+    vis, vlen, cum = visibility(s, r, cl)
+    return vis & (vlen > 0) & (cum >= op.pos1[t]) & (cum + vlen <= op.pos2[t])
+
+
+def _remove_phase(s: DocState, op: PackedOps, t, enabled) -> DocState:
+    """markRangeRemoved semantics (mergeTree.ts:2607): first acked remove
+    wins; a pending local remove is overwritten by an acked one (prior
+    remover becomes an overlap client); later removers are overlap clients."""
+    target = _range_targets(s, op, t) & enabled
+    cl, seq = op.client[t], op.seq[t]
+    is_local = seq == DEV_UNASSIGNED
+    fresh = target & (s.rem_seq == DEV_NO_REMOVE)
+    pend_overwrite = target & (s.rem_seq == DEV_UNASSIGNED) & ~is_local
+    already = target & (s.rem_seq != DEV_NO_REMOVE) & ~pend_overwrite
+
+    rem_seq = jnp.where(fresh, jnp.where(is_local, DEV_UNASSIGNED, seq),
+                        jnp.where(pend_overwrite, seq, s.rem_seq))
+    rem_local_seq = jnp.where(fresh & is_local, op.local_seq[t],
+                              jnp.where(pend_overwrite, 0, s.rem_local_seq))
+
+    k = s.rem_clients.shape[-1]
+    rc = s.rem_clients
+    # fresh: primary slot takes this client.
+    rc = jnp.where(fresh[:, None] & (jnp.arange(k) == 0), cl, rc)
+    # pend_overwrite: prior (pending) remover shifts into an overlap slot,
+    # the acked remover takes the primary slot.
+    prior = s.rem_clients[:, 0]
+    rc = jnp.where(pend_overwrite[:, None] & (jnp.arange(k) == 0), cl, rc)
+    displaced = pend_overwrite & (prior != cl)
+    rc = _append_overlap(rc, displaced, prior)
+    # already-removed (acked): record this client as an overlapping remover.
+    need = already & ~jnp.any(s.rem_clients == cl, axis=-1)
+    rc = _append_overlap(rc, need, jnp.full_like(prior, 0) + cl)
+    overflow = jnp.any((displaced | need) & ~jnp.any(rc == jnp.where(
+        displaced, prior, cl)[:, None], axis=-1))
+    return s._replace(rem_seq=rem_seq, rem_local_seq=rem_local_seq,
+                      rem_clients=rc, overflow=s.overflow | overflow)
+
+
+def _append_overlap(rc: jnp.ndarray, need: jnp.ndarray,
+                    client: jnp.ndarray) -> jnp.ndarray:
+    """Per-row: place client[i] into the first free (-1) overlap slot (>=1)
+    where need[i]. Static K loop, K = MAX_OVERLAP_CLIENTS."""
+    k = rc.shape[-1]
+    free = rc == -1
+    free = free.at[:, 0].set(False)  # slot 0 is the primary remover
+    first_free = jnp.argmax(free, axis=-1)  # 0 if none free (masked below)
+    can = need & jnp.any(free, axis=-1)
+    onehot = jnp.arange(k) == first_free[:, None]
+    return jnp.where((can[:, None]) & onehot, client[:, None], rc)
+
+
+def _annotate_phase(s: DocState, op: PackedOps, t, enabled) -> DocState:
+    """Append an annotate edge per affected segment into the edge pool;
+    host resolves per-key LWW by op seq at summary time."""
+    target = _range_targets(s, op, t) & enabled
+    e = s.edge_capacity
+    offs = s.edge_count + (jnp.cumsum(target.astype(jnp.int32)) - target)
+    can = target & (offs < e)
+    dest = jnp.where(can, offs, e)  # out-of-bounds rows dropped
+    edge_op = s.edge_op.at[dest].set(op.op_id[t], mode="drop")
+    edge_prev = s.edge_prev.at[dest].set(
+        jnp.where(can, s.anno_head, -1), mode="drop")
+    anno_head = jnp.where(can, offs, s.anno_head)
+    n = jnp.sum(can.astype(jnp.int32))
+    overflow = jnp.any(target & ~can)
+    return s._replace(edge_op=edge_op, edge_prev=edge_prev,
+                      anno_head=anno_head, edge_count=s.edge_count + n,
+                      overflow=s.overflow | overflow)
+
+
+def _ack_phase(s: DocState, op: PackedOps, t, kind) -> DocState:
+    """Assign the server seq to pending segments matching the acked local op
+    (reference ackPendingSegment, mergeTree.ts:1893). An overwritten pending
+    remove keeps the earlier remote seq (segment.ack returning false)."""
+    seq, target = op.seq[t], op.local_seq[t]
+    ins_hit = (kind == OpKind.ACK_INSERT) & (s.ins_seq == DEV_UNASSIGNED) & \
+        (s.local_seq == target)
+    rem_hit = (kind == OpKind.ACK_REMOVE) & (s.rem_seq == DEV_UNASSIGNED) & \
+        (s.rem_local_seq == target)
+    return s._replace(
+        ins_seq=jnp.where(ins_hit, seq, s.ins_seq),
+        local_seq=jnp.where(ins_hit, 0, s.local_seq),
+        rem_seq=jnp.where(rem_hit, seq, s.rem_seq),
+        rem_local_seq=jnp.where(rem_hit, 0, s.rem_local_seq),
+    )
+
+
+# ---------------------------------------------------------------------------
+# one step
+# ---------------------------------------------------------------------------
+
+def apply_one(s: DocState, op: PackedOps, t) -> DocState:
+    """Apply op column t to a single document's state."""
+    kind = op.kind[t]
+    is_edit = (kind == OpKind.INSERT) | (kind == OpKind.REMOVE) | \
+        (kind == OpKind.ANNOTATE)
+    is_range = (kind == OpKind.REMOVE) | (kind == OpKind.ANNOTATE)
+    # Capacity guard: an edit may create up to 2 new slots. Overflowing ops
+    # become no-ops with the overflow flag set; the host re-runs that doc
+    # at higher capacity.
+    fits = s.count + 2 <= s.capacity
+    s = s._replace(overflow=s.overflow | (is_edit & ~fits))
+    is_edit = is_edit & fits
+    is_range = is_range & fits
+
+    r, cl = op.ref_seq[t], op.client[t]
+    s1 = _ensure_boundary(s, op.pos1[t], r, cl, is_edit)
+    s2 = _ensure_boundary(s1, op.pos2[t], r, cl, is_range)
+
+    s_ins = _insert_phase(s2, op, t, is_edit & (kind == OpKind.INSERT))
+    s_rem = _remove_phase(s_ins, op, t, is_range & (kind == OpKind.REMOVE))
+    s_ann = _annotate_phase(s_rem, op, t, is_range & (kind == OpKind.ANNOTATE))
+    out = _ack_phase(s_ann, op, t, kind)
+
+    # Pending local submits (seq == DEV_UNASSIGNED) must not advance the
+    # acked high-water mark used as the default extraction perspective.
+    acked = (kind != OpKind.NOOP) & (op.seq[t] != DEV_UNASSIGNED)
+    out = out._replace(
+        seq=jnp.where(acked, jnp.maximum(out.seq, op.seq[t]), out.seq),
+        min_seq=jnp.where(acked, jnp.maximum(out.min_seq, op.msn[t]),
+                          out.min_seq),
+    )
+    return out
+
+
+# The phases are written against single-doc shapes; vmap lifts them over the
+# document batch axis, scan drives the time axis.
+
+def _scan_ops(state: DocState, ops: PackedOps, batched: bool) -> DocState:
+    steps = ops.steps
+
+    def body(s, t):
+        if batched:
+            s2 = jax.vmap(lambda sd, od: apply_one(sd, od, t))(s, ops)
+        else:
+            s2 = apply_one(s, ops, t)
+        return s2, None
+
+    out, _ = jax.lax.scan(body, state, jnp.arange(steps, dtype=jnp.int32))
+    return out
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def apply_ops(state: DocState, ops: PackedOps) -> DocState:
+    """Apply a [T] op stream to a single document."""
+    return _scan_ops(state, ops, batched=False)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def apply_ops_batched(state: DocState, ops: PackedOps) -> DocState:
+    """Apply [B, T] op streams to B documents: scan(T) of vmap(B)."""
+    return _scan_ops(state, ops, batched=True)
+
+
+# ---------------------------------------------------------------------------
+# zamboni: compaction
+# ---------------------------------------------------------------------------
+
+def _compact_one(s: DocState) -> DocState:
+    """Free segments removed at-or-before min_seq (reference zamboni,
+    mergeTree.ts:1422): stable-partition live segments to the front."""
+    c = s.capacity
+    idx = jnp.arange(c, dtype=jnp.int32)
+    valid = idx < s.count
+    keep = valid & ~(s.rem_seq <= s.min_seq)
+    new_count = jnp.sum(keep.astype(jnp.int32))
+    # Destination of each kept row; gather formulation: for each output slot
+    # j, source = index of the (j+1)-th kept row.
+    order = jnp.cumsum(keep.astype(jnp.int32)) - 1  # dest slot per kept row
+    src = jnp.full((c,), c - 1, jnp.int32)
+    src = src.at[jnp.where(keep, order, c)].set(idx, mode="drop")
+    g = _gather_segments(s, src)
+    pad = jnp.arange(c) >= new_count
+    g = g._replace(
+        length=jnp.where(pad, 0, g.length),
+        ins_seq=jnp.where(pad, DEV_UNASSIGNED, g.ins_seq),
+        ins_client=jnp.where(pad, -1, g.ins_client),
+        local_seq=jnp.where(pad, 0, g.local_seq),
+        rem_seq=jnp.where(pad, DEV_NO_REMOVE, g.rem_seq),
+        rem_local_seq=jnp.where(pad, 0, g.rem_local_seq),
+        rem_clients=jnp.where(pad[:, None], -1, g.rem_clients),
+        origin_op=jnp.where(pad, -1, g.origin_op),
+        origin_off=jnp.where(pad, 0, g.origin_off),
+        anno_head=jnp.where(pad, -1, g.anno_head),
+        count=new_count,
+    )
+    return g
+
+
+@jax.jit
+def compact(state: DocState) -> DocState:
+    return _compact_one(state)
+
+
+@jax.jit
+def compact_batched(state: DocState) -> DocState:
+    return jax.vmap(_compact_one)(state)
+
+
+# ---------------------------------------------------------------------------
+# queries
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=())
+def visible_mask(state: DocState, ref_seq, client):
+    vis, _, _ = visibility(state, ref_seq, client)
+    return vis
+
+
+@jax.jit
+def doc_length(state: DocState, ref_seq, client):
+    _, vlen, _ = visibility(state, ref_seq, client)
+    return jnp.sum(vlen)

@@ -1,0 +1,84 @@
+"""Packing host op streams into device tensors.
+
+The hot path never iterates Python objects: ops are packed into int32
+columns [B, T] (documents x time), padded with NOOP rows, and the kernel
+scans over T applying one op per document per step.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class OpKind:
+    NOOP = 0
+    INSERT = 1
+    REMOVE = 2
+    ANNOTATE = 3
+    ACK_INSERT = 4
+    ACK_REMOVE = 5
+
+
+@dataclass
+class HostOp:
+    """One op in host form, positions relative to (ref_seq, client)."""
+
+    kind: int
+    seq: int            # DEV_UNASSIGNED for a pending local submit
+    ref_seq: int
+    client: int
+    pos1: int = 0
+    pos2: int = 0       # remove/annotate end (exclusive)
+    op_id: int = -1     # global id: insert text payload / annotate pset
+    new_len: int = 0    # insert payload length
+    local_seq: int = 0  # local seq for pending submits; ack target
+    msn: int = 0
+
+
+class PackedOps(NamedTuple):
+    """Int32 op columns, each [B, T] (or [T] unbatched)."""
+
+    kind: jnp.ndarray
+    seq: jnp.ndarray
+    ref_seq: jnp.ndarray
+    client: jnp.ndarray
+    pos1: jnp.ndarray
+    pos2: jnp.ndarray
+    op_id: jnp.ndarray
+    new_len: jnp.ndarray
+    local_seq: jnp.ndarray
+    msn: jnp.ndarray
+
+    @property
+    def steps(self) -> int:
+        return self.kind.shape[-1]
+
+
+_FIELDS = ("kind", "seq", "ref_seq", "client", "pos1", "pos2", "op_id",
+           "new_len", "local_seq", "msn")
+
+
+def pack_ops(streams: List[List[HostOp]], steps: Optional[int] = None
+             ) -> PackedOps:
+    """Pack per-document op lists into [B, T] columns, NOOP-padded."""
+    b = len(streams)
+    t = steps if steps is not None else max((len(s) for s in streams), default=0)
+    t = max(t, 1)
+    cols = {f: np.zeros((b, t), np.int32) for f in _FIELDS}
+    for d, stream in enumerate(streams):
+        if len(stream) > t:
+            raise ValueError(f"doc {d}: {len(stream)} ops > {t} steps")
+        for i, op in enumerate(stream):
+            for f in _FIELDS:
+                cols[f][d, i] = getattr(op, f)
+    return PackedOps(**{f: jnp.asarray(cols[f]) for f in _FIELDS})
+
+
+def pack_single(stream: List[HostOp], steps: Optional[int] = None) -> PackedOps:
+    """Pack one document's ops into unbatched [T] columns."""
+    packed = pack_ops([stream], steps)
+    return PackedOps(**{f: getattr(packed, f)[0] for f in _FIELDS})

@@ -1,0 +1,139 @@
+"""Device-side merge-tree state: structure-of-arrays segment tables.
+
+The reference's pointer B-tree (mergeTree.ts:334 MaxNodesInBlock=8) becomes
+flat int32 arrays in document order. Position resolution = masked prefix sum
+under a (refSeq, clientId) visibility predicate; inserts/splits = shift
+gathers; everything batches over a leading documents axis.
+
+Payloads stay host-side: a segment's text is (origin_op, origin_off, length)
+into a host op->text table; properties are a device-side linked list of
+(op id) edges resolved host-side at summary time (SURVEY.md §7 hard parts:
+"props are JSON-shaped: keep props host-side behind integer refs").
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .constants import DEV_NO_REMOVE, DEV_UNASSIGNED, MAX_OVERLAP_CLIENTS
+
+
+class DocState(NamedTuple):
+    """One document's segment table (or a batch with a leading axis).
+
+    Segment columns, shape [C] (capacity; slots >= count are padding):
+      length      visible length contribution when the segment is visible
+      ins_seq     sequence number of the insert; DEV_UNASSIGNED = pending
+      ins_client  inserting client (>= 0; host interns string ids)
+      local_seq   local sequence number while pending, else 0
+      rem_seq     DEV_NO_REMOVE = never removed; DEV_UNASSIGNED = pending
+      rem_local_seq  local seq of a pending local remove, else 0
+      rem_clients [C, K] removing client + overlap clients (-1 = free slot)
+      origin_op   global op id whose payload this segment's text comes from
+      origin_off  offset into that op's payload (splits advance this)
+      anno_head   head of the annotate edge list (-1 = none)
+
+    Annotate edge pool, shape [E] (append-only per document):
+      edge_op     global op id of the annotate
+      edge_prev   previous edge for the same segment (-1 = end)
+
+    Scalars: count, edge_count, min_seq, seq (latest applied), overflow.
+    """
+
+    length: jnp.ndarray
+    ins_seq: jnp.ndarray
+    ins_client: jnp.ndarray
+    local_seq: jnp.ndarray
+    rem_seq: jnp.ndarray
+    rem_local_seq: jnp.ndarray
+    rem_clients: jnp.ndarray
+    origin_op: jnp.ndarray
+    origin_off: jnp.ndarray
+    anno_head: jnp.ndarray
+    edge_op: jnp.ndarray
+    edge_prev: jnp.ndarray
+    count: jnp.ndarray
+    edge_count: jnp.ndarray
+    min_seq: jnp.ndarray
+    seq: jnp.ndarray
+    overflow: jnp.ndarray
+
+    @property
+    def capacity(self) -> int:
+        return self.length.shape[-1]
+
+    @property
+    def edge_capacity(self) -> int:
+        return self.edge_op.shape[-1]
+
+
+SEGMENT_COLUMNS = ("length", "ins_seq", "ins_client", "local_seq", "rem_seq",
+                   "rem_local_seq", "rem_clients", "origin_op", "origin_off",
+                   "anno_head")
+
+
+def make_state(capacity: int, edge_capacity: int = 0,
+               overlap_slots: int = MAX_OVERLAP_CLIENTS,
+               batch: int | None = None) -> DocState:
+    """Fresh empty state; batch=None for a single doc, int for [B, ...]."""
+    def shape(*dims):
+        return dims if batch is None else (batch, *dims)
+
+    def zeros(*dims):
+        return jnp.zeros(shape(*dims), jnp.int32)
+
+    def full(value, *dims):
+        return jnp.full(shape(*dims), value, jnp.int32)
+
+    e = max(edge_capacity, 1)
+    return DocState(
+        length=zeros(capacity),
+        ins_seq=full(DEV_UNASSIGNED, capacity),
+        ins_client=full(-1, capacity),
+        local_seq=zeros(capacity),
+        rem_seq=full(DEV_NO_REMOVE, capacity),
+        rem_local_seq=zeros(capacity),
+        rem_clients=full(-1, capacity, overlap_slots),
+        origin_op=full(-1, capacity),
+        origin_off=zeros(capacity),
+        anno_head=full(-1, capacity),
+        edge_op=full(-1, e),
+        edge_prev=full(-1, e),
+        count=zeros(),
+        edge_count=zeros(),
+        min_seq=zeros(),
+        seq=zeros(),
+        overflow=jnp.zeros(shape(), jnp.bool_),
+    )
+
+
+def state_from_numpy(columns: dict, capacity: int, edge_capacity: int = 0,
+                     overlap_slots: int = MAX_OVERLAP_CLIENTS) -> DocState:
+    """Build single-doc state from host numpy columns of length n <= capacity."""
+    n = len(columns["length"])
+    if n > capacity:
+        raise ValueError(f"{n} segments exceed capacity {capacity}")
+    base = make_state(capacity, edge_capacity, overlap_slots)
+
+    def put(col, dst):
+        arr = np.asarray(columns.get(col, np.asarray(dst)[:n]), np.int32)
+        return jnp.asarray(np.concatenate(
+            [arr, np.asarray(dst)[n:]]).astype(np.int32))
+
+    rem_clients = np.asarray(base.rem_clients)
+    if "rem_client" in columns:
+        rem_clients = rem_clients.copy()
+        rem_clients[:n, 0] = np.asarray(columns["rem_client"], np.int32)
+    return base._replace(
+        length=put("length", base.length),
+        ins_seq=put("ins_seq", base.ins_seq),
+        ins_client=put("ins_client", base.ins_client),
+        rem_seq=put("rem_seq", base.rem_seq),
+        origin_op=put("origin_op", base.origin_op),
+        origin_off=put("origin_off", base.origin_off),
+        rem_clients=jnp.asarray(rem_clients),
+        count=jnp.asarray(n, jnp.int32),
+    )

@@ -1,0 +1,271 @@
+"""Device-kernel conformance vs the scalar oracle.
+
+The reference validates merge-tree with deterministic unit tests plus
+randomized farms (SURVEY.md §4.1-4.2). Here the kernel must reproduce the
+oracle exactly: same text at every perspective, same resolved props, on
+random sequenced schedules and on client-mode pending/ack schedules.
+"""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.mergetree import MergeTreeOracle
+from fluidframework_tpu.mergetree.constants import DEV_UNASSIGNED, UNASSIGNED_SEQ
+from fluidframework_tpu.mergetree import kernel
+from fluidframework_tpu.mergetree.host import (
+    GOD_CLIENT,
+    OpBuilder,
+    PayloadTable,
+    extract_segments,
+    extract_text,
+)
+from fluidframework_tpu.mergetree.oppack import pack_ops, pack_single
+from fluidframework_tpu.mergetree.state import make_state
+
+GOD = GOD_CLIENT
+
+
+def apply_to_oracle(tree, op_tuples):
+    for op in op_tuples:
+        kind = op[0]
+        if kind == "insert":
+            _, pos, text, ref_seq, client, seq = op
+            tree.insert_text(pos, text, ref_seq, client, seq)
+        elif kind == "remove":
+            _, start, end, ref_seq, client, seq = op
+            tree.remove_range(start, end, ref_seq, client, seq)
+        else:
+            _, start, end, props, ref_seq, client, seq = op
+            tree.annotate_range(start, end, props, ref_seq, client, seq)
+        tree.update_seq(op[-1])
+
+
+def build_kernel_ops(builder, op_tuples):
+    ops = []
+    for op in op_tuples:
+        kind = op[0]
+        if kind == "insert":
+            _, pos, text, ref_seq, client, seq = op
+            ops.append(builder.insert_text(pos, text, ref_seq, client, seq))
+        elif kind == "remove":
+            _, start, end, ref_seq, client, seq = op
+            ops.append(builder.remove(start, end, ref_seq, client, seq))
+        else:
+            _, start, end, props, ref_seq, client, seq = op
+            ops.append(builder.annotate(start, end, props, ref_seq, client, seq))
+    return ops
+
+
+def run_both(op_tuples, capacity=128, edges=256):
+    tree = MergeTreeOracle(local_client=GOD)
+    apply_to_oracle(tree, op_tuples)
+    builder = OpBuilder()
+    ops = build_kernel_ops(builder, op_tuples)
+    state = make_state(capacity, edges)
+    state = kernel.apply_ops(state, pack_single(ops))
+    assert not bool(state.overflow), "kernel overflow"
+    return tree, state, builder.payloads
+
+
+def assert_match(tree, state, payloads, perspectives):
+    for ref_seq, client in perspectives:
+        expect = tree.get_text(ref_seq=ref_seq, client=client)
+        got = extract_text(state, payloads, ref_seq=ref_seq, client=client)
+        assert got == expect, (
+            f"text mismatch at (refSeq={ref_seq}, client={client}): "
+            f"kernel={got!r} oracle={expect!r}")
+    # Resolved props at the latest view.
+    oracle_segs = []
+    for s in tree.segments:
+        if tree.visible_length(s, tree.current_seq, GOD) > 0:
+            oracle_segs.append((s.text if s.kind == 0 else "￼",
+                                s.props or None))
+    kernel_segs = extract_segments(state, payloads)
+    # Segment boundaries may differ (coalescing); compare flattened runs.
+    assert flatten_runs(kernel_segs) == flatten_runs(oracle_segs)
+
+
+def flatten_runs(segs):
+    out = []
+    for text, props in segs:
+        for ch in text:
+            out.append((ch, tuple(sorted((props or {}).items(),
+                                         key=lambda kv: kv[0]))))
+    return out
+
+
+class TestKernelBasics:
+    def test_insert_sequence(self):
+        ops = [("insert", 0, "hello", 0, 1, 1),
+               ("insert", 5, " world", 1, 1, 2)]
+        tree, state, payloads = run_both(ops)
+        assert extract_text(state, payloads) == "hello world"
+        assert_match(tree, state, payloads, [(2, GOD), (1, GOD)])
+
+    def test_insert_split(self):
+        ops = [("insert", 0, "abcd", 0, 1, 1),
+               ("insert", 2, "XY", 1, 1, 2)]
+        tree, state, payloads = run_both(ops)
+        assert extract_text(state, payloads) == "abXYcd"
+
+    def test_concurrent_inserts_newer_first(self):
+        ops = [("insert", 0, "AAA", 0, 1, 1),
+               ("insert", 0, "BBB", 0, 2, 2)]
+        tree, state, payloads = run_both(ops)
+        assert extract_text(state, payloads) == "BBBAAA"
+
+    def test_remove_and_tombstone_skip(self):
+        ops = [("insert", 0, "abcdef", 0, 1, 1),
+               ("remove", 2, 4, 1, 1, 2),
+               ("insert", 2, "XX", 2, 2, 3)]
+        tree, state, payloads = run_both(ops)
+        assert extract_text(state, payloads) == "abXXef"
+        assert_match(tree, state, payloads,
+                     [(3, GOD), (2, GOD), (1, GOD), (0, GOD)])
+
+    def test_insert_into_concurrently_removed(self):
+        ops = [("insert", 0, "abcdef", 0, 1, 1),
+               ("remove", 0, 6, 1, 1, 2),
+               ("insert", 3, "XY", 1, 2, 3)]
+        tree, state, payloads = run_both(ops)
+        assert extract_text(state, payloads) == "XY"
+
+    def test_overlapping_removes(self):
+        ops = [("insert", 0, "abcdef", 0, 1, 1),
+               ("remove", 1, 3, 1, 1, 2),
+               ("remove", 1, 5, 1, 2, 3)]
+        tree, state, payloads = run_both(ops)
+        assert extract_text(state, payloads) == "af"
+        assert_match(tree, state, payloads, [(3, GOD), (2, GOD), (2, 2)])
+
+    def test_annotate_lww(self):
+        ops = [("insert", 0, "abcd", 0, 1, 1),
+               ("annotate", 0, 4, {"bold": True}, 1, 1, 2),
+               ("annotate", 1, 3, {"bold": None, "em": 1}, 1, 2, 3)]
+        tree, state, payloads = run_both(ops)
+        assert_match(tree, state, payloads, [(3, GOD)])
+
+    def test_compact_preserves_visible_text(self):
+        ops = [("insert", 0, "aaa", 0, 1, 1),
+               ("insert", 3, "bbb", 1, 1, 2),
+               ("remove", 2, 4, 2, 1, 3)]
+        tree, state, payloads = run_both(ops)
+        state = state._replace(min_seq=state.min_seq * 0 + 3)
+        state = kernel.compact(state)
+        assert extract_text(state, payloads) == "aabb"
+        assert int(state.count) < 4 + 2
+
+
+def random_schedule(rng, n_clients, n_ops):
+    """Random *sequenced* schedule: each op's refSeq < its seq, positions
+    valid at its own perspective (simulated via a shadow oracle)."""
+    shadow = MergeTreeOracle(local_client=GOD)
+    ops = []
+    seq = 0
+    for _ in range(n_ops):
+        seq += 1
+        client = rng.randint(1, n_clients)
+        # Anything the client could have seen: refSeq in [seen_floor, seq-1].
+        ref_seq = rng.randint(max(0, seq - 1 - rng.randint(0, 6)), seq - 1)
+        length = shadow.get_length(ref_seq=ref_seq, client=client)
+        choice = rng.random()
+        if length == 0 or choice < 0.5:
+            pos = rng.randint(0, length)
+            text = "".join(rng.choice("abcdefgh")
+                           for _ in range(rng.randint(1, 3)))
+            op = ("insert", pos, text, ref_seq, client, seq)
+        elif choice < 0.8:
+            start = rng.randint(0, length - 1)
+            end = rng.randint(start + 1, min(length, start + 5))
+            op = ("remove", start, end, ref_seq, client, seq)
+        else:
+            start = rng.randint(0, length - 1)
+            end = rng.randint(start + 1, min(length, start + 5))
+            key = rng.choice(["a", "b"])
+            val = rng.choice([1, 2, None])
+            op = ("annotate", start, end, {key: val}, ref_seq, client, seq)
+        apply_to_oracle(shadow, [op])
+        ops.append(op)
+    return ops
+
+
+class TestKernelFuzz:
+    @pytest.mark.parametrize("seed", range(10))
+    def test_random_sequenced_schedules(self, seed):
+        rng = random.Random(seed)
+        ops = random_schedule(rng, n_clients=4, n_ops=30)
+        tree, state, payloads = run_both(ops, capacity=256, edges=512)
+        last = ops[-1][-1]
+        perspectives = [(last, GOD)] + [
+            (rng.randint(0, last), rng.choice([GOD, 1, 2, 3, 4]))
+            for _ in range(6)]
+        assert_match(tree, state, payloads, perspectives)
+
+    def test_batched_matches_single(self):
+        rng = random.Random(42)
+        schedules = [random_schedule(rng, 3, 20) for _ in range(5)]
+        trees = []
+        builders = []
+        all_ops = []
+        for ops in schedules:
+            tree = MergeTreeOracle(local_client=GOD)
+            apply_to_oracle(tree, ops)
+            trees.append(tree)
+            b = OpBuilder()
+            all_ops.append(build_kernel_ops(b, ops))
+            builders.append(b)
+        state = make_state(256, 512, batch=len(schedules))
+        state = kernel.apply_ops_batched(state, pack_ops(all_ops))
+        for d, (tree, b) in enumerate(zip(trees, builders)):
+            got = extract_text(state, b.payloads, doc=d)
+            assert got == tree.get_text(), f"doc {d} mismatch"
+
+
+class TestKernelClientMode:
+    """Pending local ops + acks on device must match the oracle replica."""
+
+    def test_pending_then_ack(self):
+        # Client 1 types locally, then remote insert arrives, then ack.
+        tree = MergeTreeOracle(local_client=1)
+        tree.insert_text(0, "abc", 0, 1, UNASSIGNED_SEQ)
+        builder = OpBuilder()
+        k_ops = [builder.insert_text(0, "abc", 0, 1, DEV_UNASSIGNED)]
+        # Remote op from client 2 sequenced first.
+        tree.insert_text(0, "ZZ", 0, 2, 1)
+        tree.update_seq(1)
+        k_ops.append(builder.insert_text(0, "ZZ", 0, 2, 1))
+        # Our op acked as seq 2.
+        tree.ack(2)
+        k_ops.append(builder.ack_insert(local_seq=1, seq=2))
+        state = make_state(64, 64)
+        state = kernel.apply_ops(state, pack_single(k_ops))
+        got = extract_text(state, builder.payloads, ref_seq=2, client=1)
+        assert got == tree.get_text() == "abcZZ"
+
+    def test_pending_remove_overwritten_by_remote(self):
+        tree = MergeTreeOracle(local_client=1)
+        builder = OpBuilder()
+        k_ops = []
+        # Acked base text.
+        tree.insert_text(0, "abcdef", 0, 1, UNASSIGNED_SEQ)
+        k_ops.append(builder.insert_text(0, "abcdef", 0, 1, DEV_UNASSIGNED))
+        tree.ack(1)
+        k_ops.append(builder.ack_insert(local_seq=1, seq=1))
+        # Local pending remove [1, 4).
+        tree.remove_range(1, 4, 1, 1, UNASSIGNED_SEQ)
+        k_ops.append(builder.remove(1, 4, 1, 1, DEV_UNASSIGNED))
+        # Remote remove [2, 5) sequenced first (overlaps ours).
+        tree.remove_range(2, 5, 1, 2, 2)
+        tree.update_seq(2)
+        k_ops.append(builder.remove(2, 5, 1, 2, 2))
+        # Our remove acked at seq 3: overlapped chars keep seq 2.
+        tree.ack(3)
+        k_ops.append(builder.ack_remove(local_seq=2, seq=3))
+        state = make_state(64, 64)
+        state = kernel.apply_ops(state, pack_single(k_ops))
+        for persp in [(3, 1), (3, GOD), (2, GOD), (1, GOD)]:
+            got = extract_text(state, builder.payloads, ref_seq=persp[0],
+                               client=persp[1])
+            expect = tree.get_text(ref_seq=persp[0], client=persp[1])
+            assert got == expect, f"mismatch at {persp}: {got!r} != {expect!r}"
