@@ -1,0 +1,283 @@
+"""SharedMatrix: 2-D cells addressed through two permutation vectors.
+
+Capability parity with reference packages/dds/matrix/src/{matrix.ts:75,
+permutationvector.ts:126}: rows and columns are each a merge-tree sequence
+of *runs* of stable ids (the reference's handle allocation becomes run
+payloads carrying (client, counter, offset) ids — the same origin-lineage
+trick the device kernel uses for text). Cells live in a sparse dict keyed by
+stable (row_id, col_id), so cell writes never conflict with row/col
+insertion or removal; set-vs-set conflicts resolve LWW with pending-local
+shadowing (reference conflict-resolution + handle recycling via zamboni).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..mergetree.client import MergeTreeClient, OP_INSERT, OP_REMOVE
+from ..mergetree.constants import SEG_TEXT, UNASSIGNED_SEQ
+from ..protocol.summary import SummaryTree
+from .shared_object import SharedObject, collect_handles
+
+
+class Run:
+    """A sliceable run of stable ids: (base, start+k) for k < length.
+
+    base = (client_ordinal, per-client-run counter) makes ids globally
+    unique and replica-consistent without coordination.
+    """
+
+    __slots__ = ("base", "start", "length")
+
+    def __init__(self, base: Tuple[int, int], start: int, length: int):
+        self.base = base
+        self.start = start
+        self.length = length
+
+    def __len__(self) -> int:
+        return self.length
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            lo, hi, step = key.indices(self.length)
+            assert step == 1
+            return Run(self.base, self.start + lo, max(0, hi - lo))
+        if key < 0:
+            key += self.length
+        return (self.base[0], self.base[1], self.start + key)
+
+    def ids(self) -> List[Tuple[int, int, int]]:
+        return [(self.base[0], self.base[1], self.start + k)
+                for k in range(self.length)]
+
+    def encode(self) -> list:
+        return [self.base[0], self.base[1], self.start, self.length]
+
+    @staticmethod
+    def decode(data: list) -> "Run":
+        return Run((data[0], data[1]), data[2], data[3])
+
+
+def _id_key(stable_id: Tuple[int, int, int]) -> str:
+    return f"{stable_id[0]}.{stable_id[1]}.{stable_id[2]}"
+
+
+class PermutationVector:
+    """A merge-tree client whose payloads are Runs (reference
+    permutationvector.ts: PermutationVector extends Client).
+
+    Run id bases use a per-session random nonce, not the client ordinal:
+    the base ships inside the insert op, so replica consistency never
+    depends on join timing (a pre-join insert must not collide)."""
+
+    def __init__(self, client_id: int = -1):
+        self.client = MergeTreeClient(client_id)
+        self.run_counter = 0
+        self.nonce = random.getrandbits(48)
+
+    @property
+    def tree(self):
+        return self.client.tree
+
+    def count(self) -> int:
+        return self.client.get_length()
+
+    def insert_local(self, pos: int, count: int) -> dict:
+        self.run_counter += 1
+        run = Run((self.nonce, self.run_counter), 0, count)
+        tree = self.client.tree
+        from ..mergetree.oracle import Segment
+        seg = Segment(kind=SEG_TEXT, text=run)
+        tree.insert(pos, seg, tree.current_seq, self.client.client_id,
+                    UNASSIGNED_SEQ)
+        return {"type": OP_INSERT, "pos1": pos,
+                "seg": {"run": run.encode()}}
+
+    def remove_local(self, pos: int, count: int) -> dict:
+        return self.client.remove_range_local(pos, pos + count)
+
+    def apply_remote(self, op: dict, seq: int, ref_seq: int, client: int):
+        if op["type"] == OP_INSERT:
+            run = Run.decode(op["seg"]["run"])
+            tree = self.client.tree
+            from ..mergetree.oracle import Segment
+            seg = Segment(kind=SEG_TEXT, text=run)
+            tree.insert(op["pos1"], seg, ref_seq, client, seq)
+            tree.update_seq(seq)
+        else:
+            self.client.apply_msg(op, seq, ref_seq, client)
+
+    def ack(self, seq: int) -> None:
+        self.client.tree.ack(seq)
+        self.client.tree.update_seq(seq)
+
+    def ids_in_order(self) -> List[Tuple[int, int, int]]:
+        tree = self.client.tree
+        out: List[Tuple[int, int, int]] = []
+        for seg in tree.segments:
+            if tree.visible_length(seg, tree.current_seq,
+                                   self.client.client_id) > 0:
+                out.extend(seg.text.ids())
+        return out
+
+    def id_at(self, index: int) -> Tuple[int, int, int]:
+        tree = self.client.tree
+        acc = 0
+        for seg in tree.segments:
+            vlen = tree.visible_length(seg, tree.current_seq,
+                                       self.client.client_id)
+            if acc + vlen > index:
+                return seg.text[index - acc]
+            acc += vlen
+        raise IndexError(index)
+
+    def snapshot(self) -> dict:
+        snap = self.client.snapshot()
+        for entry in snap["segments"]:
+            if isinstance(entry.get("text"), Run):
+                entry["text"] = {"run": entry["text"].encode()}
+        return snap
+
+    def load(self, snap: dict, client_id: int) -> None:
+        for entry in snap["segments"]:
+            if isinstance(entry.get("text"), dict) and "run" in entry["text"]:
+                entry["text"] = Run.decode(entry["text"]["run"])
+        self.client = MergeTreeClient.load(snap, client_id=client_id)
+        self.run_counter = 0
+
+
+class SharedMatrix(SharedObject):
+    TYPE = "https://graph.microsoft.com/types/sharedmatrix"
+
+    def __init__(self, object_id: str, runtime=None):
+        super().__init__(object_id, runtime)
+        self.rows = PermutationVector(self.local_client_id)
+        self.cols = PermutationVector(self.local_client_id)
+        # cell key "(rowid,colid)" -> value; pending LWW shadow counts
+        self.cells: Dict[str, Any] = {}
+        self._pending_cells: Dict[str, int] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+    def adopt_client_ordinal(self, ordinal: int) -> None:
+        self.rows.client.update_client_id(ordinal)
+        self.cols.client.update_client_id(ordinal)
+
+    def connect(self) -> None:
+        if not self.attached:
+            self.rows.client.commit_detached()
+            self.cols.client.commit_detached()
+            self._pending_cells.clear()
+        super().connect()
+
+    # -- dimensions --------------------------------------------------------
+    @property
+    def row_count(self) -> int:
+        return self.rows.count()
+
+    @property
+    def col_count(self) -> int:
+        return self.cols.count()
+
+    def insert_rows(self, pos: int, count: int) -> None:
+        op = self.rows.insert_local(pos, count)
+        self.submit_local_message({"target": "rows", "op": op})
+        self.emit("rowsChanged", pos, count, True)
+
+    def insert_cols(self, pos: int, count: int) -> None:
+        op = self.cols.insert_local(pos, count)
+        self.submit_local_message({"target": "cols", "op": op})
+        self.emit("colsChanged", pos, count, True)
+
+    def remove_rows(self, pos: int, count: int) -> None:
+        op = self.rows.remove_local(pos, count)
+        self.submit_local_message({"target": "rows", "op": op})
+        self.emit("rowsChanged", pos, -count, True)
+
+    def remove_cols(self, pos: int, count: int) -> None:
+        op = self.cols.remove_local(pos, count)
+        self.submit_local_message({"target": "cols", "op": op})
+        self.emit("colsChanged", pos, -count, True)
+
+    # -- cells ---------------------------------------------------------------
+    def _cell_key(self, row: int, col: int) -> str:
+        return _id_key(self.rows.id_at(row)) + "|" + \
+            _id_key(self.cols.id_at(col))
+
+    def set_cell(self, row: int, col: int, value: Any) -> None:
+        key = self._cell_key(row, col)
+        self.cells[key] = value
+        self._pending_cells[key] = self._pending_cells.get(key, 0) + 1
+        self.submit_local_message(
+            {"target": "cell", "key": key, "value": value})
+        self.emit("cellChanged", row, col, value, True)
+
+    def get_cell(self, row: int, col: int) -> Any:
+        return self.cells.get(self._cell_key(row, col))
+
+    def extract(self) -> List[List[Any]]:
+        row_ids = [_id_key(r) for r in self.rows.ids_in_order()]
+        col_ids = [_id_key(c) for c in self.cols.ids_in_order()]
+        return [[self.cells.get(r + "|" + c) for c in col_ids]
+                for r in row_ids]
+
+    # -- processing ----------------------------------------------------------
+    def process_core(self, contents, local, seq, ref_seq, client_ordinal,
+                     min_seq) -> None:
+        target = contents["target"]
+        if target == "cell":
+            key = contents["key"]
+            if local:
+                n = self._pending_cells.get(key, 0)
+                if n > 1:
+                    self._pending_cells[key] = n - 1
+                else:
+                    self._pending_cells.pop(key, None)
+                return
+            if key in self._pending_cells:
+                return  # pending local write shadows (reference set-vs-set)
+            self.cells[key] = contents["value"]
+            self.emit("cellChanged", None, None, contents["value"], False)
+            return
+        vector = self.rows if target == "rows" else self.cols
+        if local:
+            vector.ack(seq)
+        else:
+            vector.apply_remote(contents["op"], seq, ref_seq, client_ordinal)
+
+    def resubmit_pending(self) -> List[Any]:
+        ops = []
+        for op in self.rows.client.regenerate_pending_ops():
+            if "seg" in op and isinstance(op["seg"].get("text"), Run):
+                op["seg"] = {"run": op["seg"]["text"].encode()}
+            ops.append({"target": "rows", "op": op})
+        for op in self.cols.client.regenerate_pending_ops():
+            if "seg" in op and isinstance(op["seg"].get("text"), Run):
+                op["seg"] = {"run": op["seg"]["text"].encode()}
+            ops.append({"target": "cols", "op": op})
+        for key in self._pending_cells:
+            self._pending_cells[key] = 1
+            ops.append({"target": "cell", "key": key,
+                        "value": self.cells.get(key)})
+        return ops
+
+    # -- summary -------------------------------------------------------------
+    def summarize_core(self) -> SummaryTree:
+        tree = SummaryTree()
+        tree.add_blob("rows", json.dumps(self.rows.snapshot()))
+        tree.add_blob("cols", json.dumps(self.cols.snapshot()))
+        tree.add_blob("cells", json.dumps(self.cells, sort_keys=True))
+        return tree
+
+    def load_core(self, tree: SummaryTree) -> None:
+        self.rows.load(json.loads(tree.entries["rows"].content),
+                       self.local_client_id)
+        self.cols.load(json.loads(tree.entries["cols"].content),
+                       self.local_client_id)
+        self.cells = json.loads(tree.entries["cells"].content)
+
+    def get_gc_data(self) -> List[str]:
+        routes: List[str] = []
+        collect_handles(self.cells, routes)
+        return routes

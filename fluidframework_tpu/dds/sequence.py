@@ -1,0 +1,122 @@
+"""SharedSegmentSequence + SharedString over the merge-tree client.
+
+Capability parity with reference packages/dds/sequence/src/{sequence.ts:51,
+sharedString.ts:36}: text insert/remove/annotate, markers, position queries,
+delta events, snapshot (header + chunked body, snapshotV1.ts:33-40), and
+reconnect resubmission delegated to the merge-tree client's pending-op
+rewrite (client.ts:863).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, List, Optional
+
+from ..mergetree.client import MergeTreeClient
+from ..mergetree.constants import SNAPSHOT_CHUNK_SIZE
+from ..protocol.summary import SummaryTree
+from .shared_object import SharedObject
+
+
+class SharedSegmentSequence(SharedObject):
+    TYPE = "https://graph.microsoft.com/types/mergeTree"
+
+    def __init__(self, object_id: str, runtime=None):
+        super().__init__(object_id, runtime)
+        self.client = MergeTreeClient(client_id=self.local_client_id)
+        self.client.on("delta", lambda args, local:
+                       self.emit("sequenceDelta", args, local))
+
+    def bind_to_runtime(self, runtime) -> None:
+        super().bind_to_runtime(runtime)
+        # Adopt the runtime's client ordinal (retags pending segments too).
+        self.client.update_client_id(runtime.client_ordinal)
+
+    # -- queries -----------------------------------------------------------
+    def get_length(self) -> int:
+        return self.client.get_length()
+
+    # -- lifecycle ---------------------------------------------------------
+    def adopt_client_ordinal(self, ordinal: int) -> None:
+        self.client.update_client_id(ordinal)
+
+    def connect(self) -> None:
+        if not self.attached and self.client.tree.pending_groups:
+            # Detached edits fold into the attach summary, not ops.
+            self.client.commit_detached()
+        super().connect()
+
+    # -- channel plumbing --------------------------------------------------
+    def process_core(self, contents, local, seq, ref_seq, client_ordinal,
+                     min_seq) -> None:
+        self.client.apply_msg(contents, seq, ref_seq, client_ordinal,
+                              min_seq=min_seq)
+
+    def resubmit_pending(self) -> List[Any]:
+        return self.client.regenerate_pending_ops()
+
+    def summarize_core(self) -> SummaryTree:
+        """Chunked snapshot: header with collab window + body chunks of
+        bounded size (reference snapshotV1.ts chunking, chunkSize=10000)."""
+        snap = self.client.snapshot()
+        segments = snap["segments"]
+        chunks: List[List[dict]] = [[]]
+        size = 0
+        for seg in segments:
+            seg_size = len(seg.get("text", "")) + 1
+            if size + seg_size > SNAPSHOT_CHUNK_SIZE and chunks[-1]:
+                chunks.append([])
+                size = 0
+            chunks[-1].append(seg)
+            size += seg_size
+        tree = SummaryTree()
+        tree.add_blob("header", json.dumps({
+            "seq": snap["seq"],
+            "minSeq": snap["minSeq"],
+            "chunkCount": len(chunks),
+        }))
+        for i, chunk in enumerate(chunks):
+            tree.add_blob(f"body_{i}", json.dumps(chunk))
+        return tree
+
+    def load_core(self, tree: SummaryTree) -> None:
+        header = json.loads(tree.entries["header"].content)
+        segments: List[dict] = []
+        for i in range(header["chunkCount"]):
+            segments.extend(json.loads(tree.entries[f"body_{i}"].content))
+        self.client = MergeTreeClient.load(
+            {"segments": segments, "seq": header["seq"],
+             "minSeq": header["minSeq"]},
+            client_id=self.local_client_id)
+        self.client.on("delta", lambda args, local:
+                       self.emit("sequenceDelta", args, local))
+
+
+class SharedString(SharedSegmentSequence):
+    """Reference sharedString.ts:36 API: collaborative rich text."""
+
+    TYPE = "https://graph.microsoft.com/types/mergeTree/string"
+
+    def insert_text(self, pos: int, text: str,
+                    props: Optional[dict] = None) -> None:
+        self.submit_local_message(
+            self.client.insert_text_local(pos, text, props))
+
+    def insert_marker(self, pos: int, props: Optional[dict] = None) -> None:
+        self.submit_local_message(self.client.insert_marker_local(pos, props))
+
+    def remove_text(self, start: int, end: int) -> None:
+        self.submit_local_message(self.client.remove_range_local(start, end))
+
+    def annotate_range(self, start: int, end: int, props: dict) -> None:
+        self.submit_local_message(
+            self.client.annotate_range_local(start, end, props))
+
+    def replace_text(self, start: int, end: int, text: str,
+                     props: Optional[dict] = None) -> None:
+        # Insert-then-remove in one turn (reference groupOperation shape).
+        self.insert_text(end, text, props)
+        self.remove_text(start, end)
+
+    def get_text(self) -> str:
+        return self.client.get_text()

@@ -1,0 +1,275 @@
+"""Merge-tree Client: one replica's engine + pending-op lifecycle.
+
+Capability parity with reference packages/dds/merge-tree/src/client.ts:42 —
+local edits (insertSegmentLocal :201), applying sequenced messages
+(applyMsg :805, applyRemoteOp :776), acking own ops, minSeq-driven zamboni,
+and reconnect resubmission (regeneratePendingOp :863,
+findReconnectionPostition :682): pending ops are rewritten against the
+current view before resubmit, dropping segments already removed remotely.
+
+The interactive path runs on the scalar oracle (single-op latency); bulk
+catch-up and server-side summarization run the same op streams through the
+device kernel (mergetree.kernel), which is conformance-locked to the oracle.
+
+Wire op shape mirrors reference ops.ts (IMergeTreeInsertMsg/RemoveMsg/
+AnnotateMsg/GroupMsg): {"type": 0|1|2|3, "pos1", "pos2", "seg", "props"}.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..core.events import TypedEventEmitter
+from .constants import SEG_MARKER, SEG_TEXT, UNASSIGNED_SEQ
+from .oracle import MergeTreeOracle, Segment
+
+# MergeTreeDeltaType (reference ops.ts:29)
+OP_INSERT = 0
+OP_REMOVE = 1
+OP_ANNOTATE = 2
+OP_GROUP = 3
+
+
+def make_insert_op(pos: int, seg: dict) -> dict:
+    return {"type": OP_INSERT, "pos1": pos, "seg": seg}
+
+def make_remove_op(start: int, end: int) -> dict:
+    return {"type": OP_REMOVE, "pos1": start, "pos2": end}
+
+def make_annotate_op(start: int, end: int, props: dict) -> dict:
+    return {"type": OP_ANNOTATE, "pos1": start, "pos2": end, "props": props}
+
+def make_group_op(ops: List[dict]) -> dict:
+    return {"type": OP_GROUP, "ops": ops}
+
+
+def text_seg(text: str, props: Optional[dict] = None) -> dict:
+    seg: Dict[str, Any] = {"text": text}
+    if props:
+        seg["props"] = props
+    return seg
+
+
+def marker_seg(props: Optional[dict] = None) -> dict:
+    seg: Dict[str, Any] = {"marker": True}
+    if props:
+        seg["props"] = props
+    return seg
+
+
+class MergeTreeClient(TypedEventEmitter):
+    """Events: "delta" (op_args, is_local) fired on every applied change."""
+
+    def __init__(self, client_id: int = -1):
+        super().__init__()
+        self.tree = MergeTreeOracle(local_client=client_id)
+        self.client_id = client_id
+
+    # -- queries -----------------------------------------------------------
+    @property
+    def current_seq(self) -> int:
+        return self.tree.current_seq
+
+    def get_length(self) -> int:
+        return self.tree.get_length()
+
+    def get_text(self) -> str:
+        return self.tree.get_text()
+
+    # -- local edits (return the wire op to submit) ------------------------
+    def insert_text_local(self, pos: int, text: str,
+                          props: Optional[dict] = None) -> dict:
+        self.tree.insert_text(pos, text, self.tree.current_seq, self.client_id,
+                              UNASSIGNED_SEQ, props=props)
+        self.emit("delta", {"op": "insert", "pos": pos, "text": text}, True)
+        return make_insert_op(pos, text_seg(text, props))
+
+    def insert_marker_local(self, pos: int,
+                            props: Optional[dict] = None) -> dict:
+        self.tree.insert_marker(pos, self.tree.current_seq, self.client_id,
+                                UNASSIGNED_SEQ, props=props)
+        self.emit("delta", {"op": "insertMarker", "pos": pos}, True)
+        return make_insert_op(pos, marker_seg(props))
+
+    def remove_range_local(self, start: int, end: int) -> dict:
+        self.tree.remove_range(start, end, self.tree.current_seq,
+                               self.client_id, UNASSIGNED_SEQ)
+        self.emit("delta", {"op": "remove", "start": start, "end": end}, True)
+        return make_remove_op(start, end)
+
+    def annotate_range_local(self, start: int, end: int, props: dict) -> dict:
+        self.tree.annotate_range(start, end, props, self.tree.current_seq,
+                                 self.client_id, UNASSIGNED_SEQ)
+        self.emit("delta", {"op": "annotate", "start": start, "end": end,
+                            "props": props}, True)
+        return make_annotate_op(start, end, props)
+
+    # -- sequenced message application ------------------------------------
+    def apply_msg(self, op: dict, seq: int, ref_seq: int, client: int,
+                  min_seq: Optional[int] = None) -> None:
+        """Apply one sequenced merge-tree op (reference client.ts:805)."""
+        if client == self.client_id:
+            self._ack_op(op, seq)
+        else:
+            self._apply_remote(op, seq, ref_seq, client)
+        self.tree.update_seq(seq)
+        if min_seq is not None and min_seq > self.tree.min_seq:
+            self.tree.set_min_seq(min_seq)
+
+    def _apply_remote(self, op: dict, seq: int, ref_seq: int, client: int):
+        t = op["type"]
+        if t == OP_GROUP:
+            for sub in op["ops"]:
+                self._apply_remote(sub, seq, ref_seq, client)
+        elif t == OP_INSERT:
+            seg = op["seg"]
+            if seg.get("marker"):
+                self.tree.insert_marker(op["pos1"], ref_seq, client, seq,
+                                        props=seg.get("props"))
+            else:
+                self.tree.insert_text(op["pos1"], seg["text"], ref_seq, client,
+                                      seq, props=seg.get("props"))
+            self.emit("delta", {"op": "insert", "pos": op["pos1"],
+                                "seg": seg, "seq": seq}, False)
+        elif t == OP_REMOVE:
+            self.tree.remove_range(op["pos1"], op["pos2"], ref_seq, client, seq)
+            self.emit("delta", {"op": "remove", "start": op["pos1"],
+                                "end": op["pos2"], "seq": seq}, False)
+        elif t == OP_ANNOTATE:
+            self.tree.annotate_range(op["pos1"], op["pos2"], op["props"],
+                                     ref_seq, client, seq)
+            self.emit("delta", {"op": "annotate", "seq": seq}, False)
+
+    def _ack_op(self, op: dict, seq: int) -> None:
+        if op["type"] == OP_GROUP:
+            for _ in op["ops"]:
+                self.tree.ack(seq)
+        else:
+            self.tree.ack(seq)
+
+    # -- reconnect ---------------------------------------------------------
+    def regenerate_pending_ops(self) -> List[dict]:
+        """Rewrite all pending local ops against the current view for
+        resubmission after reconnect (reference client.ts:863
+        regeneratePendingOp + findReconnectionPostition :682).
+
+        Position math uses the op's original localSeq as a perspective cap:
+        pending edits with a *smaller* localSeq count (they will be
+        resubmitted first and thus precede this op at the server), later
+        ones do not. Two passes: compute every position against the original
+        localSeqs, then renumber/replace the pending groups so subsequent
+        acks pair with the regenerated ops.
+        """
+        tree = self.tree
+        old_groups = tree.pending_groups
+        # Pass 1: positions at the original localSeq perspectives.
+        plans = []  # (kind, [(seg, pos)], extra)
+        for kind, group, extra in old_groups:
+            cap = extra.get("local_seq", tree.local_seq_counter)
+            entries = []
+            for seg in group:
+                if kind == "insert" and (
+                        seg.local_seq is None or seg.ins_seq != UNASSIGNED_SEQ):
+                    continue  # already acked
+                if kind == "remove" and seg.rem_seq != UNASSIGNED_SEQ:
+                    continue  # a remote remove won while we were offline
+                if kind == "annotate" and seg.rem_seq is not None \
+                        and seg.rem_seq != UNASSIGNED_SEQ:
+                    self._drop_pending_props(seg, extra["props"])
+                    continue
+                entries.append((seg, self._pending_segment_position(seg, cap)))
+            plans.append((kind, entries, extra))
+        # Pass 2: rebuild groups in order with fresh localSeqs + emit ops.
+        tree.pending_groups = []
+        new_ops: List[dict] = []
+        for kind, entries, extra in plans:
+            for seg, pos in entries:
+                tree.local_seq_counter += 1
+                new_local = tree.local_seq_counter
+                if kind == "insert":
+                    seg.local_seq = new_local
+                    tree.pending_groups.append(
+                        ("insert", [seg], {"local_seq": new_local}))
+                    if seg.kind == SEG_MARKER:
+                        new_ops.append(make_insert_op(pos, marker_seg(seg.props)))
+                    else:
+                        new_ops.append(make_insert_op(
+                            pos, text_seg(seg.text, seg.props)))
+                elif kind == "remove":
+                    seg.rem_local_seq = new_local
+                    tree.pending_groups.append(
+                        ("remove", [seg], {"local_seq": new_local}))
+                    new_ops.append(make_remove_op(pos, pos + seg.length))
+                else:
+                    tree.pending_groups.append(
+                        ("annotate", [seg],
+                         {"props": extra["props"], "local_seq": new_local}))
+                    new_ops.append(make_annotate_op(
+                        pos, pos + seg.length, extra["props"]))
+        return new_ops
+
+    def _pending_segment_position(self, seg: Segment, local_seq_cap: int) -> int:
+        idx = self.tree.segments.index(seg)
+        tree = self.tree
+        return sum(
+            tree.visible_length(tree.segments[i], tree.current_seq,
+                                self.client_id, local_seq=local_seq_cap)
+            for i in range(idx))
+
+    def _drop_pending_props(self, seg: Segment, props: dict) -> None:
+        if seg.pending_props:
+            for key in props:
+                if seg.pending_props.get(key, 0) > 0:
+                    seg.pending_props[key] -= 1
+
+    # -- identity / lifecycle ---------------------------------------------
+    def update_client_id(self, new_id: int) -> None:
+        """Adopt a new client ordinal (join/reconnect): pending segments are
+        re-tagged so own-client visibility keeps holding (reference
+        startOrUpdateCollaboration semantics)."""
+        old = self.client_id
+        if new_id == old:
+            return
+        self.client_id = new_id
+        tree = self.tree
+        tree.local_client = new_id
+        for seg in tree.segments:
+            if seg.ins_client == old and seg.ins_seq == UNASSIGNED_SEQ:
+                seg.ins_client = new_id
+            if seg.rem_client == old and seg.rem_seq == UNASSIGNED_SEQ:
+                seg.rem_client = new_id
+            if old in seg.rem_overlap:
+                seg.rem_overlap = [new_id if c == old else c
+                                   for c in seg.rem_overlap]
+
+    def commit_detached(self) -> None:
+        """Fold pending local edits into base (universal) state — used when a
+        detached container attaches: its offline edits become part of the
+        attach summary rather than ops."""
+        tree = self.tree
+        for seg in tree.segments:
+            if seg.ins_seq == UNASSIGNED_SEQ:
+                seg.ins_seq = 0
+                seg.local_seq = None
+            if seg.rem_seq == UNASSIGNED_SEQ:
+                seg.rem_seq = 0
+                seg.rem_local_seq = None
+            seg.pending_props = None
+        tree.pending_groups = []
+        tree.zamboni()
+
+    # -- snapshot ----------------------------------------------------------
+    def snapshot(self) -> dict:
+        return {
+            "segments": self.tree.snapshot_segments(),
+            "seq": self.tree.current_seq,
+            "minSeq": self.tree.min_seq,
+        }
+
+    @staticmethod
+    def load(snap: dict, client_id: int = -1) -> "MergeTreeClient":
+        client = MergeTreeClient(client_id)
+        client.tree = MergeTreeOracle.load_segments(
+            snap["segments"], local_client=client_id,
+            min_seq=snap.get("minSeq", 0), current_seq=snap.get("seq", 0))
+        return client

@@ -161,6 +161,18 @@ class MergeTreeOracle:
                    for i in range(seg_index))
 
     # ------------------------------------------------------------------
+    # pending groups
+    # ------------------------------------------------------------------
+    def _new_pending_group(self, kind: str, **extra) -> List[Segment]:
+        """Allocate the next localSeq and enqueue a pending op group carrying
+        it (the regenerate position cap depends on this metadata)."""
+        self.local_seq_counter += 1
+        group: List[Segment] = []
+        extra["local_seq"] = self.local_seq_counter
+        self.pending_groups.append((kind, group, extra))
+        return group
+
+    # ------------------------------------------------------------------
     # splitting
     # ------------------------------------------------------------------
     def _next_uid(self) -> int:
@@ -168,9 +180,10 @@ class MergeTreeOracle:
         return self._uid_counter
 
     def _split(self, index: int, offset: int) -> None:
-        """Split segments[index] at text offset (0 < offset < length)."""
+        """Split segments[index] at payload offset (0 < offset < length).
+        Works for any sliceable payload (str text, matrix permutation runs)."""
         seg = self.segments[index]
-        assert 0 < offset < seg.length and seg.kind == SEG_TEXT
+        assert 0 < offset < seg.length and seg.kind != SEG_MARKER
         right = seg.clone_meta_for_split(self._next_uid(), seg.text[offset:])
         seg.text = seg.text[:offset]
         self.segments.insert(index + 1, right)
@@ -243,9 +256,8 @@ class MergeTreeOracle:
         seg.ins_client = client
         seg.uid = self._next_uid()
         if seq == UNASSIGNED_SEQ:
-            self.local_seq_counter += 1
+            self._new_pending_group("insert").append(seg)
             seg.local_seq = self.local_seq_counter
-            self.pending_groups.append(("insert", [seg], {}))
         self.segments.insert(idx, seg)
         return seg
 
@@ -305,9 +317,7 @@ class MergeTreeOracle:
                 seg.rem_client = client
                 if seq == UNASSIGNED_SEQ:
                     if pending_group is None:
-                        self.local_seq_counter += 1
-                        pending_group = []
-                        self.pending_groups.append(("remove", pending_group, {}))
+                        pending_group = self._new_pending_group("remove")
                     seg.rem_local_seq = self.local_seq_counter
                     pending_group.append(seg)
 
@@ -340,10 +350,8 @@ class MergeTreeOracle:
                               remote=(client != self.local_client))
             if local_pending:
                 if pending_group is None:
-                    self.local_seq_counter += 1
-                    pending_group = []
-                    self.pending_groups.append(
-                        ("annotate", pending_group, {"props": props}))
+                    pending_group = self._new_pending_group(
+                        "annotate", props=props)
                 pending_group.append(seg)
 
     def _apply_props(self, seg: Segment, props: Dict[str, Any],
@@ -422,6 +430,7 @@ class MergeTreeOracle:
     def _can_append(self, a: Segment, b: Segment) -> bool:
         return (
             a.kind == SEG_TEXT and b.kind == SEG_TEXT
+            and isinstance(a.text, str) and isinstance(b.text, str)
             and a.rem_seq is None and b.rem_seq is None
             and a.ins_seq != UNASSIGNED_SEQ and b.ins_seq != UNASSIGNED_SEQ
             and a.ins_seq <= self.min_seq and b.ins_seq <= self.min_seq

@@ -1,0 +1,136 @@
+"""DataStoreRuntime: per-data-store channel registry + op routing.
+
+Capability parity with reference packages/runtime/datastore/src/
+dataStoreRuntime.ts:89 (createChannel :340, bindChannel :375, process :472,
+submitMessage :698): owns the DDS channels of one data store, routes channel
+ops by address, aggregates summaries, and fans reconnect resubmission out to
+channels.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, TYPE_CHECKING
+
+from ..core.events import TypedEventEmitter
+from ..dds.shared_object import SharedObject
+from ..protocol.summary import SummaryTree
+
+if TYPE_CHECKING:
+    from .container_runtime import ContainerRuntime
+
+
+class ChannelRegistry:
+    """IChannelFactory registry (reference datastore-definitions channel.ts:134):
+    maps DDS type names to constructors."""
+
+    def __init__(self):
+        self._factories: Dict[str, Any] = {}
+
+    def register(self, cls) -> None:
+        self._factories[cls.TYPE] = cls
+
+    def create(self, type_name: str, object_id: str) -> SharedObject:
+        if type_name not in self._factories:
+            raise KeyError(f"no channel factory for {type_name!r}")
+        return self._factories[type_name](object_id)
+
+    def types(self) -> List[str]:
+        return list(self._factories)
+
+
+def default_registry() -> ChannelRegistry:
+    from ..dds.map import SharedMap
+    from ..dds.sequence import SharedString, SharedSegmentSequence
+    from ..dds.counter import SharedCounter
+    from ..dds.cell import SharedCell
+    from ..dds.directory import SharedDirectory
+    from ..dds.register_collection import ConsensusRegisterCollection
+    from ..dds.ordered_collection import ConsensusQueue
+    from ..dds.matrix import SharedMatrix
+    reg = ChannelRegistry()
+    for cls in (SharedMap, SharedString, SharedSegmentSequence, SharedCounter,
+                SharedCell, SharedDirectory, ConsensusRegisterCollection,
+                ConsensusQueue, SharedMatrix):
+        reg.register(cls)
+    return reg
+
+
+class DataStoreRuntime(TypedEventEmitter):
+    def __init__(self, store_id: str, container: "ContainerRuntime",
+                 registry: Optional[ChannelRegistry] = None):
+        super().__init__()
+        self.id = store_id
+        self.container = container
+        self.registry = registry or default_registry()
+        self.channels: Dict[str, SharedObject] = {}
+
+    @property
+    def client_ordinal(self) -> int:
+        return self.container.client_ordinal
+
+    @property
+    def attached(self) -> bool:
+        return self.container.attached
+
+    # -- channels ----------------------------------------------------------
+    def create_channel(self, object_id: str, type_name: str) -> SharedObject:
+        channel = self.registry.create(type_name, object_id)
+        channel.bind_to_runtime(self)
+        if self.attached:
+            channel.connect()
+        return channel
+
+    def bind_channel(self, channel: SharedObject) -> None:
+        if channel.id in self.channels:
+            raise ValueError(f"duplicate channel id {channel.id!r}")
+        self.channels[channel.id] = channel
+        channel.runtime = self
+
+    def get_channel(self, object_id: str) -> SharedObject:
+        return self.channels[object_id]
+
+    # -- op plumbing -------------------------------------------------------
+    def submit_channel_op(self, channel_id: str, contents: Any) -> None:
+        self.container.submit_datastore_op(
+            self.id, {"address": channel_id, "contents": contents})
+
+    def process(self, envelope: dict, local: bool, seq: int, ref_seq: int,
+                client_ordinal: int, min_seq: int) -> None:
+        channel = self.channels[envelope["address"]]
+        channel.process(envelope["contents"], local, seq, ref_seq,
+                        client_ordinal, min_seq)
+
+    def resubmit_pending(self) -> List[dict]:
+        ops = []
+        for channel_id, channel in self.channels.items():
+            for contents in channel.resubmit_pending():
+                ops.append({"address": channel_id, "contents": contents})
+        return ops
+
+    # -- attach / summary --------------------------------------------------
+    def connect(self) -> None:
+        for channel in self.channels.values():
+            channel.connect()
+
+    def summarize(self) -> SummaryTree:
+        tree = SummaryTree()
+        channels = tree.add_tree(".channels")
+        for channel_id, channel in sorted(self.channels.items()):
+            channels.entries[channel_id] = channel.summarize()
+        return tree
+
+    def load(self, tree: SummaryTree) -> None:
+        import json
+        channels = tree.entries[".channels"]
+        for channel_id, sub in channels.entries.items():
+            attrs = json.loads(sub.entries[".attributes"].content)
+            channel = self.registry.create(attrs["type"], channel_id)
+            channel.runtime = self
+            self.channels[channel_id] = channel
+            channel.load_core(sub)
+            if self.attached:
+                channel.connect()
+
+    def get_gc_data(self) -> Dict[str, List[str]]:
+        return {f"/{self.id}/{cid}": ch.get_gc_data()
+                for cid, ch in self.channels.items()}

@@ -1,0 +1,52 @@
+"""PendingStateManager: tracks in-flight local ops for ack and reconnect.
+
+Capability parity with reference packages/runtime/container-runtime/src/
+pendingStateManager.ts:56 — every submitted op is recorded; sequenced own
+ops must ack in submission order (a mismatch is data corruption); on
+reconnect the recorded ops are discarded and channels regenerate their
+pending work (merge-tree rewrites positions, map re-emits sets).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, List
+
+
+class DataCorruptionError(Exception):
+    """Ack arrived out of order vs submission (reference DataCorruptionError)."""
+
+
+@dataclass
+class PendingOp:
+    client_sequence_number: int
+    contents: Any
+
+
+class PendingStateManager:
+    def __init__(self):
+        self._pending: List[PendingOp] = []
+
+    @property
+    def count(self) -> int:
+        return len(self._pending)
+
+    def on_submit(self, client_sequence_number: int, contents: Any) -> None:
+        self._pending.append(PendingOp(client_sequence_number, contents))
+
+    def on_local_ack(self, client_sequence_number: int) -> PendingOp:
+        if not self._pending:
+            raise DataCorruptionError(
+                f"ack for csn {client_sequence_number} with nothing pending")
+        head = self._pending.pop(0)
+        if head.client_sequence_number != client_sequence_number:
+            raise DataCorruptionError(
+                f"out-of-order ack: expected csn "
+                f"{head.client_sequence_number}, got {client_sequence_number}")
+        return head
+
+    def drain(self) -> List[PendingOp]:
+        """Take all in-flight ops (reconnect: they are re-generated, not
+        replayed verbatim)."""
+        out, self._pending = self._pending, []
+        return out

@@ -1,0 +1,5 @@
+"""Test doubles (reference packages/runtime/test-runtime-utils parity):
+an in-process sequencing service + runtime wiring for DDS tests, including
+reconnection injection (mocksForReconnection.ts)."""
+
+from .mocks import MockSequencedEnvironment

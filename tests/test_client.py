@@ -1,0 +1,164 @@
+"""MergeTreeClient tests: op wire format, ack pairing, reconnect rewrite.
+
+Models the reference reconnectFarm (SURVEY.md §4.2): clients drop their
+in-flight ops, keep editing offline, then catch up and resubmit regenerated
+ops — every replica must converge.
+"""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.mergetree.client import MergeTreeClient
+
+
+class Sequencer:
+    """Mini ordering service: per-client FIFO queues, random interleave,
+    supports dropping a client's in-flight ops (disconnect)."""
+
+    def __init__(self, clients):
+        self.clients = {c.client_id: c for c in clients}
+        self.queues = {c.client_id: [] for c in clients}
+        self.connected = {c.client_id: True for c in clients}
+        self.buffered = {c.client_id: [] for c in clients}
+        self.seq = 0
+
+    def submit(self, client_id, op, ref_seq):
+        if self.connected[client_id]:
+            self.queues[client_id].append((op, ref_seq))
+
+    def disconnect(self, client_id):
+        self.connected[client_id] = False
+        self.queues[client_id].clear()  # in-flight ops are lost
+
+    def reconnect(self, client_id):
+        self.connected[client_id] = True
+        client = self.clients[client_id]
+        for args in self.buffered[client_id]:
+            client.apply_msg(*args)
+        self.buffered[client_id].clear()
+        for op in client.regenerate_pending_ops():
+            self.submit(client_id, op, client.current_seq)
+
+    def sequence_all(self, rng):
+        while True:
+            live = [cid for cid, q in self.queues.items() if q]
+            if not live:
+                break
+            cid = rng.choice(live)
+            op, ref_seq = self.queues[cid].pop(0)
+            self.seq += 1
+            for target_id, client in self.clients.items():
+                args = (op, self.seq, ref_seq, cid)
+                if self.connected[target_id]:
+                    client.apply_msg(*args)
+                elif target_id != cid:
+                    self.buffered[target_id].append(args)
+                # A disconnected author's op can't be in a queue (cleared),
+                # so cid is always connected here.
+
+
+class TestClientBasics:
+    def test_submit_ack_roundtrip(self):
+        a, b = MergeTreeClient(0), MergeTreeClient(1)
+        seqr = Sequencer([a, b])
+        op = a.insert_text_local(0, "hello")
+        seqr.submit(0, op, a.current_seq)
+        seqr.sequence_all(random.Random(0))
+        assert a.get_text() == b.get_text() == "hello"
+        assert not a.tree.pending_groups
+
+    def test_delta_events(self):
+        a, b = MergeTreeClient(0), MergeTreeClient(1)
+        events = []
+        b.on("delta", lambda args, local: events.append((args["op"], local)))
+        seqr = Sequencer([a, b])
+        seqr.submit(0, a.insert_text_local(0, "hi"), a.current_seq)
+        seqr.sequence_all(random.Random(0))
+        assert ("insert", False) in events
+
+    def test_snapshot_load(self):
+        a = MergeTreeClient(0)
+        b = MergeTreeClient(1)
+        seqr = Sequencer([a, b])
+        seqr.submit(0, a.insert_text_local(0, "abcdef"), a.current_seq)
+        seqr.submit(1, b.insert_text_local(0, "x"), b.current_seq)
+        seqr.sequence_all(random.Random(1))
+        snap = a.snapshot()
+        c = MergeTreeClient.load(snap, client_id=2)
+        assert c.get_text() == a.get_text()
+
+
+class TestReconnect:
+    def test_simple_resubmit(self):
+        a, b = MergeTreeClient(0), MergeTreeClient(1)
+        seqr = Sequencer([a, b])
+        rng = random.Random(0)
+        # a's op gets lost in flight.
+        a.insert_text_local(0, "lost?")
+        seqr.disconnect(0)
+        # b edits meanwhile.
+        seqr.submit(1, b.insert_text_local(0, "BBB"), b.current_seq)
+        seqr.sequence_all(rng)
+        seqr.reconnect(0)
+        seqr.sequence_all(rng)
+        assert a.get_text() == b.get_text()
+        assert "lost?" in a.get_text() and "BBB" in a.get_text()
+
+    def test_offline_edits_then_resubmit(self):
+        a, b = MergeTreeClient(0), MergeTreeClient(1)
+        seqr = Sequencer([a, b])
+        rng = random.Random(1)
+        seqr.submit(0, a.insert_text_local(0, "base text"), a.current_seq)
+        seqr.sequence_all(rng)
+        seqr.disconnect(0)
+        # Offline: a removes "base ", types "my "; b annotates + inserts.
+        a.remove_range_local(0, 5)
+        a.insert_text_local(0, "my ")
+        seqr.submit(1, b.insert_text_local(9, "!"), b.current_seq)
+        seqr.submit(1, b.remove_range_local(0, 4), b.current_seq)
+        seqr.sequence_all(rng)
+        seqr.reconnect(0)
+        seqr.sequence_all(rng)
+        assert a.get_text() == b.get_text()
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_reconnect_farm(self, seed):
+        rng = random.Random(seed)
+        clients = [MergeTreeClient(i) for i in range(4)]
+        seqr = Sequencer(clients)
+        for rnd in range(6):
+            for c in clients:
+                for _ in range(rng.randint(0, 2)):
+                    length = c.get_length()
+                    if length == 0 or rng.random() < 0.6:
+                        pos = rng.randint(0, length)
+                        text = "".join(rng.choice("abcdef")
+                                       for _ in range(rng.randint(1, 3)))
+                        op = c.insert_text_local(pos, text)
+                    elif rng.random() < 0.8:
+                        start = rng.randint(0, length - 1)
+                        end = rng.randint(start + 1, min(length, start + 4))
+                        op = c.remove_range_local(start, end)
+                    else:
+                        start = rng.randint(0, length - 1)
+                        end = rng.randint(start + 1, min(length, start + 4))
+                        op = c.annotate_range_local(start, end,
+                                                    {"k": rng.randint(0, 3)})
+                    seqr.submit(c.client_id, op, c.current_seq)
+            # Random disconnect/reconnect churn.
+            for c in clients:
+                if seqr.connected[c.client_id]:
+                    if rng.random() < 0.25:
+                        seqr.disconnect(c.client_id)
+                elif rng.random() < 0.6:
+                    seqr.reconnect(c.client_id)
+            seqr.sequence_all(rng)
+        # Quiesce: reconnect everyone, drain.
+        for c in clients:
+            if not seqr.connected[c.client_id]:
+                seqr.reconnect(c.client_id)
+        seqr.sequence_all(rng)
+        texts = [c.get_text() for c in clients]
+        assert all(t == texts[0] for t in texts), f"seed {seed}: {texts}"
+        assert not any(c.tree.pending_groups for c in clients)

@@ -1,0 +1,376 @@
+"""DDS end-to-end tests over the in-process sequencer (reference
+end-to-end-tests + per-DDS spec strategy, SURVEY.md §4.3-4.4)."""
+
+import random
+
+import pytest
+
+from fluidframework_tpu.testing import MockSequencedEnvironment
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.dds.counter import SharedCounter
+from fluidframework_tpu.dds.cell import SharedCell
+from fluidframework_tpu.dds.directory import SharedDirectory
+from fluidframework_tpu.dds.matrix import SharedMatrix
+from fluidframework_tpu.dds.register_collection import (
+    ConsensusRegisterCollection, READ_LWW)
+from fluidframework_tpu.dds.ordered_collection import ConsensusQueue
+
+
+def pair(env, dds_cls, object_id="obj"):
+    """Two connected runtimes each holding a replica of one DDS."""
+    r1 = env.create_runtime()
+    r2 = env.create_runtime()
+    ds1 = r1.create_datastore("ds")
+    ds2 = r2.create_datastore("ds")
+    a = ds1.create_channel(object_id, dds_cls.TYPE)
+    b = ds2.create_channel(object_id, dds_cls.TYPE)
+    env.process_all()  # joins
+    return r1, r2, a, b
+
+
+class TestSharedMap:
+    def test_set_get_converges(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMap)
+        a.set("k", 1)
+        b.set("j", {"nested": True})
+        env.process_all()
+        assert a.get("k") == b.get("k") == 1
+        assert a.get("j") == b.get("j") == {"nested": True}
+
+    def test_lww_conflict(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMap)
+        a.set("k", "from-a")
+        b.set("k", "from-b")
+        env.process_all()
+        assert a.get("k") == b.get("k")  # whoever sequenced last wins
+
+    def test_pending_shadows_remote(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMap)
+        a.set("k", "a1")
+        env.process_all()
+        b.set("k", "b1")           # b's write in flight
+        a.set("k", "a2")           # a writes again, also in flight
+        # Deliver b's first: a must keep showing its pending value.
+        rng = random.Random(3)
+        env.process_all(rng)
+        assert a.get("k") == b.get("k")
+
+    def test_clear_and_delete(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMap)
+        a.set("x", 1)
+        a.set("y", 2)
+        env.process_all()
+        b.delete("x")
+        env.process_all()
+        assert not a.has("x") and a.get("y") == 2
+        a.clear()
+        env.process_all()
+        assert len(b) == 0
+
+    def test_reconnect_resubmits(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMap)
+        a.set("survives", "yes")
+        env.disconnect(r1)
+        env.process_all()
+        assert not b.has("survives")
+        env.reconnect(r1)
+        env.process_all()
+        assert b.get("survives") == "yes"
+
+
+class TestSharedString:
+    def test_concurrent_editing(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedString)
+        a.insert_text(0, "hello world")
+        env.process_all()
+        b.insert_text(5, ",")
+        a.remove_text(0, 1)
+        a.insert_text(0, "H")
+        env.process_all()
+        assert a.get_text() == b.get_text() == "Hello, world"
+
+    def test_annotate(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedString)
+        a.insert_text(0, "styled")
+        env.process_all()
+        b.annotate_range(0, 6, {"bold": True})
+        env.process_all()
+        assert any(s.props == {"bold": True}
+                   for s in a.client.tree.segments)
+
+    def test_reconnect_with_offline_edits(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedString)
+        a.insert_text(0, "base")
+        env.process_all()
+        env.disconnect(r1)
+        a.insert_text(4, " offline")
+        b.insert_text(0, "B:")
+        env.process_all()
+        env.reconnect(r1)
+        env.process_all()
+        assert a.get_text() == b.get_text()
+        assert "offline" in a.get_text() and "B:" in a.get_text()
+
+    def test_summary_roundtrip_into_new_runtime(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedString)
+        a.insert_text(0, "persist me")
+        a.annotate_range(0, 7, {"em": 1})
+        env.process_all()
+        summary = r1.summarize()
+        # New environment loads from the summary.
+        env2 = MockSequencedEnvironment()
+        r3 = env2.create_runtime()
+        r3.load(summary)
+        env2.process_all()
+        c = r3.get_datastore("ds").get_channel("obj")
+        assert c.get_text() == "persist me"
+
+
+class TestSmallDDSes:
+    def test_counter(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedCounter)
+        a.increment(5)
+        b.increment(-2)
+        env.process_all()
+        assert a.value == b.value == 3
+
+    def test_cell(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedCell)
+        a.set({"payload": 1})
+        env.process_all()
+        assert b.get() == {"payload": 1}
+        b.delete()
+        env.process_all()
+        assert a.empty() and b.empty()
+
+    def test_directory_subdirs(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedDirectory)
+        sub = a.create_sub_directory("settings")
+        sub.set("theme", "dark")
+        a.set("rootKey", 1)
+        env.process_all()
+        assert b.get_sub_directory("settings").get("theme") == "dark"
+        assert b.get("rootKey") == 1
+        b.get_sub_directory("settings").set("theme", "light")
+        env.process_all()
+        assert a.get_sub_directory("settings").get("theme") == "light"
+
+    def test_register_collection_versions(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, ConsensusRegisterCollection)
+        acks = []
+        a.write("leader", "alice", on_ack=lambda won: acks.append(won))
+        b.write("leader", "bob")
+        env.process_all()
+        # Concurrent writes: both versions retained, same on both replicas.
+        assert a.read_versions("leader") == b.read_versions("leader")
+        assert len(a.read_versions("leader")) == 2
+        # a's ack says whether its write became the atomic (first) version.
+        assert acks == [a.read("leader") == "alice"]
+        # A later write that has seen everything supersedes.
+        a.write("leader", "carol")
+        env.process_all()
+        assert b.read_versions("leader") == ["carol"]
+        assert b.read("leader", READ_LWW) == "carol"
+
+    def test_consensus_queue_acquire(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, ConsensusQueue)
+        a.add("task-1")
+        env.process_all()
+        got = []
+        a.acquire(lambda item_id, value: got.append((item_id, value)))
+        b.acquire(lambda item_id, value: got.append((item_id, value)))
+        env.process_all()
+        # Exactly one acquirer got the task; queues agree it is leased.
+        values = [v for _, v in got]
+        assert values.count("task-1") == 1 and values.count(None) == 1
+        assert len(a.jobs) == len(b.jobs) == 1
+        # Complete clears the lease everywhere.
+        item_id = next(iter(a.jobs))
+        a.complete(item_id)
+        env.process_all()
+        assert not a.jobs and not b.jobs
+
+
+class TestSharedMatrix:
+    def test_rows_cols_cells(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMatrix)
+        a.insert_rows(0, 2)
+        a.insert_cols(0, 2)
+        env.process_all()
+        assert (b.row_count, b.col_count) == (2, 2)
+        a.set_cell(0, 0, "tl")
+        b.set_cell(1, 1, "br")
+        env.process_all()
+        assert a.extract() == b.extract() == [["tl", None], [None, "br"]]
+
+    def test_concurrent_row_insert_and_cell_set(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMatrix)
+        a.insert_rows(0, 2)
+        a.insert_cols(0, 1)
+        env.process_all()
+        a.set_cell(1, 0, "stable")  # address row 1 by stable id
+        b.insert_rows(0, 1)         # concurrently shift positions
+        env.process_all()
+        # The cell stayed with its row despite the index shift.
+        assert a.extract() == b.extract()
+        assert a.extract()[2][0] == "stable"
+
+    def test_remove_rows(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMatrix)
+        a.insert_rows(0, 3)
+        a.insert_cols(0, 1)
+        env.process_all()
+        a.set_cell(0, 0, "r0")
+        a.set_cell(2, 0, "r2")
+        env.process_all()
+        b.remove_rows(1, 1)
+        env.process_all()
+        assert a.extract() == b.extract() == [["r0"], ["r2"]]
+
+    def test_matrix_summary_roundtrip(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMatrix)
+        a.insert_rows(0, 2)
+        a.insert_cols(0, 2)
+        env.process_all()
+        a.set_cell(0, 1, 42)
+        env.process_all()
+        summary = r1.summarize()
+        env2 = MockSequencedEnvironment()
+        r3 = env2.create_runtime()
+        r3.load(summary)
+        c = r3.get_datastore("ds").get_channel("obj")
+        assert c.extract() == a.extract()
+
+
+class TestDetachedAttach:
+    def test_detached_edits_ship_via_summary(self):
+        from fluidframework_tpu.runtime.container_runtime import ContainerRuntime
+        detached = ContainerRuntime()
+        ds = detached.create_datastore("ds")
+        s = ds.create_channel("text", SharedString.TYPE)
+        m = ds.create_channel("meta", SharedMap.TYPE)
+        s.insert_text(0, "created offline")
+        m.set("k", 1)
+        # Attach: connect channels, take the attach summary.
+        for ch in (s, m):
+            ch.connect()
+        summary = detached.summarize()
+        env = MockSequencedEnvironment()
+        r = env.create_runtime()
+        r.load(summary)
+        env.process_all()
+        assert r.get_datastore("ds").get_channel("text").get_text() == \
+            "created offline"
+        assert r.get_datastore("ds").get_channel("meta").get("k") == 1
+
+
+class TestReviewRegressions:
+    """Repros from code review: silent-divergence bugs must stay fixed."""
+
+    def test_loaded_runtime_can_edit(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMap)
+        a.set("k", 1)
+        env.process_all()
+        summary = r1.summarize()
+        r3 = env.create_runtime()
+        r3.load(summary)
+        env.process_all()
+        c = r3.get_datastore("ds").get_channel("obj")
+        c.set("new", 42)
+        env.process_all()
+        assert a.get("new") == b.get("new") == 42
+
+    def test_directory_offline_subdir_survives_reconnect(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedDirectory)
+        env.disconnect(r1)
+        a.create_sub_directory("settings").set("theme", "dark")
+        env.reconnect(r1)
+        env.process_all()
+        assert b.get_sub_directory("settings") is not None
+        assert b.get_sub_directory("settings").get("theme") == "dark"
+
+    def test_queue_add_survives_reconnect(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, ConsensusQueue)
+        a.add("task-1")
+        env.disconnect(r1)
+        env.reconnect(r1)
+        env.process_all()
+        assert [i["value"] for i in b.items] == ["task-1"]
+
+    def test_register_write_survives_reconnect(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, ConsensusRegisterCollection)
+        acks = []
+        a.write("leader", "alice", on_ack=acks.append)
+        env.disconnect(r1)
+        env.reconnect(r1)
+        env.process_all()
+        assert b.read("leader") == "alice"
+        assert acks == [True]
+
+    def test_prejoin_matrix_inserts_do_not_collide(self):
+        env = MockSequencedEnvironment()
+        r1 = env.create_runtime()
+        r2 = env.create_runtime()
+        a = r1.create_datastore("ds").create_channel("m", SharedMatrix.TYPE)
+        b = r2.create_datastore("ds").create_channel("m", SharedMatrix.TYPE)
+        # Edits before any join is sequenced.
+        a.insert_rows(0, 1)
+        b.insert_rows(0, 1)
+        env.process_all()
+        assert a.row_count == b.row_count == 2
+        a.insert_cols(0, 1)
+        env.process_all()
+        a.set_cell(0, 0, "first")
+        env.process_all()
+        assert a.extract() == b.extract()
+        assert a.extract()[1][0] is None  # distinct rows, no aliasing
+
+    def test_queue_lease_released_on_client_leave(self):
+        import json as _json
+        from fluidframework_tpu.protocol.messages import (
+            MessageType, SequencedDocumentMessage)
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, ConsensusQueue)
+        a.add("job")
+        env.process_all()
+        got = []
+        a.acquire(lambda i, v: got.append((i, v)))
+        env.process_all()
+        assert a.jobs and b.jobs
+        # r1's client leaves the quorum: its lease must release everywhere.
+        state = env._state_of(r1)
+        env.seq += 1
+        leave = SequencedDocumentMessage(
+            client_id=state.client_id, sequence_number=env.seq,
+            minimum_sequence_number=env.seq - 1, client_sequence_number=0,
+            reference_sequence_number=env.seq - 1,
+            type=MessageType.CLIENT_LEAVE,
+            contents={"clientId": state.client_id})
+        for s in env.clients.values():
+            s.runtime.process(leave)
+            s.last_seen_seq = env.seq
+        assert not b.jobs
+        assert [i["value"] for i in b.items] == ["job"]
