@@ -1,0 +1,154 @@
+"""Benchmark: merge-tree ops applied/sec across a 10k-document batch.
+
+Driver metric (BASELINE.json): replay editing traces across thousands of
+documents — deli ticketing + merge-tree apply on device — vs the reference-
+equivalent single-threaded scalar apply loop (the oracle), measured here.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ops_per_sec, "unit": "ops/s", "vs_baseline": x}
+
+Env knobs: BENCH_DOCS (default 10000), BENCH_OPS (ops/doc, default 100),
+BENCH_CAPACITY (segment slots/doc, default 256).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def gen_traces(n_docs: int, n_ops: int, seed: int = 0):
+    """Vectorized synthetic editing traces: per-doc sequential ops (the
+    ProseMirror/Monaco replay shape): 70% insert (1-8 chars), 30% remove,
+    positions uniform over the current doc length (tracked arithmetically).
+    Returns numpy op columns [B, T] in mergetree.oppack layout."""
+    rng = np.random.default_rng(seed)
+    b, t = n_docs, n_ops
+    kind = np.where(rng.random((b, t)) < 0.7, 1, 2).astype(np.int32)
+    ins_len = rng.integers(1, 9, (b, t), dtype=np.int32)
+    frac_pos = rng.random((b, t))
+    frac_end = rng.random((b, t))
+
+    pos1 = np.zeros((b, t), np.int32)
+    pos2 = np.zeros((b, t), np.int32)
+    lengths = np.zeros(b, np.int64)
+    for j in range(t):
+        kj = kind[:, j].copy()
+        # Removes on empty docs become inserts.
+        kj[(kj == 2) & (lengths < 2)] = 1
+        kind[:, j] = kj
+        is_ins = kj == 1
+        p = (frac_pos[:, j] * (lengths + 1)).astype(np.int64)
+        p = np.minimum(p, lengths)
+        # Remove [p, e): p < length, e in (p, min(len, p+16)]
+        pr = np.minimum(p, lengths - 1)
+        pr[pr < 0] = 0
+        span = np.minimum(lengths - pr, 16)
+        e = pr + 1 + (frac_end[:, j] * span).astype(np.int64)
+        e = np.minimum(e, lengths)
+        e = np.maximum(e, pr + 1)
+        pos1[:, j] = np.where(is_ins, p, pr).astype(np.int32)
+        pos2[:, j] = np.where(is_ins, 0, e).astype(np.int32)
+        lengths = np.where(is_ins, lengths + ins_len[:, j], lengths - (e - pr))
+    seq = np.tile(np.arange(1, t + 1, dtype=np.int32), (b, 1))
+    return {
+        "kind": kind, "seq": seq, "ref_seq": seq - 1,
+        "client": np.ones((b, t), np.int32),
+        "pos1": pos1, "pos2": pos2,
+        "op_id": np.tile(np.arange(t, dtype=np.int32), (b, 1)),
+        "new_len": np.where(kind == 1, ins_len, 0).astype(np.int32),
+        "local_seq": np.zeros((b, t), np.int32),
+        "msn": seq - 1,
+    }
+
+
+def run_baseline(cols, sample_docs: int, n_ops: int) -> float:
+    """Single-threaded scalar apply (the reference-equivalent loop)."""
+    from fluidframework_tpu.mergetree import MergeTreeOracle
+    total = 0
+    start = time.perf_counter()
+    for d in range(sample_docs):
+        tree = MergeTreeOracle(local_client=-2)
+        for j in range(n_ops):
+            k = int(cols["kind"][d, j])
+            seq = int(cols["seq"][d, j])
+            ref = int(cols["ref_seq"][d, j])
+            if k == 1:
+                tree.insert_text(int(cols["pos1"][d, j]),
+                                 "x" * int(cols["new_len"][d, j]), ref, 1, seq)
+            else:
+                tree.remove_range(int(cols["pos1"][d, j]),
+                                  int(cols["pos2"][d, j]), ref, 1, seq)
+            tree.update_seq(seq)
+            total += 1
+    elapsed = time.perf_counter() - start
+    return total / elapsed
+
+
+def main() -> None:
+    n_docs = int(os.environ.get("BENCH_DOCS", "10000"))
+    n_ops = int(os.environ.get("BENCH_OPS", "100"))
+    capacity = int(os.environ.get("BENCH_CAPACITY", "256"))
+
+    import jax
+    from fluidframework_tpu.mergetree import kernel
+    from fluidframework_tpu.mergetree.oppack import PackedOps
+    from fluidframework_tpu.mergetree.state import make_state
+    from fluidframework_tpu.server import ticket_kernel as tk
+
+    cols = gen_traces(n_docs, n_ops)
+    baseline_sample = min(16, n_docs)
+    baseline_ops_per_sec = run_baseline(cols, baseline_sample, n_ops)
+
+    import jax.numpy as jnp
+    ops = PackedOps(**{f: jnp.asarray(cols[f]) for f in PackedOps._fields})
+    raw = tk.RawOps(client=ops.client,
+                    client_seq=ops.seq,  # per-doc clientSeq == seq here
+                    ref_seq=ops.ref_seq)
+
+    from fluidframework_tpu.server.pipeline import full_step
+    step = jax.jit(full_step, donate_argnums=(0, 1))
+
+    def fresh():
+        return (tk.make_ticket_state(8, batch=n_docs),
+                make_state(capacity, 1, batch=n_docs))
+
+    # Compile + warm.
+    tstate, mstate = fresh()
+    out = step(tstate, mstate, raw, ops)
+    np.asarray(out[3])  # force full execution + D2H
+    # Timed run (includes the result fetch: block_until_ready alone can
+    # return early over the remote-device relay).
+    tstate, mstate = fresh()
+    jax.block_until_ready((tstate, mstate))
+    start = time.perf_counter()
+    out = step(tstate, mstate, raw, ops)
+    total_len_host = np.asarray(out[3])
+    elapsed = time.perf_counter() - start
+
+    overflow = bool(np.asarray(out[1].overflow).any())
+    total_ops = n_docs * n_ops
+    ops_per_sec = total_ops / elapsed
+    result = {
+        "metric": "merge-tree ops applied/sec across "
+                  f"{n_docs} docs (ticket+apply+summary-len)",
+        "value": round(ops_per_sec, 1),
+        "unit": "ops/s",
+        "vs_baseline": round(ops_per_sec / baseline_ops_per_sec, 2),
+        "extra": {
+            "backend": jax.default_backend(),
+            "elapsed_s": round(elapsed, 4),
+            "docs": n_docs, "ops_per_doc": n_ops,
+            "baseline_single_thread_ops_s": round(baseline_ops_per_sec, 1),
+            "overflow": overflow,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

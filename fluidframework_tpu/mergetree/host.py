@@ -5,7 +5,7 @@ into a host rope, not characters"). The host keeps:
 - an op payload table: op_id -> inserted text / marker / annotate pset;
 - client id interning (wire client ids are strings);
 and reconstructs text and per-segment properties from (origin_op,
-origin_off, length) plus the annotate edge chains.
+origin_off, length) plus each segment's annotate op-id ring.
 """
 
 from __future__ import annotations
@@ -128,10 +128,10 @@ def _to_host(state: DocState, doc: Optional[int]) -> dict:
     cols = {}
     for name in ("length", "ins_seq", "ins_client", "local_seq", "rem_seq",
                  "rem_local_seq", "rem_clients", "origin_op", "origin_off",
-                 "anno_head", "edge_op", "edge_prev"):
+                 "anno"):
         arr = np.asarray(getattr(state, name))
         cols[name] = arr[doc] if doc is not None else arr
-    for name in ("count", "edge_count", "min_seq", "seq", "overflow"):
+    for name in ("count", "min_seq", "seq", "overflow"):
         val = np.asarray(getattr(state, name))
         cols[name] = int(val[doc]) if doc is not None else int(val)
     return cols
@@ -192,19 +192,20 @@ def extract_segments(state: DocState, payloads: PayloadTable,
             off = int(cols["origin_off"][i])
             text = payload.text[off:off + int(cols["length"][i])]
         props = dict(payload.props) if payload.props else {}
-        # Collect annotate chain; order by effective seq (pending local
-        # annotates rank after everything acked, in submission order, which
-        # is their op_id creation order — only own pendings can coexist).
+        # Collect the annotate ring (newest first); order by effective seq
+        # (pending local annotates rank after everything acked, in
+        # submission order, which is their op_id creation order — only own
+        # pendings can coexist on a replica).
         chain = []
-        edge = int(cols["anno_head"][i])
-        while edge >= 0:
-            op_id = int(cols["edge_op"][edge])
+        for op_id in cols["anno"][i]:
+            op_id = int(op_id)
+            if op_id < 0:
+                continue
             ann = payloads.get(op_id)
             seq = ann.seq
             if seq == DEV_UNASSIGNED:
                 seq = PENDING_ORDER_BASE + op_id
             chain.append((seq, ann.props))
-            edge = int(cols["edge_prev"][edge])
         chain.sort(key=lambda kv: kv[0])  # ascending: later seq wins per key
         for _, pset in chain:
             for key, value in pset.items():

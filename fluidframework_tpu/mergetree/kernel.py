@@ -7,12 +7,19 @@ mergeTree.ts:2345,2770,1893,1422) with data-parallel array ops:
 - position resolution: masked exclusive prefix sum of visible lengths under
   the op's (refSeq, clientId) perspective — no tree walk, no partial-length
   caches (the prefix sum IS the partial-length computation, fused);
-- insert/split: shift-gathers over the segment axis;
-- remove/annotate marking: masked column updates;
+- insert/split: roll-selects over the segment axis. TPU note: arbitrary
+  data-dependent gathers lower to slow scatter/gather loops (~20x worse than
+  shifts, measured); every structural change here is a shift-by-one, so it
+  is expressed as where(j >= slot, roll(x, 1), x) — pure elementwise work
+  the VPU streams at full bandwidth;
 - the insert tie-break (mergeTree.ts:2248 breakTie): a vectorized first-true
   scan over the boundary run — skip acked tombstones, land before visible or
   concurrent-acked segments, skip unacked foreign segments;
-- zamboni compaction: keep-mask prefix sum + gather.
+- remove/annotate marking: masked column updates; annotates append into a
+  fixed-depth per-segment ring of op ids (LWW-resolved host-side by seq;
+  ring exhaustion sets the overflow flag instead of corrupting);
+- zamboni compaction: keep-mask prefix sum + gather (runs between batches,
+  not per op, so its gather cost amortizes).
 
 One `step` applies one op to one document; `lax.scan` over the time axis x
 `vmap` over the document axis yields the batched kernel that applies T ops
@@ -58,29 +65,41 @@ def visibility(s: DocState, ref_seq, client) -> Tuple[jnp.ndarray, jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
-# shift helpers
+# shift helpers (roll-select: no data-dependent gathers on the hot path)
 # ---------------------------------------------------------------------------
 
-def _gather_segments(s: DocState, src: jnp.ndarray) -> DocState:
-    """Reindex all segment columns by src (clipped gather)."""
-    src = jnp.clip(src, 0, s.capacity - 1)
+def _shift_right_at(s: DocState, slot, do) -> DocState:
+    """Shift all segment rows at indices >= slot right by one (the row at
+    slot duplicates its left neighbor, i.e. out[slot] == in[slot-1]) when
+    `do`; identity otherwise. out[j] = in[j] for j < slot."""
+    c = s.capacity
+    j = jnp.arange(c, dtype=jnp.int32)
+
+    def shift(x):
+        rolled = jnp.roll(x, 1, axis=0)
+        mask = (j >= slot) & do
+        if x.ndim > 1:
+            mask = mask.reshape(mask.shape + (1,) * (x.ndim - 1))
+        return jnp.where(mask, rolled, x)
+
     return s._replace(
-        length=s.length[src],
-        ins_seq=s.ins_seq[src],
-        ins_client=s.ins_client[src],
-        local_seq=s.local_seq[src],
-        rem_seq=s.rem_seq[src],
-        rem_local_seq=s.rem_local_seq[src],
-        rem_clients=s.rem_clients[src],
-        origin_op=s.origin_op[src],
-        origin_off=s.origin_off[src],
-        anno_head=s.anno_head[src],
+        length=shift(s.length),
+        ins_seq=shift(s.ins_seq),
+        ins_client=shift(s.ins_client),
+        local_seq=shift(s.local_seq),
+        rem_seq=shift(s.rem_seq),
+        rem_local_seq=shift(s.rem_local_seq),
+        rem_clients=shift(s.rem_clients),
+        origin_op=shift(s.origin_op),
+        origin_off=shift(s.origin_off),
+        anno=shift(s.anno),
+        count=s.count + do.astype(jnp.int32),
     )
 
 
-def _select(do, a: DocState, b: DocState) -> DocState:
-    """Per-column where(do, a, b) over segment columns + scalars."""
-    return jax.tree_util.tree_map(lambda x, y: jnp.where(do, x, y), a, b)
+def _masked_scalar(values, mask):
+    """values[argwhere(mask)] as a reduce (avoids dynamic_slice)."""
+    return jnp.sum(jnp.where(mask, values, 0))
 
 
 def _ensure_boundary(s: DocState, pos, ref_seq, client, enabled) -> DocState:
@@ -90,21 +109,17 @@ def _ensure_boundary(s: DocState, pos, ref_seq, client, enabled) -> DocState:
     inside = vis & (cum < pos) & (pos < cum + vlen)
     do = enabled & jnp.any(inside)
     idx = jnp.argmax(inside).astype(jnp.int32)
-    off = pos - cum[idx]
-    c = s.capacity
-    j = jnp.arange(c, dtype=jnp.int32)
-    # Shift right of idx by one; idx+1 becomes the right half.
-    src = jnp.where(j <= idx, j, j - 1)
-    g = _gather_segments(s, src)
-    is_left = j == idx
-    is_right = j == idx + 1
-    g = g._replace(
+    off = pos - _masked_scalar(cum, inside)
+    parent_len = _masked_scalar(s.length, inside)
+    g = _shift_right_at(s, idx + 1, do)
+    j = jnp.arange(s.capacity, dtype=jnp.int32)
+    is_left = do & (j == idx)
+    is_right = do & (j == idx + 1)
+    return g._replace(
         length=jnp.where(is_left, off,
-                         jnp.where(is_right, s.length[idx] - off, g.length)),
+                         jnp.where(is_right, parent_len - off, g.length)),
         origin_off=jnp.where(is_right, g.origin_off + off, g.origin_off),
     )
-    g = g._replace(count=s.count + 1)
-    return _select(do, g, s)
 
 
 # ---------------------------------------------------------------------------
@@ -126,14 +141,14 @@ def _insert_phase(s: DocState, op: PackedOps, t, enabled) -> DocState:
     # pos beyond the visible length leaves no stop slot: flag instead of
     # silently landing at argmax-of-all-false == 0.
     found = jnp.any(stop)
+    bad = enabled & ~found
     enabled = enabled & found
     slot = jnp.argmax(stop).astype(jnp.int32)  # first stop
-    # Shift right of slot by one and write the new segment at slot.
-    src = jnp.where(j < slot, j, j - 1)
-    g = _gather_segments(s, src)
-    here = j == slot
+    g = _shift_right_at(s, slot, enabled)
+    here = enabled & (j == slot)
     new_seq = op.seq[t]
-    g = g._replace(
+    hereK = here[:, None]
+    return g._replace(
         length=jnp.where(here, op.new_len[t], g.length),
         ins_seq=jnp.where(here, new_seq, g.ins_seq),
         ins_client=jnp.where(here, cl, g.ins_client),
@@ -141,16 +156,12 @@ def _insert_phase(s: DocState, op: PackedOps, t, enabled) -> DocState:
                             g.local_seq),
         rem_seq=jnp.where(here, DEV_NO_REMOVE, g.rem_seq),
         rem_local_seq=jnp.where(here, 0, g.rem_local_seq),
-        rem_clients=jnp.where(here[:, None], -1, g.rem_clients),
+        rem_clients=jnp.where(hereK, -1, g.rem_clients),
         origin_op=jnp.where(here, op.op_id[t], g.origin_op),
         origin_off=jnp.where(here, 0, g.origin_off),
-        anno_head=jnp.where(here, -1, g.anno_head),
-        count=s.count + 1,
+        anno=jnp.where(hereK, -1, g.anno),
+        overflow=g.overflow | bad,
     )
-    bad = (op.kind[t] == OpKind.INSERT) & ~found
-    g = g._replace(overflow=g.overflow | bad)
-    s = s._replace(overflow=s.overflow | bad)
-    return _select(enabled, g, s)
 
 
 def _range_targets(s: DocState, op: PackedOps, t):
@@ -209,21 +220,16 @@ def _append_overlap(rc: jnp.ndarray, need: jnp.ndarray,
 
 
 def _annotate_phase(s: DocState, op: PackedOps, t, enabled) -> DocState:
-    """Append an annotate edge per affected segment into the edge pool;
-    host resolves per-key LWW by op seq at summary time."""
+    """Push the annotate op id into each affected segment's fixed-depth ring
+    (newest first); host resolves per-key LWW by op seq at summary time.
+    Ring exhaustion (oldest id still occupied) flags overflow."""
     target = _range_targets(s, op, t) & enabled
-    e = s.edge_capacity
-    offs = s.edge_count + (jnp.cumsum(target.astype(jnp.int32)) - target)
-    can = target & (offs < e)
-    dest = jnp.where(can, offs, e)  # out-of-bounds rows dropped
-    edge_op = s.edge_op.at[dest].set(op.op_id[t], mode="drop")
-    edge_prev = s.edge_prev.at[dest].set(
-        jnp.where(can, s.anno_head, -1), mode="drop")
-    anno_head = jnp.where(can, offs, s.anno_head)
-    n = jnp.sum(can.astype(jnp.int32))
-    overflow = jnp.any(target & ~can)
-    return s._replace(edge_op=edge_op, edge_prev=edge_prev,
-                      anno_head=anno_head, edge_count=s.edge_count + n,
+    tK = target[:, None]
+    pushed = jnp.concatenate(
+        [jnp.full(s.anno.shape[:-1] + (1,), op.op_id[t], jnp.int32),
+         s.anno[..., :-1]], axis=-1)
+    overflow = jnp.any(target & (s.anno[..., -1] != -1))
+    return s._replace(anno=jnp.where(tK, pushed, s.anno),
                       overflow=s.overflow | overflow)
 
 
@@ -315,6 +321,25 @@ def apply_ops_batched(state: DocState, ops: PackedOps) -> DocState:
 # zamboni: compaction
 # ---------------------------------------------------------------------------
 
+def _gather_segments(s: DocState, src: jnp.ndarray) -> DocState:
+    """Reindex all segment columns by src (clipped gather). Only used off
+    the per-op hot path (compaction), where the arbitrary-gather cost
+    amortizes over a whole batch of applied ops."""
+    src = jnp.clip(src, 0, s.capacity - 1)
+    return s._replace(
+        length=s.length[src],
+        ins_seq=s.ins_seq[src],
+        ins_client=s.ins_client[src],
+        local_seq=s.local_seq[src],
+        rem_seq=s.rem_seq[src],
+        rem_local_seq=s.rem_local_seq[src],
+        rem_clients=s.rem_clients[src],
+        origin_op=s.origin_op[src],
+        origin_off=s.origin_off[src],
+        anno=s.anno[src],
+    )
+
+
 def _compact_one(s: DocState) -> DocState:
     """Free segments removed at-or-before min_seq (reference zamboni,
     mergeTree.ts:1422): stable-partition live segments to the front."""
@@ -340,7 +365,7 @@ def _compact_one(s: DocState) -> DocState:
         rem_clients=jnp.where(pad[:, None], -1, g.rem_clients),
         origin_op=jnp.where(pad, -1, g.origin_op),
         origin_off=jnp.where(pad, 0, g.origin_off),
-        anno_head=jnp.where(pad, -1, g.anno_head),
+        anno=jnp.where(pad[:, None], -1, g.anno),
         count=new_count,
     )
     return g
@@ -360,7 +385,7 @@ def compact_batched(state: DocState) -> DocState:
 # queries
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.jit, static_argnums=())
+@jax.jit
 def visible_mask(state: DocState, ref_seq, client):
     vis, _, _ = visibility(state, ref_seq, client)
     return vis

@@ -2,12 +2,12 @@
 
 The reference's pointer B-tree (mergeTree.ts:334 MaxNodesInBlock=8) becomes
 flat int32 arrays in document order. Position resolution = masked prefix sum
-under a (refSeq, clientId) visibility predicate; inserts/splits = shift
-gathers; everything batches over a leading documents axis.
+under a (refSeq, clientId) visibility predicate; inserts/splits = roll-
+selects; everything batches over a leading documents axis.
 
 Payloads stay host-side: a segment's text is (origin_op, origin_off, length)
-into a host op->text table; properties are a device-side linked list of
-(op id) edges resolved host-side at summary time (SURVEY.md §7 hard parts:
+into a host op->text table; properties are a fixed-depth per-segment ring of
+annotate op ids resolved host-side at summary time (SURVEY.md §7 hard parts:
 "props are JSON-shaped: keep props host-side behind integer refs").
 """
 
@@ -19,6 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .constants import DEV_NO_REMOVE, DEV_UNASSIGNED, MAX_OVERLAP_CLIENTS
+
+DEFAULT_ANNO_SLOTS = 4
 
 
 class DocState(NamedTuple):
@@ -34,13 +36,9 @@ class DocState(NamedTuple):
       rem_clients [C, K] removing client + overlap clients (-1 = free slot)
       origin_op   global op id whose payload this segment's text comes from
       origin_off  offset into that op's payload (splits advance this)
-      anno_head   head of the annotate edge list (-1 = none)
+      anno        [C, A] ring of annotate op ids, newest first (-1 = empty)
 
-    Annotate edge pool, shape [E] (append-only per document):
-      edge_op     global op id of the annotate
-      edge_prev   previous edge for the same segment (-1 = end)
-
-    Scalars: count, edge_count, min_seq, seq (latest applied), overflow.
+    Scalars: count, min_seq, seq (latest applied), overflow.
     """
 
     length: jnp.ndarray
@@ -52,11 +50,8 @@ class DocState(NamedTuple):
     rem_clients: jnp.ndarray
     origin_op: jnp.ndarray
     origin_off: jnp.ndarray
-    anno_head: jnp.ndarray
-    edge_op: jnp.ndarray
-    edge_prev: jnp.ndarray
+    anno: jnp.ndarray
     count: jnp.ndarray
-    edge_count: jnp.ndarray
     min_seq: jnp.ndarray
     seq: jnp.ndarray
     overflow: jnp.ndarray
@@ -66,16 +61,16 @@ class DocState(NamedTuple):
         return self.length.shape[-1]
 
     @property
-    def edge_capacity(self) -> int:
-        return self.edge_op.shape[-1]
+    def anno_slots(self) -> int:
+        return self.anno.shape[-1]
 
 
 SEGMENT_COLUMNS = ("length", "ins_seq", "ins_client", "local_seq", "rem_seq",
                    "rem_local_seq", "rem_clients", "origin_op", "origin_off",
-                   "anno_head")
+                   "anno")
 
 
-def make_state(capacity: int, edge_capacity: int = 0,
+def make_state(capacity: int, anno_slots: int = DEFAULT_ANNO_SLOTS,
                overlap_slots: int = MAX_OVERLAP_CLIENTS,
                batch: int | None = None) -> DocState:
     """Fresh empty state; batch=None for a single doc, int for [B, ...]."""
@@ -88,7 +83,7 @@ def make_state(capacity: int, edge_capacity: int = 0,
     def full(value, *dims):
         return jnp.full(shape(*dims), value, jnp.int32)
 
-    e = max(edge_capacity, 1)
+    a = max(anno_slots, 1)
     return DocState(
         length=zeros(capacity),
         ins_seq=full(DEV_UNASSIGNED, capacity),
@@ -99,24 +94,22 @@ def make_state(capacity: int, edge_capacity: int = 0,
         rem_clients=full(-1, capacity, overlap_slots),
         origin_op=full(-1, capacity),
         origin_off=zeros(capacity),
-        anno_head=full(-1, capacity),
-        edge_op=full(-1, e),
-        edge_prev=full(-1, e),
+        anno=full(-1, capacity, a),
         count=zeros(),
-        edge_count=zeros(),
         min_seq=zeros(),
         seq=zeros(),
         overflow=jnp.zeros(shape(), jnp.bool_),
     )
 
 
-def state_from_numpy(columns: dict, capacity: int, edge_capacity: int = 0,
+def state_from_numpy(columns: dict, capacity: int,
+                     anno_slots: int = DEFAULT_ANNO_SLOTS,
                      overlap_slots: int = MAX_OVERLAP_CLIENTS) -> DocState:
     """Build single-doc state from host numpy columns of length n <= capacity."""
     n = len(columns["length"])
     if n > capacity:
         raise ValueError(f"{n} segments exceed capacity {capacity}")
-    base = make_state(capacity, edge_capacity, overlap_slots)
+    base = make_state(capacity, anno_slots, overlap_slots)
 
     def put(col, dst):
         arr = np.asarray(columns.get(col, np.asarray(dst)[:n]), np.int32)

@@ -1,0 +1,13 @@
+"""Device-mesh parallelism: sharding layouts + sequence-parallel scans.
+
+The reference's parallelism axes (SURVEY.md §2.6) map onto the mesh as:
+- partition/document parallelism  -> 'dp' (documents axis of every batch)
+- long-sequence scaling            -> 'sp' (segment-capacity axis, with the
+  prefix-sum hierarchically decomposed: local cumsum + all-gathered shard
+  totals, the moral analog of ring/blockwise attention for positions)
+- pipeline across stages           -> host-side async dispatch (ticket batch
+  N+1 while batch N's summary write flushes), see server.partition
+"""
+
+from .mesh import make_mesh, shard_docs, replicate
+from .seq_scan import sharded_cumsum

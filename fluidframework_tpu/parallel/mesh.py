@@ -1,0 +1,52 @@
+"""Mesh construction + sharding specs for batched document state.
+
+Multi-chip design: documents shard over 'dp' (embarrassingly parallel — the
+kernel is vmap over docs, so GSPMD partitions it with zero collectives);
+the segment capacity axis can shard over 'sp' for very long documents, where
+the position prefix-sum becomes local-cumsum + cross-shard offset (XLA
+inserts the collectives from the sharding annotations; see seq_scan for the
+explicit shard_map formulation).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make_mesh(dp: Optional[int] = None, sp: int = 1,
+              devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if dp is None:
+        dp = n // sp
+    if dp * sp != n:
+        raise ValueError(f"dp({dp}) x sp({sp}) != device count {n}")
+    arr = np.asarray(devices).reshape(dp, sp)
+    return Mesh(arr, axis_names=("dp", "sp"))
+
+
+def shard_docs(mesh: Mesh, state, seq_sharded: bool = False):
+    """Place a batched pytree: leading axis over 'dp'; optionally the
+    second (capacity) axis of rank>=2 leaves over 'sp'."""
+    sp = mesh.shape.get("sp", 1)
+
+    def place(x):
+        if x.ndim == 0:
+            return jax.device_put(x, NamedSharding(mesh, P()))
+        spec = [None] * x.ndim
+        spec[0] = "dp"
+        # Shard the capacity axis only when it divides evenly (side tables
+        # with small dim-1, e.g. ticket client tables, replicate along sp).
+        if seq_sharded and x.ndim >= 2 and sp > 1 and x.shape[1] % sp == 0:
+            spec[1] = "sp"
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+    return jax.tree_util.tree_map(place, state)
+
+
+def replicate(mesh: Mesh, tree):
+    return jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, NamedSharding(mesh, P())), tree)

@@ -1,0 +1,5 @@
+"""The ordering service (reference Routerlicious, SURVEY.md §2.5):
+deli sequencing (host lambda + device ticket kernel), scriptorium persistence,
+scribe summaries, broadcaster fan-out, the partition lambda host, in-memory
+log ("LocalKafka"), content-addressed storage (gitrest/historian), and the
+local server that wires it together for tests and dev."""

@@ -1,0 +1,21 @@
+"""The fused server pipeline step: deli ticketing + merge-tree apply +
+summary-length reduction in one jit program — the device half of a
+partition lambda (reference Deli -> Scriptorium/Scribe stage fusion,
+SURVEY.md §2.6.3 pipeline parallelism)."""
+
+from __future__ import annotations
+
+import jax
+
+from ..mergetree import kernel
+from . import ticket_kernel as tk
+
+
+def full_step(tstate, mstate, raw, ops):
+    """(ticket_state, merge_state, RawOps, PackedOps) ->
+    (ticket_state, merge_state, per-op seqs [B, T], per-doc visible length)."""
+    tstate, ticketed = tk._scan_tickets(tstate, raw, batched=True)
+    mstate = kernel._scan_ops(mstate, ops, batched=True)
+    total_len = jax.vmap(
+        lambda s: kernel.visibility(s, s.seq, -2)[1].sum())(mstate)
+    return tstate, mstate, ticketed.seq, total_len

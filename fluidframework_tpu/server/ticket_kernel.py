@@ -1,0 +1,166 @@
+"""Deli ticketing as a batched device kernel.
+
+The reference sequencer (server/routerlicious/packages/lambdas/src/deli/
+lambda.ts:142-224 ticket()) assigns each raw op a sequenceNumber and a
+minimumSequenceNumber (min over per-client refSeqs held in a heap,
+clientSeqManager.ts), nacks bad refSeqs, and drops duplicate clientSeqs.
+
+Here a whole partition tickets in one jit: ops are packed [B, T] (documents
+x time, NOOP-padded), per-document sequencing state is a fixed-size client
+table (the heap becomes a masked min over a [B, K] table), and lax.scan
+walks the time axis while vmap covers documents — the same shape discipline
+as the merge-tree kernel, so deli + apply fuse into one device pipeline.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+INT32_MAX = 2**31 - 1
+
+
+class TicketState(NamedTuple):
+    """Per-document sequencing state (leading batch axis when batched).
+
+    client_ids   [K] connected client ordinals (-1 = free slot)
+    client_ref   [K] each client's latest referenceSequenceNumber
+    client_cseq  [K] each client's last clientSequenceNumber (dup/gap guard)
+    next_seq     []  next sequenceNumber to assign
+    min_seq      []  current minimumSequenceNumber
+    """
+
+    client_ids: jnp.ndarray
+    client_ref: jnp.ndarray
+    client_cseq: jnp.ndarray
+    next_seq: jnp.ndarray
+    min_seq: jnp.ndarray
+
+
+class RawOps(NamedTuple):
+    """Unsequenced client ops, [B, T] (or [T] unbatched), NOOP = client -1."""
+
+    client: jnp.ndarray
+    client_seq: jnp.ndarray
+    ref_seq: jnp.ndarray
+
+
+class Ticketed(NamedTuple):
+    """Per-op ticketing results, same shape as the input RawOps."""
+
+    seq: jnp.ndarray      # assigned sequence number (0 for nacked/noop)
+    min_seq: jnp.ndarray  # msn stamped on the op
+    nacked: jnp.ndarray   # bool: refSeq below window or duplicate clientSeq
+
+
+def make_ticket_state(clients_capacity: int, batch: int | None = None
+                      ) -> TicketState:
+    def shape(*dims):
+        return dims if batch is None else (batch, *dims)
+    return TicketState(
+        client_ids=jnp.full(shape(clients_capacity), -1, jnp.int32),
+        client_ref=jnp.full(shape(clients_capacity), INT32_MAX, jnp.int32),
+        client_cseq=jnp.zeros(shape(clients_capacity), jnp.int32),
+        next_seq=jnp.ones(shape(), jnp.int32),
+        min_seq=jnp.zeros(shape(), jnp.int32),
+    )
+
+
+def _ticket_one(s: TicketState, client, client_seq, ref_seq
+                ) -> Tuple[TicketState, Tuple]:
+    """Ticket one op for one document (deli/lambda.ts:224 ticket())."""
+    is_op = client >= 0
+    k = s.client_ids.shape[-1]
+    slot_mask = s.client_ids == client
+    known = is_op & jnp.any(slot_mask)
+    slot = jnp.argmax(slot_mask)
+    # Unknown client joins the table at the first free slot (the reference
+    # creates the heap entry on first op / join).
+    free = s.client_ids == -1
+    join_slot = jnp.argmax(free)
+    can_join = is_op & ~known & jnp.any(free)
+    slot = jnp.where(known, slot, join_slot)
+    active = known | can_join
+
+    prev_cseq = jnp.where(known, s.client_cseq[slot], 0)
+    dup = known & (client_seq <= prev_cseq)
+    # refSeq must sit inside the collab window (deli nacks stale refs).
+    stale = is_op & (ref_seq < s.min_seq)
+    nacked = is_op & (dup | stale | ~active)
+    ticket = is_op & ~nacked
+
+    seq = jnp.where(ticket, s.next_seq, 0)
+    onehot = jnp.arange(k) == slot
+    upd = ticket & onehot
+    client_ids = jnp.where(upd, client, s.client_ids)
+    client_ref = jnp.where(upd, ref_seq, s.client_ref)
+    client_cseq = jnp.where(upd, client_seq, s.client_cseq)
+    # MSN: min over active clients' refSeqs (clientSeqManager heap min);
+    # monotone non-decreasing.
+    active_refs = jnp.where(client_ids >= 0, client_ref, INT32_MAX)
+    heap_min = jnp.min(active_refs)
+    msn = jnp.where(heap_min == INT32_MAX, s.min_seq,
+                    jnp.maximum(s.min_seq, heap_min))
+    s2 = TicketState(
+        client_ids=client_ids,
+        client_ref=client_ref,
+        client_cseq=client_cseq,
+        next_seq=jnp.where(ticket, s.next_seq + 1, s.next_seq),
+        min_seq=jnp.where(ticket, msn, s.min_seq),
+    )
+    return s2, (seq, s2.min_seq, nacked)
+
+
+def _leave_one(s: TicketState, client) -> TicketState:
+    """Evict a client from the MSN calculation (deli canEvict / leave)."""
+    gone = s.client_ids == client
+    return s._replace(
+        client_ids=jnp.where(gone, -1, s.client_ids),
+        client_ref=jnp.where(gone, INT32_MAX, s.client_ref),
+    )
+
+
+def _scan_tickets(state: TicketState, ops: RawOps, batched: bool
+                  ) -> Tuple[TicketState, Ticketed]:
+    steps = ops.client.shape[-1]
+
+    def body(s, t):
+        if batched:
+            s2, out = jax.vmap(
+                lambda sd, c, cs, r: _ticket_one(sd, c[t], cs[t], r[t])
+            )(s, ops.client, ops.client_seq, ops.ref_seq)
+        else:
+            s2, out = _ticket_one(s, ops.client[t], ops.client_seq[t],
+                                  ops.ref_seq[t])
+        return s2, out
+
+    state, (seq, msn, nacked) = jax.lax.scan(
+        body, state, jnp.arange(steps, dtype=jnp.int32))
+    # scan stacks on axis 0 (time); move time last to match [B, T] layout.
+    if batched:
+        seq, msn, nacked = (jnp.moveaxis(x, 0, -1) for x in (seq, msn, nacked))
+    return state, Ticketed(seq=seq, min_seq=msn, nacked=nacked)
+
+
+@jax.jit
+def ticket_ops(state: TicketState, ops: RawOps
+               ) -> Tuple[TicketState, Ticketed]:
+    """Ticket a [T] stream for one document."""
+    return _scan_tickets(state, ops, batched=False)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def ticket_ops_batched(state: TicketState, ops: RawOps
+                       ) -> Tuple[TicketState, Ticketed]:
+    """Ticket [B, T] streams for B documents in one jit."""
+    return _scan_tickets(state, ops, batched=True)
+
+
+@jax.jit
+def evict_clients_batched(state: TicketState, clients: jnp.ndarray
+                          ) -> TicketState:
+    """Evict one client per document ([B] array, -1 = none)."""
+    return jax.vmap(_leave_one)(state, clients)

@@ -57,12 +57,12 @@ def build_kernel_ops(builder, op_tuples):
     return ops
 
 
-def run_both(op_tuples, capacity=128, edges=256):
+def run_both(op_tuples, capacity=128, anno_slots=8):
     tree = MergeTreeOracle(local_client=GOD)
     apply_to_oracle(tree, op_tuples)
     builder = OpBuilder()
     ops = build_kernel_ops(builder, op_tuples)
-    state = make_state(capacity, edges)
+    state = make_state(capacity, anno_slots)
     state = kernel.apply_ops(state, pack_single(ops))
     assert not bool(state.overflow), "kernel overflow"
     return tree, state, builder.payloads
@@ -195,7 +195,7 @@ class TestKernelFuzz:
     def test_random_sequenced_schedules(self, seed):
         rng = random.Random(seed)
         ops = random_schedule(rng, n_clients=4, n_ops=30)
-        tree, state, payloads = run_both(ops, capacity=256, edges=512)
+        tree, state, payloads = run_both(ops, capacity=256, anno_slots=8)
         last = ops[-1][-1]
         perspectives = [(last, GOD)] + [
             (rng.randint(0, last), rng.choice([GOD, 1, 2, 3, 4]))
@@ -215,7 +215,7 @@ class TestKernelFuzz:
             b = OpBuilder()
             all_ops.append(build_kernel_ops(b, ops))
             builders.append(b)
-        state = make_state(256, 512, batch=len(schedules))
+        state = make_state(256, 8, batch=len(schedules))
         state = kernel.apply_ops_batched(state, pack_ops(all_ops))
         for d, (tree, b) in enumerate(zip(trees, builders)):
             got = extract_text(state, b.payloads, doc=d)
@@ -238,7 +238,7 @@ class TestKernelClientMode:
         # Our op acked as seq 2.
         tree.ack(2)
         k_ops.append(builder.ack_insert(local_seq=1, seq=2))
-        state = make_state(64, 64)
+        state = make_state(64, 8)
         state = kernel.apply_ops(state, pack_single(k_ops))
         got = extract_text(state, builder.payloads, ref_seq=2, client=1)
         assert got == tree.get_text() == "abcZZ"
@@ -262,7 +262,7 @@ class TestKernelClientMode:
         # Our remove acked at seq 3: overlapped chars keep seq 2.
         tree.ack(3)
         k_ops.append(builder.ack_remove(local_seq=2, seq=3))
-        state = make_state(64, 64)
+        state = make_state(64, 8)
         state = kernel.apply_ops(state, pack_single(k_ops))
         for persp in [(3, 1), (3, GOD), (2, GOD), (1, GOD)]:
             got = extract_text(state, builder.payloads, ref_seq=persp[0],
