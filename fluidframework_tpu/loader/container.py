@@ -1,0 +1,214 @@
+"""Container + Loader: document lifecycle against a real service.
+
+Capability parity with reference container-loader/src/{loader.ts,
+container.ts:186,543}: create-detached -> attach (upload initial summary,
+connect), load (fetch summary, init protocol + runtime, connect, process op
+tail), reconnect with pending resubmission, quorum/audience tracking, and
+the client summarize path (upload summary -> summarize op -> scribe ack,
+reference summaryCollection.ts:244 waitSummaryAck).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core.events import TypedEventEmitter
+from ..protocol.messages import MessageType, SequencedDocumentMessage
+from ..protocol.protocol_handler import ProtocolOpHandler, ProtocolState
+from ..protocol.summary import SummaryTree
+from ..runtime.container_runtime import ContainerRuntime
+from ..runtime.datastore_runtime import ChannelRegistry
+from .delta_manager import DeltaManager
+from .drivers.base import IDocumentService, IDocumentServiceFactory
+
+
+class Audience(TypedEventEmitter):
+    """Connected-client roster (reference container-loader/src/audience.ts)."""
+
+    def __init__(self):
+        super().__init__()
+        self.members: Dict[str, dict] = {}
+
+    def add_member(self, client_id: str, details: dict) -> None:
+        self.members[client_id] = details
+        self.emit("addMember", client_id, details)
+
+    def remove_member(self, client_id: str) -> None:
+        if client_id in self.members:
+            del self.members[client_id]
+            self.emit("removeMember", client_id)
+
+
+class Container(TypedEventEmitter):
+    """Events: "connected", "disconnected", "op", "summaryAck",
+    "summaryNack", "closed"."""
+
+    def __init__(self, document_id: str, service: IDocumentService,
+                 registry: Optional[ChannelRegistry] = None):
+        super().__init__()
+        self.document_id = document_id
+        self.service = service
+        self.storage = service.connect_to_storage()
+        self.delta_manager = DeltaManager(service)
+        self.protocol = ProtocolOpHandler()
+        self.audience = Audience()
+        self.runtime = ContainerRuntime(registry=registry)
+        self.attached = False
+        self.connected = False
+        self.closed = False
+        self._last_summary_handle: Optional[str] = None
+        self._summary_waiters: List[Callable[[str, bool, Any], None]] = []
+
+    # -- creation / loading ------------------------------------------------
+    @staticmethod
+    def create_detached(document_id: str, service: IDocumentService,
+                        registry: Optional[ChannelRegistry] = None
+                        ) -> "Container":
+        return Container(document_id, service, registry)
+
+    @staticmethod
+    def load(document_id: str, service: IDocumentService,
+             registry: Optional[ChannelRegistry] = None) -> "Container":
+        """Reference Container.load (container.ts:186): summary + op tail."""
+        container = Container(document_id, service, registry)
+        summary = container.storage.get_summary()
+        if summary is None:
+            raise FileNotFoundError(f"document {document_id!r} has no summary")
+        container._load_from_summary(summary)
+        versions = container.storage.get_versions(1)
+        container._last_summary_handle = versions[0] if versions else None
+        container.attached = True
+        container.connect()
+        return container
+
+    def _load_from_summary(self, summary: SummaryTree) -> None:
+        protocol_blob = summary.entries.get(".protocol")
+        if protocol_blob is not None:
+            state = json.loads(protocol_blob.content)
+            self.protocol = ProtocolOpHandler.load(ProtocolState(
+                sequence_number=state["sequenceNumber"],
+                minimum_sequence_number=state["minimumSequenceNumber"],
+                quorum_snapshot=state["quorum"]))
+        self.runtime.load(summary.entries[".app"])
+
+    # -- attach (detached -> live) ----------------------------------------
+    def attach(self) -> None:
+        """Upload the initial summary and go live (container.ts:543)."""
+        if self.attached:
+            return
+        for store in self.runtime.datastores.values():
+            store.connect()
+        self.storage.upload_summary(self._assemble_summary())
+        self.attached = True
+        self.connect()
+
+    # -- connection --------------------------------------------------------
+    def connect(self) -> None:
+        self.delta_manager.attach_op_handler(
+            self.protocol.sequence_number, self._process)
+        self.delta_manager.on("disconnect", self._on_disconnect)
+        self.delta_manager.on("nack", self._on_nack)
+        self.delta_manager.on("connect", self._on_connect_identity)
+        self.delta_manager.connect()
+
+    def _on_connect_identity(self, client_id: str) -> None:
+        """Runs before the op pump: the runtime must know its wire identity
+        when its own join op arrives (that is what flips it connected)."""
+        self.runtime.set_local_client(client_id)
+        if not self.runtime.attached:
+            self.runtime.attach(self.delta_manager.submit)
+        else:
+            self.runtime._submit_fn = self.delta_manager.submit
+
+    def _on_disconnect(self) -> None:
+        self.connected = False
+        self.runtime.set_connected(False)
+        self.emit("disconnected")
+
+    def _on_nack(self, nack) -> None:
+        # Reconnect with a fresh identity and resubmit (deltaManager nack
+        # path: resubmit or fatal close; we resubmit).
+        self.reconnect()
+
+    def reconnect(self) -> None:
+        self._on_disconnect()
+        self.delta_manager.reconnect()
+
+    def close(self) -> None:
+        self.closed = True
+        self.delta_manager.disconnect()
+        self.emit("closed")
+
+    # -- inbound sequenced stream -----------------------------------------
+    def _process(self, message: SequencedDocumentMessage) -> None:
+        self.protocol.process_message(message)
+        mtype = message.type
+        if mtype == MessageType.CLIENT_JOIN:
+            detail = json.loads(message.data) if message.data else {}
+            joined = detail.get("clientId")
+            self.audience.add_member(joined, detail.get("detail", {}))
+            if joined == self.delta_manager.client_id:
+                self.connected = True
+                self.emit("connected")
+        elif mtype == MessageType.CLIENT_LEAVE:
+            detail = json.loads(message.data) if message.data else {}
+            self.audience.remove_member(detail.get("clientId"))
+        elif mtype == MessageType.SUMMARY_ACK:
+            self._last_summary_handle = message.contents["handle"]
+            self._notify_summary(True, message.contents)
+            self.emit("summaryAck", message.contents)
+        elif mtype == MessageType.SUMMARY_NACK:
+            self._notify_summary(False, message.contents)
+            self.emit("summaryNack", message.contents)
+        self.runtime.process(message)
+        self.emit("op", message)
+
+    # -- summaries ---------------------------------------------------------
+    def _assemble_summary(self) -> SummaryTree:
+        root = SummaryTree()
+        snap = self.protocol.snapshot()
+        root.add_blob(".protocol", json.dumps({
+            "sequenceNumber": snap.sequence_number,
+            "minimumSequenceNumber": snap.minimum_sequence_number,
+            "quorum": snap.quorum_snapshot,
+        }))
+        root.entries[".app"] = self.runtime.summarize()
+        return root
+
+    def summarize(self, on_result: Optional[Callable[[str, bool, Any], None]]
+                  = None) -> str:
+        """Client summarize: upload -> summarize op -> scribe ack
+        (SURVEY.md §3.5). Returns the uploaded commit handle."""
+        handle = self.storage.upload_summary(
+            self._assemble_summary(), parent=self._last_summary_handle)
+        if on_result is not None:
+            self._summary_waiters.append(on_result)
+        self.delta_manager.submit(MessageType.SUMMARIZE, {
+            "handle": handle,
+            "head": self._last_summary_handle,
+            "message": f"summary@{self.protocol.sequence_number}",
+        })
+        return handle
+
+    def _notify_summary(self, ack: bool, contents: Any) -> None:
+        waiters, self._summary_waiters = self._summary_waiters, []
+        for fn in waiters:
+            fn(contents.get("handle"), ack, contents)
+
+
+class Loader:
+    """Resolves document ids to Containers (reference loader.ts)."""
+
+    def __init__(self, factory: IDocumentServiceFactory,
+                 registry: Optional[ChannelRegistry] = None):
+        self.factory = factory
+        self.registry = registry
+
+    def create_detached(self, document_id: str) -> Container:
+        service = self.factory.create_document_service(document_id)
+        return Container.create_detached(document_id, service, self.registry)
+
+    def resolve(self, document_id: str) -> Container:
+        service = self.factory.create_document_service(document_id)
+        return Container.load(document_id, service, self.registry)

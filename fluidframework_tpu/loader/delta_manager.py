@@ -1,0 +1,135 @@
+"""DeltaManager: the op pump between the wire and the container.
+
+Capability parity with reference container-loader/src/deltaManager.ts:108 —
+inbound queue with strict ordering, gap detection + catch-up fetch from
+delta storage (:1380 fetchMissingDeltas), outbound submission with
+clientSequenceNumber stamping, nack handling, and reconnect (new delta
+connection, refetch, hand the container a fresh client id to resubmit on).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from ..core.events import TypedEventEmitter
+from ..protocol.messages import DocumentMessage, SequencedDocumentMessage
+from .drivers.base import IDocumentService
+
+
+class DeltaManager(TypedEventEmitter):
+    """Events: "op" (each sequenced message, in order), "connect"
+    (client_id), "disconnect", "nack"."""
+
+    def __init__(self, service: IDocumentService,
+                 client_details: Optional[dict] = None):
+        super().__init__()
+        self.service = service
+        self.client_details = client_details or {}
+        self.delta_storage = service.connect_to_delta_storage()
+        self.connection = None
+        self.client_id: Optional[str] = None
+        self.last_sequence_number = 0
+        self.client_sequence_number = 0
+        self.minimum_sequence_number = 0
+        self._handler: Optional[Callable[[SequencedDocumentMessage], None]] = None
+        self._inbound: List[SequencedDocumentMessage] = []
+        self._processing = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def attach_op_handler(self, sequence_number: int,
+                          handler: Callable[[SequencedDocumentMessage], None]
+                          ) -> None:
+        """Start pumping at sequence_number (the loaded summary's seq)."""
+        self.last_sequence_number = sequence_number
+        self._handler = handler
+
+    def connect(self) -> str:
+        self.connection = self.service.connect_to_delta_stream(
+            self.client_details)
+        self.client_id = self.connection.client_id
+        self.client_sequence_number = 0
+        self.connection.on("op", self._enqueue)
+        self.connection.on("nack", lambda nack: self.emit("nack", nack))
+        self.connection.on("disconnect", lambda: self.emit("disconnect"))
+        # Identity must be known to listeners BEFORE the op pump runs: the
+        # catch-up tail contains our own join op, and the container runtime
+        # goes "connected" by recognizing its client id in it.
+        self.emit("connect", self.client_id)
+        self.catch_up()
+        return self.client_id
+
+    def disconnect(self) -> None:
+        if self.connection is not None:
+            conn, self.connection = self.connection, None
+            conn.close()
+
+    def reconnect(self) -> str:
+        """Drop the connection and establish a new identity; the container
+        resubmits pending ops against it (deltaManager.ts:1119)."""
+        self.disconnect()
+        return self.connect()
+
+    # -- outbound ----------------------------------------------------------
+    def submit(self, mtype: str, contents, data: Optional[str] = None,
+               before_send: Optional[Callable[[int], None]] = None) -> int:
+        """Stamp and send one op. `before_send(csn)` runs after the
+        clientSequenceNumber is assigned but before the wire push — callers
+        record pending state there, because over an in-process service the
+        sequenced ack can arrive synchronously inside the send."""
+        if self.connection is None:
+            raise ConnectionError("not connected")
+        self.client_sequence_number += 1
+        csn = self.client_sequence_number
+        msg = DocumentMessage(
+            client_sequence_number=csn,
+            reference_sequence_number=self.last_sequence_number,
+            type=mtype, contents=contents, data=data)
+        if before_send is not None:
+            before_send(csn)
+        self.connection.submit([msg])
+        return csn
+
+    # -- inbound -----------------------------------------------------------
+    def _enqueue(self, message: SequencedDocumentMessage) -> None:
+        self._inbound.append(message)
+        self._process_inbound()
+
+    def _process_inbound(self) -> None:
+        if self._processing:
+            return  # re-entrant deliveries drain in the outer loop
+        self._processing = True
+        try:
+            while self._inbound:
+                self._inbound.sort(key=lambda m: m.sequence_number)
+                msg = self._inbound[0]
+                if msg.sequence_number <= self.last_sequence_number:
+                    self._inbound.pop(0)  # duplicate
+                    continue
+                if msg.sequence_number > self.last_sequence_number + 1:
+                    fetched = self.delta_storage.get(
+                        self.last_sequence_number, msg.sequence_number - 1)
+                    if not fetched:
+                        break  # gap not yet durable; wait for more
+                    self._inbound = fetched + self._inbound
+                    continue
+                self._inbound.pop(0)
+                self._deliver(msg)
+        finally:
+            self._processing = False
+
+    def _deliver(self, msg: SequencedDocumentMessage) -> None:
+        self.last_sequence_number = msg.sequence_number
+        self.minimum_sequence_number = msg.minimum_sequence_number
+        if self._handler is not None:
+            self._handler(msg)
+        self.emit("op", msg)
+
+    def catch_up(self) -> None:
+        """Fetch + process everything durable past our position
+        (deltaManager.ts:1401)."""
+        while True:
+            fetched = self.delta_storage.get(self.last_sequence_number)
+            if not fetched:
+                return
+            for msg in fetched:
+                self._enqueue(msg)

@@ -1,0 +1,65 @@
+"""Driver SPI (reference packages/loader/driver-definitions/src/storage.ts:
+30-259): the service abstraction the loader consumes. A driver provides
+storage (summaries/blobs), delta storage (catch-up reads), and a delta
+connection (live op stream) for one document.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+from ...protocol.messages import DocumentMessage, SequencedDocumentMessage
+from ...protocol.summary import SummaryTree
+
+
+class IDocumentStorageService:
+    def get_summary(self, version: Optional[str] = None
+                    ) -> Optional[SummaryTree]:
+        raise NotImplementedError
+
+    def upload_summary(self, summary: SummaryTree,
+                       parent: Optional[str] = None) -> str:
+        """Returns the storage handle (commit sha) for a summarize op."""
+        raise NotImplementedError
+
+    def get_versions(self, count: int = 1) -> List[str]:
+        raise NotImplementedError
+
+
+class IDocumentDeltaStorageService:
+    def get(self, from_seq: int, to_seq: Optional[int] = None
+            ) -> List[SequencedDocumentMessage]:
+        raise NotImplementedError
+
+
+class IDocumentDeltaConnection:
+    """Live connection: .client_id, .submit(), events via .on('op'|'nack'|
+    'disconnect', fn), .close()."""
+
+    client_id: str
+
+    def submit(self, messages: List[DocumentMessage]) -> None:
+        raise NotImplementedError
+
+    def on(self, event: str, fn: Callable) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class IDocumentService:
+    def connect_to_storage(self) -> IDocumentStorageService:
+        raise NotImplementedError
+
+    def connect_to_delta_storage(self) -> IDocumentDeltaStorageService:
+        raise NotImplementedError
+
+    def connect_to_delta_stream(self, client_details: Optional[dict] = None
+                                ) -> IDocumentDeltaConnection:
+        raise NotImplementedError
+
+
+class IDocumentServiceFactory:
+    def create_document_service(self, document_id: str) -> IDocumentService:
+        raise NotImplementedError

@@ -1,0 +1,101 @@
+"""Local driver: the in-process driver onto LocalServer (reference
+packages/drivers/local-driver — the test backbone, SURVEY.md §2.3)."""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ...protocol.messages import ITrace, SequencedDocumentMessage
+from ...protocol.summary import SummaryTree
+from ...server.local_server import LocalServer
+from .base import (
+    IDocumentDeltaConnection,
+    IDocumentDeltaStorageService,
+    IDocumentService,
+    IDocumentServiceFactory,
+    IDocumentStorageService,
+)
+
+
+def _row_to_message(row: dict) -> SequencedDocumentMessage:
+    return SequencedDocumentMessage(
+        client_id=row["client_id"],
+        sequence_number=row["sequence_number"],
+        minimum_sequence_number=row["minimum_sequence_number"],
+        client_sequence_number=row["client_sequence_number"],
+        reference_sequence_number=row["reference_sequence_number"],
+        type=row["type"],
+        contents=row["contents"],
+        metadata=row.get("metadata"),
+        server_metadata=row.get("server_metadata"),
+        timestamp=row.get("timestamp", 0.0),
+        traces=[ITrace(**t) if isinstance(t, dict) else t
+                for t in row.get("traces", [])],
+        data=row.get("data"),
+    )
+
+
+class LocalDocumentStorageService(IDocumentStorageService):
+    def __init__(self, server: LocalServer, document_id: str):
+        self.store = server.storage(document_id)
+
+    def get_summary(self, version: Optional[str] = None):
+        return self.store.read_summary(commit_sha=version)
+
+    def upload_summary(self, summary: SummaryTree,
+                       parent: Optional[str] = None) -> str:
+        return self.store.write_summary(summary, base_commit=parent)
+
+    def get_versions(self, count: int = 1) -> List[str]:
+        return [c.sha for c in self.store.list_commits(limit=count)]
+
+
+class LocalDeltaStorageService(IDocumentDeltaStorageService):
+    def __init__(self, server: LocalServer, document_id: str):
+        self.server = server
+        self.document_id = document_id
+
+    def get(self, from_seq: int, to_seq: Optional[int] = None
+            ) -> List[SequencedDocumentMessage]:
+        rows = self.server.get_deltas(self.document_id, from_seq, to_seq)
+        return [_row_to_message(r) for r in rows]
+
+
+class LocalDocumentDeltaConnection(IDocumentDeltaConnection):
+    def __init__(self, server: LocalServer, document_id: str,
+                 client_details: Optional[dict]):
+        self._conn = server.connect(document_id, client_details)
+        self.client_id = self._conn.client_id
+
+    def submit(self, messages) -> None:
+        self._conn.submit(messages)
+
+    def on(self, event, fn) -> None:
+        self._conn.on(event, fn)
+
+    def close(self) -> None:
+        self._conn.disconnect()
+
+
+class LocalDocumentService(IDocumentService):
+    def __init__(self, server: LocalServer, document_id: str):
+        self.server = server
+        self.document_id = document_id
+
+    def connect_to_storage(self):
+        return LocalDocumentStorageService(self.server, self.document_id)
+
+    def connect_to_delta_storage(self):
+        return LocalDeltaStorageService(self.server, self.document_id)
+
+    def connect_to_delta_stream(self, client_details=None):
+        return LocalDocumentDeltaConnection(self.server, self.document_id,
+                                            client_details)
+
+
+class LocalDocumentServiceFactory(IDocumentServiceFactory):
+    def __init__(self, server: LocalServer):
+        self.server = server
+
+    def create_document_service(self, document_id: str) -> IDocumentService:
+        return LocalDocumentService(self.server, document_id)

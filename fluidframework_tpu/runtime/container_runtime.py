@@ -93,8 +93,7 @@ class ContainerRuntime(TypedEventEmitter):
         if self._batch is not None:
             self._batch.append(contents)
             return
-        csn = self._submit_fn(MessageType.OPERATION, contents)
-        self.pending.on_submit(csn, contents)
+        self._send(contents)
 
     def order_sequentially(self, callback: Callable[[], None]) -> None:
         """Batch ops submitted inside callback into one turn (reference
@@ -109,8 +108,14 @@ class ContainerRuntime(TypedEventEmitter):
         finally:
             self._batch = None
         for contents in batch:
-            csn = self._submit_fn(MessageType.OPERATION, contents)
-            self.pending.on_submit(csn, contents)
+            self._send(contents)
+
+    def _send(self, contents) -> None:
+        # Record pending BEFORE the wire push: over an in-process service
+        # the sequenced ack can arrive synchronously inside the send.
+        self._submit_fn(
+            MessageType.OPERATION, contents,
+            before_send=lambda csn: self.pending.on_submit(csn, contents))
 
     def _resubmit_all(self) -> None:
         self.pending.drain()

@@ -1,0 +1,38 @@
+"""Lambda SPI (reference services-core/src/lambdas.ts:18-73):
+IPartitionLambda.handler(message) processes one queued message;
+IContext.checkpoint(offset) commits progress; IContext.error signals
+recoverable-vs-fatal (restart => replay from last checkpoint)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from ..log import MessageLog, QueuedMessage
+
+
+class LambdaContext:
+    def __init__(self, log: MessageLog, group: str, topic: str,
+                 partition: int,
+                 on_error: Optional[Callable[[Exception, bool], None]] = None):
+        self.log = log
+        self.group = group
+        self.topic = topic
+        self.partition = partition
+        self._on_error = on_error
+
+    def checkpoint(self, offset: int) -> None:
+        self.log.commit(self.group, self.topic, self.partition, offset)
+
+    def error(self, err: Exception, restart: bool) -> None:
+        if self._on_error:
+            self._on_error(err, restart)
+        elif restart:
+            raise err
+
+
+class IPartitionLambda:
+    def handler(self, message: QueuedMessage) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass

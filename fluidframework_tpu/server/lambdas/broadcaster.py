@@ -1,0 +1,37 @@
+"""Broadcaster: fans sequenced ops out to connected clients per document
+room (reference broadcaster/lambda.ts — socket.io rooms batched per
+tenantId/documentId)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+from ...protocol.messages import SequencedDocumentMessage
+from ..log import QueuedMessage
+from .base import IPartitionLambda, LambdaContext
+
+
+class BroadcasterLambda(IPartitionLambda):
+    def __init__(self, context: LambdaContext,
+                 rooms: Dict[str, List[Callable]] = None):
+        self.context = context
+        # document id -> list of listener callbacks (the "room"). The dict
+        # may be owned by the hosting server so membership survives a
+        # crash-restart of this lambda (connection state is not log-derived).
+        self.rooms: Dict[str, List[Callable[[SequencedDocumentMessage], None]]] \
+            = rooms if rooms is not None else {}
+
+    def join_room(self, document_id: str,
+                  listener: Callable[[SequencedDocumentMessage], None]) -> None:
+        self.rooms.setdefault(document_id, []).append(listener)
+
+    def leave_room(self, document_id: str, listener) -> None:
+        room = self.rooms.get(document_id)
+        if room and listener in room:
+            room.remove(listener)
+
+    def handler(self, message: QueuedMessage) -> None:
+        doc_id, sequenced = message.value
+        for listener in list(self.rooms.get(doc_id, [])):
+            listener(sequenced)
+        self.context.checkpoint(message.offset)

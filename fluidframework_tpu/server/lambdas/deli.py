@@ -1,0 +1,188 @@
+"""Deli: the sequencer lambda.
+
+Capability parity with reference lambdas/src/deli/lambda.ts:82-224 — assign
+sequenceNumber + minimumSequenceNumber per document (min over per-client
+refSeqs), nack stale refSeqs, drop duplicate clientSeqs, manage client
+join/leave, emit NoClient when the document empties, and checkpoint state.
+
+Two execution paths share the semantics:
+- this host lambda: per-op, for the interactive local-server path;
+- server/ticket_kernel.py: the batched device kernel the partition host
+  uses to ticket whole [B, T] op blocks in one jit (the TPU "boxcar").
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from ...protocol.messages import (
+    Boxcar,
+    DocumentMessage,
+    MessageType,
+    Nack,
+    NackContent,
+    NACK_BAD_REF_SEQ,
+    SequencedDocumentMessage,
+)
+from ..log import QueuedMessage
+from .base import IPartitionLambda, LambdaContext
+
+
+@dataclass
+class ClientSeqState:
+    """Per-client sequencing entry (reference clientSeqManager.ts)."""
+
+    client_id: str
+    ref_seq: int
+    client_seq: int
+    can_evict: bool = True
+    last_update: float = field(default_factory=time.time)
+
+
+@dataclass
+class DeliCheckpoint:
+    sequence_number: int
+    minimum_sequence_number: int
+    log_offset: int
+    clients: List[dict]
+
+
+class DocumentDeliState:
+    def __init__(self, sequence_number: int = 0,
+                 minimum_sequence_number: int = 0, log_offset: int = -1):
+        self.sequence_number = sequence_number
+        self.minimum_sequence_number = minimum_sequence_number
+        self.log_offset = log_offset
+        self.clients: Dict[str, ClientSeqState] = {}
+
+    def msn(self) -> int:
+        refs = [c.ref_seq for c in self.clients.values()]
+        if not refs:
+            return self.minimum_sequence_number
+        return max(self.minimum_sequence_number, min(refs))
+
+
+class DeliLambda(IPartitionLambda):
+    def __init__(self, context: LambdaContext,
+                 emit: Callable[[str, SequencedDocumentMessage], None],
+                 nack: Callable[[str, str, Nack], None],
+                 checkpoints=None):
+        """emit(document_id, sequenced_message); nack(document_id,
+        client_id, nack). checkpoints: optional Collection for state dumps —
+        restored at construction so a crash-restarted lambda resumes from
+        its last checkpoint instead of re-sequencing from zero."""
+        self.context = context
+        self.emit = emit
+        self.nack = nack
+        self.docs: Dict[str, DocumentDeliState] = {}
+        self.checkpoints = checkpoints
+        if checkpoints is not None:
+            for row in checkpoints.find(lambda d: "documentId" in d):
+                self.docs[row["documentId"]] = self.load_state(row["state"])
+
+    # -- lambda ------------------------------------------------------------
+    def handler(self, message: QueuedMessage) -> None:
+        boxcar: Boxcar = message.value
+        doc_id = boxcar.document_id
+        state = self.docs.setdefault(doc_id, DocumentDeliState())
+        if message.offset <= state.log_offset:
+            return  # replayed message already processed (deli/lambda.ts:143)
+        for raw in boxcar.contents:
+            self._ticket(doc_id, state, boxcar.client_id, raw)
+        state.log_offset = message.offset
+        self.context.checkpoint(message.offset)
+        if self.checkpoints is not None:
+            self.checkpoints.upsert(
+                lambda d, _id=doc_id: d.get("documentId") == _id,
+                {"documentId": doc_id, "state": self._dump(state)})
+
+    def _dump(self, state: DocumentDeliState) -> dict:
+        return {
+            "sequenceNumber": state.sequence_number,
+            "minimumSequenceNumber": state.minimum_sequence_number,
+            "logOffset": state.log_offset,
+            "clients": [
+                {"clientId": c.client_id, "referenceSequenceNumber": c.ref_seq,
+                 "clientSequenceNumber": c.client_seq,
+                 "canEvict": c.can_evict}
+                for c in state.clients.values()],
+        }
+
+    @staticmethod
+    def load_state(dump: dict) -> DocumentDeliState:
+        state = DocumentDeliState(dump["sequenceNumber"],
+                                  dump["minimumSequenceNumber"],
+                                  dump["logOffset"])
+        for c in dump.get("clients", []):
+            state.clients[c["clientId"]] = ClientSeqState(
+                c["clientId"], c["referenceSequenceNumber"],
+                c["clientSequenceNumber"], c.get("canEvict", True))
+        return state
+
+    # -- ticketing (reference ticket(), deli/lambda.ts:224) ----------------
+    def _ticket(self, doc_id: str, state: DocumentDeliState,
+                client_id: Optional[str], msg: DocumentMessage) -> None:
+        mtype = msg.type
+        if mtype == MessageType.CLIENT_JOIN:
+            detail = _join_detail(msg)
+            joining = detail.get("clientId", client_id)
+            state.clients[joining] = ClientSeqState(
+                joining, ref_seq=state.sequence_number, client_seq=0,
+                can_evict=False)
+            self._sequence(doc_id, state, None, msg)
+            return
+        if mtype == MessageType.CLIENT_LEAVE:
+            detail = _join_detail(msg)
+            leaving = detail if isinstance(detail, str) else \
+                detail.get("clientId", client_id)
+            if leaving in state.clients:
+                del state.clients[leaving]
+                self._sequence(doc_id, state, None, msg)
+                if not state.clients:
+                    noclient = DocumentMessage(
+                        client_sequence_number=0,
+                        reference_sequence_number=state.sequence_number,
+                        type=MessageType.NO_CLIENT)
+                    self._sequence(doc_id, state, None, noclient)
+            return
+        if client_id is None:
+            # Server-generated control/system message.
+            self._sequence(doc_id, state, None, msg)
+            return
+        entry = state.clients.get(client_id)
+        if entry is None:
+            self.nack(doc_id, client_id, Nack(
+                msg, state.sequence_number,
+                NackContent(NACK_BAD_REF_SEQ, "client not joined")))
+            return
+        if msg.client_sequence_number <= entry.client_seq:
+            return  # duplicate (idempotent replay) — deli drops silently
+        if msg.reference_sequence_number < state.minimum_sequence_number:
+            self.nack(doc_id, client_id, Nack(
+                msg, state.sequence_number,
+                NackContent(NACK_BAD_REF_SEQ,
+                            "refSeq below minimum sequence number")))
+            return
+        entry.client_seq = msg.client_sequence_number
+        entry.ref_seq = msg.reference_sequence_number
+        entry.last_update = time.time()
+        self._sequence(doc_id, state, client_id, msg)
+
+    def _sequence(self, doc_id: str, state: DocumentDeliState,
+                  client_id: Optional[str], msg: DocumentMessage) -> None:
+        state.sequence_number += 1
+        state.minimum_sequence_number = min(state.msn(),
+                                            state.sequence_number - 1)
+        sequenced = SequencedDocumentMessage.from_document_message(
+            msg, client_id, state.sequence_number,
+            state.minimum_sequence_number)
+        self.emit(doc_id, sequenced)
+
+
+def _join_detail(msg: DocumentMessage):
+    import json
+    if msg.data is not None:
+        return json.loads(msg.data)
+    return msg.contents or {}

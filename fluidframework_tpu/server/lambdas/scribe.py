@@ -1,0 +1,90 @@
+"""Scribe: the server-side summary writer.
+
+Capability parity with reference lambdas/src/scribe/lambda.ts:40-192 — runs
+a ProtocolOpHandler replica over the sequenced stream, and on a client
+Summarize op validates + commits the uploaded summary to git storage, then
+emits summaryAck (or summaryNack) back through the sequencer. Also persists
+its own protocol-state checkpoints so a restart resumes mid-stream.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, Dict, Optional
+
+from ...protocol.messages import DocumentMessage, MessageType, \
+    SequencedDocumentMessage
+from ...protocol.protocol_handler import ProtocolOpHandler, ProtocolState
+from ..database import Collection
+from ..log import QueuedMessage
+from ..storage import GitStore, Historian
+from .base import IPartitionLambda, LambdaContext
+
+
+class ScribeLambda(IPartitionLambda):
+    def __init__(self, context: LambdaContext, historian: Historian,
+                 tenant_id: str,
+                 send_system: Callable[[str, DocumentMessage], None],
+                 checkpoints: Optional[Collection] = None):
+        """send_system(document_id, message) routes summaryAck/Nack back
+        through deli for sequencing."""
+        self.context = context
+        self.historian = historian
+        self.tenant_id = tenant_id
+        self.send_system = send_system
+        self.checkpoints = checkpoints
+        self.handlers: Dict[str, ProtocolOpHandler] = {}
+        if checkpoints is not None:
+            # Crash restart resumes each document's protocol replica from
+            # its checkpoint (duplicate sequenced ops replay as no-ops).
+            for row in checkpoints.find(lambda d: "documentId" in d):
+                self.load_checkpoint(row["documentId"], row)
+
+    def handler(self, message: QueuedMessage) -> None:
+        doc_id, sequenced = message.value
+        handler = self.handlers.setdefault(doc_id, ProtocolOpHandler())
+        handler.process_message(sequenced)
+        if sequenced.type == MessageType.SUMMARIZE:
+            self._handle_summarize(doc_id, sequenced)
+        self.context.checkpoint(message.offset)
+        if self.checkpoints is not None:
+            snap = handler.snapshot()
+            self.checkpoints.upsert(
+                lambda d, _id=doc_id: d.get("documentId") == _id,
+                {"documentId": doc_id,
+                 "sequenceNumber": snap.sequence_number,
+                 "minimumSequenceNumber": snap.minimum_sequence_number,
+                 "quorum": snap.quorum_snapshot,
+                 "logOffset": message.offset})
+
+    def _handle_summarize(self, doc_id: str,
+                          sequenced: SequencedDocumentMessage) -> None:
+        contents = sequenced.contents
+        if isinstance(contents, str):
+            contents = json.loads(contents)
+        store = self.historian.store(self.tenant_id, doc_id)
+        commit_sha = contents.get("handle")
+        commit = store.get(commit_sha) if commit_sha else None
+        if commit is None:
+            self.send_system(doc_id, DocumentMessage(
+                client_sequence_number=0,
+                reference_sequence_number=sequenced.sequence_number,
+                type=MessageType.SUMMARY_NACK,
+                contents={"summaryProposal": {
+                    "summarySequenceNumber": sequenced.sequence_number},
+                    "errorMessage": f"unknown summary commit {commit_sha!r}"}))
+            return
+        # Valid: advance the main ref and ack with the commit handle.
+        store.set_ref("main", commit_sha)
+        self.send_system(doc_id, DocumentMessage(
+            client_sequence_number=0,
+            reference_sequence_number=sequenced.sequence_number,
+            type=MessageType.SUMMARY_ACK,
+            contents={"handle": commit_sha, "summaryProposal": {
+                "summarySequenceNumber": sequenced.sequence_number}}))
+
+    def load_checkpoint(self, doc_id: str, dump: dict) -> None:
+        self.handlers[doc_id] = ProtocolOpHandler.load(ProtocolState(
+            sequence_number=dump["sequenceNumber"],
+            minimum_sequence_number=dump["minimumSequenceNumber"],
+            quorum_snapshot=dump["quorum"]))

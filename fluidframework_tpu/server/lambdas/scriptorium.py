@@ -1,0 +1,33 @@
+"""Scriptorium: persists sequenced deltas (reference scriptorium/lambda.ts:
+16-103 — batched Mongo insertMany, idempotent on duplicate keys, traces
+stripped before persisting)."""
+
+from __future__ import annotations
+
+from dataclasses import asdict
+from typing import List
+
+from ...protocol.messages import SequencedDocumentMessage
+from ..database import Collection
+from ..log import QueuedMessage
+from .base import IPartitionLambda, LambdaContext
+
+
+class ScriptoriumLambda(IPartitionLambda):
+    def __init__(self, context: LambdaContext, deltas: Collection):
+        self.context = context
+        self.deltas = deltas
+
+    def handler(self, message: QueuedMessage) -> None:
+        doc_id, sequenced = message.value
+        record = asdict(sequenced)
+        record["traces"] = []  # strip latency traces before persisting
+        record["documentId"] = doc_id
+        # The in-memory collection makes the reference's batched async
+        # insertMany a synchronous insert; duplicates (replay) are ignored.
+        self.deltas.insert_one(record)
+        self.context.checkpoint(message.offset)
+
+
+def delta_key(doc: dict):
+    return (doc["documentId"], doc["sequence_number"])

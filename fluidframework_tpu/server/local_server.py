@@ -1,0 +1,205 @@
+"""LocalServer: the full ordering service in one process.
+
+Capability parity with reference local-server's LocalDeltaConnectionServer
+(localDeltaConnectionServer.ts:59) + memory-orderer's LocalOrderer
+(localOrderer.ts:87-260): the *real* Deli/Scriptorium/Scribe/Broadcaster/
+Copier lambdas run over the in-memory MessageLog ("LocalKafka"), fronted by
+an Alfred-shaped connection API — the contract point for the local driver
+and the test backbone (SURVEY.md §4.4).
+
+Message flow (reference docker-compose pipeline):
+  Connection.submit -> boxcar -> 'rawdeltas' topic
+  DeliLambda: ticket -> 'deltas' topic (+ nacks straight to the socket)
+  ScriptoriumLambda -> deltas collection (catch-up queries)
+  ScribeLambda -> summary commits + summaryAck/Nack back through 'rawdeltas'
+  BroadcasterLambda -> connected Connection listeners
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core.events import TypedEventEmitter
+from ..protocol.messages import (
+    Boxcar,
+    DocumentMessage,
+    MessageType,
+    Nack,
+    SequencedDocumentMessage,
+)
+from .database import DatabaseManager
+from .lambdas import (
+    BroadcasterLambda,
+    CopierLambda,
+    DeliLambda,
+    ScribeLambda,
+    ScriptoriumLambda,
+)
+from .lambdas.scriptorium import delta_key
+from .log import MessageLog
+from .partition import LambdaRunner, PartitionManager
+from .storage import Historian
+
+RAW_TOPIC = "rawdeltas"
+DELTAS_TOPIC = "deltas"
+
+
+class Connection(TypedEventEmitter):
+    """A client's delta connection (the "websocket"). Events: "op"
+    (SequencedDocumentMessage), "nack" (Nack), "disconnect"."""
+
+    def __init__(self, server: "LocalServer", tenant_id: str,
+                 document_id: str, client_id: str, details: Optional[dict]):
+        super().__init__()
+        self.server = server
+        self.tenant_id = tenant_id
+        self.document_id = document_id
+        self.client_id = client_id
+        self.details = details or {}
+        self.connected = True
+
+    def submit(self, messages: List[DocumentMessage]) -> None:
+        if not self.connected:
+            raise ConnectionError("connection closed")
+        self.server._submit_boxcar(Boxcar(
+            tenant_id=self.tenant_id, document_id=self.document_id,
+            client_id=self.client_id, contents=list(messages)))
+
+    def disconnect(self) -> None:
+        if not self.connected:
+            return
+        self.connected = False
+        self.server._client_leave(self)
+        self.emit("disconnect")
+
+
+class LocalServer:
+    """One in-process ordering + storage service (single tenant scope per
+    instance is fine; tenant_id still namespaces storage)."""
+
+    def __init__(self, tenant_id: str = "local", partitions: int = 1,
+                 auto_pump: bool = True):
+        self.tenant_id = tenant_id
+        self.auto_pump = auto_pump
+        self.log = MessageLog(default_partitions=partitions)
+        self.db = DatabaseManager()
+        self.historian = Historian()
+        self.deltas = self.db.collection("deltas", unique_key=delta_key)
+        self.raw_deltas = self.db.collection("rawdeltas")
+        self.deli_checkpoints = self.db.collection("deliCheckpoints")
+        self.scribe_checkpoints = self.db.collection("scribeCheckpoints")
+        self._connections: Dict[str, List[Connection]] = {}
+        # Broadcaster room membership lives here (not in the lambda) so it
+        # survives lambda crash-restarts; the lambda reads it by reference.
+        self._rooms: Dict[str, List] = {}
+        self._client_counter = itertools.count(1)
+        self._pump_lock = threading.RLock()
+
+        # Ensure topics exist before wiring consumers.
+        self.log.topic(RAW_TOPIC)
+        self.log.topic(DELTAS_TOPIC)
+
+        self.runner = LambdaRunner()
+        self._deli_mgr = self.runner.add(PartitionManager(
+            self.log, "deli", RAW_TOPIC,
+            lambda ctx: DeliLambda(ctx, emit=self._emit_sequenced,
+                                   nack=self._emit_nack,
+                                   checkpoints=self.deli_checkpoints)))
+        self._copier_mgr = self.runner.add(PartitionManager(
+            self.log, "copier", RAW_TOPIC,
+            lambda ctx: CopierLambda(ctx, self.raw_deltas)))
+        self._scriptorium_mgr = self.runner.add(PartitionManager(
+            self.log, "scriptorium", DELTAS_TOPIC,
+            lambda ctx: ScriptoriumLambda(ctx, self.deltas)))
+        self._scribe_mgr = self.runner.add(PartitionManager(
+            self.log, "scribe", DELTAS_TOPIC,
+            lambda ctx: ScribeLambda(ctx, self.historian, tenant_id,
+                                     send_system=self._send_system,
+                                     checkpoints=self.scribe_checkpoints)))
+        self._broadcaster_mgr = self.runner.add(PartitionManager(
+            self.log, "broadcaster", DELTAS_TOPIC,
+            lambda ctx: BroadcasterLambda(ctx, rooms=self._rooms)))
+
+    # -- internal wiring ---------------------------------------------------
+    def _emit_sequenced(self, doc_id: str,
+                        sequenced: SequencedDocumentMessage) -> None:
+        self.log.send(DELTAS_TOPIC, doc_id, (doc_id, sequenced))
+
+    def _emit_nack(self, doc_id: str, client_id: str, nack: Nack) -> None:
+        for conn in self._connections.get(doc_id, []):
+            if conn.client_id == client_id and conn.connected:
+                conn.emit("nack", nack)
+
+    def _send_system(self, doc_id: str, message: DocumentMessage) -> None:
+        self.log.send(RAW_TOPIC, doc_id, Boxcar(
+            tenant_id=self.tenant_id, document_id=doc_id, client_id=None,
+            contents=[message]))
+
+    def _submit_boxcar(self, boxcar: Boxcar) -> None:
+        self.log.send(RAW_TOPIC, boxcar.document_id, boxcar)
+        if self.auto_pump:
+            self.pump()
+
+    # -- the Alfred surface (connect/disconnect, catch-up, storage) --------
+    def connect(self, document_id: str,
+                details: Optional[dict] = None) -> Connection:
+        client_id = f"client-{next(self._client_counter)}"
+        conn = Connection(self, self.tenant_id, document_id, client_id,
+                          details)
+        self._connections.setdefault(document_id, []).append(conn)
+        # Broadcaster room subscription (removed again at disconnect).
+        conn._room_listener = \
+            lambda msg, c=conn: c.connected and c.emit("op", msg)
+        self._rooms.setdefault(document_id, []).append(conn._room_listener)
+        # Join op through the sequencer (alfred connect_document).
+        import json
+        self._send_system(document_id, DocumentMessage(
+            client_sequence_number=0, reference_sequence_number=-1,
+            type=MessageType.CLIENT_JOIN,
+            data=json.dumps({"clientId": client_id,
+                             "detail": conn.details})))
+        if self.auto_pump:
+            self.pump()
+        return conn
+
+    def _client_leave(self, conn: Connection) -> None:
+        import json
+        room = self._connections.get(conn.document_id, [])
+        if conn in room:
+            room.remove(conn)
+        listeners = self._rooms.get(conn.document_id, [])
+        if conn._room_listener in listeners:
+            listeners.remove(conn._room_listener)
+        self._send_system(conn.document_id, DocumentMessage(
+            client_sequence_number=0, reference_sequence_number=-1,
+            type=MessageType.CLIENT_LEAVE,
+            data=json.dumps({"clientId": conn.client_id})))
+        if self.auto_pump:
+            self.pump()
+
+    def get_deltas(self, document_id: str, from_seq: int = 0,
+                   to_seq: Optional[int] = None) -> List[dict]:
+        """Catch-up range query (alfred delta REST API over the scriptorium
+        collection): ops with from_seq < seq <= to_seq, ordered."""
+        hi = to_seq if to_seq is not None else 2**62
+        out = self.deltas.find(
+            lambda d: d["documentId"] == document_id
+            and from_seq < d["sequence_number"] <= hi)
+        out.sort(key=lambda d: d["sequence_number"])
+        return out
+
+    def storage(self, document_id: str):
+        return self.historian.store(self.tenant_id, document_id)
+
+    def pump(self) -> int:
+        """Drive every lambda stage to quiescence (synchronous pipeline)."""
+        with self._pump_lock:
+            return self.runner.pump()
+
+    # -- introspection ----------------------------------------------------
+    def sequence_number(self, document_id: str) -> int:
+        row = self.deli_checkpoints.find_one(
+            lambda d: d.get("documentId") == document_id)
+        return row["state"]["sequenceNumber"] if row else 0

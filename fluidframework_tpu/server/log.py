@@ -1,0 +1,108 @@
+"""The ordered log: topics, partitions, offsets, consumer checkpoints.
+
+Capability parity with the reference's Kafka backbone (services-core
+IProducer/IConsumer/IQueuedMessage, queue.ts) and its in-memory stand-in
+LocalKafka (memory-orderer/src/localKafka.ts). Messages are boxcars keyed
+by document; documents hash to partitions; consumers poll per partition and
+commit offsets, so a crashed lambda replays from its last checkpoint
+idempotently (kafka-service/README design).
+
+A C++ shared-memory implementation with the same interface lives in
+fluidframework_tpu.native.oplog (the librdkafka-equivalent native path);
+this module is the always-available pure-Python engine and the fallback.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass
+class QueuedMessage:
+    topic: str
+    partition: int
+    offset: int
+    key: str
+    value: Any
+
+
+class Partition:
+    def __init__(self, topic: str, index: int):
+        self.topic = topic
+        self.index = index
+        self.messages: List[QueuedMessage] = []
+        self.lock = threading.Lock()
+        self.listeners: List[Callable[[QueuedMessage], None]] = []
+
+    def append(self, key: str, value: Any) -> QueuedMessage:
+        with self.lock:
+            msg = QueuedMessage(self.topic, self.index, len(self.messages),
+                                key, value)
+            self.messages.append(msg)
+            listeners = list(self.listeners)
+        for fn in listeners:
+            fn(msg)
+        return msg
+
+    def read(self, offset: int, limit: int = 1000) -> List[QueuedMessage]:
+        with self.lock:
+            return self.messages[offset:offset + limit]
+
+    @property
+    def end_offset(self) -> int:
+        with self.lock:
+            return len(self.messages)
+
+
+class Topic:
+    def __init__(self, name: str, partitions: int):
+        self.name = name
+        self.partitions = [Partition(name, i) for i in range(partitions)]
+
+    def partition_for(self, key: str) -> Partition:
+        return self.partitions[hash(key) % len(self.partitions)]
+
+
+class MessageLog:
+    """Broker: named topics with N partitions each + consumer-group offsets."""
+
+    def __init__(self, default_partitions: int = 1):
+        self.topics: Dict[str, Topic] = {}
+        self.default_partitions = default_partitions
+        # (group, topic, partition) -> committed offset
+        self.checkpoints: Dict[tuple, int] = {}
+        self._lock = threading.Lock()
+
+    def topic(self, name: str, partitions: Optional[int] = None) -> Topic:
+        with self._lock:
+            if name not in self.topics:
+                self.topics[name] = Topic(
+                    name, partitions or self.default_partitions)
+            return self.topics[name]
+
+    # -- producer ----------------------------------------------------------
+    def send(self, topic: str, key: str, value: Any) -> QueuedMessage:
+        return self.topic(topic).partition_for(key).append(key, value)
+
+    # -- consumer ----------------------------------------------------------
+    def poll(self, group: str, topic: str, partition: int = 0,
+             limit: int = 1000) -> List[QueuedMessage]:
+        start = self.committed(group, topic, partition)
+        return self.topic(topic).partitions[partition].read(start, limit)
+
+    def commit(self, group: str, topic: str, partition: int,
+               offset: int) -> None:
+        """Commit 'processed through offset' (next poll starts at offset+1)."""
+        with self._lock:
+            key = (group, topic, partition)
+            if offset + 1 > self.checkpoints.get(key, 0):
+                self.checkpoints[key] = offset + 1
+
+    def committed(self, group: str, topic: str, partition: int) -> int:
+        return self.checkpoints.get((group, topic, partition), 0)
+
+    def subscribe(self, topic: str, partition: int,
+                  fn: Callable[[QueuedMessage], None]) -> None:
+        self.topic(topic).partitions[partition].listeners.append(fn)

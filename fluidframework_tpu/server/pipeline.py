@@ -1,21 +1,35 @@
 """The fused server pipeline step: deli ticketing + merge-tree apply +
 summary-length reduction in one jit program — the device half of a
 partition lambda (reference Deli -> Scriptorium/Scribe stage fusion,
-SURVEY.md §2.6.3 pipeline parallelism)."""
+SURVEY.md §2.6.3 pipeline parallelism).
+
+The ticketing output FEEDS the apply: each op's assigned sequence number and
+msn replace the packed columns, and ops the sequencer rejected (nack) or
+dropped (duplicate) are turned into NOOPs before the merge-tree sees them —
+the document state can only contain what the sequencer admitted.
+"""
 
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 
 from ..mergetree import kernel
+from ..mergetree.oppack import OpKind
 from . import ticket_kernel as tk
 
 
 def full_step(tstate, mstate, raw, ops):
     """(ticket_state, merge_state, RawOps, PackedOps) ->
-    (ticket_state, merge_state, per-op seqs [B, T], per-doc visible length)."""
+    (ticket_state, merge_state, Ticketed, per-doc visible length)."""
     tstate, ticketed = tk._scan_tickets(tstate, raw, batched=True)
+    admitted = ticketed.seq > 0
+    ops = ops._replace(
+        kind=jnp.where(admitted, ops.kind, OpKind.NOOP),
+        seq=jnp.where(admitted, ticketed.seq, ops.seq),
+        msn=jnp.where(admitted, ticketed.min_seq, ops.msn),
+    )
     mstate = kernel._scan_ops(mstate, ops, batched=True)
     total_len = jax.vmap(
         lambda s: kernel.visibility(s, s.seq, -2)[1].sum())(mstate)
-    return tstate, mstate, ticketed.seq, total_len
+    return tstate, mstate, ticketed, total_len

@@ -86,11 +86,13 @@ def _ticket_one(s: TicketState, client, client_seq, ref_seq
     active = known | can_join
 
     prev_cseq = jnp.where(known, s.client_cseq[slot], 0)
+    # Duplicate clientSeq: silently dropped, NOT nacked — matching the host
+    # deli (deli.py), so an at-least-once log replay is benign on both paths.
     dup = known & (client_seq <= prev_cseq)
     # refSeq must sit inside the collab window (deli nacks stale refs).
     stale = is_op & (ref_seq < s.min_seq)
-    nacked = is_op & (dup | stale | ~active)
-    ticket = is_op & ~nacked
+    nacked = is_op & (stale | ~active)
+    ticket = is_op & ~dup & ~nacked
 
     seq = jnp.where(ticket, s.next_seq, 0)
     onehot = jnp.arange(k) == slot
@@ -99,11 +101,14 @@ def _ticket_one(s: TicketState, client, client_seq, ref_seq
     client_ref = jnp.where(upd, ref_seq, s.client_ref)
     client_cseq = jnp.where(upd, client_seq, s.client_cseq)
     # MSN: min over active clients' refSeqs (clientSeqManager heap min);
-    # monotone non-decreasing.
+    # monotone non-decreasing, clamped below the just-assigned seq so a
+    # future-dated refSeq cannot poison the window (host deli applies the
+    # same min(msn, seq-1) clamp in _sequence).
     active_refs = jnp.where(client_ids >= 0, client_ref, INT32_MAX)
     heap_min = jnp.min(active_refs)
     msn = jnp.where(heap_min == INT32_MAX, s.min_seq,
                     jnp.maximum(s.min_seq, heap_min))
+    msn = jnp.minimum(msn, s.next_seq - 1)
     s2 = TicketState(
         client_ids=client_ids,
         client_ref=client_ref,

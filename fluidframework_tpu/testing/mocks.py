@@ -45,8 +45,10 @@ class MockSequencedEnvironment:
         state = _ClientState(client_id, runtime)
         self.clients[client_id] = state
 
-        def submit_fn(mtype, contents, _state=state):
+        def submit_fn(mtype, contents, before_send=None, _state=state):
             _state.csn += 1
+            if before_send is not None:
+                before_send(_state.csn)
             _state.queue.append(
                 (mtype, contents, _state.csn, _state.last_seen_seq))
             return _state.csn
