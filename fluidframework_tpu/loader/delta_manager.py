@@ -13,6 +13,7 @@ from typing import Callable, List, Optional
 
 from ..core.events import TypedEventEmitter
 from ..protocol.messages import DocumentMessage, SequencedDocumentMessage
+from ..telemetry import ChildLogger, OpRoundTripTelemetry, TelemetryLogger
 from .drivers.base import IDocumentService
 
 
@@ -21,7 +22,8 @@ class DeltaManager(TypedEventEmitter):
     (client_id), "disconnect", "nack"."""
 
     def __init__(self, service: IDocumentService,
-                 client_details: Optional[dict] = None):
+                 client_details: Optional[dict] = None,
+                 logger: Optional[TelemetryLogger] = None):
         super().__init__()
         self.service = service
         self.client_details = client_details or {}
@@ -31,6 +33,9 @@ class DeltaManager(TypedEventEmitter):
         self.last_sequence_number = 0
         self.client_sequence_number = 0
         self.minimum_sequence_number = 0
+        self.logger = ChildLogger.create(logger, "DeltaManager")
+        self._op_perf = OpRoundTripTelemetry(lambda: self.client_id,
+                                             self.logger)
         self._handler: Optional[Callable[[SequencedDocumentMessage], None]] = None
         self._inbound: List[SequencedDocumentMessage] = []
         self._processing = False
@@ -86,6 +91,7 @@ class DeltaManager(TypedEventEmitter):
             type=mtype, contents=contents, data=data)
         if before_send is not None:
             before_send(csn)
+        self._op_perf.on_submit(csn)
         self.connection.submit([msg])
         return csn
 
@@ -120,6 +126,7 @@ class DeltaManager(TypedEventEmitter):
     def _deliver(self, msg: SequencedDocumentMessage) -> None:
         self.last_sequence_number = msg.sequence_number
         self.minimum_sequence_number = msg.minimum_sequence_number
+        self._op_perf.on_sequenced(msg)
         if self._handler is not None:
             self._handler(msg)
         self.emit("op", msg)

@@ -20,6 +20,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ...protocol.messages import (
     Boxcar,
     DocumentMessage,
+    ITrace,
     MessageType,
     Nack,
     NackContent,
@@ -178,6 +179,8 @@ class DeliLambda(IPartitionLambda):
         sequenced = SequencedDocumentMessage.from_document_message(
             msg, client_id, state.sequence_number,
             state.minimum_sequence_number)
+        # Wire-level latency trace stamp (reference deli/lambda.ts:154).
+        sequenced.traces.append(ITrace.now("deli", "sequence"))
         self.emit(doc_id, sequenced)
 
 

@@ -1,0 +1,24 @@
+"""Telemetry: logger hierarchy, perf spans, round-trip measurement, mocks.
+
+Parity: reference packages/utils/telemetry-utils (see SURVEY.md §5
+Metrics/logging)."""
+
+from .logger import (
+    ERROR,
+    GENERIC,
+    PERFORMANCE,
+    ChildLogger,
+    DebugLogger,
+    MultiSinkLogger,
+    OpRoundTripTelemetry,
+    PerformanceEvent,
+    TelemetryLogger,
+)
+from .mock import MockLogger
+
+__all__ = [
+    "ERROR", "GENERIC", "PERFORMANCE",
+    "ChildLogger", "DebugLogger", "MultiSinkLogger",
+    "OpRoundTripTelemetry", "PerformanceEvent", "TelemetryLogger",
+    "MockLogger",
+]

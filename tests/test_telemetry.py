@@ -1,0 +1,129 @@
+"""Telemetry subsystem tests: logger hierarchy, perf spans, traces.
+
+Models reference telemetry-utils test usage (MockLogger assertions) and the
+wire-trace behavior of deli (stamp) / scriptorium (strip)."""
+
+import logging
+
+import pytest
+
+from fluidframework_tpu.telemetry import (
+    ChildLogger,
+    DebugLogger,
+    MockLogger,
+    MultiSinkLogger,
+    OpRoundTripTelemetry,
+    PerformanceEvent,
+)
+
+
+def test_child_logger_namespaces_and_props():
+    mock = MockLogger()
+    child = ChildLogger.create(mock, "Container", {"docId": "d1"})
+    grand = ChildLogger.create(child, "DeltaManager")
+    grand.send_telemetry_event({"eventName": "Connected", "clientId": "c1"})
+    assert len(mock.events) == 1
+    ev = mock.events[0]
+    assert ev["eventName"] == "Container:DeltaManager:Connected"
+    assert ev["docId"] == "d1"
+    assert ev["clientId"] == "c1"
+    assert ev["category"] == "generic"
+
+
+def test_error_event_folds_exception():
+    mock = MockLogger()
+    try:
+        raise ValueError("boom")
+    except ValueError as e:
+        mock.send_error_event({"eventName": "Oops"}, e)
+    ev = mock.events[0]
+    assert ev["category"] == "error"
+    assert ev["error"] == "boom"
+    assert ev["errorType"] == "ValueError"
+
+
+def test_multi_sink_fans_out():
+    a, b = MockLogger(), MockLogger()
+    multi = MultiSinkLogger()
+    multi.add_logger(a)
+    multi.add_logger(b)
+    multi.send_telemetry_event({"eventName": "X"})
+    assert len(a.events) == 1 and len(b.events) == 1
+
+
+def test_performance_event_span():
+    mock = MockLogger()
+    ev = PerformanceEvent.start(mock, {"eventName": "Summarize"})
+    ev.report_progress({"phase": "generate"})
+    ev.end({"opCount": 5})
+    names = [e["eventName"] for e in mock.events]
+    assert names == ["Summarize_start", "Summarize_update", "Summarize_end"]
+    assert mock.events[2]["duration"] >= 0
+    assert mock.events[2]["opCount"] == 5
+
+
+def test_performance_event_cancel_on_exception():
+    mock = MockLogger()
+    with pytest.raises(RuntimeError):
+        with PerformanceEvent.timed_event(mock, {"eventName": "Load"}):
+            raise RuntimeError("nope")
+    assert mock.events[-1]["eventName"] == "Load_cancel"
+    assert mock.events[-1]["errorType"] == "RuntimeError"
+
+
+def test_mock_logger_match_events_order():
+    mock = MockLogger()
+    for name in ["A", "B", "C"]:
+        mock.send_telemetry_event({"eventName": name})
+    assert mock.match_events([{"eventName": "A"}, {"eventName": "C"}])
+    assert not mock.match_events([{"eventName": "C"}, {"eventName": "A"}])
+
+
+def test_debug_logger_routes_to_logging(caplog):
+    logger = DebugLogger.create("fluid.test")
+    with caplog.at_level(logging.DEBUG, logger="fluid.test"):
+        logger.send_telemetry_event({"eventName": "Hello", "n": 1})
+        logger.send_error_event({"eventName": "Bad"})
+    assert any("Hello" in r.message for r in caplog.records)
+    assert any(r.levelno == logging.ERROR for r in caplog.records)
+
+
+def test_op_roundtrip_telemetry_samples():
+    mock = MockLogger()
+    perf = OpRoundTripTelemetry(lambda: "me", mock)
+    perf.SAMPLE_EVERY = 2
+
+    class Msg:
+        def __init__(self, cid, csn, seq):
+            self.client_id = cid
+            self.client_sequence_number = csn
+            self.sequence_number = seq
+
+    perf.on_submit(1)
+    perf.on_submit(2)  # sampled
+    perf.on_sequenced(Msg("other", 2, 10))  # not ours
+    perf.on_sequenced(Msg("me", 1, 11))     # not the tracked csn
+    perf.on_sequenced(Msg("me", 2, 12))     # ack of tracked op
+    mock.assert_match_any({"eventName": "OpRoundtripTime",
+                           "sequenceNumber": 12})
+
+
+def test_deli_stamps_trace_scriptorium_strips():
+    """Sequenced messages carry an ITrace from deli; scriptorium removes
+    traces before persisting (reference scriptorium/lambda.ts:34)."""
+    from fluidframework_tpu.server.local_server import LocalServer
+
+    server = LocalServer()
+    conn = server.connect("doc-t", {"user": "u"})
+    seen = []
+    conn.on("op", seen.append)
+    from fluidframework_tpu.protocol.messages import DocumentMessage
+    conn.submit([DocumentMessage(client_sequence_number=1,
+                                 reference_sequence_number=0,
+                                 type="op", contents={"x": 1})])
+    assert seen, "no sequenced ops delivered"
+    assert any(t.service == "deli" for m in seen for t in m.traces)
+    # Persisted records have traces stripped.
+    stored = server.get_deltas("doc-t", 0)
+    assert stored
+    assert all(not m["traces"] for m in stored)
