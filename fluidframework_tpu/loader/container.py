@@ -99,7 +99,7 @@ class Container(TypedEventEmitter):
             return
         for store in self.runtime.datastores.values():
             store.connect()
-        self.storage.upload_summary(self._assemble_summary())
+        self.storage.upload_summary(self._assemble_summary(), initial=True)
         self.attached = True
         self.connect()
 
@@ -154,6 +154,13 @@ class Container(TypedEventEmitter):
         elif mtype == MessageType.CLIENT_LEAVE:
             detail = json.loads(message.data) if message.data else {}
             self.audience.remove_member(detail.get("clientId"))
+        elif mtype == MessageType.SUMMARIZE:
+            # Our own summarize op sequencing: its sequence number is the
+            # summarySequenceNumber acks correlate on (summaryCollection.ts).
+            if message.client_id == self.delta_manager.client_id:
+                for waiter in self._summary_waiters:
+                    if waiter["csn"] == message.client_sequence_number:
+                        waiter["summary_seq"] = message.sequence_number
         elif mtype == MessageType.SUMMARY_ACK:
             self._last_summary_handle = message.contents["handle"]
             self._notify_summary(True, message.contents)
@@ -182,19 +189,34 @@ class Container(TypedEventEmitter):
         (SURVEY.md §3.5). Returns the uploaded commit handle."""
         handle = self.storage.upload_summary(
             self._assemble_summary(), parent=self._last_summary_handle)
-        if on_result is not None:
-            self._summary_waiters.append(on_result)
+        # Register the waiter inside before_send: over an in-process service
+        # the sequenced SUMMARIZE op AND its ack can both arrive synchronously
+        # within submit(), and the waiter must exist (with its csn) by then.
+        waiter = ({"csn": None, "summary_seq": None, "fn": on_result}
+                  if on_result is not None else None)
+
+        def _register(csn: int) -> None:
+            if waiter is not None:
+                waiter["csn"] = csn
+                self._summary_waiters.append(waiter)
+
         self.delta_manager.submit(MessageType.SUMMARIZE, {
             "handle": handle,
             "head": self._last_summary_handle,
             "message": f"summary@{self.protocol.sequence_number}",
-        })
+        }, before_send=_register)
         return handle
 
     def _notify_summary(self, ack: bool, contents: Any) -> None:
-        waiters, self._summary_waiters = self._summary_waiters, []
-        for fn in waiters:
-            fn(contents.get("handle"), ack, contents)
+        proposal = (contents or {}).get("summaryProposal", {})
+        target = proposal.get("summarySequenceNumber")
+        remaining = []
+        for waiter in self._summary_waiters:
+            if waiter["summary_seq"] == target and target is not None:
+                waiter["fn"](contents.get("handle"), ack, contents)
+            else:
+                remaining.append(waiter)
+        self._summary_waiters = remaining
 
 
 class Loader:

@@ -18,8 +18,11 @@ class IDocumentStorageService:
         raise NotImplementedError
 
     def upload_summary(self, summary: SummaryTree,
-                       parent: Optional[str] = None) -> str:
-        """Returns the storage handle (commit sha) for a summarize op."""
+                       parent: Optional[str] = None,
+                       initial: bool = False) -> str:
+        """Returns the storage handle (commit sha) for a summarize op.
+        initial=True marks the attach summary, which becomes the load
+        target directly; other uploads await a scribe summaryAck."""
         raise NotImplementedError
 
     def get_versions(self, count: int = 1) -> List[str]:

@@ -37,14 +37,24 @@ def _row_to_message(row: dict) -> SequencedDocumentMessage:
 
 class LocalDocumentStorageService(IDocumentStorageService):
     def __init__(self, server: LocalServer, document_id: str):
+        self.server = server
+        self.document_id = document_id
         self.store = server.storage(document_id)
 
     def get_summary(self, version: Optional[str] = None):
-        return self.store.read_summary(commit_sha=version)
+        # Reads ride the historian cache (reference: drivers talk to
+        # historian, the caching proxy, never to gitrest directly).
+        return self.server.historian.read_summary(
+            self.server.tenant_id, self.document_id, commit_sha=version)
 
     def upload_summary(self, summary: SummaryTree,
-                       parent: Optional[str] = None) -> str:
-        return self.store.write_summary(summary, base_commit=parent)
+                       parent: Optional[str] = None,
+                       initial: bool = False) -> str:
+        """initial=True is the attach summary: it becomes the load target
+        immediately (no scribe in the loop yet). Later uploads are proposals;
+        scribe advances the ref on summaryAck."""
+        return self.store.write_summary(summary, base_commit=parent,
+                                        advance_ref=initial)
 
     def get_versions(self, count: int = 1) -> List[str]:
         return [c.sha for c in self.store.list_commits(limit=count)]

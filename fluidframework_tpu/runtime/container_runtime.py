@@ -72,6 +72,10 @@ class ContainerRuntime(TypedEventEmitter):
         self.connected = connected
         if connected and not was:
             self._resubmit_all()
+        elif was and not connected:
+            # In-flight ops may still be sequenced under the old client id;
+            # remember it so process() can recognize them as ours.
+            self.pending.on_connection_change(self.client_id)
         self.emit("connected" if connected else "disconnected")
 
     # -- datastores --------------------------------------------------------
@@ -159,6 +163,12 @@ class ContainerRuntime(TypedEventEmitter):
                  and self.client_id is not None)
         if local:
             self.pending.on_local_ack(message.client_sequence_number)
+        elif message.client_id is not None:
+            # An op of ours sequenced under a previous connection's id:
+            # ack it instead of double-applying (remote now + resubmit later).
+            if self.pending.try_prior_ack(
+                    message.client_id, message.client_sequence_number):
+                local = True
         contents = message.contents
         store = self.datastores[contents["address"]]
         ordinal = self._ordinals.get(message.client_id, -1)

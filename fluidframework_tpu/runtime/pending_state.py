@@ -10,7 +10,7 @@ pending work (merge-tree rewrites positions, map re-emits sets).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, List
+from typing import Any, Dict, List, Optional
 
 
 class DataCorruptionError(Exception):
@@ -26,10 +26,15 @@ class PendingOp:
 class PendingStateManager:
     def __init__(self):
         self._pending: List[PendingOp] = []
+        # Ops still in flight under previous connections' client ids. An op
+        # submitted just before a disconnect may still get sequenced under
+        # the OLD id; recognizing it here acks it instead of double-applying
+        # it (once as "remote", once via reconnect resubmission).
+        self._prior: Dict[str, List[PendingOp]] = {}
 
     @property
     def count(self) -> int:
-        return len(self._pending)
+        return len(self._pending) + sum(len(v) for v in self._prior.values())
 
     def on_submit(self, client_sequence_number: int, contents: Any) -> None:
         self._pending.append(PendingOp(client_sequence_number, contents))
@@ -45,8 +50,32 @@ class PendingStateManager:
                 f"{head.client_sequence_number}, got {client_sequence_number}")
         return head
 
+    def on_connection_change(self, old_client_id: Optional[str]) -> None:
+        """Archive in-flight ops under the id they were submitted with; they
+        either arrive sequenced under that id (try_prior_ack) or get
+        regenerated at the next connect (drain)."""
+        if old_client_id is not None and self._pending:
+            self._prior.setdefault(old_client_id, []).extend(self._pending)
+            self._pending = []
+
+    def try_prior_ack(self, client_id: str, client_sequence_number: int
+                      ) -> Optional[PendingOp]:
+        """If (client_id, csn) is the head of a previous connection's
+        in-flight queue, this sequenced message is one of OURS: pop it so
+        reconnect does not resubmit it, and ack it as local."""
+        queue = self._prior.get(client_id)
+        if queue and queue[0].client_sequence_number == client_sequence_number:
+            op = queue.pop(0)
+            if not queue:
+                del self._prior[client_id]
+            return op
+        return None
+
     def drain(self) -> List[PendingOp]:
         """Take all in-flight ops (reconnect: they are re-generated, not
         replayed verbatim)."""
-        out, self._pending = self._pending, []
+        out = self._pending
+        for queue in self._prior.values():
+            out.extend(queue)
+        self._pending, self._prior = [], {}
         return out

@@ -34,6 +34,7 @@ class ScribeLambda(IPartitionLambda):
         self.send_system = send_system
         self.checkpoints = checkpoints
         self.handlers: Dict[str, ProtocolOpHandler] = {}
+        self.log_offsets: Dict[str, int] = {}
         if checkpoints is not None:
             # Crash restart resumes each document's protocol replica from
             # its checkpoint (duplicate sequenced ops replay as no-ops).
@@ -42,10 +43,13 @@ class ScribeLambda(IPartitionLambda):
 
     def handler(self, message: QueuedMessage) -> None:
         doc_id, sequenced = message.value
+        if message.offset <= self.log_offsets.get(doc_id, -1):
+            return  # replayed message already handled (mirrors deli's guard)
         handler = self.handlers.setdefault(doc_id, ProtocolOpHandler())
         handler.process_message(sequenced)
         if sequenced.type == MessageType.SUMMARIZE:
             self._handle_summarize(doc_id, sequenced)
+        self.log_offsets[doc_id] = message.offset
         self.context.checkpoint(message.offset)
         if self.checkpoints is not None:
             snap = handler.snapshot()
@@ -88,3 +92,4 @@ class ScribeLambda(IPartitionLambda):
             sequence_number=dump["sequenceNumber"],
             minimum_sequence_number=dump["minimumSequenceNumber"],
             quorum_snapshot=dump["quorum"]))
+        self.log_offsets[doc_id] = dump.get("logOffset", -1)

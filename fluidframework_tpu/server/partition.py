@@ -88,6 +88,12 @@ class PartitionManager:
     def pump_all(self) -> int:
         return sum(p.pump() for p in self.pumps.values())
 
+    def restart(self) -> None:
+        """Crash-restart every partition's lambda (fresh instances rebuilt
+        from their checkpoint stores; consumer offsets are preserved)."""
+        for pump in self.pumps.values():
+            pump.restart()
+
     def lambdas(self) -> List[IPartitionLambda]:
         return [p.lambda_ for p in self.pumps.values()]
 

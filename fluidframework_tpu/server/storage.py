@@ -93,9 +93,16 @@ class GitStore:
     # -- summary upload/download ------------------------------------------
     def write_summary(self, tree: SummaryTree, ref: str = "main",
                       message: str = "summary",
-                      base_commit: Optional[str] = None) -> str:
+                      base_commit: Optional[str] = None,
+                      advance_ref: bool = False) -> str:
         """Upload a summary tree (resolving handles against the ref's
-        current commit) and advance the ref. Returns the new commit sha."""
+        current commit). Returns the new commit sha.
+
+        The ref only advances when advance_ref=True (the initial attach
+        summary, or scribe acking a client summary): a client upload is a
+        *proposal* — it must not become the load target until the sequenced
+        summarize op is validated and acked (reference: scribe writes the
+        ref, clients only upload; scribe/lambda.ts:162-192)."""
         parent = base_commit if base_commit is not None else self.get_ref(ref)
         base_tree = None
         if parent:
@@ -104,7 +111,8 @@ class GitStore:
         tree_sha = self._write_tree(tree, base_tree)
         commit_sha = self.put_commit(tree_sha, [parent] if parent else [],
                                      message)
-        self.set_ref(ref, commit_sha)
+        if advance_ref:
+            self.set_ref(ref, commit_sha)
         return commit_sha
 
     def _write_tree(self, node: SummaryObject, base_tree: Optional[str]) -> str:
@@ -154,6 +162,8 @@ class GitStore:
         if sha is None:
             return None
         commit = self.get(sha)
+        if not isinstance(commit, GitCommit):
+            return None  # unknown/garbage version
         return self._read_tree(commit.tree_sha)
 
     def _read_tree(self, tree_sha: str) -> SummaryTree:
@@ -201,6 +211,9 @@ class Historian:
             return self._stores[key]
 
     def get_cached(self, sha: str, tenant_id: str, document_id: str):
+        """Object lookup through the cache. Safe to share across documents:
+        objects are content-addressed, so a sha uniquely names its bytes;
+        only refs (mutable) must never be cached."""
         if sha in self._cache:
             self.cache_hits += 1
             return self._cache[sha]
@@ -210,3 +223,34 @@ class Historian:
             with self._lock:
                 self._cache[sha] = obj
         return obj
+
+    def read_summary(self, tenant_id: str, document_id: str,
+                     commit_sha: Optional[str] = None,
+                     ref: str = "main") -> Optional[SummaryTree]:
+        """The drivers' summary download path: identical semantics to
+        GitStore.read_summary but every object fetch rides the cache, so a
+        summary shared by N loading clients hits storage once."""
+        store = self.store(tenant_id, document_id)
+        sha = commit_sha or store.get_ref(ref)
+        if sha is None:
+            return None
+        commit = self.get_cached(sha, tenant_id, document_id)
+        if not isinstance(commit, GitCommit):
+            return None
+        return self._read_tree_cached(commit.tree_sha, tenant_id, document_id)
+
+    def _read_tree_cached(self, tree_sha: str, tenant_id: str,
+                          document_id: str) -> SummaryTree:
+        tree = self.get_cached(tree_sha, tenant_id, document_id)
+        out = SummaryTree()
+        for name, (kind, sha) in tree.entries.items():
+            if kind == "blob":
+                blob = self.get_cached(sha, tenant_id, document_id)
+                try:
+                    out.entries[name] = SummaryBlob(blob.content.decode())
+                except UnicodeDecodeError:
+                    out.entries[name] = SummaryBlob(blob.content)
+            else:
+                out.entries[name] = self._read_tree_cached(
+                    sha, tenant_id, document_id)
+        return out

@@ -53,7 +53,9 @@ class Ticketed(NamedTuple):
 
     seq: jnp.ndarray      # assigned sequence number (0 for nacked/noop)
     min_seq: jnp.ndarray  # msn stamped on the op
-    nacked: jnp.ndarray   # bool: refSeq below window or duplicate clientSeq
+    nacked: jnp.ndarray   # bool: refSeq below window or client not joined
+    # (duplicate clientSeqs are dropped silently — seq stays 0, nacked stays
+    # False — matching the host deli's idempotent-replay behavior)
 
 
 def make_ticket_state(clients_capacity: int, batch: int | None = None

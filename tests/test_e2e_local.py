@@ -88,11 +88,12 @@ class TestSummarizeFlow:
         loader, c1, ds1 = make_doc(server)
         c1.attach()
         results = []
-        c1._summary_waiters.append(
-            lambda handle, ack, contents: results.append(ack))
         from fluidframework_tpu.protocol.messages import MessageType
-        c1.delta_manager.submit(MessageType.SUMMARIZE,
-                                {"handle": "deadbeef"})
+        c1.delta_manager.submit(
+            MessageType.SUMMARIZE, {"handle": "deadbeef"},
+            before_send=lambda csn: c1._summary_waiters.append(
+                {"csn": csn, "summary_seq": None,
+                 "fn": lambda handle, ack, contents: results.append(ack)}))
         server.pump()
         assert results == [False]
 
