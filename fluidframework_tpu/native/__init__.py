@@ -1,0 +1,11 @@
+"""Native (C++) runtime components with ctypes bindings.
+
+The reference's native capability enters through librdkafka/libgit2
+(SURVEY.md §2 "Implementation language"); here the broker engine itself is
+in-tree C++ (src/oplog.cpp) with the Python engines as always-available
+fallbacks. Build: python -m fluidframework_tpu.native.build
+"""
+
+from .build import NativeBuildError, ensure_built, sources
+
+__all__ = ["NativeBuildError", "ensure_built", "sources"]

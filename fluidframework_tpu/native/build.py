@@ -1,0 +1,76 @@
+"""Builds the native (C++) components into shared libraries.
+
+Usage: python -m fluidframework_tpu.native.build [--force]
+
+Each src/<name>.cpp compiles to lib/<name>.so with g++ (the toolchain baked
+into the image; no external deps). Loaders in this package call
+ensure_built() lazily, so an explicit build run is optional — it just moves
+the compile cost out of first use.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+from typing import List, Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+SRC_DIR = os.path.join(_HERE, "src")
+LIB_DIR = os.path.join(_HERE, "lib")
+
+_CXX = os.environ.get("CXX", "g++")
+_FLAGS = ["-O2", "-std=c++17", "-shared", "-fPIC", "-pthread"]
+
+_build_lock = threading.Lock()
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def sources() -> List[str]:
+    if not os.path.isdir(SRC_DIR):
+        return []
+    return sorted(f[:-4] for f in os.listdir(SRC_DIR) if f.endswith(".cpp"))
+
+
+def lib_path(name: str) -> str:
+    return os.path.join(LIB_DIR, f"{name}.so")
+
+
+def ensure_built(name: str, force: bool = False) -> str:
+    """Compile src/<name>.cpp if its .so is missing or stale; returns the
+    .so path. Raises NativeBuildError when the toolchain fails."""
+    src = os.path.join(SRC_DIR, f"{name}.cpp")
+    out = lib_path(name)
+    if not os.path.exists(src):
+        raise NativeBuildError(f"no native source {src}")
+    with _build_lock:
+        if (not force and os.path.exists(out)
+                and os.path.getmtime(out) >= os.path.getmtime(src)):
+            return out
+        os.makedirs(LIB_DIR, exist_ok=True)
+        cmd = [_CXX, *_FLAGS, src, "-o", out]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise NativeBuildError(
+                f"{' '.join(cmd)} failed:\n{proc.stderr[-4000:]}")
+        return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    force = "--force" in (argv or sys.argv[1:])
+    names = sources()
+    if not names:
+        print("no native sources")
+        return 0
+    for name in names:
+        out = ensure_built(name, force=force)
+        print(f"built {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -38,7 +38,7 @@ from .lambdas import (
     ScriptoriumLambda,
 )
 from .lambdas.scriptorium import delta_key
-from .log import MessageLog
+from .log import MessageLog, make_message_log
 from .partition import LambdaRunner, PartitionManager
 from .storage import Historian
 
@@ -80,10 +80,14 @@ class LocalServer:
     instance is fine; tenant_id still namespaces storage)."""
 
     def __init__(self, tenant_id: str = "local", partitions: int = 1,
-                 auto_pump: bool = True):
+                 auto_pump: bool = True,
+                 native_log: Optional[bool] = False):
+        """native_log: False = pure-Python broker (default, the LocalKafka
+        role); True = the C++ engine (requires the toolchain); None = auto."""
         self.tenant_id = tenant_id
         self.auto_pump = auto_pump
-        self.log = MessageLog(default_partitions=partitions)
+        self.log = make_message_log(default_partitions=partitions,
+                                    native=native_log)
         self.db = DatabaseManager()
         self.historian = Historian()
         self.deltas = self.db.collection("deltas", unique_key=delta_key)

@@ -106,3 +106,20 @@ class MessageLog:
     def subscribe(self, topic: str, partition: int,
                   fn: Callable[[QueuedMessage], None]) -> None:
         self.topic(topic).partitions[partition].listeners.append(fn)
+
+
+def make_message_log(default_partitions: int = 1,
+                     native: Optional[bool] = None):
+    """Broker factory. native=True requires the C++ engine (raises if the
+    toolchain is unavailable); native=None auto-selects it when it builds;
+    native=False pins the pure-Python engine."""
+    if native is False:
+        return MessageLog(default_partitions)
+    try:
+        from ..native.oplog import NativeMessageLog, is_available
+        if native or is_available():
+            return NativeMessageLog(default_partitions)
+    except Exception:
+        if native:
+            raise
+    return MessageLog(default_partitions)
