@@ -31,7 +31,9 @@ class SharedCell(SharedObject):
         self._has_value = True
         self._pending_count += 1
         self.emit("valueChanged", value, True)
-        self.submit_local_message({"type": "setCell", "value": value})
+        from .shared_object import encode_handles
+        self.submit_local_message({"type": "setCell",
+                                   "value": encode_handles(value)})
 
     def delete(self) -> None:
         self.value = None
@@ -57,7 +59,8 @@ class SharedCell(SharedObject):
         if self._pending_count > 0:
             return  # pending local write shadows remote
         if contents["type"] == "setCell":
-            self.value = contents["value"]
+            from .shared_object import decode_handles
+            self.value = decode_handles(contents["value"])
             self._has_value = True
             self.emit("valueChanged", self.value, False)
         else:

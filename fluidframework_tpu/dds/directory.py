@@ -208,7 +208,9 @@ class SharedDirectory(SharedObject):
             "header", json.dumps(self.root.to_dict(), sort_keys=True))
 
     def load_core(self, tree: SummaryTree) -> None:
-        self.root.load_dict(json.loads(tree.entries["header"].content))
+        from .shared_object import decode_handles
+        self.root.load_dict(
+            decode_handles(json.loads(tree.entries["header"].content)))
 
     def get_gc_data(self) -> List[str]:
         routes: List[str] = []

@@ -17,7 +17,12 @@ import json
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from ..protocol.summary import SummaryTree
-from .shared_object import SharedObject, collect_handles
+from .shared_object import (
+    SharedObject,
+    collect_handles,
+    decode_handles,
+    encode_handles,
+)
 
 
 class MapKernel:
@@ -36,7 +41,8 @@ class MapKernel:
         self.data[key] = value
         pid = self._track(key)
         self.emit("valueChanged", key, True)
-        return {"type": "set", "key": key, "value": value, "pid": pid}
+        return {"type": "set", "key": key, "value": encode_handles(value),
+                "pid": pid}
 
     def delete(self, key: str) -> Optional[dict]:
         existed = key in self.data
@@ -85,7 +91,7 @@ class MapKernel:
         if key in self.pending_keys or self.pending_clear_count > 0:
             return  # shadowed by pending local write / pending local clear
         if t == "set":
-            self.data[key] = op["value"]
+            self.data[key] = decode_handles(op["value"])
             self.emit("valueChanged", key, False)
         elif t == "delete":
             if key in self.data:
@@ -101,7 +107,8 @@ class MapKernel:
             for pid in pids:
                 if key in self.data:
                     ops.append({"type": "set", "key": key,
-                                "value": self.data[key], "pid": pid})
+                                "value": encode_handles(self.data[key]),
+                                "pid": pid})
                 else:
                     ops.append({"type": "delete", "key": key, "pid": pid})
         return ops
@@ -111,7 +118,8 @@ class MapKernel:
         return json.dumps(self.data, sort_keys=True, default=_encode_value)
 
     def load_blob(self, blob: str) -> None:
-        self.data = json.loads(blob)
+        from .shared_object import decode_handles
+        self.data = decode_handles(json.loads(blob))
 
 
 def _encode_value(value: Any):

@@ -131,9 +131,13 @@ def _attributes_blob(type_name: str) -> str:
 
 
 def collect_handles(value: Any, out: List[str]) -> None:
-    """Recursively gather handle routes from a JSON-ish value (the
-    SummarySerializer role: handle-tracking serialization)."""
-    if FluidHandle.is_handle(value):
+    """Recursively gather handle routes from a value (the SummarySerializer
+    role: handle-tracking serialization). Matches both live FluidHandle
+    objects (as stored by local set()) and their serialized dict form (as
+    loaded from a summary)."""
+    if isinstance(value, FluidHandle):
+        out.append(value.absolute_path)
+    elif FluidHandle.is_handle(value):
         out.append(value["url"])
     elif isinstance(value, dict):
         for v in value.values():
@@ -141,3 +145,28 @@ def collect_handles(value: Any, out: List[str]) -> None:
     elif isinstance(value, (list, tuple)):
         for v in value:
             collect_handles(v, out)
+
+
+def encode_handles(value: Any) -> Any:
+    """Serialize live FluidHandle objects into their wire dict form. Op
+    contents must be plain data: they cross process boundaries (pickled by
+    the native broker, deep-copied by copier's raw-op persistence)."""
+    if isinstance(value, FluidHandle):
+        return value.encode()
+    if isinstance(value, dict):
+        return {k: encode_handles(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [encode_handles(v) for v in value]
+    return value
+
+
+def decode_handles(value: Any) -> Any:
+    """Rehydrate serialized handle dicts into FluidHandle objects after a
+    summary load (inverse of the encode in each DDS's to_blob)."""
+    if FluidHandle.is_handle(value):
+        return FluidHandle(value["url"])
+    if isinstance(value, dict):
+        return {k: decode_handles(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [decode_handles(v) for v in value]
+    return value

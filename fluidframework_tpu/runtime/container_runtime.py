@@ -25,8 +25,10 @@ from typing import Any, Callable, Dict, List, Optional
 from ..core.events import TypedEventEmitter
 from ..protocol.messages import MessageType, SequencedDocumentMessage
 from ..protocol.summary import SummaryTree
+from .blob_manager import BlobManager
 from .datastore_runtime import ChannelRegistry, DataStoreRuntime
 from .pending_state import PendingStateManager
+from .summarizer import GCResult, run_garbage_collection
 
 
 class ContainerRuntime(TypedEventEmitter):
@@ -47,6 +49,10 @@ class ContainerRuntime(TypedEventEmitter):
         self.sequence_number = 0
         self.minimum_sequence_number = 0
         self._batch: Optional[List] = None
+        self.blob_manager = BlobManager()
+        # Datastores created as GC roots (aliased/default stores); non-root
+        # stores stay alive only while a handle route reaches them.
+        self._gc_roots: List[str] = []
 
     # -- wiring ------------------------------------------------------------
     def attach(self, submit_fn: Callable[[str, Any], int]) -> None:
@@ -79,11 +85,17 @@ class ContainerRuntime(TypedEventEmitter):
         self.emit("connected" if connected else "disconnected")
 
     # -- datastores --------------------------------------------------------
-    def create_datastore(self, store_id: str) -> DataStoreRuntime:
+    def create_datastore(self, store_id: str,
+                         root: bool = True) -> DataStoreRuntime:
+        """root=True pins the store as a GC root (the reference's
+        root/aliased data stores); root=False stores survive only while
+        some channel value holds a handle to them."""
         if store_id in self.datastores:
             raise ValueError(f"duplicate datastore id {store_id!r}")
         store = DataStoreRuntime(store_id, self, self.registry)
         self.datastores[store_id] = store
+        if root:
+            self._gc_roots.append(f"/{store_id}")
         return store
 
     def get_datastore(self, store_id: str) -> DataStoreRuntime:
@@ -190,13 +202,20 @@ class ContainerRuntime(TypedEventEmitter):
 
     # -- summary / load ----------------------------------------------------
     def summarize(self) -> SummaryTree:
+        gc = self.run_gc()
         tree = SummaryTree()
         stores = tree.add_tree(".dataStores")
         for store_id, store in sorted(self.datastores.items()):
             stores.entries[store_id] = store.summarize()
+        if len(self.blob_manager):
+            tree.entries[".blobs"] = self.blob_manager.summarize()
         tree.add_blob(".metadata", json.dumps({
             "sequenceNumber": self.sequence_number,
             "ordinals": self._ordinals,
+            "gcRoots": self._gc_roots,
+            # Mark pass result rides the summary (reference: GC runs inside
+            # summarize and stamps unreferenced nodes, garbageCollector.ts).
+            "unreferenced": gc.unreferenced,
         }))
         return tree
 
@@ -205,14 +224,22 @@ class ContainerRuntime(TypedEventEmitter):
         self.sequence_number = meta.get("sequenceNumber", 0)
         self._ordinals = {k: int(v) for k, v in
                           meta.get("ordinals", {}).items()}
+        self._gc_roots = list(meta.get("gcRoots", []))
         for store_id, sub in tree.entries[".dataStores"].entries.items():
             store = DataStoreRuntime(store_id, self, self.registry)
             self.datastores[store_id] = store
             store.load(sub)
+        if ".blobs" in tree.entries:
+            self.blob_manager.load(tree.entries[".blobs"])
 
     # -- GC ----------------------------------------------------------------
     def get_gc_data(self) -> Dict[str, List[str]]:
         out: Dict[str, List[str]] = {}
         for store in self.datastores.values():
             out.update(store.get_gc_data())
+        for blob_id in self.blob_manager.node_ids():
+            out[blob_id] = []  # blobs are leaves
         return out
+
+    def run_gc(self) -> GCResult:
+        return run_garbage_collection(self.get_gc_data(), self._gc_roots)

@@ -1,0 +1,227 @@
+"""Summarization subsystem: GC mark pass, blob manager, summary collection,
+heuristics, and summarizer election over the live local stack."""
+
+from fluidframework_tpu.dds.counter import SharedCounter
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.runtime.summarizer import (
+    RunningSummarizer,
+    SummaryCollection,
+    SummaryConfig,
+    SummaryManager,
+    run_garbage_collection,
+)
+from fluidframework_tpu.server.local_server import LocalServer
+
+
+def make_doc(server, doc_id="doc"):
+    loader = Loader(LocalDocumentServiceFactory(server))
+    container = loader.create_detached(doc_id)
+    ds = container.runtime.create_datastore("default")
+    return loader, container, ds
+
+
+class TestGarbageCollection:
+    def test_mark_pass(self):
+        nodes = {
+            "/a/root": ["/b"],
+            "/b/data": [],
+            "/c/orphan": [],
+        }
+        result = run_garbage_collection(nodes, roots=["/a"])
+        assert result.referenced == ["/a/root", "/b/data"]
+        assert result.unreferenced == ["/c/orphan"]
+
+    def test_transitive_and_cyclic(self):
+        nodes = {
+            "/a/x": ["/b"],
+            "/b/y": ["/c"],
+            "/c/z": ["/a"],  # cycle back
+            "/d/w": ["/d"],  # self-cycle, unreachable
+        }
+        result = run_garbage_collection(nodes, roots=["/a"])
+        assert result.unreferenced == ["/d/w"]
+
+    def test_runtime_gc_via_handles(self):
+        """A non-root datastore is unreferenced until a handle to it is
+        stored in a root store's map."""
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        root_map = ds1.create_channel("root", SharedMap.TYPE)
+        ds2 = c1.runtime.create_datastore("loose", root=False)
+        loose = ds2.create_channel("data", SharedMap.TYPE)
+        c1.attach()
+
+        gc = c1.runtime.run_gc()
+        assert "/loose/data" in gc.unreferenced
+
+        root_map.set("ref", loose.handle)
+        gc = c1.runtime.run_gc()
+        assert "/loose/data" in gc.referenced
+
+    def test_unreferenced_recorded_in_summary(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        ds1.create_channel("root", SharedMap.TYPE)
+        c1.runtime.create_datastore("dead", root=False) \
+            .create_channel("d", SharedMap.TYPE)
+        c1.attach()
+        import json
+        tree = c1.runtime.summarize()
+        meta = json.loads(tree.entries[".metadata"].content)
+        assert "/dead/d" in meta["unreferenced"]
+
+
+class TestBlobManager:
+    def test_create_and_roundtrip_through_summary(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        m = ds1.create_channel("root", SharedMap.TYPE)
+        payload = b"\x00\x01binary payload\xff"
+        handle = c1.runtime.blob_manager.create_blob(payload)
+        m.set("attachment", handle)
+        c1.attach()
+        c1.summarize()
+        server.pump()
+
+        c2 = loader.resolve("doc")
+        m2 = c2.runtime.get_datastore("default").get_channel("root")
+        h2 = m2.get("attachment")
+        sha = h2.absolute_path.split("/")[-1]
+        assert c2.runtime.blob_manager.get_blob(sha) == payload
+
+    def test_blobs_participate_in_gc(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        m = ds1.create_channel("root", SharedMap.TYPE)
+        used = c1.runtime.blob_manager.create_blob(b"used")
+        c1.runtime.blob_manager.create_blob(b"orphan")
+        m.set("k", used)
+        c1.attach()
+        gc = c1.runtime.run_gc()
+        assert used.absolute_path in gc.referenced
+        assert len(gc.unreferenced) == 1
+
+
+class TestSummaryCollection:
+    def test_tracks_latest_ack(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        counter = ds1.create_channel("n", SharedCounter.TYPE)
+        c1.attach()
+        sc = SummaryCollection()
+        c1.on("op", sc.process)
+        counter.increment(1)
+        handle = c1.summarize()
+        server.pump()
+        assert sc.last_ack is not None
+        assert sc.last_ack["handle"] == handle
+
+    def test_waiter_fires_once(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        counter = ds1.create_channel("n", SharedCounter.TYPE)
+        c1.attach()
+        sc = SummaryCollection()
+        c1.on("op", sc.process)
+        fired = []
+        sc.wait_summary_ack(lambda ack, c: fired.append(ack))
+        counter.increment(1)
+        c1.summarize()
+        server.pump()
+        c1.summarize()
+        server.pump()
+        assert fired == [True]
+
+
+class TestHeuristics:
+    def test_max_ops_triggers(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        counter = ds1.create_channel("n", SharedCounter.TYPE)
+        c1.attach()
+        clock = [0.0]
+        rs = RunningSummarizer(c1, SummaryConfig(max_ops=5),
+                               clock=lambda: clock[0])
+        c1.on("op", rs.on_op)
+        for _ in range(5):
+            counter.increment(1)
+        server.pump()
+        assert rs.summaries_run == 1
+        assert rs.ops_since_ack < 5
+
+    def test_idle_trigger_via_tick(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        counter = ds1.create_channel("n", SharedCounter.TYPE)
+        c1.attach()
+        clock = [0.0]
+        rs = RunningSummarizer(c1, SummaryConfig(idle_time=5.0, max_ops=10**6),
+                               clock=lambda: clock[0])
+        c1.on("op", rs.on_op)
+        counter.increment(1)
+        server.pump()
+        rs.tick()
+        assert rs.summaries_run == 0  # not idle long enough
+        clock[0] = 6.0
+        rs.tick()
+        server.pump()
+        assert rs.summaries_run == 1
+
+    def test_no_summary_without_ops(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        ds1.create_channel("n", SharedCounter.TYPE)
+        c1.attach()
+        clock = [100.0]
+        rs = RunningSummarizer(c1, SummaryConfig(), clock=lambda: clock[0])
+        clock[0] = 1000.0
+        rs.tick()
+        assert rs.summaries_run == 0
+
+
+class TestElection:
+    def test_oldest_interactive_client_elected(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        counter = ds1.create_channel("n", SharedCounter.TYPE)
+        c1.attach()
+        c2 = loader.resolve("doc")
+
+        sm1 = SummaryManager(c1, SummaryConfig(max_ops=3))
+        sm2 = SummaryManager(c2, SummaryConfig(max_ops=3))
+        counter.increment(1)  # flush events through both managers
+        assert sm1.elected_self and not sm2.elected_self
+        assert sm1.running is not None and sm2.running is None
+
+    def test_election_flips_on_leave(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        counter = ds1.create_channel("n", SharedCounter.TYPE)
+        c1.attach()
+        c2 = loader.resolve("doc")
+        n2 = c2.runtime.get_datastore("default").get_channel("n")
+        sm2 = SummaryManager(c2, SummaryConfig(max_ops=3))
+        assert not sm2.elected_self
+        c1.close()
+        n2.increment(1)
+        assert sm2.elected_self and sm2.running is not None
+
+    def test_elected_summarizer_produces_acked_summaries(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        counter = ds1.create_channel("n", SharedCounter.TYPE)
+        c1.attach()
+        sm = SummaryManager(c1, SummaryConfig(max_ops=4))
+        sc = SummaryCollection()
+        c1.on("op", sc.process)
+        for _ in range(4):
+            counter.increment(1)
+        server.pump()
+        assert sm.running is not None and sm.running.summaries_run == 1
+        assert sc.last_ack is not None
+
+        # New client loads from the acked summary.
+        c2 = loader.resolve("doc")
+        assert c2.runtime.get_datastore("default").get_channel("n").value == 4
