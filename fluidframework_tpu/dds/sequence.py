@@ -9,13 +9,172 @@ rewrite (client.ts:863).
 
 from __future__ import annotations
 
+import itertools
 import json
-from typing import Any, List, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
+from ..core.events import TypedEventEmitter
 from ..mergetree.client import MergeTreeClient
 from ..mergetree.constants import SNAPSHOT_CHUNK_SIZE
+from ..mergetree.oracle import REF_SLIDE_ON_REMOVE, LocalReference
 from ..protocol.summary import SummaryTree
 from .shared_object import SharedObject
+
+_interval_uid = itertools.count(1)
+
+
+class SequenceInterval:
+    """An [start, end] position pair anchored by local references
+    (reference sequence/src/intervalCollection.ts SequenceInterval)."""
+
+    def __init__(self, interval_id: str, start_ref: LocalReference,
+                 end_ref: LocalReference,
+                 properties: Optional[dict] = None):
+        self.interval_id = interval_id
+        self.start_ref = start_ref
+        self.end_ref = end_ref
+        self.properties = dict(properties or {})
+
+
+class IntervalCollection(TypedEventEmitter):
+    """A labeled set of intervals over one sequence, kept consistent via
+    interval ops on the sequence's op stream (reference
+    intervalCollection.ts:264-274; events addInterval/deleteInterval/
+    changeInterval). Queries resolve through the live local references, so
+    interval positions track concurrent edits."""
+
+    def __init__(self, label: str, sequence: "SharedSegmentSequence"):
+        super().__init__()
+        self.label = label
+        self.sequence = sequence
+        self.intervals: Dict[str, SequenceInterval] = {}
+
+    # -- local mutations ---------------------------------------------------
+    def add(self, start: int, end: int,
+            properties: Optional[dict] = None) -> SequenceInterval:
+        iid = f"iv-{self.sequence.local_client_id}-{next(_interval_uid)}"
+        interval = self._attach(iid, start, end, properties)
+        self.sequence._submit_interval_op(self.label, {
+            "opName": "add", "intervalId": iid, "start": start, "end": end,
+            "properties": dict(properties or {})})
+        self.emit("addInterval", interval, True)
+        return interval
+
+    def remove_interval_by_id(self, interval_id: str) -> None:
+        interval = self.intervals.pop(interval_id, None)
+        if interval is None:
+            return
+        self._detach(interval)
+        self.sequence._submit_interval_op(self.label, {
+            "opName": "delete", "intervalId": interval_id})
+        self.emit("deleteInterval", interval, True)
+
+    def change(self, interval_id: str, start: int, end: int) -> None:
+        interval = self.intervals.get(interval_id)
+        if interval is None:
+            return
+        self._reanchor(interval, start, end)
+        self.sequence._submit_interval_op(self.label, {
+            "opName": "change", "intervalId": interval_id,
+            "start": start, "end": end})
+        self.emit("changeInterval", interval, True)
+
+    def change_properties(self, interval_id: str, props: dict) -> None:
+        interval = self.intervals.get(interval_id)
+        if interval is None:
+            return
+        interval.properties.update(props)
+        self.sequence._submit_interval_op(self.label, {
+            "opName": "changeProperties", "intervalId": interval_id,
+            "properties": props})
+        self.emit("changeInterval", interval, True)
+
+    # -- queries -----------------------------------------------------------
+    def get_interval_by_id(self, interval_id: str
+                           ) -> Optional[SequenceInterval]:
+        return self.intervals.get(interval_id)
+
+    def endpoints(self, interval: SequenceInterval) -> tuple:
+        tree = self.sequence.client.tree
+        return (tree.local_reference_position(interval.start_ref),
+                tree.local_reference_position(interval.end_ref))
+
+    def find_overlapping_intervals(self, start: int, end: int
+                                   ) -> List[SequenceInterval]:
+        out = []
+        for interval in self.intervals.values():
+            s, e = self.endpoints(interval)
+            if not (e < start or s > end):
+                out.append(interval)
+        out.sort(key=lambda iv: self.endpoints(iv))
+        return out
+
+    def __iter__(self) -> Iterator[SequenceInterval]:
+        return iter(sorted(self.intervals.values(),
+                           key=lambda iv: self.endpoints(iv)))
+
+    def __len__(self) -> int:
+        return len(self.intervals)
+
+    # -- op application ----------------------------------------------------
+    def _process(self, op: dict, local: bool, ref_seq: int,
+                 client_ordinal: int) -> None:
+        if local:
+            return  # state applied at submit; the op record acks elsewhere
+        name = op["opName"]
+        iid = op["intervalId"]
+        if name == "add":
+            interval = self._attach(iid, op["start"], op["end"],
+                                    op.get("properties"),
+                                    ref_seq=ref_seq, client=client_ordinal)
+            self.emit("addInterval", interval, False)
+        elif name == "delete":
+            interval = self.intervals.pop(iid, None)
+            if interval is not None:
+                self._detach(interval)
+                self.emit("deleteInterval", interval, False)
+        elif name == "change":
+            interval = self.intervals.get(iid)
+            if interval is not None:
+                self._reanchor(interval, op["start"], op["end"],
+                               ref_seq=ref_seq, client=client_ordinal)
+                self.emit("changeInterval", interval, False)
+        elif name == "changeProperties":
+            interval = self.intervals.get(iid)
+            if interval is not None:
+                interval.properties.update(op["properties"])
+                self.emit("changeInterval", interval, False)
+
+    # -- internals ---------------------------------------------------------
+    def _attach(self, iid: str, start: int, end: int,
+                properties: Optional[dict],
+                ref_seq: Optional[int] = None,
+                client: Optional[int] = None) -> SequenceInterval:
+        tree = self.sequence.client.tree
+        interval = SequenceInterval(
+            iid,
+            tree.create_local_reference(start, REF_SLIDE_ON_REMOVE,
+                                        ref_seq=ref_seq, client=client),
+            tree.create_local_reference(end, REF_SLIDE_ON_REMOVE,
+                                        ref_seq=ref_seq, client=client),
+            properties)
+        self.intervals[iid] = interval
+        return interval
+
+    def _detach(self, interval: SequenceInterval) -> None:
+        tree = self.sequence.client.tree
+        tree.remove_local_reference(interval.start_ref)
+        tree.remove_local_reference(interval.end_ref)
+
+    def _reanchor(self, interval: SequenceInterval, start: int, end: int,
+                  ref_seq: Optional[int] = None,
+                  client: Optional[int] = None) -> None:
+        tree = self.sequence.client.tree
+        self._detach(interval)
+        interval.start_ref = tree.create_local_reference(
+            start, REF_SLIDE_ON_REMOVE, ref_seq=ref_seq, client=client)
+        interval.end_ref = tree.create_local_reference(
+            end, REF_SLIDE_ON_REMOVE, ref_seq=ref_seq, client=client)
 
 
 class SharedSegmentSequence(SharedObject):
@@ -26,6 +185,11 @@ class SharedSegmentSequence(SharedObject):
         self.client = MergeTreeClient(client_id=self.local_client_id)
         self.client.on("delta", lambda args, local:
                        self.emit("sequenceDelta", args, local))
+        self._interval_collections: Dict[str, IntervalCollection] = {}
+        # In-flight interval ops by uid (resubmitted verbatim on reconnect;
+        # interval ops carry ids, not positions needing rewrite).
+        self._pending_interval_ops: Dict[int, dict] = {}
+        self._interval_op_uid = itertools.count(1)
 
     def bind_to_runtime(self, runtime) -> None:
         super().bind_to_runtime(runtime)
@@ -46,14 +210,52 @@ class SharedSegmentSequence(SharedObject):
             self.client.commit_detached()
         super().connect()
 
+    # -- local references (client.ts createLocalReferencePosition) --------
+    def create_local_reference_position(
+            self, pos: int, ref_type: int = REF_SLIDE_ON_REMOVE,
+            properties: Optional[dict] = None) -> LocalReference:
+        return self.client.tree.create_local_reference(pos, ref_type,
+                                                       properties)
+
+    def local_reference_to_position(self, ref: LocalReference) -> int:
+        return self.client.tree.local_reference_position(ref)
+
+    def remove_local_reference_position(self, ref: LocalReference) -> None:
+        self.client.tree.remove_local_reference(ref)
+
+    # -- interval collections ---------------------------------------------
+    def get_interval_collection(self, label: str) -> IntervalCollection:
+        if label not in self._interval_collections:
+            self._interval_collections[label] = IntervalCollection(label,
+                                                                   self)
+        return self._interval_collections[label]
+
+    def _submit_interval_op(self, label: str, op: dict) -> None:
+        uid = next(self._interval_op_uid)
+        contents = {"type": "intervalCollection", "label": label,
+                    "uid": uid, "op": op}
+        self._pending_interval_ops[uid] = contents
+        self.submit_local_message(contents)
+
     # -- channel plumbing --------------------------------------------------
     def process_core(self, contents, local, seq, ref_seq, client_ordinal,
                      min_seq) -> None:
+        if isinstance(contents, dict) and \
+                contents.get("type") == "intervalCollection":
+            if local:
+                self._pending_interval_ops.pop(contents.get("uid"), None)
+            self.get_interval_collection(contents["label"])._process(
+                contents["op"], local, ref_seq, client_ordinal)
+            self.client.tree.update_seq(seq)
+            if min_seq is not None and min_seq > self.client.tree.min_seq:
+                self.client.tree.set_min_seq(min_seq)
+            return
         self.client.apply_msg(contents, seq, ref_seq, client_ordinal,
                               min_seq=min_seq)
 
     def resubmit_pending(self) -> List[Any]:
-        return self.client.regenerate_pending_ops()
+        return (self.client.regenerate_pending_ops()
+                + list(self._pending_interval_ops.values()))
 
     def summarize_core(self) -> SummaryTree:
         """Chunked snapshot: header with collab window + body chunks of
@@ -77,6 +279,18 @@ class SharedSegmentSequence(SharedObject):
         }))
         for i, chunk in enumerate(chunks):
             tree.add_blob(f"body_{i}", json.dumps(chunk))
+        if any(self._interval_collections.values()):
+            # Interval positions serialize resolved at the snapshot
+            # perspective (reference: intervalCollection valuetype snapshot).
+            payload = {}
+            for label, coll in self._interval_collections.items():
+                payload[label] = [
+                    {"intervalId": iv.interval_id,
+                     "start": coll.endpoints(iv)[0],
+                     "end": coll.endpoints(iv)[1],
+                     "properties": iv.properties}
+                    for iv in coll]
+            tree.add_blob("intervals", json.dumps(payload))
         return tree
 
     def load_core(self, tree: SummaryTree) -> None:
@@ -90,6 +304,13 @@ class SharedSegmentSequence(SharedObject):
             client_id=self.local_client_id)
         self.client.on("delta", lambda args, local:
                        self.emit("sequenceDelta", args, local))
+        if "intervals" in tree.entries:
+            payload = json.loads(tree.entries["intervals"].content)
+            for label, entries in payload.items():
+                coll = self.get_interval_collection(label)
+                for entry in entries:
+                    coll._attach(entry["intervalId"], entry["start"],
+                                 entry["end"], entry.get("properties"))
 
 
 class SharedString(SharedSegmentSequence):

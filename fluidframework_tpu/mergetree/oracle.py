@@ -57,6 +57,9 @@ class Segment:
     props: Optional[Dict[str, Any]] = None
     pending_props: Optional[Dict[str, int]] = None  # key -> pending local count
     uid: int = 0
+    # Local references anchored on this segment (reference
+    # merge-tree/src/localReference.ts; populated lazily).
+    local_refs: Optional[List["LocalReference"]] = None
 
     @property
     def length(self) -> int:
@@ -77,6 +80,24 @@ class Segment:
             pending_props=dict(self.pending_props) if self.pending_props else None,
             uid=uid,
         )
+
+
+# Reference-type flags (reference merge-tree/src/ops.ts ReferenceType).
+REF_SIMPLE = 0
+REF_SLIDE_ON_REMOVE = 1
+REF_STAY_ON_REMOVE = 2
+
+
+@dataclass
+class LocalReference:
+    """A position anchored to (segment, offset) that tracks edits
+    (reference localReference.ts:362 LoC). Detached refs (segment=None)
+    pin to end-of-document."""
+
+    segment: Optional[Segment]
+    offset: int = 0
+    ref_type: int = REF_SLIDE_ON_REMOVE
+    properties: Optional[Dict[str, Any]] = None
 
 
 class MergeTreeOracle:
@@ -192,6 +213,17 @@ class MergeTreeOracle:
         for _, group, _ in self.pending_groups:
             if seg in group:
                 group.insert(group.index(seg) + 1, right)
+        # Local refs at/past the split point move to the right half.
+        if seg.local_refs:
+            stay, move = [], []
+            for ref in seg.local_refs:
+                (move if ref.offset >= offset else stay).append(ref)
+            seg.local_refs = stay or None
+            for ref in move:
+                ref.segment = right
+                ref.offset -= offset
+            if move:
+                right.local_refs = move
 
     def _ensure_boundary(self, pos: int, ref_seq: int, client: int) -> None:
         idx, off = self.get_containing_segment(pos, ref_seq, client)
@@ -416,15 +448,45 @@ class MergeTreeOracle:
         fully-acked compatible text segments (reference mergeTree.ts:1422,
         scour/pack :1289-:1468)."""
         out: List[Segment] = []
+        pending_slide: List[LocalReference] = []
         for seg in self.segments:
             if seg.rem_seq is not None and seg.rem_seq != UNASSIGNED_SEQ \
                     and seg.rem_seq <= self.min_seq:
-                continue  # tombstone out of the collab window: free it
+                # Tombstone out of the collab window: free it. SlideOnRemove
+                # refs move to the next surviving segment's start; simple
+                # refs detach to end-of-doc (localReference.ts slide).
+                for ref in seg.local_refs or []:
+                    if ref.ref_type == REF_SLIDE_ON_REMOVE:
+                        pending_slide.append(ref)
+                    else:
+                        ref.segment = None
+                        ref.offset = 0
+                continue
             prev = out[-1] if out else None
             if prev is not None and self._can_append(prev, seg):
+                join = len(prev.text)
+                for ref in pending_slide:  # slid refs land at the join point
+                    ref.segment = prev
+                    ref.offset = join
+                for ref in seg.local_refs or []:
+                    ref.segment = prev
+                    ref.offset += join
+                moved = pending_slide + list(seg.local_refs or [])
+                pending_slide = []
+                if moved:
+                    prev.local_refs = (prev.local_refs or []) + moved
                 prev.text += seg.text
             else:
                 out.append(seg)
+                for ref in pending_slide:
+                    ref.segment = seg
+                    ref.offset = 0
+                if pending_slide:
+                    seg.local_refs = (seg.local_refs or []) + pending_slide
+                    pending_slide = []
+        for ref in pending_slide:  # removed the tail: pin to end-of-doc
+            ref.segment = None
+            ref.offset = 0
         self.segments = out
 
     def _can_append(self, a: Segment, b: Segment) -> bool:
@@ -438,6 +500,57 @@ class MergeTreeOracle:
             and a.pending_props in (None, {}) and b.pending_props in (None, {})
             and a.length + b.length <= self.granularity
         )
+
+    # ------------------------------------------------------------------
+    # local references (reference localReference.ts; client.ts
+    # createLocalReferencePosition / localReferencePositionToPosition)
+    # ------------------------------------------------------------------
+    def create_local_reference(self, pos: int,
+                               ref_type: int = REF_SLIDE_ON_REMOVE,
+                               properties: Optional[Dict[str, Any]] = None,
+                               ref_seq: Optional[int] = None,
+                               client: Optional[int] = None
+                               ) -> LocalReference:
+        """Anchor a reference at `pos` under the given perspective
+        (defaults: current seq, local client)."""
+        ref_seq = self.current_seq if ref_seq is None else ref_seq
+        client = self.local_client if client is None else client
+        idx, off = self.get_containing_segment(pos, ref_seq, client)
+        ref = LocalReference(segment=None, offset=0, ref_type=ref_type,
+                             properties=properties)
+        if idx is not None:
+            seg = self.segments[idx]
+            ref.segment = seg
+            ref.offset = off
+            seg.local_refs = (seg.local_refs or []) + [ref]
+        return ref  # segment=None: end-of-document pin
+
+    def local_reference_position(self, ref: LocalReference,
+                                 ref_seq: Optional[int] = None,
+                                 client: Optional[int] = None) -> int:
+        """Current position of the reference. A ref on a removed-but-
+        unzambonied segment resolves to the tombstone's slot (= position of
+        the next visible content); a detached ref resolves to doc end."""
+        ref_seq = self.current_seq if ref_seq is None else ref_seq
+        client = self.local_client if client is None else client
+        if ref.segment is None:
+            return self.get_length(ref_seq, client)
+        try:
+            idx = self.segments.index(ref.segment)
+        except ValueError:
+            return self.get_length(ref_seq, client)
+        pos = self.get_position(idx, ref_seq, client)
+        if self.visible_length(ref.segment, ref_seq, client) > 0:
+            pos += ref.offset
+        return pos
+
+    def remove_local_reference(self, ref: LocalReference) -> None:
+        if ref.segment is not None and ref.segment.local_refs:
+            try:
+                ref.segment.local_refs.remove(ref)
+            except ValueError:
+                pass
+        ref.segment = None
 
     # ------------------------------------------------------------------
     # snapshot

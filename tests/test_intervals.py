@@ -1,0 +1,182 @@
+"""Local references + interval collections: position tracking through
+edits, slide-on-remove, cross-client convergence, summary round trip."""
+
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.mergetree import MergeTreeOracle
+from fluidframework_tpu.mergetree.oracle import REF_SIMPLE, REF_SLIDE_ON_REMOVE
+from fluidframework_tpu.server.local_server import LocalServer
+
+
+def god_tree():
+    t = MergeTreeOracle(local_client=-2)
+    return t
+
+
+class TestLocalReferences:
+    def test_position_tracks_inserts(self):
+        t = god_tree()
+        t.insert_text(0, "hello world", 0, 1, 1)
+        t.update_seq(1)
+        ref = t.create_local_reference(6)  # at 'w'
+        t.insert_text(0, ">>> ", 1, 1, 2)
+        t.update_seq(2)
+        assert t.local_reference_position(ref) == 10
+        t.insert_text(t.get_length(), "!", 2, 1, 3)
+        t.update_seq(3)
+        assert t.local_reference_position(ref) == 10
+
+    def test_ref_inside_split_segment(self):
+        t = god_tree()
+        t.insert_text(0, "abcdef", 0, 1, 1)
+        t.update_seq(1)
+        ref = t.create_local_reference(4)  # inside "abcdef"
+        t.insert_text(2, "XY", 1, 1, 2)  # splits the segment before the ref
+        t.update_seq(2)
+        assert t.get_text() == "abXYcdef"
+        assert t.local_reference_position(ref) == 6
+
+    def test_tombstone_resolves_to_slot(self):
+        t = god_tree()
+        t.insert_text(0, "abcdef", 0, 1, 1)
+        t.update_seq(1)
+        ref = t.create_local_reference(3)  # at 'd'
+        t.remove_range(2, 5, 1, 1, 2)  # removes "cde" containing the ref
+        t.update_seq(2)
+        assert t.get_text() == "abf"
+        assert t.local_reference_position(ref) == 2  # slot of removed span
+
+    def test_slide_on_remove_after_zamboni(self):
+        t = god_tree()
+        t.insert_text(0, "abcdef", 0, 1, 1)
+        t.update_seq(1)
+        ref = t.create_local_reference(3, REF_SLIDE_ON_REMOVE)
+        t.remove_range(2, 5, 1, 1, 2)
+        t.update_seq(2)
+        t.set_min_seq(2)  # zamboni frees the tombstone
+        assert t.local_reference_position(ref) == 2  # slid to 'f'
+
+    def test_simple_ref_detaches_to_end(self):
+        t = god_tree()
+        t.insert_text(0, "abcdef", 0, 1, 1)
+        t.update_seq(1)
+        ref = t.create_local_reference(3, REF_SIMPLE)
+        t.remove_range(2, 5, 1, 1, 2)
+        t.update_seq(2)
+        t.set_min_seq(2)
+        assert t.local_reference_position(ref) == t.get_length()
+
+    def test_refs_survive_pack_coalesce(self):
+        t = god_tree()
+        t.insert_text(0, "abc", 0, 1, 1)
+        t.insert_text(3, "def", 1, 1, 2)
+        t.update_seq(2)
+        ref = t.create_local_reference(4)  # at 'e' in second segment
+        t.set_min_seq(2)  # zamboni coalesces "abc"+"def"
+        assert len(t.segments) == 1
+        assert t.local_reference_position(ref) == 4
+
+    def test_remove_local_reference(self):
+        t = god_tree()
+        t.insert_text(0, "abc", 0, 1, 1)
+        t.update_seq(1)
+        ref = t.create_local_reference(1)
+        t.remove_local_reference(ref)
+        assert t.local_reference_position(ref) == t.get_length()
+        assert not any(s.local_refs for s in t.segments)
+
+
+def make_string_pair(server=None):
+    server = server or LocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.create_detached("doc")
+    ds1 = c1.runtime.create_datastore("default")
+    s1 = ds1.create_channel("text", SharedString.TYPE)
+    c1.attach()
+    c2 = loader.resolve("doc")
+    s2 = c2.runtime.get_datastore("default").get_channel("text")
+    return server, loader, (c1, s1), (c2, s2)
+
+
+class TestIntervalCollections:
+    def test_add_and_query(self):
+        server, loader, (c1, s1), (c2, s2) = make_string_pair()
+        s1.insert_text(0, "the quick brown fox")
+        coll = s1.get_interval_collection("comments")
+        iv = coll.add(4, 8, {"author": "a"})
+        assert coll.endpoints(iv) == (4, 8)
+        hits = coll.find_overlapping_intervals(5, 6)
+        assert [h.interval_id for h in hits] == [iv.interval_id]
+        assert coll.find_overlapping_intervals(15, 18) == []
+
+    def test_intervals_converge_across_clients(self):
+        server, loader, (c1, s1), (c2, s2) = make_string_pair()
+        s1.insert_text(0, "collaborate")
+        coll1 = s1.get_interval_collection("sel")
+        coll2 = s2.get_interval_collection("sel")
+        iv = coll1.add(2, 5)
+        assert len(coll2) == 1
+        iv2 = coll2.get_interval_by_id(iv.interval_id)
+        assert coll2.endpoints(iv2) == (2, 5)
+
+    def test_interval_tracks_concurrent_edit(self):
+        server, loader, (c1, s1), (c2, s2) = make_string_pair()
+        s1.insert_text(0, "abcdef")
+        coll1 = s1.get_interval_collection("sel")
+        coll2 = s2.get_interval_collection("sel")
+        iv = coll1.add(3, 5)
+        s2.insert_text(0, "XXX")  # shifts everything right by 3
+        assert coll1.endpoints(coll1.get_interval_by_id(iv.interval_id)) \
+            == (6, 8)
+        assert coll2.endpoints(coll2.get_interval_by_id(iv.interval_id)) \
+            == (6, 8)
+
+    def test_delete_and_change(self):
+        server, loader, (c1, s1), (c2, s2) = make_string_pair()
+        s1.insert_text(0, "0123456789")
+        coll1 = s1.get_interval_collection("x")
+        coll2 = s2.get_interval_collection("x")
+        iv = coll1.add(1, 3)
+        coll1.change(iv.interval_id, 5, 7)
+        assert coll2.endpoints(coll2.get_interval_by_id(iv.interval_id)) \
+            == (5, 7)
+        coll2.change_properties(iv.interval_id, {"bold": True})
+        assert coll1.get_interval_by_id(iv.interval_id) \
+                    .properties["bold"] is True
+        coll2.remove_interval_by_id(iv.interval_id)
+        assert len(coll1) == 0 and len(coll2) == 0
+
+    def test_events(self):
+        server, loader, (c1, s1), (c2, s2) = make_string_pair()
+        s1.insert_text(0, "events")
+        seen = []
+        s2.get_interval_collection("e").on(
+            "addInterval", lambda iv, local: seen.append(("add", local)))
+        s1.get_interval_collection("e").add(0, 2)
+        assert seen == [("add", False)]
+
+    def test_summary_roundtrip(self):
+        server, loader, (c1, s1), (c2, s2) = make_string_pair()
+        s1.insert_text(0, "persisted text")
+        iv = s1.get_interval_collection("notes").add(2, 6, {"n": 1})
+        c1.summarize()
+        server.pump()
+        c3 = loader.resolve("doc")
+        s3 = c3.runtime.get_datastore("default").get_channel("text")
+        coll3 = s3.get_interval_collection("notes")
+        assert len(coll3) == 1
+        iv3 = coll3.get_interval_by_id(iv.interval_id)
+        assert coll3.endpoints(iv3) == (2, 6)
+        assert iv3.properties == {"n": 1}
+        # Loaded intervals still track subsequent edits.
+        s1.insert_text(0, "> ")
+        assert coll3.endpoints(iv3) == (4, 8)
+
+    def test_sharedstring_local_reference_api(self):
+        server, loader, (c1, s1), (c2, s2) = make_string_pair()
+        s1.insert_text(0, "anchor here")
+        ref = s1.create_local_reference_position(7)
+        s1.insert_text(0, "___")
+        assert s1.local_reference_to_position(ref) == 10
+        s1.remove_local_reference_position(ref)
