@@ -25,8 +25,22 @@ from .shared_object import (
 )
 
 
+class _Missing:
+    """Sentinel for 'key absent' in valueChanged previous-value payloads
+    (distinguishes delete-on-undo from set-None-on-undo)."""
+
+    def __repr__(self):
+        return "<missing>"
+
+
+MISSING = _Missing()
+
+
 class MapKernel:
-    """Op/state kernel shared by SharedMap and each Directory subdirectory."""
+    """Op/state kernel shared by SharedMap and each Directory subdirectory.
+
+    valueChanged events carry (key, local, previous) where previous is the
+    pre-op value or MISSING — the undo-redo handlers revert from it."""
 
     def __init__(self, emit=None):
         self.data: Dict[str, Any] = {}
@@ -38,18 +52,20 @@ class MapKernel:
 
     # -- local ops (return op contents + record pending) -------------------
     def set(self, key: str, value: Any) -> dict:
+        previous = self.data.get(key, MISSING)
         self.data[key] = value
         pid = self._track(key)
-        self.emit("valueChanged", key, True)
+        self.emit("valueChanged", key, True, previous)
         return {"type": "set", "key": key, "value": encode_handles(value),
                 "pid": pid}
 
     def delete(self, key: str) -> Optional[dict]:
         existed = key in self.data
+        previous = self.data.get(key, MISSING)
         self.data.pop(key, None)
         pid = self._track(key)
         if existed:
-            self.emit("valueChanged", key, True)
+            self.emit("valueChanged", key, True, previous)
         return {"type": "delete", "key": key, "pid": pid}
 
     def clear(self) -> dict:
@@ -91,12 +107,14 @@ class MapKernel:
         if key in self.pending_keys or self.pending_clear_count > 0:
             return  # shadowed by pending local write / pending local clear
         if t == "set":
+            previous = self.data.get(key, MISSING)
             self.data[key] = decode_handles(op["value"])
-            self.emit("valueChanged", key, False)
+            self.emit("valueChanged", key, False, previous)
         elif t == "delete":
             if key in self.data:
+                previous = self.data[key]
                 del self.data[key]
-                self.emit("valueChanged", key, False)
+                self.emit("valueChanged", key, False, previous)
 
     # -- resubmit (reconnect) ---------------------------------------------
     def pending_ops(self) -> List[dict]:

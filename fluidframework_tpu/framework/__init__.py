@@ -1,0 +1,39 @@
+"""Framework layer: aqueduct data objects, runtime factories, undo-redo,
+interceptions, agent scheduler, DI, request routing.
+
+Parity: reference packages/framework/* (SURVEY.md §2.4)."""
+
+from .agent_scheduler import AgentScheduler
+from .container_factories import (
+    BaseContainerRuntimeFactory,
+    ContainerRuntimeFactoryWithDefaultDataStore,
+)
+from .data_object import DataObject, DataObjectFactory, PureDataObject
+from .interceptions import (
+    create_shared_map_with_interception,
+    create_shared_string_with_interception,
+)
+from .request_handler import (
+    RequestHandlerChain,
+    RequestParser,
+    datastore_route_handler,
+)
+from .synthesize import DependencyContainer
+from .undo_redo import (
+    SharedMapUndoRedoHandler,
+    SharedSegmentSequenceUndoRedoHandler,
+    UndoRedoStackManager,
+)
+
+__all__ = [
+    "AgentScheduler",
+    "BaseContainerRuntimeFactory",
+    "ContainerRuntimeFactoryWithDefaultDataStore",
+    "DataObject", "DataObjectFactory", "PureDataObject",
+    "create_shared_map_with_interception",
+    "create_shared_string_with_interception",
+    "RequestHandlerChain", "RequestParser", "datastore_route_handler",
+    "DependencyContainer",
+    "SharedMapUndoRedoHandler", "SharedSegmentSequenceUndoRedoHandler",
+    "UndoRedoStackManager",
+]

@@ -92,16 +92,27 @@ class MergeTreeClient(TypedEventEmitter):
         return make_insert_op(pos, marker_seg(props))
 
     def remove_range_local(self, start: int, end: int) -> dict:
+        # Capture removed content before applying so undo can reinsert it
+        # (text payloads only; permutation vectors carry non-str runs).
+        try:
+            removed = self.get_text()[start:end]
+        except TypeError:
+            removed = None
         self.tree.remove_range(start, end, self.tree.current_seq,
                                self.client_id, UNASSIGNED_SEQ)
-        self.emit("delta", {"op": "remove", "start": start, "end": end}, True)
+        args = {"op": "remove", "start": start, "end": end}
+        if isinstance(removed, str):
+            args["text"] = removed
+        self.emit("delta", args, True)
         return make_remove_op(start, end)
 
     def annotate_range_local(self, start: int, end: int, props: dict) -> dict:
+        # Per-span previous values (undo restores them; null deletes).
+        deltas = self.tree.get_range_property_deltas(start, end, props.keys())
         self.tree.annotate_range(start, end, props, self.tree.current_seq,
                                  self.client_id, UNASSIGNED_SEQ)
         self.emit("delta", {"op": "annotate", "start": start, "end": end,
-                            "props": props}, True)
+                            "props": props, "propertyDeltas": deltas}, True)
         return make_annotate_op(start, end, props)
 
     # -- sequenced message application ------------------------------------

@@ -356,6 +356,32 @@ class MergeTreeOracle:
     # ------------------------------------------------------------------
     # annotate
     # ------------------------------------------------------------------
+    def get_range_property_deltas(self, start: int, end: int,
+                                  keys) -> List[Tuple[int, int, dict]]:
+        """Per-span snapshot of the CURRENT values of `keys` over visible
+        [start, end) — captured before an annotate so undo can restore them
+        (reference: propertyDeltas on the merge-tree delta event)."""
+        out: List[Tuple[int, int, dict]] = []
+        acc = 0
+        for seg in self.segments:
+            vlen = self.visible_length(seg, self.current_seq,
+                                       self.local_client)
+            if vlen == 0:
+                continue
+            seg_start, seg_end = acc, acc + vlen
+            acc = seg_end
+            if seg_end <= start:
+                continue
+            if seg_start >= end:
+                break
+            old = {k: (seg.props or {}).get(k) for k in keys}
+            span = (max(seg_start, start), min(seg_end, end), old)
+            if out and out[-1][1] == span[0] and out[-1][2] == span[2]:
+                out[-1] = (out[-1][0], span[1], out[-1][2])  # merge runs
+            else:
+                out.append(span)
+        return out
+
     def annotate_range(self, start: int, end: int, props: Dict[str, Any],
                        ref_seq: int, client: int, seq: int) -> None:
         """Set properties on visible segments in [start, end); per-key LWW

@@ -1,0 +1,262 @@
+"""Framework layer: data objects + factories, undo-redo, interceptions,
+agent scheduler, DI, request routing — over the live local stack."""
+
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.register_collection import (
+    ConsensusRegisterCollection,
+)
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.framework import (
+    AgentScheduler,
+    ContainerRuntimeFactoryWithDefaultDataStore,
+    DataObject,
+    DataObjectFactory,
+    DependencyContainer,
+    RequestHandlerChain,
+    SharedMapUndoRedoHandler,
+    SharedSegmentSequenceUndoRedoHandler,
+    UndoRedoStackManager,
+    create_shared_map_with_interception,
+    create_shared_string_with_interception,
+    datastore_route_handler,
+)
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.server.local_server import LocalServer
+
+
+class Clicker(DataObject):
+    """The canonical example data object (examples/data-objects/clicker)."""
+
+    def initializing_first_time(self):
+        self.root.set("clicks", 0)
+
+    @property
+    def value(self):
+        return self.root.get("clicks")
+
+    def click(self):
+        self.root.set("clicks", self.value + 1)
+
+
+clicker_factory = DataObjectFactory("clicker", Clicker)
+
+
+def make_env(server=None):
+    server = server or LocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    return server, loader
+
+
+class TestDataObjects:
+    def test_create_attach_load_via_factory(self):
+        server, loader = make_env()
+        runtime_factory = ContainerRuntimeFactoryWithDefaultDataStore(
+            clicker_factory)
+        c1, clicker1 = runtime_factory.create_detached(loader, "doc")
+        assert clicker1.value == 0
+        clicker1.click()
+        c1.attach()
+
+        c2, clicker2 = runtime_factory.load(loader, "doc")
+        assert clicker2.value == 1
+        clicker2.click()
+        assert clicker1.value == clicker2.value == 2
+
+    def test_lifecycle_hooks_run_once_each(self):
+        calls = []
+
+        class Probe(DataObject):
+            def initializing_first_time(self):
+                calls.append("first")
+
+            def initializing_from_existing(self):
+                calls.append("existing")
+
+            def has_initialized(self):
+                calls.append("has")
+
+        factory = DataObjectFactory("probe", Probe)
+        runtime_factory = ContainerRuntimeFactoryWithDefaultDataStore(factory)
+        server, loader = make_env()
+        c1, obj = runtime_factory.create_detached(loader, "doc")
+        c1.attach()
+        assert calls == ["first", "has"]
+        runtime_factory.load(loader, "doc")
+        assert calls == ["first", "has", "existing", "has"]
+
+    def test_request_routes_to_default(self):
+        server, loader = make_env()
+        runtime_factory = ContainerRuntimeFactoryWithDefaultDataStore(
+            clicker_factory)
+        c1, obj = runtime_factory.create_detached(loader, "doc")
+        assert runtime_factory.request(c1, "/") is obj
+        assert runtime_factory.request(c1, "/default") is obj
+
+
+class TestRequestHandlerChain:
+    def test_datastore_routing(self):
+        server, loader = make_env()
+        c1 = loader.create_detached("doc")
+        ds = c1.runtime.create_datastore("store")
+        channel = ds.create_channel("m", SharedMap.TYPE)
+        chain = RequestHandlerChain(datastore_route_handler(c1.runtime))
+        assert chain.request("/store") is ds
+        assert chain.request("/store/m") is channel
+
+    def test_chain_falls_through(self):
+        hits = []
+        chain = RequestHandlerChain(
+            lambda p, ctx: hits.append("a") or None,
+            lambda p, ctx: "resolved")
+        assert chain.request("/x") == "resolved"
+        assert hits == ["a"]
+
+
+class TestSynthesize:
+    def test_register_resolve_and_chain(self):
+        parent = DependencyContainer()
+        parent.register("logger", "parent-logger")
+        child = DependencyContainer(parent)
+        child.register("store", lambda: {"fresh": True})
+        scope = child.synthesize(optional=("missing",),
+                                 required=("logger", "store"))
+        assert scope.logger == "parent-logger"
+        assert scope.store == {"fresh": True}
+        assert scope.missing is None
+
+
+def make_map_doc():
+    server, loader = make_env()
+    c1 = loader.create_detached("doc")
+    ds = c1.runtime.create_datastore("default")
+    m = ds.create_channel("m", SharedMap.TYPE)
+    c1.attach()
+    return server, loader, c1, m
+
+
+class TestUndoRedo:
+    def test_map_undo_redo(self):
+        server, loader, c1, m = make_map_doc()
+        mgr = UndoRedoStackManager()
+        SharedMapUndoRedoHandler(mgr).attach(m)
+        m.set("k", 1)
+        m.set("k", 2)
+        assert mgr.undo_operation() and m.get("k") == 1
+        assert mgr.undo_operation() and m.get("k") is None
+        assert mgr.redo_operation() and m.get("k") == 1
+        assert mgr.redo_operation() and m.get("k") == 2
+
+    def test_grouped_operation(self):
+        server, loader, c1, m = make_map_doc()
+        mgr = UndoRedoStackManager()
+        SharedMapUndoRedoHandler(mgr).attach(m)
+        mgr.open_current_operation()
+        m.set("a", 1)
+        m.set("b", 2)
+        mgr.close_current_operation()
+        assert mgr.undo_operation()
+        assert m.get("a") is None and m.get("b") is None
+
+    def test_new_edit_clears_redo(self):
+        server, loader, c1, m = make_map_doc()
+        mgr = UndoRedoStackManager()
+        SharedMapUndoRedoHandler(mgr).attach(m)
+        m.set("k", 1)
+        mgr.undo_operation()
+        m.set("k", 9)
+        assert not mgr.redo_operation()
+
+    def test_sequence_undo_insert_remove_annotate(self):
+        server, loader = make_env()
+        c1 = loader.create_detached("doc")
+        ds = c1.runtime.create_datastore("default")
+        s = ds.create_channel("t", SharedString.TYPE)
+        c1.attach()
+        mgr = UndoRedoStackManager()
+        SharedSegmentSequenceUndoRedoHandler(mgr).attach(s)
+
+        s.insert_text(0, "hello")
+        s.insert_text(5, " world")
+        mgr.undo_operation()
+        assert s.get_text() == "hello"
+        mgr.redo_operation()
+        assert s.get_text() == "hello world"
+
+        s.remove_text(0, 6)
+        assert s.get_text() == "world"
+        mgr.undo_operation()
+        assert s.get_text() == "hello world"
+
+        s.annotate_range(0, 5, {"bold": True})
+        mgr.undo_operation()
+        props = s.client.tree.get_range_property_deltas(0, 5, ["bold"])
+        assert all(old["bold"] is None for _, _, old in props)
+
+
+class TestInterceptions:
+    def test_string_attribution_props(self):
+        server, loader = make_env()
+        c1 = loader.create_detached("doc")
+        ds = c1.runtime.create_datastore("default")
+        s = ds.create_channel("t", SharedString.TYPE)
+        c1.attach()
+        wrapped = create_shared_string_with_interception(
+            s, lambda props: {**(props or {}), "author": "me"})
+        wrapped.insert_text(0, "attributed")
+        deltas = s.client.tree.get_range_property_deltas(0, 5, ["author"])
+        assert all(old["author"] == "me" for _, _, old in deltas)
+        assert wrapped.get_text() == "attributed"
+
+    def test_map_interceptor(self):
+        server, loader, c1, m = make_map_doc()
+        wrapped = create_shared_map_with_interception(
+            m, lambda key, value: {"v": value, "stamped": True})
+        wrapped.set("k", 7)
+        assert m.get("k") == {"v": 7, "stamped": True}
+
+
+class TestAgentScheduler:
+    def make_pair(self):
+        server, loader = make_env()
+        c1 = loader.create_detached("doc")
+        ds1 = c1.runtime.create_datastore("default")
+        ds1.create_channel("tasks", ConsensusRegisterCollection.TYPE)
+        c1.attach()
+        c2 = loader.resolve("doc")
+        r1 = c1.runtime.get_datastore("default").get_channel("tasks")
+        r2 = c2.runtime.get_datastore("default").get_channel("tasks")
+        return server, loader, (c1, r1), (c2, r2)
+
+    def test_single_winner(self):
+        server, loader, (c1, r1), (c2, r2) = self.make_pair()
+        runs = []
+        s1 = AgentScheduler(c1, r1)
+        s2 = AgentScheduler(c2, r2)
+        s1.pick("snapshot", lambda: runs.append("c1"))
+        s2.pick("snapshot", lambda: runs.append("c2"))
+        assert runs == ["c1"]
+        assert s1.picked("snapshot") and not s2.picked("snapshot")
+        assert s1.picked_tasks() == ["snapshot"]
+
+    def test_takeover_on_leave(self):
+        server, loader, (c1, r1), (c2, r2) = self.make_pair()
+        runs = []
+        s1 = AgentScheduler(c1, r1)
+        s2 = AgentScheduler(c2, r2)
+        s1.pick("job", lambda: runs.append("c1"))
+        s2.pick("job", lambda: runs.append("c2"))
+        assert runs == ["c1"]
+        c1.close()
+        assert runs == ["c1", "c2"]
+        assert s2.picked("job")
+
+    def test_release_hands_off(self):
+        server, loader, (c1, r1), (c2, r2) = self.make_pair()
+        runs = []
+        s1 = AgentScheduler(c1, r1)
+        s2 = AgentScheduler(c2, r2)
+        s1.pick("t", lambda: runs.append("c1"))
+        s2.pick("t", lambda: runs.append("c2"))
+        s1.release("t")
+        assert runs == ["c1", "c2"]
