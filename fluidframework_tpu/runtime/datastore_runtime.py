@@ -47,10 +47,14 @@ def default_registry() -> ChannelRegistry:
     from ..dds.register_collection import ConsensusRegisterCollection
     from ..dds.ordered_collection import ConsensusQueue
     from ..dds.matrix import SharedMatrix
+    from ..dds.ink import Ink
+    from ..dds.summary_block import SharedSummaryBlock
+    from ..dds.sparse_matrix import SparseMatrix
     reg = ChannelRegistry()
     for cls in (SharedMap, SharedString, SharedSegmentSequence, SharedCounter,
                 SharedCell, SharedDirectory, ConsensusRegisterCollection,
-                ConsensusQueue, SharedMatrix):
+                ConsensusQueue, SharedMatrix, Ink, SharedSummaryBlock,
+                SparseMatrix):
         reg.register(cls)
     return reg
 

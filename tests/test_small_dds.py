@@ -1,0 +1,97 @@
+"""Ink, SharedSummaryBlock, SparseMatrix over the live local stack."""
+
+import pytest
+
+from fluidframework_tpu.dds.ink import Ink
+from fluidframework_tpu.dds.sparse_matrix import SparseMatrix
+from fluidframework_tpu.dds.summary_block import SharedSummaryBlock
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.server.local_server import LocalServer
+
+
+def make_pair(dds_type):
+    server = LocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.create_detached("doc")
+    ds1 = c1.runtime.create_datastore("default")
+    ch1 = ds1.create_channel("x", dds_type)
+    c1.attach()
+    c2 = loader.resolve("doc")
+    ch2 = c2.runtime.get_datastore("default").get_channel("x")
+    return server, loader, (c1, ch1), (c2, ch2)
+
+
+class TestInk:
+    def test_strokes_converge(self):
+        server, loader, (c1, i1), (c2, i2) = make_pair(Ink.TYPE)
+        sid = i1.create_stroke({"color": "red", "thickness": 3})
+        i1.append_point_to_stroke(sid, {"x": 1, "y": 2})
+        i2.append_point_to_stroke(sid, {"x": 3, "y": 4})
+        s1, s2 = i1.get_stroke(sid), i2.get_stroke(sid)
+        assert s1["points"] == s2["points"]
+        assert len(s1["points"]) == 2
+        assert s1["pen"] == {"color": "red", "thickness": 3}
+
+    def test_clear(self):
+        server, loader, (c1, i1), (c2, i2) = make_pair(Ink.TYPE)
+        i1.create_stroke()
+        i2.clear()
+        assert i1.get_strokes() == [] and i2.get_strokes() == []
+
+    def test_summary_roundtrip(self):
+        server, loader, (c1, i1), (c2, i2) = make_pair(Ink.TYPE)
+        sid = i1.create_stroke({"color": "blue"})
+        i1.append_point_to_stroke(sid, {"x": 0, "y": 0})
+        c1.summarize()
+        server.pump()
+        c3 = loader.resolve("doc")
+        i3 = c3.runtime.get_datastore("default").get_channel("x")
+        assert i3.get_stroke(sid)["points"] == [{"x": 0, "y": 0}]
+
+
+class TestSharedSummaryBlock:
+    def test_persists_only_via_summary(self):
+        server, loader, (c1, b1), (c2, b2) = make_pair(
+            SharedSummaryBlock.TYPE)
+        b1.set("index", {"terms": ["a", "b"]})
+        # No ops flow: the second client does NOT see it live.
+        assert b2.get("index") is None
+        c1.summarize()
+        server.pump()
+        c3 = loader.resolve("doc")
+        b3 = c3.runtime.get_datastore("default").get_channel("x")
+        assert b3.get("index") == {"terms": ["a", "b"]}
+
+    def test_rejects_non_serializable(self):
+        server, loader, (c1, b1), _ = make_pair(SharedSummaryBlock.TYPE)
+        with pytest.raises(TypeError):
+            b1.set("bad", object())
+
+
+class TestSparseMatrix:
+    def test_rows_and_items(self):
+        server, loader, (c1, m1), (c2, m2) = make_pair(SparseMatrix.TYPE)
+        m1.insert_rows(0, 3)
+        m1.set_items(0, 2, ["a", "b", "c"])
+        assert m2.get_item(0, 2) == "a"
+        assert m2.get_item(0, 4) == "c"
+        assert m2.get_item(0, 100) is None
+        assert m1.num_rows == m2.num_rows == 3
+        assert m1.num_cols == 1 << 31
+
+    def test_row_insert_shifts_identity(self):
+        server, loader, (c1, m1), (c2, m2) = make_pair(SparseMatrix.TYPE)
+        m1.insert_rows(0, 2)
+        m1.set_items(1, 0, ["keep"])
+        m2.insert_rows(0, 1)  # shifts rows down
+        assert m1.get_item(2, 0) == "keep"
+        assert m2.get_item(2, 0) == "keep"
+
+    def test_remove_rows(self):
+        server, loader, (c1, m1), (c2, m2) = make_pair(SparseMatrix.TYPE)
+        m1.insert_rows(0, 3)
+        m1.set_items(2, 0, ["last"])
+        m2.remove_rows(0, 2)
+        assert m1.num_rows == m2.num_rows == 1
+        assert m1.get_item(0, 0) == m2.get_item(0, 0) == "last"
