@@ -1,0 +1,150 @@
+"""Replay, file, and debug drivers + URL resolvers: capture a live session
+with the local stack, then reload it through each driver."""
+
+import pytest
+
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.loader.container import Container, Loader
+from fluidframework_tpu.loader.drivers.debug import (
+    DebugController,
+    DebugDocumentServiceFactory,
+)
+from fluidframework_tpu.loader.drivers.file import (
+    FileDocumentCapture,
+    FileDocumentServiceFactory,
+)
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.loader.drivers.replay import (
+    ReplayController,
+    ReplayDocumentService,
+)
+from fluidframework_tpu.loader.drivers.url_resolver import (
+    FluidUrlResolver,
+    MultiUrlResolver,
+)
+from fluidframework_tpu.server.local_server import LocalServer
+
+
+def record_session():
+    """A live session: attach summary + op tail, returned as a capture."""
+    server = LocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.create_detached("doc")
+    ds = c1.runtime.create_datastore("default")
+    text = ds.create_channel("t", SharedString.TYPE)
+    text.insert_text(0, "recorded")
+    c1.attach()
+    text.insert_text(8, " session")
+    server.pump()
+    summary = server.storage("doc").read_summary()
+    ops = loader.factory.create_document_service("doc") \
+        .connect_to_delta_storage().get(0)
+    return summary, ops, text.get_text()
+
+
+class TestReplayDriver:
+    def test_full_replay_matches_live(self):
+        summary, ops, expected = record_session()
+        service = ReplayDocumentService(summary, ops)
+        c = Container.load("doc", service)
+        t = c.runtime.get_datastore("default").get_channel("t")
+        assert t.get_text() == expected
+
+    def test_watermark_stepping(self):
+        summary, ops, expected = record_session()
+        controller = ReplayController(replay_to=0)
+        service = ReplayDocumentService(summary, ops, controller)
+        c = Container.load("doc", service)
+        t = c.runtime.get_datastore("default").get_channel("t")
+        before = t.get_text()
+        controller.forward(None)  # release everything
+        assert t.get_text() == expected
+        assert before != expected or not ops  # watermark actually held ops
+
+    def test_read_only(self):
+        summary, ops, _ = record_session()
+        service = ReplayDocumentService(summary, ops)
+        c = Container.load("doc", service)
+        with pytest.raises(PermissionError):
+            c.delta_manager.submit("op", {"x": 1})
+
+
+class TestFileDriver:
+    def test_capture_and_reload(self, tmp_path):
+        summary, ops, expected = record_session()
+        capture = FileDocumentCapture(str(tmp_path / "doc"))
+        capture.write_summary(summary)
+        capture.write_ops(ops)
+
+        factory = FileDocumentServiceFactory(str(tmp_path))
+        c = Container.load("doc", factory.create_document_service("doc"))
+        t = c.runtime.get_datastore("default").get_channel("t")
+        assert t.get_text() == expected
+
+    def test_missing_document(self, tmp_path):
+        factory = FileDocumentServiceFactory(str(tmp_path))
+        with pytest.raises(FileNotFoundError):
+            Container.load("nope", factory.create_document_service("nope"))
+
+    def test_append_ops(self, tmp_path):
+        capture = FileDocumentCapture(str(tmp_path / "doc"))
+        _, ops, _ = record_session()
+        capture.write_ops(ops[:2])
+        capture.append_ops(ops[2:])
+        assert len(capture.read_ops()) == len(ops)
+
+
+class TestDebugDriver:
+    def test_step_through_ops(self):
+        server = LocalServer(auto_pump=False)
+        loader = Loader(LocalDocumentServiceFactory(server))
+        c1 = loader.create_detached("doc")
+        ds = c1.runtime.create_datastore("default")
+        text = ds.create_channel("t", SharedString.TYPE)
+        c1.attach()
+        server.pump()
+
+        controller = DebugController(paused=False)
+        debug_factory = DebugDocumentServiceFactory(
+            LocalDocumentServiceFactory(server), controller)
+        loader2 = Loader(debug_factory)
+        c2 = loader2.resolve("doc")
+        t2 = c2.runtime.get_datastore("default").get_channel("t")
+        server.pump()  # sequence c2's own join before pausing
+
+        controller.pause()
+        text.insert_text(0, "abc")
+        text.insert_text(3, "def")
+        server.pump()
+        assert t2.get_text() == ""  # held by the debugger
+
+        controller.step(1)
+        assert t2.get_text() == "abc"
+        controller.go()
+        assert t2.get_text() == "abcdef"
+
+
+class TestUrlResolvers:
+    def test_fluid_url(self):
+        r = FluidUrlResolver()
+        resolved = r.resolve("fluid://localhost:3000/tenantA/doc42/path/x")
+        assert resolved.tenant_id == "tenantA"
+        assert resolved.document_id == "doc42"
+        assert resolved.path == "/path/x"
+        assert resolved.endpoint == "localhost:3000"
+
+    def test_default_tenant(self):
+        r = FluidUrlResolver(default_tenant="local")
+        resolved = r.resolve("fluid://host/onlydoc")
+        assert resolved.tenant_id == "local"
+        assert resolved.document_id == "onlydoc"
+
+    def test_multi_resolver(self):
+        class Rejecting:
+            def resolve(self, url):
+                raise ValueError("nope")
+
+        multi = MultiUrlResolver(Rejecting(), FluidUrlResolver())
+        assert multi.resolve("fluid://h/t/d").document_id == "d"
+        with pytest.raises(ValueError):
+            MultiUrlResolver(Rejecting()).resolve("fluid://h/t/d")
