@@ -60,6 +60,14 @@ class Container(TypedEventEmitter):
         self._last_summary_handle: Optional[str] = None
         self._summary_waiters: List[Callable[[str, bool, Any], None]] = []
 
+    @property
+    def op_lock(self):
+        """The container's serialization lock (the JS-event-loop analog).
+        Network drivers deliver inbound ops on a reader thread under this
+        lock; application code mutating DDSes from its own threads wraps the
+        mutation in `with container.op_lock:` to serialize against them."""
+        return self.delta_manager.lock
+
     # -- creation / loading ------------------------------------------------
     @staticmethod
     def create_detached(document_id: str, service: IDocumentService,

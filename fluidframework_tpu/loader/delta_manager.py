@@ -9,6 +9,7 @@ connection, refetch, hand the container a fresh client id to resubmit on).
 
 from __future__ import annotations
 
+import threading
 from typing import Callable, List, Optional
 
 from ..core.events import TypedEventEmitter
@@ -39,6 +40,12 @@ class DeltaManager(TypedEventEmitter):
         self._handler: Optional[Callable[[SequencedDocumentMessage], None]] = None
         self._inbound: List[SequencedDocumentMessage] = []
         self._processing = False
+        # The "event loop" of this container. In-process drivers deliver ops
+        # synchronously on the caller's thread; network drivers deliver on a
+        # websocket reader thread. Inbound processing and outbound submission
+        # both serialize on this lock, and application code doing multi-
+        # threaded DDS mutation takes it too (Container.op_lock).
+        self.lock = threading.RLock()
 
     # -- lifecycle ---------------------------------------------------------
     def attach_op_handler(self, sequence_number: int,
@@ -81,47 +88,66 @@ class DeltaManager(TypedEventEmitter):
         clientSequenceNumber is assigned but before the wire push — callers
         record pending state there, because over an in-process service the
         sequenced ack can arrive synchronously inside the send."""
-        if self.connection is None:
-            raise ConnectionError("not connected")
-        self.client_sequence_number += 1
-        csn = self.client_sequence_number
-        msg = DocumentMessage(
-            client_sequence_number=csn,
-            reference_sequence_number=self.last_sequence_number,
-            type=mtype, contents=contents, data=data)
-        if before_send is not None:
-            before_send(csn)
-        self._op_perf.on_submit(csn)
-        self.connection.submit([msg])
-        return csn
+        with self.lock:
+            if self.connection is None:
+                raise ConnectionError("not connected")
+            self.client_sequence_number += 1
+            csn = self.client_sequence_number
+            msg = DocumentMessage(
+                client_sequence_number=csn,
+                reference_sequence_number=self.last_sequence_number,
+                type=mtype, contents=contents, data=data)
+            if before_send is not None:
+                before_send(csn)
+            self._op_perf.on_submit(csn)
+            self.connection.submit([msg])
+            return csn
 
     # -- inbound -----------------------------------------------------------
     def _enqueue(self, message: SequencedDocumentMessage) -> None:
-        self._inbound.append(message)
+        with self.lock:
+            self._inbound.append(message)
         self._process_inbound()
 
     def _process_inbound(self) -> None:
-        if self._processing:
-            return  # re-entrant deliveries drain in the outer loop
-        self._processing = True
-        try:
-            while self._inbound:
-                self._inbound.sort(key=lambda m: m.sequence_number)
-                msg = self._inbound[0]
-                if msg.sequence_number <= self.last_sequence_number:
-                    self._inbound.pop(0)  # duplicate
-                    continue
-                if msg.sequence_number > self.last_sequence_number + 1:
-                    fetched = self.delta_storage.get(
-                        self.last_sequence_number, msg.sequence_number - 1)
+        """Drain the inbound queue in sequence order. Deliveries happen
+        under self.lock; gap-fill fetches (network I/O over remote drivers)
+        happen with the lock RELEASED so application threads aren't stalled
+        behind a slow/timed-out catch-up request."""
+        while True:
+            with self.lock:
+                if self._processing:
+                    return  # re-entrant deliveries drain in the outer loop
+                self._processing = True
+            gap: Optional[tuple] = None
+            try:
+                with self.lock:
+                    while self._inbound:
+                        self._inbound.sort(key=lambda m: m.sequence_number)
+                        msg = self._inbound[0]
+                        if msg.sequence_number <= self.last_sequence_number:
+                            self._inbound.pop(0)  # duplicate
+                            continue
+                        if msg.sequence_number > self.last_sequence_number + 1:
+                            gap = (self.last_sequence_number,
+                                   msg.sequence_number - 1)
+                            break
+                        self._inbound.pop(0)
+                        self._deliver(msg)
+                if gap is not None:
+                    fetched = self.delta_storage.get(*gap)  # lock released
                     if not fetched:
-                        break  # gap not yet durable; wait for more
-                    self._inbound = fetched + self._inbound
-                    continue
-                self._inbound.pop(0)
-                self._deliver(msg)
-        finally:
-            self._processing = False
+                        return  # gap not yet durable; wait for more ops
+                    with self.lock:
+                        self._inbound = fetched + self._inbound
+            finally:
+                self._processing = False
+            with self.lock:
+                # Messages enqueued by another thread between our final
+                # drain and clearing _processing would otherwise be
+                # stranded until the next delivery.
+                if gap is None and not self._inbound:
+                    return
 
     def _deliver(self, msg: SequencedDocumentMessage) -> None:
         self.last_sequence_number = msg.sequence_number

@@ -1,0 +1,277 @@
+"""Network driver: REST storage/delta catch-up + websocket delta stream.
+
+Capability parity with reference packages/drivers/routerlicious-driver
+(`src/documentService.ts`, `documentDeltaConnection.ts`,
+`deltaStorageService.ts`, `documentStorageService.ts`) and driver-base
+(`src/documentDeltaConnection.ts`): the production driver that talks to an
+Alfred front door (server/alfred.py) over real sockets. Token minting
+follows the reference's ITokenProvider pattern — the host supplies a
+callable returning a JWT for (tenantId, documentId).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+import urllib.error
+from typing import Callable, List, Optional
+
+from ...core.events import TypedEventEmitter
+from ...protocol.messages import DocumentMessage, SequencedDocumentMessage
+from ...protocol.summary import (
+    SummaryTree,
+    summary_tree_from_dict,
+    summary_tree_to_dict,
+)
+from ...server import websocket
+from ...server.wire import (
+    delta_rows_to_messages,
+    document_message_to_dict,
+    nack_from_dict,
+    sequenced_message_from_dict,
+)
+from .base import (
+    IDocumentDeltaConnection,
+    IDocumentDeltaStorageService,
+    IDocumentService,
+    IDocumentServiceFactory,
+    IDocumentStorageService,
+)
+
+TokenProvider = Callable[[str, str], str]  # (tenant_id, document_id) -> jwt
+
+
+class RestWrapper:
+    """Thin authenticated JSON REST client (reference services-client
+    RestWrapper)."""
+
+    def __init__(self, base_url: str, token: Optional[str] = None,
+                 timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+
+    def request(self, method: str, path: str,
+                body: Optional[dict] = None) -> dict:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(self.base_url + path, data=data,
+                                     method=method)
+        req.add_header("Content-Type", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as exc:
+            detail = exc.read().decode(errors="replace")
+            raise RestError(exc.code, detail) from exc
+
+    def get(self, path: str) -> dict:
+        return self.request("GET", path)
+
+    def post(self, path: str, body: Optional[dict] = None) -> dict:
+        return self.request("POST", path, body or {})
+
+
+class RestError(Exception):
+    def __init__(self, status: int, detail: str):
+        super().__init__(f"HTTP {status}: {detail}")
+        self.status = status
+        self.detail = detail
+
+
+RestFactory = Callable[[], RestWrapper]
+
+
+class NetworkDocumentStorageService(IDocumentStorageService):
+    """Summary upload/download over the historian REST routes. Takes a
+    RestWrapper *factory* so every request gets a freshly minted token —
+    these services are long-lived and tokens expire."""
+
+    def __init__(self, rest_factory: RestFactory, tenant_id: str,
+                 document_id: str):
+        self._rest = rest_factory
+        self.tenant_id = tenant_id
+        self.document_id = document_id
+        self._repo = f"/repos/{tenant_id}/{document_id}"
+
+    def get_summary(self, version: Optional[str] = None
+                    ) -> Optional[SummaryTree]:
+        path = self._repo + "/summaries/latest"
+        if version:
+            path += f"?sha={version}"
+        try:
+            data = self._rest().get(path)
+        except RestError as exc:
+            if exc.status == 404:
+                return None
+            raise
+        return summary_tree_from_dict(data["summary"])
+
+    def upload_summary(self, summary: SummaryTree,
+                       parent: Optional[str] = None,
+                       initial: bool = False) -> str:
+        return self._rest().post(self._repo + "/summaries", {
+            "summary": summary_tree_to_dict(summary),
+            "parent": parent,
+            "initial": initial,
+        })["sha"]
+
+    def get_versions(self, count: int = 1) -> List[str]:
+        return self._rest().get(self._repo + f"/versions?count={count}"
+                                )["versions"]
+
+
+class NetworkDeltaStorageService(IDocumentDeltaStorageService):
+    """Catch-up reads over the alfred delta REST route."""
+
+    def __init__(self, rest_factory: RestFactory, tenant_id: str,
+                 document_id: str):
+        self._rest = rest_factory
+        self.path = f"/deltas/{tenant_id}/{document_id}"
+
+    def get(self, from_seq: int, to_seq: Optional[int] = None
+            ) -> List[SequencedDocumentMessage]:
+        path = self.path + f"?from={from_seq}"
+        if to_seq is not None:
+            path += f"&to={to_seq}"
+        return delta_rows_to_messages(self._rest().get(path)["deltas"])
+
+
+class NetworkDocumentDeltaConnection(TypedEventEmitter,
+                                     IDocumentDeltaConnection):
+    """The live op stream over a websocket. A reader thread dispatches
+    server frames to "op"/"nack"/"disconnect" listeners — same event
+    surface as the local driver so DeltaManager is agnostic."""
+
+    def __init__(self, host: str, port: int, tenant_id: str,
+                 document_id: str, token: Optional[str],
+                 client_details: Optional[dict]):
+        TypedEventEmitter.__init__(self)
+        self._ws = websocket.connect(host, port, "/socket")
+        self._ws.send_text(json.dumps({
+            "type": "connect_document",
+            "tenantId": tenant_id,
+            "documentId": document_id,
+            "token": token,
+            "client": client_details or {},
+        }))
+        hello = json.loads(self._ws.recv())
+        if hello.get("type") != "connected":
+            self._ws.close()
+            raise ConnectionError(
+                f"connect_document rejected: {hello.get('error', hello)}")
+        self.client_id = hello["clientId"]
+        self.checkpoint_sequence_number = hello.get("sequenceNumber", 0)
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name=f"ws-{self.client_id}",
+                                        daemon=True)
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                frame = json.loads(self._ws.recv())
+                ftype = frame.get("type")
+                if ftype == "op":
+                    self.emit("op",
+                              sequenced_message_from_dict(frame["message"]))
+                elif ftype == "nack":
+                    self.emit("nack", nack_from_dict(frame["nack"]))
+        except (websocket.WebSocketClosed, OSError,
+                json.JSONDecodeError, ValueError, RestError):
+            # RestError: an op handler's catch-up fetch failed (e.g. expired
+            # token); treat like a dropped connection so the container's
+            # disconnect/reconnect path takes over instead of a dead thread.
+            pass
+        finally:
+            if not self._closed:
+                self._closed = True
+                self.emit("disconnect")
+
+    def submit(self, messages: List[DocumentMessage]) -> None:
+        if self._closed:
+            raise ConnectionError("connection closed")
+        self._ws.send_text(json.dumps({
+            "type": "submitOp",
+            "messages": [document_message_to_dict(m) for m in messages],
+        }))
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self._ws.send_text(json.dumps({"type": "disconnect"}))
+        except (websocket.WebSocketClosed, OSError):
+            pass
+        self._ws.close()
+        # close() can be reached from the reader thread itself (e.g. a nack
+        # handler triggering reconnect); a thread cannot join itself.
+        if self._reader is not threading.current_thread():
+            self._reader.join(timeout=5)
+
+
+class NetworkDocumentService(IDocumentService):
+    def __init__(self, base_url: str, tenant_id: str, document_id: str,
+                 token_provider: Optional[TokenProvider]):
+        self.base_url = base_url.rstrip("/")
+        self.tenant_id = tenant_id
+        self.document_id = document_id
+        self.token_provider = token_provider
+        without_scheme = self.base_url.split("://", 1)[-1]
+        host, _, port = without_scheme.partition(":")
+        self._host, self._port = host, int(port or 80)
+
+    def _token(self) -> Optional[str]:
+        if self.token_provider is None:
+            return None
+        return self.token_provider(self.tenant_id, self.document_id)
+
+    def _rest(self) -> RestWrapper:
+        return RestWrapper(self.base_url, self._token())
+
+    def connect_to_storage(self) -> NetworkDocumentStorageService:
+        return NetworkDocumentStorageService(self._rest, self.tenant_id,
+                                             self.document_id)
+
+    def connect_to_delta_storage(self) -> NetworkDeltaStorageService:
+        return NetworkDeltaStorageService(self._rest, self.tenant_id,
+                                          self.document_id)
+
+    def connect_to_delta_stream(self, client_details: Optional[dict] = None
+                                ) -> NetworkDocumentDeltaConnection:
+        return NetworkDocumentDeltaConnection(
+            self._host, self._port, self.tenant_id, self.document_id,
+            self._token(), client_details)
+
+
+class NetworkDocumentServiceFactory(IDocumentServiceFactory):
+    """Driver entry point: points at an alfred URL + tenant, mints a
+    document service per document."""
+
+    def __init__(self, base_url: str, tenant_id: str,
+                 token_provider: Optional[TokenProvider] = None):
+        self.base_url = base_url
+        self.tenant_id = tenant_id
+        self.token_provider = token_provider
+
+    def create_document_service(self, document_id: str
+                                ) -> NetworkDocumentService:
+        return NetworkDocumentService(self.base_url, self.tenant_id,
+                                      document_id, self.token_provider)
+
+    def create_document(self, document_id: Optional[str] = None,
+                        summary: Optional[SummaryTree] = None) -> str:
+        """POST /documents (reference createDoc flow). Returns the doc id."""
+        token = (self.token_provider(self.tenant_id, document_id or "*")
+                 if self.token_provider else None)
+        body: dict = {}
+        if document_id:
+            body["id"] = document_id
+        if summary is not None:
+            body["summary"] = summary_tree_to_dict(summary)
+        rest = RestWrapper(self.base_url, token)
+        return rest.post(f"/documents/{self.tenant_id}", body)["id"]

@@ -1,0 +1,409 @@
+"""Alfred: the network front door of the ordering service.
+
+Capability parity with reference routerlicious-base Alfred
+(`src/alfred/{app,runner,routes}`, socket handlers `connect_document`/
+`submitOp` in `lambdas/src/alfred/index.ts:305-328`) plus the co-hosted
+REST surfaces of Riddler (tenant CRUD/token validation,
+`riddler/tenantManager.ts`) and historian/gitrest (git summary storage,
+`server/historian`, `server/gitrest`). One `AlfredService` exposes:
+
+  REST  GET  /api/v1/ping
+        POST /documents/{tenant}                (create document)
+        GET  /deltas/{tenant}/{doc}?from=&to=   (catch-up range query)
+        POST /tenants/{tenant}                  (Riddler: create tenant)
+        GET  /tenants/{tenant}/key              (Riddler: fetch secret)
+        POST /tenants/{tenant}/validate         (Riddler: validate a JWT)
+        POST /repos/{tenant}/{doc}/summaries    (upload summary tree)
+        GET  /repos/{tenant}/{doc}/summaries/latest?sha=
+        GET  /repos/{tenant}/{doc}/versions?count=
+        GET  /repos/{tenant}/{doc}/git/commits?count=
+  WS    GET  /socket  (upgrade)  -> connect_document / submitOp / op / nack
+
+Behind the door each tenant gets a `LocalServer` core — the *real*
+Deli/Scribe/Scriptorium/Broadcaster lambda pipeline (server/local_server.py)
+— so the network path and the in-process test path exercise identical
+sequencing code, exactly like the reference where LocalOrderer and the
+Kafka deployment share lambda implementations.
+
+The delta-stream wire protocol is JSON text frames:
+  C->S {"type": "connect_document", "tenantId", "documentId", "token", "client"}
+  S->C {"type": "connected", "clientId", "sequenceNumber"}
+  C->S {"type": "submitOp", "messages": [DocumentMessage...]}
+  S->C {"type": "op", "message": SequencedDocumentMessage}
+  S->C {"type": "nack", "nack": Nack}
+"""
+
+from __future__ import annotations
+
+import hmac
+import json
+import re
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Tuple
+
+from ..protocol.summary import summary_tree_from_dict, summary_tree_to_dict
+from .auth import AuthError, TenantManager
+from .local_server import LocalServer
+from .websocket import WebSocketClosed, upgrade_server_socket
+from .wire import (
+    document_message_from_dict,
+    nack_to_dict,
+    sequenced_message_to_dict,
+)
+
+
+class AlfredService:
+    """The front-door service. Thread-safe; one instance serves many
+    tenants/documents over one listening port."""
+
+    def __init__(self, tenants: Optional[TenantManager] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 require_auth: bool = True,
+                 partitions: int = 1,
+                 admin_key: Optional[str] = None):
+        self.tenants = tenants or TenantManager()
+        self.require_auth = require_auth
+        # Riddler's tenant CRUD/key routes are operator-only (the reference
+        # deploys riddler on an internal network); when auth is on they
+        # require this key in an X-Admin-Key header.
+        self.admin_key = admin_key or uuid.uuid4().hex
+        self.partitions = partitions
+        self._cores: Dict[str, LocalServer] = {}
+        self._cores_lock = threading.Lock()
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def do_GET(self):
+                if self.headers.get("Upgrade", "").lower() == "websocket":
+                    service._handle_websocket(self)
+                    self.close_connection = True
+                    return
+                service._handle_rest(self, "GET")
+
+            def do_POST(self):
+                service._handle_rest(self, "POST")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "AlfredService":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="alfred", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def core(self, tenant_id: str) -> LocalServer:
+        """The per-tenant ordering core (lazily created)."""
+        with self._cores_lock:
+            if tenant_id not in self._cores:
+                self._cores[tenant_id] = LocalServer(
+                    tenant_id=tenant_id, partitions=self.partitions)
+            return self._cores[tenant_id]
+
+    # -- auth --------------------------------------------------------------
+    def _check_auth(self, handler, tenant_id: str,
+                    document_id: Optional[str], scope: Optional[str],
+                    token: Optional[str] = None) -> Optional[dict]:
+        """Returns claims (or {} when auth is off); None after sending an
+        error response."""
+        if not self.require_auth:
+            return {}
+        if token is None:
+            auth = handler.headers.get("Authorization", "")
+            if auth.startswith("Bearer "):
+                token = auth[len("Bearer "):]
+        if not token:
+            _send_json(handler, 401, {"error": "missing token"})
+            return None
+        try:
+            return self.tenants.validate_token(tenant_id, token,
+                                               document_id, scope)
+        except AuthError as exc:
+            _send_json(handler, 403, {"error": str(exc)})
+            return None
+
+    def _validate_ws_token(self, tenant_id: str, document_id: str,
+                           token: Optional[str]) -> Optional[str]:
+        """Returns an error string or None if admitted."""
+        if not self.require_auth:
+            return None
+        if not token:
+            return "missing token"
+        try:
+            self.tenants.validate_token(tenant_id, token, document_id,
+                                        "doc:write")
+            return None
+        except AuthError as exc:
+            return str(exc)
+
+    # -- REST --------------------------------------------------------------
+    _ROUTES = [
+        ("GET", re.compile(r"^/api/v1/ping$"), "_r_ping"),
+        ("POST", re.compile(r"^/documents/(?P<tenant>[^/]+)$"), "_r_create_doc"),
+        ("GET", re.compile(r"^/deltas/(?P<tenant>[^/]+)/(?P<doc>[^/?]+)$"),
+         "_r_deltas"),
+        ("POST", re.compile(r"^/tenants/(?P<tenant>[^/]+)/validate$"),
+         "_r_validate"),
+        ("GET", re.compile(r"^/tenants/(?P<tenant>[^/]+)/key$"), "_r_key"),
+        ("POST", re.compile(r"^/tenants/(?P<tenant>[^/]+)$"), "_r_create_tenant"),
+        ("POST", re.compile(
+            r"^/repos/(?P<tenant>[^/]+)/(?P<doc>[^/]+)/summaries$"),
+         "_r_upload_summary"),
+        ("GET", re.compile(
+            r"^/repos/(?P<tenant>[^/]+)/(?P<doc>[^/]+)/summaries/latest$"),
+         "_r_latest_summary"),
+        ("GET", re.compile(
+            r"^/repos/(?P<tenant>[^/]+)/(?P<doc>[^/]+)/versions$"),
+         "_r_versions"),
+        ("GET", re.compile(
+            r"^/repos/(?P<tenant>[^/]+)/(?P<doc>[^/]+)/git/commits$"),
+         "_r_commits"),
+    ]
+
+    def _handle_rest(self, handler, method: str) -> None:
+        path, _, query = handler.path.partition("?")
+        params = _parse_query(query)
+        for route_method, pattern, name in self._ROUTES:
+            if route_method != method:
+                continue
+            m = pattern.match(path)
+            if m:
+                try:
+                    getattr(self, name)(handler, params, **m.groupdict())
+                except BrokenPipeError:
+                    pass
+                except Exception as exc:  # route bug -> 500, keep serving
+                    try:
+                        _send_json(handler, 500, {"error": repr(exc)})
+                    except Exception:
+                        pass
+                return
+        _send_json(handler, 404, {"error": f"no route {method} {path}"})
+
+    def _r_ping(self, handler, params) -> None:
+        _send_json(handler, 200, {"ok": True})
+
+    def _check_admin(self, handler) -> bool:
+        """Operator gate for riddler routes. Sends the error response when
+        rejecting."""
+        if not self.require_auth:
+            return True
+        supplied = handler.headers.get("X-Admin-Key", "")
+        if hmac.compare_digest(supplied, self.admin_key):
+            return True
+        _send_json(handler, 403, {"error": "admin key required"})
+        return False
+
+    def _r_create_tenant(self, handler, params, tenant: str) -> None:
+        if not self._check_admin(handler):
+            return
+        body = _read_json(handler) or {}
+        try:
+            t = self.tenants.create_tenant(tenant, key=body.get("key"))
+        except ValueError as exc:
+            _send_json(handler, 409, {"error": str(exc)})
+            return
+        _send_json(handler, 201, {"id": t.id, "key": t.key})
+
+    def _r_key(self, handler, params, tenant: str) -> None:
+        if not self._check_admin(handler):
+            return
+        try:
+            key = self.tenants.get_key(tenant)
+        except AuthError as exc:
+            _send_json(handler, 404, {"error": str(exc)})
+            return
+        _send_json(handler, 200, {"key": key})
+
+    def _r_validate(self, handler, params, tenant: str) -> None:
+        body = _read_json(handler) or {}
+        try:
+            claims = self.tenants.validate_token(tenant, body.get("token", ""))
+        except AuthError as exc:
+            _send_json(handler, 403, {"error": str(exc)})
+            return
+        _send_json(handler, 200, {"claims": claims})
+
+    def _r_create_doc(self, handler, params, tenant: str) -> None:
+        body = _read_json(handler) or {}
+        doc_id = body.get("id") or f"doc-{uuid.uuid4().hex[:12]}"
+        # The token must be bound to the document being created (or be a
+        # wildcard token) — a docA-scoped token must not create/overwrite
+        # docB's attach summary.
+        claims = self._check_auth(handler, tenant, doc_id, "doc:write")
+        if claims is None:
+            return
+        core = self.core(tenant)
+        if body.get("summary") is not None:
+            # Attach-with-summary: the initial summary becomes the load
+            # target immediately (no scribe ack needed for attach).
+            tree = summary_tree_from_dict(body["summary"])
+            core.storage(doc_id).write_summary(tree, message="attach",
+                                               advance_ref=True)
+        _send_json(handler, 201, {"id": doc_id})
+
+    def _r_deltas(self, handler, params, tenant: str, doc: str) -> None:
+        claims = self._check_auth(handler, tenant, doc, "doc:read")
+        if claims is None:
+            return
+        from_seq = int(params.get("from", 0))
+        to_seq = int(params["to"]) if "to" in params else None
+        rows = self.core(tenant).get_deltas(doc, from_seq, to_seq)
+        _send_json(handler, 200, {"deltas": rows})
+
+    def _r_upload_summary(self, handler, params, tenant: str,
+                          doc: str) -> None:
+        claims = self._check_auth(handler, tenant, doc, "summary:write")
+        if claims is None:
+            return
+        body = _read_json(handler) or {}
+        tree = summary_tree_from_dict(body["summary"])
+        sha = self.core(tenant).storage(doc).write_summary(
+            tree, base_commit=body.get("parent"),
+            advance_ref=bool(body.get("initial")))
+        _send_json(handler, 201, {"sha": sha})
+
+    def _r_latest_summary(self, handler, params, tenant: str,
+                          doc: str) -> None:
+        claims = self._check_auth(handler, tenant, doc, "doc:read")
+        if claims is None:
+            return
+        core = self.core(tenant)
+        tree = core.historian.read_summary(tenant, doc,
+                                           commit_sha=params.get("sha"))
+        if tree is None:
+            _send_json(handler, 404, {"error": "no summary"})
+            return
+        _send_json(handler, 200, {"summary": summary_tree_to_dict(tree)})
+
+    def _r_versions(self, handler, params, tenant: str, doc: str) -> None:
+        claims = self._check_auth(handler, tenant, doc, "doc:read")
+        if claims is None:
+            return
+        count = int(params.get("count", 1))
+        shas = [c.sha for c in
+                self.core(tenant).storage(doc).list_commits(limit=count)]
+        _send_json(handler, 200, {"versions": shas})
+
+    def _r_commits(self, handler, params, tenant: str, doc: str) -> None:
+        claims = self._check_auth(handler, tenant, doc, "doc:read")
+        if claims is None:
+            return
+        count = int(params.get("count", 10))
+        commits = self.core(tenant).storage(doc).list_commits(limit=count)
+        _send_json(handler, 200, {"commits": [
+            {"sha": c.sha, "tree": c.tree_sha, "parents": c.parents,
+             "message": c.message, "timestamp": c.timestamp}
+            for c in commits]})
+
+    # -- websocket delta stream -------------------------------------------
+    def _handle_websocket(self, handler) -> None:
+        key = handler.headers.get("Sec-WebSocket-Key")
+        if not key:
+            _send_json(handler, 400, {"error": "bad upgrade"})
+            return
+        handler.wfile.flush()
+        ws = upgrade_server_socket(handler.connection, key)
+        conn = None
+        try:
+            # First message must be connect_document.
+            hello = json.loads(ws.recv())
+            if hello.get("type") != "connect_document":
+                ws.send_text(json.dumps(
+                    {"type": "error", "error": "expected connect_document"}))
+                return
+            tenant_id = hello.get("tenantId", "")
+            document_id = hello.get("documentId", "")
+            err = self._validate_ws_token(tenant_id, document_id,
+                                          hello.get("token"))
+            if err is not None:
+                ws.send_text(json.dumps({"type": "error", "error": err}))
+                return
+            core = self.core(tenant_id)
+            conn = core.connect(document_id, hello.get("client"))
+
+            def on_op(msg, ws=ws):
+                try:
+                    ws.send_text(json.dumps(
+                        {"type": "op",
+                         "message": sequenced_message_to_dict(msg)}))
+                except (OSError, WebSocketClosed):
+                    pass  # reader loop will notice the dead socket
+
+            def on_nack(nack, ws=ws):
+                try:
+                    ws.send_text(json.dumps(
+                        {"type": "nack", "nack": nack_to_dict(nack)}))
+                except (OSError, WebSocketClosed):
+                    pass
+
+            conn.on("op", on_op)
+            conn.on("nack", on_nack)
+            ws.send_text(json.dumps({
+                "type": "connected",
+                "clientId": conn.client_id,
+                "sequenceNumber": core.sequence_number(document_id),
+            }))
+            while True:
+                msg = json.loads(ws.recv())
+                mtype = msg.get("type")
+                if mtype == "submitOp":
+                    conn.submit([document_message_from_dict(d)
+                                 for d in msg.get("messages", [])])
+                elif mtype == "disconnect":
+                    break
+                else:
+                    ws.send_text(json.dumps(
+                        {"type": "error",
+                         "error": f"unknown message {mtype!r}"}))
+        except (WebSocketClosed, OSError, json.JSONDecodeError):
+            pass
+        finally:
+            if conn is not None:
+                conn.disconnect()
+            ws.close()
+
+
+def _send_json(handler, status: int, payload: dict) -> None:
+    body = json.dumps(payload).encode()
+    handler.send_response(status)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
+def _read_json(handler) -> Optional[dict]:
+    length = int(handler.headers.get("Content-Length", 0))
+    if not length:
+        return None
+    return json.loads(handler.rfile.read(length))
+
+
+def _parse_query(query: str) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for part in query.split("&"):
+        if part:
+            name, _, value = part.partition("=")
+            out[name] = value
+    return out
