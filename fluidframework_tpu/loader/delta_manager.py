@@ -136,17 +136,20 @@ class DeltaManager(TypedEventEmitter):
                         self._deliver(msg)
                 if gap is not None:
                     fetched = self.delta_storage.get(*gap)  # lock released
-                    if not fetched:
-                        return  # gap not yet durable; wait for more ops
                     with self.lock:
                         self._inbound = fetched + self._inbound
             finally:
                 self._processing = False
             with self.lock:
-                # Messages enqueued by another thread between our final
-                # drain and clearing _processing would otherwise be
-                # stranded until the next delivery.
-                if gap is None and not self._inbound:
+                # Another thread may have enqueued while we were fetching /
+                # finishing the drain (its _process_inbound no-oped on the
+                # _processing flag). Go around again only if the queue now
+                # has something deliverable; an unfillable gap waits for the
+                # next arrival instead of spinning.
+                if not self._inbound:
+                    return
+                head = min(m.sequence_number for m in self._inbound)
+                if head > self.last_sequence_number + 1:
                     return
 
     def _deliver(self, msg: SequencedDocumentMessage) -> None:

@@ -13,8 +13,9 @@ from __future__ import annotations
 
 import json
 import threading
-import urllib.request
 import urllib.error
+import urllib.parse
+import urllib.request
 from typing import Callable, List, Optional
 
 from ...core.events import TypedEventEmitter
@@ -40,6 +41,12 @@ from .base import (
 )
 
 TokenProvider = Callable[[str, str], str]  # (tenant_id, document_id) -> jwt
+
+
+def _q(segment: str) -> str:
+    """Percent-encode a caller-supplied id for use as one URL path/query
+    segment (ids may contain spaces, '#', '%', ...)."""
+    return urllib.parse.quote(str(segment), safe="")
 
 
 class RestWrapper:
@@ -94,13 +101,13 @@ class NetworkDocumentStorageService(IDocumentStorageService):
         self._rest = rest_factory
         self.tenant_id = tenant_id
         self.document_id = document_id
-        self._repo = f"/repos/{tenant_id}/{document_id}"
+        self._repo = f"/repos/{_q(tenant_id)}/{_q(document_id)}"
 
     def get_summary(self, version: Optional[str] = None
                     ) -> Optional[SummaryTree]:
         path = self._repo + "/summaries/latest"
         if version:
-            path += f"?sha={version}"
+            path += f"?sha={_q(version)}"
         try:
             data = self._rest().get(path)
         except RestError as exc:
@@ -129,7 +136,7 @@ class NetworkDeltaStorageService(IDocumentDeltaStorageService):
     def __init__(self, rest_factory: RestFactory, tenant_id: str,
                  document_id: str):
         self._rest = rest_factory
-        self.path = f"/deltas/{tenant_id}/{document_id}"
+        self.path = f"/deltas/{_q(tenant_id)}/{_q(document_id)}"
 
     def get(self, from_seq: int, to_seq: Optional[int] = None
             ) -> List[SequencedDocumentMessage]:
@@ -157,8 +164,18 @@ class NetworkDocumentDeltaConnection(TypedEventEmitter,
             "token": token,
             "client": client_details or {},
         }))
-        hello = json.loads(self._ws.recv())
-        if hello.get("type") != "connected":
+        # The server registers broadcast listeners before sending
+        # "connected", so a busy document can push op frames ahead of the
+        # handshake reply. Skip them — they are already durable (the server
+        # persists before broadcasting) and the post-connect catch-up fetch
+        # replays them in order.
+        while True:
+            hello = json.loads(self._ws.recv())
+            htype = hello.get("type")
+            if htype == "connected":
+                break
+            if htype in ("op", "nack"):
+                continue
             self._ws.close()
             raise ConnectionError(
                 f"connect_document rejected: {hello.get('error', hello)}")
@@ -274,4 +291,4 @@ class NetworkDocumentServiceFactory(IDocumentServiceFactory):
         if summary is not None:
             body["summary"] = summary_tree_to_dict(summary)
         rest = RestWrapper(self.base_url, token)
-        return rest.post(f"/documents/{self.tenant_id}", body)["id"]
+        return rest.post(f"/documents/{_q(self.tenant_id)}", body)["id"]

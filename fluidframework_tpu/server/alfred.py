@@ -39,6 +39,7 @@ import hmac
 import json
 import re
 import threading
+import urllib.parse
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
@@ -255,11 +256,17 @@ class AlfredService:
             return
         core = self.core(tenant)
         if body.get("summary") is not None:
+            store = core.storage(doc_id)
+            if store.get_ref("main") is not None:
+                # The document already has a load target; repointing it at
+                # a fresh attach summary would orphan the existing history.
+                _send_json(handler, 409,
+                           {"error": f"document {doc_id!r} exists"})
+                return
             # Attach-with-summary: the initial summary becomes the load
             # target immediately (no scribe ack needed for attach).
             tree = summary_tree_from_dict(body["summary"])
-            core.storage(doc_id).write_summary(tree, message="attach",
-                                               advance_ref=True)
+            store.write_summary(tree, message="attach", advance_ref=True)
         _send_json(handler, 201, {"id": doc_id})
 
     def _r_deltas(self, handler, params, tenant: str, doc: str) -> None:
@@ -278,9 +285,16 @@ class AlfredService:
             return
         body = _read_json(handler) or {}
         tree = summary_tree_from_dict(body["summary"])
-        sha = self.core(tenant).storage(doc).write_summary(
-            tree, base_commit=body.get("parent"),
-            advance_ref=bool(body.get("initial")))
+        store = self.core(tenant).storage(doc)
+        initial = bool(body.get("initial"))
+        if initial and store.get_ref("main") is not None:
+            # Same guard as create: only the attach of a NEW document may
+            # set the load target directly; later summaries are proposals
+            # that scribe acks (advance_ref stays False for them).
+            _send_json(handler, 409, {"error": f"document {doc!r} exists"})
+            return
+        sha = store.write_summary(tree, base_commit=body.get("parent"),
+                                  advance_ref=initial)
         _send_json(handler, 201, {"sha": sha})
 
     def _r_latest_summary(self, handler, params, tenant: str,
@@ -401,9 +415,5 @@ def _read_json(handler) -> Optional[dict]:
 
 
 def _parse_query(query: str) -> Dict[str, str]:
-    out: Dict[str, str] = {}
-    for part in query.split("&"):
-        if part:
-            name, _, value = part.partition("=")
-            out[name] = value
-    return out
+    return {name: values[-1]
+            for name, values in urllib.parse.parse_qs(query).items()}

@@ -69,11 +69,18 @@ class DeliLambda(IPartitionLambda):
     def __init__(self, context: LambdaContext,
                  emit: Callable[[str, SequencedDocumentMessage], None],
                  nack: Callable[[str, str, Nack], None],
-                 checkpoints=None):
+                 checkpoints=None, fresh_log: bool = False):
         """emit(document_id, sequenced_message); nack(document_id,
         client_id, nack). checkpoints: optional Collection for state dumps —
         restored at construction so a crash-restarted lambda resumes from
-        its last checkpoint instead of re-sequencing from zero."""
+        its last checkpoint instead of re-sequencing from zero.
+
+        fresh_log=True when this lambda consumes a brand-new MessageLog
+        (multi-node takeover hands over checkpointed deli state, not the
+        log): checkpointed offsets index the previous core's log, so replay
+        protection must not skip the new log's messages. False (default) is
+        the same-log crash-restart, where the checkpointed offset is the
+        replay guard."""
         self.context = context
         self.emit = emit
         self.nack = nack
@@ -81,7 +88,10 @@ class DeliLambda(IPartitionLambda):
         self.checkpoints = checkpoints
         if checkpoints is not None:
             for row in checkpoints.find(lambda d: "documentId" in d):
-                self.docs[row["documentId"]] = self.load_state(row["state"])
+                state = self.load_state(row["state"])
+                if fresh_log:
+                    state.log_offset = -1
+                self.docs[row["documentId"]] = state
 
     # -- lambda ------------------------------------------------------------
     def handler(self, message: QueuedMessage) -> None:

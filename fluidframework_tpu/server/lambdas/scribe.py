@@ -25,9 +25,12 @@ class ScribeLambda(IPartitionLambda):
     def __init__(self, context: LambdaContext, historian: Historian,
                  tenant_id: str,
                  send_system: Callable[[str, DocumentMessage], None],
-                 checkpoints: Optional[Collection] = None):
+                 checkpoints: Optional[Collection] = None,
+                 fresh_log: bool = False):
         """send_system(document_id, message) routes summaryAck/Nack back
-        through deli for sequencing."""
+        through deli for sequencing. fresh_log: see DeliLambda — True when
+        consuming a new MessageLog with checkpoints handed over from a
+        previous core (takeover), False for same-log crash-restart."""
         self.context = context
         self.historian = historian
         self.tenant_id = tenant_id
@@ -40,6 +43,8 @@ class ScribeLambda(IPartitionLambda):
             # its checkpoint (duplicate sequenced ops replay as no-ops).
             for row in checkpoints.find(lambda d: "documentId" in d):
                 self.load_checkpoint(row["documentId"], row)
+                if fresh_log:
+                    self.log_offsets[row["documentId"]] = -1
 
     def handler(self, message: QueuedMessage) -> None:
         doc_id, sequenced = message.value

@@ -31,3 +31,15 @@ class ScriptoriumLambda(IPartitionLambda):
 
 def delta_key(doc: dict):
     return (doc["documentId"], doc["sequence_number"])
+
+
+def query_deltas(deltas: Collection, document_id: str, from_seq: int = 0,
+                 to_seq=None) -> List[dict]:
+    """Catch-up range query over the delta store: rows with
+    from_seq < seq <= to_seq, ordered (alfred's delta REST semantics)."""
+    hi = to_seq if to_seq is not None else 2 ** 62
+    out = deltas.find(
+        lambda d: d["documentId"] == document_id
+        and from_seq < d["sequence_number"] <= hi)
+    out.sort(key=lambda d: d["sequence_number"])
+    return out

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 from ..core.events import TypedEventEmitter
@@ -37,7 +38,7 @@ from .lambdas import (
     ScribeLambda,
     ScriptoriumLambda,
 )
-from .lambdas.scriptorium import delta_key
+from .lambdas.scriptorium import delta_key, query_deltas
 from .log import MessageLog, make_message_log
 from .partition import LambdaRunner, PartitionManager
 from .storage import Historian
@@ -81,15 +82,22 @@ class LocalServer:
 
     def __init__(self, tenant_id: str = "local", partitions: int = 1,
                  auto_pump: bool = True,
-                 native_log: Optional[bool] = False):
+                 native_log: Optional[bool] = False,
+                 db: Optional[DatabaseManager] = None,
+                 historian: Optional[Historian] = None):
         """native_log: False = pure-Python broker (default, the LocalKafka
-        role); True = the C++ engine (requires the toolchain); None = auto."""
+        role); True = the C++ engine (requires the toolchain); None = auto.
+
+        db/historian: pass shared instances to make this core one node of a
+        cluster over common durable services (the reference's Mongo + git);
+        deli/scribe then resume from any checkpoints already present —
+        the multi-node takeover path (server/nodes.py)."""
         self.tenant_id = tenant_id
         self.auto_pump = auto_pump
         self.log = make_message_log(default_partitions=partitions,
                                     native=native_log)
-        self.db = DatabaseManager()
-        self.historian = Historian()
+        self.db = db if db is not None else DatabaseManager()
+        self.historian = historian if historian is not None else Historian()
         self.deltas = self.db.collection("deltas", unique_key=delta_key)
         self.raw_deltas = self.db.collection("rawdeltas")
         self.deli_checkpoints = self.db.collection("deliCheckpoints")
@@ -100,6 +108,10 @@ class LocalServer:
         self._rooms: Dict[str, List] = {}
         self._client_counter = itertools.count(1)
         self._pump_lock = threading.RLock()
+        # Optional pre-pump gate (multi-node fencing): called before the
+        # lambdas run; returning False aborts the pump — the node lost its
+        # reservation and must not sequence another op (server/nodes.py).
+        self.pump_gate: Optional[Callable[[], bool]] = None
 
         # Ensure topics exist before wiring consumers.
         self.log.topic(RAW_TOPIC)
@@ -110,7 +122,8 @@ class LocalServer:
             self.log, "deli", RAW_TOPIC,
             lambda ctx: DeliLambda(ctx, emit=self._emit_sequenced,
                                    nack=self._emit_nack,
-                                   checkpoints=self.deli_checkpoints)))
+                                   checkpoints=self.deli_checkpoints,
+                                   fresh_log=True)))
         self._copier_mgr = self.runner.add(PartitionManager(
             self.log, "copier", RAW_TOPIC,
             lambda ctx: CopierLambda(ctx, self.raw_deltas)))
@@ -121,7 +134,8 @@ class LocalServer:
             self.log, "scribe", DELTAS_TOPIC,
             lambda ctx: ScribeLambda(ctx, self.historian, tenant_id,
                                      send_system=self._send_system,
-                                     checkpoints=self.scribe_checkpoints)))
+                                     checkpoints=self.scribe_checkpoints,
+                                     fresh_log=True)))
         self._broadcaster_mgr = self.runner.add(PartitionManager(
             self.log, "broadcaster", DELTAS_TOPIC,
             lambda ctx: BroadcasterLambda(ctx, rooms=self._rooms)))
@@ -149,7 +163,12 @@ class LocalServer:
     # -- the Alfred surface (connect/disconnect, catch-up, storage) --------
     def connect(self, document_id: str,
                 details: Optional[dict] = None) -> Connection:
-        client_id = f"client-{next(self._client_counter)}"
+        # Globally unique id, not a per-core counter: after a multi-node
+        # takeover a new core must never reissue an id that appears in the
+        # document's history (a late loader would mistake those historical
+        # ops for its own and corrupt pending-state/merge-tree visibility).
+        client_id = (f"client-{next(self._client_counter)}-"
+                     f"{uuid.uuid4().hex[:8]}")
         conn = Connection(self, self.tenant_id, document_id, client_id,
                           details)
         self._connections.setdefault(document_id, []).append(conn)
@@ -187,12 +206,7 @@ class LocalServer:
                    to_seq: Optional[int] = None) -> List[dict]:
         """Catch-up range query (alfred delta REST API over the scriptorium
         collection): ops with from_seq < seq <= to_seq, ordered."""
-        hi = to_seq if to_seq is not None else 2**62
-        out = self.deltas.find(
-            lambda d: d["documentId"] == document_id
-            and from_seq < d["sequence_number"] <= hi)
-        out.sort(key=lambda d: d["sequence_number"])
-        return out
+        return query_deltas(self.deltas, document_id, from_seq, to_seq)
 
     def storage(self, document_id: str):
         return self.historian.store(self.tenant_id, document_id)
@@ -200,6 +214,8 @@ class LocalServer:
     def pump(self) -> int:
         """Drive every lambda stage to quiescence (synchronous pipeline)."""
         with self._pump_lock:
+            if self.pump_gate is not None and not self.pump_gate():
+                return 0
             return self.runner.pump()
 
     # -- introspection ----------------------------------------------------
