@@ -261,11 +261,13 @@ class SharedSegmentSequence(SharedObject):
         """Chunked snapshot: header with collab window + body chunks of
         bounded size (reference snapshotV1.ts chunking, chunkSize=10000)."""
         snap = self.client.snapshot()
-        segments = snap["segments"]
+        segments = self._encode_snapshot_segments(snap["segments"])
         chunks: List[List[dict]] = [[]]
         size = 0
         for seg in segments:
-            seg_size = len(seg.get("text", "")) + 1
+            payload = seg.get("text", "")
+            seg_size = (len(payload) if isinstance(payload, str)
+                        else len(json.dumps(payload))) + 1
             if size + seg_size > SNAPSHOT_CHUNK_SIZE and chunks[-1]:
                 chunks.append([])
                 size = 0
@@ -293,11 +295,19 @@ class SharedSegmentSequence(SharedObject):
             tree.add_blob("intervals", json.dumps(payload))
         return tree
 
+    def _encode_snapshot_segments(self, segments: List[dict]) -> List[dict]:
+        """Hook: make segment payloads JSON-safe (item sequences override)."""
+        return segments
+
+    def _decode_snapshot_segments(self, segments: List[dict]) -> List[dict]:
+        return segments
+
     def load_core(self, tree: SummaryTree) -> None:
         header = json.loads(tree.entries["header"].content)
         segments: List[dict] = []
         for i in range(header["chunkCount"]):
             segments.extend(json.loads(tree.entries[f"body_{i}"].content))
+        segments = self._decode_snapshot_segments(segments)
         self.client = MergeTreeClient.load(
             {"segments": segments, "seq": header["seq"],
              "minSeq": header["minSeq"]},
@@ -311,6 +321,71 @@ class SharedSegmentSequence(SharedObject):
                 for entry in entries:
                     coll._attach(entry["intervalId"], entry["start"],
                                  entry["end"], entry.get("properties"))
+
+
+class SharedItemsSequence(SharedSegmentSequence):
+    """Sequence of JSON values over the merge-tree engine (reference
+    sequence/src/sharedSequence.ts SharedSequence<T>: insert :64,
+    remove :45, getItems :90 over SubSequence segments)."""
+
+    def insert_range(self, pos: int, values, props: Optional[dict] = None
+                     ) -> None:
+        values = list(values)  # one-shot iterables are consumed repeatedly
+        if not values:
+            return
+        self.submit_local_message(
+            self.client.insert_items_local(pos, values, props))
+
+    def remove_range(self, start: int, end: int) -> None:
+        self.submit_local_message(self.client.remove_range_local(start, end))
+
+    def annotate_range(self, start: int, end: int, props: dict) -> None:
+        self.submit_local_message(
+            self.client.annotate_range_local(start, end, props))
+
+    def get_item_count(self) -> int:
+        return self.get_length()
+
+    def get_items(self, start: int = 0, end: Optional[int] = None) -> list:
+        from ..mergetree.oracle import Items
+        tree = self.client.tree
+        out: list = []
+        for seg in tree.segments:
+            if tree.visible_length(seg, tree.current_seq,
+                                   self.client.client_id) > 0:
+                if isinstance(seg.text, Items):
+                    out.extend(seg.text.values)
+        return out[start:end]
+
+    # Items payloads are not JSON until wrapped (snapshot wire shape
+    # mirrors matrix.py's Run encoding: {"items": [...]}).
+    def _encode_snapshot_segments(self, segments: List[dict]) -> List[dict]:
+        from ..mergetree.oracle import Items
+        for entry in segments:
+            if isinstance(entry.get("text"), Items):
+                entry["text"] = {"items": entry["text"].encode()}
+        return segments
+
+    def _decode_snapshot_segments(self, segments: List[dict]) -> List[dict]:
+        from ..mergetree.oracle import Items
+        for entry in segments:
+            text = entry.get("text")
+            if isinstance(text, dict) and "items" in text:
+                entry["text"] = Items(text["items"])
+        return segments
+
+
+class SharedNumberSequence(SharedItemsSequence):
+    """Reference sequence/src/sharedNumberSequence.ts: sequence of numbers."""
+
+    TYPE = "https://graph.microsoft.com/types/mergeTree/numberSequence"
+
+
+class SharedObjectSequence(SharedItemsSequence):
+    """Reference sequence/src/sharedObjectSequence.ts: sequence of
+    serializable values."""
+
+    TYPE = "https://graph.microsoft.com/types/mergeTree/objectSequence"
 
 
 class SharedString(SharedSegmentSequence):

@@ -21,7 +21,7 @@ from typing import Any, Dict, List, Optional
 
 from ..core.events import TypedEventEmitter
 from .constants import SEG_MARKER, SEG_TEXT, UNASSIGNED_SEQ
-from .oracle import MergeTreeOracle, Segment
+from .oracle import Items, MergeTreeOracle, Segment
 
 # MergeTreeDeltaType (reference ops.ts:29)
 OP_INSERT = 0
@@ -52,6 +52,13 @@ def text_seg(text: str, props: Optional[dict] = None) -> dict:
 
 def marker_seg(props: Optional[dict] = None) -> dict:
     seg: Dict[str, Any] = {"marker": True}
+    if props:
+        seg["props"] = props
+    return seg
+
+
+def items_seg(values, props: Optional[dict] = None) -> dict:
+    seg: Dict[str, Any] = {"items": list(values)}
     if props:
         seg["props"] = props
     return seg
@@ -90,6 +97,14 @@ class MergeTreeClient(TypedEventEmitter):
                                 UNASSIGNED_SEQ, props=props)
         self.emit("delta", {"op": "insertMarker", "pos": pos}, True)
         return make_insert_op(pos, marker_seg(props))
+
+    def insert_items_local(self, pos: int, values,
+                           props: Optional[dict] = None) -> dict:
+        self.tree.insert_items(pos, values, self.tree.current_seq,
+                               self.client_id, UNASSIGNED_SEQ, props=props)
+        self.emit("delta", {"op": "insert", "pos": pos,
+                            "items": list(values)}, True)
+        return make_insert_op(pos, items_seg(values, props))
 
     def remove_range_local(self, start: int, end: int) -> dict:
         # Capture removed content before applying so undo can reinsert it
@@ -137,6 +152,9 @@ class MergeTreeClient(TypedEventEmitter):
             if seg.get("marker"):
                 self.tree.insert_marker(op["pos1"], ref_seq, client, seq,
                                         props=seg.get("props"))
+            elif "items" in seg:
+                self.tree.insert_items(op["pos1"], seg["items"], ref_seq,
+                                       client, seq, props=seg.get("props"))
             else:
                 self.tree.insert_text(op["pos1"], seg["text"], ref_seq, client,
                                       seq, props=seg.get("props"))
@@ -203,6 +221,9 @@ class MergeTreeClient(TypedEventEmitter):
                         ("insert", [seg], {"local_seq": new_local}))
                     if seg.kind == SEG_MARKER:
                         new_ops.append(make_insert_op(pos, marker_seg(seg.props)))
+                    elif isinstance(seg.text, Items):
+                        new_ops.append(make_insert_op(
+                            pos, items_seg(seg.text.values, seg.props)))
                     else:
                         new_ops.append(make_insert_op(
                             pos, text_seg(seg.text, seg.props)))

@@ -82,6 +82,35 @@ class Segment:
         )
 
 
+class Items:
+    """A sliceable run of JSON values: the segment payload for the
+    item-sequence DDSes (reference sequence/src/sharedSequence.ts
+    SubSequence<T> — SharedNumberSequence / SharedObjectSequence carry
+    arrays of values instead of text)."""
+
+    __slots__ = ("values",)
+
+    def __init__(self, values):
+        self.values = tuple(values)
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    def __getitem__(self, key):
+        if isinstance(key, slice):
+            return Items(self.values[key])
+        return self.values[key]
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Items) and self.values == other.values
+
+    def __repr__(self) -> str:
+        return f"Items({list(self.values)!r})"
+
+    def encode(self) -> list:
+        return list(self.values)
+
+
 # Reference-type flags (reference merge-tree/src/ops.ts ReferenceType).
 REF_SIMPLE = 0
 REF_SLIDE_ON_REMOVE = 1
@@ -302,6 +331,12 @@ class MergeTreeOracle:
     def insert_marker(self, pos: int, ref_seq: int, client: int, seq: int,
                       props: Optional[dict] = None) -> Segment:
         seg = Segment(kind=SEG_MARKER, props=dict(props) if props else None)
+        return self.insert(pos, seg, ref_seq, client, seq)
+
+    def insert_items(self, pos: int, values, ref_seq: int, client: int,
+                     seq: int, props: Optional[dict] = None) -> Segment:
+        seg = Segment(kind=SEG_TEXT, text=Items(values),
+                      props=dict(props) if props else None)
         return self.insert(pos, seg, ref_seq, client, seq)
 
     # ------------------------------------------------------------------

@@ -40,7 +40,8 @@ class ChannelRegistry:
 
 def default_registry() -> ChannelRegistry:
     from ..dds.map import SharedMap
-    from ..dds.sequence import SharedString, SharedSegmentSequence
+    from ..dds.sequence import (SharedString, SharedSegmentSequence,
+                                SharedNumberSequence, SharedObjectSequence)
     from ..dds.counter import SharedCounter
     from ..dds.cell import SharedCell
     from ..dds.directory import SharedDirectory
@@ -54,7 +55,7 @@ def default_registry() -> ChannelRegistry:
     for cls in (SharedMap, SharedString, SharedSegmentSequence, SharedCounter,
                 SharedCell, SharedDirectory, ConsensusRegisterCollection,
                 ConsensusQueue, SharedMatrix, Ink, SharedSummaryBlock,
-                SparseMatrix):
+                SparseMatrix, SharedNumberSequence, SharedObjectSequence):
         reg.register(cls)
     return reg
 

@@ -189,8 +189,12 @@ class AlfredService:
                 continue
             m = pattern.match(path)
             if m:
+                # Path params arrive percent-encoded (the driver encodes
+                # ids); decode so REST and websocket paths key identically.
+                groups = {k: urllib.parse.unquote(v)
+                          for k, v in m.groupdict().items()}
                 try:
-                    getattr(self, name)(handler, params, **m.groupdict())
+                    getattr(self, name)(handler, params, **groups)
                 except BrokenPipeError:
                     pass
                 except Exception as exc:  # route bug -> 500, keep serving

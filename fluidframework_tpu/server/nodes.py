@@ -154,6 +154,7 @@ class OrdererNode:
         self.cluster = cluster
         self.node_id = node_id
         self.cores: Dict[str, LocalServer] = {}
+        self.proxies: List[ProxyConnection] = []
         self.running = True
         self._lock = threading.RLock()
         cluster.node_manager.register(node_id)
@@ -235,7 +236,10 @@ class OrdererNode:
             return self._own_core(document_id).connect(document_id, details)
         peer = self.cluster.node(owner)
         remote = peer._own_core(document_id).connect(document_id, details)
-        return ProxyConnection(remote, via_node=self.node_id)
+        proxy = ProxyConnection(remote, via_node=self.node_id)
+        with self._lock:
+            self.proxies.append(proxy)
+        return proxy
 
     def get_deltas(self, document_id: str, from_seq: int = 0,
                    to_seq: Optional[int] = None) -> List[dict]:
@@ -259,6 +263,13 @@ class OrdererNode:
                     conn.connected = False
                     conn.emit("disconnect")
             self.cores.clear()
+            # Clients that entered through this node as a proxy lose their
+            # path too: sever at the owner's end so 'disconnect' fires and
+            # ProxyConnection.connected goes False.
+            proxies, self.proxies = self.proxies, []
+        for proxy in proxies:
+            if proxy.remote.connected:
+                proxy.remote.disconnect()
         self.cluster.node_manager.mark_dead(self.node_id)
 
 
