@@ -1,5 +1,6 @@
 """Shared collections + utilities (reference common-utils capability parity)."""
 
 from .collections import Heap, RangeTracker, RedBlackTree, IntervalTree
+from .config import ConfigProvider
 from .events import TypedEventEmitter
 from .trace import Trace

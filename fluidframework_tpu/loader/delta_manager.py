@@ -15,6 +15,7 @@ from typing import Callable, List, Optional
 from ..core.events import TypedEventEmitter
 from ..protocol.messages import DocumentMessage, SequencedDocumentMessage
 from ..telemetry import ChildLogger, OpRoundTripTelemetry, TelemetryLogger
+from .delta_scheduler import DeltaScheduler
 from .drivers.base import IDocumentService
 
 
@@ -24,10 +25,12 @@ class DeltaManager(TypedEventEmitter):
 
     def __init__(self, service: IDocumentService,
                  client_details: Optional[dict] = None,
-                 logger: Optional[TelemetryLogger] = None):
+                 logger: Optional[TelemetryLogger] = None,
+                 scheduler: Optional[DeltaScheduler] = None):
         super().__init__()
         self.service = service
         self.client_details = client_details or {}
+        self.scheduler = scheduler or DeltaScheduler()
         self.delta_storage = service.connect_to_delta_storage()
         self.connection = None
         self.client_id: Optional[str] = None
@@ -120,6 +123,7 @@ class DeltaManager(TypedEventEmitter):
                     return  # re-entrant deliveries drain in the outer loop
                 self._processing = True
             gap: Optional[tuple] = None
+            yielding = False
             try:
                 with self.lock:
                     while self._inbound:
@@ -133,7 +137,16 @@ class DeltaManager(TypedEventEmitter):
                                    msg.sequence_number - 1)
                             break
                         self._inbound.pop(0)
+                        self.scheduler.op_started()
                         self._deliver(msg)
+                        self.scheduler.op_processed()
+                        if self.scheduler.should_yield():
+                            yielding = True
+                            break
+                    else:
+                        self.scheduler.drain_done()
+                if yielding:
+                    self.scheduler.on_yield()  # lock released
                 if gap is not None:
                     fetched = self.delta_storage.get(*gap)  # lock released
                     with self.lock:

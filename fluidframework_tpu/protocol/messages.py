@@ -33,6 +33,7 @@ class MessageType:
     SUMMARY_ACK = "summaryAck"
     SUMMARY_NACK = "summaryNack"
     OPERATION = "op"
+    CHUNKED_OP = "chunkedOp"
     SAVE = "saveOp"
     NO_CLIENT = "noClient"
     REMOTE_HELP = "remoteHelp"

@@ -32,11 +32,24 @@ from .summarizer import GCResult, run_garbage_collection
 
 
 class ContainerRuntime(TypedEventEmitter):
+    # Ops whose serialized contents exceed this split into CHUNKED_OP
+    # messages (reference containerRuntime.ts:1444 submitChunkedMessage /
+    # :1557 processRemoteChunkedMessage; IContainerRuntimeOptions
+    # maxOpSizeInBytes). Overridable via options={"maxOpSize": n}.
+    DEFAULT_MAX_OP_SIZE = 768 * 1024
+
     def __init__(self, submit_fn: Optional[Callable[[str, Any], int]] = None,
-                 registry: Optional[ChannelRegistry] = None):
+                 registry: Optional[ChannelRegistry] = None,
+                 options: Optional[Dict[str, Any]] = None):
         super().__init__()
         self._submit_fn = submit_fn  # (type, contents) -> client_seq_number
         self.registry = registry
+        self.options = dict(options or {})
+        self.max_op_size = int(self.options.get(
+            "maxOpSize", self.DEFAULT_MAX_OP_SIZE))
+        # Partial chunked-op reassembly per sending client id
+        # (reference chunkMap, containerRuntime.ts:1557).
+        self._chunk_buffers: Dict[str, List[str]] = {}
         self.datastores: Dict[str, DataStoreRuntime] = {}
         self.pending = PendingStateManager()
         self.attached = submit_fn is not None
@@ -127,11 +140,29 @@ class ContainerRuntime(TypedEventEmitter):
             self._send(contents)
 
     def _send(self, contents) -> None:
+        serialized = json.dumps(contents)
+        if len(serialized) > self.max_op_size:
+            self._send_chunked(serialized)
+            return
         # Record pending BEFORE the wire push: over an in-process service
         # the sequenced ack can arrive synchronously inside the send.
         self._submit_fn(
             MessageType.OPERATION, contents,
             before_send=lambda csn: self.pending.on_submit(csn, contents))
+
+    def _send_chunked(self, serialized: str) -> None:
+        """Split one oversized op into CHUNKED_OP messages; receivers
+        reassemble per client and apply on the final chunk."""
+        size = self.max_op_size
+        pieces = [serialized[i:i + size]
+                  for i in range(0, len(serialized), size)]
+        total = len(pieces)
+        for index, piece in enumerate(pieces, start=1):
+            chunk = {"chunkId": index, "totalChunks": total,
+                     "contents": piece}
+            self._submit_fn(
+                MessageType.CHUNKED_OP, chunk,
+                before_send=lambda csn, c=chunk: self.pending.on_submit(csn, c))
 
     def _resubmit_all(self) -> None:
         self.pending.drain()
@@ -160,6 +191,7 @@ class ContainerRuntime(TypedEventEmitter):
             detail = json.loads(data) if isinstance(data, str) else \
                 (message.contents or {})
             left = detail if isinstance(detail, str) else detail.get("clientId")
+            self._chunk_buffers.pop(left, None)  # abandon partial chunks
             ordinal = self._ordinals.pop(left, None)
             if ordinal is not None:
                 # Crash-safe lease release etc. (ConsensusQueue.client_left).
@@ -169,7 +201,7 @@ class ContainerRuntime(TypedEventEmitter):
                         if hook:
                             hook(ordinal)
             return
-        if mtype != MessageType.OPERATION:
+        if mtype not in (MessageType.OPERATION, MessageType.CHUNKED_OP):
             return
         local = (message.client_id == self.client_id
                  and self.client_id is not None)
@@ -182,6 +214,15 @@ class ContainerRuntime(TypedEventEmitter):
                     message.client_id, message.client_sequence_number):
                 local = True
         contents = message.contents
+        if mtype == MessageType.CHUNKED_OP:
+            # Reassemble per sending client; only the final chunk applies
+            # (reference processRemoteChunkedMessage).
+            buf = self._chunk_buffers.setdefault(message.client_id, [])
+            buf.append(contents["contents"])
+            if contents["chunkId"] < contents["totalChunks"]:
+                return
+            del self._chunk_buffers[message.client_id]
+            contents = json.loads("".join(buf))
         store = self.datastores[contents["address"]]
         ordinal = self._ordinals.get(message.client_id, -1)
         store.process(contents["contents"], local, message.sequence_number,

@@ -1,0 +1,118 @@
+"""Chunked ops, DeltaScheduler time-slicing, layered config provider
+(reference containerRuntime.ts:1444/1557, deltaScheduler.ts:25, nconf)."""
+
+import json
+import os
+
+from fluidframework_tpu.core.config import ConfigProvider
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.server.local_server import LocalServer
+from fluidframework_tpu.testing.mocks import MockSequencedEnvironment
+
+
+def live_pair(dds_type):
+    server = LocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.create_detached("doc")
+    ch1 = c1.runtime.create_datastore("default").create_channel("x", dds_type)
+    c1.attach()
+    c2 = loader.resolve("doc")
+    ch2 = c2.runtime.get_datastore("default").get_channel("x")
+    return (c1, ch1), (c2, ch2)
+
+
+class TestChunkedOps:
+    def test_oversized_op_roundtrips(self):
+        (c1, m1), (c2, m2) = live_pair(SharedMap.TYPE)
+        c1.runtime.max_op_size = 256
+        big = "x" * 2000
+        m1.set("big", big)
+        assert m2.get("big") == big
+        assert m1.get("big") == big  # local ack path: no double-apply
+
+    def test_chunks_interleave_between_clients(self):
+        env = MockSequencedEnvironment()
+        r1, r2 = env.create_runtime(), env.create_runtime()
+        m1 = r1.create_datastore("d").create_channel("m", SharedMap.TYPE)
+        m2 = r2.create_datastore("d").create_channel("m", SharedMap.TYPE)
+        env.process_all()
+        r1.max_op_size = 128
+        r2.max_op_size = 128
+        m1.set("a", "A" * 500)
+        m2.set("b", "B" * 500)
+        env.process_all()  # random interleave of the two chunk streams
+        assert m1.get("a") == m2.get("a") == "A" * 500
+        assert m1.get("b") == m2.get("b") == "B" * 500
+
+    def test_small_ops_not_chunked(self):
+        env = MockSequencedEnvironment()
+        r1 = env.create_runtime()
+        m1 = r1.create_datastore("d").create_channel("m", SharedMap.TYPE)
+        m1.set("k", "v")
+        types = [entry[0] for state in env.clients.values()
+                 for entry in state.queue]
+        assert "chunkedOp" not in types
+
+
+class TestDeltaScheduler:
+    def test_yields_during_long_drain(self):
+        (c1, s1), (c2, s2) = live_pair(SharedString.TYPE)
+        c2.delta_manager.scheduler.quantum_s = 0.0  # yield after every op
+        for i in range(30):
+            s1.insert_text(0, f"{i},")
+        assert s2.get_text() == s1.get_text()
+        assert c2.delta_manager.scheduler.interruptions > 0
+        assert c2.delta_manager.scheduler.ops_processed >= 30
+
+    def test_counters_quiet_by_default(self):
+        (c1, s1), (c2, s2) = live_pair(SharedString.TYPE)
+        s1.insert_text(0, "hi")
+        # 20ms quantum: a 2-op drain never yields.
+        assert c2.delta_manager.scheduler.interruptions == 0
+
+
+class TestConfigProvider:
+    def test_layer_precedence(self, tmp_path):
+        cfg_file = tmp_path / "config.json"
+        cfg_file.write_text(json.dumps(
+            {"deli": {"checkpointBatchSize": 10, "fromFile": True}}))
+        os.environ["FFT__deli__checkpointBatchSize"] = "99"
+        try:
+            cfg = ConfigProvider.from_sources(
+                defaults={"deli": {"checkpointBatchSize": 1,
+                                   "timeoutMs": 500}},
+                file_path=str(cfg_file),
+                env_prefix="FFT",
+                overrides={"logger": {"level": "debug"}})
+        finally:
+            del os.environ["FFT__deli__checkpointBatchSize"]
+        assert cfg.get("deli.checkpointBatchSize") == 99  # env beats file
+        assert cfg.get("deli.fromFile") is True           # file beats default
+        assert cfg.get("deli.timeoutMs") == 500           # default survives
+        assert cfg.get("logger.level") == "debug"         # overrides top
+        assert cfg.get("missing.key", "fallback") == "fallback"
+
+    def test_sub_and_require(self):
+        cfg = ConfigProvider({"scribe": {"maxPending": 3}})
+        sub = cfg.sub("scribe")
+        assert sub.get("maxPending") == 3
+        assert cfg.require("scribe.maxPending") == 3
+        try:
+            cfg.require("nope")
+            assert False
+        except KeyError:
+            pass
+
+    def test_env_json_parsing(self):
+        os.environ["PX__a__b"] = '{"deep": [1, 2]}'
+        os.environ["PX__plain"] = "hello"
+        try:
+            cfg = ConfigProvider.from_sources(env_prefix="PX")
+        finally:
+            del os.environ["PX__a__b"]
+            del os.environ["PX__plain"]
+        assert cfg.get("a.b") == {"deep": [1, 2]}
+        assert cfg.get("plain") == "hello"
