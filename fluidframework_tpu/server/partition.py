@@ -26,7 +26,8 @@ class PartitionPump:
     def __init__(self, log: MessageLog, group: str, topic: str,
                  partition: int,
                  lambda_factory: Callable[[LambdaContext], IPartitionLambda],
-                 on_error: Optional[Callable[[Exception, bool], None]] = None):
+                 on_error: Optional[Callable[[Exception, bool], None]] = None,
+                 auto_commit: bool = True):
         self.log = log
         self.group = group
         self.topic = topic
@@ -35,6 +36,11 @@ class PartitionPump:
         self.lambda_factory = lambda_factory
         self.lambda_ = lambda_factory(self.context)
         self.paused = False
+        # auto_commit=False when the lambda owns its replay window (the
+        # document router consolidates per-document checkpoints; an eager
+        # batch commit here would shrink what a crash replays).
+        self.auto_commit = auto_commit
+        self._cursor = 0  # next offset to dispatch (>= committed offset)
         self._lock = threading.Lock()
 
     def pump(self, limit: int = 10**9) -> int:
@@ -42,9 +48,12 @@ class PartitionPump:
         if self.paused:
             return 0
         processed = 0
+        partition = self.log.topic(self.topic).partitions[self.partition]
         while processed < limit:
-            batch = self.log.poll(self.group, self.topic, self.partition,
-                                  limit=min(256, limit - processed))
+            start = max(self._cursor,
+                        self.log.committed(self.group, self.topic,
+                                           self.partition))
+            batch = partition.read(start, min(256, limit - processed))
             if not batch:
                 break
             for msg in batch:
@@ -55,10 +64,12 @@ class PartitionPump:
                     self.context.error(err, restart=True)
                     return processed
                 processed += 1
-            # Lambdas checkpoint themselves; ensure forward progress even if
-            # a lambda checkpoints lazily.
-            self.log.commit(self.group, self.topic, self.partition,
-                            batch[-1].offset)
+                self._cursor = msg.offset + 1
+            if self.auto_commit:
+                # Lambdas checkpoint themselves; ensure forward progress even
+                # if a lambda checkpoints lazily.
+                self.log.commit(self.group, self.topic, self.partition,
+                                batch[-1].offset)
         return processed
 
     def restart(self) -> None:
@@ -66,6 +77,8 @@ class PartitionPump:
         the last committed offset (idempotent handlers absorb the replay)."""
         self.lambda_.close()
         self.lambda_ = self.lambda_factory(self.context)
+        self._cursor = self.log.committed(self.group, self.topic,
+                                          self.partition)
 
     def pause(self) -> None:
         self.paused = True
