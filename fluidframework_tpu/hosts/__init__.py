@@ -1,0 +1,3 @@
+"""Host layer (reference layer 8: packages/hosts)."""
+
+from .base_host import BaseHost

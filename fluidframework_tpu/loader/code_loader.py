@@ -1,0 +1,72 @@
+"""Code loader: resolve code details -> runtime-factory module.
+
+Capability parity with reference packages/loader/web-code-loader (425 LoC,
+`WebCodeLoader.load(IFluidCodeDetails) -> IFluidModule`) and the quorum
+"code" proposal flow (container.ts code upgrade path; capability
+negotiation, SURVEY.md §5 config): a container's *code details* — package
+name + version range — select which registered runtime factory drives the
+container. The reference fetches bundles from npm/CDN; here modules are
+registered in-process (the TPU framework ships as one package), but the
+resolution contract — semver-range matching over a registry, highest
+matching version wins — is the same.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def parse_version(version: str) -> Tuple[int, ...]:
+    return tuple(int(p) for p in version.split("."))
+
+
+def satisfies(version: str, spec: str) -> bool:
+    """Minimal semver-range check: exact, "^x.y.z" (same major, >=),
+    "~x.y.z" (same major.minor, >=), "*" / "latest" (any)."""
+    if spec in ("*", "latest", "", None):
+        return True
+    v = parse_version(version)
+    if spec.startswith("^"):
+        base = parse_version(spec[1:])
+        return v[0] == base[0] and v >= base
+    if spec.startswith("~"):
+        base = parse_version(spec[1:])
+        return v[:2] == base[:2] and v >= base
+    return v == parse_version(spec)
+
+
+class FluidModule:
+    """IFluidModule: the loaded bundle's entry point. `fluid_export` is the
+    runtime factory (reference fluidExport convention)."""
+
+    def __init__(self, fluid_export: Any, package: str, version: str):
+        self.fluid_export = fluid_export
+        self.package = package
+        self.version = version
+
+
+class CodeLoader:
+    """ICodeLoader: registry of (package, version) -> runtime factory."""
+
+    def __init__(self):
+        self._registry: Dict[str, List[Tuple[str, Any]]] = {}
+
+    def register(self, package: str, version: str, runtime_factory: Any
+                 ) -> None:
+        self._registry.setdefault(package, []).append(
+            (version, runtime_factory))
+
+    def load(self, details: Dict[str, Any]) -> FluidModule:
+        """Resolve code details {"package": name, "version": range} to the
+        highest registered version satisfying the range."""
+        package = details["package"]
+        spec = details.get("version", "*")
+        candidates = [
+            (parse_version(version), version, factory)
+            for version, factory in self._registry.get(package, [])
+            if satisfies(version, spec)]
+        if not candidates:
+            raise KeyError(
+                f"no registered module satisfies {package}@{spec}")
+        _, version, factory = max(candidates)
+        return FluidModule(factory, package, version)

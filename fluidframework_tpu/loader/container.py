@@ -45,7 +45,8 @@ class Container(TypedEventEmitter):
     "summaryNack", "closed"."""
 
     def __init__(self, document_id: str, service: IDocumentService,
-                 registry: Optional[ChannelRegistry] = None):
+                 registry: Optional[ChannelRegistry] = None,
+                 code_loader=None):
         super().__init__()
         self.document_id = document_id
         self.service = service
@@ -57,6 +58,9 @@ class Container(TypedEventEmitter):
         self.attached = False
         self.connected = False
         self.closed = False
+        self.code_loader = code_loader
+        self.runtime_factory = None  # set when code details resolve
+        self._code_details: Optional[dict] = None
         self._last_summary_handle: Optional[str] = None
         self._summary_waiters: List[Callable[[str, bool, Any], None]] = []
 
@@ -71,15 +75,20 @@ class Container(TypedEventEmitter):
     # -- creation / loading ------------------------------------------------
     @staticmethod
     def create_detached(document_id: str, service: IDocumentService,
-                        registry: Optional[ChannelRegistry] = None
-                        ) -> "Container":
-        return Container(document_id, service, registry)
+                        registry: Optional[ChannelRegistry] = None,
+                        code_loader=None,
+                        code_details: Optional[dict] = None) -> "Container":
+        container = Container(document_id, service, registry, code_loader)
+        if code_details is not None:
+            container.set_code_details(code_details)
+        return container
 
     @staticmethod
     def load(document_id: str, service: IDocumentService,
-             registry: Optional[ChannelRegistry] = None) -> "Container":
+             registry: Optional[ChannelRegistry] = None,
+             code_loader=None) -> "Container":
         """Reference Container.load (container.ts:186): summary + op tail."""
-        container = Container(document_id, service, registry)
+        container = Container(document_id, service, registry, code_loader)
         summary = container.storage.get_summary()
         if summary is None:
             raise FileNotFoundError(f"document {document_id!r} has no summary")
@@ -87,8 +96,48 @@ class Container(TypedEventEmitter):
         versions = container.storage.get_versions(1)
         container._last_summary_handle = versions[0] if versions else None
         container.attached = True
+        container._instantiate_code(existing=True)
         container.connect()
         return container
+
+    # -- code loading (web-code-loader + quorum "code" proposal) -----------
+    def set_code_details(self, details: dict) -> None:
+        """Select the code package for a detached container. The accepted
+        proposal is folded into the quorum pre-attach (the reference
+        serializes protocol state with the code proposal approved for
+        detached containers) and the runtime factory runs first-time
+        initialization."""
+        if self.attached:
+            raise RuntimeError("use propose_code_details on live containers")
+        self._code_details = details
+        self.protocol.quorum.add_proposal("code", details, 0)
+        self.protocol.quorum.update_minimum_sequence_number(0)
+        self._instantiate_code(existing=False)
+
+    def _instantiate_code(self, existing: bool) -> None:
+        if self.code_loader is None:
+            return
+        details = self.protocol.quorum.get("code") or self._code_details
+        if details is None:
+            return
+        module = self.code_loader.load(details)
+        self.runtime_factory = module.fluid_export
+        self.runtime_factory.initialize(self, existing)
+
+    def propose_code_details(self, details: dict) -> None:
+        """Live code upgrade: a quorum "code" proposal (container.ts code
+        upgrade path). When the MSN passes it unrejected, "codeChanged"
+        fires; hosts reload the container against the new module (the
+        reference closes + reloads the context the same way)."""
+        self.delta_manager.submit(
+            MessageType.PROPOSE, {"key": "code", "value": details})
+
+    def request(self, url: str = "/"):
+        """Route a request through the code-loaded runtime factory
+        (reference request handler chain / base-host requestFluidObject)."""
+        if self.runtime_factory is None:
+            raise RuntimeError("container has no code-loaded runtime factory")
+        return self.runtime_factory.request(self, url)
 
     def _load_from_summary(self, summary: SummaryTree) -> None:
         protocol_blob = summary.entries.get(".protocol")
@@ -113,6 +162,7 @@ class Container(TypedEventEmitter):
 
     # -- connection --------------------------------------------------------
     def connect(self) -> None:
+        self.protocol.quorum.on("approveProposal", self._on_approve_proposal)
         self.delta_manager.attach_op_handler(
             self.protocol.sequence_number, self._process)
         self.delta_manager.on("disconnect", self._on_disconnect)
@@ -128,6 +178,10 @@ class Container(TypedEventEmitter):
             self.runtime.attach(self.delta_manager.submit)
         else:
             self.runtime._submit_fn = self.delta_manager.submit
+
+    def _on_approve_proposal(self, seq, key, value, msn) -> None:
+        if key == "code":
+            self.emit("codeChanged", value)
 
     def _on_disconnect(self) -> None:
         self.connected = False
@@ -231,14 +285,22 @@ class Loader:
     """Resolves document ids to Containers (reference loader.ts)."""
 
     def __init__(self, factory: IDocumentServiceFactory,
-                 registry: Optional[ChannelRegistry] = None):
+                 registry: Optional[ChannelRegistry] = None,
+                 code_loader=None,
+                 code_details: Optional[dict] = None):
         self.factory = factory
         self.registry = registry
+        self.code_loader = code_loader
+        self.code_details = code_details
 
-    def create_detached(self, document_id: str) -> Container:
+    def create_detached(self, document_id: str,
+                        code_details: Optional[dict] = None) -> Container:
         service = self.factory.create_document_service(document_id)
-        return Container.create_detached(document_id, service, self.registry)
+        return Container.create_detached(
+            document_id, service, self.registry, self.code_loader,
+            code_details or self.code_details)
 
     def resolve(self, document_id: str) -> Container:
         service = self.factory.create_document_service(document_id)
-        return Container.load(document_id, service, self.registry)
+        return Container.load(document_id, service, self.registry,
+                              self.code_loader)

@@ -50,6 +50,8 @@ class ContainerRuntime(TypedEventEmitter):
         # Partial chunked-op reassembly per sending client id
         # (reference chunkMap, containerRuntime.ts:1557).
         self._chunk_buffers: Dict[str, List[str]] = {}
+        # Datastores created while live whose attach op is unacked.
+        self._pending_store_attach: Dict[str, dict] = {}
         self.datastores: Dict[str, DataStoreRuntime] = {}
         self.pending = PendingStateManager()
         self.attached = submit_fn is not None
@@ -109,6 +111,13 @@ class ContainerRuntime(TypedEventEmitter):
         self.datastores[store_id] = store
         if root:
             self._gc_roots.append(f"/{store_id}")
+        if self.attached:
+            # Live creation: replicate the (empty) store; its channels each
+            # ship their own attach op as they are created.
+            info = {"id": store_id, "root": root}
+            self._pending_store_attach[store_id] = info
+            if self.connected:
+                self._send({"attachStore": info})
         return store
 
     def get_datastore(self, store_id: str) -> DataStoreRuntime:
@@ -166,6 +175,8 @@ class ContainerRuntime(TypedEventEmitter):
 
     def _resubmit_all(self) -> None:
         self.pending.drain()
+        for info in list(self._pending_store_attach.values()):
+            self._send({"attachStore": info})
         for store_id, store in self.datastores.items():
             for envelope in store.resubmit_pending():
                 self.submit_datastore_op(store_id, envelope)
@@ -223,6 +234,16 @@ class ContainerRuntime(TypedEventEmitter):
                 return
             del self._chunk_buffers[message.client_id]
             contents = json.loads("".join(buf))
+        if "attachStore" in contents:
+            info = contents["attachStore"]
+            if local:
+                self._pending_store_attach.pop(info["id"], None)
+            elif info["id"] not in self.datastores:
+                store = DataStoreRuntime(info["id"], self, self.registry)
+                self.datastores[info["id"]] = store
+                if info.get("root"):
+                    self._gc_roots.append(f"/{info['id']}")
+            return
         store = self.datastores[contents["address"]]
         ordinal = self._ordinals.get(message.client_id, -1)
         store.process(contents["contents"], local, message.sequence_number,

@@ -68,6 +68,11 @@ class DataStoreRuntime(TypedEventEmitter):
         self.container = container
         self.registry = registry or default_registry()
         self.channels: Dict[str, SharedObject] = {}
+        # Channels created while live whose attach op is unacked; maps id ->
+        # the attach summary captured AT CREATION (resubmits reuse it — a
+        # re-captured summary would double-count data ops that are also
+        # resubmitted as pendings). Reference: LocalChannelContext attach.
+        self._pending_attach: Dict[str, dict] = {}
 
     @property
     def client_ordinal(self) -> int:
@@ -83,6 +88,14 @@ class DataStoreRuntime(TypedEventEmitter):
         channel.bind_to_runtime(self)
         if self.attached:
             channel.connect()
+            # Live creation: ship an attach op so remote replicas build a
+            # remote channel context (reference dataStoreRuntime.ts:340
+            # createChannel -> bindChannel attach path).
+            from ..protocol.summary import summary_tree_to_dict
+            attach = {"id": object_id, "type": type_name,
+                      "summary": summary_tree_to_dict(channel.summarize())}
+            self._pending_attach[object_id] = attach
+            self.container.submit_datastore_op(self.id, {"attach": attach})
         return channel
 
     def bind_channel(self, channel: SharedObject) -> None:
@@ -101,12 +114,38 @@ class DataStoreRuntime(TypedEventEmitter):
 
     def process(self, envelope: dict, local: bool, seq: int, ref_seq: int,
                 client_ordinal: int, min_seq: int) -> None:
+        if "attach" in envelope:
+            self._process_attach(envelope["attach"], local)
+            return
         channel = self.channels[envelope["address"]]
         channel.process(envelope["contents"], local, seq, ref_seq,
                         client_ordinal, min_seq)
 
+    def _process_attach(self, info: dict, local: bool) -> None:
+        """Build a remote channel context from a live attach op (reference
+        remoteChannelContext.ts:34). Duplicate ids (concurrent same-id
+        creation) keep the first; later data ops still converge because both
+        replicas apply the same sequenced stream."""
+        if local:
+            self._pending_attach.pop(info["id"], None)
+            return
+        if info["id"] in self.channels:
+            return
+        from ..protocol.summary import summary_tree_from_dict
+        channel = self.registry.create(info["type"], info["id"])
+        channel.runtime = self
+        self.channels[info["id"]] = channel
+        channel.load_core(summary_tree_from_dict(info["summary"]))
+        adopt = getattr(channel, "adopt_client_ordinal", None)
+        if adopt:
+            adopt(self.client_ordinal)
+        channel.connect()
+
     def resubmit_pending(self) -> List[dict]:
-        ops = []
+        # Unacked attach ops go first: the channels' data ops land on
+        # replicas that must already have the channel.
+        ops: List[dict] = [{"attach": attach}
+                           for attach in self._pending_attach.values()]
         for channel_id, channel in self.channels.items():
             for contents in channel.resubmit_pending():
                 ops.append({"address": channel_id, "contents": contents})

@@ -1,0 +1,173 @@
+"""Code loader, base host, legacy client-api Document facade, and dynamic
+channel/datastore attach ops (reference web-code-loader, base-host,
+client-api, dataStoreRuntime.ts:340/remoteChannelContext.ts:34)."""
+
+import pytest
+
+from fluidframework_tpu.client_api import Document
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.framework.container_factories import (
+    ContainerRuntimeFactoryWithDefaultDataStore)
+from fluidframework_tpu.framework.data_object import (DataObject,
+                                                      DataObjectFactory)
+from fluidframework_tpu.hosts import BaseHost
+from fluidframework_tpu.loader.code_loader import (CodeLoader, satisfies)
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.server.local_server import LocalServer
+
+
+class Notes(DataObject):
+    def initializing_first_time(self):
+        self.root.set("title", "untitled")
+
+
+NOTES_FACTORY = DataObjectFactory("notes", Notes)
+
+
+def make_runtime_factory():
+    return ContainerRuntimeFactoryWithDefaultDataStore(NOTES_FACTORY)
+
+
+class TestSemver:
+    def test_ranges(self):
+        assert satisfies("1.2.3", "1.2.3")
+        assert not satisfies("1.2.4", "1.2.3")
+        assert satisfies("1.9.0", "^1.2.3")
+        assert not satisfies("2.0.0", "^1.2.3")
+        assert satisfies("1.2.9", "~1.2.3")
+        assert not satisfies("1.3.0", "~1.2.3")
+        assert satisfies("9.9.9", "*")
+
+    def test_highest_matching_wins(self):
+        cl = CodeLoader()
+        cl.register("app", "1.0.0", "old")
+        cl.register("app", "1.5.0", "new")
+        cl.register("app", "2.0.0", "next-major")
+        module = cl.load({"package": "app", "version": "^1.0.0"})
+        assert module.fluid_export == "new" and module.version == "1.5.0"
+        with pytest.raises(KeyError):
+            cl.load({"package": "app", "version": "^3.0.0"})
+
+
+class TestCodeLoadedContainer:
+    def setup_method(self):
+        self.server = LocalServer()
+        self.code_loader = CodeLoader()
+        self.code_loader.register("notes-app", "1.0.0",
+                                  make_runtime_factory())
+        self.loader = Loader(
+            LocalDocumentServiceFactory(self.server),
+            code_loader=self.code_loader,
+            code_details={"package": "notes-app", "version": "^1.0.0"})
+
+    def test_create_then_load_resolves_default_object(self):
+        c1 = self.loader.create_detached("doc")
+        obj1 = c1.request("/")
+        assert obj1.root.get("title") == "untitled"
+        c1.attach()
+        obj1.root.set("title", "shared notes")
+        c2 = self.loader.resolve("doc")
+        obj2 = c2.request("/")
+        assert obj2.root.get("title") == "shared notes"
+        # Quorum carries the approved code details.
+        assert c2.protocol.quorum.get("code")["package"] == "notes-app"
+
+    def test_code_upgrade_proposal_fires_event(self):
+        c1 = self.loader.create_detached("doc")
+        c1.attach()
+        c2 = self.loader.resolve("doc")
+        seen = []
+        c2.on("codeChanged", seen.append)
+        c1.propose_code_details({"package": "notes-app", "version": "^2.0.0"})
+        # MSN must pass the proposal: BOTH clients must advance their
+        # refSeq (an idle client pins the MSN — correct deli behavior).
+        obj1, obj2 = c1.request("/"), c2.request("/")
+        obj1.root.set("a", 1)
+        obj2.root.set("b", 2)
+        obj1.root.set("c", 3)
+        assert seen and seen[0]["version"] == "^2.0.0"
+        assert c2.protocol.quorum.get("code")["version"] == "^2.0.0"
+
+
+class TestBaseHost:
+    def test_initialize_container_create_and_load(self):
+        server = LocalServer()
+        cl = CodeLoader()
+        cl.register("notes-app", "1.0.0", make_runtime_factory())
+        host = BaseHost(LocalDocumentServiceFactory(server), cl,
+                        {"package": "notes-app"})
+        obj = host.get_fluid_object("doc-1")
+        obj.root.set("k", "v")
+        # Second host (fresh loader) loads the same doc.
+        host2 = BaseHost(LocalDocumentServiceFactory(server), cl,
+                         {"package": "notes-app"})
+        obj2 = host2.get_fluid_object("doc-1")
+        assert obj2.root.get("k") == "v"
+
+
+class TestDynamicAttach:
+    def test_channel_created_live_replicates(self):
+        server = LocalServer()
+        loader = Loader(LocalDocumentServiceFactory(server))
+        c1 = loader.create_detached("doc")
+        ds1 = c1.runtime.create_datastore("default")
+        ds1.create_channel("seed", SharedMap.TYPE)
+        c1.attach()
+        c2 = loader.resolve("doc")
+        # Created AFTER both clients are live:
+        m1 = ds1.create_channel("late", SharedMap.TYPE)
+        m1.set("x", 42)
+        m2 = c2.runtime.get_datastore("default").get_channel("late")
+        assert m2.get("x") == 42
+        m2.set("y", 7)
+        assert m1.get("y") == 7
+
+    def test_datastore_created_live_replicates(self):
+        server = LocalServer()
+        loader = Loader(LocalDocumentServiceFactory(server))
+        c1 = loader.create_detached("doc")
+        c1.runtime.create_datastore("default").create_channel(
+            "seed", SharedMap.TYPE)
+        c1.attach()
+        c2 = loader.resolve("doc")
+        ds_new = c1.runtime.create_datastore("extra")
+        m1 = ds_new.create_channel("m", SharedMap.TYPE)
+        m1.set("deep", {"n": 1})
+        m2 = c2.runtime.get_datastore("extra").get_channel("m")
+        assert m2.get("deep") == {"n": 1}
+
+
+class TestLegacyDocument:
+    def test_create_and_load_roundtrip(self):
+        server = LocalServer()
+        factory = LocalDocumentServiceFactory(server)
+        doc = Document.create("legacy-doc", factory)
+        root = doc.get_root()
+        root.set("greeting", "hello")
+        text = doc.create_string("story")
+        text.insert_text(0, "once upon a time")
+        doc2 = Document.load("legacy-doc", factory)
+        assert doc2.existing is True
+        assert doc2.get_root().get("greeting") == "hello"
+        t2 = doc2.get("story")
+        assert t2.get_text() == "once upon a time"
+        t2.insert_text(0, "and ")
+        assert text.get_text() == "and once upon a time"
+
+    def test_typed_creators(self):
+        server = LocalServer()
+        factory = LocalDocumentServiceFactory(server)
+        doc = Document.create("doc-x", factory)
+        counter = doc.create_counter("c")
+        counter.increment(5)
+        matrix = doc.create_matrix("m")
+        matrix.insert_rows(0, 2)
+        matrix.insert_cols(0, 2)
+        matrix.set_cell(0, 0, "corner")
+        nums = doc.create_number_sequence("n")
+        nums.insert_range(0, [1, 2, 3])
+        doc2 = Document.load("doc-x", factory)
+        assert doc2.get("c").value == 5
+        assert doc2.get("m").get_cell(0, 0) == "corner"
+        assert doc2.get("n").get_items() == [1, 2, 3]
