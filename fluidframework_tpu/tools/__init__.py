@@ -1,0 +1,5 @@
+"""Offline tools (reference layer 8: packages/tools)."""
+
+from .fetch_tool import FetchStats, fetch_document
+from .mergetree_replay import MergeTreeReplayer
+from .replay_tool import ReplayArgs, ReplayResult, ReplayTool
