@@ -9,6 +9,11 @@ from .container_factories import (
     ContainerRuntimeFactoryWithDefaultDataStore,
 )
 from .data_object import DataObject, DataObjectFactory, PureDataObject
+from .last_edited import (LastEditedTracker, setup_last_edited_tracking)
+from .lazy_data_object import (LazyLoadedDataObject,
+                               LazyLoadedDataObjectFactory)
+from .views import (MountableView, SyncedDataObject, ViewAdapter,
+                    use_synced_state)
 from .interceptions import (
     create_shared_map_with_interception,
     create_shared_string_with_interception,
@@ -36,4 +41,7 @@ __all__ = [
     "DependencyContainer",
     "SharedMapUndoRedoHandler", "SharedSegmentSequenceUndoRedoHandler",
     "UndoRedoStackManager",
+    "LastEditedTracker", "setup_last_edited_tracking",
+    "LazyLoadedDataObject", "LazyLoadedDataObjectFactory",
+    "MountableView", "SyncedDataObject", "ViewAdapter", "use_synced_state",
 ]

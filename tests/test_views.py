@@ -1,0 +1,145 @@
+"""View adapters, synced state bindings, last-edited tracker, lazy data
+objects (reference view-interfaces/view-adapters/react,
+last-edited-experimental, data-object-base)."""
+
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.summary_block import SharedSummaryBlock
+from fluidframework_tpu.framework import (LastEditedTracker,
+                                          LazyLoadedDataObject,
+                                          LazyLoadedDataObjectFactory,
+                                          MountableView, SyncedDataObject,
+                                          ViewAdapter, use_synced_state,
+                                          setup_last_edited_tracking)
+from fluidframework_tpu.framework.data_object import (DataObject,
+                                                      DataObjectFactory)
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.server.local_server import LocalServer
+
+
+class CounterView(DataObject):
+    def initializing_first_time(self):
+        self.root.set("count", 0)
+
+    def render(self):
+        return f"count={self.root.get('count')}"
+
+
+def live_pair():
+    server = LocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.create_detached("doc")
+    ds1 = c1.runtime.create_datastore("default")
+    m1 = ds1.create_channel("m", SharedMap.TYPE)
+    c1.attach()
+    c2 = loader.resolve("doc")
+    m2 = c2.runtime.get_datastore("default").get_channel("m")
+    return server, (c1, ds1, m1), (c2, m2)
+
+
+class TestViewAdapter:
+    def test_render_and_rerender_on_remote_change(self):
+        server, (c1, ds1, m1), (c2, m2) = live_pair()
+        factory = DataObjectFactory("cv", CounterView)
+        obj = CounterView(ds1)
+        obj.initialize(existing=False)
+        frames = []
+        adapter = ViewAdapter(obj)
+        adapter.mount(frames.append)
+        assert frames[-1] == "count=0"
+        obj.root.set("count", 3)
+        assert frames[-1] == "count=3"
+        adapter.unmount()
+        obj.root.set("count", 9)
+        assert frames[-1] == "count=3"  # unmounted: no repaint
+
+    def test_rejects_viewless_objects(self):
+        try:
+            ViewAdapter(object())
+            assert False
+        except TypeError:
+            pass
+
+    def test_mountable_view_moves_between_surfaces(self):
+        server, (c1, ds1, m1), _ = live_pair()
+        obj = CounterView(ds1)
+        obj.initialize(existing=False)
+        view = MountableView(obj)
+        a, b = [], []
+        view.mount("surface-a", a.append)
+        assert a[-1] == "count=0"
+        view.unmount()
+        view.mount("surface-b", b.append)
+        assert b[-1] == "count=0"
+
+
+class TestSyncedState:
+    def test_use_synced_state_two_clients(self):
+        server, (c1, ds1, m1), (c2, m2) = live_pair()
+        changes = []
+        get1, set1 = use_synced_state(m1, "color", "white")
+        get2, _ = use_synced_state(m2, "color", "white",
+                                   on_change=changes.append)
+        assert get1() == get2() == "white"
+        set1("teal")
+        assert get2() == "teal"
+        assert changes == ["teal"]
+
+    def test_synced_data_object(self):
+        server, (c1, ds1, m1), _ = live_pair()
+        obj = CounterView(ds1)
+        obj.initialize(existing=False)
+        synced = SyncedDataObject(obj, {"count": 0, "label": "x"})
+        events = []
+        synced.on_state_change(lambda k, v: events.append((k, v)))
+        synced.set("count", 5)
+        assert synced.get("count") == 5
+        assert ("count", 5) in events
+        try:
+            synced.set("undeclared", 1)
+            assert False
+        except KeyError:
+            pass
+
+
+class TestLastEdited:
+    def test_tracks_latest_editor(self):
+        server, (c1, ds1, m1), (c2, m2) = live_pair()
+        block = ds1.create_channel("led", SharedSummaryBlock.TYPE)
+        tracker = LastEditedTracker(block)
+        setup_last_edited_tracking(tracker, c1)
+        assert tracker.get_last_edit_details() is None
+        m2.set("edit", "by-client-2")
+        details = tracker.get_last_edit_details()
+        assert details is not None
+        assert details["clientId"] == c2.delta_manager.client_id
+        m1.set("edit", "by-client-1")
+        assert tracker.get_last_edit_details()["clientId"] == \
+            c1.delta_manager.client_id
+
+    def test_discards_non_edit_messages(self):
+        server, (c1, ds1, m1), (c2, m2) = live_pair()
+        block = ds1.create_channel("led", SharedSummaryBlock.TYPE)
+        tracker = LastEditedTracker(block)
+        setup_last_edited_tracking(tracker, c1)
+        c1.summarize()        # summarize op: not an edit
+        server.pump()
+        assert tracker.get_last_edit_details() is None
+
+
+class TestLazyDataObject:
+    def test_realize_deferred_until_first_get(self):
+        server, (c1, ds1, m1), _ = live_pair()
+        realized = []
+
+        class Heavy(LazyLoadedDataObject):
+            def realize(self):
+                realized.append(self.store.id)
+
+        c1.runtime.create_datastore("heavy")
+        factory = LazyLoadedDataObjectFactory("heavy", Heavy)
+        assert realized == []
+        obj = factory.get(c1.runtime, "heavy")
+        assert realized == ["heavy"] and obj.realized
+        factory.get(c1.runtime, "heavy")
+        assert realized == ["heavy"]  # realize ran once
