@@ -133,12 +133,18 @@ class MergeTreeClient(TypedEventEmitter):
     # -- sequenced message application ------------------------------------
     def apply_msg(self, op: dict, seq: int, ref_seq: int, client: int,
                   min_seq: Optional[int] = None) -> None:
-        """Apply one sequenced merge-tree op (reference client.ts:805)."""
+        """Apply one sequenced merge-tree op (reference client.ts:805).
+
+        current_seq advances BEFORE the apply: every apply path positions by
+        the op's explicit (ref_seq, client) perspective, and listeners of
+        the resulting "delta" event must see the op's effect when they read
+        the tree (a remote insert stamped ins_seq=seq would be invisible
+        under the old current_seq)."""
+        self.tree.update_seq(seq)
         if client == self.client_id:
             self._ack_op(op, seq)
         else:
             self._apply_remote(op, seq, ref_seq, client)
-        self.tree.update_seq(seq)
         if min_seq is not None and min_seq > self.tree.min_seq:
             self.tree.set_min_seq(min_seq)
 

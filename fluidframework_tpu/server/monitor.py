@@ -1,0 +1,152 @@
+"""Service monitor: health + metrics over the ordering service.
+
+Capability parity with reference server/service-monitor (the ops stub) and
+the IMetricClient surface (services-core/src/metricClient.ts): collects
+counters from registered probes (documents resident, sequence numbers,
+partition checkpoint lag, op throughput), serves them as JSON over
+`/health` and `/metrics`, and keeps a rolling sample window for rate
+computation.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+class MetricClient:
+    """Programmatic metric sink (reference IMetricClient.writeLatencyMetric
+    shape): named counters + latency samples with simple aggregation."""
+
+    def __init__(self, window: int = 512):
+        self.counters: Dict[str, float] = {}
+        self.latencies: Dict[str, List[float]] = {}
+        self.window = window
+        self._lock = threading.Lock()
+
+    def increment(self, name: str, by: float = 1.0) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0.0) + by
+
+    def write_latency(self, name: str, ms: float) -> None:
+        with self._lock:
+            samples = self.latencies.setdefault(name, [])
+            samples.append(ms)
+            if len(samples) > self.window:
+                del samples[:len(samples) - self.window]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out: dict = {"counters": dict(self.counters), "latencies": {}}
+            for name, samples in self.latencies.items():
+                if not samples:
+                    continue
+                ordered = sorted(samples)
+                out["latencies"][name] = {
+                    "count": len(samples),
+                    "p50": ordered[len(ordered) // 2],
+                    "p99": ordered[min(len(ordered) - 1,
+                                       int(len(ordered) * 0.99))],
+                    "max": ordered[-1],
+                }
+            return out
+
+
+class ServiceMonitor:
+    """Aggregates probes (name -> callable returning a dict) and serves
+    them. Probes run at request time, so readings are live."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 metrics: Optional[MetricClient] = None):
+        self.metrics = metrics or MetricClient()
+        self.probes: Dict[str, Callable[[], dict]] = {}
+        self.started_at = time.time()
+        service = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet
+                pass
+
+            def do_GET(self):
+                service._route(self)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def add_probe(self, name: str, probe: Callable[[], dict]) -> None:
+        self.probes[name] = probe
+
+    def watch_local_server(self, name: str, server) -> None:
+        """Convenience probe over a LocalServer pipeline core."""
+
+        def probe() -> dict:
+            docs = sorted(getattr(server, "_connections", {}))
+            return {"documents": docs, "connections":
+                    {d: len(c) for d, c in
+                     getattr(server, "_connections", {}).items()}}
+
+        self.add_probe(name, probe)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ServiceMonitor":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="monitor", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- views --------------------------------------------------------------
+    def health(self) -> dict:
+        checks: Dict[str, Tuple[bool, str]] = {}
+        for name, probe in self.probes.items():
+            try:
+                probe()
+                checks[name] = (True, "ok")
+            except Exception as exc:  # noqa: BLE001 — probe crash = unhealthy
+                checks[name] = (False, repr(exc))
+        return {"ok": all(ok for ok, _ in checks.values()),
+                "uptimeS": time.time() - self.started_at,
+                "checks": {n: {"ok": ok, "detail": d}
+                           for n, (ok, d) in checks.items()}}
+
+    def report(self) -> dict:
+        out = {"metrics": self.metrics.snapshot(), "probes": {}}
+        for name, probe in self.probes.items():
+            try:
+                out["probes"][name] = probe()
+            except Exception as exc:  # noqa: BLE001
+                out["probes"][name] = {"error": repr(exc)}
+        return out
+
+    def _route(self, handler) -> None:
+        path = handler.path.partition("?")[0]
+        if path == "/health":
+            payload, status = self.health(), 200
+            if not payload["ok"]:
+                status = 503
+        elif path == "/metrics":
+            payload, status = self.report(), 200
+        else:
+            payload, status = {"error": f"no route {path}"}, 404
+        body = json.dumps(payload).encode()
+        handler.send_response(status)
+        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Length", str(len(body)))
+        handler.end_headers()
+        handler.wfile.write(body)

@@ -1,0 +1,182 @@
+"""Intelligence runner, headless agent runner, gateway, service monitor
+(reference intelligence-runner-agent, headless-agent, gateway,
+service-monitor)."""
+
+import json
+import urllib.request
+
+from fluidframework_tpu.agents import (HeadlessAgentRunner,
+                                       IntelligenceRunner, key_phrases,
+                                       sentiment, text_analytics)
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.register_collection import (
+    ConsensusRegisterCollection)
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.framework.agent_scheduler import AgentScheduler
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.server.gateway import GatewayService
+from fluidframework_tpu.server.local_server import LocalServer
+from fluidframework_tpu.server.monitor import MetricClient, ServiceMonitor
+
+
+def make_doc():
+    server = LocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.create_detached("doc")
+    ds = c1.runtime.create_datastore("default")
+    ds.create_channel("text", SharedString.TYPE)
+    ds.create_channel("insights", SharedMap.TYPE)
+    ds.create_channel("tasks", ConsensusRegisterCollection.TYPE)
+    c1.attach()
+    return server, loader, c1
+
+
+def wire_runner(container, batch_size=1):
+    ds = container.runtime.get_datastore("default")
+    scheduler = AgentScheduler(container, ds.get_channel("tasks"))
+    runner = IntelligenceRunner(scheduler, ds.get_channel("text"),
+                                ds.get_channel("insights"),
+                                batch_size=batch_size)
+    return runner, ds
+
+
+class TestProviders:
+    def test_text_analytics(self):
+        out = text_analytics("Two words. One more sentence!")
+        assert out["wordCount"] == 5 and out["sentenceCount"] == 2
+
+    def test_sentiment_polarity(self):
+        assert sentiment("this is great and wonderful")["score"] > 0
+        assert sentiment("terrible awful broken")["score"] < 0
+
+    def test_key_phrases_skips_stopwords(self):
+        out = key_phrases("the ocean and the ocean and waves")
+        assert out["phrases"][0] == "ocean"
+        assert "the" not in out["phrases"]
+
+
+class TestIntelligenceRunner:
+    def test_single_runner_wins_and_publishes(self):
+        server, loader, c1 = make_doc()
+        c2 = loader.resolve("doc")
+        r1, ds1 = wire_runner(c1)
+        r2, _ = wire_runner(c2)
+        r1.start()
+        r2.start()
+        assert r1.is_runner != r2.is_runner  # exactly one wins
+        winner = r1 if r1.is_runner else r2
+        ds = (ds1 if winner is r1
+              else c2.runtime.get_datastore("default"))
+        ds.get_channel("text").insert_text(0, "good good excellent ocean")
+        # Insights are visible to BOTH clients (they ride normal map ops).
+        for c in (c1, c2):
+            insights = c.runtime.get_datastore("default") \
+                .get_channel("insights")
+            assert insights.get("sentiment")["score"] > 0
+            assert insights.get("textAnalytics")["wordCount"] == 4
+            assert insights.get("meta")["runner"] == \
+                winner.scheduler.container.delta_manager.client_id
+
+    def test_batching(self):
+        server, loader, c1 = make_doc()
+        runner, ds = wire_runner(c1, batch_size=3)
+        runner.start()
+        text = ds.get_channel("text")
+        base = runner.runs
+        text.insert_text(0, "a")
+        text.insert_text(0, "b")
+        assert runner.runs == base  # below batch threshold
+        text.insert_text(0, "c")
+        assert runner.runs == base + 1
+
+
+class TestHeadlessRunner:
+    def test_launch_close_and_agent_lifecycle(self):
+        server, loader, c1 = make_doc()
+
+        def agent_factory(container):
+            runner, _ = wire_runner(container)
+            return runner
+
+        headless = HeadlessAgentRunner(Loader(
+            LocalDocumentServiceFactory(server)))
+        headless.launch("doc", [agent_factory])
+        assert headless.running() == ["doc"]
+        # The headless client (only volunteer) is the intelligence runner.
+        ds = c1.runtime.get_datastore("default")
+        ds.get_channel("text").insert_text(0, "hello ocean world")
+        insights = ds.get_channel("insights")
+        assert insights.get("textAnalytics")["wordCount"] == 3
+        headless.close("doc")
+        assert headless.running() == []
+
+
+class TestGateway:
+    def test_serves_document_state(self):
+        server, loader, c1 = make_doc()
+        ds = c1.runtime.get_datastore("default")
+        ds.get_channel("text").insert_text(0, "served text")
+        gw = GatewayService(Loader(
+            LocalDocumentServiceFactory(server))).start()
+        try:
+            with urllib.request.urlopen(f"{gw.url}/doc/doc") as resp:
+                payload = json.load(resp)
+            channels = payload["dataStores"]["default"]
+            assert channels["text"]["text"] == "served text"
+            with urllib.request.urlopen(f"{gw.url}/health") as resp:
+                assert json.load(resp)["ok"] is True
+            # Live residency: a later edit is visible on re-GET.
+            ds.get_channel("text").insert_text(0, "updated ")
+            with urllib.request.urlopen(f"{gw.url}/doc/doc") as resp:
+                payload = json.load(resp)
+            assert payload["dataStores"]["default"]["text"]["text"] \
+                == "updated served text"
+        finally:
+            gw.stop()
+
+    def test_unknown_document_404(self):
+        server, loader, c1 = make_doc()
+        gw = GatewayService(Loader(
+            LocalDocumentServiceFactory(server))).start()
+        try:
+            try:
+                urllib.request.urlopen(f"{gw.url}/doc/nope")
+                assert False
+            except urllib.error.HTTPError as err:
+                assert err.code == 404
+        finally:
+            gw.stop()
+
+
+class TestServiceMonitor:
+    def test_metrics_and_health(self):
+        metrics = MetricClient()
+        metrics.increment("ops", 5)
+        metrics.write_latency("ticket", 1.5)
+        metrics.write_latency("ticket", 3.5)
+        monitor = ServiceMonitor(metrics=metrics).start()
+        monitor.add_probe("static", lambda: {"alive": True})
+        try:
+            with urllib.request.urlopen(f"{monitor.url}/metrics") as resp:
+                report = json.load(resp)
+            assert report["metrics"]["counters"]["ops"] == 5
+            assert report["metrics"]["latencies"]["ticket"]["count"] == 2
+            assert report["probes"]["static"]["alive"] is True
+            with urllib.request.urlopen(f"{monitor.url}/health") as resp:
+                assert json.load(resp)["ok"] is True
+        finally:
+            monitor.stop()
+
+    def test_failing_probe_unhealthy(self):
+        monitor = ServiceMonitor().start()
+        monitor.add_probe("broken", lambda: 1 / 0)
+        try:
+            try:
+                urllib.request.urlopen(f"{monitor.url}/health")
+                assert False
+            except urllib.error.HTTPError as err:
+                assert err.code == 503
+                assert json.load(err)["checks"]["broken"]["ok"] is False
+        finally:
+            monitor.stop()
