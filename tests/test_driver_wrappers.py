@@ -1,0 +1,130 @@
+"""Caching driver (odsp-driver role) + isolation proxy driver
+(iframe-driver role)."""
+
+import json
+
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.caching import (
+    CachingDocumentServiceFactory, PersistentCache, TokenRefreshWrapper)
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.loader.drivers.proxy import (
+    DriverProxyHost, ProxyDocumentServiceFactory)
+from fluidframework_tpu.server.local_server import LocalServer
+
+
+def seeded_server(text="cached content"):
+    server = LocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.create_detached("doc")
+    ds = c1.runtime.create_datastore("default")
+    s = ds.create_channel("t", SharedString.TYPE)
+    s.insert_text(0, text)
+    c1.attach()
+    c1.summarize()
+    server.pump()
+    return server, c1, s
+
+
+class TestCachingDriver:
+    def test_cache_hit_on_second_load(self, tmp_path):
+        server, c1, s = seeded_server()
+        cache = PersistentCache(str(tmp_path))
+        factory = CachingDocumentServiceFactory(
+            LocalDocumentServiceFactory(server), cache)
+        loader = Loader(factory)
+        a = loader.resolve("doc")
+        assert cache.misses >= 1
+        hits_before = cache.hits
+        b = loader.resolve("doc")
+        assert cache.hits > hits_before
+        for c in (a, b):
+            t = c.runtime.get_datastore("default").get_channel("t")
+            assert t.get_text() == "cached content"
+
+    def test_epoch_invalidation_on_new_summary(self, tmp_path):
+        server, c1, s = seeded_server()
+        cache = PersistentCache(str(tmp_path))
+        factory = CachingDocumentServiceFactory(
+            LocalDocumentServiceFactory(server), cache)
+        Loader(factory).resolve("doc")          # populate cache
+        s.insert_text(0, "fresh ")
+        c1.summarize()                          # head version moves
+        server.pump()
+        c2 = Loader(factory).resolve("doc")     # cache must refresh
+        t = c2.runtime.get_datastore("default").get_channel("t")
+        assert t.get_text() == "fresh cached content"
+
+    def test_live_edits_flow_through_cached_load(self, tmp_path):
+        server, c1, s = seeded_server()
+        factory = CachingDocumentServiceFactory(
+            LocalDocumentServiceFactory(server), PersistentCache())
+        c2 = Loader(factory).resolve("doc")
+        t2 = c2.runtime.get_datastore("default").get_channel("t")
+        s.insert_text(0, "live ")
+        assert t2.get_text() == "live cached content"
+        t2.insert_text(0, "both ")
+        assert s.get_text() == "both live cached content"
+
+    def test_token_refresh_on_auth_failure(self):
+        calls = []
+
+        def provider(refresh):
+            calls.append(refresh)
+            return "tok-2" if refresh else "tok-1"
+
+        wrapper = TokenRefreshWrapper(provider)
+
+        def guarded(token):
+            if token != "tok-2":
+                raise PermissionError("expired")
+            return "ok"
+
+        assert wrapper.call(guarded) == "ok"
+        assert calls == [False, True]
+        # Refreshed token is reused without refetching.
+        assert wrapper.call(guarded) == "ok"
+        assert calls == [False, True]
+
+
+class TestProxyDriver:
+    def _proxy_loader(self, server):
+        host = DriverProxyHost(LocalDocumentServiceFactory(server))
+        # Force every payload across the boundary through JSON: anything
+        # non-serializable breaks loudly (the iframe/postMessage guarantee).
+        codec = lambda d: json.loads(json.dumps(d))  # noqa: E731
+        return Loader(ProxyDocumentServiceFactory.over_host(host, codec))
+
+    def test_full_session_through_serialized_boundary(self):
+        server, c1, s = seeded_server()
+        loader = self._proxy_loader(server)
+        c2 = loader.resolve("doc")
+        t2 = c2.runtime.get_datastore("default").get_channel("t")
+        assert t2.get_text() == "cached content"
+        # Bidirectional: sandboxed edits reach the host world and back.
+        t2.insert_text(0, "inner ")
+        assert s.get_text() == "inner cached content"
+        s.insert_text(0, "outer ")
+        assert t2.get_text() == "outer inner cached content"
+
+    def test_detached_create_through_proxy(self):
+        server = LocalServer()
+        loader = self._proxy_loader(server)
+        c = loader.create_detached("fresh")
+        m = c.runtime.create_datastore("d").create_channel(
+            "m", SharedMap.TYPE)
+        c.attach()
+        m.set("k", [1, 2, 3])
+        direct = Loader(LocalDocumentServiceFactory(server)).resolve("fresh")
+        assert direct.runtime.get_datastore("d").get_channel("m") \
+            .get("k") == [1, 2, 3]
+
+    def test_errors_marshal_across_boundary(self):
+        server = LocalServer()
+        loader = self._proxy_loader(server)
+        try:
+            loader.resolve("missing-doc")
+            assert False
+        except FileNotFoundError:
+            pass
