@@ -1,0 +1,3 @@
+"""Example applications (reference examples/data-objects — the BASELINE
+benchmark configs are drawn from these: clicker, collaborative text,
+spreadsheet, nested JSON merges)."""

@@ -1,0 +1,75 @@
+"""Clicker: the hello-world data object (BASELINE config #1; reference
+examples/data-objects/clicker): a SharedCounter behind a DataObject, every
+client clicks, all replicas converge. This is the minimum end-to-end slice
+through loader -> runtime -> DDS -> sequencer (SURVEY.md §7.5)."""
+
+from __future__ import annotations
+
+from fluidframework_tpu.dds.counter import SharedCounter
+from fluidframework_tpu.framework.container_factories import (
+    ContainerRuntimeFactoryWithDefaultDataStore)
+from fluidframework_tpu.framework.data_object import (DataObject,
+                                                      DataObjectFactory)
+from fluidframework_tpu.loader.code_loader import CodeLoader
+from fluidframework_tpu.loader.container import Loader
+
+COUNTER_KEY = "clicks"
+
+
+class Clicker(DataObject):
+    def initializing_first_time(self):
+        counter = self.store.create_channel("counter", SharedCounter.TYPE)
+        self.root.set(COUNTER_KEY, counter.handle.encode())
+
+    @property
+    def counter(self) -> SharedCounter:
+        return self.store.get_channel("counter")
+
+    def click(self, by: int = 1) -> None:
+        self.counter.increment(by)
+
+    @property
+    def value(self) -> int:
+        return self.counter.value
+
+    def render(self):
+        return f"clicks: {self.value}"
+
+
+ClickerFactory = DataObjectFactory("clicker", Clicker)
+
+CODE_DETAILS = {"package": "@examples/clicker", "version": "^1.0.0"}
+
+
+def make_loader(service_factory) -> Loader:
+    code_loader = CodeLoader()
+    code_loader.register(
+        "@examples/clicker", "1.0.0",
+        ContainerRuntimeFactoryWithDefaultDataStore(ClickerFactory))
+    return Loader(service_factory, code_loader=code_loader,
+                  code_details=CODE_DETAILS)
+
+
+def main() -> int:
+    """Run a small local session: three clients click concurrently."""
+    from fluidframework_tpu.loader.drivers.local import (
+        LocalDocumentServiceFactory)
+    from fluidframework_tpu.server.local_server import LocalServer
+
+    server = LocalServer()
+    creator = make_loader(LocalDocumentServiceFactory(server))
+    c0 = creator.create_detached("clicker-doc")
+    c0.attach()
+    clients = [c0] + [make_loader(LocalDocumentServiceFactory(server))
+                      .resolve("clicker-doc") for _ in range(2)]
+    clickers = [c.request("/") for c in clients]
+    for i, clicker in enumerate(clickers):
+        clicker.click(i + 1)
+    values = [c.value for c in clickers]
+    assert values == [6, 6, 6], values
+    print(clickers[0].render())
+    return values[0]
+
+
+if __name__ == "__main__":
+    main()

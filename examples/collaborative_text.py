@@ -1,0 +1,99 @@
+"""Collaborative text editor (BASELINE config #2; reference
+examples/data-objects/shared-text): a SharedString document with markers
+(paragraph structure), annotations (formatting), interval collections
+(comments), undo-redo, and the intelligence agent publishing analytics."""
+
+from __future__ import annotations
+
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.framework.container_factories import (
+    ContainerRuntimeFactoryWithDefaultDataStore)
+from fluidframework_tpu.framework.data_object import (DataObject,
+                                                      DataObjectFactory)
+from fluidframework_tpu.framework.undo_redo import (
+    SharedSegmentSequenceUndoRedoHandler, UndoRedoStackManager)
+from fluidframework_tpu.loader.code_loader import CodeLoader
+from fluidframework_tpu.loader.container import Loader
+
+
+class SharedTextDocument(DataObject):
+    def initializing_first_time(self):
+        self.store.create_channel("text", SharedString.TYPE)
+        self.store.create_channel("insights", SharedMap.TYPE)
+
+    @property
+    def text(self) -> SharedString:
+        return self.store.get_channel("text")
+
+    @property
+    def insights(self) -> SharedMap:
+        return self.store.get_channel("insights")
+
+    # -- editing surface ---------------------------------------------------
+    def insert(self, pos: int, content: str, props=None) -> None:
+        self.text.insert_text(pos, content, props)
+
+    def delete(self, start: int, end: int) -> None:
+        self.text.remove_text(start, end)
+
+    def bold(self, start: int, end: int) -> None:
+        self.text.annotate_range(start, end, {"fontWeight": "bold"})
+
+    def insert_paragraph(self, pos: int) -> None:
+        self.text.insert_marker(pos, {"type": "paragraph"})
+
+    def add_comment(self, start: int, end: int, comment: str):
+        return self.text.get_interval_collection("comments").add(
+            start, end, {"comment": comment})
+
+    def comments(self):
+        coll = self.text.get_interval_collection("comments")
+        return [(coll.endpoints(iv), iv.properties["comment"])
+                for iv in coll]
+
+    def make_undo_stack(self) -> UndoRedoStackManager:
+        manager = UndoRedoStackManager()
+        SharedSegmentSequenceUndoRedoHandler(manager).attach(self.text)
+        return manager
+
+    def render(self):
+        return self.text.get_text()
+
+
+SharedTextFactory = DataObjectFactory("shared-text", SharedTextDocument)
+
+CODE_DETAILS = {"package": "@examples/shared-text", "version": "^1.0.0"}
+
+
+def make_loader(service_factory) -> Loader:
+    code_loader = CodeLoader()
+    code_loader.register(
+        "@examples/shared-text", "1.0.0",
+        ContainerRuntimeFactoryWithDefaultDataStore(SharedTextFactory))
+    return Loader(service_factory, code_loader=code_loader,
+                  code_details=CODE_DETAILS)
+
+
+def main() -> str:
+    from fluidframework_tpu.loader.drivers.local import (
+        LocalDocumentServiceFactory)
+    from fluidframework_tpu.server.local_server import LocalServer
+
+    server = LocalServer()
+    loader = make_loader(LocalDocumentServiceFactory(server))
+    c1 = loader.create_detached("shared-text-doc")
+    c1.attach()
+    c2 = loader.resolve("shared-text-doc")
+    alice, bob = c1.request("/"), c2.request("/")
+    alice.insert(0, "Collaborative editing on TPU.")
+    bob.insert(0, "Hello! ")
+    alice.bold(0, 6)
+    bob.add_comment(7, 20, "love this part")
+    assert alice.render() == bob.render()
+    print(alice.render())
+    return alice.render()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+"""Project tracker: nested JSON state in a SharedDirectory (BASELINE
+config #4 — nested-subtree JSON merges with concurrent editors): projects
+are subdirectories, tasks are keys inside them; concurrent editors merge
+per-key (last-write-wins) while structural create/delete of subtrees
+converges through the directory op protocol."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+from fluidframework_tpu.dds.directory import SharedDirectory
+from fluidframework_tpu.framework.container_factories import (
+    ContainerRuntimeFactoryWithDefaultDataStore)
+from fluidframework_tpu.framework.data_object import (DataObject,
+                                                      DataObjectFactory)
+from fluidframework_tpu.loader.code_loader import CodeLoader
+from fluidframework_tpu.loader.container import Loader
+
+
+class ProjectTracker(DataObject):
+    def initializing_first_time(self):
+        self.store.create_channel("projects", SharedDirectory.TYPE)
+
+    @property
+    def directory(self) -> SharedDirectory:
+        return self.store.get_channel("projects")
+
+    # -- tracker surface ---------------------------------------------------
+    def create_project(self, name: str, meta: Dict[str, Any] = None) -> None:
+        sub = self.directory.create_sub_directory(name)
+        sub.set("meta", dict(meta or {}))
+
+    def delete_project(self, name: str) -> None:
+        self.directory.root.delete_sub_directory(name)
+
+    def projects(self) -> List[str]:
+        return sorted(name for name, _ in
+                      self.directory.root.subdirectories())
+
+    def add_task(self, project: str, task_id: str, task: dict) -> None:
+        sub = self.directory.get_working_directory(f"/{project}")
+        sub.set(f"task:{task_id}", task)
+
+    def set_status(self, project: str, task_id: str, status: str) -> None:
+        sub = self.directory.get_working_directory(f"/{project}")
+        task = dict(sub.get(f"task:{task_id}") or {})
+        task["status"] = status
+        sub.set(f"task:{task_id}", task)
+
+    def tasks(self, project: str) -> Dict[str, dict]:
+        sub = self.directory.get_working_directory(f"/{project}")
+        if sub is None:
+            return {}
+        return {key[5:]: sub.get(key) for key in sub.keys()
+                if key.startswith("task:")}
+
+    def render(self):
+        return {p: self.tasks(p) for p in self.projects()}
+
+
+TrackerFactory = DataObjectFactory("project-tracker", ProjectTracker)
+
+CODE_DETAILS = {"package": "@examples/project-tracker", "version": "^1.0.0"}
+
+
+def make_loader(service_factory) -> Loader:
+    code_loader = CodeLoader()
+    code_loader.register(
+        "@examples/project-tracker", "1.0.0",
+        ContainerRuntimeFactoryWithDefaultDataStore(TrackerFactory))
+    return Loader(service_factory, code_loader=code_loader,
+                  code_details=CODE_DETAILS)
+
+
+def main():
+    from fluidframework_tpu.loader.drivers.local import (
+        LocalDocumentServiceFactory)
+    from fluidframework_tpu.server.local_server import LocalServer
+
+    server = LocalServer()
+    loader = make_loader(LocalDocumentServiceFactory(server))
+    c1 = loader.create_detached("tracker")
+    c1.attach()
+    c2 = loader.resolve("tracker")
+    a, b = c1.request("/"), c2.request("/")
+    a.create_project("tpu-port", {"owner": "alice"})
+    b.add_task("tpu-port", "t1", {"title": "write kernels",
+                                  "status": "open"})
+    a.add_task("tpu-port", "t2", {"title": "bench", "status": "open"})
+    b.set_status("tpu-port", "t1", "done")
+    assert a.render() == b.render()
+    print(a.render())
+    return a.render()
+
+
+if __name__ == "__main__":
+    main()

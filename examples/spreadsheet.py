@@ -1,0 +1,113 @@
+"""Spreadsheet over SharedMatrix (BASELINE config #3; reference
+examples/data-objects/table-document): cells in a SharedMatrix, concurrent
+row/col insertion, formula cells (=SUM ranges) evaluated on read."""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Optional
+
+from fluidframework_tpu.dds.matrix import SharedMatrix
+from fluidframework_tpu.framework.container_factories import (
+    ContainerRuntimeFactoryWithDefaultDataStore)
+from fluidframework_tpu.framework.data_object import (DataObject,
+                                                      DataObjectFactory)
+from fluidframework_tpu.loader.code_loader import CodeLoader
+from fluidframework_tpu.loader.container import Loader
+
+_FORMULA = re.compile(
+    r"^=SUM\((?P<r1>\d+),(?P<c1>\d+):(?P<r2>\d+),(?P<c2>\d+)\)$")
+
+
+class Spreadsheet(DataObject):
+    def initializing_first_time(self):
+        matrix = self.store.create_channel("cells", SharedMatrix.TYPE)
+        matrix.insert_rows(0, 4)
+        matrix.insert_cols(0, 4)
+
+    @property
+    def matrix(self) -> SharedMatrix:
+        return self.store.get_channel("cells")
+
+    # -- table surface (reference table-document API shape) ----------------
+    @property
+    def num_rows(self) -> int:
+        return self.matrix.row_count
+
+    @property
+    def num_cols(self) -> int:
+        return self.matrix.col_count
+
+    def set_cell(self, row: int, col: int, value: Any) -> None:
+        self.matrix.set_cell(row, col, value)
+
+    def get_cell(self, row: int, col: int) -> Any:
+        return self.matrix.get_cell(row, col)
+
+    def insert_rows(self, at: int, count: int) -> None:
+        self.matrix.insert_rows(at, count)
+
+    def insert_cols(self, at: int, count: int) -> None:
+        self.matrix.insert_cols(at, count)
+
+    def remove_rows(self, at: int, count: int) -> None:
+        self.matrix.remove_rows(at, count)
+
+    def evaluate(self, row: int, col: int) -> Any:
+        """Formula-aware read: \"=SUM(r1,c1:r2,c2)\" sums the inclusive
+        range, skipping blanks/non-numbers (table-document's evaluation
+        role)."""
+        value = self.get_cell(row, col)
+        if not isinstance(value, str):
+            return value
+        m = _FORMULA.match(value)
+        if not m:
+            return value
+        total = 0
+        for r in range(int(m["r1"]), int(m["r2"]) + 1):
+            for c in range(int(m["c1"]), int(m["c2"]) + 1):
+                cell = self.get_cell(r, c)
+                if isinstance(cell, (int, float)):
+                    total += cell
+        return total
+
+    def render(self):
+        return [[self.evaluate(r, c) for c in range(self.num_cols)]
+                for r in range(self.num_rows)]
+
+
+SpreadsheetFactory = DataObjectFactory("spreadsheet", Spreadsheet)
+
+CODE_DETAILS = {"package": "@examples/spreadsheet", "version": "^1.0.0"}
+
+
+def make_loader(service_factory) -> Loader:
+    code_loader = CodeLoader()
+    code_loader.register(
+        "@examples/spreadsheet", "1.0.0",
+        ContainerRuntimeFactoryWithDefaultDataStore(SpreadsheetFactory))
+    return Loader(service_factory, code_loader=code_loader,
+                  code_details=CODE_DETAILS)
+
+
+def main():
+    from fluidframework_tpu.loader.drivers.local import (
+        LocalDocumentServiceFactory)
+    from fluidframework_tpu.server.local_server import LocalServer
+
+    server = LocalServer()
+    loader = make_loader(LocalDocumentServiceFactory(server))
+    c1 = loader.create_detached("sheet")
+    c1.attach()
+    c2 = loader.resolve("sheet")
+    a, b = c1.request("/"), c2.request("/")
+    a.set_cell(0, 0, 10)
+    b.set_cell(0, 1, 32)
+    a.set_cell(1, 0, "=SUM(0,0:0,3)")
+    assert a.evaluate(1, 0) == b.evaluate(1, 0) == 42
+    print("sum:", a.evaluate(1, 0))
+    return a.evaluate(1, 0)
+
+
+if __name__ == "__main__":
+    main()

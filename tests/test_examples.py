@@ -1,0 +1,112 @@
+"""Example applications end-to-end (BASELINE configs #1-4)."""
+
+import random
+
+from examples import (clicker, collaborative_text, project_tracker,
+                      spreadsheet)
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.server.local_server import LocalServer
+
+
+def pair(module, doc_id):
+    server = LocalServer()
+    loader = module.make_loader(LocalDocumentServiceFactory(server))
+    c1 = loader.create_detached(doc_id)
+    c1.attach()
+    c2 = loader.resolve(doc_id)
+    return server, loader, c1.request("/"), c2.request("/")
+
+
+class TestClicker:
+    def test_main(self):
+        assert clicker.main() == 6
+
+    def test_concurrent_clicks_converge(self):
+        _, _, a, b = pair(clicker, "doc")
+        for _ in range(5):
+            a.click()
+            b.click(2)
+        assert a.value == b.value == 15
+
+    def test_summary_reload(self):
+        server, loader, a, b = pair(clicker, "doc")
+        a.click(7)
+        a.container = None  # not used; summarize via the runtime container
+        b_container = loader.resolve("doc")
+        assert b_container.request("/").value == 7
+
+
+class TestSharedTextExample:
+    def test_main(self):
+        out = collaborative_text.main()
+        assert out.startswith("Hello! ")
+
+    def test_comments_track_edits(self):
+        _, _, a, b = pair(collaborative_text, "doc")
+        a.insert(0, "hello world")
+        iv = a.add_comment(6, 10, "note")
+        b.insert(0, "XX ")  # insert before the comment: anchors slide
+        (start, end), _comment = a.comments()[0]
+        assert a.text.get_text()[start:end + 1] == "world"
+        assert b.comments()[0][1] == "note"
+
+    def test_undo(self):
+        _, _, a, b = pair(collaborative_text, "doc")
+        stack = a.make_undo_stack()
+        a.insert(0, "typed")
+        stack.undo_operation()
+        assert a.render() == b.render() == ""
+
+
+class TestSpreadsheetExample:
+    def test_main(self):
+        assert spreadsheet.main() == 42
+
+    def test_concurrent_row_insert_and_formula(self):
+        _, _, a, b = pair(spreadsheet, "doc")
+        a.set_cell(0, 0, 1)
+        a.set_cell(0, 1, 2)
+        b.insert_rows(0, 1)  # concurrent with the sets? sequenced after
+        a.set_cell(0, 0, 100)  # row 0 is now b's inserted row
+        assert a.render() == b.render()
+        b.set_cell(3, 0, "=SUM(0,0:2,3)")
+        assert a.evaluate(3, 0) == b.evaluate(3, 0) >= 100
+
+    def test_random_storm_converges(self):
+        _, _, a, b = pair(spreadsheet, "doc")
+        rng = random.Random(3)
+        for i in range(40):
+            actor = a if i % 2 else b
+            r = rng.randrange(actor.num_rows)
+            c = rng.randrange(actor.num_cols)
+            roll = rng.random()
+            if roll < 0.15:
+                actor.insert_rows(r, 1)
+            elif roll < 0.3:
+                actor.insert_cols(c, 1)
+            else:
+                actor.set_cell(r, c, rng.randrange(100))
+        assert a.render() == b.render()
+
+
+class TestProjectTrackerExample:
+    def test_main(self):
+        out = project_tracker.main()
+        assert out["tpu-port"]["t1"]["status"] == "done"
+
+    def test_concurrent_subtree_edits_merge(self):
+        _, _, a, b = pair(project_tracker, "doc")
+        a.create_project("alpha")
+        b.create_project("beta")
+        a.add_task("beta", "x", {"status": "open"})
+        b.add_task("alpha", "y", {"status": "open"})
+        b.set_status("beta", "x", "done")
+        assert a.render() == b.render()
+        assert a.render()["beta"]["x"]["status"] == "done"
+
+    def test_delete_project_converges(self):
+        _, _, a, b = pair(project_tracker, "doc")
+        a.create_project("temp")
+        b.add_task("temp", "t", {"status": "open"})
+        a.delete_project("temp")
+        assert a.projects() == b.projects() == []
