@@ -1,0 +1,135 @@
+"""Service load/stress rig.
+
+Capability parity with reference packages/test/service-load-test
+(`nodeStressTest.ts:24-33`, `loadTestDataStore.ts`): a configurable
+profile — documents × clients-per-doc × ops, with an op mix across DDS
+types — driven against any service through its driver factory; reports
+throughput and verifies full cross-client convergence per document at the
+end (the rig doubles as an eventual-consistency checker, SURVEY.md §5
+race detection)."""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..dds.counter import SharedCounter
+from ..dds.map import SharedMap
+from ..dds.sequence import SharedString
+from ..loader.container import Container, Loader
+
+
+@dataclass
+class LoadProfile:
+    """Mirrors the reference's profile knobs (docs, clients, op budget)."""
+
+    documents: int = 2
+    clients_per_document: int = 2
+    ops_per_client: int = 50
+    seed: int = 0
+    # Op mix weights: (map set, string insert, string remove, counter inc)
+    weights: tuple = (4, 3, 1, 2)
+    reconnect_probability: float = 0.0  # per-op chance to drop + resubmit
+
+
+@dataclass
+class LoadResult:
+    total_ops: int = 0
+    elapsed_s: float = 0.0
+    documents: int = 0
+    divergences: List[str] = field(default_factory=list)
+
+    @property
+    def ops_per_second(self) -> float:
+        return self.total_ops / self.elapsed_s if self.elapsed_s else 0.0
+
+    @property
+    def converged(self) -> bool:
+        return not self.divergences
+
+
+class LoadRunner:
+    """`loader_factory()` must yield a FRESH Loader per client (each client
+    is its own wire identity), all bound to the same service."""
+
+    def __init__(self, loader_factory: Callable[[], Loader]):
+        self.loader_factory = loader_factory
+
+    def _setup_document(self, doc_id: str, n_clients: int
+                        ) -> List[Container]:
+        creator = self.loader_factory()
+        c0 = creator.create_detached(doc_id)
+        ds = c0.runtime.create_datastore("load")
+        ds.create_channel("map", SharedMap.TYPE)
+        ds.create_channel("text", SharedString.TYPE)
+        ds.create_channel("counter", SharedCounter.TYPE)
+        c0.attach()
+        containers = [c0]
+        for _ in range(n_clients - 1):
+            containers.append(self.loader_factory().resolve(doc_id))
+        return containers
+
+    def _one_op(self, rng: random.Random, client_index: int, op_index: int,
+                container: Container, profile: LoadProfile) -> None:
+        ds = container.runtime.get_datastore("load")
+        kind = rng.choices(("map", "insert", "remove", "counter"),
+                           weights=profile.weights)[0]
+        if kind == "map":
+            # JSON-canonical values only: the writer keeps the submitted
+            # object while replicas see its wire round-trip (a tuple would
+            # come back as a list — same as the reference, which stores the
+            # local JS object as-is).
+            ds.get_channel("map").set(
+                f"k{rng.randrange(32)}", [client_index, op_index])
+        elif kind == "insert":
+            text = ds.get_channel("text")
+            pos = rng.randrange(text.get_length() + 1)
+            text.insert_text(pos, f"c{client_index}.{op_index};")
+        elif kind == "remove":
+            text = ds.get_channel("text")
+            length = text.get_length()
+            if length > 2:
+                start = rng.randrange(length - 1)
+                text.remove_text(start,
+                                 min(length, start + rng.randrange(1, 4)))
+        else:
+            ds.get_channel("counter").increment(rng.randrange(1, 5))
+
+    def run(self, profile: Optional[LoadProfile] = None) -> LoadResult:
+        profile = profile or LoadProfile()
+        result = LoadResult(documents=profile.documents)
+        rng = random.Random(profile.seed)
+        docs: Dict[str, List[Container]] = {}
+        for d in range(profile.documents):
+            doc_id = f"load-doc-{d}"
+            docs[doc_id] = self._setup_document(
+                doc_id, profile.clients_per_document)
+        started = time.perf_counter()
+        for doc_id, containers in docs.items():
+            for op_index in range(profile.ops_per_client):
+                for client_index, container in enumerate(containers):
+                    if (profile.reconnect_probability
+                            and rng.random() < profile.reconnect_probability):
+                        container.reconnect()
+                    self._one_op(rng, client_index, op_index, container,
+                                 profile)
+                    result.total_ops += 1
+        result.elapsed_s = time.perf_counter() - started
+        # -- convergence audit (the race detector role) ---------------------
+        for doc_id, containers in docs.items():
+            views = []
+            for container in containers:
+                ds = container.runtime.get_datastore("load")
+                m = ds.get_channel("map")
+                views.append({
+                    "map": {k: m.get(k) for k in sorted(m.keys())},
+                    "text": ds.get_channel("text").get_text(),
+                    "counter": ds.get_channel("counter").value,
+                })
+            for i, view in enumerate(views[1:], start=1):
+                if view != views[0]:
+                    result.divergences.append(
+                        f"{doc_id}: client {i} diverged from client 0")
+        return result

@@ -1,0 +1,115 @@
+"""Snapshot-format regression corpus.
+
+Capability parity with reference packages/test/snapshots (replays recorded
+documents and byte-compares generated snapshots across code versions) and
+sequence/src/test/snapshotVersion.spec.ts (pins the serialized snapshot
+format against checked-in files): deterministic builders produce documents
+covering every serialization path; their canonical summary bytes are
+hashed and pinned in tests/snapshots/pinned.json. A pin mismatch means the
+on-disk format changed — either a regression, or an intentional format
+evolution that must update the pin file (and, in a live deployment, ship a
+format-version bump with a loader for the old format).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+from typing import Callable, Dict
+
+from ..dds.directory import SharedDirectory
+from ..dds.map import SharedMap
+from ..dds.matrix import SharedMatrix
+from ..dds.sequence import SharedNumberSequence, SharedString
+from ..loader.container import Container
+from ..loader.drivers.local import LocalDocumentServiceFactory
+from ..protocol.summary import summary_tree_to_dict
+from ..server.local_server import LocalServer
+
+
+def _detached(doc_id: str) -> Container:
+    service = LocalDocumentServiceFactory(
+        LocalServer()).create_document_service(doc_id)
+    return Container.create_detached(doc_id, service)
+
+
+def build_text_document() -> Container:
+    c = _detached("pin-text")
+    ds = c.runtime.create_datastore("default")
+    text = ds.create_channel("text", SharedString.TYPE)
+    text.insert_text(0, "The quick brown fox jumps over the lazy dog. " * 8)
+    text.insert_marker(45, {"type": "paragraph"})
+    text.annotate_range(4, 9, {"fontWeight": "bold"})
+    text.remove_text(10, 16)
+    text.insert_text(0, "Title\n", {"header": 1})
+    return c
+
+
+def build_kv_document() -> Container:
+    c = _detached("pin-kv")
+    ds = c.runtime.create_datastore("default")
+    m = ds.create_channel("map", SharedMap.TYPE)
+    for i in range(16):
+        m.set(f"key-{i:02d}", {"index": i, "squares": [i, i * i]})
+    m.delete("key-03")
+    d = ds.create_channel("dir", SharedDirectory.TYPE)
+    d.set("top", "level")
+    sub = d.create_sub_directory("nested")
+    sub.set("deep", {"a": [1, 2, 3]})
+    return c
+
+
+def build_matrix_document() -> Container:
+    random.seed(1234)  # permutation-vector run nonces draw from global rng
+    c = _detached("pin-matrix")
+    ds = c.runtime.create_datastore("default")
+    mx = ds.create_channel("matrix", SharedMatrix.TYPE)
+    mx.insert_rows(0, 8)
+    mx.insert_cols(0, 4)
+    for r in range(8):
+        mx.set_cell(r, r % 4, r * 10)
+    mx.remove_rows(2, 2)
+    return c
+
+
+def build_sequence_document() -> Container:
+    c = _detached("pin-numseq")
+    ds = c.runtime.create_datastore("default")
+    ns = ds.create_channel("nums", SharedNumberSequence.TYPE)
+    ns.insert_range(0, list(range(20)))
+    ns.remove_range(5, 10)
+    ns.insert_range(3, [100, 200])
+    return c
+
+
+BUILDERS: Dict[str, Callable[[], Container]] = {
+    "text": build_text_document,
+    "kv": build_kv_document,
+    "matrix": build_matrix_document,
+    "number-sequence": build_sequence_document,
+}
+
+
+def canonical(container: Container) -> str:
+    return json.dumps(summary_tree_to_dict(container._assemble_summary()),
+                      sort_keys=True)
+
+
+def corpus_digests() -> Dict[str, str]:
+    return {name: hashlib.sha256(canonical(build()).encode()).hexdigest()
+            for name, build in BUILDERS.items()}
+
+
+def write_pins(path: str) -> Dict[str, str]:
+    digests = corpus_digests()
+    with open(path, "w") as f:
+        json.dump(digests, f, indent=1, sort_keys=True)
+    return digests
+
+
+if __name__ == "__main__":
+    import sys
+    out = sys.argv[1] if len(sys.argv) > 1 else "tests/snapshots/pinned.json"
+    for name, digest in write_pins(out).items():
+        print(f"{name}: {digest}")

@@ -1,0 +1,149 @@
+"""Layer check: machine-enforced package layering.
+
+Capability parity with reference tools/build-tools/src/layerCheck (the
+build step that validates the dependency DAG documented in
+docs/PACKAGES.md; README.md:52-54): every subpackage declares which
+subpackages it may import; an import outside the matrix fails the build.
+Imports guarded by `if TYPE_CHECKING:` are type-only and exempt (they
+erase at runtime), mirroring layer-check's type-only allowance.
+
+Run: `python -m fluidframework_tpu.tools.layer_check` (exit 1 on
+violation); `tests/test_quality_gates.py` runs it in CI.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, NamedTuple, Optional, Set
+
+# The layering matrix, bottom-up (SURVEY.md §1 mapped onto this package).
+ALLOWED: Dict[str, Set[str]] = {
+    "core": set(),
+    "protocol": {"core"},
+    "telemetry": {"core", "protocol"},
+    "parallel": {"core"},
+    "mergetree": {"core", "protocol", "telemetry", "parallel"},
+    # native is the C++ transport under the server; it shares the server's
+    # queued-message types (the reference's librdkafka binding lives inside
+    # the services package the same way).
+    "native": {"core", "server"},
+    "dds": {"core", "protocol", "mergetree"},
+    "runtime": {"core", "protocol", "dds"},
+    "server": {"core", "protocol", "mergetree", "native", "telemetry",
+               "parallel"},
+    # loader's local/network drivers bind to the in-process server (the
+    # reference's local-driver -> local-server edge, SURVEY.md §1).
+    "loader": {"core", "protocol", "runtime", "telemetry", "server", "dds"},
+    "framework": {"core", "protocol", "dds", "runtime"},
+    # testing hosts the load rig + snapshot corpus, which drive the full
+    # stack like the reference's test-utils/localLoader does.
+    "testing": {"core", "protocol", "dds", "runtime", "loader", "server"},
+    "hosts": {"core", "loader", "runtime", "framework"},
+    "client_api": {"core", "dds", "loader"},
+    "agents": {"core", "dds", "loader", "framework"},
+    "tools": {"core", "protocol", "mergetree", "loader"},
+}
+
+# Per-module exceptions (module path relative to the package root).
+EXCEPTIONS: Dict[str, Set[str]] = {
+    # The gateway is a host service that happens to live under server/
+    # (reference server/gateway is S3 aux, above the client stack).
+    "server/gateway.py": {"loader", "framework"},
+}
+
+
+class Violation(NamedTuple):
+    module: str
+    line: int
+    imports: str
+    reason: str
+
+    def __str__(self) -> str:
+        return (f"{self.module}:{self.line}: imports {self.imports!r} — "
+                f"{self.reason}")
+
+
+def _runtime_imports(tree: ast.AST) -> List[ast.stmt]:
+    """All import nodes NOT under an `if TYPE_CHECKING:` guard."""
+    type_only: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.If):
+            test = node.test
+            name = (test.id if isinstance(test, ast.Name) else
+                    test.attr if isinstance(test, ast.Attribute) else None)
+            if name == "TYPE_CHECKING":
+                for child in ast.walk(node):
+                    type_only.add(id(child))
+    return [node for node in ast.walk(tree)
+            if isinstance(node, (ast.Import, ast.ImportFrom))
+            and id(node) not in type_only]
+
+
+def _target_package(node, module_rel_parts: List[str],
+                    package_name: str) -> Optional[str]:
+    """Top-level subpackage an import lands in, or None if external."""
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            if alias.name == package_name or \
+                    alias.name.startswith(package_name + "."):
+                parts = alias.name.split(".")
+                return parts[1] if len(parts) > 1 else None
+        return None
+    if node.module and node.module.startswith(package_name):
+        parts = node.module.split(".")
+        return parts[1] if len(parts) > 1 else None
+    if node.level:  # relative import
+        base = module_rel_parts[:-1]
+        up = node.level - 1
+        if up:
+            base = base[:-up] if up <= len(base) else []
+        mod_parts = (node.module or "").split(".") if node.module else []
+        full = base + [p for p in mod_parts if p]
+        return full[0] if full else None
+    return None
+
+
+def check(package_root: str, allowed: Optional[Dict[str, Set[str]]] = None,
+          exceptions: Optional[Dict[str, Set[str]]] = None
+          ) -> List[Violation]:
+    allowed = ALLOWED if allowed is None else allowed
+    exceptions = EXCEPTIONS if exceptions is None else exceptions
+    package_name = os.path.basename(os.path.abspath(package_root))
+    violations: List[Violation] = []
+    for root, _dirs, files in os.walk(package_root):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_root)
+            parts = rel.split(os.sep)
+            pkg = parts[0][:-3] if parts[0].endswith(".py") else parts[0]
+            if pkg not in allowed:
+                continue  # top-level modules (e.g. client_api.py) map by name
+            permitted = allowed[pkg] | exceptions.get(
+                rel.replace(os.sep, "/"), set())
+            tree = ast.parse(open(path).read())
+            for node in _runtime_imports(tree):
+                target = _target_package(node, parts, package_name)
+                if target and target != pkg and target not in permitted:
+                    violations.append(Violation(
+                        rel, node.lineno, target,
+                        f"layer {pkg!r} may import only "
+                        f"{sorted(permitted)}"))
+    return violations
+
+
+def main() -> int:
+    import sys
+    root = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    found = check(root)
+    for violation in found:
+        print(violation)
+    print(f"layer-check: {len(found)} violation(s)")
+    return 1 if found else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

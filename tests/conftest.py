@@ -3,8 +3,21 @@ sharding paths (mesh/pjit/shard_map) are exercised without TPU hardware."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard override (not setdefault): the ambient environment may export
+# JAX_PLATFORMS=axon (the real-TPU tunnel); tests must stay hermetic on
+# the virtual 8-device CPU mesh regardless.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Plugins (e.g. jaxtyping's) may import jax before this conftest runs, in
+# which case jax captured the ambient JAX_PLATFORMS at import time; override
+# through the live config as well (backends have not initialized yet).
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover
+    pass

@@ -1,0 +1,86 @@
+"""Build-quality gates: layer-check DAG enforcement, snapshot-format pins,
+service load/stress rig (reference layer-check build step, test/snapshots,
+service-load-test)."""
+
+import json
+import os
+import textwrap
+
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.server.local_server import LocalServer
+from fluidframework_tpu.testing.load_test import LoadProfile, LoadRunner
+from fluidframework_tpu.testing.snapshot_corpus import corpus_digests
+from fluidframework_tpu.tools.layer_check import ALLOWED, check
+
+PACKAGE_ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "fluidframework_tpu")
+PINS_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "snapshots", "pinned.json")
+
+
+class TestLayerCheck:
+    def test_package_satisfies_layering(self):
+        violations = check(PACKAGE_ROOT)
+        assert violations == [], "\n".join(map(str, violations))
+
+    def test_detects_violation(self, tmp_path):
+        pkg = tmp_path / "fakepkg"
+        (pkg / "core").mkdir(parents=True)
+        (pkg / "dds").mkdir()
+        (pkg / "core" / "__init__.py").write_text(
+            "from ..dds import thing\n")
+        (pkg / "dds" / "__init__.py").write_text("thing = 1\n")
+        violations = check(str(pkg), allowed={"core": set(), "dds": {"core"}},
+                           exceptions={})
+        assert len(violations) == 1
+        assert violations[0].imports == "dds"
+
+    def test_type_checking_imports_exempt(self, tmp_path):
+        pkg = tmp_path / "fakepkg"
+        (pkg / "core").mkdir(parents=True)
+        (pkg / "core" / "__init__.py").write_text(textwrap.dedent("""
+            from typing import TYPE_CHECKING
+            if TYPE_CHECKING:
+                from ..dds import thing
+        """))
+        violations = check(str(pkg), allowed={"core": set()}, exceptions={})
+        assert violations == []
+
+    def test_matrix_covers_every_subpackage(self):
+        subpackages = {name for name in os.listdir(PACKAGE_ROOT)
+                       if os.path.isdir(os.path.join(PACKAGE_ROOT, name))
+                       and not name.startswith("__")}
+        missing = subpackages - set(ALLOWED)
+        assert not missing, f"layer matrix missing {sorted(missing)}"
+
+
+class TestSnapshotPins:
+    def test_formats_match_pins(self):
+        with open(PINS_PATH) as f:
+            pinned = json.load(f)
+        current = corpus_digests()
+        assert current == pinned, (
+            "snapshot format drift — if intentional, regenerate pins with "
+            "`python -m fluidframework_tpu.testing.snapshot_corpus "
+            "tests/snapshots/pinned.json` and note the format change")
+
+
+class TestLoadRig:
+    def _runner(self):
+        server = LocalServer()
+        return LoadRunner(
+            lambda: Loader(LocalDocumentServiceFactory(server)))
+
+    def test_profile_runs_and_converges(self):
+        result = self._runner().run(LoadProfile(
+            documents=2, clients_per_document=3, ops_per_client=30, seed=5))
+        assert result.total_ops == 2 * 3 * 30
+        assert result.converged, result.divergences
+        assert result.ops_per_second > 0
+
+    def test_reconnect_storm_still_converges(self):
+        result = self._runner().run(LoadProfile(
+            documents=1, clients_per_document=2, ops_per_client=40,
+            seed=11, reconnect_probability=0.05))
+        assert result.converged, result.divergences
