@@ -95,6 +95,12 @@ def main() -> None:
     capacity = int(os.environ.get("BENCH_CAPACITY", "256"))
 
     import jax
+
+    # BENCH_PLATFORM=cpu forces the host backend through jax.config (the
+    # env var alone is not enough where a site hook pins a plugin backend).
+    platform = os.environ.get("BENCH_PLATFORM")
+    if platform:
+        jax.config.update("jax_platforms", platform)
     from fluidframework_tpu.mergetree import kernel
     from fluidframework_tpu.mergetree.oppack import PackedOps
     from fluidframework_tpu.mergetree.state import make_state
