@@ -15,24 +15,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Tuple
 
-
-def parse_version(version: str) -> Tuple[int, ...]:
-    return tuple(int(p) for p in version.split("."))
-
-
-def satisfies(version: str, spec: str) -> bool:
-    """Minimal semver-range check: exact, "^x.y.z" (same major, >=),
-    "~x.y.z" (same major.minor, >=), "*" / "latest" (any)."""
-    if spec in ("*", "latest", "", None):
-        return True
-    v = parse_version(version)
-    if spec.startswith("^"):
-        base = parse_version(spec[1:])
-        return v[0] == base[0] and v >= base
-    if spec.startswith("~"):
-        base = parse_version(spec[1:])
-        return v[:2] == base[:2] and v >= base
-    return v == parse_version(spec)
+from ..core.semver import parse_version, satisfies  # noqa: F401 (re-export)
 
 
 class FluidModule:

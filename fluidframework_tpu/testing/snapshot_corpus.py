@@ -34,6 +34,15 @@ def _detached(doc_id: str) -> Container:
     return Container.create_detached(doc_id, service)
 
 
+def _commit_detached(container: Container) -> Container:
+    """Fold pending detached edits into permanent channel state (the attach
+    path does this via store.connect) WITHOUT attaching — attaching would
+    pull in wire client ids (uuid-based) and break determinism."""
+    for store in container.runtime.datastores.values():
+        store.connect()
+    return container
+
+
 def build_text_document() -> Container:
     c = _detached("pin-text")
     ds = c.runtime.create_datastore("default")
@@ -43,7 +52,7 @@ def build_text_document() -> Container:
     text.annotate_range(4, 9, {"fontWeight": "bold"})
     text.remove_text(10, 16)
     text.insert_text(0, "Title\n", {"header": 1})
-    return c
+    return _commit_detached(c)
 
 
 def build_kv_document() -> Container:
@@ -57,7 +66,7 @@ def build_kv_document() -> Container:
     d.set("top", "level")
     sub = d.create_sub_directory("nested")
     sub.set("deep", {"a": [1, 2, 3]})
-    return c
+    return _commit_detached(c)
 
 
 def build_matrix_document() -> Container:
@@ -70,7 +79,7 @@ def build_matrix_document() -> Container:
     for r in range(8):
         mx.set_cell(r, r % 4, r * 10)
     mx.remove_rows(2, 2)
-    return c
+    return _commit_detached(c)
 
 
 def build_sequence_document() -> Container:
@@ -80,7 +89,7 @@ def build_sequence_document() -> Container:
     ns.insert_range(0, list(range(20)))
     ns.remove_range(5, 10)
     ns.insert_range(3, [100, 200])
-    return c
+    return _commit_detached(c)
 
 
 BUILDERS: Dict[str, Callable[[], Container]] = {
