@@ -36,8 +36,11 @@ class CodeLoader:
 
     def register(self, package: str, version: str, runtime_factory: Any
                  ) -> None:
-        self._registry.setdefault(package, []).append(
-            (version, runtime_factory))
+        entries = self._registry.setdefault(package, [])
+        # Re-registering a version replaces it (registry resolvers may
+        # install the same resolved bundle for several containers).
+        entries[:] = [(v, f) for v, f in entries if v != version]
+        entries.append((version, runtime_factory))
 
     def load(self, details: Dict[str, Any]) -> FluidModule:
         """Resolve code details {"package": name, "version": range} to the
@@ -51,5 +54,5 @@ class CodeLoader:
         if not candidates:
             raise KeyError(
                 f"no registered module satisfies {package}@{spec}")
-        _, version, factory = max(candidates)
+        _, version, factory = max(candidates, key=lambda c: c[0])
         return FluidModule(factory, package, version)

@@ -135,6 +135,10 @@ class DeltaManager(TypedEventEmitter):
                         if msg.sequence_number > self.last_sequence_number + 1:
                             gap = (self.last_sequence_number,
                                    msg.sequence_number - 1)
+                            # The fetch is network I/O, not processing time:
+                            # close the slice so it isn't billed against the
+                            # quantum (a spurious yield per gap otherwise).
+                            self.scheduler.drain_done()
                             break
                         self._inbound.pop(0)
                         self.scheduler.op_started()

@@ -90,6 +90,10 @@ class CachingStorageService(IDocumentStorageService):
         self.key = key
 
     def get_summary(self, version: Optional[str] = None):
+        if version is not None:
+            # Explicit historical version: bypass the cache entirely (the
+            # cache only ever holds the document head).
+            return self.inner.get_summary(version)
         entry = self.cache.get(self.key)
         versions = self.inner.get_versions(1)
         head = versions[0] if versions else None
@@ -97,7 +101,7 @@ class CachingStorageService(IDocumentStorageService):
             return summary_tree_from_dict(entry["summary"])
         # Epoch moved (another client summarized) or cold: refresh.
         self.cache.remove(self.key)
-        summary = self.inner.get_summary(version)
+        summary = self.inner.get_summary()
         if summary is not None:
             self.cache.put(self.key, {
                 "version": head,
@@ -132,12 +136,23 @@ class CachingDeltaStorage(IDocumentDeltaStorageService):
     def get(self, from_seq: int, to_seq: Optional[int] = None
             ) -> List[SequencedDocumentMessage]:
         entry = self.cache.get(self.key)
+        # Only the CONTIGUOUS cached run starting at from_seq+1 is usable —
+        # a cached tail beyond a hole (e.g. ops that arrived over the live
+        # stream and were never cached) must not mask the hole.
         cached: List[SequencedDocumentMessage] = []
         if entry is not None:
-            cached = [message_from_json(d) for d in entry.get("ops", [])
-                      if d["sequenceNumber"] > from_seq
-                      and (to_seq is None or d["sequenceNumber"] <= to_seq)]
-        start = max([from_seq] + [m.sequence_number for m in cached])
+            run = sorted((message_from_json(d) for d in entry.get("ops", [])
+                          if d["sequenceNumber"] > from_seq
+                          and (to_seq is None
+                               or d["sequenceNumber"] <= to_seq)),
+                         key=lambda m: m.sequence_number)
+            expect = from_seq + 1
+            for m in run:
+                if m.sequence_number != expect:
+                    break
+                cached.append(m)
+                expect += 1
+        start = cached[-1].sequence_number if cached else from_seq
         fetched = self.inner.get(start, to_seq)
         if fetched and entry is not None:
             known = {d["sequenceNumber"] for d in entry.get("ops", [])}

@@ -159,10 +159,15 @@ class ContainerRuntime(TypedEventEmitter):
             MessageType.OPERATION, contents,
             before_send=lambda csn: self.pending.on_submit(csn, contents))
 
+    # Per-chunk envelope + framing headroom: each CHUNKED_OP message
+    # (payload + chunkId/totalChunks + message fields) must itself fit
+    # under the service's op-size limit.
+    CHUNK_ENVELOPE_HEADROOM = 512
+
     def _send_chunked(self, serialized: str) -> None:
         """Split one oversized op into CHUNKED_OP messages; receivers
         reassemble per client and apply on the final chunk."""
-        size = self.max_op_size
+        size = max(1, self.max_op_size - self.CHUNK_ENVELOPE_HEADROOM)
         pieces = [serialized[i:i + size]
                   for i in range(0, len(serialized), size)]
         total = len(pieces)

@@ -50,6 +50,7 @@ class GatewayService:
                  port: int = 0):
         self.loader = loader
         self.containers: Dict[str, Container] = {}
+        self._adapters: Dict[tuple, object] = {}  # (doc, path) -> ViewAdapter
         self._lock = threading.Lock()
         service = self
 
@@ -132,12 +133,18 @@ class GatewayService:
             })
 
     def _serve_view(self, handler, doc_id: str, path: str) -> None:
-        """Render through the code-loaded data object's own view surface."""
+        """Render through the code-loaded data object's own view surface.
+        One adapter per (doc, path) — adapters subscribe to channel events
+        for their lifetime, so per-request adapters would leak listeners on
+        the resident container."""
         from ..framework.views import ViewAdapter
         container = self._container(doc_id)
-        obj = container.request(path)
+        with self._lock:
+            adapter = self._adapters.get((doc_id, path))
+            if adapter is None:
+                adapter = ViewAdapter(container.request(path))
+                self._adapters[(doc_id, path)] = adapter
         frames = []
-        adapter = ViewAdapter(obj)
         adapter.mount(frames.append)
         adapter.unmount()
         _send(handler, 200, {"documentId": doc_id, "view": frames[-1]})

@@ -115,8 +115,12 @@ class PackageRegistryService:
                     return _send(handler, 200, resolved)
                 if method == "POST" and len(parts) == 3:
                     length = int(handler.headers.get("Content-Length", 0))
-                    manifest = json.loads(
-                        handler.rfile.read(length)) if length else {}
+                    raw = handler.rfile.read(length) if length else b""
+                    try:
+                        manifest = json.loads(raw) if raw else {}
+                    except json.JSONDecodeError as exc:
+                        return _send(handler, 400,
+                                     {"error": f"malformed body: {exc}"})
                     self.store.publish(name, parts[2], manifest)
                     return _send(handler, 201, {"published":
                                                 f"{name}@{parts[2]}"})

@@ -67,6 +67,38 @@ class TestCachingDriver:
         t2.insert_text(0, "both ")
         assert s.get_text() == "both live cached content"
 
+    def test_cached_tail_beyond_hole_not_served(self):
+        """Cached ops past an uncached hole must not mask the hole
+        (review finding: contiguity check in CachingDeltaStorage.get)."""
+        server, c1, s = seeded_server()
+        cache = PersistentCache()
+        factory = CachingDocumentServiceFactory(
+            LocalDocumentServiceFactory(server), cache)
+        service = factory.create_document_service("doc")
+        service.connect_to_storage().get_summary()  # creates the cache entry
+        delta = service.connect_to_delta_storage()
+        full = delta.get(0)  # populates cached op tail
+        # Simulate a hole: drop the first two cached ops.
+        entry = cache.get("doc")
+        entry["ops"] = entry["ops"][2:]
+        cache.put("doc", entry)
+        refetched = delta.get(0)
+        assert [m.sequence_number for m in refetched] == \
+            [m.sequence_number for m in full]
+
+    def test_explicit_version_bypasses_cache(self):
+        server, c1, s = seeded_server()
+        cache = PersistentCache()
+        factory = CachingDocumentServiceFactory(
+            LocalDocumentServiceFactory(server), cache)
+        storage = factory.create_document_service("doc") \
+            .connect_to_storage()
+        head = storage.get_summary()          # populates cache with head
+        assert cache.get("doc") is not None
+        version_entry = dict(cache.get("doc"))
+        storage.get_summary(version="some-old-sha")  # must not poison cache
+        assert cache.get("doc")["version"] == version_entry["version"]
+
     def test_token_refresh_on_auth_failure(self):
         calls = []
 
