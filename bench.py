@@ -139,6 +139,20 @@ def main() -> None:
     overflow = bool(np.asarray(out[1].overflow).any())
     total_ops = n_docs * n_ops
     ops_per_sec = total_ops / elapsed
+
+    # Summary catch-up p50 (the second driver metric, BASELINE.json): a
+    # client's catch-up = load summary + replay the op tail. Device analog:
+    # one full pipeline step over the whole doc batch's tail; p50 over
+    # repeated trials from fresh (summary-loaded) state.
+    trials = []
+    for _ in range(5):
+        t_i, m_i = fresh()
+        jax.block_until_ready((t_i, m_i))
+        t0 = time.perf_counter()
+        r = step(t_i, m_i, raw, ops)
+        np.asarray(r[3])
+        trials.append(time.perf_counter() - t0)
+    catchup_p50_ms = sorted(trials)[len(trials) // 2] * 1000.0
     result = {
         "metric": "merge-tree ops applied/sec across "
                   f"{n_docs} docs (ticket+apply+summary-len)",
@@ -150,6 +164,7 @@ def main() -> None:
             "elapsed_s": round(elapsed, 4),
             "docs": n_docs, "ops_per_doc": n_ops,
             "baseline_single_thread_ops_s": round(baseline_ops_per_sec, 1),
+            "summary_catchup_p50_ms": round(catchup_p50_ms, 2),
             "overflow": overflow,
         },
     }

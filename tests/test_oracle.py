@@ -183,7 +183,8 @@ class Replica:
         self.tree.update_seq(seq)
 
 
-def run_farm(n_clients, rounds, ops_per_round, seed, with_annotate=True):
+def run_farm(n_clients, rounds, ops_per_round, seed, with_annotate=True,
+             advance_min_seq=False):
     rng = random.Random(seed)
     replicas = [Replica(i) for i in range(n_clients)]
     seq = 0
@@ -225,6 +226,12 @@ def run_farm(n_clients, rounds, ops_per_round, seed, with_annotate=True):
             log.append((op, seq))
             for rep in replicas:
                 rep.apply_sequenced(op, seq)
+        if advance_min_seq and seq > 0:
+            # All replicas are caught up after the round: the collab window
+            # closes behind them and zamboni compacts mid-farm (the
+            # reference farms advance the MSN the same way).
+            for rep in replicas:
+                rep.tree.set_min_seq(seq - 1)
     texts = [rep.tree.get_text() for rep in replicas]
     assert all(tx == texts[0] for tx in texts), (
         f"divergence (seed {seed}): {texts}")
@@ -251,6 +258,30 @@ class TestConflictFarm:
     @pytest.mark.parametrize("seed", range(4))
     def test_converges_more_clients(self, seed):
         run_farm(n_clients=6, rounds=3, ops_per_round=2, seed=100 + seed)
+
+    @pytest.mark.parametrize("n_clients", [2, 4, 8, 16])
+    def test_converges_scaling_with_window_close(self, n_clients):
+        """Reference conflictFarm growth (1-32 clients, growing docs) with
+        the MSN advancing each round so zamboni compacts mid-farm."""
+        replicas, _ = run_farm(n_clients=n_clients, rounds=4,
+                               ops_per_round=3, seed=7000 + n_clients,
+                               advance_min_seq=True)
+        # Window closed: tombstones from fully-acked removes are compacted.
+        for rep in replicas:
+            live = rep.tree.get_length()
+            slots = sum(seg.length for seg in rep.tree.segments
+                        if seg.rem_seq is None)
+            assert slots == live
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_zamboni_farm_matches_unzambonied(self, seed):
+        """Same schedule with and without window advancement must read
+        identically — compaction is invisible to content."""
+        with_z, _ = run_farm(n_clients=4, rounds=3, ops_per_round=3,
+                             seed=9000 + seed, advance_min_seq=True)
+        without_z, _ = run_farm(n_clients=4, rounds=3, ops_per_round=3,
+                                seed=9000 + seed, advance_min_seq=False)
+        assert with_z[0].tree.get_text() == without_z[0].tree.get_text()
 
     def test_props_converge(self):
         replicas, _ = run_farm(n_clients=3, rounds=5, ops_per_round=3, seed=7)
