@@ -1,0 +1,117 @@
+"""Pallas TPU kernels for merge-tree reductions.
+
+The summary-length pass — per-document total visible length at the acked
+perspective — runs once per pipeline step over the whole `[docs, capacity]`
+segment table (SURVEY.md §3 hot loop (d): summary gather). The XLA version
+materializes the visibility mask and masked lengths as separate `[B, C]`
+intermediates; this Pallas kernel fuses predicate + mask + reduce into one
+VMEM pass per document tile, so each segment column is read from HBM
+exactly once and nothing is written back but the `[B]` totals.
+
+At the acked/global perspective (client = OBSERVER, ref_seq = state.seq)
+the predicate needs only (ins_seq, rem_seq, count): pending-insert and
+overlap-remove columns cannot affect visibility at an acked ref_seq.
+
+`summary_lengths()` dispatches: Pallas on TPU backends (or when forced),
+the jnp fallback elsewhere. `interpret=True` runs the same kernel through
+the Pallas interpreter for CPU correctness tests.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .state import DocState
+
+_DOC_TILE = 8  # int32 sublane tile
+
+_PALLAS_OK = None  # lazily probed once per process
+
+
+def _pallas_available() -> bool:
+    """Compile + run a tiny kernel once; a Mosaic failure on an exotic
+    backend (e.g. the tunneled TPU) falls back to the jnp path instead of
+    poisoning the pipeline jit. Concrete-input probe: safe to call during
+    an outer trace (no tracers involved)."""
+    global _PALLAS_OK
+    if _PALLAS_OK is None:
+        try:
+            from jax.experimental import pallas as pl
+
+            def probe_kernel(x_ref, o_ref):
+                o_ref[:] = x_ref[:] * 2
+
+            x = jnp.ones((_DOC_TILE, 128), jnp.int32)
+            out = pl.pallas_call(
+                probe_kernel,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)
+            jax.block_until_ready(out)
+            _PALLAS_OK = bool((out == 2).all())
+        except Exception:  # noqa: BLE001 — any backend failure => fallback
+            _PALLAS_OK = False
+    return _PALLAS_OK
+
+
+def _summary_len_kernel(length_ref, ins_seq_ref, rem_seq_ref, count_ref,
+                        seq_ref, out_ref):
+    idx = jax.lax.broadcasted_iota(jnp.int32, length_ref.shape, 1)
+    count = count_ref[:, 0][:, None]
+    seq = seq_ref[:, 0][:, None]
+    vis = ((idx < count) & (ins_seq_ref[:] <= seq)
+           & ~(rem_seq_ref[:] <= seq))
+    out_ref[:, 0] = jnp.sum(jnp.where(vis, length_ref[:], 0), axis=1)
+
+
+def _pallas_summary_lengths(state: DocState, interpret: bool) -> jnp.ndarray:
+    from jax.experimental import pallas as pl
+
+    batch, capacity = state.length.shape
+    padded = ((batch + _DOC_TILE - 1) // _DOC_TILE) * _DOC_TILE
+    pad = padded - batch
+
+    def pad_rows(x, fill):
+        return jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1),
+                       constant_values=fill) if pad else x
+
+    length = pad_rows(state.length, 0)
+    ins_seq = pad_rows(state.ins_seq, 1)
+    rem_seq = pad_rows(state.rem_seq, 0)
+    count = pad_rows(state.count.reshape(batch, 1), 0)
+    seq = pad_rows(state.seq.reshape(batch, 1), 0)
+
+    grid = (padded // _DOC_TILE,)
+    row_block = lambda block: pl.BlockSpec(  # noqa: E731
+        block, lambda i: (i, 0))
+    out = pl.pallas_call(
+        _summary_len_kernel,
+        out_shape=jax.ShapeDtypeStruct((padded, 1), state.length.dtype),
+        grid=grid,
+        in_specs=[row_block((_DOC_TILE, capacity)),
+                  row_block((_DOC_TILE, capacity)),
+                  row_block((_DOC_TILE, capacity)),
+                  row_block((_DOC_TILE, 1)),
+                  row_block((_DOC_TILE, 1))],
+        out_specs=row_block((_DOC_TILE, 1)),
+        interpret=interpret,
+    )(length, ins_seq, rem_seq, count, seq)
+    return out[:batch, 0]
+
+
+def _jnp_summary_lengths(state: DocState) -> jnp.ndarray:
+    idx = jax.lax.broadcasted_iota(jnp.int32, state.length.shape, 1)
+    seq = state.seq[:, None]
+    vis = ((idx < state.count[:, None]) & (state.ins_seq <= seq)
+           & ~(state.rem_seq <= seq))
+    return jnp.sum(jnp.where(vis, state.length, 0), axis=1)
+
+
+def summary_lengths(state: DocState, force_pallas: bool = False,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Per-document visible length at the acked perspective for a BATCHED
+    DocState. Pallas on TPU, jnp elsewhere."""
+    if interpret or force_pallas:
+        return _pallas_summary_lengths(state, interpret=interpret)
+    if jax.default_backend() in ("tpu", "axon") and _pallas_available():
+        return _pallas_summary_lengths(state, interpret=False)
+    return _jnp_summary_lengths(state)

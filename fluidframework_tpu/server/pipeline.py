@@ -16,6 +16,7 @@ import jax.numpy as jnp
 
 from ..mergetree import kernel
 from ..mergetree.oppack import OpKind
+from ..mergetree.pallas_ops import summary_lengths
 from . import ticket_kernel as tk
 
 
@@ -30,6 +31,7 @@ def full_step(tstate, mstate, raw, ops):
         msn=jnp.where(admitted, ticketed.min_seq, ops.msn),
     )
     mstate = kernel._scan_ops(mstate, ops, batched=True)
-    total_len = jax.vmap(
-        lambda s: kernel.visibility(s, s.seq, -2)[1].sum())(mstate)
+    # Summary-length reduction: fused Pallas pass on TPU, jnp elsewhere
+    # (mergetree/pallas_ops.py; semantics == visibility(s, s.seq, OBSERVER)).
+    total_len = summary_lengths(mstate)
     return tstate, mstate, ticketed, total_len
