@@ -1,0 +1,184 @@
+"""Op-tensor bridge: gRPC ingress for packed op batches.
+
+Capability parity with the reference's client↔service wire at partition
+scale (SURVEY.md §2.7 / BASELINE north star: "Node↔Python gRPC bridge with
+packed op tensors... the gRPC hop must amortize via partition-sized
+batches"): an external producer — a JS front door, another host, a replay
+rig — ships a whole partition batch of ops as ONE packed int32 tensor
+frame; the bridge runs the fused device pipeline (ticket + apply + summary
+lengths) and returns the ticketed assignments in one packed reply.
+
+No protoc codegen: methods are registered with identity (bytes) serializers
+and a fixed little-endian frame layout, so any language with a gRPC client
+and a struct packer can speak it:
+
+  request  := header(int32 x2: n_docs, n_steps) ++ 10 column tensors
+              (PackedOps field order, int32 [n_docs, n_steps], C order)
+  response := header(int32 x2) ++ seq[int32 B,T] ++ min_seq[int32 B,T]
+              ++ nack[int32 B,T] ++ total_len[int32 B]
+
+Sessions are keyed by metadata ("session-id"); each session owns persistent
+device state, so successive batches continue the same documents.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+SERVICE = "fluidframework.OpBridge"
+_HEADER = np.dtype("<i4")
+
+
+def encode_ops(cols: Dict[str, np.ndarray]) -> bytes:
+    """Pack gen_traces-style columns (PackedOps field order) into a frame."""
+    from ..mergetree.oppack import PackedOps
+    first = cols[PackedOps._fields[0]]
+    b, t = first.shape
+    parts = [np.asarray([b, t], dtype=_HEADER).tobytes()]
+    for field in PackedOps._fields:
+        col = np.ascontiguousarray(cols[field], dtype=np.int32)
+        assert col.shape == (b, t), f"column {field} shape {col.shape}"
+        parts.append(col.tobytes())
+    return b"".join(parts)
+
+
+def decode_ops(frame: bytes):
+    from ..mergetree.oppack import PackedOps
+    b, t = np.frombuffer(frame, dtype=_HEADER, count=2)
+    size = int(b) * int(t) * 4
+    offset = 8
+    cols = {}
+    for field in PackedOps._fields:
+        cols[field] = np.frombuffer(
+            frame, dtype=np.int32, count=b * t, offset=offset
+        ).reshape(b, t)
+        offset += size
+    return int(b), int(t), cols
+
+
+def encode_reply(seq: np.ndarray, min_seq: np.ndarray, nack: np.ndarray,
+                 total_len: np.ndarray) -> bytes:
+    b, t = seq.shape
+    return b"".join([
+        np.asarray([b, t], dtype=_HEADER).tobytes(),
+        np.ascontiguousarray(seq, np.int32).tobytes(),
+        np.ascontiguousarray(min_seq, np.int32).tobytes(),
+        np.ascontiguousarray(nack, np.int32).tobytes(),
+        np.ascontiguousarray(total_len, np.int32).tobytes(),
+    ])
+
+
+def decode_reply(frame: bytes):
+    b, t = np.frombuffer(frame, dtype=_HEADER, count=2)
+    b, t = int(b), int(t)
+    n = b * t
+    seq = np.frombuffer(frame, np.int32, n, 8).reshape(b, t)
+    min_seq = np.frombuffer(frame, np.int32, n, 8 + 4 * n).reshape(b, t)
+    nack = np.frombuffer(frame, np.int32, n, 8 + 8 * n).reshape(b, t)
+    total = np.frombuffer(frame, np.int32, b, 8 + 12 * n)
+    return {"seq": seq, "minSeq": min_seq, "nack": nack, "totalLen": total}
+
+
+class _Session:
+    def __init__(self, n_docs: int, capacity: int):
+        from ..mergetree.state import make_state
+        from . import ticket_kernel as tk
+        self.tstate = tk.make_ticket_state(8, batch=n_docs)
+        self.mstate = make_state(capacity, 1, batch=n_docs)
+        self.lock = threading.Lock()
+
+
+class OpBridgeServer:
+    def __init__(self, capacity: int = 256, port: int = 0,
+                 max_workers: int = 4):
+        import grpc
+        from .pipeline import full_step
+        self._step = jax.jit(full_step)
+        self.capacity = capacity
+        self.sessions: Dict[Tuple[str, int], _Session] = {}
+        self._lock = threading.Lock()
+        service = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                if handler_call_details.method == f"/{SERVICE}/SubmitBatch":
+                    return grpc.unary_unary_rpc_method_handler(
+                        service._submit_batch)
+                if handler_call_details.method == f"/{SERVICE}/Ping":
+                    return grpc.unary_unary_rpc_method_handler(
+                        lambda req, ctx: b"pong")
+                return None
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+
+    def start(self) -> "OpBridgeServer":
+        self._server.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.stop(grace=1)
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    # -- the one hot RPC ----------------------------------------------------
+    def _submit_batch(self, request: bytes, context) -> bytes:
+        import jax.numpy as jnp
+        from ..mergetree.oppack import PackedOps
+        from . import ticket_kernel as tk
+        session_id = dict(context.invocation_metadata()).get(
+            "session-id", "default")
+        b, t, cols = decode_ops(request)
+        key = (session_id, b)
+        with self._lock:
+            session = self.sessions.get(key)
+            if session is None:
+                session = _Session(b, self.capacity)
+                self.sessions[key] = session
+        ops = PackedOps(**{f: jnp.asarray(cols[f])
+                           for f in PackedOps._fields})
+        raw = tk.RawOps(client=ops.client, client_seq=ops.seq,
+                        ref_seq=ops.ref_seq)
+        with session.lock:
+            session.tstate, session.mstate, ticketed, total_len = \
+                self._step(session.tstate, session.mstate, raw, ops)
+            seq = np.asarray(ticketed.seq)
+            min_seq = np.asarray(ticketed.min_seq)
+            nack = np.asarray(ticketed.nacked).astype(np.int32)
+            total = np.asarray(total_len)
+        return encode_reply(seq, min_seq, nack, total)
+
+
+class OpBridgeClient:
+    def __init__(self, address: str, session_id: str = "default"):
+        import grpc
+        self._channel = grpc.insecure_channel(address)
+        self.session_id = session_id
+        self._submit = self._channel.unary_unary(
+            f"/{SERVICE}/SubmitBatch",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        self._ping = self._channel.unary_unary(
+            f"/{SERVICE}/Ping",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+
+    def ping(self) -> bool:
+        return self._ping(b"") == b"pong"
+
+    def submit_batch(self, cols: Dict[str, np.ndarray]) -> dict:
+        reply = self._submit(encode_ops(cols),
+                             metadata=(("session-id", self.session_id),))
+        return decode_reply(reply)
+
+    def close(self) -> None:
+        self._channel.close()
