@@ -69,7 +69,8 @@ class DeliLambda(IPartitionLambda):
     def __init__(self, context: LambdaContext,
                  emit: Callable[[str, SequencedDocumentMessage], None],
                  nack: Callable[[str, str, Nack], None],
-                 checkpoints=None, fresh_log: bool = False):
+                 checkpoints=None, fresh_log: bool = False,
+                 config=None):
         """emit(document_id, sequenced_message); nack(document_id,
         client_id, nack). checkpoints: optional Collection for state dumps —
         restored at construction so a crash-restarted lambda resumes from
@@ -86,6 +87,22 @@ class DeliLambda(IPartitionLambda):
         self.nack = nack
         self.docs: Dict[str, DocumentDeliState] = {}
         self.checkpoints = checkpoints
+        # Batched checkpointing (reference deli/checkpointContext.ts with
+        # checkpointBatchSize / checkpointTimeIntervalMsec from the nconf
+        # config, routerlicious/config/config.json:62-68): the state dump
+        # AND the offset commit move together — committing an offset beyond
+        # the saved state would shrink the crash-replay window below what
+        # the state needs. Default batch size 1 = checkpoint every message.
+        self.checkpoint_batch_size = 1
+        self.checkpoint_interval_s = 0.0
+        if config is not None:
+            self.checkpoint_batch_size = int(config.get(
+                "deli.checkpointBatchSize", 1))
+            self.checkpoint_interval_s = float(config.get(
+                "deli.checkpointTimeIntervalMsec", 0)) / 1000.0
+        self._uncheckpointed = 0
+        self._last_checkpoint_time = time.monotonic()
+        self._pending_offset: Optional[int] = None
         if checkpoints is not None:
             for row in checkpoints.find(lambda d: "documentId" in d):
                 state = self.load_state(row["state"])
@@ -103,11 +120,34 @@ class DeliLambda(IPartitionLambda):
         for raw in boxcar.contents:
             self._ticket(doc_id, state, boxcar.client_id, raw)
         state.log_offset = message.offset
-        self.context.checkpoint(message.offset)
+        self._pending_offset = message.offset
+        self._uncheckpointed += 1
+        now = time.monotonic()
+        due = (self._uncheckpointed >= self.checkpoint_batch_size
+               or (self.checkpoint_interval_s
+                   and now - self._last_checkpoint_time
+                   >= self.checkpoint_interval_s))
+        if due:
+            self.flush_checkpoint()
+
+    def flush_checkpoint(self) -> None:
+        """Write all document states + commit the consumer offset."""
+        if self._pending_offset is None:
+            return
         if self.checkpoints is not None:
-            self.checkpoints.upsert(
-                lambda d, _id=doc_id: d.get("documentId") == _id,
-                {"documentId": doc_id, "state": self._dump(state)})
+            for doc_id, state in self.docs.items():
+                self.checkpoints.upsert(
+                    lambda d, _id=doc_id: d.get("documentId") == _id,
+                    {"documentId": doc_id, "state": self._dump(state)})
+        self.context.checkpoint(self._pending_offset)
+        self._pending_offset = None
+        self._uncheckpointed = 0
+        self._last_checkpoint_time = time.monotonic()
+
+    def close(self) -> None:
+        # Graceful close flushes; a crash (no close) replays the batch —
+        # exactly the reference's at-least-once window.
+        self.flush_checkpoint()
 
     def _dump(self, state: DocumentDeliState) -> dict:
         return {

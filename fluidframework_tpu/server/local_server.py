@@ -84,7 +84,8 @@ class LocalServer:
                  auto_pump: bool = True,
                  native_log: Optional[bool] = False,
                  db: Optional[DatabaseManager] = None,
-                 historian: Optional[Historian] = None):
+                 historian: Optional[Historian] = None,
+                 config=None):
         """native_log: False = pure-Python broker (default, the LocalKafka
         role); True = the C++ engine (requires the toolchain); None = auto.
 
@@ -118,12 +119,21 @@ class LocalServer:
         self.log.topic(DELTAS_TOPIC)
 
         self.runner = LambdaRunner()
+        # Per-service config (the reference's nconf slice per lambda,
+        # services-core/src/lambdas.ts:56). Batched deli checkpointing
+        # requires the pump's eager offset commit OFF so the replay window
+        # matches the saved state.
+        self.config = config
+        deli_batched = bool(config is not None and int(
+            config.get("deli.checkpointBatchSize", 1)) > 1)
         self._deli_mgr = self.runner.add(PartitionManager(
             self.log, "deli", RAW_TOPIC,
             lambda ctx: DeliLambda(ctx, emit=self._emit_sequenced,
                                    nack=self._emit_nack,
                                    checkpoints=self.deli_checkpoints,
-                                   fresh_log=True)))
+                                   fresh_log=True,
+                                   config=self.config),
+            auto_commit=not deli_batched))
         self._copier_mgr = self.runner.add(PartitionManager(
             self.log, "copier", RAW_TOPIC,
             lambda ctx: CopierLambda(ctx, self.raw_deltas)))

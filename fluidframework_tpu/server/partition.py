@@ -91,12 +91,15 @@ class PartitionManager:
     """Spawns a pump per partition of a topic (partitionManager.ts:22)."""
 
     def __init__(self, log: MessageLog, group: str, topic: str,
-                 lambda_factory: Callable[[LambdaContext], IPartitionLambda]):
+                 lambda_factory: Callable[[LambdaContext], IPartitionLambda],
+                 auto_commit: bool = True):
         self.log = log
         self.pumps: Dict[int, PartitionPump] = {}
         topic_obj = log.topic(topic)
         for p in range(len(topic_obj.partitions)):
-            self.pumps[p] = PartitionPump(log, group, topic, p, lambda_factory)
+            self.pumps[p] = PartitionPump(log, group, topic, p,
+                                          lambda_factory,
+                                          auto_commit=auto_commit)
 
     def pump_all(self) -> int:
         return sum(p.pump() for p in self.pumps.values())
