@@ -143,6 +143,13 @@ class MergeTreeOracle:
         self._uid_counter = 0
         # FIFO of pending local op segment groups (reference pendingSegments).
         self.pending_groups: List[Tuple[str, List[Segment], dict]] = []
+        # Local-perspective visible length, maintained incrementally (the
+        # reference's root partial-lengths cache role for the hot
+        # getLength() call): at the local perspective a segment is visible
+        # iff rem_seq is None — all acked inserts are <= current_seq (the
+        # caller advances seq before applying), own pending inserts are
+        # visible, and foreign pending segments never exist in a replica.
+        self._local_len = 0
 
     # ------------------------------------------------------------------
     # visibility
@@ -171,19 +178,47 @@ class MergeTreeOracle:
 
     def visible_length(self, seg: Segment, ref_seq: int, client: int,
                        local_seq: Optional[int] = None) -> int:
-        if self._inserted_at(seg, ref_seq, client, local_seq) and \
-           not self._removed_at(seg, ref_seq, client, local_seq):
-            return seg.length
-        return 0
+        # _inserted_at/_removed_at inlined: this predicate dominates every
+        # walk (profile: ~5M calls per 2k-op session before inlining).
+        ins = seg.ins_seq
+        if not (ins != UNASSIGNED_SEQ and ins <= ref_seq):
+            if seg.ins_client != client:
+                return 0
+            if local_seq is not None and seg.local_seq is not None \
+                    and seg.local_seq > local_seq:
+                return 0
+        rem = seg.rem_seq
+        if rem is not None:
+            if rem != UNASSIGNED_SEQ and rem <= ref_seq:
+                return 0
+            if seg.rem_client == client or client in seg.rem_overlap:
+                if local_seq is None or seg.rem_local_seq is None \
+                        or seg.rem_local_seq <= local_seq:
+                    return 0
+        text = seg.text
+        return len(text) if seg.kind == SEG_TEXT else 1
 
     # ------------------------------------------------------------------
     # queries
     # ------------------------------------------------------------------
     def get_length(self, ref_seq: Optional[int] = None,
                    client: Optional[int] = None) -> int:
+        if ref_seq is None and client is None:
+            return self._local_len  # O(1) hot path (local perspective)
         ref_seq = self.current_seq if ref_seq is None else ref_seq
         client = self.local_client if client is None else client
         return sum(self.visible_length(s, ref_seq, client) for s in self.segments)
+
+    def verify_local_length(self) -> None:
+        """Self-check mode (reference PartialSequenceLengths.options.verify,
+        partialLengths.ts:64-67): the incremental counter must equal the
+        full local-perspective reduction."""
+        actual = sum(self.visible_length(s, self.current_seq,
+                                         self.local_client)
+                     for s in self.segments)
+        if actual != self._local_len:
+            raise AssertionError(
+                f"local length cache {self._local_len} != walked {actual}")
 
     def get_text(self, ref_seq: Optional[int] = None,
                  client: Optional[int] = None) -> str:
@@ -320,6 +355,7 @@ class MergeTreeOracle:
             self._new_pending_group("insert").append(seg)
             seg.local_seq = self.local_seq_counter
         self.segments.insert(idx, seg)
+        self._local_len += seg.length  # new segments are never removed
         return seg
 
     def insert_text(self, pos: int, text: str, ref_seq: int, client: int,
@@ -382,6 +418,7 @@ class MergeTreeOracle:
             else:
                 seg.rem_seq = seq
                 seg.rem_client = client
+                self._local_len -= seg.length  # None -> removed transition
                 if seq == UNASSIGNED_SEQ:
                     if pending_group is None:
                         pending_group = self._new_pending_group("remove")
@@ -655,4 +692,6 @@ class MergeTreeOracle:
                 uid=tree._next_uid(),
             )
             tree.segments.append(seg)
+            if seg.rem_seq is None:
+                tree._local_len += seg.length
         return tree

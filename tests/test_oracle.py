@@ -235,6 +235,8 @@ def run_farm(n_clients, rounds, ops_per_round, seed, with_annotate=True,
     texts = [rep.tree.get_text() for rep in replicas]
     assert all(tx == texts[0] for tx in texts), (
         f"divergence (seed {seed}): {texts}")
+    for rep in replicas:  # partial-lengths verify mode (SURVEY §5)
+        rep.tree.verify_local_length()
     # God-view sequenced replay converges to the same text.
     god = god_tree()
     for op, s in log:
