@@ -1,0 +1,165 @@
+"""Remote ordered-log service: the networked broker deployment shape.
+
+Capability parity with the reference's Kafka deployment topology
+(docker-compose.yml: every lambda service — deli, scriptorium, scribe,
+broadcaster — is a separate process connecting to the broker over the
+network through librdkafka): `LogServiceServer` exposes a MessageLog
+(pure-Python or the native C++ engine) over gRPC raw-bytes methods, and
+`RemoteMessageLog` is a drop-in consumer/producer surface — the same
+`topic().partitions[].read()` / `send` / `commit` contract the partition
+host and lambdas already use in-process — so a `LambdaRunner` can run in a
+different process (or host, over DCN) from the broker.
+
+Payloads are pickled across the wire (a trusted internal link, exactly the
+role rdkafka's serialized frames play; the front door speaking to untrusted
+clients is alfred's REST/WebSocket + JWT, not this)."""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from concurrent import futures
+from typing import List, Optional
+
+from .log import MessageLog, QueuedMessage
+
+SERVICE = "fluidframework.LogService"
+
+
+class LogServiceServer:
+    def __init__(self, log: Optional[MessageLog] = None, port: int = 0,
+                 max_workers: int = 8):
+        import grpc
+        self.log = log if log is not None else MessageLog()
+        service = self
+
+        def method(fn):
+            return grpc.unary_unary_rpc_method_handler(fn)
+
+        handlers = {
+            f"/{SERVICE}/Send": method(service._send),
+            f"/{SERVICE}/Read": method(service._read),
+            f"/{SERVICE}/Commit": method(service._commit),
+            f"/{SERVICE}/Committed": method(service._committed),
+            f"/{SERVICE}/Topic": method(service._topic),
+        }
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, details):
+                return handlers.get(details.method)
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers))
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self.port = self._server.add_insecure_port(f"127.0.0.1:{port}")
+
+    def start(self) -> "LogServiceServer":
+        self._server.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.stop(grace=1)
+
+    @property
+    def address(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    # -- methods (request/response are pickled tuples) ----------------------
+    def _send(self, request: bytes, context) -> bytes:
+        topic, key, value = pickle.loads(request)
+        msg = self.log.send(topic, key, value)
+        return pickle.dumps(msg.offset)
+
+    def _read(self, request: bytes, context) -> bytes:
+        topic, partition, offset, limit = pickle.loads(request)
+        msgs = self.log.topic(topic).partitions[partition].read(offset, limit)
+        return pickle.dumps([(m.offset, m.key, m.value) for m in msgs])
+
+    def _commit(self, request: bytes, context) -> bytes:
+        group, topic, partition, offset = pickle.loads(request)
+        self.log.commit(group, topic, partition, offset)
+        return pickle.dumps(True)
+
+    def _committed(self, request: bytes, context) -> bytes:
+        group, topic, partition = pickle.loads(request)
+        return pickle.dumps(self.log.committed(group, topic, partition))
+
+    def _topic(self, request: bytes, context) -> bytes:
+        name, partitions = pickle.loads(request)
+        topic = self.log.topic(name, partitions)
+        return pickle.dumps(len(topic.partitions))
+
+
+class _RemotePartition:
+    def __init__(self, client: "RemoteMessageLog", topic: str, index: int):
+        self._client = client
+        self.topic = topic
+        self.index = index
+
+    def read(self, offset: int, limit: int = 1000) -> List[QueuedMessage]:
+        rows = self._client._call("Read",
+                                  (self.topic, self.index, offset, limit))
+        return [QueuedMessage(self.topic, self.index, off, key, value)
+                for off, key, value in rows]
+
+
+class _RemoteTopic:
+    def __init__(self, client: "RemoteMessageLog", name: str,
+                 n_partitions: int):
+        self.name = name
+        self.partitions = [_RemotePartition(client, name, i)
+                           for i in range(n_partitions)]
+
+
+class RemoteMessageLog:
+    """MessageLog-compatible client over a LogServiceServer."""
+
+    def __init__(self, address: str, default_partitions: int = 1):
+        import grpc
+        self._channel = grpc.insecure_channel(address)
+        self.default_partitions = default_partitions
+        self._methods = {}
+        self._topics = {}
+        self._lock = threading.Lock()
+
+    def _call(self, name: str, payload):
+        with self._lock:
+            stub = self._methods.get(name)
+            if stub is None:
+                stub = self._channel.unary_unary(
+                    f"/{SERVICE}/{name}",
+                    request_serializer=lambda b: b,
+                    response_deserializer=lambda b: b)
+                self._methods[name] = stub
+        return pickle.loads(stub(pickle.dumps(payload)))
+
+    # -- MessageLog surface --------------------------------------------------
+    def topic(self, name: str, partitions: Optional[int] = None
+              ) -> _RemoteTopic:
+        known = self._topics.get(name)
+        if known is None or (partitions is not None
+                             and partitions != len(known.partitions)):
+            n = self._call("Topic",
+                           (name, partitions or self.default_partitions))
+            known = _RemoteTopic(self, name, n)
+            self._topics[name] = known
+        return known
+
+    def send(self, topic: str, key: str, value) -> QueuedMessage:
+        offset = self._call("Send", (topic, key, value))
+        return QueuedMessage(topic, 0, offset, key, value)
+
+    def poll(self, group: str, topic: str, partition: int = 0,
+             limit: int = 1000) -> List[QueuedMessage]:
+        start = self.committed(group, topic, partition)
+        return self.topic(topic).partitions[partition].read(start, limit)
+
+    def commit(self, group: str, topic: str, partition: int,
+               offset: int) -> None:
+        self._call("Commit", (group, topic, partition, offset))
+
+    def committed(self, group: str, topic: str, partition: int) -> int:
+        return self._call("Committed", (group, topic, partition))
+
+    def close(self) -> None:
+        self._channel.close()
