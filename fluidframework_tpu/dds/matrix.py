@@ -183,22 +183,66 @@ class SharedMatrix(SharedObject):
     def insert_rows(self, pos: int, count: int) -> None:
         op = self.rows.insert_local(pos, count)
         self.submit_local_message({"target": "rows", "op": op})
-        self.emit("rowsChanged", pos, count, True)
+        self.emit("rowsChanged", pos, count, True, None)
 
     def insert_cols(self, pos: int, count: int) -> None:
         op = self.cols.insert_local(pos, count)
         self.submit_local_message({"target": "cols", "op": op})
-        self.emit("colsChanged", pos, count, True)
+        self.emit("colsChanged", pos, count, True, None)
+
+    def _capture_axis(self, axis: str, pos: int, count: int) -> dict:
+        """Cell contents of the rows/cols about to be removed, keyed by the
+        OTHER axis's stable ids — the undo provider reinserts fresh
+        rows/cols and restores by surviving-axis identity (reference
+        matrix undoprovider.ts revert via tracked segments)."""
+        if axis == "rows":
+            gone = [_id_key(r) for r in self.rows.ids_in_order()[
+                pos:pos + count]]
+            other = [_id_key(c) for c in self.cols.ids_in_order()]
+            cells = [{c: self.cells[g + "|" + c] for c in other
+                      if g + "|" + c in self.cells} for g in gone]
+        else:
+            gone = [_id_key(c) for c in self.cols.ids_in_order()[
+                pos:pos + count]]
+            other = [_id_key(r) for r in self.rows.ids_in_order()]
+            cells = [{r: self.cells[r + "|" + g] for r in other
+                      if r + "|" + g in self.cells} for g in gone]
+        return {"cells": cells}
 
     def remove_rows(self, pos: int, count: int) -> None:
+        captured = self._capture_axis("rows", pos, count)
         op = self.rows.remove_local(pos, count)
         self.submit_local_message({"target": "rows", "op": op})
-        self.emit("rowsChanged", pos, -count, True)
+        self.emit("rowsChanged", pos, -count, True, captured)
 
     def remove_cols(self, pos: int, count: int) -> None:
+        captured = self._capture_axis("cols", pos, count)
         op = self.cols.remove_local(pos, count)
         self.submit_local_message({"target": "cols", "op": op})
-        self.emit("colsChanged", pos, -count, True)
+        self.emit("colsChanged", pos, -count, True, captured)
+
+    # -- undo support -------------------------------------------------------
+    def restore_rows(self, pos: int, captured: dict) -> None:
+        """Reinsert removed rows and restore their cells against columns
+        that still exist (by stable column id)."""
+        cells = captured["cells"]
+        self.insert_rows(pos, len(cells))
+        col_ids = {_id_key(c): i
+                   for i, c in enumerate(self.cols.ids_in_order())}
+        for i, row_cells in enumerate(cells):
+            for col_id, value in row_cells.items():
+                if col_id in col_ids:
+                    self.set_cell(pos + i, col_ids[col_id], value)
+
+    def restore_cols(self, pos: int, captured: dict) -> None:
+        cells = captured["cells"]
+        self.insert_cols(pos, len(cells))
+        row_ids = {_id_key(r): i
+                   for i, r in enumerate(self.rows.ids_in_order())}
+        for i, col_cells in enumerate(cells):
+            for row_id, value in col_cells.items():
+                if row_id in row_ids:
+                    self.set_cell(row_ids[row_id], pos + i, value)
 
     # -- cells ---------------------------------------------------------------
     def _cell_key(self, row: int, col: int) -> str:
@@ -207,11 +251,12 @@ class SharedMatrix(SharedObject):
 
     def set_cell(self, row: int, col: int, value: Any) -> None:
         key = self._cell_key(row, col)
+        previous = self.cells.get(key)
         self.cells[key] = value
         self._pending_cells[key] = self._pending_cells.get(key, 0) + 1
         self.submit_local_message(
             {"target": "cell", "key": key, "value": value})
-        self.emit("cellChanged", row, col, value, True)
+        self.emit("cellChanged", row, col, value, True, previous)
 
     def get_cell(self, row: int, col: int) -> Any:
         return self.cells.get(self._cell_key(row, col))
@@ -237,8 +282,10 @@ class SharedMatrix(SharedObject):
                 return
             if key in self._pending_cells:
                 return  # pending local write shadows (reference set-vs-set)
+            previous = self.cells.get(key)
             self.cells[key] = contents["value"]
-            self.emit("cellChanged", None, None, contents["value"], False)
+            self.emit("cellChanged", None, None, contents["value"], False,
+                      previous)
             return
         vector = self.rows if target == "rows" else self.cols
         if local:

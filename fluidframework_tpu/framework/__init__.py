@@ -26,6 +26,7 @@ from .request_handler import (
 from .synthesize import DependencyContainer
 from .undo_redo import (
     SharedMapUndoRedoHandler,
+    SharedMatrixUndoRedoHandler,
     SharedSegmentSequenceUndoRedoHandler,
     UndoRedoStackManager,
 )
@@ -39,8 +40,8 @@ __all__ = [
     "create_shared_string_with_interception",
     "RequestHandlerChain", "RequestParser", "datastore_route_handler",
     "DependencyContainer",
-    "SharedMapUndoRedoHandler", "SharedSegmentSequenceUndoRedoHandler",
-    "UndoRedoStackManager",
+    "SharedMapUndoRedoHandler", "SharedMatrixUndoRedoHandler",
+    "SharedSegmentSequenceUndoRedoHandler", "UndoRedoStackManager",
     "LastEditedTracker", "setup_last_edited_tracking",
     "LazyLoadedDataObject", "LazyLoadedDataObjectFactory",
     "MountableView", "SyncedDataObject", "ViewAdapter", "use_synced_state",

@@ -141,3 +141,49 @@ class SharedSegmentSequenceUndoRedoHandler:
                 self.manager.push(revert_annotate)
 
         sequence.on("sequenceDelta", on_delta)
+
+
+class SharedMatrixUndoRedoHandler:
+    """Pushes revertibles for local SharedMatrix changes (reference
+    matrix/src/undoprovider.ts): cell set -> restore previous value;
+    row/col insert -> remove them; row/col remove -> reinsert + restore the
+    captured cells by surviving-axis stable ids."""
+
+    def __init__(self, manager: UndoRedoStackManager):
+        self.manager = manager
+
+    def attach(self, matrix) -> None:
+        def on_cell(row, col, value, local, previous=None):
+            if not local or row is None:
+                return
+
+            def revert_cell():
+                matrix.set_cell(row, col, previous)
+
+            self.manager.push(revert_cell)
+
+        def on_axis(pos, count, local, captured=None, *, axis):
+            if not local:
+                return
+            if count > 0:
+                def revert_insert():
+                    if axis == "rows":
+                        matrix.remove_rows(pos, count)
+                    else:
+                        matrix.remove_cols(pos, count)
+                self.manager.push(revert_insert)
+            elif captured is not None:
+                def revert_remove():
+                    if axis == "rows":
+                        matrix.restore_rows(pos, captured)
+                    else:
+                        matrix.restore_cols(pos, captured)
+                self.manager.push(revert_remove)
+
+        matrix.on("cellChanged", on_cell)
+        matrix.on("rowsChanged",
+                  lambda pos, count, local, captured=None:
+                  on_axis(pos, count, local, captured, axis="rows"))
+        matrix.on("colsChanged",
+                  lambda pos, count, local, captured=None:
+                  on_axis(pos, count, local, captured, axis="cols"))

@@ -1,0 +1,86 @@
+"""SharedMatrix undo-redo (reference matrix/src/undoprovider.ts)."""
+
+from fluidframework_tpu.dds.matrix import SharedMatrix
+from fluidframework_tpu.framework import (SharedMatrixUndoRedoHandler,
+                                          UndoRedoStackManager)
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.server.local_server import LocalServer
+
+
+def make_pair():
+    server = LocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.create_detached("doc")
+    m1 = c1.runtime.create_datastore("d").create_channel(
+        "mx", SharedMatrix.TYPE)
+    m1.insert_rows(0, 3)
+    m1.insert_cols(0, 3)
+    c1.attach()
+    c2 = loader.resolve("doc")
+    m2 = c2.runtime.get_datastore("d").get_channel("mx")
+    return m1, m2
+
+
+def with_undo(matrix):
+    manager = UndoRedoStackManager()
+    SharedMatrixUndoRedoHandler(manager).attach(matrix)
+    return manager
+
+
+class TestMatrixUndo:
+    def test_cell_set_undo_redo(self):
+        m1, m2 = make_pair()
+        undo = with_undo(m1)
+        m1.set_cell(1, 1, "first")
+        m1.set_cell(1, 1, "second")
+        undo.undo_operation()
+        assert m1.get_cell(1, 1) == m2.get_cell(1, 1) == "first"
+        undo.undo_operation()
+        assert m1.get_cell(1, 1) is None and m2.get_cell(1, 1) is None
+        undo.redo_operation()
+        assert m2.get_cell(1, 1) == "first"
+
+    def test_insert_rows_undo(self):
+        m1, m2 = make_pair()
+        undo = with_undo(m1)
+        m1.insert_rows(1, 2)
+        assert m2.row_count == 5
+        undo.undo_operation()
+        assert m1.row_count == m2.row_count == 3
+
+    def test_remove_rows_undo_restores_cells(self):
+        m1, m2 = make_pair()
+        m1.set_cell(1, 0, "keep-a")
+        m1.set_cell(1, 2, "keep-b")
+        undo = with_undo(m1)
+        undo.open_current_operation()
+        m1.remove_rows(1, 1)
+        undo.close_current_operation()
+        assert m2.row_count == 2
+        undo.undo_operation()
+        assert m1.row_count == m2.row_count == 3
+        assert m2.get_cell(1, 0) == "keep-a"
+        assert m2.get_cell(1, 2) == "keep-b"
+
+    def test_remove_cols_undo_restores_cells(self):
+        m1, m2 = make_pair()
+        m1.set_cell(0, 1, 11)
+        m1.set_cell(2, 1, 22)
+        undo = with_undo(m1)
+        undo.open_current_operation()
+        m1.remove_cols(1, 1)
+        undo.close_current_operation()
+        undo.undo_operation()
+        assert m1.col_count == 3
+        assert m2.get_cell(0, 1) == 11 and m2.get_cell(2, 1) == 22
+
+    def test_undo_converges_across_clients(self):
+        m1, m2 = make_pair()
+        undo = with_undo(m1)
+        m1.set_cell(0, 0, "x")
+        m2.set_cell(2, 2, "y")  # remote activity interleaves
+        undo.undo_operation()
+        assert m1.extract() == m2.extract()
+        assert m2.get_cell(2, 2) == "y"
+        assert m2.get_cell(0, 0) is None
