@@ -61,6 +61,11 @@ class SharedObject(TypedEventEmitter):
         self.runtime = runtime
         self.attached = False
         self._handle: Optional[FluidHandle] = None
+        # Bumped on every state change; incremental summaries emit a handle
+        # to the previous summary's subtree when the epoch matches the last
+        # ACKED summary (reference SummaryTracker / ISummarizeInternal
+        # trackState, sharedObject.ts:210).
+        self.change_epoch = 0
 
     # -- identity ----------------------------------------------------------
     @property
@@ -88,11 +93,13 @@ class SharedObject(TypedEventEmitter):
     def submit_local_message(self, contents: Any) -> None:
         """Send a channel op into the runtime (no-op while detached —
         detached state ships via the attach summary instead)."""
+        self.change_epoch += 1
         if self.attached and self.runtime is not None:
             self.runtime.submit_channel_op(self.id, contents)
 
     def process(self, contents: Any, local: bool, seq: int, ref_seq: int,
                 client_ordinal: int, min_seq: int) -> None:
+        self.change_epoch += 1  # any sequenced op dirties the channel
         self.process_core(contents, local, seq, ref_seq, client_ordinal,
                           min_seq)
 

@@ -30,6 +30,7 @@ class SharedSummaryBlock(SharedObject):
         must be JSON-serializable (they go straight into the blob)."""
         json.dumps(value)  # fail fast on non-serializable input
         self.data[key] = value
+        self.change_epoch += 1  # no ops flow: dirty explicitly
         return value
 
     def process_core(self, contents, local, seq, ref_seq, client_ordinal,

@@ -52,6 +52,11 @@ class ContainerRuntime(TypedEventEmitter):
         self._chunk_buffers: Dict[str, List[str]] = {}
         # Datastores created while live whose attach op is unacked.
         self._pending_store_attach: Dict[str, dict] = {}
+        # Incremental-summary bookkeeping: channel epochs as of the last
+        # ACKED summary (only against that baseline may a new summary emit
+        # subtree handles), and epochs captured per in-flight upload.
+        self._acked_epochs: Dict[str, int] = {}
+        self._upload_epochs: Dict[str, Dict[str, int]] = {}
         self.datastores: Dict[str, DataStoreRuntime] = {}
         self.pending = PendingStateManager()
         self.attached = submit_fn is not None
@@ -268,12 +273,34 @@ class ContainerRuntime(TypedEventEmitter):
         self.set_connected(True)
 
     # -- summary / load ----------------------------------------------------
-    def summarize(self) -> SummaryTree:
+    def all_channel_epochs(self) -> Dict[str, int]:
+        epochs: Dict[str, int] = {}
+        for store in self.datastores.values():
+            epochs.update(store.channel_epochs())
+        return epochs
+
+    def record_upload(self, handle: str) -> None:
+        """Remember the epochs a just-uploaded summary serialized; they
+        become the acked baseline if/when that summary is acked."""
+        self._upload_epochs[handle] = self.all_channel_epochs()
+
+    def on_summary_ack(self, handle: Optional[str]) -> None:
+        if handle in self._upload_epochs:
+            self._acked_epochs = self._upload_epochs.pop(handle)
+            self._upload_epochs.clear()  # older proposals are dead
+
+    def baseline_epochs(self) -> None:
+        """The current state IS durable (attach upload or fresh load):
+        everything may summarize incrementally until it changes."""
+        self._acked_epochs = self.all_channel_epochs()
+
+    def summarize(self, incremental: bool = False) -> SummaryTree:
         gc = self.run_gc()
         tree = SummaryTree()
         stores = tree.add_tree(".dataStores")
         for store_id, store in sorted(self.datastores.items()):
-            stores.entries[store_id] = store.summarize()
+            stores.entries[store_id] = store.summarize(
+                incremental=incremental, acked_epochs=self._acked_epochs)
         if len(self.blob_manager):
             tree.entries[".blobs"] = self.blob_manager.summarize()
         tree.add_blob(".metadata", json.dumps({

@@ -156,12 +156,28 @@ class DataStoreRuntime(TypedEventEmitter):
         for channel in self.channels.values():
             channel.connect()
 
-    def summarize(self) -> SummaryTree:
+    def summarize(self, incremental: bool = False,
+                  acked_epochs: Optional[Dict[str, int]] = None
+                  ) -> SummaryTree:
+        """incremental=True: channels unchanged since the last ACKED summary
+        serialize as a handle to the previous summary's same-position
+        subtree (reference trackState/SummaryTracker; the storage layer
+        resolves handles against the parent commit)."""
+        from ..protocol.summary import SummaryHandle
+        acked_epochs = acked_epochs or {}
         tree = SummaryTree()
         channels = tree.add_tree(".channels")
         for channel_id, channel in sorted(self.channels.items()):
-            channels.entries[channel_id] = channel.summarize()
+            key = f"{self.id}/{channel_id}"
+            if incremental and acked_epochs.get(key) == channel.change_epoch:
+                channels.entries[channel_id] = SummaryHandle("/")
+            else:
+                channels.entries[channel_id] = channel.summarize()
         return tree
+
+    def channel_epochs(self) -> Dict[str, int]:
+        return {f"{self.id}/{cid}": ch.change_epoch
+                for cid, ch in self.channels.items()}
 
     def load(self, tree: SummaryTree) -> None:
         import json
