@@ -1,0 +1,60 @@
+"""Host-platform forcing for tests, entrypoints, and tools.
+
+The ambient environment may pin an accelerator plugin backend (e.g. the
+axon TPU tunnel) via a site hook that registers it through jax.config at
+interpreter start.  That has two consequences every caller must respect:
+
+- ``JAX_PLATFORMS=cpu`` in the environment is NOT enough — the site hook's
+  config registration beats the env var; only
+  ``jax.config.update("jax_platforms", "cpu")`` after ``import jax`` wins.
+- Probing real devices first is NOT safe — ``jax.devices()`` initializes
+  the plugin backend, and if its tunnel is unreachable the init blocks
+  forever in native code (SIGALRM does not land).
+
+This module is the single implementation of the force-CPU-with-virtual-
+devices recipe used by tests/conftest.py, __graft_entry__.py, and tools.
+"""
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_host_platform(n_devices: int = 8) -> None:
+    """Force the CPU backend with ``n_devices`` virtual devices.
+
+    Must run BEFORE any jax backend initialization.  Safe to call whether
+    or not jax is already imported.  If backends are already initialized
+    with an incompatible platform/device count, raises RuntimeError with a
+    clear message instead of silently running on the wrong backend.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if _COUNT_FLAG in flags:
+        # Replace, don't defer: a stale count from an earlier run would
+        # leave fewer virtual devices than the caller requires.
+        flags = re.sub(rf"{_COUNT_FLAG}=\d+", f"{_COUNT_FLAG}={n_devices}",
+                       flags)
+    else:
+        flags = (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    initialized = False
+    try:
+        from jax._src import xla_bridge
+
+        initialized = xla_bridge.backends_are_initialized()
+    except (ImportError, AttributeError):  # pragma: no cover - jax internals
+        pass
+    if initialized:
+        if jax.default_backend() != "cpu" or len(jax.devices()) < n_devices:
+            raise RuntimeError(
+                "jax backends already initialized "
+                f"({jax.default_backend()}, {len(jax.devices())} devices); "
+                f"cannot force cpu x {n_devices} — call force_host_platform "
+                "before any jax.devices()/jit use")
+        return
+    jax.config.update("jax_platforms", "cpu")

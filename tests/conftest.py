@@ -1,23 +1,19 @@
 """Test configuration: force an 8-virtual-device CPU backend so multi-chip
-sharding paths (mesh/pjit/shard_map) are exercised without TPU hardware."""
+sharding paths (mesh/pjit/shard_map) are exercised without TPU hardware.
+
+The forcing recipe (env + jax.config override, already-initialized guard)
+lives in fluidframework_tpu.core.platform.force_host_platform — the shared
+implementation also used by __graft_entry__.dryrun_multichip.
+"""
 
 import os
+import sys
 
-# Hard override (not setdefault): the ambient environment may export
-# JAX_PLATFORMS=axon (the real-TPU tunnel); tests must stay hermetic on
-# the virtual 8-device CPU mesh regardless.
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-# Plugins (e.g. jaxtyping's) may import jax before this conftest runs, in
-# which case jax captured the ambient JAX_PLATFORMS at import time; override
-# through the live config as well (backends have not initialized yet).
 try:
-    import jax
+    from fluidframework_tpu.core.platform import force_host_platform
 
-    jax.config.update("jax_platforms", "cpu")
-except ImportError:  # pragma: no cover
+    force_host_platform(8)
+except ImportError:  # pragma: no cover - jax-less env: pure-Python tests only
     pass
