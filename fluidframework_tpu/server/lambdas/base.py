@@ -34,5 +34,11 @@ class IPartitionLambda:
     def handler(self, message: QueuedMessage) -> None:
         raise NotImplementedError
 
+    def flush(self) -> None:
+        """Called by the pump after a drain pass. Batching lambdas (the TPU
+        sequencer) accumulate per-message work in handler() and execute it
+        here as one device batch — the reference's boxcar/batch moment
+        (kafka-service/README.md: process batch N while N+1 queues)."""
+
     def close(self) -> None:
         pass

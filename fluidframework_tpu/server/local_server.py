@@ -124,16 +124,7 @@ class LocalServer:
         # requires the pump's eager offset commit OFF so the replay window
         # matches the saved state.
         self.config = config
-        deli_batched = bool(config is not None and int(
-            config.get("deli.checkpointBatchSize", 1)) > 1)
-        self._deli_mgr = self.runner.add(PartitionManager(
-            self.log, "deli", RAW_TOPIC,
-            lambda ctx: DeliLambda(ctx, emit=self._emit_sequenced,
-                                   nack=self._emit_nack,
-                                   checkpoints=self.deli_checkpoints,
-                                   fresh_log=True,
-                                   config=self.config),
-            auto_commit=not deli_batched))
+        self._deli_mgr = self.runner.add(self._build_sequencer())
         self._copier_mgr = self.runner.add(PartitionManager(
             self.log, "copier", RAW_TOPIC,
             lambda ctx: CopierLambda(ctx, self.raw_deltas)))
@@ -151,6 +142,20 @@ class LocalServer:
             lambda ctx: BroadcasterLambda(ctx, rooms=self._rooms)))
 
     # -- internal wiring ---------------------------------------------------
+    def _build_sequencer(self) -> PartitionManager:
+        """The sequencing stage (scalar DeliLambda here; TpuLocalServer
+        overrides with the device-batched TpuSequencerLambda)."""
+        deli_batched = bool(self.config is not None and int(
+            self.config.get("deli.checkpointBatchSize", 1)) > 1)
+        return PartitionManager(
+            self.log, "deli", RAW_TOPIC,
+            lambda ctx: DeliLambda(ctx, emit=self._emit_sequenced,
+                                   nack=self._emit_nack,
+                                   checkpoints=self.deli_checkpoints,
+                                   fresh_log=True,
+                                   config=self.config),
+            auto_commit=not deli_batched)
+
     def _emit_sequenced(self, doc_id: str,
                         sequenced: SequencedDocumentMessage) -> None:
         self.log.send(DELTAS_TOPIC, doc_id, (doc_id, sequenced))
@@ -233,3 +238,36 @@ class LocalServer:
         row = self.deli_checkpoints.find_one(
             lambda d: d.get("documentId") == document_id)
         return row["state"]["sequenceNumber"] if row else 0
+
+
+class TpuLocalServer(LocalServer):
+    """LocalServer whose sequencing stage is the DEVICE pipeline: boxcars
+    drain into [B, T] tensors and sequence through ticket_kernel.
+    sequence_batched_strict, with admitted merge-tree ops applied to
+    device-resident segment tables (server/tpu_sequencer.py) — the
+    TPU-batched partition lambda of the north star on the real serving
+    path. Scriptorium/Scribe/Broadcaster/Copier are unchanged (host I/O).
+    """
+
+    def _build_sequencer(self) -> PartitionManager:
+        from .tpu_sequencer import TpuSequencerLambda
+
+        def factory(ctx):
+            lam = TpuSequencerLambda(
+                ctx, emit=self._emit_sequenced, nack=self._emit_nack,
+                checkpoints=self.deli_checkpoints, deltas=self.deltas)
+            self.tpu_sequencers.append(lam)
+            return lam
+
+        self.tpu_sequencers = []
+        # auto_commit off: offsets commit only at the lambda's flush
+        # checkpoint, so a crash replays the whole unflushed window.
+        return PartitionManager(self.log, "deli", RAW_TOPIC, factory,
+                                auto_commit=False)
+
+    def sequencer(self):
+        """The live TpuSequencerLambda (single-partition default)."""
+        return self.tpu_sequencers[-1]
+
+    def sequence_number(self, document_id: str) -> int:
+        return self.sequencer().document_seq(document_id)

@@ -70,6 +70,12 @@ class PartitionPump:
                 # if a lambda checkpoints lazily.
                 self.log.commit(self.group, self.topic, self.partition,
                                 batch[-1].offset)
+        if processed:
+            try:
+                self.lambda_.flush()
+            except Exception as err:  # noqa: BLE001 — lambda crash path
+                self.restart()
+                self.context.error(err, restart=True)
         return processed
 
     def restart(self) -> None:

@@ -23,6 +23,20 @@ import jax.numpy as jnp
 INT32_MAX = 2**31 - 1
 
 
+class MsgKind:
+    """Wire message classes the sequencer distinguishes (deli/lambda.ts:179
+    branches on MessageType): OP covers every client-authored message
+    (op/summarize/propose — they all ticket identically), JOIN/LEAVE mutate
+    the client table, SYSTEM is a server-generated message (NoClient,
+    summaryAck) that sequences unconditionally with no client entry."""
+
+    NOOP = 0
+    OP = 1
+    JOIN = 2
+    LEAVE = 3
+    SYSTEM = 4
+
+
 class TicketState(NamedTuple):
     """Per-document sequencing state (leading batch axis when batched).
 
@@ -31,6 +45,9 @@ class TicketState(NamedTuple):
     client_cseq  [K] each client's last clientSequenceNumber (dup/gap guard)
     next_seq     []  next sequenceNumber to assign
     min_seq      []  current minimumSequenceNumber
+    overflow     []  bool: a JOIN arrived with no free client slot (the host
+                     must re-shard that document at a larger K; semantics
+                     stay correct-by-flag, like the merge kernel's overflow)
     """
 
     client_ids: jnp.ndarray
@@ -38,14 +55,21 @@ class TicketState(NamedTuple):
     client_cseq: jnp.ndarray
     next_seq: jnp.ndarray
     min_seq: jnp.ndarray
+    overflow: jnp.ndarray
 
 
 class RawOps(NamedTuple):
-    """Unsequenced client ops, [B, T] (or [T] unbatched), NOOP = client -1."""
+    """Unsequenced client ops, [B, T] (or [T] unbatched), NOOP = client -1.
+
+    kind (optional [B, T] MsgKind column): when None, every op with
+    client >= 0 is an OP and unknown clients auto-join on first op (the
+    bench/bridge shape). With a kind column, JOIN/LEAVE/SYSTEM messages
+    sequence on device too — the full deli state machine in one scan."""
 
     client: jnp.ndarray
     client_seq: jnp.ndarray
     ref_seq: jnp.ndarray
+    kind: jnp.ndarray | None = None
 
 
 class Ticketed(NamedTuple):
@@ -56,6 +80,7 @@ class Ticketed(NamedTuple):
     nacked: jnp.ndarray   # bool: refSeq below window or client not joined
     # (duplicate clientSeqs are dropped silently — seq stays 0, nacked stays
     # False — matching the host deli's idempotent-replay behavior)
+    not_joined: jnp.ndarray  # bool: nack was for an un-joined client
 
 
 def make_ticket_state(clients_capacity: int, batch: int | None = None
@@ -68,40 +93,67 @@ def make_ticket_state(clients_capacity: int, batch: int | None = None
         client_cseq=jnp.zeros(shape(clients_capacity), jnp.int32),
         next_seq=jnp.ones(shape(), jnp.int32),
         min_seq=jnp.zeros(shape(), jnp.int32),
+        overflow=jnp.zeros(shape(), jnp.bool_),
     )
 
 
-def _ticket_one(s: TicketState, client, client_seq, ref_seq
-                ) -> Tuple[TicketState, Tuple]:
-    """Ticket one op for one document (deli/lambda.ts:224 ticket())."""
-    is_op = client >= 0
+def _ticket_one(s: TicketState, kind, client, client_seq, ref_seq,
+                require_join: bool) -> Tuple[TicketState, Tuple]:
+    """Ticket one message for one document (deli/lambda.ts:179-224): the
+    whole deli branch structure — join/leave table updates, dup drop, stale
+    nack, seq/MSN assignment — as masked updates on the client table."""
     k = s.client_ids.shape[-1]
-    slot_mask = s.client_ids == client
-    known = is_op & jnp.any(slot_mask)
-    slot = jnp.argmax(slot_mask)
-    # Unknown client joins the table at the first free slot (the reference
-    # creates the heap entry on first op / join).
-    free = s.client_ids == -1
-    join_slot = jnp.argmax(free)
-    can_join = is_op & ~known & jnp.any(free)
-    slot = jnp.where(known, slot, join_slot)
-    active = known | can_join
+    has_client = client >= 0
+    is_op = (kind == MsgKind.OP) & has_client
+    is_join = (kind == MsgKind.JOIN) & has_client
+    is_leave = (kind == MsgKind.LEAVE) & has_client
+    is_system = kind == MsgKind.SYSTEM
 
+    # Leave first: evict the client from the MSN calculation (deli.py
+    # CLIENT_LEAVE; clientSeqManager canEvict). An unknown leaver is dropped.
+    gone = is_leave & (s.client_ids == client)
+    ids0 = jnp.where(gone, -1, s.client_ids)
+    ref0 = jnp.where(gone, INT32_MAX, s.client_ref)
+    leave_ok = is_leave & jnp.any(gone)
+
+    slot_mask = ids0 == client
+    known = has_client & jnp.any(slot_mask)
+    free = ids0 == -1
+    have_free = jnp.any(free)
+    slot = jnp.where(known, jnp.argmax(slot_mask), jnp.argmax(free))
+
+    # OP admission. Without an explicit-join wire (kind=None), unknown
+    # clients auto-join on first op; with it, they nack ("client not
+    # joined", deli.py).
+    auto_join = is_op & ~known & have_free & (not require_join)
+    active = (is_op & known) | auto_join
     prev_cseq = jnp.where(known, s.client_cseq[slot], 0)
     # Duplicate clientSeq: silently dropped, NOT nacked — matching the host
     # deli (deli.py), so an at-least-once log replay is benign on both paths.
-    dup = known & (client_seq <= prev_cseq)
+    dup = is_op & known & (client_seq <= prev_cseq)
     # refSeq must sit inside the collab window (deli nacks stale refs).
     stale = is_op & (ref_seq < s.min_seq)
-    nacked = is_op & (stale | ~active)
-    ticket = is_op & ~dup & ~nacked
+    not_joined = is_op & ~active
+    nacked = stale | not_joined
+    op_ticket = is_op & ~dup & ~nacked
 
-    seq = jnp.where(ticket, s.next_seq, 0)
+    # JOIN: place (or refresh) the client entry with refSeq = the sequence
+    # number just before the join op's own (deli.py CLIENT_JOIN). A full
+    # table still sequences the join but flags overflow.
+    join_ok = is_join & (known | have_free)
+    join_full = is_join & ~known & ~have_free
+
     onehot = jnp.arange(k) == slot
-    upd = ticket & onehot
-    client_ids = jnp.where(upd, client, s.client_ids)
-    client_ref = jnp.where(upd, ref_seq, s.client_ref)
-    client_cseq = jnp.where(upd, client_seq, s.client_cseq)
+    upd_op = op_ticket & onehot
+    upd_join = join_ok & onehot
+    client_ids = jnp.where(upd_op | upd_join, client, ids0)
+    client_ref = jnp.where(upd_op, ref_seq,
+                           jnp.where(upd_join, s.next_seq - 1, ref0))
+    client_cseq = jnp.where(upd_op, client_seq,
+                            jnp.where(upd_join, 0, s.client_cseq))
+
+    ticket = op_ticket | join_ok | join_full | leave_ok | is_system
+    seq = jnp.where(ticket, s.next_seq, 0)
     # MSN: min over active clients' refSeqs (clientSeqManager heap min);
     # monotone non-decreasing, clamped below the just-assigned seq so a
     # future-dated refSeq cannot poison the window (host deli applies the
@@ -117,8 +169,9 @@ def _ticket_one(s: TicketState, client, client_seq, ref_seq
         client_cseq=client_cseq,
         next_seq=jnp.where(ticket, s.next_seq + 1, s.next_seq),
         min_seq=jnp.where(ticket, msn, s.min_seq),
+        overflow=s.overflow | join_full,
     )
-    return s2, (seq, s2.min_seq, nacked)
+    return s2, (seq, s2.min_seq, nacked, not_joined)
 
 
 def _leave_one(s: TicketState, client) -> TicketState:
@@ -130,26 +183,31 @@ def _leave_one(s: TicketState, client) -> TicketState:
     )
 
 
-def _scan_tickets(state: TicketState, ops: RawOps, batched: bool
-                  ) -> Tuple[TicketState, Ticketed]:
+def _scan_tickets(state: TicketState, ops: RawOps, batched: bool,
+                  require_join: bool = False) -> Tuple[TicketState, Ticketed]:
     steps = ops.client.shape[-1]
+    # No kind column: every op row (client >= 0) is an OP (bench/bridge).
+    kind = ops.kind if ops.kind is not None else jnp.where(
+        ops.client >= 0, MsgKind.OP, MsgKind.NOOP).astype(jnp.int32)
 
     def body(s, t):
         if batched:
             s2, out = jax.vmap(
-                lambda sd, c, cs, r: _ticket_one(sd, c[t], cs[t], r[t])
-            )(s, ops.client, ops.client_seq, ops.ref_seq)
+                lambda sd, kd, c, cs, r: _ticket_one(
+                    sd, kd[t], c[t], cs[t], r[t], require_join)
+            )(s, kind, ops.client, ops.client_seq, ops.ref_seq)
         else:
-            s2, out = _ticket_one(s, ops.client[t], ops.client_seq[t],
-                                  ops.ref_seq[t])
+            s2, out = _ticket_one(s, kind[t], ops.client[t],
+                                  ops.client_seq[t], ops.ref_seq[t],
+                                  require_join)
         return s2, out
 
-    state, (seq, msn, nacked) = jax.lax.scan(
+    state, outs = jax.lax.scan(
         body, state, jnp.arange(steps, dtype=jnp.int32))
     # scan stacks on axis 0 (time); move time last to match [B, T] layout.
     if batched:
-        seq, msn, nacked = (jnp.moveaxis(x, 0, -1) for x in (seq, msn, nacked))
-    return state, Ticketed(seq=seq, min_seq=msn, nacked=nacked)
+        outs = tuple(jnp.moveaxis(x, 0, -1) for x in outs)
+    return state, Ticketed(*outs)
 
 
 @jax.jit
@@ -164,6 +222,15 @@ def ticket_ops_batched(state: TicketState, ops: RawOps
                        ) -> Tuple[TicketState, Ticketed]:
     """Ticket [B, T] streams for B documents in one jit."""
     return _scan_tickets(state, ops, batched=True)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def sequence_batched_strict(state: TicketState, ops: RawOps
+                            ) -> Tuple[TicketState, Ticketed]:
+    """The serving-path sequencer: [B, T] message streams WITH a MsgKind
+    column — joins/leaves/system messages sequence on device and un-joined
+    clients nack, exactly the host DeliLambda contract."""
+    return _scan_tickets(state, ops, batched=True, require_join=True)
 
 
 @jax.jit

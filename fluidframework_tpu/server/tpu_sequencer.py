@@ -1,0 +1,672 @@
+"""The TPU partition sequencer: the device pipeline on the serving path.
+
+This is the TPU-batched IPartitionLambdaFactory of the north star (reference
+services-core/src/lambdas.ts:36-73 + deli/lambda.ts:142-224): one lambda
+owns a whole partition's documents, drains their boxcars into [B, T] message
+tensors, and sequences them in ONE device program (ticket_kernel.
+sequence_batched_strict — joins/leaves/system messages included). Admitted
+merge-tree ops are then applied to device-resident per-channel segment
+tables (mergetree.kernel) so the server materializes document state for
+batched summarization, exactly the role Scribe's protocol replica plays in
+the reference (scribe/lambda.ts:40) but vectorized across every document.
+
+Host responsibilities are the irreducibly host-shaped ones: JSON parsing,
+client-id interning, emission to the downstream topics (scriptorium/
+broadcaster/scribe consume SequencedDocumentMessages unchanged), nacks,
+and checkpointing.
+
+Capacity discipline (SURVEY.md §7 hard parts 1/3): merge lanes live in
+capacity buckets (one compiled program per bucket size). A lane that
+overflows its bucket during apply is first zamboni-compacted and re-run;
+if it still overflows it promotes to the next bucket — correct-by-recovery,
+never correct-by-luck. The ticket client table grows the same way (K
+doubles pre-flush when a window's join count could exceed it).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..mergetree import kernel
+from ..mergetree.host import OpBuilder, PayloadTable, extract_text
+from ..mergetree.oppack import HostOp, PackedOps, pack_ops
+from ..mergetree.state import DocState, make_state
+from ..protocol.messages import (
+    Boxcar,
+    DocumentMessage,
+    ITrace,
+    MessageType,
+    Nack,
+    NackContent,
+    NACK_BAD_REF_SEQ,
+    SequencedDocumentMessage,
+)
+from . import ticket_kernel as tk
+from .lambdas.base import IPartitionLambda, LambdaContext
+from .log import QueuedMessage
+
+# Merge-tree wire op types (mergetree/client.py, reference ops.ts:29).
+_OP_INSERT, _OP_REMOVE, _OP_ANNOTATE, _OP_GROUP = 0, 1, 2, 3
+
+
+def _bucket(n: int, buckets: Tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"window of {n} exceeds max bucket {buckets[-1]}")
+
+
+# ---------------------------------------------------------------------------
+# merge lanes: device-resident per-channel segment tables, capacity-bucketed
+# ---------------------------------------------------------------------------
+
+class _MergeBucket:
+    """A batch of merge lanes sharing one segment capacity (one compiled
+    apply program per (capacity, T-bucket) pair)."""
+
+    def __init__(self, capacity: int, lanes: int):
+        self.capacity = capacity
+        self.lanes = lanes
+        self.state: DocState = make_state(capacity, batch=lanes)
+        self.used: List[Optional[tuple]] = [None] * lanes  # lane key or None
+
+    def alloc(self, key: tuple) -> int:
+        for i, k in enumerate(self.used):
+            if k is None:
+                self.used[i] = key
+                return i
+        # Grow the batch axis (pad with empty lanes).
+        old = self.lanes
+        grown = make_state(self.capacity, batch=old * 2)
+        self.state = jax.tree_util.tree_map(
+            lambda g, s: g.at[:old].set(s) if g.ndim else s, grown, self.state)
+        self.used.extend([None] * old)
+        self.lanes = old * 2
+        self.used[old] = key
+        return old
+
+    def free(self, lane: int) -> None:
+        self.used[lane] = None
+
+    def row(self, lane: int) -> DocState:
+        """Extract one lane as a single-doc DocState (host-side gather)."""
+        return jax.tree_util.tree_map(lambda x: x[lane], self.state)
+
+    def put_row(self, lane: int, row: DocState) -> None:
+        self.state = jax.tree_util.tree_map(
+            lambda b, r: b.at[lane].set(r), self.state, row)
+
+
+def _repad_row(row: DocState, capacity: int) -> DocState:
+    """Re-pad a single-doc state to a larger capacity (bucket promotion)."""
+    base = make_state(capacity, anno_slots=row.anno_slots,
+                      overlap_slots=row.rem_clients.shape[-1])
+    c = row.capacity
+
+    def widen(dst, src):
+        if src.ndim == 0:
+            return src
+        return dst.at[:c].set(src)
+
+    return jax.tree_util.tree_map(widen, base, row)
+
+
+# Non-donating apply variants: the serving path keeps the pre-flush state
+# alive until overflow recovery has cleared, so nothing is rebuilt on the
+# recovery path (jax arrays are immutable; retaining the input is free).
+_apply_keep_batched = jax.jit(
+    lambda s, ops: kernel._scan_ops(s, ops, batched=True))
+_apply_keep_single = jax.jit(
+    lambda s, ops: kernel._scan_ops(s, ops, batched=False))
+_compact_single = jax.jit(kernel._compact_one)
+
+
+class MergeLaneStore:
+    """All merge lanes across capacity buckets + the shared payload table."""
+
+    def __init__(self, capacities: Tuple[int, ...] = (64, 256, 1024),
+                 lanes_per_bucket: int = 8,
+                 t_buckets: Tuple[int, ...] = (1, 4, 16, 64, 256)):
+        self.capacities = tuple(capacities)
+        self.t_buckets = tuple(t_buckets)
+        self.buckets = [
+            _MergeBucket(c, lanes_per_bucket) for c in self.capacities]
+        self.payloads = PayloadTable()
+        self.builder = OpBuilder(self.payloads)
+        self.where: Dict[tuple, Tuple[int, int]] = {}  # key -> (bucket, lane)
+        self.opaque: set = set()  # lanes dropped (unparseable op seen)
+        self.flushes_since_compact = 0
+        self.compact_every = 8
+
+    # -- lane admission ----------------------------------------------------
+    def lane_for(self, key: tuple) -> Tuple[int, int]:
+        if key not in self.where:
+            bucket = 0
+            lane = self.buckets[bucket].alloc(key)
+            self.where[key] = (bucket, lane)
+        return self.where[key]
+
+    def drop(self, key: tuple) -> None:
+        """Mark a channel opaque: an op arrived the server cannot model
+        (chunked/unknown payload); its device lane is abandoned."""
+        if key in self.where:
+            b, lane = self.where.pop(key)
+            self.buckets[b].free(lane)
+        self.opaque.add(key)
+
+    # -- batched apply with overflow recovery ------------------------------
+    def apply(self, streams: Dict[tuple, List[HostOp]]) -> None:
+        """Apply per-lane op streams; windows longer than the largest
+        T-bucket chunk into successive device passes (bulk catch-up)."""
+        max_t = self.t_buckets[-1]
+        while streams:
+            window: Dict[tuple, List[HostOp]] = {}
+            rest: Dict[tuple, List[HostOp]] = {}
+            for key, ops in streams.items():
+                if not ops:
+                    continue
+                window[key] = ops[:max_t]
+                if len(ops) > max_t:
+                    rest[key] = ops[max_t:]
+            if not window:
+                break
+            self._apply_window(window)
+            streams = rest
+
+    def _apply_window(self, streams: Dict[tuple, List[HostOp]]) -> None:
+        """One batched device pass per bucket; recover overflowing lanes by
+        compact -> re-run -> promote."""
+        per_bucket: Dict[int, Dict[int, List[HostOp]]] = {}
+        for key, ops in streams.items():
+            if key in self.opaque or not ops:
+                continue
+            b, lane = self.lane_for(key)
+            per_bucket.setdefault(b, {})[lane] = ops
+
+        for b, lane_ops in sorted(per_bucket.items()):
+            bucket = self.buckets[b]
+            t = _bucket(max(len(v) for v in lane_ops.values()),
+                        self.t_buckets)
+            streams_list = [lane_ops.get(i, []) for i in range(bucket.lanes)]
+            packed = pack_ops(streams_list, steps=t)
+            pre = bucket.state
+            new_state = _apply_keep_batched(pre, packed)
+            over = np.asarray(new_state.overflow)
+            flagged = [i for i in range(bucket.lanes)
+                       if over[i] and i in lane_ops]
+            if flagged:
+                # Adopt the clean lanes; roll flagged lanes back to their
+                # pre-flush rows, then recover each individually.
+                for i in flagged:
+                    row = jax.tree_util.tree_map(lambda x: x[i], pre)
+                    new_state = jax.tree_util.tree_map(
+                        lambda bcol, r: bcol.at[i].set(r), new_state, row)
+            bucket.state = new_state
+            for i in flagged:
+                self._recover(b, i, lane_ops[i])
+
+        self.flushes_since_compact += 1
+        if self.flushes_since_compact >= self.compact_every:
+            self.compact_all()
+
+    def _recover(self, b: int, lane: int, ops: List[HostOp]) -> None:
+        """Overflowed lane: zamboni-compact and re-run in place; if it still
+        overflows, promote to the next capacity bucket (repeat upward)."""
+        bucket = self.buckets[b]
+        key = bucket.used[lane]
+        row = bucket.row(lane)
+        t = _bucket(len(ops), self.t_buckets)
+        packed = pack_ops([ops], steps=t)
+        single = PackedOps(**{f: getattr(packed, f)[0]
+                              for f in PackedOps._fields})
+        # Attempt 1: compact in place (frees min_seq-passed tombstones).
+        compacted = _compact_single(row)
+        redone = _apply_keep_single(compacted, single)
+        if not bool(np.asarray(redone.overflow)):
+            bucket.put_row(lane, redone)
+            return
+        # Promote upward until it fits.
+        bucket.free(lane)
+        src_row = compacted
+        for nb in range(b + 1, len(self.buckets)):
+            target = self.buckets[nb]
+            wide = _repad_row(src_row, target.capacity)
+            redone = _apply_keep_single(wide, single)
+            if not bool(np.asarray(redone.overflow)):
+                new_lane = target.alloc(key)
+                target.put_row(new_lane, redone)
+                self.where[key] = (nb, new_lane)
+                return
+            src_row = wide
+        del self.where[key]
+        raise RuntimeError(
+            f"merge lane {key} overflows the largest capacity bucket "
+            f"{self.capacities[-1]}")
+
+    def compact_all(self) -> None:
+        """Zamboni every bucket (reference mergeTree.ts:1422, run between
+        batches so the gather cost amortizes, kernel.py design note)."""
+        for bucket in self.buckets:
+            if any(k is not None for k in bucket.used):
+                bucket.state = kernel.compact_batched(bucket.state)
+        self.flushes_since_compact = 0
+
+    # -- queries -----------------------------------------------------------
+    def text(self, key: tuple) -> Optional[str]:
+        """Materialized text for a channel (None if opaque/unknown)."""
+        if key not in self.where:
+            return None
+        b, lane = self.where[key]
+        return extract_text(self.buckets[b].row(lane), self.payloads)
+
+    def lane_count(self) -> int:
+        return len(self.where)
+
+
+# ---------------------------------------------------------------------------
+# op parsing: sequenced envelope -> merge-tree HostOps
+# ---------------------------------------------------------------------------
+
+class _Unmodelable(Exception):
+    """Op content the server cannot mirror on device (drops the lane)."""
+
+
+def _merge_host_ops(builder: OpBuilder, op: dict, seq: int, ref_seq: int,
+                    client: int, msn: int) -> List[HostOp]:
+    t = op.get("type")
+    if t == _OP_GROUP:
+        out: List[HostOp] = []
+        for sub in op.get("ops", []):
+            out.extend(_merge_host_ops(builder, sub, seq, ref_seq, client,
+                                       msn))
+        return out
+    if t == _OP_INSERT:
+        seg = op.get("seg") or {}
+        if seg.get("marker"):
+            return [builder.insert_marker(op["pos1"], ref_seq, client, seq,
+                                          props=seg.get("props"), msn=msn)]
+        if "text" in seg:
+            return [builder.insert_text(op["pos1"], seg["text"], ref_seq,
+                                        client, seq, props=seg.get("props"),
+                                        msn=msn)]
+        raise _Unmodelable("insert payload is not text/marker")
+    if t == _OP_REMOVE:
+        return [builder.remove(op["pos1"], op["pos2"], ref_seq, client, seq,
+                               msn=msn)]
+    if t == _OP_ANNOTATE:
+        return [builder.annotate(op["pos1"], op["pos2"], op.get("props") or {},
+                                 ref_seq, client, seq, msn=msn)]
+    raise _Unmodelable(f"unknown merge op type {t!r}")
+
+
+def _looks_like_merge_op(op: Any) -> bool:
+    if not isinstance(op, dict):
+        return False
+    t = op.get("type")
+    if t == _OP_GROUP:
+        return isinstance(op.get("ops"), list)
+    return t in (_OP_INSERT, _OP_REMOVE, _OP_ANNOTATE) and "pos1" in op
+
+
+# ---------------------------------------------------------------------------
+# the lambda
+# ---------------------------------------------------------------------------
+
+class _DocLane:
+    """Host bookkeeping for one document's device lane."""
+
+    def __init__(self, lane: int):
+        self.lane = lane
+        self.interner: Dict[str, int] = {}   # wire client id -> ordinal
+        self.ordinals: Dict[int, str] = {}
+        self.log_offset = -1
+        self.next_ordinal = 0
+
+    def intern(self, client_id: str) -> int:
+        if client_id not in self.interner:
+            self.interner[client_id] = self.next_ordinal
+            self.ordinals[self.next_ordinal] = client_id
+            self.next_ordinal += 1
+        return self.interner[client_id]
+
+    def dump(self) -> dict:
+        return {"lane": self.lane, "logOffset": self.log_offset,
+                "interner": dict(self.interner),
+                "nextOrdinal": self.next_ordinal}
+
+    @staticmethod
+    def load(d: dict) -> "_DocLane":
+        dl = _DocLane(d["lane"])
+        dl.log_offset = d["logOffset"]
+        dl.interner = {k: int(v) for k, v in d["interner"].items()}
+        dl.ordinals = {v: k for k, v in dl.interner.items()}
+        dl.next_ordinal = d["nextOrdinal"]
+        return dl
+
+
+class _Pending:
+    """One parsed, not-yet-flushed message."""
+
+    __slots__ = ("kind", "ordinal", "client_seq", "ref_seq", "msg",
+                 "client_id")
+
+    def __init__(self, kind: int, ordinal: int, client_seq: int,
+                 ref_seq: int, msg: DocumentMessage,
+                 client_id: Optional[str]):
+        self.kind = kind
+        self.ordinal = ordinal
+        self.client_seq = client_seq
+        self.ref_seq = ref_seq
+        self.msg = msg
+        self.client_id = client_id
+
+
+class TpuSequencerLambda(IPartitionLambda):
+    """Sequences a partition's documents on device (see module docstring).
+
+    emit(document_id, SequencedDocumentMessage) and nack(document_id,
+    client_id, Nack) have the exact DeliLambda contract, so this lambda is a
+    drop-in for the scalar deli in any lambda host.
+    """
+
+    def __init__(self, context: LambdaContext,
+                 emit: Callable[[str, SequencedDocumentMessage], None],
+                 nack: Callable[[str, str, Nack], None],
+                 lanes: int = 8, clients_capacity: int = 8,
+                 checkpoints=None, deltas=None,
+                 materialize: bool = True,
+                 merge_store: Optional[MergeLaneStore] = None,
+                 t_buckets: Tuple[int, ...] = (1, 4, 16, 64, 256)):
+        self.context = context
+        self.emit = emit
+        self.nack = nack
+        self.checkpoints = checkpoints
+        self.deltas = deltas
+        self.t_buckets = tuple(t_buckets)
+        self.lanes = lanes
+        self.k = clients_capacity
+        self.tstate: tk.TicketState = tk.make_ticket_state(self.k,
+                                                           batch=lanes)
+        self.docs: Dict[str, _DocLane] = {}
+        self.pending: Dict[str, List[_Pending]] = {}
+        self.materialize = materialize
+        self.merge = merge_store if merge_store is not None else \
+            MergeLaneStore(t_buckets=t_buckets)
+        self._pending_offset: Optional[int] = None
+        self._restore()
+
+    # -- checkpoint/restore ------------------------------------------------
+    def _restore(self) -> None:
+        if self.checkpoints is None:
+            return
+        rows = list(self.checkpoints.find(
+            lambda d: d.get("kind") == "tpu-sequencer"))
+        if not rows:
+            return
+        dump = rows[0]["state"]
+        self.docs = {doc: _DocLane.load(d)
+                     for doc, d in dump["docs"].items()}
+        cols = dump["tstate"]
+        self.lanes = len(cols["next_seq"])
+        self.k = len(cols["client_ids"][0]) if cols["client_ids"] else self.k
+        self.tstate = tk.TicketState(
+            client_ids=jnp.asarray(np.asarray(cols["client_ids"], np.int32)),
+            client_ref=jnp.asarray(np.asarray(cols["client_ref"], np.int32)),
+            client_cseq=jnp.asarray(np.asarray(cols["client_cseq"],
+                                               np.int32)),
+            next_seq=jnp.asarray(np.asarray(cols["next_seq"], np.int32)),
+            min_seq=jnp.asarray(np.asarray(cols["min_seq"], np.int32)),
+            overflow=jnp.asarray(np.asarray(cols["overflow"], np.bool_)),
+        )
+        self._rebuild_merge()
+
+    def _rebuild_merge(self) -> None:
+        """Crash-restart: rebuild the device merge lanes by replaying each
+        known document's sequenced deltas through the kernel in bulk — the
+        server-side device catch-up path (reference deltaManager.ts:1380
+        fetchMissingDeltas, applied at partition scale)."""
+        if self.deltas is None or not self.materialize or not self.docs:
+            return
+        from .lambdas.scriptorium import query_deltas
+        streams: Dict[tuple, List[HostOp]] = {}
+        for doc_id, dl in self.docs.items():
+            for row in query_deltas(self.deltas, doc_id):
+                if row.get("type") != MessageType.OPERATION or \
+                        not row.get("client_id"):
+                    continue
+                p = _Pending(tk.MsgKind.OP, dl.intern(row["client_id"]),
+                             row["client_sequence_number"],
+                             row["reference_sequence_number"],
+                             DocumentMessage(
+                                 client_sequence_number=row[
+                                     "client_sequence_number"],
+                                 reference_sequence_number=row[
+                                     "reference_sequence_number"],
+                                 type=row["type"],
+                                 contents=row.get("contents")),
+                             row["client_id"])
+                self._collect_merge(streams, doc_id, p,
+                                    row["sequence_number"],
+                                    row["minimum_sequence_number"])
+        if streams:
+            self.merge.apply(streams)
+
+    def _checkpoint(self) -> None:
+        if self._pending_offset is None:
+            return
+        if self.checkpoints is not None:
+            t = jax.tree_util.tree_map(
+                lambda x: np.asarray(x).tolist(), self.tstate)
+            self.checkpoints.upsert(
+                lambda d: d.get("kind") == "tpu-sequencer",
+                {"kind": "tpu-sequencer", "state": {
+                    "docs": {doc: dl.dump() for doc, dl in self.docs.items()},
+                    "tstate": t._asdict(),
+                }})
+        self.context.checkpoint(self._pending_offset)
+        self._pending_offset = None
+
+    # -- ingestion ---------------------------------------------------------
+    def handler(self, message: QueuedMessage) -> None:
+        boxcar: Boxcar = message.value
+        doc_id = boxcar.document_id
+        dl = self._doc(doc_id)
+        if message.offset <= dl.log_offset:
+            return  # checkpointed replay (deli/lambda.ts:143)
+        queue = self.pending.setdefault(doc_id, [])
+        for msg in boxcar.contents:
+            queue.append(self._parse(dl, boxcar.client_id, msg))
+        dl.log_offset = message.offset
+        self._pending_offset = message.offset
+
+    def _doc(self, doc_id: str) -> _DocLane:
+        dl = self.docs.get(doc_id)
+        if dl is None:
+            lane = len(self.docs)
+            if lane >= self.lanes:
+                self._grow_lanes()
+            dl = _DocLane(lane)
+            self.docs[doc_id] = dl
+        return dl
+
+    def _grow_lanes(self) -> None:
+        old = self.lanes
+        grown = tk.make_ticket_state(self.k, batch=old * 2)
+        self.tstate = jax.tree_util.tree_map(
+            lambda g, s: g.at[:old].set(s), grown, self.tstate)
+        self.lanes = old * 2
+
+    def _grow_clients(self) -> None:
+        k2 = self.k * 2
+        t = self.tstate
+
+        def widen(col, fill):
+            out = jnp.full((self.lanes, k2), fill, col.dtype)
+            return out.at[:, :self.k].set(col)
+
+        self.tstate = t._replace(
+            client_ids=widen(t.client_ids, -1),
+            client_ref=widen(t.client_ref, tk.INT32_MAX),
+            client_cseq=widen(t.client_cseq, 0),
+        )
+        self.k = k2
+
+    def _parse(self, dl: _DocLane, client_id: Optional[str],
+               msg: DocumentMessage) -> _Pending:
+        if msg.type == MessageType.CLIENT_JOIN:
+            detail = _detail(msg)
+            joining = detail.get("clientId", client_id)
+            return _Pending(tk.MsgKind.JOIN, dl.intern(joining), 0, 0, msg,
+                            None)
+        if msg.type == MessageType.CLIENT_LEAVE:
+            detail = _detail(msg)
+            leaving = detail if isinstance(detail, str) else \
+                detail.get("clientId", client_id)
+            return _Pending(tk.MsgKind.LEAVE, dl.intern(leaving), 0, 0, msg,
+                            None)
+        if client_id is None:
+            return _Pending(tk.MsgKind.SYSTEM, -1, 0, 0, msg, None)
+        return _Pending(tk.MsgKind.OP, dl.intern(client_id),
+                        msg.client_sequence_number,
+                        msg.reference_sequence_number, msg, client_id)
+
+    # -- the device flush --------------------------------------------------
+    def flush(self) -> None:
+        self._flush_window()
+        self._checkpoint()
+
+    def _flush_window(self, depth: int = 0) -> None:
+        live = {d: q for d, q in self.pending.items() if q}
+        if not live:
+            return
+        self.pending = {}
+        # Pre-size the client table: joins this window + already-known
+        # ordinals must fit K (grow BEFORE the kernel, so the in-kernel
+        # overflow flag is a genuine invariant violation, not a sizing bug).
+        need_k = max((dl.next_ordinal for dl in self.docs.values()),
+                     default=0)
+        while self.k < need_k:
+            self._grow_clients()
+
+        t = _bucket(max(len(q) for q in live.values()), self.t_buckets)
+        b = self.lanes
+        kind = np.zeros((b, t), np.int32)
+        client = np.full((b, t), -1, np.int32)
+        cseq = np.zeros((b, t), np.int32)
+        ref = np.zeros((b, t), np.int32)
+        for doc_id, queue in live.items():
+            lane = self.docs[doc_id].lane
+            for i, p in enumerate(queue):
+                kind[lane, i] = p.kind
+                client[lane, i] = p.ordinal
+                cseq[lane, i] = p.client_seq
+                ref[lane, i] = p.ref_seq
+        raw = tk.RawOps(client=jnp.asarray(client),
+                        client_seq=jnp.asarray(cseq),
+                        ref_seq=jnp.asarray(ref),
+                        kind=jnp.asarray(kind))
+        self.tstate, ticketed = tk.sequence_batched_strict(self.tstate, raw)
+
+        seqs = np.asarray(ticketed.seq)
+        msns = np.asarray(ticketed.min_seq)
+        nacked = np.asarray(ticketed.nacked)
+        not_joined = np.asarray(ticketed.not_joined)
+        next_seq = np.asarray(self.tstate.next_seq)
+        client_ids = np.asarray(self.tstate.client_ids)
+        if bool(np.asarray(self.tstate.overflow).any()):
+            raise RuntimeError("ticket client table overflow despite "
+                               "pre-flush growth — invariant violation")
+
+        merge_streams: Dict[tuple, List[HostOp]] = {}
+        had_leave: List[str] = []
+        for doc_id, queue in live.items():
+            lane = self.docs[doc_id].lane
+            for i, p in enumerate(queue):
+                seq = int(seqs[lane, i])
+                if seq > 0:
+                    sequenced = SequencedDocumentMessage.from_document_message(
+                        p.msg, p.client_id, seq, int(msns[lane, i]))
+                    sequenced.traces.append(ITrace.now("deli", "sequence"))
+                    self.emit(doc_id, sequenced)
+                    if p.kind == tk.MsgKind.OP and self.materialize:
+                        self._collect_merge(merge_streams, doc_id, p, seq,
+                                            int(msns[lane, i]))
+                elif nacked[lane, i]:
+                    reason = ("client not joined" if not_joined[lane, i]
+                              else "refSeq below minimum sequence number")
+                    self.nack(doc_id, p.client_id or "", Nack(
+                        p.msg, int(next_seq[lane]) - 1,
+                        NackContent(NACK_BAD_REF_SEQ, reason)))
+                if p.kind == tk.MsgKind.LEAVE:
+                    had_leave.append(doc_id)
+
+        if self.materialize and merge_streams:
+            self.merge.apply(merge_streams)
+
+        # NoClient: a document whose last client left gets a NO_CLIENT
+        # system message (deli.py CLIENT_LEAVE tail) — sequenced through the
+        # same device path in an immediate follow-up window.
+        for doc_id in had_leave:
+            lane = self.docs[doc_id].lane
+            if (client_ids[lane] == -1).all():
+                self.pending.setdefault(doc_id, []).append(_Pending(
+                    tk.MsgKind.SYSTEM, -1, 0, 0, DocumentMessage(
+                        client_sequence_number=0,
+                        reference_sequence_number=int(next_seq[lane]) - 1,
+                        type=MessageType.NO_CLIENT), None))
+        if self.pending and depth < 2:
+            self._flush_window(depth + 1)
+
+    def _collect_merge(self, streams: Dict[tuple, List[HostOp]],
+                       doc_id: str, p: _Pending, seq: int, msn: int) -> None:
+        if p.msg.type != MessageType.OPERATION:
+            return
+        contents = p.msg.contents
+        if not isinstance(contents, dict):
+            return
+        envelope = contents.get("contents")
+        if not isinstance(envelope, dict):
+            return
+        op = envelope.get("contents")
+        if not _looks_like_merge_op(op):
+            return
+        key = (doc_id, contents.get("address"), envelope.get("address"))
+        if key in self.merge.opaque:
+            return
+        try:
+            ops = _merge_host_ops(self.merge.builder, op, seq, p.ref_seq,
+                                  p.ordinal, msn)
+        except _Unmodelable:
+            self.merge.drop(key)
+            return
+        streams.setdefault(key, []).extend(ops)
+
+    # -- introspection (tests / summarization) -----------------------------
+    def channel_text(self, doc_id: str, store: str,
+                     channel: str) -> Optional[str]:
+        """Server-materialized text for a channel (device state + host
+        payload table) — the batched-summarization read path."""
+        return self.merge.text((doc_id, store, channel))
+
+    def document_seq(self, doc_id: str) -> int:
+        dl = self.docs.get(doc_id)
+        if dl is None:
+            return 0
+        return int(np.asarray(self.tstate.next_seq)[dl.lane]) - 1
+
+    def close(self) -> None:
+        # Graceful close persists progress; pending (unflushed) messages are
+        # NOT emitted here — a crash-restart replays them from the last
+        # committed offset, the same at-least-once window as the scalar deli.
+        self._checkpoint()
+
+
+def _detail(msg: DocumentMessage):
+    if msg.data is not None:
+        return json.loads(msg.data)
+    return msg.contents or {}
