@@ -2,5 +2,6 @@
 
 from .collections import Heap, RangeTracker, RedBlackTree, IntervalTree
 from .config import ConfigProvider
+from .errors import BulkApplyUnsupported
 from .events import TypedEventEmitter
 from .trace import Trace

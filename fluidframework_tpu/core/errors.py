@@ -1,0 +1,8 @@
+"""Cross-layer error contracts (reference container-utils DataCorruptionError
+shape: exception types shared across layers without creating import edges)."""
+
+
+class BulkApplyUnsupported(Exception):
+    """A channel cannot apply a given batch in bulk; the caller must fall
+    back to per-op processing. Raisers guarantee channel state is untouched.
+    The merge-tree engine's catchup.Unmodelable subclasses this."""

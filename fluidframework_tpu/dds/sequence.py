@@ -186,6 +186,7 @@ class SharedSegmentSequence(SharedObject):
         self.client.on("delta", lambda args, local:
                        self.emit("sequenceDelta", args, local))
         self._interval_collections: Dict[str, IntervalCollection] = {}
+        self.bulk_catchup_count = 0  # device bulk applies (telemetry/tests)
         # In-flight interval ops by uid (resubmitted verbatim on reconnect;
         # interval ops carry ids, not positions needing rewrite).
         self._pending_interval_ops: Dict[int, dict] = {}
@@ -252,6 +253,30 @@ class SharedSegmentSequence(SharedObject):
             return
         self.client.apply_msg(contents, seq, ref_seq, client_ordinal,
                               min_seq=min_seq)
+
+    def process_bulk_core(self, batch) -> None:
+        """Device bulk catch-up: apply a run of remote sequenced ops
+        [(contents, seq, ref_seq, client_ordinal, min_seq)] through the
+        merge-tree kernel in one pass (mergetree/catchup.py; reference
+        deltaManager.ts:1380-1401 catch-up, vectorized).
+
+        Raises Unmodelable/ValueError — with channel state untouched — when
+        the scalar path is required: interval ops in the run, live local
+        references (they slide per-op), or pending local state."""
+        from ..mergetree.catchup import Unmodelable
+
+        if self._interval_collections or self._pending_interval_ops:
+            raise Unmodelable("interval collections require per-op apply")
+        if any(seg.local_refs for seg in self.client.tree.segments):
+            raise Unmodelable("local references require per-op sliding")
+        tail = []
+        for contents, seq, ref_seq, ordinal, min_seq in batch:
+            if isinstance(contents, dict) and \
+                    contents.get("type") == "intervalCollection":
+                raise Unmodelable("interval op in bulk run")
+            tail.append((contents, seq, ref_seq, ordinal, min_seq))
+        self.client.apply_bulk(tail)
+        self.bulk_catchup_count += 1
 
     def resubmit_pending(self) -> List[Any]:
         return (self.client.regenerate_pending_ops()

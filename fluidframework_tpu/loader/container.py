@@ -165,6 +165,7 @@ class Container(TypedEventEmitter):
         self.protocol.quorum.on("approveProposal", self._on_approve_proposal)
         self.delta_manager.attach_op_handler(
             self.protocol.sequence_number, self._process)
+        self.delta_manager.attach_bulk_handler(self._process_bulk)
         self.delta_manager.on("disconnect", self._on_disconnect)
         self.delta_manager.on("nack", self._on_nack)
         self.delta_manager.on("connect", self._on_connect_identity)
@@ -232,6 +233,55 @@ class Container(TypedEventEmitter):
             self.emit("summaryNack", message.contents)
         self.runtime.process(message)
         self.emit("op", message)
+
+    def _process_bulk(self, tail) -> None:
+        """Catch-up tail processing with the device fast path: maximal runs
+        of remote OPERATION messages addressed to one bulk-capable channel
+        apply through the merge-tree kernel in one pass (mergetree/
+        catchup.py); everything else takes the normal per-message path.
+        Per-op events coalesce into one "bulkCatchUp" delta per run, the
+        reference's deferred-ops load behavior (sequence.ts:664)."""
+        from ..core.errors import BulkApplyUnsupported
+
+        i = 0
+        while i < len(tail):
+            run_key = self._bulk_key(tail[i])
+            j = i
+            while run_key is not None and j < len(tail) and \
+                    self._bulk_key(tail[j]) == run_key:
+                j += 1
+            if run_key is not None and \
+                    j - i >= self.delta_manager.bulk_catchup_threshold:
+                try:
+                    self.runtime.process_channel_bulk(tail[i:j])
+                    for msg in tail[i:j]:
+                        self.protocol.process_message(msg)
+                except (BulkApplyUnsupported, ValueError):
+                    # Channel state untouched: process the WHOLE detected
+                    # run scalar (re-attempting bulk on its suffix would
+                    # fail identically, O(N^2) for a long run).
+                    for msg in tail[i:j]:
+                        self._process(msg)
+                i = j
+                continue
+            self._process(tail[i])
+            i += 1
+
+    def _bulk_key(self, message) -> tuple | None:
+        """(store, channel) when the message can join a device bulk run."""
+        if message.type != MessageType.OPERATION:
+            return None
+        if message.client_id == self.delta_manager.client_id:
+            return None  # local acks need pending-state pairing
+        contents = message.contents
+        if not isinstance(contents, dict) or "attachStore" in contents:
+            return None
+        envelope = contents.get("contents")
+        if not isinstance(envelope, dict):
+            return None
+        return self.runtime.bulk_route(contents.get("address"),
+                                       envelope.get("address"),
+                                       message.client_id)
 
     # -- summaries ---------------------------------------------------------
     def _assemble_summary(self) -> SummaryTree:

@@ -41,6 +41,12 @@ class DeltaManager(TypedEventEmitter):
         self._op_perf = OpRoundTripTelemetry(lambda: self.client_id,
                                              self.logger)
         self._handler: Optional[Callable[[SequencedDocumentMessage], None]] = None
+        # Optional batch handler for the catch-up tail (device bulk path,
+        # mergetree/catchup.py): receives the WHOLE contiguous fetched tail
+        # at once when it is at least bulk_catchup_threshold long.
+        self._bulk_handler: Optional[
+            Callable[[List[SequencedDocumentMessage]], None]] = None
+        self.bulk_catchup_threshold = 64
         self._inbound: List[SequencedDocumentMessage] = []
         self._processing = False
         # The "event loop" of this container. In-process drivers deliver ops
@@ -57,6 +63,10 @@ class DeltaManager(TypedEventEmitter):
         """Start pumping at sequence_number (the loaded summary's seq)."""
         self.last_sequence_number = sequence_number
         self._handler = handler
+
+    def attach_bulk_handler(self, bulk_handler: Callable[
+            [List[SequencedDocumentMessage]], None]) -> None:
+        self._bulk_handler = bulk_handler
 
     def connect(self) -> str:
         self.connection = self.service.connect_to_delta_stream(
@@ -179,10 +189,37 @@ class DeltaManager(TypedEventEmitter):
 
     def catch_up(self) -> None:
         """Fetch + process everything durable past our position
-        (deltaManager.ts:1401)."""
+        (deltaManager.ts:1401). A long contiguous tail is handed to the
+        bulk handler in one call — the device catch-up path — instead of
+        per-op enqueueing; anything irregular falls back per-message."""
+        tail: List[SequencedDocumentMessage] = []
         while True:
-            fetched = self.delta_storage.get(self.last_sequence_number)
+            from_seq = (tail[-1].sequence_number if tail
+                        else self.last_sequence_number)
+            fetched = self.delta_storage.get(from_seq)
             if not fetched:
-                return
-            for msg in fetched:
-                self._enqueue(msg)
+                break
+            tail.extend(fetched)
+        if not tail:
+            return
+        if (self._bulk_handler is not None
+                and len(tail) >= self.bulk_catchup_threshold):
+            with self.lock:
+                # Revalidate under the lock: the connection's reader thread
+                # may have delivered (and processed) a prefix of this tail
+                # concurrently — drop what is already applied and require
+                # gapless continuation from our position.
+                live = [m for m in tail
+                        if m.sequence_number > self.last_sequence_number]
+                contiguous = all(
+                    m.sequence_number == self.last_sequence_number + 1 + i
+                    for i, m in enumerate(live))
+                if contiguous and \
+                        len(live) >= self.bulk_catchup_threshold:
+                    self._bulk_handler(live)
+                    self.last_sequence_number = live[-1].sequence_number
+                    self.minimum_sequence_number = \
+                        live[-1].minimum_sequence_number
+                    return
+        for msg in tail:
+            self._enqueue(msg)

@@ -1,0 +1,272 @@
+"""Device bulk catch-up: replay a large sequenced-op tail through the
+merge-tree kernel instead of the scalar oracle.
+
+The reference loads summary + op tail and applies the tail one op at a time
+(container-loader/src/deltaManager.ts:1380 fetchMissingDeltas, :1401
+catchUp). Here the tail becomes packed [T] op columns applied by
+mergetree.kernel in capacity-bucketed chunks — the same engine the server's
+partition lambda runs, reused at client load/reconnect scale:
+
+    snapshot entries ──seed──▶ DocState ──kernel chunks──▶ entries'
+
+Both endpoints are the oracle's snapshot format (oracle.py
+snapshot_segments/load_segments), so adoption into a live client is a
+state swap, conformance-locked by byte-comparing against the scalar path.
+
+Capacity discipline: chunks are T-bucketed (one compiled program per
+(capacity, T) pair); an edit can add at most 2 segment rows (kernel.py
+apply_one guard), so capacity >= rows + 2*T never overflows — the bucket is
+chosen accordingly and escalates if compaction between chunks cannot keep
+the row count down.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import kernel
+from .constants import (
+    DEV_NO_REMOVE,
+    DEV_UNASSIGNED,
+    SEG_MARKER,
+    SEG_TEXT,
+    UNIVERSAL_SEQ,
+)
+from .host import OpBuilder, PayloadTable, PENDING_ORDER_BASE
+from .oppack import HostOp, PackedOps, pack_single
+from .state import DocState, make_state
+
+# Merge-tree wire op types (client.py, reference ops.ts:29).
+OP_INSERT, OP_REMOVE, OP_ANNOTATE, OP_GROUP = 0, 1, 2, 3
+
+CAPACITY_BUCKETS = (256, 1024, 4096, 16384, 65536)
+CHUNK_T = 512
+
+
+from ..core.errors import BulkApplyUnsupported
+
+
+class Unmodelable(BulkApplyUnsupported):
+    """Wire content the device kernel cannot represent (items payloads,
+    unknown op types): callers fall back to the scalar path."""
+
+
+def wire_to_host_ops(builder: OpBuilder, op: dict, seq: int, ref_seq: int,
+                     client: int, msn: int) -> List[HostOp]:
+    """One sequenced wire op (client.py shape) -> kernel HostOps."""
+    t = op.get("type")
+    if t == OP_GROUP:
+        out: List[HostOp] = []
+        for sub in op.get("ops", []):
+            out.extend(wire_to_host_ops(builder, sub, seq, ref_seq, client,
+                                        msn))
+        return out
+    if t == OP_INSERT:
+        seg = op.get("seg") or {}
+        if seg.get("marker"):
+            return [builder.insert_marker(op["pos1"], ref_seq, client, seq,
+                                          props=seg.get("props"), msn=msn)]
+        if "text" in seg:
+            return [builder.insert_text(op["pos1"], seg["text"], ref_seq,
+                                        client, seq, props=seg.get("props"),
+                                        msn=msn)]
+        raise Unmodelable("insert payload is not text/marker")
+    if t == OP_REMOVE:
+        return [builder.remove(op["pos1"], op["pos2"], ref_seq, client, seq,
+                               msn=msn)]
+    if t == OP_ANNOTATE:
+        return [builder.annotate(op["pos1"], op["pos2"],
+                                 op.get("props") or {}, ref_seq, client, seq,
+                                 msn=msn)]
+    raise Unmodelable(f"unknown merge op type {t!r}")
+
+
+def looks_like_merge_op(op: Any) -> bool:
+    if not isinstance(op, dict):
+        return False
+    t = op.get("type")
+    if t == OP_GROUP:
+        return isinstance(op.get("ops"), list)
+    return t in (OP_INSERT, OP_REMOVE, OP_ANNOTATE) and "pos1" in op
+
+
+# ---------------------------------------------------------------------------
+# snapshot entries <-> device state
+# ---------------------------------------------------------------------------
+
+def seed_device_state(entries: Sequence[dict], payloads: PayloadTable,
+                      capacity: int, min_seq: int,
+                      current_seq: int) -> DocState:
+    """Snapshot-format segments (oracle.snapshot_segments) -> a single-doc
+    DocState whose visibility math reproduces the snapshot perspective."""
+    n = len(entries)
+    if n > capacity:
+        raise ValueError(f"{n} segments exceed capacity {capacity}")
+    cols = {name: np.zeros(n, np.int32)
+            for name in ("length", "ins_seq", "ins_client", "rem_seq",
+                         "origin_op", "origin_off")}
+    rem_client = np.full(n, -1, np.int32)
+    cols["rem_seq"][:] = DEV_NO_REMOVE
+    for i, e in enumerate(entries):
+        kind = e.get("kind", SEG_TEXT)
+        text = e.get("text", "")
+        if kind == SEG_MARKER:
+            length = 1
+            op_id = payloads.add_insert(SEG_MARKER, "", e.get("props"))
+        else:
+            if not isinstance(text, str):
+                raise Unmodelable("items payloads stay on the scalar path")
+            length = len(text)
+            op_id = payloads.add_insert(SEG_TEXT, text, e.get("props"))
+        cols["length"][i] = length
+        cols["ins_seq"][i] = e.get("seq", UNIVERSAL_SEQ)
+        cols["ins_client"][i] = e.get("client", -1)
+        if e.get("removedSeq") is not None:
+            cols["rem_seq"][i] = e["removedSeq"]
+            rem_client[i] = e.get("removedClient", -1)
+        cols["origin_op"][i] = op_id
+        cols["origin_off"][i] = 0
+    cols["rem_client"] = rem_client
+    from .state import state_from_numpy
+    import jax.numpy as jnp
+    state = state_from_numpy(cols, capacity)
+    return state._replace(min_seq=jnp.asarray(min_seq, jnp.int32),
+                          seq=jnp.asarray(current_seq, jnp.int32))
+
+
+def extract_entries(state: DocState, payloads: PayloadTable,
+                    min_seq: int) -> List[dict]:
+    """Device state -> full-fidelity snapshot entries (including contended
+    insert/remove metadata above min_seq), adoptable by
+    MergeTreeOracle.load_segments. Mirrors oracle.snapshot_segments."""
+    cols = {name: np.asarray(getattr(state, name))
+            for name in ("length", "ins_seq", "ins_client", "rem_seq",
+                         "rem_clients", "origin_op", "origin_off", "anno")}
+    count = int(np.asarray(state.count))
+    out: List[dict] = []
+    for i in range(count):
+        rem_seq = int(cols["rem_seq"][i])
+        if rem_seq != DEV_NO_REMOVE and rem_seq <= min_seq:
+            continue  # zamboni-equivalent: tombstone past the window
+        if int(cols["ins_seq"][i]) == DEV_UNASSIGNED:
+            raise Unmodelable("pending segments cannot appear in catch-up")
+        payload = payloads.get(int(cols["origin_op"][i]))
+        entry: Dict[str, Any] = {"kind": payload.kind}
+        if payload.kind == SEG_MARKER:
+            entry["text"] = ""
+        else:
+            off = int(cols["origin_off"][i])
+            entry["text"] = payload.text[off:off + int(cols["length"][i])]
+        props = _resolve_props(payload, cols["anno"][i], payloads)
+        if props:
+            entry["props"] = props
+        ins_seq = int(cols["ins_seq"][i])
+        if ins_seq > min_seq:
+            entry["seq"] = ins_seq
+            entry["client"] = int(cols["ins_client"][i])
+        if rem_seq != DEV_NO_REMOVE:
+            entry["removedSeq"] = rem_seq
+            entry["removedClient"] = int(cols["rem_clients"][i][0])
+        out.append(entry)
+    return out
+
+
+def _resolve_props(payload, anno_row, payloads: PayloadTable
+                   ) -> Optional[dict]:
+    """Resolve a segment's property set from its annotate op-id ring by
+    ascending seq (host.extract_segments semantics)."""
+    props = dict(payload.props) if payload.props else {}
+    chain = []
+    for op_id in anno_row:
+        op_id = int(op_id)
+        if op_id < 0:
+            continue
+        ann = payloads.get(op_id)
+        seq = ann.seq
+        if seq == DEV_UNASSIGNED:
+            seq = PENDING_ORDER_BASE + op_id
+        chain.append((seq, ann.props))
+    chain.sort(key=lambda kv: kv[0])
+    for _, pset in chain:
+        for key, value in pset.items():
+            if value is None:
+                props.pop(key, None)
+            else:
+                props[key] = value
+    return props or None
+
+
+# ---------------------------------------------------------------------------
+# the bulk apply
+# ---------------------------------------------------------------------------
+
+def device_apply_tail(entries: Sequence[dict],
+                      tail: Sequence[Tuple[dict, int, int, int, int]],
+                      min_seq: int, current_seq: int) -> List[dict]:
+    """Apply a sequenced tail [(wire_op, seq, ref_seq, client_ordinal, msn)]
+    to snapshot entries via the kernel; returns the resulting entries.
+
+    Raises Unmodelable for content the kernel cannot represent — callers
+    fall back to the scalar per-op path."""
+    payloads = PayloadTable()
+    builder = OpBuilder(payloads)
+    host_ops: List[HostOp] = []
+    for op, seq, ref_seq, client, msn in tail:
+        if client < 0:
+            raise Unmodelable("op without a client ordinal")
+        host_ops.extend(wire_to_host_ops(builder, op, seq, ref_seq, client,
+                                         msn))
+
+    def capacity_for(rows: int, chunk: int) -> int:
+        need = rows + 2 * chunk + 8
+        for c in CAPACITY_BUCKETS:
+            if need <= c:
+                return c
+        raise Unmodelable(f"{rows} live segments exceed the largest "
+                          f"catch-up capacity {CAPACITY_BUCKETS[-1]}")
+
+    cur_entries = list(entries)
+    state = None
+    pos = 0
+    while pos < len(host_ops) or state is None:
+        chunk = host_ops[pos:pos + CHUNK_T]
+        if state is None:
+            cap = capacity_for(len(cur_entries), len(chunk) or 1)
+            state = seed_device_state(cur_entries, payloads, cap, min_seq,
+                                      current_seq)
+        if not chunk:
+            break
+        t = CHUNK_T if len(chunk) == CHUNK_T else _pow2(len(chunk))
+        packed = pack_single(chunk, steps=t)
+        new_state = kernel.apply_ops_keep(state, packed)
+        if bool(np.asarray(new_state.overflow)):
+            # Compact (window may have advanced) and retry this chunk; if
+            # the compacted row count still needs more room, escalate the
+            # capacity bucket and retry from the compacted state.
+            compacted = kernel.compact(state)
+            rows = int(np.asarray(compacted.count))
+            cap = capacity_for(rows, len(chunk))
+            if cap > compacted.capacity:
+                mseq = int(np.asarray(compacted.min_seq))
+                cseq = int(np.asarray(compacted.seq))
+                cur = extract_entries(compacted, payloads, mseq)
+                state = seed_device_state(cur, payloads, cap, mseq, cseq)
+            else:
+                state = compacted
+            new_state = kernel.apply_ops_keep(state, packed)
+            if bool(np.asarray(new_state.overflow)):
+                raise Unmodelable("catch-up chunk overflowed after "
+                                  "escalation — invariant violation")
+        state = kernel.compact(new_state)
+        pos += len(chunk)
+    final_min = int(np.asarray(state.min_seq))
+    return extract_entries(state, payloads, final_min)
+
+
+def _pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p

@@ -182,6 +182,35 @@ class MergeTreeClient(TypedEventEmitter):
         else:
             self.tree.ack(seq)
 
+    # -- device bulk catch-up ---------------------------------------------
+    def apply_bulk(self, tail: List[tuple]) -> None:
+        """Apply a large sequenced-op tail through the device kernel and
+        adopt the result (the bulk half of reference deltaManager.ts:1380
+        catch-up; engine in mergetree/catchup.py).
+
+        tail: [(wire_op_dict, seq, ref_seq, client_ordinal, msn)], strictly
+        ordered, all remote. Raises catchup.Unmodelable (caller falls back
+        to per-op apply_msg) when the tail or current state contains content
+        the kernel cannot represent, or ValueError when this replica has
+        pending local state (bulk adoption would drop it)."""
+        from .catchup import Unmodelable, device_apply_tail
+
+        if self.tree.pending_groups:
+            raise ValueError("bulk catch-up with pending local ops")
+        if not tail:
+            return
+        entries = self.tree.snapshot_segments()
+        new_entries = device_apply_tail(
+            entries, tail, min_seq=self.tree.min_seq,
+            current_seq=self.tree.current_seq)
+        last_seq = tail[-1][1]
+        last_msn = tail[-1][4]
+        self.tree = MergeTreeOracle.load_segments(
+            new_entries, local_client=self.client_id,
+            min_seq=max(self.tree.min_seq, last_msn), current_seq=last_seq)
+        self.emit("delta", {"op": "bulkCatchUp", "count": len(tail),
+                            "seq": last_seq}, False)
+
     # -- reconnect ---------------------------------------------------------
     def regenerate_pending_ops(self) -> List[dict]:
         """Rewrite all pending local ops against the current view for

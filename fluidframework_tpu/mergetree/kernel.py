@@ -317,6 +317,19 @@ def apply_ops_batched(state: DocState, ops: PackedOps) -> DocState:
     return _scan_ops(state, ops, batched=True)
 
 
+# Non-donating variants for callers that must retain the pre-apply state
+# (overflow recovery / bulk catch-up retry at a larger capacity): jax arrays
+# are immutable, so keeping the input alive costs nothing extra.
+@jax.jit
+def apply_ops_keep(state: DocState, ops: PackedOps) -> DocState:
+    return _scan_ops(state, ops, batched=False)
+
+
+@jax.jit
+def apply_ops_batched_keep(state: DocState, ops: PackedOps) -> DocState:
+    return _scan_ops(state, ops, batched=True)
+
+
 # ---------------------------------------------------------------------------
 # zamboni: compaction
 # ---------------------------------------------------------------------------

@@ -261,6 +261,36 @@ class ContainerRuntime(TypedEventEmitter):
                       message.minimum_sequence_number)
         self.emit("op", message, local)
 
+    # -- device bulk catch-up routing (mergetree/catchup.py) ---------------
+    def bulk_route(self, store_id, channel_id, client_id):
+        """(store, channel) key when this message can ride a device bulk
+        run: the channel exists, supports bulk apply, and the sender's
+        quorum ordinal is known (merge-tree perspectives are ordinals)."""
+        store = self.datastores.get(store_id)
+        if store is None:
+            return None
+        channel = store.channels.get(channel_id)
+        if channel is None or not hasattr(channel, "process_bulk_core"):
+            return None
+        if self._ordinals.get(client_id, -1) < 0:
+            return None
+        return (store_id, channel_id)
+
+    def process_channel_bulk(self, messages) -> None:
+        """Apply a run of remote OPERATION messages for one channel in one
+        device pass. Raises mergetree.catchup.Unmodelable or ValueError
+        (channel state untouched) to request the scalar fallback."""
+        first = messages[0].contents
+        store = self.datastores[first["address"]]
+        channel = store.channels[first["contents"]["address"]]
+        batch = []
+        for m in messages:
+            batch.append((m.contents["contents"]["contents"],
+                          m.sequence_number, m.reference_sequence_number,
+                          self._ordinals[m.client_id],
+                          m.minimum_sequence_number))
+        channel.process_bulk_core(batch)
+
     def _on_self_join(self) -> None:
         """Adopt our quorum-assigned ordinal in every channel's perspective
         math (merge-tree clients track ints, not wire ids), then go

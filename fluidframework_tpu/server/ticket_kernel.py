@@ -81,6 +81,8 @@ class Ticketed(NamedTuple):
     # (duplicate clientSeqs are dropped silently — seq stays 0, nacked stays
     # False — matching the host deli's idempotent-replay behavior)
     not_joined: jnp.ndarray  # bool: nack was for an un-joined client
+    empty_after: jnp.ndarray  # bool: client table empty after this message
+    # (drives the host's NoClient emission with exact deli timing)
 
 
 def make_ticket_state(clients_capacity: int, batch: int | None = None
@@ -129,10 +131,13 @@ def _ticket_one(s: TicketState, kind, client, client_seq, ref_seq,
     active = (is_op & known) | auto_join
     prev_cseq = jnp.where(known, s.client_cseq[slot], 0)
     # Duplicate clientSeq: silently dropped, NOT nacked — matching the host
-    # deli (deli.py), so an at-least-once log replay is benign on both paths.
+    # deli (deli.py), so an at-least-once log replay is benign on both
+    # paths. The dup check wins over the stale-refSeq nack (deli.py checks
+    # duplicate first): a redelivered already-sequenced op whose refSeq has
+    # since fallen below the MSN must stay a silent drop, not a nack.
     dup = is_op & known & (client_seq <= prev_cseq)
     # refSeq must sit inside the collab window (deli nacks stale refs).
-    stale = is_op & (ref_seq < s.min_seq)
+    stale = is_op & (ref_seq < s.min_seq) & ~dup
     not_joined = is_op & ~active
     nacked = stale | not_joined
     op_ticket = is_op & ~dup & ~nacked
@@ -171,7 +176,8 @@ def _ticket_one(s: TicketState, kind, client, client_seq, ref_seq,
         min_seq=jnp.where(ticket, msn, s.min_seq),
         overflow=s.overflow | join_full,
     )
-    return s2, (seq, s2.min_seq, nacked, not_joined)
+    empty_after = ~jnp.any(client_ids >= 0)
+    return s2, (seq, s2.min_seq, nacked, not_joined, empty_after)
 
 
 def _leave_one(s: TicketState, client) -> TicketState:

@@ -33,6 +33,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..mergetree import kernel
+from ..mergetree.catchup import (
+    Unmodelable,
+    looks_like_merge_op,
+    wire_to_host_ops,
+)
 from ..mergetree.host import OpBuilder, PayloadTable, extract_text
 from ..mergetree.oppack import HostOp, PackedOps, pack_ops
 from ..mergetree.state import DocState, make_state
@@ -50,8 +55,6 @@ from . import ticket_kernel as tk
 from .lambdas.base import IPartitionLambda, LambdaContext
 from .log import QueuedMessage
 
-# Merge-tree wire op types (mergetree/client.py, reference ops.ts:29).
-_OP_INSERT, _OP_REMOVE, _OP_ANNOTATE, _OP_GROUP = 0, 1, 2, 3
 
 
 def _bucket(n: int, buckets: Tuple[int, ...]) -> int:
@@ -116,14 +119,13 @@ def _repad_row(row: DocState, capacity: int) -> DocState:
     return jax.tree_util.tree_map(widen, base, row)
 
 
-# Non-donating apply variants: the serving path keeps the pre-flush state
-# alive until overflow recovery has cleared, so nothing is rebuilt on the
-# recovery path (jax arrays are immutable; retaining the input is free).
-_apply_keep_batched = jax.jit(
-    lambda s, ops: kernel._scan_ops(s, ops, batched=True))
-_apply_keep_single = jax.jit(
-    lambda s, ops: kernel._scan_ops(s, ops, batched=False))
-_compact_single = jax.jit(kernel._compact_one)
+# Non-donating applies (kernel.apply_ops*_keep): the serving path keeps the
+# pre-flush state alive until overflow recovery has cleared, so nothing is
+# rebuilt on the recovery path (jax arrays are immutable; retaining the
+# input is free).
+_apply_keep_batched = kernel.apply_ops_batched_keep
+_apply_keep_single = kernel.apply_ops_keep
+_compact_single = kernel.compact
 
 
 class MergeLaneStore:
@@ -269,51 +271,6 @@ class MergeLaneStore:
 
 
 # ---------------------------------------------------------------------------
-# op parsing: sequenced envelope -> merge-tree HostOps
-# ---------------------------------------------------------------------------
-
-class _Unmodelable(Exception):
-    """Op content the server cannot mirror on device (drops the lane)."""
-
-
-def _merge_host_ops(builder: OpBuilder, op: dict, seq: int, ref_seq: int,
-                    client: int, msn: int) -> List[HostOp]:
-    t = op.get("type")
-    if t == _OP_GROUP:
-        out: List[HostOp] = []
-        for sub in op.get("ops", []):
-            out.extend(_merge_host_ops(builder, sub, seq, ref_seq, client,
-                                       msn))
-        return out
-    if t == _OP_INSERT:
-        seg = op.get("seg") or {}
-        if seg.get("marker"):
-            return [builder.insert_marker(op["pos1"], ref_seq, client, seq,
-                                          props=seg.get("props"), msn=msn)]
-        if "text" in seg:
-            return [builder.insert_text(op["pos1"], seg["text"], ref_seq,
-                                        client, seq, props=seg.get("props"),
-                                        msn=msn)]
-        raise _Unmodelable("insert payload is not text/marker")
-    if t == _OP_REMOVE:
-        return [builder.remove(op["pos1"], op["pos2"], ref_seq, client, seq,
-                               msn=msn)]
-    if t == _OP_ANNOTATE:
-        return [builder.annotate(op["pos1"], op["pos2"], op.get("props") or {},
-                                 ref_seq, client, seq, msn=msn)]
-    raise _Unmodelable(f"unknown merge op type {t!r}")
-
-
-def _looks_like_merge_op(op: Any) -> bool:
-    if not isinstance(op, dict):
-        return False
-    t = op.get("type")
-    if t == _OP_GROUP:
-        return isinstance(op.get("ops"), list)
-    return t in (_OP_INSERT, _OP_REMOVE, _OP_ANNOTATE) and "pos1" in op
-
-
-# ---------------------------------------------------------------------------
 # the lambda
 # ---------------------------------------------------------------------------
 
@@ -433,9 +390,16 @@ class TpuSequencerLambda(IPartitionLambda):
         if self.deltas is None or not self.materialize or not self.docs:
             return
         from .lambdas.scriptorium import query_deltas
+        next_seq = np.asarray(self.tstate.next_seq)
         streams: Dict[tuple, List[HostOp]] = {}
         for doc_id, dl in self.docs.items():
-            for row in query_deltas(self.deltas, doc_id):
+            # Bound at the restored checkpoint's last seq: deltas persisted
+            # by a flush that crashed before checkpointing will be
+            # re-sequenced by the raw-log replay (same seqs, scriptorium
+            # dedups) and applied to the merge lanes THEN — replaying them
+            # here too would double-apply.
+            last_seq = int(next_seq[dl.lane]) - 1
+            for row in query_deltas(self.deltas, doc_id, 0, last_seq):
                 if row.get("type") != MessageType.OPERATION or \
                         not row.get("client_id"):
                     continue
@@ -537,14 +501,40 @@ class TpuSequencerLambda(IPartitionLambda):
 
     # -- the device flush --------------------------------------------------
     def flush(self) -> None:
-        self._flush_window()
+        # Each window consumes at least one pending message per live doc,
+        # so this loop is bounded by the backlog length.
+        while any(self.pending.values()):
+            self._flush_window()
         self._checkpoint()
 
-    def _flush_window(self, depth: int = 0) -> None:
-        live = {d: q for d, q in self.pending.items() if q}
+    def _take_window(self) -> Dict[str, List[_Pending]]:
+        """Carve the next per-doc message chunks off the backlog: at most
+        max-T-bucket messages per doc, and cut immediately AFTER a LEAVE —
+        so the host can interpose the NoClient message with the scalar
+        deli's exact timing (deli.py CLIENT_LEAVE tail) before the doc's
+        remaining messages sequence."""
+        max_t = self.t_buckets[-1]
+        live: Dict[str, List[_Pending]] = {}
+        for doc_id, q in list(self.pending.items()):
+            if not q:
+                del self.pending[doc_id]
+                continue
+            cut = min(len(q), max_t)
+            for idx in range(cut):
+                if q[idx].kind == tk.MsgKind.LEAVE:
+                    cut = idx + 1
+                    break
+            live[doc_id] = q[:cut]
+            if len(q) > cut:
+                self.pending[doc_id] = q[cut:]
+            else:
+                del self.pending[doc_id]
+        return live
+
+    def _flush_window(self) -> None:
+        live = self._take_window()
         if not live:
             return
-        self.pending = {}
         # Pre-size the client table: joins this window + already-known
         # ordinals must fit K (grow BEFORE the kernel, so the in-kernel
         # overflow flag is a genuine invariant violation, not a sizing bug).
@@ -576,14 +566,13 @@ class TpuSequencerLambda(IPartitionLambda):
         msns = np.asarray(ticketed.min_seq)
         nacked = np.asarray(ticketed.nacked)
         not_joined = np.asarray(ticketed.not_joined)
+        empty_after = np.asarray(ticketed.empty_after)
         next_seq = np.asarray(self.tstate.next_seq)
-        client_ids = np.asarray(self.tstate.client_ids)
         if bool(np.asarray(self.tstate.overflow).any()):
             raise RuntimeError("ticket client table overflow despite "
                                "pre-flush growth — invariant violation")
 
         merge_streams: Dict[tuple, List[HostOp]] = {}
-        had_leave: List[str] = []
         for doc_id, queue in live.items():
             lane = self.docs[doc_id].lane
             for i, p in enumerate(queue):
@@ -602,25 +591,20 @@ class TpuSequencerLambda(IPartitionLambda):
                     self.nack(doc_id, p.client_id or "", Nack(
                         p.msg, int(next_seq[lane]) - 1,
                         NackContent(NACK_BAD_REF_SEQ, reason)))
-                if p.kind == tk.MsgKind.LEAVE:
-                    had_leave.append(doc_id)
+                # NoClient with exact deli timing: windows cut right after
+                # a LEAVE (_take_window), so a leave that empties the table
+                # interposes NO_CLIENT before the doc's remaining backlog.
+                if p.kind == tk.MsgKind.LEAVE and seq > 0 and \
+                        empty_after[lane, i]:
+                    self.pending.setdefault(doc_id, []).insert(0, _Pending(
+                        tk.MsgKind.SYSTEM, -1, 0, 0, DocumentMessage(
+                            client_sequence_number=0,
+                            reference_sequence_number=int(
+                                next_seq[lane]) - 1,
+                            type=MessageType.NO_CLIENT), None))
 
         if self.materialize and merge_streams:
             self.merge.apply(merge_streams)
-
-        # NoClient: a document whose last client left gets a NO_CLIENT
-        # system message (deli.py CLIENT_LEAVE tail) — sequenced through the
-        # same device path in an immediate follow-up window.
-        for doc_id in had_leave:
-            lane = self.docs[doc_id].lane
-            if (client_ids[lane] == -1).all():
-                self.pending.setdefault(doc_id, []).append(_Pending(
-                    tk.MsgKind.SYSTEM, -1, 0, 0, DocumentMessage(
-                        client_sequence_number=0,
-                        reference_sequence_number=int(next_seq[lane]) - 1,
-                        type=MessageType.NO_CLIENT), None))
-        if self.pending and depth < 2:
-            self._flush_window(depth + 1)
 
     def _collect_merge(self, streams: Dict[tuple, List[HostOp]],
                        doc_id: str, p: _Pending, seq: int, msn: int) -> None:
@@ -633,15 +617,15 @@ class TpuSequencerLambda(IPartitionLambda):
         if not isinstance(envelope, dict):
             return
         op = envelope.get("contents")
-        if not _looks_like_merge_op(op):
+        if not looks_like_merge_op(op):
             return
         key = (doc_id, contents.get("address"), envelope.get("address"))
         if key in self.merge.opaque:
             return
         try:
-            ops = _merge_host_ops(self.merge.builder, op, seq, p.ref_seq,
-                                  p.ordinal, msn)
-        except _Unmodelable:
+            ops = wire_to_host_ops(self.merge.builder, op, seq, p.ref_seq,
+                                   p.ordinal, msn)
+        except Unmodelable:
             self.merge.drop(key)
             return
         streams.setdefault(key, []).extend(ops)

@@ -233,7 +233,8 @@ class TestDeviceTicketingVsScalarDeli:
                     client_sequence_number=0, reference_sequence_number=-1,
                     type=MessageType.CLIENT_JOIN,
                     data=json.dumps({"clientId": cid, "detail": {}}))))
-            elif roll < 0.25 and len(clients[d]) > 1:
+            elif roll < 0.25:
+                # May empty the table: exercises NoClient emission parity.
                 cid = clients[d].pop(rng.randrange(len(clients[d])))
                 streams.append((d, None, DocumentMessage(
                     client_sequence_number=0, reference_sequence_number=-1,
@@ -261,6 +262,37 @@ class TestDeviceTicketingVsScalarDeli:
             type=MessageType.OPERATION, contents={}))]
         device = self._run_device(streams, 1)
         assert device == [("nack", "doc", "ghost")]
+
+    def test_redelivered_op_with_stale_refseq_drops_silently(self):
+        """An at-least-once redelivery whose refSeq has since fallen below
+        the MSN must be a silent duplicate drop, not a nack (the scalar
+        deli checks duplicate before stale; the kernel must match or the
+        client gets a spurious reconnect)."""
+        import json
+        streams = []
+        for cid in ("c1", "c2"):
+            streams.append(("doc", None, DocumentMessage(
+                client_sequence_number=0, reference_sequence_number=-1,
+                type=MessageType.CLIENT_JOIN,
+                data=json.dumps({"clientId": cid, "detail": {}}))))
+        # c1 op at refSeq 0, then both clients advance the window well past
+        # it, then the first op is redelivered verbatim.
+        first = ("doc", "c1", DocumentMessage(
+            client_sequence_number=1, reference_sequence_number=0,
+            type=MessageType.OPERATION, contents={"n": 0}))
+        streams.append(first)
+        for i in range(2, 8):
+            streams.append(("doc", "c1", DocumentMessage(
+                client_sequence_number=i, reference_sequence_number=i,
+                type=MessageType.OPERATION, contents={"n": i})))
+            streams.append(("doc", "c2", DocumentMessage(
+                client_sequence_number=i, reference_sequence_number=i,
+                type=MessageType.OPERATION, contents={"n": i})))
+        streams.append(first)  # redelivery
+        scalar = self._run_scalar(streams)
+        device = self._run_device(streams, 1)
+        assert scalar == device
+        assert not any(e[0] == "nack" for e in device)
 
     def test_duplicate_clientseq_dropped(self):
         import json
