@@ -192,6 +192,19 @@ def main() -> None:
         np.asarray(r[3])
         trials.append(time.perf_counter() - t0)
     catchup_p50_ms = sorted(trials)[len(trials) // 2] * 1000.0
+
+    # Batched summarization: ONE device extraction pass over the whole doc
+    # batch (mask + prefix-sum packing, kernel.extract_visible_batched) +
+    # the D2H transfer of exactly the live rows' references — the device
+    # half of the 10k-doc snapshot write (host text assembly is
+    # payload-table-bound and proportional to visible segments).
+    mt_state = out[1]
+    kernel.fetch_extracted(kernel.extract_visible_batched(mt_state))  # warm
+    t0 = time.perf_counter()
+    packed_np = kernel.fetch_extracted(
+        kernel.extract_visible_batched(mt_state))
+    summarize_extract_ms = (time.perf_counter() - t0) * 1000.0
+    live_segments = int(packed_np[-1].sum())
     result = {
         "metric": "merge-tree ops applied/sec across "
                   f"{n_docs} docs (ticket+apply+summary-len)",
@@ -204,6 +217,8 @@ def main() -> None:
             "docs": n_docs, "ops_per_doc": n_ops,
             "baseline_single_thread_ops_s": round(baseline_ops_per_sec, 1),
             "summary_catchup_p50_ms": round(catchup_p50_ms, 2),
+            "summarize_extract_ms": round(summarize_extract_ms, 2),
+            "summarize_live_segments": live_segments,
             "overflow": overflow,
         },
     }

@@ -171,6 +171,75 @@ def extract_text(state: DocState, payloads: PayloadTable,
     return "".join(parts)
 
 
+def assemble_entries(packed, payloads: PayloadTable, doc: int,
+                     min_seq: int = 0) -> List[dict]:
+    """Full-fidelity snapshot entries for one document from the batched
+    device extraction (kernel.extract_visible_batched output): only the
+    live rows are touched — the device already did the mask + prefix-sum
+    packing. Entries keep contended insert/remove metadata above min_seq
+    (oracle.snapshot_segments format), so the snapshot loads mid-window."""
+    from .constants import DEV_NO_REMOVE
+
+    (origin_op, origin_off, length, anno, ins_seq, ins_client,
+     rem_seq, rem_client, counts) = packed
+    out: List[dict] = []
+    for i in range(int(counts[doc])):
+        payload = payloads.get(int(origin_op[doc, i]))
+        entry: Dict[str, Any] = {"kind": payload.kind}
+        if payload.kind == SEG_MARKER:
+            entry["text"] = ""
+        else:
+            off = int(origin_off[doc, i])
+            entry["text"] = payload.text[off:off + int(length[doc, i])]
+        props = dict(payload.props) if payload.props else {}
+        chain = []
+        for op_id in anno[doc, i]:
+            op_id = int(op_id)
+            if op_id < 0:
+                continue
+            ann = payloads.get(op_id)
+            seq = ann.seq
+            if seq == DEV_UNASSIGNED:
+                seq = PENDING_ORDER_BASE + op_id
+            chain.append((seq, ann.props))
+        chain.sort(key=lambda kv: kv[0])
+        for _, pset in chain:
+            for key, value in pset.items():
+                if value is None:
+                    props.pop(key, None)
+                else:
+                    props[key] = value
+        if props:
+            entry["props"] = props
+        if int(ins_seq[doc, i]) > min_seq:
+            entry["seq"] = int(ins_seq[doc, i])
+            entry["client"] = int(ins_client[doc, i])
+        if int(rem_seq[doc, i]) != DEV_NO_REMOVE:
+            entry["removedSeq"] = int(rem_seq[doc, i])
+            entry["removedClient"] = int(rem_client[doc, i])
+        out.append(entry)
+    return out
+
+
+def chunk_entries(entries: List[dict], chunk_chars: int = 10000
+                  ) -> List[List[dict]]:
+    """Split snapshot entries into body chunks of ~chunk_chars characters
+    (reference SnapshotV1 header + 10k-char chunks, snapshotV1.ts:33-40)."""
+    chunks: List[List[dict]] = []
+    cur: List[dict] = []
+    size = 0
+    for e in entries:
+        cur.append(e)
+        size += max(1, len(e.get("text") or ""))
+        if size >= chunk_chars:
+            chunks.append(cur)
+            cur = []
+            size = 0
+    if cur or not chunks:
+        chunks.append(cur)
+    return chunks
+
+
 def extract_segments(state: DocState, payloads: PayloadTable,
                      ref_seq: Optional[int] = None, client: int = GOD_CLIENT,
                      doc: Optional[int] = None) -> List[Tuple[str, Optional[dict]]]:

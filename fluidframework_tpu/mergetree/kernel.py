@@ -395,6 +395,54 @@ def compact_batched(state: DocState) -> DocState:
 
 
 # ---------------------------------------------------------------------------
+# batched summary extraction
+# ---------------------------------------------------------------------------
+
+def _extract_one(s: DocState):
+    """Left-pack the snapshot-relevant segment rows — everything not yet
+    zambonied (removed at-or-before min_seq), i.e. visible text PLUS
+    contended collab-window metadata — via mask + prefix-sum addressing
+    into a dense output, so the host reads exactly the live rows instead
+    of scanning the whole capacity (reference snapshotV1.ts:33 segment
+    gather via mapRange, batched; the snapshot stays loadable mid-window)."""
+    c = s.capacity
+    idx = jnp.arange(c, dtype=jnp.int32)
+    valid = idx < s.count
+    keep = valid & ~(s.rem_seq <= s.min_seq)
+    n = jnp.sum(keep.astype(jnp.int32))
+    order = jnp.cumsum(keep.astype(jnp.int32)) - 1
+    src = jnp.full((c,), c - 1, jnp.int32)
+    src = src.at[jnp.where(keep, order, c)].set(idx, mode="drop")
+    return (s.origin_op[src], s.origin_off[src], s.length[src],
+            s.anno[src], s.ins_seq[src], s.ins_client[src],
+            s.rem_seq[src], s.rem_clients[src, 0], n)
+
+
+@jax.jit
+def extract_visible_batched(state: DocState):
+    """One device pass over a [B, ...] batch -> packed per-doc segment
+    rows: (origin_op, origin_off, length, anno, ins_seq, ins_client,
+    rem_seq, rem_client) each [B, C] (rows >= counts[b] are padding) +
+    counts [B]. One D2H transfer serves every document's snapshot
+    assembly."""
+    return jax.vmap(_extract_one)(state)
+
+
+def fetch_extracted(packed) -> tuple:
+    """Host fetch of an extraction result, sliced to the batch's max live
+    row count BEFORE the transfer: with left-packed rows everything past
+    max(counts) is padding, so this cuts D2H bytes by C/max_count — the
+    transfer, not the kernel, dominates snapshot extraction cost."""
+    import numpy as np
+
+    counts = np.asarray(packed[-1])
+    mx = max(int(counts.max()) if counts.size else 0, 1)
+    return tuple(
+        np.asarray(x[:, :mx]) if getattr(x, "ndim", 0) >= 2 else np.asarray(x)
+        for x in packed[:-1]) + (counts,)
+
+
+# ---------------------------------------------------------------------------
 # queries
 # ---------------------------------------------------------------------------
 

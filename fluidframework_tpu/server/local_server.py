@@ -271,3 +271,35 @@ class TpuLocalServer(LocalServer):
 
     def sequence_number(self, document_id: str) -> int:
         return self.sequencer().document_seq(document_id)
+
+    def write_materialized_snapshots(self, ref: str = "materialized"
+                                     ) -> Dict[str, str]:
+        """Commit the server-materialized chunked snapshots to git storage
+        under their own ref (per doc): the server-side summarization path —
+        no client summarizer involved (reference Scribe writes CLIENT
+        summaries, scribe/lambda.ts:162; this writes the sequencer's own
+        device state). Returns {document_id: commit_sha}."""
+        import json as _json
+
+        from ..protocol.summary import SummaryTree
+
+        snaps = self.sequencer().summarize_documents()
+        by_doc: Dict[str, SummaryTree] = {}
+        for (doc_id, store_id, channel_id), snap in snaps.items():
+            root = by_doc.setdefault(doc_id, SummaryTree())
+            store_node = root.entries.get(store_id)
+            if store_node is None:
+                store_node = root.add_tree(store_id)
+            node = store_node.add_tree(channel_id)
+            node.add_blob("header", _json.dumps(snap["header"]))
+            for i, chunk in enumerate(snap["chunks"]):
+                node.add_blob(f"chunk_{i}", _json.dumps(chunk))
+        out = {}
+        for doc_id, tree in by_doc.items():
+            gstore = self.historian.store(self.tenant_id, doc_id)
+            # The sequencer's own state is authoritative (no client-proposal
+            # validation cycle to wait for): advance the ref directly.
+            out[doc_id] = gstore.write_summary(
+                tree, ref=ref, message="server-materialized snapshot",
+                advance_ref=True)
+        return out

@@ -258,6 +258,60 @@ class MergeLaneStore:
                 bucket.state = kernel.compact_batched(bucket.state)
         self.flushes_since_compact = 0
 
+    # -- batched summary extraction ----------------------------------------
+    def extract_dispatch(self) -> List[tuple]:
+        """Phase 1 (device, async): launch ONE extraction pass per bucket
+        (mask + prefix-sum packing, kernel.extract_visible_batched). The
+        returned jobs hold in-flight device arrays — jax dispatch is
+        asynchronous, so the caller can keep sequencing the next window
+        while these execute (the reference's pipeline-stage overlap,
+        kafka-service/README.md:58-60)."""
+        jobs = []
+        for bucket in self.buckets:
+            lanes = [(i, key) for i, key in enumerate(bucket.used)
+                     if key is not None]
+            if not lanes:
+                continue
+            packed = kernel.extract_visible_batched(bucket.state)
+            jobs.append((packed, lanes, bucket.state.seq,
+                         bucket.state.min_seq))
+        return jobs
+
+    def extract_assemble(self, jobs: List[tuple],
+                         chunk_chars: int = 10000) -> Dict[tuple, dict]:
+        """Phase 2 (host): D2H transfer + text/props assembly touching only
+        the visible rows. Returns {lane_key: {"header", "chunks"}} — chunked
+        snapshot shape per reference SnapshotV1 (snapshotV1.ts:33-40)."""
+        from ..mergetree.host import assemble_entries, chunk_entries
+
+        from ..mergetree.constants import SEG_MARKER
+
+        out: Dict[tuple, dict] = {}
+        for packed, lanes, seq_dev, min_seq_dev in jobs:
+            packed = kernel.fetch_extracted(packed)
+            seqs = np.asarray(seq_dev)
+            min_seqs = np.asarray(min_seq_dev)
+            for lane, key in lanes:
+                entries = assemble_entries(packed, self.payloads, lane,
+                                           min_seq=int(min_seqs[lane]))
+                chunks = chunk_entries(entries, chunk_chars)
+                total = sum(
+                    (1 if e["kind"] == SEG_MARKER else len(e["text"]))
+                    for e in entries if e.get("removedSeq") is None)
+                out[key] = {
+                    "header": {
+                        "sequenceNumber": int(seqs[lane]),
+                        "minimumSequenceNumber": int(min_seqs[lane]),
+                        "totalLength": total,
+                        "chunkCount": len(chunks),
+                    },
+                    "chunks": chunks,
+                }
+        return out
+
+    def extract_all(self, chunk_chars: int = 10000) -> Dict[tuple, dict]:
+        return self.extract_assemble(self.extract_dispatch(), chunk_chars)
+
     # -- queries -----------------------------------------------------------
     def text(self, key: tuple) -> Optional[str]:
         """Materialized text for a channel (None if opaque/unknown)."""
@@ -629,6 +683,32 @@ class TpuSequencerLambda(IPartitionLambda):
             self.merge.drop(key)
             return
         streams.setdefault(key, []).extend(ops)
+
+    # -- batched server-side summarization ---------------------------------
+    def summarize_documents(self, chunk_chars: int = 10000
+                            ) -> Dict[tuple, dict]:
+        """Chunked snapshots of every materialized channel in one batched
+        device extraction per capacity bucket."""
+        return self.merge.extract_all(chunk_chars)
+
+    def summarize_documents_async(self, on_done,
+                                  chunk_chars: int = 10000):
+        """Pipeline-stage overlap (kafka-service/README.md:58-60): the
+        device extraction is dispatched NOW (async on the accelerator
+        queue); the D2H transfer + host snapshot assembly run on a worker
+        thread while the caller keeps sequencing the next batch. The
+        extracted device arrays are immutable, so subsequent flushes
+        replacing the lane states cannot corrupt an in-flight summary."""
+        import threading
+
+        jobs = self.merge.extract_dispatch()
+
+        def work():
+            on_done(self.merge.extract_assemble(jobs, chunk_chars))
+
+        th = threading.Thread(target=work, daemon=True)
+        th.start()
+        return th
 
     # -- introspection (tests / summarization) -----------------------------
     def channel_text(self, doc_id: str, store: str,

@@ -307,6 +307,75 @@ class TestDeviceTicketingVsScalarDeli:
         assert [e[0] for e in device] == ["seq", "seq"]  # dup silently drops
 
 
+class TestBatchedSummarization:
+    def _server_with_text(self, n_docs=3, ops_per_doc=30):
+        server = TpuLocalServer()
+        loader = Loader(LocalDocumentServiceFactory(server))
+        texts = {}
+        rng = random.Random(5)
+        for d in range(n_docs):
+            doc = f"doc{d}"
+            c = loader.create_detached(doc)
+            ds = c.runtime.create_datastore("default")
+            c.attach()
+            t = ds.create_channel("text", SharedString.TYPE)
+            for i in range(ops_per_doc):
+                n = t.get_length()
+                if n > 4 and rng.random() < 0.3:
+                    a = rng.randrange(n - 1)
+                    t.remove_text(a, min(n, a + 2))
+                else:
+                    t.insert_text(rng.randrange(n + 1) if n else 0,
+                                  f"d{d}i{i};")
+            texts[doc] = t
+        return server, texts
+
+    def test_batched_extraction_matches_live_text(self):
+        """One device pass per bucket reproduces every document's text."""
+        server, texts = self._server_with_text()
+        snaps = server.sequencer().summarize_documents()
+        for doc, t in texts.items():
+            snap = snaps[(doc, "default", "text")]
+            joined = "".join(
+                e.get("text") or "￼"
+                for chunk in snap["chunks"] for e in chunk
+                if e.get("removedSeq") is None)
+            assert joined == t.get_text()
+            assert snap["header"]["totalLength"] == t.get_length()
+
+    def test_materialized_snapshots_commit_to_git(self):
+        server, texts = self._server_with_text(n_docs=2)
+        shas = server.write_materialized_snapshots()
+        assert set(shas) == {"doc0", "doc1"}
+        for doc, sha in shas.items():
+            store = server.historian.store(server.tenant_id, doc)
+            assert store.get(sha) is not None
+            assert store.get_ref("materialized") == sha
+
+    def test_async_extraction_overlaps_sequencing(self):
+        """The summary snapshot reflects the state at DISPATCH time even
+        though sequencing continues while the host assembly runs — the
+        stage-overlap contract (device arrays immutable)."""
+        server, texts = self._server_with_text(n_docs=1, ops_per_doc=10)
+        t = texts["doc0"]
+        frozen = t.get_text()
+        done = {}
+        th = server.sequencer().summarize_documents_async(
+            lambda snaps: done.update(snaps))
+        # Keep sequencing while the summary assembles.
+        for i in range(20):
+            t.insert_text(0, f"+{i}")
+        th.join(timeout=30)
+        assert not th.is_alive()
+        snap = done[("doc0", "default", "text")]
+        joined = "".join(
+            e.get("text") or "￼"
+            for chunk in snap["chunks"] for e in chunk
+            if e.get("removedSeq") is None)
+        assert joined == frozen
+        assert t.get_text() != frozen
+
+
 class TestOverflowRecovery:
     def test_lane_promotes_through_buckets(self):
         """A document that outgrows its capacity bucket mid-batch recovers
