@@ -48,8 +48,26 @@ from .state import DocState
 # visibility
 # ---------------------------------------------------------------------------
 
-def visibility(s: DocState, ref_seq, client) -> Tuple[jnp.ndarray, jnp.ndarray,
-                                                      jnp.ndarray]:
+def _cumsum_sp(vlen: jnp.ndarray, sp_shards: int) -> jnp.ndarray:
+    """Inclusive prefix sum over the capacity axis in the sequence-parallel
+    formulation: sp_shards local cumsums + an exclusive scan of the shard
+    totals (the two-level collective-scan recipe, parallel/seq_scan.py).
+    With the capacity axis sharded over 'sp', the reshape aligns blocks to
+    shards, the inner cumsum stays shard-local, and GSPMD lowers the tiny
+    totals exchange to an all-gather over ICI — long-document position
+    resolution scales across the mesh instead of serializing one chip."""
+    c = vlen.shape[-1]
+    if sp_shards <= 1 or c % sp_shards:
+        return jnp.cumsum(vlen)
+    blocks = vlen.reshape(sp_shards, c // sp_shards)
+    local = jnp.cumsum(blocks, axis=-1)
+    totals = local[:, -1]
+    offsets = jnp.cumsum(totals) - totals  # exclusive over shards
+    return (local + offsets[:, None]).reshape(c)
+
+
+def visibility(s: DocState, ref_seq, client, sp_shards: int = 1
+               ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """(vis, vlen, cum): visibility mask, visible lengths, exclusive prefix
     sum at perspective (ref_seq, client). mergeTree.ts:1586 nodeLength."""
     c = s.capacity
@@ -60,7 +78,7 @@ def visibility(s: DocState, ref_seq, client) -> Tuple[jnp.ndarray, jnp.ndarray,
         s.rem_clients == client, axis=-1)
     vis = valid & inserted & ~removed
     vlen = jnp.where(vis, s.length, 0)
-    cum = jnp.cumsum(vlen) - vlen  # exclusive
+    cum = _cumsum_sp(vlen, sp_shards) - vlen  # exclusive
     return vis, vlen, cum
 
 
@@ -102,10 +120,11 @@ def _masked_scalar(values, mask):
     return jnp.sum(jnp.where(mask, values, 0))
 
 
-def _ensure_boundary(s: DocState, pos, ref_seq, client, enabled) -> DocState:
+def _ensure_boundary(s: DocState, pos, ref_seq, client, enabled,
+                     sp_shards: int = 1) -> DocState:
     """Split the segment containing `pos` (if any) so `pos` falls on a
     segment boundary (reference ensureIntervalBoundary, mergeTree.ts:2240)."""
-    vis, vlen, cum = visibility(s, ref_seq, client)
+    vis, vlen, cum = visibility(s, ref_seq, client, sp_shards)
     inside = vis & (cum < pos) & (pos < cum + vlen)
     do = enabled & jnp.any(inside)
     idx = jnp.argmax(inside).astype(jnp.int32)
@@ -126,12 +145,13 @@ def _ensure_boundary(s: DocState, pos, ref_seq, client, enabled) -> DocState:
 # op phases (single doc)
 # ---------------------------------------------------------------------------
 
-def _insert_phase(s: DocState, op: PackedOps, t, enabled) -> DocState:
+def _insert_phase(s: DocState, op: PackedOps, t, enabled,
+                  sp_shards: int = 1) -> DocState:
     """Find the insert slot via the breakTie run-scan, shift, write the new
     segment (boundary already ensured, so the op never lands mid-segment)."""
     r, cl, p = op.ref_seq[t], op.client[t], op.pos1[t]
     is_local = op.seq[t] == DEV_UNASSIGNED
-    vis, vlen, cum = visibility(s, r, cl)
+    vis, vlen, cum = visibility(s, r, cl, sp_shards)
     c = s.capacity
     j = jnp.arange(c, dtype=jnp.int32)
     in_run = cum == p
@@ -164,18 +184,19 @@ def _insert_phase(s: DocState, op: PackedOps, t, enabled) -> DocState:
     )
 
 
-def _range_targets(s: DocState, op: PackedOps, t):
+def _range_targets(s: DocState, op: PackedOps, t, sp_shards: int = 1):
     """Visible segments fully inside [pos1, pos2) (boundaries pre-split)."""
     r, cl = op.ref_seq[t], op.client[t]
-    vis, vlen, cum = visibility(s, r, cl)
+    vis, vlen, cum = visibility(s, r, cl, sp_shards)
     return vis & (vlen > 0) & (cum >= op.pos1[t]) & (cum + vlen <= op.pos2[t])
 
 
-def _remove_phase(s: DocState, op: PackedOps, t, enabled) -> DocState:
+def _remove_phase(s: DocState, op: PackedOps, t, enabled,
+                  sp_shards: int = 1) -> DocState:
     """markRangeRemoved semantics (mergeTree.ts:2607): first acked remove
     wins; a pending local remove is overwritten by an acked one (prior
     remover becomes an overlap client); later removers are overlap clients."""
-    target = _range_targets(s, op, t) & enabled
+    target = _range_targets(s, op, t, sp_shards) & enabled
     cl, seq = op.client[t], op.seq[t]
     is_local = seq == DEV_UNASSIGNED
     fresh = target & (s.rem_seq == DEV_NO_REMOVE)
@@ -219,11 +240,12 @@ def _append_overlap(rc: jnp.ndarray, need: jnp.ndarray,
     return jnp.where((can[:, None]) & onehot, client[:, None], rc)
 
 
-def _annotate_phase(s: DocState, op: PackedOps, t, enabled) -> DocState:
+def _annotate_phase(s: DocState, op: PackedOps, t, enabled,
+                    sp_shards: int = 1) -> DocState:
     """Push the annotate op id into each affected segment's fixed-depth ring
     (newest first); host resolves per-key LWW by op seq at summary time.
     Ring exhaustion (oldest id still occupied) flags overflow."""
-    target = _range_targets(s, op, t) & enabled
+    target = _range_targets(s, op, t, sp_shards) & enabled
     tK = target[:, None]
     pushed = jnp.concatenate(
         [jnp.full(s.anno.shape[:-1] + (1,), op.op_id[t], jnp.int32),
@@ -254,7 +276,7 @@ def _ack_phase(s: DocState, op: PackedOps, t, kind) -> DocState:
 # one step
 # ---------------------------------------------------------------------------
 
-def apply_one(s: DocState, op: PackedOps, t) -> DocState:
+def apply_one(s: DocState, op: PackedOps, t, sp_shards: int = 1) -> DocState:
     """Apply op column t to a single document's state."""
     kind = op.kind[t]
     is_edit = (kind == OpKind.INSERT) | (kind == OpKind.REMOVE) | \
@@ -269,12 +291,15 @@ def apply_one(s: DocState, op: PackedOps, t) -> DocState:
     is_range = is_range & fits
 
     r, cl = op.ref_seq[t], op.client[t]
-    s1 = _ensure_boundary(s, op.pos1[t], r, cl, is_edit)
-    s2 = _ensure_boundary(s1, op.pos2[t], r, cl, is_range)
+    s1 = _ensure_boundary(s, op.pos1[t], r, cl, is_edit, sp_shards)
+    s2 = _ensure_boundary(s1, op.pos2[t], r, cl, is_range, sp_shards)
 
-    s_ins = _insert_phase(s2, op, t, is_edit & (kind == OpKind.INSERT))
-    s_rem = _remove_phase(s_ins, op, t, is_range & (kind == OpKind.REMOVE))
-    s_ann = _annotate_phase(s_rem, op, t, is_range & (kind == OpKind.ANNOTATE))
+    s_ins = _insert_phase(s2, op, t, is_edit & (kind == OpKind.INSERT),
+                          sp_shards)
+    s_rem = _remove_phase(s_ins, op, t, is_range & (kind == OpKind.REMOVE),
+                          sp_shards)
+    s_ann = _annotate_phase(s_rem, op, t,
+                            is_range & (kind == OpKind.ANNOTATE), sp_shards)
     out = _ack_phase(s_ann, op, t, kind)
 
     # Pending local submits (seq == DEV_UNASSIGNED) must not advance the
@@ -291,14 +316,16 @@ def apply_one(s: DocState, op: PackedOps, t) -> DocState:
 # The phases are written against single-doc shapes; vmap lifts them over the
 # document batch axis, scan drives the time axis.
 
-def _scan_ops(state: DocState, ops: PackedOps, batched: bool) -> DocState:
+def _scan_ops(state: DocState, ops: PackedOps, batched: bool,
+              sp_shards: int = 1) -> DocState:
     steps = ops.steps
 
     def body(s, t):
         if batched:
-            s2 = jax.vmap(lambda sd, od: apply_one(sd, od, t))(s, ops)
+            s2 = jax.vmap(lambda sd, od: apply_one(sd, od, t, sp_shards)
+                          )(s, ops)
         else:
-            s2 = apply_one(s, ops, t)
+            s2 = apply_one(s, ops, t, sp_shards)
         return s2, None
 
     out, _ = jax.lax.scan(body, state, jnp.arange(steps, dtype=jnp.int32))

@@ -20,18 +20,32 @@ from ..mergetree.pallas_ops import summary_lengths
 from . import ticket_kernel as tk
 
 
-def full_step(tstate, mstate, raw, ops):
-    """(ticket_state, merge_state, RawOps, PackedOps) ->
-    (ticket_state, merge_state, Ticketed, per-doc visible length)."""
-    tstate, ticketed = tk._scan_tickets(tstate, raw, batched=True)
-    admitted = ticketed.seq > 0
-    ops = ops._replace(
-        kind=jnp.where(admitted, ops.kind, OpKind.NOOP),
-        seq=jnp.where(admitted, ticketed.seq, ops.seq),
-        msn=jnp.where(admitted, ticketed.min_seq, ops.msn),
-    )
-    mstate = kernel._scan_ops(mstate, ops, batched=True)
-    # Summary-length reduction: fused Pallas pass on TPU, jnp elsewhere
-    # (mergetree/pallas_ops.py; semantics == visibility(s, s.seq, OBSERVER)).
-    total_len = summary_lengths(mstate)
-    return tstate, mstate, ticketed, total_len
+def make_full_step(sp_shards: int = 1):
+    """Build the fused pipeline step for a given sequence-parallel factor:
+    with sp_shards > 1 the merge kernel's visibility prefix sums use the
+    two-level collective-scan formulation (kernel._cumsum_sp), so a
+    capacity axis sharded over 'sp' resolves positions with shard-local
+    cumsums + a tiny cross-shard offset exchange instead of a serialized
+    full-axis scan (SURVEY.md §5 long-context mapping)."""
+
+    def full_step(tstate, mstate, raw, ops):
+        """(ticket_state, merge_state, RawOps, PackedOps) ->
+        (ticket_state, merge_state, Ticketed, per-doc visible length)."""
+        tstate, ticketed = tk._scan_tickets(tstate, raw, batched=True)
+        admitted = ticketed.seq > 0
+        ops2 = ops._replace(
+            kind=jnp.where(admitted, ops.kind, OpKind.NOOP),
+            seq=jnp.where(admitted, ticketed.seq, ops.seq),
+            msn=jnp.where(admitted, ticketed.min_seq, ops.msn),
+        )
+        mstate = kernel._scan_ops(mstate, ops2, batched=True,
+                                  sp_shards=sp_shards)
+        # Summary-length reduction: fused Pallas pass on TPU, jnp elsewhere
+        # (mergetree/pallas_ops.py; semantics == visibility(s, s.seq, ...)).
+        total_len = summary_lengths(mstate)
+        return tstate, mstate, ticketed, total_len
+
+    return full_step
+
+
+full_step = make_full_step(1)
