@@ -1,0 +1,233 @@
+"""Durable service backends: checkpoints/deltas/summaries that survive
+process death.
+
+The reference persists lambda state to MongoDB and summaries to bare git
+repos on disk (scriptorium/lambda.ts:16-103 insertMany into Mongo;
+gitrest over nodegit). The equivalents here:
+
+- SqliteDatabaseManager / SqliteCollection: the services-core ICollection
+  SPI over a sqlite3 file — same API as the in-memory DatabaseManager
+  (database.py), drop-in for LocalServer(db=...). Unique-key idempotence
+  (the dup-key-11000 replay guard) becomes a UNIQUE column.
+- FileGitStore / FileHistorian: content-addressed objects + refs persisted
+  to a directory (objects/<sha>, refs.json), loadable by a fresh process.
+
+In-memory remains the test default; pass these in where durability is the
+point (kill-and-restart, multi-node over shared storage).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .storage import GitBlob, GitCommit, GitStore, GitTree, Historian
+
+
+class SqliteCollection:
+    """services-core ICollection over one sqlite table. Documents are JSON
+    rows; the unique key (when configured) is a computed TEXT column with a
+    UNIQUE index, so replayed inserts are dropped exactly like the
+    reference's ignored dup-key errors."""
+
+    def __init__(self, conn: sqlite3.Connection, lock: threading.Lock,
+                 name: str,
+                 unique_key: Optional[Callable[[dict], Any]] = None):
+        self._conn = conn
+        self._lock = lock
+        self._table = f'col_{name}'
+        self._unique_key = unique_key
+        with self._lock:
+            self._conn.execute(
+                f'CREATE TABLE IF NOT EXISTS "{self._table}" '
+                '(id INTEGER PRIMARY KEY AUTOINCREMENT, '
+                ' ukey TEXT, doc TEXT NOT NULL)')
+            if unique_key is not None:
+                self._conn.execute(
+                    f'CREATE UNIQUE INDEX IF NOT EXISTS '
+                    f'"{self._table}_ukey" ON "{self._table}" (ukey) '
+                    'WHERE ukey IS NOT NULL')
+            self._conn.commit()
+
+    def _key(self, doc: dict) -> Optional[str]:
+        if self._unique_key is None:
+            return None
+        return json.dumps(self._unique_key(doc), sort_keys=True, default=str)
+
+    def insert_one(self, doc: dict) -> bool:
+        with self._lock:
+            try:
+                self._conn.execute(
+                    f'INSERT INTO "{self._table}" (ukey, doc) VALUES (?, ?)',
+                    (self._key(doc), json.dumps(doc, default=str)))
+                self._conn.commit()
+                return True
+            except sqlite3.IntegrityError:
+                return False  # idempotent replay
+
+    def insert_many(self, docs: List[dict]) -> int:
+        return sum(1 for d in docs if self.insert_one(d))
+
+    def _rows(self) -> List[Tuple[int, dict]]:
+        cur = self._conn.execute(
+            f'SELECT id, doc FROM "{self._table}" ORDER BY id')
+        return [(rid, json.loads(doc)) for rid, doc in cur.fetchall()]
+
+    def find(self, predicate: Callable[[dict], bool]) -> List[dict]:
+        with self._lock:
+            return [d for _, d in self._rows() if predicate(d)]
+
+    def find_one(self, predicate: Callable[[dict], bool]) -> Optional[dict]:
+        with self._lock:
+            for _, d in self._rows():
+                if predicate(d):
+                    return d
+        return None
+
+    def upsert(self, match: Callable[[dict], bool], doc: dict) -> None:
+        with self._lock:
+            for rid, d in self._rows():
+                if match(d):
+                    self._conn.execute(
+                        f'UPDATE "{self._table}" SET doc = ?, ukey = ? '
+                        'WHERE id = ?',
+                        (json.dumps(doc, default=str), self._key(doc), rid))
+                    self._conn.commit()
+                    return
+            self._conn.execute(
+                f'INSERT INTO "{self._table}" (ukey, doc) VALUES (?, ?)',
+                (self._key(doc), json.dumps(doc, default=str)))
+            self._conn.commit()
+
+    def __len__(self) -> int:
+        with self._lock:
+            cur = self._conn.execute(
+                f'SELECT COUNT(*) FROM "{self._table}"')
+            return cur.fetchone()[0]
+
+
+class SqliteDatabaseManager:
+    """IDatabaseManager over one sqlite file (drop-in for DatabaseManager)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        self._collections: Dict[str, SqliteCollection] = {}
+        self._meta_lock = threading.Lock()
+
+    def collection(self, name: str,
+                   unique_key: Optional[Callable[[dict], Any]] = None
+                   ) -> SqliteCollection:
+        with self._meta_lock:
+            if name not in self._collections:
+                self._collections[name] = SqliteCollection(
+                    self._conn, self._lock, name, unique_key)
+            return self._collections[name]
+
+    def close(self) -> None:
+        self._conn.close()
+
+
+# ---------------------------------------------------------------------------
+# file-backed git storage
+# ---------------------------------------------------------------------------
+
+class FileGitStore(GitStore):
+    """GitStore whose objects/refs persist under a directory:
+    <root>/objects/<sha> (JSON-framed object) and <root>/refs.json —
+    the gitrest bare-repo equivalent. Loads everything at construction
+    (object counts here are summary-scale, not monorepo-scale)."""
+
+    def __init__(self, root: str):
+        super().__init__()
+        self.root = root
+        self._objdir = os.path.join(root, "objects")
+        os.makedirs(self._objdir, exist_ok=True)
+        self._refs_path = os.path.join(root, "refs.json")
+        if os.path.exists(self._refs_path):
+            with open(self._refs_path) as f:
+                self._refs.update(json.load(f))
+        for sha in os.listdir(self._objdir):
+            self._objects[sha] = self._load_object(sha)
+
+    def _load_object(self, sha: str):
+        with open(os.path.join(self._objdir, sha), "rb") as f:
+            framed = json.loads(f.read().decode("utf-8"))
+        kind = framed["kind"]
+        if kind == "blob":
+            return GitBlob(sha, bytes.fromhex(framed["content"]))
+        if kind == "tree":
+            return GitTree(sha, {k: tuple(v)
+                                 for k, v in framed["entries"].items()})
+        return GitCommit(sha, framed["tree"], framed["parents"],
+                         framed["message"], framed["timestamp"])
+
+    def _persist_object(self, sha: str, obj) -> None:
+        path = os.path.join(self._objdir, sha)
+        if os.path.exists(path):
+            return  # content-addressed: same sha == same bytes
+        if isinstance(obj, GitBlob):
+            framed = {"kind": "blob", "content": obj.content.hex()}
+        elif isinstance(obj, GitTree):
+            framed = {"kind": "tree",
+                      "entries": {k: list(v)
+                                  for k, v in obj.entries.items()}}
+        else:
+            framed = {"kind": "commit", "tree": obj.tree_sha,
+                      "parents": obj.parents, "message": obj.message,
+                      "timestamp": obj.timestamp}
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(framed, f)
+        os.replace(tmp, path)  # atomic publish
+
+    def put_blob(self, content: bytes) -> str:
+        sha = super().put_blob(content)
+        self._persist_object(sha, self._objects[sha])
+        return sha
+
+    def put_tree(self, entries) -> str:
+        sha = super().put_tree(entries)
+        self._persist_object(sha, self._objects[sha])
+        return sha
+
+    def put_commit(self, tree_sha, parents, message) -> str:
+        sha = super().put_commit(tree_sha, parents, message)
+        self._persist_object(sha, self._objects[sha])
+        return sha
+
+    def set_ref(self, name: str, commit_sha: str) -> None:
+        super().set_ref(name, commit_sha)
+        tmp = self._refs_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._refs, f)
+        os.replace(tmp, self._refs_path)
+
+
+class FileHistorian(Historian):
+    """Historian whose per-document stores persist under
+    <root>/<tenant>/<document>/ (reference gitrest's repo-per-document
+    layout behind the historian proxy)."""
+
+    def __init__(self, root: str):
+        super().__init__()
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def store(self, tenant_id: str, document_id: str) -> GitStore:
+        key = (tenant_id, document_id)
+        with self._lock:
+            if key not in self._stores:
+                self._stores[key] = FileGitStore(
+                    os.path.join(self.root, _safe(tenant_id),
+                                 _safe(document_id)))
+            return self._stores[key]
+
+
+def _safe(name: str) -> str:
+    return "".join(c if c.isalnum() or c in "-_." else "_" for c in name)

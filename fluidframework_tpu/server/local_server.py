@@ -40,7 +40,8 @@ from .lambdas import (
 )
 from .lambdas.scriptorium import delta_key, query_deltas
 from .log import MessageLog, make_message_log
-from .partition import LambdaRunner, PartitionManager
+from .partition import (LambdaRunner, OverlappedLambdaRunner,
+                        PartitionManager)
 from .storage import Historian
 
 RAW_TOPIC = "rawdeltas"
@@ -85,16 +86,21 @@ class LocalServer:
                  native_log: Optional[bool] = False,
                  db: Optional[DatabaseManager] = None,
                  historian: Optional[Historian] = None,
-                 config=None):
+                 config=None, overlapped: bool = False):
         """native_log: False = pure-Python broker (default, the LocalKafka
         role); True = the C++ engine (requires the toolchain); None = auto.
 
         db/historian: pass shared instances to make this core one node of a
         cluster over common durable services (the reference's Mongo + git);
         deli/scribe then resume from any checkpoints already present —
-        the multi-node takeover path (server/nodes.py)."""
+        the multi-node takeover path (server/nodes.py).
+
+        overlapped: pump the lambda stages concurrently (OverlappedLambda
+        Runner — sequencing batch N+1 while batch N's persistence flushes);
+        the serial runner stays the deterministic default."""
         self.tenant_id = tenant_id
         self.auto_pump = auto_pump
+        self.overlapped = overlapped
         self.log = make_message_log(default_partitions=partitions,
                                     native=native_log)
         self.db = db if db is not None else DatabaseManager()
@@ -118,7 +124,8 @@ class LocalServer:
         self.log.topic(RAW_TOPIC)
         self.log.topic(DELTAS_TOPIC)
 
-        self.runner = LambdaRunner()
+        self.runner = (OverlappedLambdaRunner() if overlapped
+                       else LambdaRunner())
         # Per-service config (the reference's nconf slice per lambda,
         # services-core/src/lambdas.ts:56). Batched deli checkpointing
         # requires the pump's eager offset commit OFF so the replay window
@@ -127,16 +134,16 @@ class LocalServer:
         self._deli_mgr = self.runner.add(self._build_sequencer())
         self._copier_mgr = self.runner.add(PartitionManager(
             self.log, "copier", RAW_TOPIC,
-            lambda ctx: CopierLambda(ctx, self.raw_deltas)))
+            lambda ctx: CopierLambda(ctx, self.raw_deltas), offload=True))
         self._scriptorium_mgr = self.runner.add(PartitionManager(
             self.log, "scriptorium", DELTAS_TOPIC,
-            lambda ctx: ScriptoriumLambda(ctx, self.deltas)))
+            lambda ctx: ScriptoriumLambda(ctx, self.deltas), offload=True))
         self._scribe_mgr = self.runner.add(PartitionManager(
             self.log, "scribe", DELTAS_TOPIC,
             lambda ctx: ScribeLambda(ctx, self.historian, tenant_id,
                                      send_system=self._send_system,
                                      checkpoints=self.scribe_checkpoints,
-                                     fresh_log=True)))
+                                     fresh_log=True), offload=True))
         self._broadcaster_mgr = self.runner.add(PartitionManager(
             self.log, "broadcaster", DELTAS_TOPIC,
             lambda ctx: BroadcasterLambda(ctx, rooms=self._rooms)))
@@ -228,6 +235,19 @@ class LocalServer:
 
     def pump(self) -> int:
         """Drive every lambda stage to quiescence (synchronous pipeline)."""
+        if self.overlapped:
+            # Stage workers can re-enter pump (a broadcaster listener
+            # submitting an op -> auto_pump): never block on the active
+            # pump — its round loop runs until quiescence, so the newly
+            # queued message is drained by the pump already in flight.
+            if not self._pump_lock.acquire(blocking=False):
+                return 0
+            try:
+                if self.pump_gate is not None and not self.pump_gate():
+                    return 0
+                return self.runner.pump()
+            finally:
+                self._pump_lock.release()
         with self._pump_lock:
             if self.pump_gate is not None and not self.pump_gate():
                 return 0

@@ -98,8 +98,14 @@ class PartitionManager:
 
     def __init__(self, log: MessageLog, group: str, topic: str,
                  lambda_factory: Callable[[LambdaContext], IPartitionLambda],
-                 auto_commit: bool = True):
+                 auto_commit: bool = True, offload: bool = False):
         self.log = log
+        # offload=True marks a pure-persistence stage (scriptorium/scribe/
+        # copier): safe to pump on a worker thread because it never calls
+        # back into client connections. Interactive stages (deli nacks,
+        # broadcaster delivery) re-enter client locks and MUST pump on the
+        # submitting thread (same-thread RLock reentrancy).
+        self.offload = offload
         self.pumps: Dict[int, PartitionPump] = {}
         topic_obj = log.topic(topic)
         for p in range(len(topic_obj.partitions)):
@@ -140,3 +146,51 @@ class LambdaRunner:
             total += n
             if n == 0:
                 return total
+
+    def close(self) -> None:
+        pass
+
+
+class OverlappedLambdaRunner(LambdaRunner):
+    """Pipeline-stage overlap (reference kafka-service/README.md:58-60:
+    "process batch N+1 while batch N's DB writes are in flight"): each
+    round pumps the offload-marked persistence stages on worker threads
+    concurrently with the interactive stages inline, so the sequencer
+    drains batch N+1 while scriptorium/scribe flush batch N. pump() stays
+    synchronous (returns at quiescence), keeping the serial runner's
+    crash/replay semantics; within a round the stage wall-clock is
+    max(inline, slowest-offloaded), not the sum.
+
+    Only managers with offload=True move off-thread: stages that call back
+    into client connections (broadcaster delivery, deli nacks) re-enter
+    client-side locks held by the submitting thread and would deadlock on
+    a worker."""
+
+    def __init__(self):
+        super().__init__()
+        self._pool = None
+
+    def _ensure_pool(self):
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=max(len(self.managers), 1),
+                thread_name_prefix="lambda-stage")
+        return self._pool
+
+    def pump(self) -> int:
+        pool = self._ensure_pool()
+        total = 0
+        while True:
+            futures = [pool.submit(m.pump_all)
+                       for m in self.managers if m.offload]
+            n = sum(m.pump_all() for m in self.managers if not m.offload)
+            n += sum(f.result() for f in futures)
+            total += n
+            if n == 0:
+                return total
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
