@@ -180,7 +180,12 @@ class SharedDirectory(SharedObject):
             if local:
                 self._retire_subdir_op(t, contents)
             parent = self.get_working_directory(contents["path"])
-            if parent is not None and not local:
+            # Apply on the submitter too (idempotent pop): the optimistic
+            # local delete already removed it, but a concurrent remote
+            # create sequenced before this op resurrects the subdir — the
+            # sequenced delete must then win identically on every replica.
+            if parent is not None and \
+                    contents["name"] in parent.subdirs:
                 parent.subdirs.pop(contents["name"], None)
                 self.emit("subDirectoryDeleted", contents["path"],
                           contents["name"], local)
