@@ -155,8 +155,16 @@ def main() -> None:
                     client_seq=ops.seq,  # per-doc clientSeq == seq here
                     ref_seq=ops.ref_seq)
 
-    from fluidframework_tpu.server.pipeline import full_step
-    step = jax.jit(full_step, donate_argnums=(0, 1))
+    from fluidframework_tpu.mergetree.pallas_apply import fused_available
+    from fluidframework_tpu.server.pipeline import make_full_step
+
+    # The VMEM-resident fused apply (pallas_apply.py) when the backend
+    # compiles it; the scan×vmap kernel otherwise. BENCH_FUSED=0 forces off.
+    use_fused = (os.environ.get("BENCH_FUSED", "1") != "0"
+                 and jax.default_backend() in ("tpu", "axon")
+                 and fused_available())
+    step = jax.jit(make_full_step(fused_apply=use_fused),
+                   donate_argnums=(0, 1))
 
     def fresh():
         return (tk.make_ticket_state(8, batch=n_docs),
@@ -213,6 +221,7 @@ def main() -> None:
         "vs_baseline": round(ops_per_sec / baseline_ops_per_sec, 2),
         "extra": {
             "backend": jax.default_backend(),
+            "fused_apply": use_fused,
             "elapsed_s": round(elapsed, 4),
             "docs": n_docs, "ops_per_doc": n_ops,
             "baseline_single_thread_ops_s": round(baseline_ops_per_sec, 1),

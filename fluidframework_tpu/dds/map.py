@@ -88,13 +88,14 @@ class MapKernel:
             if t == "clear":
                 if self.pending_clear_count > 0:
                     self.pending_clear_count -= 1
-                return
-            pending = self.pending_keys.get(op["key"])
-            if pending and op.get("pid") in pending:
-                pending.remove(op["pid"])
-                if not pending:
-                    del self.pending_keys[op["key"]]
-                return
+                    return
+            else:
+                pending = self.pending_keys.get(op["key"])
+                if pending and op.get("pid") in pending:
+                    pending.remove(op["pid"])
+                    if not pending:
+                        del self.pending_keys[op["key"]]
+                    return
             # No pending record: the optimistic local state was destroyed
             # out from under this op (the containing subdirectory was
             # deleted and recreated while it was in flight). Every other

@@ -145,13 +145,14 @@ def _ensure_boundary(s: DocState, pos, ref_seq, client, enabled,
 # op phases (single doc)
 # ---------------------------------------------------------------------------
 
-def _insert_phase(s: DocState, op: PackedOps, t, enabled,
-                  sp_shards: int = 1) -> DocState:
+def _insert_phase(s: DocState, op: PackedOps, t, enabled, view) -> DocState:
     """Find the insert slot via the breakTie run-scan, shift, write the new
-    segment (boundary already ensured, so the op never lands mid-segment)."""
+    segment (boundary already ensured, so the op never lands mid-segment).
+    `view` is the precomputed visibility triple on `s` (shared with the
+    range phases — one prefix sum serves both, see apply_one)."""
     r, cl, p = op.ref_seq[t], op.client[t], op.pos1[t]
     is_local = op.seq[t] == DEV_UNASSIGNED
-    vis, vlen, cum = visibility(s, r, cl, sp_shards)
+    vis, vlen, cum = view
     c = s.capacity
     j = jnp.arange(c, dtype=jnp.int32)
     in_run = cum == p
@@ -184,19 +185,18 @@ def _insert_phase(s: DocState, op: PackedOps, t, enabled,
     )
 
 
-def _range_targets(s: DocState, op: PackedOps, t, sp_shards: int = 1):
-    """Visible segments fully inside [pos1, pos2) (boundaries pre-split)."""
-    r, cl = op.ref_seq[t], op.client[t]
-    vis, vlen, cum = visibility(s, r, cl, sp_shards)
+def _range_targets(s: DocState, op: PackedOps, t, view):
+    """Visible segments fully inside [pos1, pos2) (boundaries pre-split).
+    `view` is the shared visibility triple (see apply_one)."""
+    vis, vlen, cum = view
     return vis & (vlen > 0) & (cum >= op.pos1[t]) & (cum + vlen <= op.pos2[t])
 
 
-def _remove_phase(s: DocState, op: PackedOps, t, enabled,
-                  sp_shards: int = 1) -> DocState:
+def _remove_phase(s: DocState, op: PackedOps, t, enabled, view) -> DocState:
     """markRangeRemoved semantics (mergeTree.ts:2607): first acked remove
     wins; a pending local remove is overwritten by an acked one (prior
     remover becomes an overlap client); later removers are overlap clients."""
-    target = _range_targets(s, op, t, sp_shards) & enabled
+    target = _range_targets(s, op, t, view) & enabled
     cl, seq = op.client[t], op.seq[t]
     is_local = seq == DEV_UNASSIGNED
     fresh = target & (s.rem_seq == DEV_NO_REMOVE)
@@ -240,12 +240,11 @@ def _append_overlap(rc: jnp.ndarray, need: jnp.ndarray,
     return jnp.where((can[:, None]) & onehot, client[:, None], rc)
 
 
-def _annotate_phase(s: DocState, op: PackedOps, t, enabled,
-                    sp_shards: int = 1) -> DocState:
+def _annotate_phase(s: DocState, op: PackedOps, t, enabled, view) -> DocState:
     """Push the annotate op id into each affected segment's fixed-depth ring
     (newest first); host resolves per-key LWW by op seq at summary time.
     Ring exhaustion (oldest id still occupied) flags overflow."""
-    target = _range_targets(s, op, t, sp_shards) & enabled
+    target = _range_targets(s, op, t, view) & enabled
     tK = target[:, None]
     pushed = jnp.concatenate(
         [jnp.full(s.anno.shape[:-1] + (1,), op.op_id[t], jnp.int32),
@@ -294,12 +293,17 @@ def apply_one(s: DocState, op: PackedOps, t, sp_shards: int = 1) -> DocState:
     s1 = _ensure_boundary(s, op.pos1[t], r, cl, is_edit, sp_shards)
     s2 = _ensure_boundary(s1, op.pos2[t], r, cl, is_range, sp_shards)
 
+    # One visibility pass on s2 serves the insert AND range phases: an
+    # INSERT leaves the range phases disabled and a REMOVE/ANNOTATE leaves
+    # the insert phase disabled (s_ins == s2 exactly), so the shared view
+    # is valid wherever it is consumed — 3 prefix sums per op, not 4.
+    view2 = visibility(s2, r, cl, sp_shards)
     s_ins = _insert_phase(s2, op, t, is_edit & (kind == OpKind.INSERT),
-                          sp_shards)
+                          view2)
     s_rem = _remove_phase(s_ins, op, t, is_range & (kind == OpKind.REMOVE),
-                          sp_shards)
+                          view2)
     s_ann = _annotate_phase(s_rem, op, t,
-                            is_range & (kind == OpKind.ANNOTATE), sp_shards)
+                            is_range & (kind == OpKind.ANNOTATE), view2)
     out = _ack_phase(s_ann, op, t, kind)
 
     # Pending local submits (seq == DEV_UNASSIGNED) must not advance the

@@ -1,0 +1,427 @@
+"""Fused Pallas apply: the WHOLE op stream in one VMEM-resident kernel.
+
+The scan×vmap kernel (kernel.py) re-reads and re-writes the full segment
+table from HBM ~10× per op (three roll-select shifts + phase writes over
+~15 columns) — measured bandwidth-bound (PERF.md). This kernel instead
+tiles documents into VMEM blocks, applies ALL T ops to the resident block
+with a `fori_loop`, and writes the state back once:
+
+    HBM traffic: 2 state passes TOTAL (+ tiny op columns), vs ~10·T passes.
+
+Semantics are kernel.py's apply_one exactly, re-expressed with a leading
+doc axis and with the primitives Mosaic lowers well:
+- prefix sums  -> Hillis-Steele doubling over lane rolls (log2(C) steps);
+- argmax       -> masked min-over-iota reduction;
+- 3-D columns (rem_clients [C,K], anno [C,A]) -> K/A separate 2-D planes.
+
+The same batched body runs in three modes: plain jnp (reference/fallback),
+Pallas interpret (CPU conformance tests), Pallas TPU (the fast path).
+Dispatch + runtime probe mirror pallas_ops.summary_lengths.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .constants import DEV_NO_REMOVE, DEV_UNASSIGNED
+from .oppack import OpKind, PackedOps
+from .state import DocState
+
+DOC_TILE = 128  # docs per VMEM block (int32 sublane multiple)
+
+
+# ---------------------------------------------------------------------------
+# the batched body (pure jnp on [B, C] planes; `roll` injected per mode)
+# ---------------------------------------------------------------------------
+
+def _lane_iota(shape):
+    return jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+
+
+def _any_lane(mask):
+    return jnp.sum(mask.astype(jnp.int32), axis=1, keepdims=True) > 0
+
+
+def _first_true(mask, c):
+    idx = _lane_iota(mask.shape)
+    return jnp.min(jnp.where(mask, idx, c), axis=1, keepdims=True)
+
+
+def _masked_scalar(values, mask):
+    return jnp.sum(jnp.where(mask, values, 0), axis=1, keepdims=True)
+
+
+def _cumsum_excl(x, roll):
+    """Exclusive prefix sum along lanes: Hillis-Steele doubling."""
+    c = x.shape[-1]
+    lane = _lane_iota(x.shape)
+    total = x
+    k = 1
+    while k < c:
+        total = total + jnp.where(lane >= k, roll(total, k), 0)
+        k *= 2
+    return total - x
+
+
+def _visibility(st: Dict[str, jnp.ndarray], ref, client, k_slots, roll):
+    lane = _lane_iota(st["length"].shape)
+    valid = lane < st["count"]
+    inserted = (st["ins_seq"] <= ref) | (st["ins_client"] == client)
+    removed = st["rem_seq"] <= ref
+    for i in range(k_slots):
+        removed = removed | (st[f"rc{i}"] == client)
+    vis = valid & inserted & ~removed
+    vlen = jnp.where(vis, st["length"], 0)
+    return vis, vlen, _cumsum_excl(vlen, roll)
+
+
+_SEG_PLANES = ("length", "ins_seq", "ins_client", "local_seq", "rem_seq",
+               "rem_local_seq", "origin_op", "origin_off")
+
+
+def _shift_right(st, shift_mask, k_slots, a_slots, roll):
+    out = dict(st)
+    for name in _SEG_PLANES + tuple(f"rc{i}" for i in range(k_slots)) + \
+            tuple(f"an{i}" for i in range(a_slots)):
+        out[name] = jnp.where(shift_mask, roll(st[name], 1), st[name])
+    return out
+
+
+def _ensure_boundary(st, pos, ref, client, enabled, k_slots, a_slots, roll):
+    vis, vlen, cum = _visibility(st, ref, client, k_slots, roll)
+    inside = vis & (cum < pos) & (pos < cum + vlen)
+    do = enabled & _any_lane(inside)
+    c = st["length"].shape[-1]
+    slot = _first_true(inside, c)
+    off = pos - _masked_scalar(cum, inside)
+    parent_len = _masked_scalar(st["length"], inside)
+    lane = _lane_iota(st["length"].shape)
+    g = _shift_right(st, (lane >= slot + 1) & do, k_slots, a_slots, roll)
+    g["count"] = st["count"] + do.astype(jnp.int32)
+    is_left = do & (lane == slot)
+    is_right = do & (lane == slot + 1)
+    g["length"] = jnp.where(is_left, off,
+                            jnp.where(is_right, parent_len - off,
+                                      g["length"]))
+    g["origin_off"] = jnp.where(is_right, g["origin_off"] + off,
+                                g["origin_off"])
+    return g
+
+
+def _insert_phase(st, op, enabled, view, k_slots, a_slots, roll):
+    vis, vlen, cum = view
+    c = st["length"].shape[-1]
+    lane = _lane_iota(st["length"].shape)
+    is_local = op["seq"] == DEV_UNASSIGNED
+    in_run = cum == op["pos1"]
+    tomb = st["rem_seq"] <= op["ref_seq"]
+    acked_ins = st["ins_seq"] != DEV_UNASSIGNED
+    stop = in_run & (vis | (~tomb & (is_local | acked_ins))
+                     | (lane >= st["count"]))
+    found = _any_lane(stop)
+    bad = enabled & ~found
+    enabled = enabled & found
+    slot = _first_true(stop, c)
+    g = _shift_right(st, (lane >= slot) & enabled, k_slots, a_slots, roll)
+    g["count"] = st["count"] + enabled.astype(jnp.int32)
+    here = enabled & (lane == slot)
+    g["length"] = jnp.where(here, op["new_len"], g["length"])
+    g["ins_seq"] = jnp.where(here, op["seq"], g["ins_seq"])
+    g["ins_client"] = jnp.where(here, op["client"], g["ins_client"])
+    g["local_seq"] = jnp.where(
+        here, jnp.where(is_local, op["local_seq"], 0), g["local_seq"])
+    g["rem_seq"] = jnp.where(here, DEV_NO_REMOVE, g["rem_seq"])
+    g["rem_local_seq"] = jnp.where(here, 0, g["rem_local_seq"])
+    g["origin_op"] = jnp.where(here, op["op_id"], g["origin_op"])
+    g["origin_off"] = jnp.where(here, 0, g["origin_off"])
+    for i in range(k_slots):
+        g[f"rc{i}"] = jnp.where(here, -1, g[f"rc{i}"])
+    for i in range(a_slots):
+        g[f"an{i}"] = jnp.where(here, -1, g[f"an{i}"])
+    g["overflow"] = g["overflow"] | bad
+    return g
+
+
+def _range_targets(st, op, view):
+    vis, vlen, cum = view
+    return vis & (vlen > 0) & (cum >= op["pos1"]) & \
+        (cum + vlen <= op["pos2"])
+
+
+def _append_overlap(st, need, client, k_slots):
+    """Place client into the first free overlap slot (>=1) where need."""
+    taken_before = jnp.zeros_like(need)
+    placed = dict(st)
+    for i in range(1, k_slots):
+        free_i = st[f"rc{i}"] == -1
+        first_free = free_i & ~taken_before
+        placed[f"rc{i}"] = jnp.where(need & first_free, client,
+                                     st[f"rc{i}"])
+        taken_before = taken_before | free_i
+    # kernel._append_overlap only writes when some slot is free; with no
+    # free slot nothing changes (the overflow check below catches it).
+    return placed
+
+
+def _remove_phase(st, op, enabled, view, k_slots, roll):
+    target = _range_targets(st, op, view) & enabled
+    is_local = op["seq"] == DEV_UNASSIGNED
+    fresh = target & (st["rem_seq"] == DEV_NO_REMOVE)
+    pend_overwrite = target & (st["rem_seq"] == DEV_UNASSIGNED) & ~is_local
+    already = target & (st["rem_seq"] != DEV_NO_REMOVE) & ~pend_overwrite
+
+    g = dict(st)
+    g["rem_seq"] = jnp.where(
+        fresh, jnp.where(is_local, DEV_UNASSIGNED, op["seq"]),
+        jnp.where(pend_overwrite, op["seq"], st["rem_seq"]))
+    g["rem_local_seq"] = jnp.where(
+        fresh & is_local, op["local_seq"],
+        jnp.where(pend_overwrite, 0, st["rem_local_seq"]))
+    prior = st["rc0"]
+    g["rc0"] = jnp.where(fresh | pend_overwrite, op["client"], st["rc0"])
+    displaced = pend_overwrite & (prior != op["client"])
+    g2 = _append_overlap(g, displaced, prior, k_slots)
+    has_client = jnp.zeros_like(already)
+    for i in range(k_slots):
+        has_client = has_client | (g2[f"rc{i}"] == op["client"])
+    need = already & ~has_client
+    g3 = _append_overlap(g2, need, op["client"], k_slots)
+    want = jnp.where(displaced, prior, op["client"])
+    landed = jnp.zeros_like(already)
+    for i in range(k_slots):
+        landed = landed | (g3[f"rc{i}"] == want)
+    over = _any_lane((displaced | need) & ~landed)
+    g3["overflow"] = st["overflow"] | over
+    return g3
+
+
+def _annotate_phase(st, op, enabled, view, a_slots):
+    target = _range_targets(st, op, view) & enabled
+    g = dict(st)
+    over = _any_lane(target & (st[f"an{a_slots - 1}"] != -1))
+    for i in range(a_slots - 1, 0, -1):
+        g[f"an{i}"] = jnp.where(target, st[f"an{i - 1}"], st[f"an{i}"])
+    g["an0"] = jnp.where(target, op["op_id"], st["an0"])
+    g["overflow"] = st["overflow"] | over
+    return g
+
+
+def _ack_phase(st, op):
+    kind = op["kind"]
+    ins_hit = (kind == OpKind.ACK_INSERT) & \
+        (st["ins_seq"] == DEV_UNASSIGNED) & \
+        (st["local_seq"] == op["local_seq"])
+    rem_hit = (kind == OpKind.ACK_REMOVE) & \
+        (st["rem_seq"] == DEV_UNASSIGNED) & \
+        (st["rem_local_seq"] == op["local_seq"])
+    g = dict(st)
+    g["ins_seq"] = jnp.where(ins_hit, op["seq"], st["ins_seq"])
+    g["local_seq"] = jnp.where(ins_hit, 0, st["local_seq"])
+    g["rem_seq"] = jnp.where(rem_hit, op["seq"], st["rem_seq"])
+    g["rem_local_seq"] = jnp.where(rem_hit, 0, st["rem_local_seq"])
+    return g
+
+
+def _apply_one_batched(st, op, k_slots, a_slots, roll):
+    """kernel.apply_one with a leading doc axis; op fields are [B, 1]."""
+    kind = op["kind"]
+    is_edit = (kind == OpKind.INSERT) | (kind == OpKind.REMOVE) | \
+        (kind == OpKind.ANNOTATE)
+    is_range = (kind == OpKind.REMOVE) | (kind == OpKind.ANNOTATE)
+    c = st["length"].shape[-1]
+    fits = st["count"] + 2 <= c
+    st = dict(st)
+    st["overflow"] = st["overflow"] | (is_edit & ~fits)
+    is_edit = is_edit & fits
+    is_range = is_range & fits
+
+    r, cl = op["ref_seq"], op["client"]
+    s1 = _ensure_boundary(st, op["pos1"], r, cl, is_edit, k_slots, a_slots,
+                          roll)
+    s2 = _ensure_boundary(s1, op["pos2"], r, cl, is_range, k_slots, a_slots,
+                          roll)
+    view2 = _visibility(s2, r, cl, k_slots, roll)
+    s_ins = _insert_phase(s2, op, is_edit & (kind == OpKind.INSERT), view2,
+                          k_slots, a_slots, roll)
+    s_rem = _remove_phase(s_ins, op, is_range & (kind == OpKind.REMOVE),
+                          view2, k_slots, roll)
+    s_ann = _annotate_phase(s_rem, op, is_range & (kind == OpKind.ANNOTATE),
+                            view2, a_slots)
+    out = _ack_phase(s_ann, op)
+
+    acked = (kind != OpKind.NOOP) & (op["seq"] != DEV_UNASSIGNED)
+    out["seq"] = jnp.where(acked, jnp.maximum(out["seq"], op["seq"]),
+                           out["seq"])
+    out["min_seq"] = jnp.where(acked, jnp.maximum(out["min_seq"], op["msn"]),
+                               out["min_seq"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# plane packing
+# ---------------------------------------------------------------------------
+
+_OP_FIELDS = PackedOps._fields
+
+
+def _to_planes(state: DocState):
+    k = state.rem_clients.shape[-1]
+    a = state.anno.shape[-1]
+    b = state.length.shape[0]
+    st = {name: getattr(state, name) for name in _SEG_PLANES}
+    for i in range(k):
+        st[f"rc{i}"] = state.rem_clients[..., i]
+    for i in range(a):
+        st[f"an{i}"] = state.anno[..., i]
+    st["count"] = state.count.reshape(b, 1)
+    st["min_seq"] = state.min_seq.reshape(b, 1)
+    st["seq"] = state.seq.reshape(b, 1)
+    st["overflow"] = state.overflow.reshape(b, 1)
+    return st, k, a
+
+
+def _from_planes(st, k, a) -> DocState:
+    rem_clients = jnp.stack([st[f"rc{i}"] for i in range(k)], axis=-1)
+    anno = jnp.stack([st[f"an{i}"] for i in range(a)], axis=-1)
+    return DocState(
+        **{name: st[name] for name in _SEG_PLANES
+           if name in DocState._fields},
+        rem_clients=rem_clients, anno=anno,
+        count=st["count"][:, 0], min_seq=st["min_seq"][:, 0],
+        seq=st["seq"][:, 0], overflow=st["overflow"][:, 0],
+    )
+
+
+# ---------------------------------------------------------------------------
+# drivers
+# ---------------------------------------------------------------------------
+
+def _stream_loop(st, t_steps, get_op, k, a, roll):
+    """Apply all T ops to the resident planes. get_op(t) fetches the op
+    scalars as [B, 1] — from a value in the jnp driver, from the VMEM ref
+    in the Pallas kernel (Mosaic supports dynamic slicing only on refs)."""
+
+    def body(t, carry):
+        return _apply_one_batched(carry, get_op(t), k, a, roll)
+
+    return jax.lax.fori_loop(0, t_steps, body, st)
+
+
+@jax.jit
+def apply_ops_fused_ref(state: DocState, ops: PackedOps) -> DocState:
+    """jnp reference of the fused formulation (also the non-TPU fallback).
+    Non-donating, matching the documented apply_ops_fused contract."""
+    st, k, a = _to_planes(state)
+    op_cols = {f: getattr(ops, f) for f in _OP_FIELDS}
+
+    def get_op(t):
+        return {f: jax.lax.dynamic_slice_in_dim(op_cols[f], t, 1, axis=1)
+                for f in _OP_FIELDS}
+
+    out = _stream_loop(st, ops.kind.shape[-1], get_op, k, a,
+                       lambda x, n: jnp.roll(x, n, axis=1))
+    return _from_planes(out, k, a)
+
+
+def _kernel(n_state: int, k: int, a: int, names):
+    """Grid = (doc_tiles, T). The state planes' block index is constant in
+    t, so Mosaic keeps them VMEM-resident across the whole op stream
+    (revisited-block accumulator pattern); each grid step applies ONE op
+    whose scalars arrive as [TILE, 1] blocks — no dynamic slicing."""
+
+    def kern(*refs):
+        from jax.experimental import pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        in_refs = refs[:n_state + len(_OP_FIELDS)]
+        out_refs = refs[n_state + len(_OP_FIELDS):]
+        t = pl.program_id(1)
+
+        @pl.when(t == 0)
+        def _seed():
+            for i in range(n_state):
+                out_refs[i][:] = in_refs[i][:]
+
+        st = {name: out_refs[i][:] for i, name in enumerate(names)}
+        # Op columns ride transposed ([T, TILE], resident across t): row t
+        # is a sublane slice (lane-dim dynamic slices must be 128-aligned
+        # in Mosaic), transposed to the [TILE, 1] per-doc scalar shape.
+        op = {f: jnp.transpose(in_refs[n_state + i][pl.ds(t, 1), :])
+              for i, f in enumerate(_OP_FIELDS)}
+        out = _apply_one_batched(st, op, k, a,
+                                 lambda x, n: pltpu.roll(x, n, 1))
+        for i, name in enumerate(names):
+            out_refs[i][:] = out[name]
+    return kern
+
+
+def apply_ops_fused_pallas(state: DocState, ops: PackedOps,
+                           interpret: bool = False) -> DocState:
+    from jax.experimental import pallas as pl
+
+    st, k, a = _to_planes(state)
+    names = list(st.keys())
+    b, c = state.length.shape
+    t_steps = ops.kind.shape[-1]
+    padded = ((b + DOC_TILE - 1) // DOC_TILE) * DOC_TILE
+    pad = padded - b
+
+    def pad_rows(x):
+        return jnp.pad(x, ((0, pad), (0, 0))) if pad else x
+
+    st_in = [pad_rows(st[name]) for name in names]
+    op_in = [pad_rows(getattr(ops, f)).T for f in _OP_FIELDS]  # [T, B]
+
+    def state_block(cols):
+        return pl.BlockSpec((DOC_TILE, cols), lambda i, t: (i, 0))
+
+    op_block = pl.BlockSpec((t_steps, DOC_TILE), lambda i, t: (0, i))
+
+    grid = (padded // DOC_TILE, t_steps)
+    out_shapes = [jax.ShapeDtypeStruct((padded, x.shape[1]), x.dtype)
+                  for x in st_in]
+    outs = pl.pallas_call(
+        _kernel(len(names), k, a, names),
+        out_shape=out_shapes,
+        grid=grid,
+        in_specs=[state_block(x.shape[1]) for x in st_in]
+        + [op_block for _ in op_in],
+        out_specs=[state_block(x.shape[1]) for x in st_in],
+        interpret=interpret,
+    )(*st_in, *op_in)
+    result = {name: outs[i][:b] for i, name in enumerate(names)}
+    return _from_planes(result, k, a)
+
+
+_FUSED_OK = None
+
+
+def fused_available() -> bool:
+    """Probe once: compile+run the fused kernel on a tiny block."""
+    global _FUSED_OK
+    if _FUSED_OK is None:
+        try:
+            from .state import make_state
+            from .oppack import pack_ops, HostOp
+
+            tiny = make_state(8, 1, batch=1)
+            op = HostOp(kind=OpKind.INSERT, seq=1, ref_seq=0, client=0,
+                        pos1=0, op_id=0, new_len=3)
+            out = apply_ops_fused_pallas(tiny, pack_ops([[op]]))
+            jax.block_until_ready(out.length)
+            _FUSED_OK = int(jax.device_get(out.count)[0]) == 1
+        except Exception:  # noqa: BLE001 — any Mosaic failure => fallback
+            _FUSED_OK = False
+    return _FUSED_OK
+
+
+def apply_ops_fused(state: DocState, ops: PackedOps) -> DocState:
+    """Batched apply via the fused VMEM kernel on TPU; jnp reference
+    elsewhere. Drop-in for kernel.apply_ops_batched (non-donating)."""
+    if jax.default_backend() in ("tpu", "axon") and fused_available():
+        return apply_ops_fused_pallas(state, ops)
+    return apply_ops_fused_ref(state, ops)

@@ -20,13 +20,19 @@ from ..mergetree.pallas_ops import summary_lengths
 from . import ticket_kernel as tk
 
 
-def make_full_step(sp_shards: int = 1):
+def make_full_step(sp_shards: int = 1, fused_apply: bool = False):
     """Build the fused pipeline step for a given sequence-parallel factor:
     with sp_shards > 1 the merge kernel's visibility prefix sums use the
     two-level collective-scan formulation (kernel._cumsum_sp), so a
     capacity axis sharded over 'sp' resolves positions with shard-local
     cumsums + a tiny cross-shard offset exchange instead of a serialized
-    full-axis scan (SURVEY.md §5 long-context mapping)."""
+    full-axis scan (SURVEY.md §5 long-context mapping).
+
+    fused_apply=True routes the merge apply through the VMEM-resident
+    Pallas kernel (mergetree/pallas_apply.py — one HBM read+write for the
+    whole op stream); single-chip only (no sp sharding)."""
+    if fused_apply and sp_shards > 1:
+        raise ValueError("fused_apply is a single-shard kernel")
 
     def full_step(tstate, mstate, raw, ops):
         """(ticket_state, merge_state, RawOps, PackedOps) ->
@@ -38,8 +44,12 @@ def make_full_step(sp_shards: int = 1):
             seq=jnp.where(admitted, ticketed.seq, ops.seq),
             msn=jnp.where(admitted, ticketed.min_seq, ops.msn),
         )
-        mstate = kernel._scan_ops(mstate, ops2, batched=True,
-                                  sp_shards=sp_shards)
+        if fused_apply:
+            from ..mergetree.pallas_apply import apply_ops_fused_pallas
+            mstate = apply_ops_fused_pallas(mstate, ops2)
+        else:
+            mstate = kernel._scan_ops(mstate, ops2, batched=True,
+                                      sp_shards=sp_shards)
         # Summary-length reduction: fused Pallas pass on TPU, jnp elsewhere
         # (mergetree/pallas_ops.py; semantics == visibility(s, s.seq, ...)).
         total_len = summary_lengths(mstate)

@@ -110,7 +110,7 @@ class TestSharedDirectoryFarm:
                     node.delete_sub_directory(rng.choice(subs))
             elif r < 0.8:
                 node.set(rng.choice(names), {"s": step})
-            elif node is not d.root or True:
+            else:
                 k = rng.choice(names)
                 if node.has(k):
                     node.delete(k)
@@ -118,6 +118,49 @@ class TestSharedDirectoryFarm:
         settle(env, rng, replicas)
         dumps = [self._dump(d.root) for _, d in replicas]
         assert dumps[0] == dumps[1] == dumps[2]
+
+
+class TestClearAfterSubdirRecreate:
+    def test_pending_clear_on_recreated_subdir_converges(self):
+        """A pending clear whose subdirectory was deleted+recreated while
+        in flight must still apply on the submitter (review finding: the
+        local clear branch returned without applying, leaving the submitter
+        holding keys every other replica wiped)."""
+
+        class PickFirst:
+            """rng stub: always sequence the given runtime's ops first."""
+
+            def __init__(self, preferred):
+                self.preferred = preferred
+
+            def choice(self, live):
+                for s in live:
+                    if s.runtime is self.preferred:
+                        return s
+                return live[0]
+
+        env = MockSequencedEnvironment()
+        (ra, da), (rb, db) = [
+            (r, r.create_datastore("ds").create_channel(
+                "obj", SharedDirectory.TYPE))
+            for r in (env.create_runtime(), env.create_runtime())]
+        env.process_all()
+        da.create_sub_directory("x").set("old", 1)
+        env.process_all()
+
+        # A's clear is submitted, then B's delete/recreate/set sequence
+        # BEFORE it (forced ordering), then A's clear lands last.
+        da.get_sub_directory("x").clear()
+        db.root.delete_sub_directory("x")
+        db.create_sub_directory("x").set("fresh", 42)
+        env.process_some(PickFirst(rb))  # B's ops first
+        env.process_all()
+
+        va = {k: da.get_sub_directory("x").get(k)
+              for k in da.get_sub_directory("x").keys()}
+        vb = {k: db.get_sub_directory("x").get(k)
+              for k in db.get_sub_directory("x").keys()}
+        assert va == vb
 
 
 class TestSharedMatrixFarm:

@@ -1,0 +1,82 @@
+"""Fused Pallas apply (mergetree/pallas_apply.py) conformance: the
+VMEM-resident whole-stream kernel must be bit-identical to the scan×vmap
+kernel (which itself is conformance-locked to the scalar oracle in
+tests/test_kernel.py). Runs the jnp reference everywhere and the Pallas
+interpreter path for the kernel body itself."""
+
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bench import gen_traces
+from fluidframework_tpu.mergetree import kernel, pallas_apply
+from fluidframework_tpu.mergetree.host import OpBuilder
+from fluidframework_tpu.mergetree.oppack import PackedOps, pack_ops
+from fluidframework_tpu.mergetree.state import make_state
+
+from test_kernel import build_kernel_ops, random_schedule
+
+_CHECK = ("length", "ins_seq", "ins_client", "local_seq", "rem_seq",
+          "rem_local_seq", "rem_clients", "origin_op", "origin_off",
+          "anno", "count", "min_seq", "seq", "overflow")
+
+
+def assert_states_equal(a, b):
+    for name in _CHECK:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(a, name)), np.asarray(getattr(b, name)),
+            err_msg=name)
+
+
+def _batched_from_traces(b, t, cap, seed):
+    cols = gen_traces(b, t, seed=seed)
+    ops = PackedOps(**{f: jnp.asarray(cols[f]) for f in PackedOps._fields})
+    return make_state(cap, 2, batch=b), ops
+
+
+class TestFusedRefConformance:
+    @pytest.mark.parametrize("seed,b,t,cap", [(0, 16, 32, 64),
+                                              (1, 8, 64, 128),
+                                              (2, 32, 16, 64)])
+    def test_trace_batches_match_scan_kernel(self, seed, b, t, cap):
+        st, ops = _batched_from_traces(b, t, cap, seed)
+        ref = kernel.apply_ops_batched_keep(st, ops)
+        fused = pallas_apply.apply_ops_fused_ref(
+            *_batched_from_traces(b, t, cap, seed))
+        assert_states_equal(ref, fused)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_rich_schedules_match(self, seed):
+        """Annotates (ring + LWW), overlapping removes, concurrent inserts:
+        the random sequenced schedule generator from test_kernel."""
+        rng = random.Random(seed + 500)
+        tuples = random_schedule(rng, n_clients=4, n_ops=40)
+        builder = OpBuilder()
+        host_ops = build_kernel_ops(builder, tuples)
+        packed = pack_ops([host_ops, host_ops[: len(host_ops) // 2]])
+        st = make_state(256, 8, batch=2)
+        ref = kernel.apply_ops_batched_keep(st, packed)
+        fused = pallas_apply.apply_ops_fused_ref(
+            make_state(256, 8, batch=2), packed)
+        assert_states_equal(ref, fused)
+
+    def test_overflow_flag_matches(self):
+        st, ops = _batched_from_traces(4, 40, 16, 3)  # tiny capacity
+        ref = kernel.apply_ops_batched_keep(st, ops)
+        fused = pallas_apply.apply_ops_fused_ref(
+            *_batched_from_traces(4, 40, 16, 3))
+        np.testing.assert_array_equal(np.asarray(ref.overflow),
+                                      np.asarray(fused.overflow))
+        assert bool(np.asarray(ref.overflow).any())
+
+
+class TestFusedPallasInterpret:
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_interpret_matches_scan_kernel(self, seed):
+        st, ops = _batched_from_traces(8, 20, 64, seed)
+        ref = kernel.apply_ops_batched_keep(st, ops)
+        fused = pallas_apply.apply_ops_fused_pallas(
+            *_batched_from_traces(8, 20, 64, seed), interpret=True)
+        assert_states_equal(ref, fused)
