@@ -213,6 +213,47 @@ def main() -> None:
         kernel.extract_visible_batched(mt_state))
     summarize_extract_ms = (time.perf_counter() - t0) * 1000.0
     live_segments = int(packed_np[-1].sum())
+
+    # Ragged mixed-size workload (SURVEY.md §7 hard part #3): documents of
+    # wildly different sizes route to capacity buckets — one compiled
+    # program per (docs, ops, capacity) bucket, all three dispatched
+    # back-to-back and timed together (device queues overlap them).
+    if os.environ.get("BENCH_RAGGED", "1") == "0":
+        ragged_buckets = []
+    else:
+        ragged_buckets = [  # (docs, ops/doc, capacity) — 10k docs total
+            (6000, 16, 64), (3000, 64, 256), (1000, 256, 1024)]
+    ragged = []
+    for i, (rb, rt, rc) in enumerate(ragged_buckets):
+        rcols = gen_traces(rb, rt, seed=100 + i)
+        rops = PackedOps(**{f: jnp.asarray(rcols[f])
+                            for f in PackedOps._fields})
+        rraw = tk.RawOps(client=rops.client, client_seq=rops.seq,
+                         ref_seq=rops.ref_seq)
+        ragged.append((tk.make_ticket_state(8, batch=rb),
+                       make_state(rc, 1, batch=rb), rraw, rops))
+    warm = [step(*args) for args in ragged]  # compile all three shapes
+    for w in warm:
+        np.asarray(w[3])
+    ragged2 = []
+    for i, (rb, rt, rc) in enumerate(ragged_buckets):
+        rcols = gen_traces(rb, rt, seed=100 + i)
+        rops = PackedOps(**{f: jnp.asarray(rcols[f])
+                            for f in PackedOps._fields})
+        rraw = tk.RawOps(client=rops.client, client_seq=rops.seq,
+                         ref_seq=rops.ref_seq)
+        ragged2.append((tk.make_ticket_state(8, batch=rb),
+                        make_state(rc, 1, batch=rb), rraw, rops))
+    jax.block_until_ready([r[0] for r in ragged2])
+    t0 = time.perf_counter()
+    routs = [step(*args) for args in ragged2]
+    for r in routs:
+        np.asarray(r[3])
+    ragged_s = time.perf_counter() - t0 if ragged2 else 0.0
+    ragged_ops = sum(rb * rt for rb, rt, _ in ragged_buckets)
+    ragged_overflow = any(bool(np.asarray(r[1].overflow).any())
+                          for r in routs)
+    ragged_rate = round(ragged_ops / ragged_s, 1) if ragged_s else 0.0
     result = {
         "metric": "merge-tree ops applied/sec across "
                   f"{n_docs} docs (ticket+apply+summary-len)",
@@ -228,6 +269,10 @@ def main() -> None:
             "summary_catchup_p50_ms": round(catchup_p50_ms, 2),
             "summarize_extract_ms": round(summarize_extract_ms, 2),
             "summarize_live_segments": live_segments,
+            "ragged_ops_per_sec": ragged_rate,
+            "ragged_docs": sum(rb for rb, _, _ in ragged_buckets),
+            "ragged_total_ops": ragged_ops,
+            "ragged_overflow": ragged_overflow,
             "overflow": overflow,
         },
     }

@@ -32,6 +32,10 @@ from .oppack import OpKind, PackedOps
 from .state import DocState
 
 DOC_TILE = 128  # docs per VMEM block (int32 sublane multiple)
+# Above this capacity the resident block (+ loop temporaries) exceeds the
+# ~16MB VMEM budget and Mosaic refuses to compile; callers route larger
+# states to the scan×vmap kernel (pipeline.make_full_step does).
+FUSED_MAX_CAPACITY = 512
 
 
 # ---------------------------------------------------------------------------
@@ -341,6 +345,9 @@ def _kernel(n_state: int, k: int, a: int, names):
         out_refs = refs[n_state + len(_OP_FIELDS):]
         t = pl.program_id(1)
 
+        # The output VMEM window is NOT loaded from HBM on first visit —
+        # seed it from the (buffer-aliased) input block explicitly. The
+        # aliasing saves the HBM copy of the state, not this VMEM seed.
         @pl.when(t == 0)
         def _seed():
             for i in range(n_state):
@@ -367,7 +374,8 @@ def apply_ops_fused_pallas(state: DocState, ops: PackedOps,
     names = list(st.keys())
     b, c = state.length.shape
     t_steps = ops.kind.shape[-1]
-    padded = ((b + DOC_TILE - 1) // DOC_TILE) * DOC_TILE
+    tile = DOC_TILE
+    padded = ((b + tile - 1) // tile) * tile
     pad = padded - b
 
     def pad_rows(x):
@@ -377,11 +385,11 @@ def apply_ops_fused_pallas(state: DocState, ops: PackedOps,
     op_in = [pad_rows(getattr(ops, f)).T for f in _OP_FIELDS]  # [T, B]
 
     def state_block(cols):
-        return pl.BlockSpec((DOC_TILE, cols), lambda i, t: (i, 0))
+        return pl.BlockSpec((tile, cols), lambda i, t: (i, 0))
 
-    op_block = pl.BlockSpec((t_steps, DOC_TILE), lambda i, t: (0, i))
+    op_block = pl.BlockSpec((t_steps, tile), lambda i, t: (0, i))
 
-    grid = (padded // DOC_TILE, t_steps)
+    grid = (padded // tile, t_steps)
     out_shapes = [jax.ShapeDtypeStruct((padded, x.shape[1]), x.dtype)
                   for x in st_in]
     outs = pl.pallas_call(
@@ -391,6 +399,7 @@ def apply_ops_fused_pallas(state: DocState, ops: PackedOps,
         in_specs=[state_block(x.shape[1]) for x in st_in]
         + [op_block for _ in op_in],
         out_specs=[state_block(x.shape[1]) for x in st_in],
+        input_output_aliases={i: i for i in range(len(st_in))},
         interpret=interpret,
     )(*st_in, *op_in)
     result = {name: outs[i][:b] for i, name in enumerate(names)}

@@ -44,10 +44,13 @@ def make_full_step(sp_shards: int = 1, fused_apply: bool = False):
             seq=jnp.where(admitted, ticketed.seq, ops.seq),
             msn=jnp.where(admitted, ticketed.min_seq, ops.msn),
         )
-        if fused_apply:
+        from ..mergetree.pallas_apply import FUSED_MAX_CAPACITY
+        if fused_apply and mstate.capacity <= FUSED_MAX_CAPACITY:
             from ..mergetree.pallas_apply import apply_ops_fused_pallas
             mstate = apply_ops_fused_pallas(mstate, ops2)
         else:
+            # Very large capacities exceed the fused kernel's VMEM budget;
+            # the scan×vmap kernel covers them.
             mstate = kernel._scan_ops(mstate, ops2, batched=True,
                                       sp_shards=sp_shards)
         # Summary-length reduction: fused Pallas pass on TPU, jnp elsewhere
