@@ -1,0 +1,240 @@
+"""Service entrypoints: the deployment wiring (docker-compose analog).
+
+The reference runs every lambda as its own service against the Kafka
+broker (server/docker-compose.yml:2-55; `kafka-service/index.js <name>
+<lambda>` per service). The equivalent here:
+
+    python -m fluidframework_tpu.server.main broker  --config deploy/config.json
+    python -m fluidframework_tpu.server.main worker  --config deploy/config.json
+    python -m fluidframework_tpu.server.main worker  --stages scriptorium,scribe ...
+
+- `broker` hosts the ordered log (pure-Python or the native C++ engine)
+  over gRPC (server/log_service.py) — the Kafka role.
+- `worker` runs any subset of lambda stages over RemoteMessageLog against
+  the broker, with durable sqlite checkpoints/deltas and a file-backed git
+  store (server/durable.py) — the per-lambda service role. `--stages
+  tpu-deli` swaps the scalar sequencer for the device-batched
+  TpuSequencerLambda (server/tpu_sequencer.py).
+
+Deli nacks publish to the `nacks` topic (the front door consumes it and
+routes to the offending client's socket); sequenced deltas flow through
+the `deltas` topic exactly as in-process. Crash/restart semantics are the
+lambda host's: offsets commit with checkpoints, replay is idempotent.
+
+See deploy/RUNBOOK.md for topology, scaling, and failure handling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+from typing import List, Optional
+
+RAW_TOPIC = "rawdeltas"
+DELTAS_TOPIC = "deltas"
+NACKS_TOPIC = "nacks"
+
+DEFAULT_CONFIG = {
+    "broker": {"host": "127.0.0.1", "port": 7080, "native": False,
+               "partitions": 1},
+    "storage": {"db": "var/fluid.sqlite", "git": "var/git"},
+    "worker": {"stages": ["deli", "scriptorium", "scribe", "copier"],
+               "poll_ms": 10, "tenant": "local"},
+    "deli": {"checkpointBatchSize": 8, "checkpointTimeIntervalMsec": 500},
+}
+
+
+def load_config(path: Optional[str]) -> dict:
+    cfg = json.loads(json.dumps(DEFAULT_CONFIG))  # deep copy
+    if path:
+        with open(path) as f:
+            loaded = json.load(f)
+        for key, value in loaded.items():
+            if isinstance(value, dict):
+                cfg.setdefault(key, {}).update(value)
+            else:
+                cfg[key] = value
+    return cfg
+
+
+class _ConfigView:
+    """Dotted-key accessor over the config dict (the nconf role —
+    services-core/src/lambdas.ts:56 passes each lambda its config slice)."""
+
+    def __init__(self, cfg: dict):
+        self.cfg = cfg
+
+    def get(self, dotted: str, default=None):
+        node = self.cfg
+        for part in dotted.split("."):
+            if not isinstance(node, dict) or part not in node:
+                return default
+            node = node[part]
+        return node
+
+
+def run_broker(cfg: dict) -> None:
+    from .log import make_message_log
+    from .log_service import LogServiceServer
+
+    bcfg = cfg["broker"]
+    log = make_message_log(default_partitions=bcfg.get("partitions", 1),
+                           native=bcfg.get("native", False))
+    log.topic(RAW_TOPIC)
+    log.topic(DELTAS_TOPIC)
+    log.topic(NACKS_TOPIC)
+    server = LogServiceServer(log, port=bcfg.get("port", 7080))
+    server.start()
+    print(f"broker: serving ordered log on {server.address}", flush=True)
+    _wait_for_signal()
+    server.stop()
+
+
+def build_worker(cfg: dict, stages: List[str]):
+    """Wire the requested lambda stages over the remote log + durable
+    services. Returns (runner, close_fn)."""
+    from .durable import FileHistorian, SqliteDatabaseManager
+    from .lambdas import (
+        BroadcasterLambda,
+        CopierLambda,
+        DeliLambda,
+        ScribeLambda,
+        ScriptoriumLambda,
+    )
+    from .lambdas.scriptorium import delta_key
+    from .log_service import RemoteMessageLog
+    from .partition import LambdaRunner, PartitionManager
+    from ..protocol.messages import Boxcar
+
+    bcfg = cfg["broker"]
+    address = f"{bcfg.get('host', '127.0.0.1')}:{bcfg.get('port', 7080)}"
+    log = RemoteMessageLog(address,
+                           default_partitions=bcfg.get("partitions", 1))
+    db = SqliteDatabaseManager(cfg["storage"]["db"])
+    historian = FileHistorian(cfg["storage"]["git"])
+    tenant = cfg["worker"].get("tenant", "local")
+    deltas = db.collection("deltas", unique_key=delta_key)
+    raw_deltas = db.collection("rawdeltas")
+    deli_ckpt = db.collection("deliCheckpoints")
+    scribe_ckpt = db.collection("scribeCheckpoints")
+    view = _ConfigView(cfg)
+
+    def emit_sequenced(doc_id, sequenced):
+        log.send(DELTAS_TOPIC, doc_id, (doc_id, sequenced))
+
+    def emit_nack(doc_id, client_id, nack):
+        log.send(NACKS_TOPIC, doc_id, (doc_id, client_id, nack))
+
+    def send_system(doc_id, message):
+        log.send(RAW_TOPIC, doc_id, Boxcar(
+            tenant_id=tenant, document_id=doc_id, client_id=None,
+            contents=[message]))
+
+    runner = LambdaRunner()
+    for stage in stages:
+        if stage == "deli":
+            runner.add(PartitionManager(
+                log, "deli", RAW_TOPIC,
+                lambda ctx: DeliLambda(ctx, emit=emit_sequenced,
+                                       nack=emit_nack,
+                                       checkpoints=deli_ckpt,
+                                       fresh_log=False, config=view),
+                auto_commit=False))
+        elif stage == "tpu-deli":
+            from .tpu_sequencer import TpuSequencerLambda
+            runner.add(PartitionManager(
+                log, "deli", RAW_TOPIC,
+                lambda ctx: TpuSequencerLambda(
+                    ctx, emit=emit_sequenced, nack=emit_nack,
+                    checkpoints=deli_ckpt, deltas=deltas),
+                auto_commit=False))
+        elif stage == "scriptorium":
+            runner.add(PartitionManager(
+                log, "scriptorium", DELTAS_TOPIC,
+                lambda ctx: ScriptoriumLambda(ctx, deltas)))
+        elif stage == "scribe":
+            runner.add(PartitionManager(
+                log, "scribe", DELTAS_TOPIC,
+                lambda ctx: ScribeLambda(ctx, historian, tenant,
+                                         send_system=send_system,
+                                         checkpoints=scribe_ckpt,
+                                         fresh_log=False)))
+        elif stage == "copier":
+            runner.add(PartitionManager(
+                log, "copier", RAW_TOPIC,
+                lambda ctx: CopierLambda(ctx, raw_deltas)))
+        elif stage == "broadcaster":
+            # Standalone broadcaster keeps room state empty — real
+            # deployments host it inside the front door (alfred) where the
+            # websockets live; this stage exists for topology parity.
+            runner.add(PartitionManager(
+                log, "broadcaster", DELTAS_TOPIC,
+                lambda ctx: BroadcasterLambda(ctx, rooms={})))
+        else:
+            raise SystemExit(f"unknown stage {stage!r}")
+
+    def close():
+        for manager in runner.managers:
+            for pump in manager.pumps.values():
+                pump.lambda_.close()
+        db.close()
+
+    return runner, close
+
+
+def run_worker(cfg: dict, stages: List[str]) -> None:
+    runner, close = build_worker(cfg, stages)
+    poll_s = cfg["worker"].get("poll_ms", 10) / 1000.0
+    print(f"worker: stages={stages} broker="
+          f"{cfg['broker'].get('host')}:{cfg['broker'].get('port')}",
+          flush=True)
+    stop = {"flag": False}
+
+    def on_signal(*_):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    while not stop["flag"]:
+        if runner.pump() == 0:
+            time.sleep(poll_s)
+    close()
+    print("worker: stopped", flush=True)
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        prog="fluidframework_tpu.server.main",
+        description="Run one service of the ordering pipeline")
+    parser.add_argument("service", choices=["broker", "worker"])
+    parser.add_argument("--config", default=None,
+                        help="path to deploy config JSON")
+    parser.add_argument("--stages", default=None,
+                        help="comma-separated lambda stages for `worker`")
+    args = parser.parse_args(argv)
+    cfg = load_config(args.config)
+    if args.service == "broker":
+        run_broker(cfg)
+    else:
+        stages = (args.stages.split(",") if args.stages
+                  else cfg["worker"]["stages"])
+        run_worker(cfg, stages)
+
+
+def _wait_for_signal() -> None:
+    done = {"flag": False}
+
+    def on_signal(*_):
+        done["flag"] = True
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    while not done["flag"]:
+        time.sleep(0.2)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,118 @@
+"""Multi-process deployment smoke test: broker + workers as real OS
+processes over gRPC + shared durable storage — the docker-compose topology
+(reference server/docker-compose.yml) driven end to end."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytest.importorskip("grpc")
+
+from fluidframework_tpu.protocol.messages import Boxcar, DocumentMessage, MessageType
+from fluidframework_tpu.server.durable import SqliteDatabaseManager
+from fluidframework_tpu.server.lambdas.scriptorium import delta_key, query_deltas
+from fluidframework_tpu.server.log_service import RemoteMessageLog
+from fluidframework_tpu.server.main import RAW_TOPIC
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn(args, cwd):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="/root/repo")
+    return subprocess.Popen(
+        [sys.executable, "-m", "fluidframework_tpu.server.main", *args],
+        cwd=cwd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+class TestMultiProcessPipeline:
+    def test_broker_and_worker_processes_sequence_and_persist(self, tmp_path):
+        port = _free_port()
+        cfg = {
+            "broker": {"host": "127.0.0.1", "port": port, "partitions": 1},
+            "storage": {"db": str(tmp_path / "fluid.sqlite"),
+                        "git": str(tmp_path / "git")},
+            "worker": {"stages": ["deli", "scriptorium", "copier"],
+                       "poll_ms": 5, "tenant": "local"},
+        }
+        cfg_path = tmp_path / "config.json"
+        cfg_path.write_text(json.dumps(cfg))
+
+        broker = _spawn(["broker", "--config", str(cfg_path)], tmp_path)
+        procs = [broker]
+        try:
+            # Wait for the broker socket.
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                try:
+                    socket.create_connection(("127.0.0.1", port),
+                                             timeout=0.3).close()
+                    break
+                except OSError:
+                    if broker.poll() is not None:
+                        raise AssertionError(
+                            broker.stdout.read().decode()[-2000:])
+                    time.sleep(0.1)
+            else:
+                raise AssertionError("broker never listened")
+
+            worker = _spawn(["worker", "--config", str(cfg_path)], tmp_path)
+            procs.append(worker)
+
+            # Front-door role: join + ops straight into the raw topic.
+            log = RemoteMessageLog(f"127.0.0.1:{port}")
+            log.send(RAW_TOPIC, "doc", Boxcar(
+                tenant_id="local", document_id="doc", client_id=None,
+                contents=[DocumentMessage(
+                    client_sequence_number=0, reference_sequence_number=-1,
+                    type=MessageType.CLIENT_JOIN,
+                    data=json.dumps({"clientId": "c1", "detail": {}}))]))
+            for i in range(1, 6):
+                log.send(RAW_TOPIC, "doc", Boxcar(
+                    tenant_id="local", document_id="doc", client_id="c1",
+                    contents=[DocumentMessage(
+                        client_sequence_number=i,
+                        reference_sequence_number=0,
+                        type=MessageType.OPERATION,
+                        contents={"n": i})]))
+
+            # Sequenced deltas must land in the shared sqlite store.
+            db = SqliteDatabaseManager(str(tmp_path / "fluid.sqlite"))
+            deltas = db.collection("deltas", unique_key=delta_key)
+            deadline = time.time() + 60
+            rows = []
+            while time.time() < deadline:
+                rows = query_deltas(deltas, "doc")
+                if len(rows) >= 6:  # join + 5 ops
+                    break
+                if worker.poll() is not None:
+                    raise AssertionError(
+                        worker.stdout.read().decode()[-2000:])
+                time.sleep(0.2)
+            assert len(rows) >= 6, f"only {len(rows)} deltas persisted"
+            seqs = [r["sequence_number"] for r in rows]
+            assert seqs == sorted(seqs) and seqs[0] == 1
+            op_rows = [r for r in rows
+                       if r["type"] == MessageType.OPERATION]
+            assert [r["contents"]["n"] for r in op_rows] == [1, 2, 3, 4, 5]
+            # Copier persisted the raw (pre-sequencing) stream too.
+            raw = db.collection("rawdeltas")
+            assert len(raw) >= 6
+        finally:
+            for p in procs:
+                p.terminate()
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
