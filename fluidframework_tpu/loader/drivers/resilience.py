@@ -1,0 +1,188 @@
+"""Production resilience wrappers: retry/backoff, throttle honoring,
+single-flight fetch dedup, snapshot prefetch.
+
+Capability parity with the reference odsp-driver's network hardening
+(packages/drivers/odsp-driver: retryAndConvertToNetworkError, throttling
+(429 retryAfter) handling, prefetchSnapshot, concurrent fetch dedup) —
+decorating any `IDocumentServiceFactory`, usually stacked OUTSIDE the
+caching driver:
+
+    factory = RetryingDocumentServiceFactory(
+        CachingDocumentServiceFactory(inner, cache), policy)
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from .base import (IDocumentDeltaStorageService, IDocumentService,
+                   IDocumentServiceFactory, IDocumentStorageService)
+
+
+class ThrottlingError(Exception):
+    """Service asked the client to back off (reference 429 retryAfter)."""
+
+    def __init__(self, retry_after_s: float, message: str = "throttled"):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class NonRetryableError(Exception):
+    """Fatal service response: retrying cannot help (4xx-class)."""
+
+
+class RetryPolicy:
+    """Exponential backoff with full jitter, capped attempts/delay; a
+    ThrottlingError's retry_after overrides the computed delay."""
+
+    def __init__(self, max_attempts: int = 5, base_delay_s: float = 0.05,
+                 max_delay_s: float = 8.0,
+                 sleep: Callable[[float], None] = time.sleep,
+                 rng: Optional[random.Random] = None):
+        self.max_attempts = max_attempts
+        self.base_delay_s = base_delay_s
+        self.max_delay_s = max_delay_s
+        self.sleep = sleep
+        self.rng = rng or random.Random()
+
+    def run(self, fn: Callable[[], object], on_retry=None):
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except NonRetryableError:
+                raise
+            except ThrottlingError as err:
+                attempt += 1
+                if attempt >= self.max_attempts:
+                    raise
+                delay = min(err.retry_after_s, self.max_delay_s)
+            except Exception:  # noqa: BLE001 — transient service failure
+                attempt += 1
+                if attempt >= self.max_attempts:
+                    raise
+                cap = min(self.max_delay_s,
+                          self.base_delay_s * (2 ** (attempt - 1)))
+                delay = self.rng.uniform(0, cap)  # full jitter
+            if on_retry is not None:
+                on_retry(attempt, delay)
+            self.sleep(delay)
+
+
+class SingleFlight:
+    """Concurrent identical fetches collapse into one in-flight call
+    (reference odsp snapshot fetch dedup)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inflight: Dict[str, threading.Event] = {}
+        self._results: Dict[str, object] = {}
+
+    def do(self, key: str, fn: Callable[[], object]):
+        with self._lock:
+            event = self._inflight.get(key)
+            if event is None:
+                event = threading.Event()
+                self._inflight[key] = event
+                leader = True
+            else:
+                leader = False
+        if not leader:
+            event.wait()
+            outcome = self._results[key]
+            if isinstance(outcome, BaseException):
+                raise outcome
+            return outcome
+        try:
+            result = fn()
+            outcome: object = result
+        except BaseException as err:  # propagate to followers too
+            outcome = err
+            raise
+        finally:
+            with self._lock:
+                self._results[key] = outcome
+                del self._inflight[key]
+            event.set()
+        return result
+
+
+class RetryingStorageService(IDocumentStorageService):
+    def __init__(self, inner: IDocumentStorageService, policy: RetryPolicy,
+                 flight: SingleFlight, key: str):
+        self.inner = inner
+        self.policy = policy
+        self.flight = flight
+        self.key = key
+
+    def get_summary(self, version: Optional[str] = None):
+        flight_key = f"{self.key}:summary:{version}"
+        return self.flight.do(flight_key, lambda: self.policy.run(
+            lambda: self.inner.get_summary(version)))
+
+    def upload_summary(self, summary, parent=None, initial: bool = False):
+        # Uploads are NOT single-flighted (each is a distinct mutation).
+        return self.policy.run(lambda: self.inner.upload_summary(
+            summary, parent=parent, initial=initial))
+
+    def get_versions(self, count: int = 1) -> List[str]:
+        return self.policy.run(lambda: self.inner.get_versions(count))
+
+
+class RetryingDeltaStorage(IDocumentDeltaStorageService):
+    def __init__(self, inner: IDocumentDeltaStorageService,
+                 policy: RetryPolicy):
+        self.inner = inner
+        self.policy = policy
+
+    def get(self, from_seq: int, to_seq: Optional[int] = None):
+        return self.policy.run(lambda: self.inner.get(from_seq, to_seq))
+
+
+class RetryingDocumentService(IDocumentService):
+    def __init__(self, inner: IDocumentService, policy: RetryPolicy,
+                 flight: SingleFlight, key: str):
+        self.inner = inner
+        self.policy = policy
+        self.flight = flight
+        self.key = key
+
+    def connect_to_storage(self):
+        return RetryingStorageService(self.inner.connect_to_storage(),
+                                      self.policy, self.flight, self.key)
+
+    def connect_to_delta_storage(self):
+        return RetryingDeltaStorage(self.inner.connect_to_delta_storage(),
+                                    self.policy)
+
+    def connect_to_delta_stream(self, client_details: Optional[dict] = None):
+        # Connection attempts retry too (reference reconnect backoff).
+        return self.policy.run(
+            lambda: self.inner.connect_to_delta_stream(client_details))
+
+
+class RetryingDocumentServiceFactory(IDocumentServiceFactory):
+    def __init__(self, inner: IDocumentServiceFactory,
+                 policy: Optional[RetryPolicy] = None):
+        self.inner = inner
+        self.policy = policy or RetryPolicy()
+        self.flight = SingleFlight()
+
+    def create_document_service(self, document_id: str) -> IDocumentService:
+        return RetryingDocumentService(
+            self.inner.create_document_service(document_id), self.policy,
+            self.flight, document_id)
+
+    def prefetch_snapshot(self, document_id: str) -> bool:
+        """Warm the (stacked) cache before a load (reference
+        prefetchSnapshot): fetch the head summary through the full wrapper
+        stack; returns False when the fetch permanently failed."""
+        try:
+            service = self.create_document_service(document_id)
+            service.connect_to_storage().get_summary()
+            return True
+        except Exception:  # noqa: BLE001 — prefetch is best-effort
+            return False

@@ -36,13 +36,15 @@ def _spawn(args, cwd):
 
 
 class TestMultiProcessPipeline:
-    def test_broker_and_worker_processes_sequence_and_persist(self, tmp_path):
+    @pytest.mark.parametrize("sequencer", ["deli", "tpu-deli"])
+    def test_broker_and_worker_processes_sequence_and_persist(
+            self, tmp_path, sequencer):
         port = _free_port()
         cfg = {
             "broker": {"host": "127.0.0.1", "port": port, "partitions": 1},
             "storage": {"db": str(tmp_path / "fluid.sqlite"),
                         "git": str(tmp_path / "git")},
-            "worker": {"stages": ["deli", "scriptorium", "copier"],
+            "worker": {"stages": [sequencer, "scriptorium", "copier"],
                        "poll_ms": 5, "tenant": "local"},
         }
         cfg_path = tmp_path / "config.json"

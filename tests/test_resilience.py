@@ -1,0 +1,199 @@
+"""Resilience driver wrappers (loader/drivers/resilience.py): retry with
+backoff + throttle honoring, single-flight dedup, prefetch — the odsp
+driver's network hardening, service-agnostic."""
+
+import threading
+
+import pytest
+
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.caching import (
+    CachingDocumentServiceFactory,
+    PersistentCache,
+)
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.loader.drivers.resilience import (
+    NonRetryableError,
+    RetryingDocumentServiceFactory,
+    RetryPolicy,
+    SingleFlight,
+    ThrottlingError,
+)
+from fluidframework_tpu.server.local_server import LocalServer
+
+
+def instant_policy(**kw):
+    delays = []
+    kw.setdefault("sleep", delays.append)
+    return RetryPolicy(**kw), delays
+
+
+class TestRetryPolicy:
+    def test_retries_transient_then_succeeds(self):
+        policy, delays = instant_policy(max_attempts=5)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise ConnectionError("blip")
+            return "ok"
+
+        assert policy.run(flaky) == "ok"
+        assert calls["n"] == 3 and len(delays) == 2
+
+    def test_exhausts_attempts(self):
+        policy, _ = instant_policy(max_attempts=3)
+        with pytest.raises(ConnectionError):
+            policy.run(lambda: (_ for _ in ()).throw(ConnectionError()))
+
+    def test_throttle_retry_after_honored(self):
+        policy, delays = instant_policy(max_attempts=3)
+        calls = {"n": 0}
+
+        def throttled():
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise ThrottlingError(retry_after_s=1.25)
+            return "ok"
+
+        assert policy.run(throttled) == "ok"
+        assert delays == [1.25]
+
+    def test_non_retryable_is_immediate(self):
+        policy, delays = instant_policy(max_attempts=5)
+        with pytest.raises(NonRetryableError):
+            policy.run(lambda: (_ for _ in ()).throw(NonRetryableError()))
+        assert delays == []
+
+    def test_backoff_grows_and_caps(self):
+        import random
+        policy, delays = instant_policy(
+            max_attempts=6, base_delay_s=1.0, max_delay_s=4.0,
+            rng=random.Random(0))
+        with pytest.raises(ConnectionError):
+            policy.run(lambda: (_ for _ in ()).throw(ConnectionError()))
+        # Full jitter: each delay <= min(max, base * 2^(attempt-1)).
+        caps = [1.0, 2.0, 4.0, 4.0, 4.0]
+        assert all(d <= c for d, c in zip(delays, caps))
+
+
+class TestSingleFlight:
+    def test_concurrent_calls_collapse(self):
+        flight = SingleFlight()
+        calls = {"n": 0}
+        gate = threading.Event()
+        results = []
+
+        def slow():
+            calls["n"] += 1
+            gate.wait(5)
+            return "value"
+
+        def worker():
+            results.append(flight.do("k", slow))
+
+        threads = [threading.Thread(target=worker) for _ in range(5)]
+        for t in threads:
+            t.start()
+        while calls["n"] == 0:
+            pass
+        gate.set()
+        for t in threads:
+            t.join(5)
+        assert results == ["value"] * 5
+        assert calls["n"] == 1
+
+    def test_failure_propagates_to_followers(self):
+        flight = SingleFlight()
+
+        def boom():
+            raise ConnectionError("down")
+
+        with pytest.raises(ConnectionError):
+            flight.do("k", boom)
+        # A later call re-runs (not cached failure).
+        assert flight.do("k", lambda: 7) == 7
+
+
+class _FlakyFactory:
+    """Wraps the local factory; storage get_summary fails N times."""
+
+    def __init__(self, inner, failures):
+        self.inner = inner
+        self.failures = failures
+
+    def create_document_service(self, document_id):
+        outer = self
+
+        class Svc:
+            def __init__(self, inner_svc):
+                self.inner_svc = inner_svc
+
+            def connect_to_storage(self):
+                real = self.inner_svc.connect_to_storage()
+
+                class Storage:
+                    def get_summary(self, version=None):
+                        if outer.failures > 0:
+                            outer.failures -= 1
+                            raise ConnectionError("transient")
+                        return real.get_summary(version)
+
+                    def upload_summary(self, *a, **k):
+                        return real.upload_summary(*a, **k)
+
+                    def get_versions(self, count=1):
+                        return real.get_versions(count)
+
+                return Storage()
+
+            def connect_to_delta_storage(self):
+                return self.inner_svc.connect_to_delta_storage()
+
+            def connect_to_delta_stream(self, details=None):
+                return self.inner_svc.connect_to_delta_stream(details)
+
+        return Svc(self.inner.create_document_service(document_id))
+
+
+class TestFullStackResilience:
+    def test_load_through_flaky_service(self):
+        server = LocalServer()
+        loader = Loader(RetryingDocumentServiceFactory(
+            _FlakyFactory(LocalDocumentServiceFactory(server), failures=0),
+            RetryPolicy(sleep=lambda _: None)))
+        c1 = loader.create_detached("doc")
+        ds = c1.runtime.create_datastore("default")
+        c1.attach()
+        ds.create_channel("root", SharedMap.TYPE).set("k", 1)
+
+        flaky = _FlakyFactory(LocalDocumentServiceFactory(server),
+                              failures=3)
+        loader2 = Loader(RetryingDocumentServiceFactory(
+            flaky, RetryPolicy(sleep=lambda _: None)))
+        c2 = loader2.resolve("doc")
+        assert c2.runtime.get_datastore("default") \
+            .get_channel("root").get("k") == 1
+        assert flaky.failures == 0  # all failures consumed by retries
+
+    def test_prefetch_warms_cache(self, tmp_path):
+        server = LocalServer()
+        cache = PersistentCache(str(tmp_path))
+        stack = RetryingDocumentServiceFactory(
+            CachingDocumentServiceFactory(
+                LocalDocumentServiceFactory(server), cache),
+            RetryPolicy(sleep=lambda _: None))
+        loader = Loader(stack)
+        c1 = loader.create_detached("doc")
+        ds = c1.runtime.create_datastore("default")
+        c1.attach()
+        ds.create_channel("root", SharedMap.TYPE).set("k", "v")
+
+        assert stack.prefetch_snapshot("doc") is True
+        hits_before = cache.hits
+        c2 = Loader(stack).resolve("doc")
+        assert c2.runtime.get_datastore("default") \
+            .get_channel("root").get("k") == "v"
+        assert cache.hits > hits_before  # load served from the warm cache
