@@ -189,6 +189,22 @@ class SharedMap(SharedObject):
     def items(self) -> Iterator[Tuple[str, Any]]:
         return iter(list(self.kernel.data.items()))
 
+    def entries(self) -> Iterator[Tuple[str, Any]]:
+        """Alias of items() (reference map.ts:173 entries)."""
+        return self.items()
+
+    def values(self) -> Iterator[Any]:
+        return iter(list(self.kernel.data.values()))
+
+    def for_each(self, fn) -> None:
+        """fn(value, key, map) per entry (reference map.ts:202 forEach)."""
+        for k, v in list(self.kernel.data.items()):
+            fn(v, k, self)
+
+    @property
+    def size(self) -> int:
+        return len(self.kernel.data)
+
     def __len__(self) -> int:
         return len(self.kernel.data)
 

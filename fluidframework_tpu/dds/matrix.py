@@ -258,6 +258,17 @@ class SharedMatrix(SharedObject):
             {"target": "cell", "key": key, "value": value})
         self.emit("cellChanged", row, col, value, True, previous)
 
+    def set_cells(self, row_start: int, col_start: int, col_count: int,
+                  values) -> None:
+        """Write a rectangular run row-major (reference matrix.ts:189
+        setCells: col_count wide, wrapping to the next row)."""
+        values = list(values)
+        if col_count <= 0:
+            raise ValueError("col_count must be positive")
+        for i, value in enumerate(values):
+            self.set_cell(row_start + i // col_count,
+                          col_start + i % col_count, value)
+
     def get_cell(self, row: int, col: int) -> Any:
         return self.cells.get(self._cell_key(row, col))
 

@@ -275,7 +275,8 @@ class TpuLocalServer(LocalServer):
         def factory(ctx):
             lam = TpuSequencerLambda(
                 ctx, emit=self._emit_sequenced, nack=self._emit_nack,
-                checkpoints=self.deli_checkpoints, deltas=self.deltas)
+                checkpoints=self.deli_checkpoints, deltas=self.deltas,
+                fresh_log=True)
             self.tpu_sequencers.append(lam)
             return lam
 

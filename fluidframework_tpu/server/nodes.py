@@ -169,9 +169,9 @@ class OrdererNode:
             if core is not None:
                 return core
             had_checkpoint = self.cluster.deli_checkpoint(document_id)
-            core = LocalServer(tenant_id=self.cluster.tenant_id,
-                               db=self.cluster.db,
-                               historian=self.cluster.historian)
+            core = self.cluster.server_cls(
+                tenant_id=self.cluster.tenant_id, db=self.cluster.db,
+                historian=self.cluster.historian)
             # Fencing gate: every pump (i.e. every batch of sequencing work)
             # first renews this node's lease on the document. If the
             # reservation has moved — another node took over while this one
@@ -278,8 +278,13 @@ class Cluster:
     deployment in one process; reference docker-compose scale-out)."""
 
     def __init__(self, tenant_id: str = "cluster",
-                 heartbeat_timeout_s: float = 30.0, lease_s: float = 60.0):
+                 heartbeat_timeout_s: float = 30.0, lease_s: float = 60.0,
+                 server_cls=LocalServer):
+        """server_cls: the per-document pipeline class — LocalServer
+        (scalar deli) or TpuLocalServer (device-batched sequencer); both
+        restore from the shared checkpoint collections on takeover."""
         self.tenant_id = tenant_id
+        self.server_cls = server_cls
         self.db = DatabaseManager()
         self.historian = Historian()
         self.node_manager = NodeManager(self.db.collection("nodes"),
@@ -295,9 +300,29 @@ class Cluster:
         return self.db.collection("deltas", unique_key=delta_key)
 
     def deli_checkpoint(self, document_id: str) -> Optional[dict]:
-        row = self.db.collection("deliCheckpoints").find_one(
-            lambda d: d.get("documentId") == document_id)
-        return row["state"] if row else None
+        """Checkpointed sequencing state for a doc, normalized to the
+        scalar shape ({"clients": [{"clientId": ...}], ...}) — reads the
+        scalar deli's per-doc row or the TPU sequencer's consolidated dump
+        (server/tpu_sequencer.py _checkpoint)."""
+        ckpts = self.db.collection("deliCheckpoints")
+        row = ckpts.find_one(lambda d: d.get("documentId") == document_id)
+        if row:
+            return row["state"]
+        tpu = ckpts.find_one(lambda d: d.get("kind") == "tpu-sequencer")
+        if not tpu:
+            return None
+        dump = tpu["state"]
+        doc = dump.get("docs", {}).get(document_id)
+        if doc is None:
+            return None
+        lane = doc["lane"]
+        tstate = dump["tstate"]
+        by_ordinal = {int(v): k for k, v in doc["interner"].items()}
+        clients = [{"clientId": by_ordinal[int(o)]}
+                   for o in tstate["client_ids"][lane]
+                   if int(o) >= 0 and int(o) in by_ordinal]
+        return {"sequenceNumber": int(tstate["next_seq"][lane]) - 1,
+                "clients": clients}
 
     def create_node(self, node_id: Optional[str] = None) -> OrdererNode:
         nid = node_id or f"node-{next(self._counter)}"

@@ -389,7 +389,7 @@ class TpuSequencerLambda(IPartitionLambda):
                  emit: Callable[[str, SequencedDocumentMessage], None],
                  nack: Callable[[str, str, Nack], None],
                  lanes: int = 8, clients_capacity: int = 8,
-                 checkpoints=None, deltas=None,
+                 checkpoints=None, deltas=None, fresh_log: bool = False,
                  materialize: bool = True,
                  merge_store: Optional[MergeLaneStore] = None,
                  t_buckets: Tuple[int, ...] = (1, 4, 16, 64, 256)):
@@ -398,6 +398,11 @@ class TpuSequencerLambda(IPartitionLambda):
         self.nack = nack
         self.checkpoints = checkpoints
         self.deltas = deltas
+        # fresh_log=True: this lambda consumes a brand-new MessageLog (a
+        # multi-node takeover hands over checkpointed state, not the log);
+        # checkpointed offsets index the PREVIOUS core's log and must not
+        # gate replay of the new one (DeliLambda fresh_log semantics).
+        self.fresh_log = fresh_log
         self.t_buckets = tuple(t_buckets)
         self.lanes = lanes
         self.k = clients_capacity
@@ -422,6 +427,9 @@ class TpuSequencerLambda(IPartitionLambda):
         dump = rows[0]["state"]
         self.docs = {doc: _DocLane.load(d)
                      for doc, d in dump["docs"].items()}
+        if self.fresh_log:
+            for dl in self.docs.values():
+                dl.log_offset = -1
         cols = dump["tstate"]
         self.lanes = len(cols["next_seq"])
         self.k = len(cols["client_ids"][0]) if cols["client_ids"] else self.k

@@ -307,6 +307,54 @@ class TestDeviceTicketingVsScalarDeli:
         assert [e[0] for e in device] == ["seq", "seq"]  # dup silently drops
 
 
+class TestTpuClusterTakeover:
+    def test_owner_death_takeover_resumes_on_tpu_sequencer(self):
+        """Multi-node ordering with the DEVICE sequencer per node: owner
+        dies, the next owner's TpuSequencerLambda restores the consolidated
+        checkpoint + rebuilds merge lanes from shared deltas, evicts the
+        dead node's clients, and sequencing resumes without seq reuse
+        (reference memory-orderer reservations, SURVEY §2.6.4)."""
+        from fluidframework_tpu.loader.drivers.cluster import (
+            ClusterDocumentServiceFactory,
+        )
+        from fluidframework_tpu.server.nodes import Cluster
+
+        cluster = Cluster(server_cls=TpuLocalServer)
+        node_a = cluster.create_node("A")
+        node_b = cluster.create_node("B")
+
+        fa = ClusterDocumentServiceFactory(cluster, node_a)
+        la = Loader(fa)
+        c1 = la.create_detached("doc")
+        ds = c1.runtime.create_datastore("default")
+        text = ds.create_channel("text", SharedString.TYPE)
+        c1.attach()
+        text.insert_text(0, "written-on-A")
+        seq_before = c1.delta_manager.last_sequence_number
+        assert seq_before > 0
+
+        node_a.stop()
+        assert not c1.connected
+
+        fa.set_node(node_b)
+        c1.reconnect()
+        assert c1.connected
+        assert cluster.reservations.owner("doc") == "B"
+        text.insert_text(text.get_length(), "/continued-on-B")
+        assert c1.delta_manager.last_sequence_number > seq_before
+
+        # Fresh client through B converges; B's device merge lanes hold
+        # the full text (rebuilt from the shared deltas collection).
+        c2 = Loader(ClusterDocumentServiceFactory(cluster, node_b)
+                    ).resolve("doc")
+        t2 = c2.runtime.get_datastore("default").get_channel("text")
+        assert t2.get_text() == text.get_text() == \
+            "written-on-A/continued-on-B"
+        core_b = node_b.cores["doc"]
+        assert core_b.sequencer().channel_text(
+            "doc", "default", "text") == text.get_text()
+
+
 class TestBatchedSummarization:
     def _server_with_text(self, n_docs=3, ops_per_doc=30):
         server = TpuLocalServer()
