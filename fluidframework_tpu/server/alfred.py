@@ -161,6 +161,14 @@ class AlfredService:
     _ROUTES = [
         ("GET", re.compile(r"^/api/v1/ping$"), "_r_ping"),
         ("POST", re.compile(r"^/documents/(?P<tenant>[^/]+)$"), "_r_create_doc"),
+        ("GET", re.compile(r"^/documents/(?P<tenant>[^/]+)/(?P<doc>[^/]+)$"),
+         "_r_get_doc"),
+        ("GET", re.compile(
+            r"^/deltas/raw/(?P<tenant>[^/]+)/(?P<doc>[^/]+)$"),
+         "_r_raw_deltas"),
+        ("POST", re.compile(
+            r"^/api/(?P<tenant>[^/]+)/(?P<doc>[^/]+)/blobs$"),
+         "_r_create_blob"),
         ("GET", re.compile(r"^/deltas/(?P<tenant>[^/]+)/(?P<doc>[^/?]+)$"),
          "_r_deltas"),
         ("POST", re.compile(r"^/tenants/(?P<tenant>[^/]+)/validate$"),
@@ -281,6 +289,56 @@ class AlfredService:
         to_seq = int(params["to"]) if "to" in params else None
         rows = self.core(tenant).get_deltas(doc, from_seq, to_seq)
         _send_json(handler, 200, {"deltas": rows})
+
+    def _r_get_doc(self, handler, params, tenant: str, doc: str) -> None:
+        """Document existence + metadata (reference alfred
+        routes/api/documents.ts:14 getDocument)."""
+        claims = self._check_auth(handler, tenant, doc, "doc:read")
+        if claims is None:
+            return
+        core = self.core(tenant)
+        head = core.storage(doc).get_ref("main")
+        seq = core.sequence_number(doc)
+        if head is None and seq == 0:
+            _send_json(handler, 404, {"error": f"document {doc!r} not found"})
+            return
+        _send_json(handler, 200, {
+            "id": doc, "tenantId": tenant, "sequenceNumber": seq,
+            "headSummary": head})
+
+    def _r_raw_deltas(self, handler, params, tenant: str, doc: str) -> None:
+        """Raw (pre-sequencing) op stream persisted by the copier
+        (reference alfred routes/api/deltas.ts:183 /deltas/raw)."""
+        claims = self._check_auth(handler, tenant, doc, "doc:read")
+        if claims is None:
+            return
+        core = self.core(tenant)
+        rows = core.raw_deltas.find(
+            lambda d: d.get("documentId") == doc)
+        _send_json(handler, 200, {"rawDeltas": rows})
+
+    def _r_create_blob(self, handler, params, tenant: str,
+                       doc: str) -> None:
+        """Attachment blob upload (reference alfred api.ts:59 createBlob):
+        content-addressed into the document's git store; the returned sha
+        is referenced from summaries/ops as an attachment handle."""
+        claims = self._check_auth(handler, tenant, doc, "doc:write")
+        if claims is None:
+            return
+        body = _read_json(handler) or {}
+        content = body.get("content")
+        if not isinstance(content, str):
+            _send_json(handler, 400, {"error": "content (base64) required"})
+            return
+        import base64
+        try:
+            raw = base64.b64decode(content, validate=True)
+        except Exception:  # noqa: BLE001 — malformed payload
+            _send_json(handler, 400, {"error": "content is not base64"})
+            return
+        sha = self.core(tenant).storage(doc).put_blob(raw)
+        _send_json(handler, 201, {"sha": sha, "size": len(raw),
+                                  "url": f"/blobs/{tenant}/{doc}/{sha}"})
 
     def _r_upload_summary(self, handler, params, tenant: str,
                           doc: str) -> None:

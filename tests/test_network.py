@@ -116,6 +116,52 @@ class TestRest:
         out2 = rest.post(f"/documents/{DEFAULT_TENANT}", {})
         assert out2["id"].startswith("doc-")
 
+    def test_get_document_metadata(self, server):
+        rest = RestWrapper(server.url)
+        rest.post(f"/documents/{DEFAULT_TENANT}", {"id": "metadoc"})
+        with pytest.raises(RestError) as exc:
+            rest.get(f"/documents/{DEFAULT_TENANT}/never-created")
+        assert exc.value.status == 404
+        # A document with live history reports its sequence number + head.
+        loader, c1, ds1 = make_network_doc(server, "metadoc2")
+        ds1.create_channel("n", SharedCounter.TYPE).increment(1)
+        c1.attach()
+        assert wait_until(lambda: rest.get(
+            f"/documents/{DEFAULT_TENANT}/metadoc2")["sequenceNumber"] > 0)
+        out = rest.get(f"/documents/{DEFAULT_TENANT}/metadoc2")
+        assert out["id"] == "metadoc2"
+        assert out["headSummary"]
+        c1.close()
+
+    def test_raw_deltas_route(self, server):
+        rest = RestWrapper(server.url)
+        loader, c1, ds1 = make_network_doc(server, "rawdoc")
+        ds1.create_channel("n", SharedCounter.TYPE).increment(2)
+        c1.attach()
+        assert wait_until(lambda: len(rest.get(
+            f"/deltas/raw/{DEFAULT_TENANT}/rawdoc")["rawDeltas"]) > 0)
+        out = rest.get(f"/deltas/raw/{DEFAULT_TENANT}/rawdoc")
+        c1.close()
+        assert len(out["rawDeltas"]) > 0
+        assert all(r["documentId"] == "rawdoc" for r in out["rawDeltas"])
+
+    def test_blob_upload(self, server):
+        import base64
+        rest = RestWrapper(server.url)
+        rest.post(f"/documents/{DEFAULT_TENANT}", {"id": "blobdoc"})
+        payload = base64.b64encode(b"attachment-bytes").decode()
+        out = rest.post(f"/api/{DEFAULT_TENANT}/blobdoc/blobs",
+                        {"content": payload})
+        assert out["size"] == len(b"attachment-bytes")
+        # Content-addressed: same bytes, same sha.
+        again = rest.post(f"/api/{DEFAULT_TENANT}/blobdoc/blobs",
+                          {"content": payload})
+        assert again["sha"] == out["sha"]
+        with pytest.raises(RestError) as exc:
+            rest.post(f"/api/{DEFAULT_TENANT}/blobdoc/blobs",
+                      {"content": "!!!not-base64!!!"})
+        assert exc.value.status == 400
+
     def test_tenant_routes(self, server):
         rest = RestWrapper(server.url)
         created = rest.post("/tenants/newco", {"key": "sekrit"})
