@@ -267,6 +267,11 @@ class AlfredService:
         if claims is None:
             return
         core = self.core(tenant)
+        # Existence registry: a summary-less create must still be readable
+        # back immediately (create-then-GET consistency).
+        core.db.collection("documents").upsert(
+            lambda d, _id=doc_id: d.get("documentId") == _id,
+            {"documentId": doc_id, "tenantId": tenant})
         if body.get("summary") is not None:
             store = core.storage(doc_id)
             if store.get_ref("main") is not None:
@@ -299,7 +304,9 @@ class AlfredService:
         core = self.core(tenant)
         head = core.storage(doc).get_ref("main")
         seq = core.sequence_number(doc)
-        if head is None and seq == 0:
+        registered = core.db.collection("documents").find_one(
+            lambda d: d.get("documentId") == doc) is not None
+        if head is None and seq == 0 and not registered:
             _send_json(handler, 404, {"error": f"document {doc!r} not found"})
             return
         _send_json(handler, 200, {
@@ -313,9 +320,13 @@ class AlfredService:
         if claims is None:
             return
         core = self.core(tenant)
+        from_off = int(params.get("from", -1))
+        limit = int(params.get("limit", 1000))
         rows = core.raw_deltas.find(
-            lambda d: d.get("documentId") == doc)
-        _send_json(handler, 200, {"rawDeltas": rows})
+            lambda d: d.get("documentId") == doc
+            and d.get("offset", 0) > from_off)
+        rows.sort(key=lambda d: d.get("offset", 0))
+        _send_json(handler, 200, {"rawDeltas": rows[:limit]})
 
     def _r_create_blob(self, handler, params, tenant: str,
                        doc: str) -> None:

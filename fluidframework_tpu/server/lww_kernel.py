@@ -1,0 +1,139 @@
+"""Batched LWW kernel: map/cell/counter ops across thousands of channels.
+
+The merge-tree kernel covers the sequence family; this covers the
+last-write-wins family (SharedMap set/delete/clear — mapKernel.ts:490
+remote-apply semantics, SharedCell setCell/deleteCell, SharedCounter
+increment), so the TPU sequencer materializes EVERY common channel type
+on device (server/tpu_sequencer.py routes ops here).
+
+State per channel lane: a fixed-capacity key-slot table (interned key id,
+payload ref, writer seq) + an additive counter accumulator. One op per
+channel per scan step, `scan(T) x vmap(B)` like the other kernels; values
+stay host-side behind integer payload refs (SURVEY.md §7: JSON stays on
+the host)."""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LwwKind:
+    NOOP = 0
+    SET = 1     # key slot <- val ref (insert or overwrite)
+    DELETE = 2  # free the key's slot
+    CLEAR = 3   # free every slot
+    ADD = 4     # counter += delta
+
+
+class LwwState(NamedTuple):
+    """[B, C] slot tables + per-lane scalars (leading batch axis)."""
+
+    key: jnp.ndarray      # interned key id; -1 = free slot
+    val: jnp.ndarray      # payload ref of the latest write
+    seq: jnp.ndarray      # sequence number of the latest write
+    counter: jnp.ndarray  # [B] additive accumulator
+    last_seq: jnp.ndarray  # [B] high-water mark of applied ops
+    overflow: jnp.ndarray  # [B] bool: a SET found no free slot
+
+
+class LwwOps(NamedTuple):
+    """[B, T] op columns (NOOP-padded)."""
+
+    kind: jnp.ndarray
+    key: jnp.ndarray
+    val: jnp.ndarray
+    delta: jnp.ndarray
+    seq: jnp.ndarray
+
+
+def make_lww_state(capacity: int, batch: int | None = None) -> LwwState:
+    def shape(*dims):
+        return dims if batch is None else (batch, *dims)
+    return LwwState(
+        key=jnp.full(shape(capacity), -1, jnp.int32),
+        val=jnp.full(shape(capacity), -1, jnp.int32),
+        seq=jnp.zeros(shape(capacity), jnp.int32),
+        counter=jnp.zeros(shape(), jnp.int32),
+        last_seq=jnp.zeros(shape(), jnp.int32),
+        overflow=jnp.zeros(shape(), jnp.bool_),
+    )
+
+
+def _apply_one(s: LwwState, kind, key, val, delta, seq) -> LwwState:
+    c = s.key.shape[-1]
+    idx = jnp.arange(c, dtype=jnp.int32)
+    is_set = kind == LwwKind.SET
+    is_del = kind == LwwKind.DELETE
+    is_clear = kind == LwwKind.CLEAR
+    is_add = kind == LwwKind.ADD
+    is_op = is_set | is_del | is_clear | is_add
+
+    match = s.key == key
+    have = jnp.any(match)
+    free = s.key == -1
+    # SET: existing slot wins; else first free slot.
+    target = jnp.where(have, jnp.argmax(match),
+                       jnp.argmax(free)).astype(jnp.int32)
+    can_set = is_set & (have | jnp.any(free))
+    at = idx == target
+    new_key = jnp.where(can_set & at, key, s.key)
+    new_val = jnp.where(can_set & at, val, s.val)
+    new_seq = jnp.where(can_set & at, seq, s.seq)
+    # DELETE: free the matching slot (LWW remote semantics — the server has
+    # no pending-local shadowing, mapKernel.ts:619 reduces to this).
+    gone = is_del & match
+    new_key = jnp.where(gone, -1, new_key)
+    new_val = jnp.where(gone, -1, new_val)
+    # CLEAR: free everything.
+    new_key = jnp.where(is_clear, -1, new_key)
+    new_val = jnp.where(is_clear, -1, new_val)
+    return LwwState(
+        key=new_key, val=new_val, seq=new_seq,
+        counter=s.counter + jnp.where(is_add, delta, 0),
+        last_seq=jnp.where(is_op, jnp.maximum(s.last_seq, seq), s.last_seq),
+        overflow=s.overflow | (is_set & ~have & ~jnp.any(free)),
+    )
+
+
+def _scan(state: LwwState, ops: LwwOps, batched: bool) -> LwwState:
+    steps = ops.kind.shape[-1]
+
+    def body(s, t):
+        if batched:
+            s2 = jax.vmap(lambda sd, k, ky, v, d, q: _apply_one(
+                sd, k[t], ky[t], v[t], d[t], q[t]))(
+                s, ops.kind, ops.key, ops.val, ops.delta, ops.seq)
+        else:
+            s2 = _apply_one(s, ops.kind[t], ops.key[t], ops.val[t],
+                            ops.delta[t], ops.seq[t])
+        return s2, None
+
+    out, _ = jax.lax.scan(body, state, jnp.arange(steps, dtype=jnp.int32))
+    return out
+
+
+@jax.jit
+def apply_lww_batched(state: LwwState, ops: LwwOps) -> LwwState:
+    """Apply [B, T] LWW op streams to B channels (non-donating: callers
+    retry overflowing lanes at a larger capacity from the retained input)."""
+    return _scan(state, ops, batched=True)
+
+
+def grow_lane_capacity(state: LwwState, capacity: int) -> LwwState:
+    """Re-pad every lane's slot table (overflow recovery)."""
+    b, c = state.key.shape
+    if capacity <= c:
+        return state
+
+    def widen(col, fill):
+        out = jnp.full((b, capacity), fill, col.dtype)
+        return out.at[:, :c].set(col)
+
+    return state._replace(key=widen(state.key, -1),
+                          val=widen(state.val, -1),
+                          seq=widen(state.seq, 0),
+                          overflow=jnp.zeros((b,), jnp.bool_))

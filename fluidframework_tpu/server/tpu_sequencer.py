@@ -325,6 +325,257 @@ class MergeLaneStore:
 
 
 # ---------------------------------------------------------------------------
+# LWW lanes: map/cell/counter channels on device (server/lww_kernel.py)
+# ---------------------------------------------------------------------------
+
+_CELL_KEY = "\x00cell"  # SharedCell = a one-key LWW map
+
+
+def looks_like_lww_op(op: Any) -> bool:
+    if not isinstance(op, dict):
+        return False
+    t = op.get("type")
+    if t in ("set", "delete"):
+        # MapKernel ops always carry a pid; requiring it keeps shape-alike
+        # ops from other DDSes out of the LWW lanes.
+        return isinstance(op.get("key"), str) and "pid" in op
+    if t == "clear":
+        return "pid" in op  # ink's clear has no pid; directory's has a path
+    if t == "increment":
+        return "delta" in op
+    return t in ("setCell", "deleteCell")
+
+
+class _LwwBucket:
+    """A batch of LWW lanes sharing one key-slot capacity (mirrors
+    _MergeBucket: per-capacity buckets instead of one global table, so one
+    hot channel cannot inflate device memory for every lane)."""
+
+    def __init__(self, lk, capacity: int, lanes: int = 8):
+        self.lk = lk
+        self.capacity = capacity
+        self.lanes = lanes
+        self.state = lk.make_lww_state(capacity, batch=lanes)
+        self.used: List[Optional[tuple]] = [None] * lanes
+
+    def alloc(self, key: tuple) -> int:
+        for i, k in enumerate(self.used):
+            if k is None:
+                self.used[i] = key
+                return i
+        old = self.lanes
+        grown = self.lk.make_lww_state(self.capacity, batch=old * 2)
+        self.state = jax.tree_util.tree_map(
+            lambda g, s: g.at[:old].set(s), grown, self.state)
+        self.used.extend([None] * old)
+        self.lanes = old * 2
+        self.used[old] = key
+        return old
+
+    def free(self, lane: int) -> None:
+        self.used[lane] = None
+
+    def row(self, lane: int):
+        return jax.tree_util.tree_map(lambda x: x[lane], self.state)
+
+    def put_row(self, lane: int, row) -> None:
+        self.state = jax.tree_util.tree_map(
+            lambda b, r: b.at[lane].set(r), self.state, row)
+
+
+class LwwLaneStore:
+    """Device-resident LWW channel lanes + host key/value interning: the
+    map/cell/counter half of server-side materialization (mapKernel.ts:490
+    remote-apply semantics, batched across channels). Lanes live in
+    key-capacity buckets; a lane whose key set outgrows its bucket promotes
+    to the next one and its window re-applies from the retained pre-state."""
+
+    def __init__(self, capacities: Tuple[int, ...] = (64, 1024, 16384),
+                 lanes_per_bucket: int = 8,
+                 t_buckets: Tuple[int, ...] = (1, 4, 16, 64, 256)):
+        from . import lww_kernel as lk
+
+        self.lk = lk
+        self.capacities = tuple(capacities)
+        self.t_buckets = tuple(t_buckets)
+        self.buckets = [_LwwBucket(lk, c, lanes_per_bucket)
+                        for c in self.capacities]
+        self.where: Dict[tuple, Tuple[int, int]] = {}
+        self.key_ids: Dict[str, int] = {}
+        self.key_names: List[str] = []
+        self.values: List[Any] = []  # payload refs -> raw (encoded) values
+        self.windows_since_value_compact = 0
+        self.value_compact_every = 64
+
+    def intern_key(self, key: str) -> int:
+        if key not in self.key_ids:
+            self.key_ids[key] = len(self.key_names)
+            self.key_names.append(key)
+        return self.key_ids[key]
+
+    def add_value(self, value: Any) -> int:
+        self.values.append(value)
+        return len(self.values) - 1
+
+    def lane_for(self, key: tuple) -> Tuple[int, int]:
+        if key not in self.where:
+            lane = self.buckets[0].alloc(key)
+            self.where[key] = (0, lane)
+        return self.where[key]
+
+    def wire_to_op(self, op: dict, seq: int) -> tuple:
+        """(kind, key_id, val_id, delta, seq) for one sequenced wire op.
+        Raises Unmodelable (never anything else) for content the kernel
+        cannot represent — a malformed op must not crash-loop the
+        partition (flush aborts before checkpointing, replay redelivers)."""
+        lk = self.lk
+        t = op.get("type")
+        try:
+            if t == "set":
+                return (lk.LwwKind.SET, self.intern_key(op["key"]),
+                        self.add_value(op.get("value")), 0, seq)
+            if t == "delete":
+                return (lk.LwwKind.DELETE, self.intern_key(op["key"]), -1,
+                        0, seq)
+            if t == "clear":
+                return (lk.LwwKind.CLEAR, -1, -1, 0, seq)
+            if t == "setCell":
+                return (lk.LwwKind.SET, self.intern_key(_CELL_KEY),
+                        self.add_value(op.get("value")), 0, seq)
+            if t == "deleteCell":
+                return (lk.LwwKind.DELETE, self.intern_key(_CELL_KEY), -1,
+                        0, seq)
+            if t == "increment":
+                delta = int(op["delta"])
+                if not (-2**31 <= delta < 2**31):
+                    raise Unmodelable("increment delta exceeds int32")
+                return (lk.LwwKind.ADD, -1, -1, delta, seq)
+        except Unmodelable:
+            raise
+        except Exception as err:  # noqa: BLE001 — malformed wire content
+            raise Unmodelable(f"malformed lww op: {err}") from err
+        raise Unmodelable(f"unknown lww op {t!r}")
+
+    def apply(self, streams: Dict[tuple, List[tuple]]) -> None:
+        """streams: lane_key -> [(kind, key_id, val_id, delta, seq)].
+        Windows chunk to the largest T bucket."""
+        max_t = self.t_buckets[-1]
+        while streams:
+            window = {k: v[:max_t] for k, v in streams.items() if v}
+            streams = {k: v[max_t:] for k, v in streams.items()
+                       if len(v) > max_t}
+            if window:
+                self._apply_window(window)
+        self.windows_since_value_compact += 1
+        if self.windows_since_value_compact >= self.value_compact_every:
+            self.compact_values()
+
+    def _pack(self, lanes_count: int, window_lanes: Dict[int, List[tuple]],
+              t: int):
+        cols = {f: np.zeros((lanes_count, t), np.int32)
+                for f in ("kind", "key", "val", "delta", "seq")}
+        for lane, ops in window_lanes.items():
+            for i, (kind, kid, vid, delta, seq) in enumerate(ops):
+                cols["kind"][lane, i] = kind
+                cols["key"][lane, i] = kid
+                cols["val"][lane, i] = vid
+                cols["delta"][lane, i] = delta
+                cols["seq"][lane, i] = seq
+        return self.lk.LwwOps(**{f: jnp.asarray(cols[f]) for f in cols})
+
+    def _apply_window(self, window: Dict[tuple, List[tuple]]) -> None:
+        per_bucket: Dict[int, Dict[int, List[tuple]]] = {}
+        for key, ops in window.items():
+            b, lane = self.lane_for(key)
+            per_bucket.setdefault(b, {})[lane] = ops
+        for b, lane_ops in sorted(per_bucket.items()):
+            bucket = self.buckets[b]
+            t = _bucket(max(len(v) for v in lane_ops.values()),
+                        self.t_buckets)
+            ops_dev = self._pack(bucket.lanes, lane_ops, t)
+            pre = bucket.state
+            new = self.lk.apply_lww_batched(pre, ops_dev)
+            over = np.asarray(new.overflow)
+            flagged = [i for i in range(bucket.lanes)
+                       if over[i] and i in lane_ops]
+            if flagged:
+                for i in flagged:
+                    row = jax.tree_util.tree_map(lambda x: x[i], pre)
+                    new = jax.tree_util.tree_map(
+                        lambda bcol, r: bcol.at[i].set(r), new, row)
+            bucket.state = new
+            for i in flagged:
+                self._promote(b, i, lane_ops[i], t)
+
+    def _promote(self, b: int, lane: int, ops: List[tuple], t: int) -> None:
+        """Overflowed lane: move to the next capacity bucket and re-apply
+        its window from the retained pre-state row."""
+        key = self.buckets[b].used[lane]
+        row = self.buckets[b].row(lane)
+        self.buckets[b].free(lane)
+        for nb in range(b + 1, len(self.buckets)):
+            target = self.buckets[nb]
+            wide = self.lk.grow_lane_capacity(
+                jax.tree_util.tree_map(lambda x: x[None], row),
+                target.capacity)
+            ops_dev = self._pack(1, {0: ops}, t)
+            redone = self.lk.apply_lww_batched(wide, ops_dev)
+            if not bool(np.asarray(redone.overflow)[0]):
+                new_lane = target.alloc(key)
+                target.put_row(new_lane, jax.tree_util.tree_map(
+                    lambda x: x[0], redone))
+                self.where[key] = (nb, new_lane)
+                return
+            row = jax.tree_util.tree_map(lambda x: x[0], wide)
+        del self.where[key]
+        raise RuntimeError(
+            f"lww lane {key} overflows the largest key capacity "
+            f"{self.capacities[-1]}")
+
+    def compact_values(self) -> None:
+        """Reclaim unreferenced payloads: memory must track LIVE state, not
+        total op count (the merge side's zamboni analog for values)."""
+        referenced: set = set()
+        for bucket in self.buckets:
+            if any(k is not None for k in bucket.used):
+                vals = np.asarray(bucket.state.val)
+                referenced.update(int(v) for v in np.unique(vals) if v >= 0)
+        remap = {old: new for new, old in enumerate(sorted(referenced))}
+        self.values = [self.values[old] for old in sorted(referenced)]
+        for bucket in self.buckets:
+            if not any(k is not None for k in bucket.used):
+                continue
+            vals = np.asarray(bucket.state.val)
+            out = np.full_like(vals, -1)
+            for old, new in remap.items():
+                out[vals == old] = new
+            bucket.state = bucket.state._replace(val=jnp.asarray(out))
+        self.windows_since_value_compact = 0
+
+    # -- reads (tests / snapshots) -----------------------------------------
+    def snapshot(self, lane_key: tuple) -> Optional[dict]:
+        """Entries hold WIRE-ENCODED values (handles stay in their encoded
+        dict form): the server has no runtime to bind live handles to —
+        clients decode at load, exactly as they do for ops."""
+        if lane_key not in self.where:
+            return None
+        b, lane = self.where[lane_key]
+        state = self.buckets[b].state
+        keys = np.asarray(state.key[lane])
+        vals = np.asarray(state.val[lane])
+        entries = {}
+        for kid, vid in zip(keys, vals):
+            if int(kid) >= 0:
+                entries[self.key_names[int(kid)]] = (
+                    self.values[int(vid)] if int(vid) >= 0 else None)
+        return {
+            "entries": entries,
+            "counter": int(np.asarray(state.counter[lane])),
+            "sequenceNumber": int(np.asarray(state.last_seq[lane])),
+        }
+
+
+# ---------------------------------------------------------------------------
 # the lambda
 # ---------------------------------------------------------------------------
 
@@ -413,6 +664,7 @@ class TpuSequencerLambda(IPartitionLambda):
         self.materialize = materialize
         self.merge = merge_store if merge_store is not None else \
             MergeLaneStore(t_buckets=t_buckets)
+        self.lww = LwwLaneStore(t_buckets=t_buckets)
         self._pending_offset: Optional[int] = None
         self._restore()
 
@@ -454,6 +706,7 @@ class TpuSequencerLambda(IPartitionLambda):
         from .lambdas.scriptorium import query_deltas
         next_seq = np.asarray(self.tstate.next_seq)
         streams: Dict[tuple, List[HostOp]] = {}
+        lww_streams: Dict[tuple, List[tuple]] = {}
         for doc_id, dl in self.docs.items():
             # Bound at the restored checkpoint's last seq: deltas persisted
             # by a flush that crashed before checkpointing will be
@@ -476,11 +729,13 @@ class TpuSequencerLambda(IPartitionLambda):
                                  type=row["type"],
                                  contents=row.get("contents")),
                              row["client_id"])
-                self._collect_merge(streams, doc_id, p,
-                                    row["sequence_number"],
-                                    row["minimum_sequence_number"])
+                self._collect_channel_op(streams, lww_streams, doc_id, p,
+                                         row["sequence_number"],
+                                         row["minimum_sequence_number"])
         if streams:
             self.merge.apply(streams)
+        if lww_streams:
+            self.lww.apply(lww_streams)
 
     def _checkpoint(self) -> None:
         if self._pending_offset is None:
@@ -635,6 +890,7 @@ class TpuSequencerLambda(IPartitionLambda):
                                "pre-flush growth — invariant violation")
 
         merge_streams: Dict[tuple, List[HostOp]] = {}
+        lww_streams: Dict[tuple, List[tuple]] = {}
         for doc_id, queue in live.items():
             lane = self.docs[doc_id].lane
             for i, p in enumerate(queue):
@@ -645,8 +901,9 @@ class TpuSequencerLambda(IPartitionLambda):
                     sequenced.traces.append(ITrace.now("deli", "sequence"))
                     self.emit(doc_id, sequenced)
                     if p.kind == tk.MsgKind.OP and self.materialize:
-                        self._collect_merge(merge_streams, doc_id, p, seq,
-                                            int(msns[lane, i]))
+                        self._collect_channel_op(
+                            merge_streams, lww_streams, doc_id, p, seq,
+                            int(msns[lane, i]))
                 elif nacked[lane, i]:
                     reason = ("client not joined" if not_joined[lane, i]
                               else "refSeq below minimum sequence number")
@@ -667,9 +924,16 @@ class TpuSequencerLambda(IPartitionLambda):
 
         if self.materialize and merge_streams:
             self.merge.apply(merge_streams)
+        if self.materialize and lww_streams:
+            self.lww.apply(lww_streams)
 
-    def _collect_merge(self, streams: Dict[tuple, List[HostOp]],
-                       doc_id: str, p: _Pending, seq: int, msn: int) -> None:
+    def _collect_channel_op(self, merge_streams: Dict[tuple, List[HostOp]],
+                            lww_streams: Dict[tuple, List[tuple]],
+                            doc_id: str, p: _Pending, seq: int,
+                            msn: int) -> None:
+        """Route an admitted channel op to its device lane family:
+        merge-tree ops to the segment kernel, map/cell/counter ops to the
+        LWW kernel; anything else stays host-only."""
         if p.msg.type != MessageType.OPERATION:
             return
         contents = p.msg.contents
@@ -679,18 +943,23 @@ class TpuSequencerLambda(IPartitionLambda):
         if not isinstance(envelope, dict):
             return
         op = envelope.get("contents")
-        if not looks_like_merge_op(op):
-            return
         key = (doc_id, contents.get("address"), envelope.get("address"))
-        if key in self.merge.opaque:
-            return
-        try:
-            ops = wire_to_host_ops(self.merge.builder, op, seq, p.ref_seq,
-                                   p.ordinal, msn)
-        except Unmodelable:
-            self.merge.drop(key)
-            return
-        streams.setdefault(key, []).extend(ops)
+        if looks_like_merge_op(op):
+            if key in self.merge.opaque:
+                return
+            try:
+                ops = wire_to_host_ops(self.merge.builder, op, seq,
+                                       p.ref_seq, p.ordinal, msn)
+            except Unmodelable:
+                self.merge.drop(key)
+                return
+            merge_streams.setdefault(key, []).extend(ops)
+        elif looks_like_lww_op(op):
+            try:
+                lww_streams.setdefault(key, []).append(
+                    self.lww.wire_to_op(op, seq))
+            except Unmodelable:
+                pass
 
     # -- batched server-side summarization ---------------------------------
     def summarize_documents(self, chunk_chars: int = 10000
@@ -724,6 +993,12 @@ class TpuSequencerLambda(IPartitionLambda):
         """Server-materialized text for a channel (device state + host
         payload table) — the batched-summarization read path."""
         return self.merge.text((doc_id, store, channel))
+
+    def channel_snapshot(self, doc_id: str, store: str,
+                         channel: str) -> Optional[dict]:
+        """Server-materialized LWW channel state (map entries / cell value
+        under the reserved key / counter accumulator)."""
+        return self.lww.snapshot((doc_id, store, channel))
 
     def document_seq(self, doc_id: str) -> int:
         dl = self.docs.get(doc_id)

@@ -74,8 +74,8 @@ class TestTpuServingE2E:
         assert server_text == text.get_text() == t2.get_text()
 
     def test_mixed_dds_traffic(self):
-        """Non-merge-tree ops (map/counter) ride the same device sequencer;
-        only string channels materialize on device."""
+        """Non-merge-tree ops (map/counter) ride the same device sequencer
+        (and materialize via the LWW kernel — TestLwwMaterialization)."""
         server = TpuLocalServer()
         loader, c1, ds1 = make_doc(server)
         c1.attach()
@@ -464,3 +464,141 @@ class TestOverflowRecovery:
         count = int(np.asarray(store.buckets[b].state.count)[lane])
         assert count <= 4, f"zamboni left {count} live segments"
         assert text.get_text() == ""
+
+
+class TestLwwMaterialization:
+    """Map/cell/counter channels materialize on device via the batched LWW
+    kernel (server/lww_kernel.py) — every common channel type has a
+    server-side device representation."""
+
+    def test_map_counter_cell_materialize(self):
+        from fluidframework_tpu.dds.cell import SharedCell
+
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        m = ds1.create_channel("root", SharedMap.TYPE)
+        k = ds1.create_channel("clicks", SharedCounter.TYPE)
+        cell = ds1.create_channel("cfg", SharedCell.TYPE)
+        c2 = loader.resolve("doc")
+        m2 = c2.runtime.get_datastore("default").get_channel("root")
+        k2 = c2.runtime.get_datastore("default").get_channel("clicks")
+
+        m.set("a", 1)
+        m2.set("b", {"nested": True})
+        m.set("a", 2)          # LWW overwrite
+        m2.set("gone", "x")
+        m.delete("gone")
+        k.increment(5)
+        k2.increment(-2)
+        cell.set({"theme": "dark"})
+
+        seq = server.sequencer()
+        snap = seq.channel_snapshot("doc", "default", "root")
+        assert snap["entries"] == {"a": 2, "b": {"nested": True}}
+        assert seq.channel_snapshot("doc", "default", "clicks")[
+            "counter"] == 3 == k.value
+        cell_snap = seq.channel_snapshot("doc", "default", "cfg")
+        assert list(cell_snap["entries"].values()) == [{"theme": "dark"}]
+
+    def test_clear_and_key_capacity_growth(self):
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        m = ds1.create_channel("root", SharedMap.TYPE)
+        # Blow past the initial 64-key slot capacity: overflow retries the
+        # window at doubled capacity.
+        for i in range(150):
+            m.set(f"key{i}", i)
+        seq = server.sequencer()
+        snap = seq.channel_snapshot("doc", "default", "root")
+        assert len(snap["entries"]) == 150
+        assert snap["entries"]["key149"] == 149
+        m.clear()
+        m.set("fresh", True)
+        snap2 = seq.channel_snapshot("doc", "default", "root")
+        assert snap2["entries"] == {"fresh": True}
+
+    def test_lww_random_matches_clients(self):
+        rng = random.Random(21)
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        m = ds1.create_channel("root", SharedMap.TYPE)
+        c2 = loader.resolve("doc")
+        m2 = c2.runtime.get_datastore("default").get_channel("root")
+        keys = [f"k{i}" for i in range(8)]
+        for step in range(120):
+            target = rng.choice([m, m2])
+            key = rng.choice(keys)
+            r = rng.random()
+            if r < 0.7:
+                target.set(key, step)
+            elif target.has(key):
+                target.delete(key)
+        snap = server.sequencer().channel_snapshot("doc", "default", "root")
+        client_view = {k: m.get(k) for k in m.keys()}
+        assert snap["entries"] == client_view == {
+            k: m2.get(k) for k in m2.keys()}
+
+    def test_lww_rebuild_after_crash_restart(self):
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        m = ds1.create_channel("root", SharedMap.TYPE)
+        k = ds1.create_channel("clicks", SharedCounter.TYPE)
+        m.set("x", "pre")
+        k.increment(4)
+        server._deli_mgr.restart()
+        m.set("y", "post")
+        k.increment(1)
+        seq = server.sequencer()
+        snap = seq.channel_snapshot("doc", "default", "root")
+        assert snap["entries"] == {"x": "pre", "y": "post"}
+        assert seq.channel_snapshot("doc", "default", "clicks")[
+            "counter"] == 5
+
+    def test_value_compaction_reclaims_dead_payloads(self):
+        """Payload memory tracks live state, not op count: overwritten
+        values are reclaimed by compact_values (the zamboni analog)."""
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        m = ds1.create_channel("root", SharedMap.TYPE)
+        for i in range(200):
+            m.set("hot", i)  # 200 payloads submitted, 1 live
+        m.set("other", "keep")
+        store = server.sequencer().lww
+        # Auto-compaction (every value_compact_every windows) already keeps
+        # the table bounded by LIVE state, not op count...
+        assert len(store.values) < 100
+        store.compact_values()
+        assert len(store.values) <= 4  # ...and a manual pass gets exact
+        snap = server.sequencer().channel_snapshot("doc", "default", "root")
+        assert snap["entries"] == {"hot": 199, "other": "keep"}
+        # Continues to work after compaction (refs were remapped).
+        m.set("post", 1)
+        snap2 = server.sequencer().channel_snapshot("doc", "default", "root")
+        assert snap2["entries"]["post"] == 1
+
+    def test_malformed_increment_does_not_crash_partition(self):
+        """A garbage delta must not crash-loop the sequencer (review
+        finding): the op still sequences (clients decide how to react);
+        only device materialization skips it."""
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        k = ds1.create_channel("clicks", SharedCounter.TYPE)
+        k.increment(2)
+        conn = server._connections["doc"][0]
+        conn.submit([DocumentMessage(
+            client_sequence_number=999, reference_sequence_number=0,
+            type=MessageType.OPERATION,
+            contents={"address": "default", "contents": {
+                "address": "clicks",
+                "contents": {"type": "increment", "delta": "garbage"}}})])
+        server.pump()
+        k.increment(3)  # partition still sequencing
+        snap = server.sequencer().channel_snapshot("doc", "default",
+                                                   "clicks")
+        assert snap["counter"] == 5
