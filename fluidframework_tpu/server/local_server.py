@@ -313,8 +313,13 @@ class TpuLocalServer(LocalServer):
                 store_node = root.add_tree(store_id)
             node = store_node.add_tree(channel_id)
             node.add_blob("header", _json.dumps(snap["header"]))
-            for i, chunk in enumerate(snap["chunks"]):
-                node.add_blob(f"chunk_{i}", _json.dumps(chunk))
+            if "chunks" in snap:  # merge-tree channel: chunked body
+                for i, chunk in enumerate(snap["chunks"]):
+                    node.add_blob(f"chunk_{i}", _json.dumps(chunk))
+            else:  # LWW channel: entries + counter in one blob
+                node.add_blob("lww", _json.dumps(
+                    {"entries": snap["entries"],
+                     "counter": snap["counter"]}, sort_keys=True))
         out = {}
         for doc_id, tree in by_doc.items():
             gstore = self.historian.store(self.tenant_id, doc_id)

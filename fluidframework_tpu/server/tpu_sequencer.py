@@ -964,9 +964,22 @@ class TpuSequencerLambda(IPartitionLambda):
     # -- batched server-side summarization ---------------------------------
     def summarize_documents(self, chunk_chars: int = 10000
                             ) -> Dict[tuple, dict]:
-        """Chunked snapshots of every materialized channel in one batched
-        device extraction per capacity bucket."""
-        return self.merge.extract_all(chunk_chars)
+        """Chunked snapshots of every materialized channel — merge-tree
+        lanes (one batched device extraction per capacity bucket) AND LWW
+        lanes (map/cell/counter entries + counter accumulator)."""
+        out = self.merge.extract_all(chunk_chars)
+        for key in self.lww.where:
+            snap = self.lww.snapshot(key)
+            if snap is not None:
+                out[key] = {
+                    "header": {
+                        "kind": "lww",
+                        "sequenceNumber": snap["sequenceNumber"],
+                    },
+                    "entries": snap["entries"],
+                    "counter": snap["counter"],
+                }
+        return out
 
     def summarize_documents_async(self, on_done,
                                   chunk_chars: int = 10000):

@@ -393,12 +393,26 @@ class TestBatchedSummarization:
 
     def test_materialized_snapshots_commit_to_git(self):
         server, texts = self._server_with_text(n_docs=2)
+        # Mixed channel families in one document snapshot.
+        loader = Loader(LocalDocumentServiceFactory(server))
+        c = loader.resolve("doc0")
+        ds = c.runtime.get_datastore("default")
+        # doc0 also gets an LWW channel alongside its string.
+        m = ds.create_channel("meta", SharedMap.TYPE)
+        m.set("title", "hello")
         shas = server.write_materialized_snapshots()
         assert set(shas) == {"doc0", "doc1"}
         for doc, sha in shas.items():
             store = server.historian.store(server.tenant_id, doc)
             assert store.get(sha) is not None
             assert store.get_ref("materialized") == sha
+        # The committed tree carries the LWW channel blob too.
+        import json as _json
+        store = server.historian.store(server.tenant_id, "doc0")
+        tree = store.read_summary(shas["doc0"])
+        node = tree.entries["default"].entries["meta"]
+        payload = _json.loads(node.entries["lww"].content)
+        assert payload["entries"]["title"] == "hello"
 
     def test_async_extraction_overlaps_sequencing(self):
         """The summary snapshot reflects the state at DISPATCH time even
