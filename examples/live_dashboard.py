@@ -1,0 +1,76 @@
+"""Live dashboard: server-side reads without a client replica.
+
+The TPU serving path materializes every common channel type on device
+(server/tpu_sequencer.py), so a read-only surface — a metrics dashboard, a
+search indexer, a cold-start snapshot service — can read document state
+STRAIGHT FROM THE SEQUENCER without loading a container or replaying ops.
+The reference needs a headless client (server/headless-agent) for this;
+here it is one call against the partition lambda's device lanes.
+
+Run: python -m examples.live_dashboard
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from fluidframework_tpu.dds.counter import SharedCounter
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.server.local_server import TpuLocalServer
+
+
+def dashboard(server: TpuLocalServer, doc_ids) -> Dict[str, dict]:
+    """One server-side pass: no containers, no replicas, no op replay."""
+    seq = server.sequencer()
+    out = {}
+    for doc in doc_ids:
+        out[doc] = {
+            "body": seq.channel_text(doc, "default", "body"),
+            "meta": (seq.channel_snapshot(doc, "default", "meta")
+                     or {}).get("entries", {}),
+            "edits": (seq.channel_snapshot(doc, "default", "edits")
+                      or {}).get("counter", 0),
+            "seq": seq.document_seq(doc),
+        }
+    return out
+
+
+def main() -> None:
+    server = TpuLocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+
+    # A few live documents with concurrent editors.
+    for doc in ("notes", "spec"):
+        c = loader.create_detached(doc)
+        ds = c.runtime.create_datastore("default")
+        c.attach()
+        body = ds.create_channel("body", SharedString.TYPE)
+        meta = ds.create_channel("meta", SharedMap.TYPE)
+        edits = ds.create_channel("edits", SharedCounter.TYPE)
+        c2 = loader.resolve(doc)
+        ds2 = c2.runtime.get_datastore("default")
+        b2 = ds2.get_channel("body")
+
+        body.insert_text(0, f"The {doc} document.")
+        b2.insert_text(b2.get_length(), " More from a second editor.")
+        meta.set("owner", "alice")
+        ds2.get_channel("meta").set("status", "draft")
+        edits.increment(2)
+        ds2.get_channel("edits").increment(1)
+
+    board = dashboard(server, ("notes", "spec"))
+    for doc, row in board.items():
+        print(f"[{doc}] seq={row['seq']} edits={row['edits']} "
+              f"meta={row['meta']}")
+        print(f"    {row['body']}")
+
+    # The same lanes feed durable snapshots (cold-start load targets).
+    shas = server.write_materialized_snapshots()
+    print("materialized snapshot commits:", shas)
+
+
+if __name__ == "__main__":
+    main()

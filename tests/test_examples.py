@@ -110,3 +110,32 @@ class TestProjectTrackerExample:
         b.add_task("temp", "t", {"status": "open"})
         a.delete_project("temp")
         assert a.projects() == b.projects() == []
+
+
+class TestLiveDashboard:
+    def test_server_side_reads_match_clients(self):
+        from examples import live_dashboard
+        from fluidframework_tpu.server.local_server import TpuLocalServer
+        from fluidframework_tpu.loader.container import Loader
+        from fluidframework_tpu.dds.sequence import SharedString
+        from fluidframework_tpu.dds.map import SharedMap
+        from fluidframework_tpu.dds.counter import SharedCounter
+
+        server = TpuLocalServer()
+        loader = Loader(LocalDocumentServiceFactory(server))
+        c = loader.create_detached("notes")
+        ds = c.runtime.create_datastore("default")
+        c.attach()
+        body = ds.create_channel("body", SharedString.TYPE)
+        meta = ds.create_channel("meta", SharedMap.TYPE)
+        edits = ds.create_channel("edits", SharedCounter.TYPE)
+        body.insert_text(0, "hello dashboards")
+        meta.set("owner", "bob")
+        edits.increment(4)
+
+        board = live_dashboard.dashboard(server, ["notes"])
+        row = board["notes"]
+        assert row["body"] == body.get_text()
+        assert row["meta"] == {"owner": "bob"}
+        assert row["edits"] == 4
+        assert row["seq"] > 0
