@@ -5,8 +5,6 @@ hit. Convergence + server materialization must hold identically."""
 
 import random
 
-import pytest
-
 from fluidframework_tpu.dds.counter import SharedCounter
 from fluidframework_tpu.dds.map import SharedMap
 from fluidframework_tpu.dds.sequence import SharedString
@@ -71,6 +69,8 @@ class TestBatchedWindows:
             for doc, (texts, maps, counters) in channels.items():
                 assert texts[0].get_text() == texts[1].get_text(), doc
                 assert counters[0].value == counters[1].value, doc
+                assert {k: maps[0].get(k) for k in maps[0].keys()} == \
+                    {k: maps[1].get(k) for k in maps[1].keys()}, doc
                 state[doc] = (
                     texts[0].get_text(),
                     {k: maps[0].get(k) for k in sorted(maps[0].keys())},
