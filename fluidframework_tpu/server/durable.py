@@ -24,6 +24,7 @@ import sqlite3
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .log import MessageLog
 from .storage import GitBlob, GitCommit, GitStore, GitTree, Historian
 
 
@@ -131,6 +132,104 @@ class SqliteDatabaseManager:
 
     def close(self) -> None:
         self._conn.close()
+
+
+# ---------------------------------------------------------------------------
+# durable ordered log
+# ---------------------------------------------------------------------------
+
+class DurableMessageLog(MessageLog):
+    """MessageLog whose partitions and consumer offsets persist to disk —
+    the Kafka durability role for the broker deployment (a crashed broker
+    restarts with its full history and committed offsets; lambdas replay
+    only their uncheckpointed suffix).
+
+    Layout: <root>/<topic>/<partition>.log (length-prefixed pickle frames,
+    append-only — the rdkafka segment-file shape) + <root>/offsets.json
+    (atomic rewrite on commit). Pickle is fine here for the same reason it
+    is on the gRPC link: this is a trusted internal surface; untrusted
+    clients speak to alfred's JSON/JWT front door, never to the broker."""
+
+    def __init__(self, root: str, default_partitions: int = 1):
+        super().__init__(default_partitions)
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._files: dict = {}
+        self._io_lock = threading.Lock()
+        self._offsets_path = os.path.join(root, "offsets.json")
+        if os.path.exists(self._offsets_path):
+            with open(self._offsets_path) as f:
+                for key, off in json.load(f).items():
+                    group, topic, part = key.rsplit("|", 2)
+                    self.checkpoints[(group, topic, int(part))] = off
+        for topic_name in sorted(os.listdir(root)):
+            tdir = os.path.join(root, topic_name)
+            if not os.path.isdir(tdir):
+                continue
+            part_files = sorted(int(p[:-4]) for p in os.listdir(tdir)
+                                if p.endswith(".log"))
+            topic = self.topic(topic_name,
+                               partitions=max(len(part_files),
+                                              self.default_partitions))
+            for p in part_files:
+                self._replay_partition(topic.partitions[p],
+                                       os.path.join(tdir, f"{p}.log"))
+
+    def _replay_partition(self, partition, path: str) -> None:
+        import pickle
+        import struct
+        with open(path, "rb") as f:
+            while True:
+                header = f.read(4)
+                if len(header) < 4:
+                    break  # clean EOF or torn tail write: stop replay here
+                (size,) = struct.unpack("<I", header)
+                frame = f.read(size)
+                if len(frame) < size:
+                    break  # torn frame from a mid-write crash: drop it
+                key, value = pickle.loads(frame)
+                partition.append(key, value)  # already on disk: no re-write
+
+    def _file_for(self, topic: str, partition: int):
+        fkey = (topic, partition)
+        handle = self._files.get(fkey)
+        if handle is None:
+            tdir = os.path.join(self.root, topic)
+            os.makedirs(tdir, exist_ok=True)
+            handle = open(os.path.join(tdir, f"{partition}.log"), "ab")
+            self._files[fkey] = handle
+        return handle
+
+    def send(self, topic: str, key: str, value: Any):
+        import pickle
+        import struct
+        part = self.topic(topic).partition_for(key)
+        with self._io_lock:
+            # Disk first, memory second: a crash between the two replays
+            # the message from disk; the reverse order would lose it.
+            frame = pickle.dumps((key, value))
+            handle = self._file_for(topic, part.index)
+            handle.write(struct.pack("<I", len(frame)) + frame)
+            handle.flush()
+            os.fsync(handle.fileno())
+        return part.append(key, value)
+
+    def commit(self, group: str, topic: str, partition: int,
+               offset: int) -> None:
+        super().commit(group, topic, partition, offset)
+        with self._io_lock:
+            dump = {f"{g}|{t}|{p}": off
+                    for (g, t, p), off in self.checkpoints.items()}
+            tmp = self._offsets_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(dump, f)
+            os.replace(tmp, self._offsets_path)
+
+    def close(self) -> None:
+        with self._io_lock:
+            for handle in self._files.values():
+                handle.close()
+            self._files.clear()
 
 
 # ---------------------------------------------------------------------------

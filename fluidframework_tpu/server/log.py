@@ -62,7 +62,12 @@ class Topic:
         self.partitions = [Partition(name, i) for i in range(partitions)]
 
     def partition_for(self, key: str) -> Partition:
-        return self.partitions[hash(key) % len(self.partitions)]
+        # STABLE hash: Python's hash() is randomized per process, which
+        # would re-route a document to a different partition after a broker
+        # restart — breaking per-document ordering for durable logs.
+        import zlib
+        digest = zlib.crc32(key.encode("utf-8"))
+        return self.partitions[digest % len(self.partitions)]
 
 
 class MessageLog:

@@ -81,8 +81,16 @@ def run_broker(cfg: dict) -> None:
     from .log_service import LogServiceServer
 
     bcfg = cfg["broker"]
-    log = make_message_log(default_partitions=bcfg.get("partitions", 1),
-                           native=bcfg.get("native", False))
+    log_dir = cfg.get("storage", {}).get("log")
+    if log_dir:
+        # Durable broker: partitions + offsets persist to disk, a restart
+        # resumes with full history (server/durable.py DurableMessageLog).
+        from .durable import DurableMessageLog
+        log = DurableMessageLog(log_dir,
+                                default_partitions=bcfg.get("partitions", 1))
+    else:
+        log = make_message_log(default_partitions=bcfg.get("partitions", 1),
+                               native=bcfg.get("native", False))
     log.topic(RAW_TOPIC)
     log.topic(DELTAS_TOPIC)
     log.topic(NACKS_TOPIC)

@@ -123,3 +123,68 @@ class TestKillAndRestartE2E:
         store = hist2.store("local", "doc")
         assert store.get(handle) is not None
         assert store.get_ref("main") == handle
+
+
+class TestDurableMessageLog:
+    def test_messages_and_offsets_survive_restart(self, tmp_path):
+        from fluidframework_tpu.server.durable import DurableMessageLog
+
+        root = str(tmp_path / "log")
+        log = DurableMessageLog(root, default_partitions=2)
+        for i in range(10):
+            log.send("raw", f"k{i % 2}", {"n": i})
+        log.commit("deli", "raw", 0, 2)
+        log.close()
+
+        fresh = DurableMessageLog(root, default_partitions=2)
+        topic = fresh.topic("raw")
+        total = sum(p.end_offset for p in topic.partitions)
+        assert total == 10
+        assert fresh.committed("deli", "raw", 0) == 3
+        # Replayed payloads intact + appends continue at the right offset
+        # (partitioning is a stable key hash, so "k0" lands on the same
+        # partition in every process).
+        part = fresh.topic("raw").partition_for("k0")
+        first = part.read(0, 1)[0]
+        assert first.value["n"] in (0, 1)
+        before = part.end_offset
+        fresh.send("raw", "k0", {"n": 99})
+        assert part.end_offset == before + 1
+        fresh.close()
+
+    def test_torn_tail_write_is_dropped(self, tmp_path):
+        from fluidframework_tpu.server.durable import DurableMessageLog
+
+        root = str(tmp_path / "log")
+        log = DurableMessageLog(root)
+        log.send("raw", "k", {"n": 1})
+        log.send("raw", "k", {"n": 2})
+        log.close()
+        # Simulate a mid-write crash: truncate the last frame.
+        path = tmp_path / "log" / "raw" / "0.log"
+        data = path.read_bytes()
+        path.write_bytes(data[:-3])
+
+        fresh = DurableMessageLog(root)
+        part = fresh.topic("raw").partitions[0]
+        assert part.end_offset == 1  # torn frame dropped, prefix intact
+        assert part.read(0, 10)[0].value == {"n": 1}
+        fresh.close()
+
+    def test_reopened_log_feeds_consumers(self, tmp_path):
+        """A reopened durable log serves consumers from history: the broker
+        restart story (workers replay their uncheckpointed suffix)."""
+        from fluidframework_tpu.server.durable import DurableMessageLog
+
+        root = str(tmp_path / "log")
+        log = DurableMessageLog(root)
+        log.topic("rawdeltas")
+        for i in range(5):
+            log.send("rawdeltas", "doc", {"op": i})
+        log.commit("deli", "rawdeltas", 0, 1)  # processed through offset 1
+        log.close()
+
+        fresh = DurableMessageLog(root)
+        pending = fresh.poll("deli", "rawdeltas", 0)
+        assert [m.value["op"] for m in pending] == [2, 3, 4]
+        fresh.close()
