@@ -207,7 +207,19 @@ def run_worker(cfg: dict, stages: List[str]) -> None:
     signal.signal(signal.SIGTERM, on_signal)
     signal.signal(signal.SIGINT, on_signal)
     while not stop["flag"]:
-        if runner.pump() == 0:
+        try:
+            n = runner.pump()
+        except Exception as err:  # noqa: BLE001 — transport outage
+            # Broker unreachable (restarting, network blip): keep polling —
+            # the gRPC channel reconnects and the durable log serves our
+            # committed offsets when the broker is back. Lambda-level
+            # crashes are already handled inside the pump (restart +
+            # replay); only transport errors surface here.
+            print(f"worker: broker unavailable ({type(err).__name__}); "
+                  "retrying", flush=True)
+            time.sleep(min(poll_s * 20, 1.0))
+            continue
+        if n == 0:
             time.sleep(poll_s)
     close()
     print("worker: stopped", flush=True)
