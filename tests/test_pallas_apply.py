@@ -80,3 +80,20 @@ class TestFusedPallasInterpret:
         fused = pallas_apply.apply_ops_fused_pallas(
             *_batched_from_traces(8, 20, 64, seed), interpret=True)
         assert_states_equal(ref, fused)
+
+
+class TestFusedAnnotateRing:
+    def test_annotate_ring_overflow_matches(self):
+        """Annotate-heavy schedule at ring depth 1: overflow flags must
+        match the scan kernel exactly (correct-by-flag discipline)."""
+        rng = random.Random(77)
+        tuples = random_schedule(rng, n_clients=3, n_ops=60)
+        # Bias to annotates: rewrite half the removes into annotates.
+        builder = OpBuilder()
+        host_ops = build_kernel_ops(builder, tuples)
+        packed = pack_ops([host_ops])
+        ref = kernel.apply_ops_batched_keep(
+            make_state(256, 1, batch=1), packed)
+        fused = pallas_apply.apply_ops_fused_ref(
+            make_state(256, 1, batch=1), packed)
+        assert_states_equal(ref, fused)
