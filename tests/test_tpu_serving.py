@@ -243,9 +243,16 @@ class TestDeviceTicketingVsScalarDeli:
             else:
                 cid = rng.choice(clients[d])
                 cseq[(d, cid)] += 1
+                # refSeqs wander upward (advancing the MSN) and sometimes
+                # lag far behind (forcing real stale-refSeq nacks) — both
+                # paths must match the scalar deli exactly.
+                if rng.random() < 0.15:
+                    ref = 0  # likely below the advanced MSN -> nack
+                else:
+                    ref = rng.randrange(max(1, i))
                 streams.append((d, cid, DocumentMessage(
                     client_sequence_number=cseq[(d, cid)],
-                    reference_sequence_number=0,
+                    reference_sequence_number=ref,
                     type=MessageType.OPERATION,
                     contents={"n": i})))
         scalar = self._run_scalar(streams)
