@@ -623,3 +623,31 @@ class TestLwwMaterialization:
         snap = server.sequencer().channel_snapshot("doc", "default",
                                                    "clicks")
         assert snap["counter"] == 5
+
+
+class TestMarkersOnServingPath:
+    def test_markers_and_annotates_materialize(self):
+        """Markers (length-1 non-text segments) + annotates flow through
+        the device merge lanes and extraction like the clients' oracles."""
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        text = ds1.create_channel("text", SharedString.TYPE)
+        c2 = loader.resolve("doc")
+        t2 = c2.runtime.get_datastore("default").get_channel("text")
+
+        text.insert_text(0, "para one")
+        text.insert_marker(8, {"kind": "pg"})
+        t2.insert_text(t2.get_length(), "para two")
+        t2.annotate_range(0, 4, {"bold": True})
+        text.remove_text(2, 6)
+
+        assert text.get_text() == t2.get_text()
+        assert server.sequencer().channel_text(
+            "doc", "default", "text") == text.get_text()
+        # The marker survives in the chunked snapshot with its props.
+        snaps = server.sequencer().summarize_documents()
+        entries = [e for chunk in snaps[("doc", "default", "text")]["chunks"]
+                   for e in chunk]
+        markers = [e for e in entries if e.get("kind") == 1]
+        assert markers and markers[0].get("props", {}).get("kind") == "pg"
