@@ -1,0 +1,110 @@
+"""Presence: live cursors over the transient signal stream.
+
+Reference pattern: presence/cursor overlays ride ISignalMessage
+(protocol-definitions/src/protocol.ts ISignalMessage; alfred submitSignal,
+lambdas/src/alfred/index.ts:305-328) — transient broadcasts that bypass the
+sequencer entirely: no seq numbers, no persistence, no catch-up. A client
+that joins late sees only future cursor moves; one that disconnects
+vanishes from the roster (audience removeMember).
+
+The shared document itself (a SharedString note) rides the normal
+sequenced stream — this example shows both streams side by side, which is
+exactly how collaborative editors layer presence onto content.
+"""
+
+from __future__ import annotations
+
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.framework.container_factories import (
+    ContainerRuntimeFactoryWithDefaultDataStore)
+from fluidframework_tpu.framework.data_object import (DataObject,
+                                                      DataObjectFactory)
+from fluidframework_tpu.loader.code_loader import CodeLoader
+from fluidframework_tpu.loader.container import Loader
+
+CURSOR_SIGNAL = "cursor"
+
+
+class PresenceNote(DataObject):
+    """A shared note with live peer cursors.
+
+    Sequenced state: the note text (SharedString "note").
+    Transient state: self.cursors — {client_id: {"pos", "name"}} fed by
+    datastore-scoped signals; pruned when the audience drops a member.
+    """
+
+    def initializing_first_time(self):
+        note = self.store.create_channel("note", SharedString.TYPE)
+        self.root.set("note", note.handle.encode())
+
+    def has_initialized(self):
+        self.cursors = {}
+        self.store.on("signal", self._on_signal)
+        audience = self.store.audience
+        if audience is not None:
+            audience.on("removeMember",
+                        lambda cid: self.cursors.pop(cid, None))
+
+    @property
+    def note(self) -> SharedString:
+        return self.store.get_channel("note")
+
+    # -- presence ----------------------------------------------------------
+    def move_cursor(self, pos: int, name: str) -> None:
+        """Broadcast this client's cursor. Fire-and-forget: while
+        disconnected the signal is dropped, not queued."""
+        self.store.submit_signal(CURSOR_SIGNAL, {"pos": pos, "name": name})
+
+    def _on_signal(self, signal_type, content, local, client_id) -> None:
+        if signal_type != CURSOR_SIGNAL or local:
+            return
+        self.cursors[client_id] = dict(content)
+
+    def render(self) -> str:
+        peers = ", ".join(
+            f"{c['name']}@{c['pos']}" for c in self.cursors.values())
+        return f"note: {self.note.get_text()!r} | peers: {peers or '-'}"
+
+
+PresenceFactory = DataObjectFactory("presence-note", PresenceNote)
+
+CODE_DETAILS = {"package": "@examples/presence", "version": "^1.0.0"}
+
+
+def make_loader(service_factory) -> Loader:
+    code_loader = CodeLoader()
+    code_loader.register(
+        "@examples/presence", "1.0.0",
+        ContainerRuntimeFactoryWithDefaultDataStore(PresenceFactory))
+    return Loader(service_factory, code_loader=code_loader,
+                  code_details=CODE_DETAILS)
+
+
+def main() -> str:
+    """Two editors type into the note and wave cursors at each other."""
+    from fluidframework_tpu.loader.drivers.local import (
+        LocalDocumentServiceFactory)
+    from fluidframework_tpu.server.local_server import LocalServer
+
+    server = LocalServer()
+    creator = make_loader(LocalDocumentServiceFactory(server))
+    c0 = creator.create_detached("presence-doc")
+    c0.attach()
+    c1 = make_loader(LocalDocumentServiceFactory(server)) \
+        .resolve("presence-doc")
+    alice, bob = c0.request("/"), c1.request("/")
+
+    alice.note.insert_text(0, "hello")
+    alice.move_cursor(5, "alice")
+    bob.move_cursor(0, "bob")
+
+    assert bob.note.get_text() == "hello"
+    assert bob.cursors and next(iter(bob.cursors.values()))["name"] == "alice"
+    assert alice.cursors and next(iter(alice.cursors.values()))["name"] == "bob"
+    out = bob.render()
+    print(out)
+    return out
+
+
+if __name__ == "__main__":
+    main()

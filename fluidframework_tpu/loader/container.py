@@ -42,7 +42,8 @@ class Audience(TypedEventEmitter):
 
 class Container(TypedEventEmitter):
     """Events: "connected", "disconnected", "op", "summaryAck",
-    "summaryNack", "closed"."""
+    "summaryNack", "signal" (SignalMessage, local) — transient messages
+    outside the sequenced stream — and "closed"."""
 
     def __init__(self, document_id: str, service: IDocumentService,
                  registry: Optional[ChannelRegistry] = None,
@@ -55,6 +56,7 @@ class Container(TypedEventEmitter):
         self.protocol = ProtocolOpHandler()
         self.audience = Audience()
         self.runtime = ContainerRuntime(registry=registry)
+        self.runtime.audience = self.audience
         self.attached = False
         self.connected = False
         self.closed = False
@@ -169,6 +171,7 @@ class Container(TypedEventEmitter):
         self.delta_manager.on("disconnect", self._on_disconnect)
         self.delta_manager.on("nack", self._on_nack)
         self.delta_manager.on("connect", self._on_connect_identity)
+        self.delta_manager.on("signal", self._process_signal)
         self.delta_manager.connect()
 
     def _on_connect_identity(self, client_id: str) -> None:
@@ -179,6 +182,7 @@ class Container(TypedEventEmitter):
             self.runtime.attach(self.delta_manager.submit)
         else:
             self.runtime._submit_fn = self.delta_manager.submit
+        self.runtime._submit_signal_fn = self.delta_manager.submit_signal
 
     def _on_approve_proposal(self, seq, key, value, msn) -> None:
         if key == "code":
@@ -233,6 +237,19 @@ class Container(TypedEventEmitter):
             self.emit("summaryNack", message.contents)
         self.runtime.process(message)
         self.emit("op", message)
+
+    # -- signals (transient stream) ----------------------------------------
+    def submit_signal(self, signal_type: str, content: Any) -> None:
+        """Broadcast a container-scope transient signal (reference
+        container.ts submitSignal). Delivery is best-effort: unsequenced,
+        undurable, client-relative order only."""
+        self.runtime.submit_signal(signal_type, content)
+
+    def _process_signal(self, signal) -> None:
+        local = signal.client_id is not None and \
+            signal.client_id == self.delta_manager.client_id
+        self.runtime.process_signal(signal, local)
+        self.emit("signal", signal, local)
 
     def _process_bulk(self, tail) -> None:
         """Catch-up tail processing with the device fast path: maximal runs

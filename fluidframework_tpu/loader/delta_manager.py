@@ -21,7 +21,8 @@ from .drivers.base import IDocumentService
 
 class DeltaManager(TypedEventEmitter):
     """Events: "op" (each sequenced message, in order), "connect"
-    (client_id), "disconnect", "nack"."""
+    (client_id), "disconnect", "nack", "signal" (SignalMessage — transient,
+    NOT sequenced: no gap detection, no catch-up, no seq bookkeeping)."""
 
     def __init__(self, service: IDocumentService,
                  client_details: Optional[dict] = None,
@@ -75,6 +76,7 @@ class DeltaManager(TypedEventEmitter):
         self.client_sequence_number = 0
         self.connection.on("op", self._enqueue)
         self.connection.on("nack", lambda nack: self.emit("nack", nack))
+        self.connection.on("signal", self._on_signal)
         self.connection.on("disconnect", lambda: self.emit("disconnect"))
         # Identity must be known to listeners BEFORE the op pump runs: the
         # catch-up tail contains our own join op, and the container runtime
@@ -115,6 +117,22 @@ class DeltaManager(TypedEventEmitter):
             self._op_perf.on_submit(csn)
             self.connection.submit([msg])
             return csn
+
+    def _on_signal(self, sig) -> None:
+        # Same serialization contract as inbound ops: handlers run under
+        # the container lock, so a signal handler reading DDS state never
+        # races an application thread mutating it (op_lock docstring).
+        with self.lock:
+            self.emit("signal", sig)
+
+    def submit_signal(self, content) -> None:
+        """Send a transient signal (no clientSequenceNumber, no refSeq —
+        signals live outside the sequenced stream entirely; reference
+        deltaManager submitSignal passthrough)."""
+        with self.lock:
+            if self.connection is None:
+                raise ConnectionError("not connected")
+            self.connection.submit_signal(content)
 
     # -- inbound -----------------------------------------------------------
     def _enqueue(self, message: SequencedDocumentMessage) -> None:

@@ -36,12 +36,18 @@ class IDocumentDeltaStorageService:
 
 
 class IDocumentDeltaConnection:
-    """Live connection: .client_id, .submit(), events via .on('op'|'nack'|
-    'disconnect', fn), .close()."""
+    """Live connection: .client_id, .submit(), .submit_signal(), events via
+    .on('op'|'nack'|'signal'|'disconnect', fn), .close()."""
 
     client_id: str
 
     def submit(self, messages: List[DocumentMessage]) -> None:
+        raise NotImplementedError
+
+    def submit_signal(self, content: Any) -> None:
+        """Transient broadcast to the document's room; bypasses sequencing
+        (reference IDocumentDeltaConnection.submitSignal). Read-only
+        connections (replay/file) reject it."""
         raise NotImplementedError
 
     def on(self, event: str, fn: Callable) -> None:

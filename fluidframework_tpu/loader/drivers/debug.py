@@ -50,6 +50,7 @@ class DebugDeltaConnection(TypedEventEmitter, IDocumentDeltaConnection):
         controller._connections.append(self)
         inner.on("op", self._on_op)
         inner.on("nack", lambda n: self.emit("nack", n))
+        inner.on("signal", lambda s: self.emit("signal", s))
         inner.on("disconnect", lambda: self.emit("disconnect"))
 
     def _on_op(self, message) -> None:
@@ -70,6 +71,9 @@ class DebugDeltaConnection(TypedEventEmitter, IDocumentDeltaConnection):
 
     def submit(self, messages) -> None:
         self.inner.submit(messages)
+
+    def submit_signal(self, content) -> None:
+        self.inner.submit_signal(content)
 
     def close(self) -> None:
         self.inner.close()

@@ -80,6 +80,9 @@ class LocalDocumentDeltaConnection(IDocumentDeltaConnection):
     def submit(self, messages) -> None:
         self._conn.submit(messages)
 
+    def submit_signal(self, content) -> None:
+        self._conn.submit_signal(content)
+
     def on(self, event, fn) -> None:
         self._conn.on(event, fn)
 

@@ -20,7 +20,8 @@ import threading
 from typing import Any, Callable, Dict, List, Optional
 
 from ...core.events import TypedEventEmitter
-from ...protocol.messages import DocumentMessage, SequencedDocumentMessage
+from ...protocol.messages import (DocumentMessage,
+                                  SequencedDocumentMessage, SignalMessage)
 from ...protocol.summary import (summary_tree_from_dict,
                                  summary_tree_to_dict)
 from .base import (IDocumentDeltaConnection, IDocumentDeltaStorageService,
@@ -113,6 +114,9 @@ class DriverProxyHost:
             conn.on("nack", lambda n, cid=conn_id: self._push(
                 cid, {"event": "nack", "nack": n if isinstance(n, dict)
                       else {"content": str(n)}}))
+            conn.on("signal", lambda s, cid=conn_id: self._push(
+                cid, {"event": "signal", "clientId": s.client_id,
+                      "content": s.content}))
             conn.on("disconnect", lambda cid=conn_id: self._push(
                 cid, {"event": "disconnect"}))
             return {"connectionId": conn_id, "clientId": conn.client_id}
@@ -120,6 +124,10 @@ class DriverProxyHost:
             conn = self._connections[request["connectionId"]]
             conn.submit([_doc_message_from_json(d)
                          for d in request["messages"]])
+            return True
+        if op == "submitSignal":
+            conn = self._connections[request["connectionId"]]
+            conn.submit_signal(request.get("content"))
             return True
         if op == "closeConnection":
             conn = self._connections.pop(request["connectionId"], None)
@@ -186,12 +194,20 @@ class ProxyDeltaConnection(TypedEventEmitter, IDocumentDeltaConnection):
             self.emit("op", message_from_json(event["message"]))
         elif kind == "nack":
             self.emit("nack", event.get("nack"))
+        elif kind == "signal":
+            self.emit("signal", SignalMessage(
+                client_id=event.get("clientId"),
+                content=event.get("content")))
         elif kind == "disconnect":
             self.emit("disconnect")
 
     def submit(self, messages: List[DocumentMessage]) -> None:
         self._call({"op": "submit", "connectionId": self.connection_id,
                     "messages": [_doc_message_to_json(m) for m in messages]})
+
+    def submit_signal(self, content) -> None:
+        self._call({"op": "submitSignal", "connectionId": self.connection_id,
+                    "content": content})
 
     def close(self) -> None:
         self._call({"op": "closeConnection",

@@ -87,6 +87,9 @@ class ReplayDeltaConnection(TypedEventEmitter, IDocumentDeltaConnection):
     def submit(self, messages) -> None:
         raise PermissionError("replay documents are read-only")
 
+    def submit_signal(self, content) -> None:
+        raise PermissionError("replay documents are read-only")
+
     def push(self) -> None:
         while self._delivered < len(self.ops):
             msg = self.ops[self._delivered]

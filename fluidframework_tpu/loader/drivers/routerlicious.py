@@ -19,7 +19,8 @@ import urllib.request
 from typing import Callable, List, Optional
 
 from ...core.events import TypedEventEmitter
-from ...protocol.messages import DocumentMessage, SequencedDocumentMessage
+from ...protocol.messages import (DocumentMessage,
+                                  SequencedDocumentMessage, SignalMessage)
 from ...protocol.summary import (
     SummaryTree,
     summary_tree_from_dict,
@@ -149,8 +150,8 @@ class NetworkDeltaStorageService(IDocumentDeltaStorageService):
 class NetworkDocumentDeltaConnection(TypedEventEmitter,
                                      IDocumentDeltaConnection):
     """The live op stream over a websocket. A reader thread dispatches
-    server frames to "op"/"nack"/"disconnect" listeners — same event
-    surface as the local driver so DeltaManager is agnostic."""
+    server frames to "op"/"nack"/"signal"/"disconnect" listeners — same
+    event surface as the local driver so DeltaManager is agnostic."""
 
     def __init__(self, host: str, port: int, tenant_id: str,
                  document_id: str, token: Optional[str],
@@ -174,7 +175,9 @@ class NetworkDocumentDeltaConnection(TypedEventEmitter,
             htype = hello.get("type")
             if htype == "connected":
                 break
-            if htype in ("op", "nack"):
+            if htype in ("op", "nack", "signal"):
+                # Ops replay via catch-up; a pre-handshake signal is
+                # droppable by definition (transient, no ordering contract).
                 continue
             self._ws.close()
             raise ConnectionError(
@@ -197,6 +200,10 @@ class NetworkDocumentDeltaConnection(TypedEventEmitter,
                               sequenced_message_from_dict(frame["message"]))
                 elif ftype == "nack":
                     self.emit("nack", nack_from_dict(frame["nack"]))
+                elif ftype == "signal":
+                    self.emit("signal", SignalMessage(
+                        client_id=frame.get("clientId"),
+                        content=frame.get("content")))
         except (websocket.WebSocketClosed, OSError,
                 json.JSONDecodeError, ValueError, RestError):
             # RestError: an op handler's catch-up fetch failed (e.g. expired
@@ -215,6 +222,12 @@ class NetworkDocumentDeltaConnection(TypedEventEmitter,
             "type": "submitOp",
             "messages": [document_message_to_dict(m) for m in messages],
         }))
+
+    def submit_signal(self, content) -> None:
+        if self._closed:
+            raise ConnectionError("connection closed")
+        self._ws.send_text(json.dumps(
+            {"type": "submitSignal", "content": content}))
 
     def close(self) -> None:
         if self._closed:

@@ -43,6 +43,10 @@ class ContainerRuntime(TypedEventEmitter):
                  options: Optional[Dict[str, Any]] = None):
         super().__init__()
         self._submit_fn = submit_fn  # (type, contents) -> client_seq_number
+        self._submit_signal_fn: Optional[Callable[[Any], None]] = None
+        # Connected-client roster, set by the owning Container (reference
+        # IFluidDataStoreRuntime.getAudience()); None under mock runtimes.
+        self.audience = None
         self.registry = registry
         self.options = dict(options or {})
         self.max_op_size = int(self.options.get(
@@ -137,6 +141,40 @@ class ContainerRuntime(TypedEventEmitter):
             self._batch.append(contents)
             return
         self._send(contents)
+
+    # -- signals (transient, unsequenced) ----------------------------------
+    def submit_signal(self, signal_type: str, content: Any,
+                      address: Optional[str] = None) -> None:
+        """Broadcast a transient runtime signal (reference
+        containerRuntime.submitSignal). `address` targets a datastore's
+        signal listeners; None stays at container-runtime scope. Dropped
+        silently while disconnected — signals carry no delivery guarantee."""
+        if self._submit_signal_fn is None or not self.connected:
+            return
+        try:
+            self._submit_signal_fn({"address": address, "type": signal_type,
+                                    "content": content})
+        except (ConnectionError, OSError):
+            # The socket died before the disconnect event landed: honor the
+            # no-delivery-guarantee contract (drop, don't raise into app
+            # code); the connection's own teardown drives reconnect.
+            pass
+
+    def process_signal(self, signal, local: bool) -> None:
+        """Route an inbound SignalMessage (reference processSignal): an
+        addressed envelope goes to the datastore; unaddressed signals emit
+        at runtime scope as ("signal", type, content, local, client_id)."""
+        envelope = signal.content
+        if not isinstance(envelope, dict):
+            return  # malformed/foreign signal: ignore, never crash the pump
+        address = envelope.get("address")
+        if address is not None:
+            store = self.datastores.get(address)
+            if store is not None:
+                store.process_signal(envelope, local, signal.client_id)
+            return
+        self.emit("signal", envelope.get("type"), envelope.get("content"),
+                  local, signal.client_id)
 
     def order_sequentially(self, callback: Callable[[], None]) -> None:
         """Batch ops submitted inside callback into one turn (reference

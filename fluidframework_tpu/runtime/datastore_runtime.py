@@ -82,6 +82,12 @@ class DataStoreRuntime(TypedEventEmitter):
     def attached(self) -> bool:
         return self.container.attached
 
+    @property
+    def audience(self):
+        """The container's connected-client roster (reference
+        IFluidDataStoreRuntime.getAudience()); None when unattached."""
+        return self.container.audience
+
     # -- channels ----------------------------------------------------------
     def create_channel(self, object_id: str, type_name: str) -> SharedObject:
         channel = self.registry.create(type_name, object_id)
@@ -111,6 +117,18 @@ class DataStoreRuntime(TypedEventEmitter):
     def submit_channel_op(self, channel_id: str, contents: Any) -> None:
         self.container.submit_datastore_op(
             self.id, {"address": channel_id, "contents": contents})
+
+    # -- signals (reference dataStoreRuntime submitSignal/processSignal) ---
+    def submit_signal(self, signal_type: str, content: Any) -> None:
+        """Broadcast a transient signal scoped to this datastore; peers
+        receive it as a ("signal", type, content, local, client_id) event
+        on their DataStoreRuntime instance."""
+        self.container.submit_signal(signal_type, content, address=self.id)
+
+    def process_signal(self, envelope: dict, local: bool,
+                       client_id) -> None:
+        self.emit("signal", envelope.get("type"), envelope.get("content"),
+                  local, client_id)
 
     def process(self, envelope: dict, local: bool, seq: int, ref_seq: int,
                 client_ordinal: int, min_seq: int) -> None:

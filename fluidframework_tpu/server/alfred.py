@@ -29,8 +29,10 @@ The delta-stream wire protocol is JSON text frames:
   C->S {"type": "connect_document", "tenantId", "documentId", "token", "client"}
   S->C {"type": "connected", "clientId", "sequenceNumber"}
   C->S {"type": "submitOp", "messages": [DocumentMessage...]}
+  C->S {"type": "submitSignal", "content": ...}   (transient; no sequencing)
   S->C {"type": "op", "message": SequencedDocumentMessage}
   S->C {"type": "nack", "nack": Nack}
+  S->C {"type": "signal", "clientId", "content"}
 """
 
 from __future__ import annotations
@@ -444,8 +446,17 @@ class AlfredService:
                 except (OSError, WebSocketClosed):
                     pass
 
+            def on_signal(sig, ws=ws):
+                try:
+                    ws.send_text(json.dumps(
+                        {"type": "signal", "clientId": sig.client_id,
+                         "content": sig.content}))
+                except (OSError, WebSocketClosed):
+                    pass
+
             conn.on("op", on_op)
             conn.on("nack", on_nack)
+            conn.on("signal", on_signal)
             ws.send_text(json.dumps({
                 "type": "connected",
                 "clientId": conn.client_id,
@@ -457,6 +468,8 @@ class AlfredService:
                 if mtype == "submitOp":
                     conn.submit([document_message_from_dict(d)
                                  for d in msg.get("messages", [])])
+                elif mtype == "submitSignal":
+                    conn.submit_signal(msg.get("content"))
                 elif mtype == "disconnect":
                     break
                 else:

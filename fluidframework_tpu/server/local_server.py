@@ -29,6 +29,7 @@ from ..protocol.messages import (
     MessageType,
     Nack,
     SequencedDocumentMessage,
+    SignalMessage,
 )
 from .database import DatabaseManager
 from .lambdas import (
@@ -50,7 +51,8 @@ DELTAS_TOPIC = "deltas"
 
 class Connection(TypedEventEmitter):
     """A client's delta connection (the "websocket"). Events: "op"
-    (SequencedDocumentMessage), "nack" (Nack), "disconnect"."""
+    (SequencedDocumentMessage), "nack" (Nack), "signal" (SignalMessage),
+    "disconnect"."""
 
     def __init__(self, server: "LocalServer", tenant_id: str,
                  document_id: str, client_id: str, details: Optional[dict]):
@@ -68,6 +70,16 @@ class Connection(TypedEventEmitter):
         self.server._submit_boxcar(Boxcar(
             tenant_id=self.tenant_id, document_id=self.document_id,
             client_id=self.client_id, contents=list(messages)))
+
+    def submit_signal(self, content: Any) -> None:
+        """Transient broadcast: the signal fans out to every connection in
+        the document's room (submitter included) WITHOUT touching the
+        sequencer or the log — client-relative ordering only (reference
+        alfred submitSignal, lambdas/src/alfred/index.ts:305-328)."""
+        if not self.connected:
+            raise ConnectionError("connection closed")
+        self.server._broadcast_signal(self.document_id, SignalMessage(
+            client_id=self.client_id, content=content))
 
     def disconnect(self) -> None:
         if not self.connected:
@@ -113,6 +125,10 @@ class LocalServer:
         # Broadcaster room membership lives here (not in the lambda) so it
         # survives lambda crash-restarts; the lambda reads it by reference.
         self._rooms: Dict[str, List] = {}
+        # Signal fan-out rooms: transient messages never enter the log, so
+        # they get their own listener lists (reference: socket.io room emit
+        # straight from alfred, no Kafka hop).
+        self._signal_rooms: Dict[str, List] = {}
         self._client_counter = itertools.count(1)
         self._pump_lock = threading.RLock()
         # Optional pre-pump gate (multi-node fencing): called before the
@@ -182,6 +198,11 @@ class LocalServer:
         if self.auto_pump:
             self.pump()
 
+    def _broadcast_signal(self, document_id: str,
+                          signal: SignalMessage) -> None:
+        for listener in list(self._signal_rooms.get(document_id, [])):
+            listener(signal)
+
     # -- the Alfred surface (connect/disconnect, catch-up, storage) --------
     def connect(self, document_id: str,
                 details: Optional[dict] = None) -> Connection:
@@ -198,6 +219,10 @@ class LocalServer:
         conn._room_listener = \
             lambda msg, c=conn: c.connected and c.emit("op", msg)
         self._rooms.setdefault(document_id, []).append(conn._room_listener)
+        conn._signal_listener = \
+            lambda sig, c=conn: c.connected and c.emit("signal", sig)
+        self._signal_rooms.setdefault(document_id, []).append(
+            conn._signal_listener)
         # Join op through the sequencer (alfred connect_document).
         import json
         self._send_system(document_id, DocumentMessage(
@@ -217,6 +242,9 @@ class LocalServer:
         listeners = self._rooms.get(conn.document_id, [])
         if conn._room_listener in listeners:
             listeners.remove(conn._room_listener)
+        sig_listeners = self._signal_rooms.get(conn.document_id, [])
+        if conn._signal_listener in sig_listeners:
+            sig_listeners.remove(conn._signal_listener)
         self._send_system(conn.document_id, DocumentMessage(
             client_sequence_number=0, reference_sequence_number=-1,
             type=MessageType.CLIENT_LEAVE,

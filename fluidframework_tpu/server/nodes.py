@@ -133,6 +133,7 @@ class ProxyConnection(TypedEventEmitter):
         self.client_id = remote.client_id
         remote.on("op", lambda msg: self.emit("op", msg))
         remote.on("nack", lambda nack: self.emit("nack", nack))
+        remote.on("signal", lambda sig: self.emit("signal", sig))
         remote.on("disconnect", lambda: self.emit("disconnect"))
 
     @property
@@ -141,6 +142,9 @@ class ProxyConnection(TypedEventEmitter):
 
     def submit(self, messages) -> None:
         self.remote.submit(messages)
+
+    def submit_signal(self, content) -> None:
+        self.remote.submit_signal(content)
 
     def disconnect(self) -> None:
         self.remote.disconnect()

@@ -1,0 +1,251 @@
+"""Signals: the transient message stream (reference ISignalMessage,
+protocol-definitions/src/protocol.ts; alfred submitSignal,
+lambdas/src/alfred/index.ts:305-328; containerRuntime processSignal).
+
+Signals bypass the sequencer entirely: no sequence numbers, no log append,
+no persistence, no catch-up. These tests pin that down at every layer —
+LocalServer room fan-out, container/runtime/datastore routing, the network
+path over real websockets, the multi-node proxy path, and the TPU serving
+path (whose sequencer must never see a signal)."""
+
+import time
+
+import pytest
+
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.server.local_server import LocalServer, TpuLocalServer
+
+
+def wait_until(predicate, timeout=10.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def make_doc(server, doc_id="sig-doc"):
+    loader = Loader(LocalDocumentServiceFactory(server))
+    container = loader.create_detached(doc_id)
+    ds = container.runtime.create_datastore("default")
+    return loader, container, ds
+
+
+class TestServerFanout:
+    def test_signal_reaches_all_room_members_including_sender(self):
+        server = LocalServer()
+        conns = [server.connect("doc") for _ in range(3)]
+        other = server.connect("other-doc")
+        seen = {i: [] for i in range(3)}
+        other_seen = []
+        for i, conn in enumerate(conns):
+            conn.on("signal", lambda sig, i=i: seen[i].append(sig))
+        other.on("signal", other_seen.append)
+
+        conns[0].submit_signal({"hello": 1})
+        assert all(len(seen[i]) == 1 for i in range(3))
+        assert seen[1][0].client_id == conns[0].client_id
+        assert seen[1][0].content == {"hello": 1}
+        # Room isolation: the other document hears nothing.
+        assert other_seen == []
+
+    def test_signals_never_touch_the_sequencer_or_log(self):
+        server = LocalServer()
+        conn = server.connect("doc")
+        seq_before = server.sequence_number("doc")
+        deltas_before = server.get_deltas("doc")  # the join op only
+        for _ in range(5):
+            conn.submit_signal({"x": 1})
+        assert server.sequence_number("doc") == seq_before
+        assert server.get_deltas("doc") == deltas_before
+
+    def test_disconnected_member_stops_receiving(self):
+        server = LocalServer()
+        a, b = server.connect("doc"), server.connect("doc")
+        got = []
+        b.on("signal", got.append)
+        b.disconnect()
+        a.submit_signal("after-leave")
+        assert got == []
+
+    def test_submit_signal_on_closed_connection_raises(self):
+        server = LocalServer()
+        conn = server.connect("doc")
+        conn.disconnect()
+        with pytest.raises(ConnectionError):
+            conn.submit_signal("nope")
+
+
+class TestContainerRouting:
+    def test_container_scope_signal_round_trip(self):
+        server = LocalServer()
+        loader, c1, _ = make_doc(server)
+        c1.attach()
+        c2 = loader.resolve("sig-doc")
+
+        got_c2, got_c1 = [], []
+        c2.runtime.on("signal", lambda t, c, local, cid:
+                      got_c2.append((t, c, local, cid)))
+        c1.runtime.on("signal", lambda t, c, local, cid:
+                      got_c1.append((t, c, local, cid)))
+        c1.submit_signal("ping", {"n": 7})
+
+        assert got_c2 == [("ping", {"n": 7}, False,
+                           c1.delta_manager.client_id)]
+        # The submitter receives its own signal back, flagged local.
+        assert got_c1 == [("ping", {"n": 7}, True,
+                           c1.delta_manager.client_id)]
+
+    def test_datastore_scope_signal_routes_to_that_store_only(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.runtime.create_datastore("second")
+        c1.attach()
+        c2 = loader.resolve("sig-doc")
+
+        default_got, second_got, runtime_got = [], [], []
+        c2.runtime.get_datastore("default").on(
+            "signal", lambda t, c, local, cid: default_got.append((t, c)))
+        c2.runtime.get_datastore("second").on(
+            "signal", lambda t, c, local, cid: second_got.append((t, c)))
+        c2.runtime.on("signal",
+                      lambda t, c, local, cid: runtime_got.append(t))
+
+        ds1.submit_signal("cursor", {"pos": 3})
+        assert default_got == [("cursor", {"pos": 3})]
+        assert second_got == []
+        assert runtime_got == []  # addressed signals skip runtime scope
+
+    def test_signal_to_unknown_store_is_dropped(self):
+        server = LocalServer()
+        loader, c1, _ = make_doc(server)
+        c1.attach()
+        c2 = loader.resolve("sig-doc")
+        # c1 signals a store c2 never realized: must not raise on c2's pump.
+        c1.runtime.submit_signal("t", {"v": 1}, address="ghost-store")
+        # c2 is still alive and processing sequenced ops.
+        text = c1.runtime.get_datastore("default").create_channel(
+            "text", SharedString.TYPE)
+        text.insert_text(0, "ok")
+        t2 = c2.runtime.get_datastore("default").get_channel("text")
+        assert t2.get_text() == "ok"
+
+    def test_signals_dropped_while_disconnected(self):
+        server = LocalServer()
+        loader, c1, _ = make_doc(server)
+        c1.attach()
+        c2 = loader.resolve("sig-doc")
+        got = []
+        c2.runtime.on("signal", lambda *a: got.append(a))
+        c1._on_disconnect()  # runtime goes disconnected
+        c1.submit_signal("lost", None)  # silently dropped, no raise
+        assert got == []
+
+    def test_signals_flow_after_reconnect(self):
+        server = LocalServer()
+        loader, c1, _ = make_doc(server)
+        c1.attach()
+        c2 = loader.resolve("sig-doc")
+        c1.reconnect()
+        got = []
+        c2.runtime.on("signal", lambda t, c, local, cid: got.append(t))
+        c1.submit_signal("back", None)
+        assert got == ["back"]
+
+    def test_malformed_foreign_signal_ignored(self):
+        server = LocalServer()
+        loader, c1, _ = make_doc(server)
+        c1.attach()
+        # A non-envelope signal from a raw connection (not a Container).
+        raw = server.connect("sig-doc")
+        raw.submit_signal("just-a-string")
+        raw.submit_signal(["a", "list"])
+        # Container survives and still processes ops.
+        text = c1.runtime.get_datastore("default").create_channel(
+            "text", SharedString.TYPE)
+        text.insert_text(0, "alive")
+        assert text.get_text() == "alive"
+
+
+class TestTpuServingPath:
+    def test_signals_over_tpu_sequencer_server(self):
+        """Signals fan out identically when the sequencing stage is the
+        device pipeline — and the device sequencer never sees them."""
+        server = TpuLocalServer()
+        loader, c1, _ = make_doc(server)
+        c1.attach()
+        c2 = loader.resolve("sig-doc")
+        got = []
+        c2.runtime.on("signal", lambda t, c, local, cid: got.append((t, c)))
+        seq_before = server.sequence_number("sig-doc")
+        c1.submit_signal("presence", {"user": "a"})
+        assert got == [("presence", {"user": "a"})]
+        assert server.sequence_number("sig-doc") == seq_before
+
+
+class TestMultiNodeProxy:
+    def test_signal_crosses_proxy_connection(self):
+        from fluidframework_tpu.loader.drivers.cluster import (
+            ClusterDocumentServiceFactory)
+        from fluidframework_tpu.server.nodes import Cluster
+
+        cluster = Cluster()
+        owner = cluster.create_node("n1")
+        entry = cluster.create_node("n2")
+        # Owner claims the document; the entry node proxies to it.
+        owner_loader = Loader(ClusterDocumentServiceFactory(cluster, owner))
+        c1 = owner_loader.create_detached("prox-doc")
+        c1.runtime.create_datastore("default")
+        c1.attach()
+        proxy_loader = Loader(ClusterDocumentServiceFactory(cluster, entry))
+        c2 = proxy_loader.resolve("prox-doc")
+
+        got_c2, got_c1 = [], []
+        c2.runtime.on("signal", lambda t, c, local, cid: got_c2.append(t))
+        c1.runtime.on("signal", lambda t, c, local, cid: got_c1.append(t))
+        # Both directions: through the proxy and to the proxy.
+        c2.submit_signal("from-proxy-client", None)
+        c1.submit_signal("from-owner-client", None)
+        assert got_c1 == ["from-proxy-client", "from-owner-client"]
+        assert got_c2 == ["from-proxy-client", "from-owner-client"]
+
+
+class TestNetworkSignals:
+    @pytest.fixture(scope="class")
+    def server(self):
+        from fluidframework_tpu.server.tinylicious import Tinylicious
+        with Tinylicious() as t:
+            yield t
+
+    def test_signal_over_real_websockets(self, server):
+        from fluidframework_tpu.loader.drivers.routerlicious import (
+            NetworkDocumentServiceFactory)
+        from fluidframework_tpu.server.tinylicious import DEFAULT_TENANT
+
+        factory = NetworkDocumentServiceFactory(server.url, DEFAULT_TENANT)
+        loader = Loader(factory)
+        c1 = loader.create_detached("net-sig")
+        c1.runtime.create_datastore("default")
+        with c1.op_lock:
+            c1.attach()
+        c2 = loader.resolve("net-sig")
+
+        got = []
+        c2.runtime.on("signal", lambda t, c, local, cid:
+                      got.append((t, c, local)))
+        with c1.op_lock:
+            c1.submit_signal("wave", {"emoji": "hi"})
+        assert wait_until(lambda: len(got) == 1)
+        assert got[0] == ("wave", {"emoji": "hi"}, False)
+        c1.close()
+        c2.close()
+
+
+class TestPresenceExample:
+    def test_presence_example_runs(self):
+        from examples.presence import main
+        out = main()
+        assert "alice@5" in out
