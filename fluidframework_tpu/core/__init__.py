@@ -3,5 +3,5 @@
 from .collections import Heap, RangeTracker, RedBlackTree, IntervalTree
 from .config import ConfigProvider
 from .errors import BulkApplyUnsupported
-from .events import TypedEventEmitter
+from .events import Deferred, TypedEventEmitter
 from .trace import Trace

@@ -1,8 +1,10 @@
-"""Minimal typed event emitter (reference common-utils TypedEventEmitter)."""
+"""Minimal typed event emitter + Deferred (reference common-utils
+TypedEventEmitter, Deferred)."""
 
 from __future__ import annotations
 
-from typing import Callable, Dict, List
+import threading
+from typing import Any, Callable, Dict, List, Optional
 
 
 class TypedEventEmitter:
@@ -30,3 +32,37 @@ class TypedEventEmitter:
 
     def listener_count(self, event: str) -> int:
         return len(self._listeners.get(event, []))
+
+
+class Deferred:
+    """A one-shot promise usable across threads (reference common-utils
+    Deferred): resolve/reject once; result() blocks until settled. Over
+    in-process drivers settlement is usually synchronous, so result()
+    returns immediately; over network drivers the resolver runs on the
+    connection's reader thread."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+    @property
+    def settled(self) -> bool:
+        return self._event.is_set()
+
+    def resolve(self, value: Any = None) -> None:
+        if not self._event.is_set():
+            self._value = value
+            self._event.set()
+
+    def reject(self, error: BaseException) -> None:
+        if not self._event.is_set():
+            self._error = error
+            self._event.set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError("deferred not settled within timeout")
+        if self._error is not None:
+            raise self._error
+        return self._value

@@ -40,6 +40,17 @@ class SubDirectory:
     def has(self, key: str) -> bool:
         return key in self.kernel.data
 
+    def wait(self, key: str, timeout: Optional[float] = None) -> Any:
+        """Block until `key` exists in THIS subdirectory and return its
+        value (reference IDirectory.wait). Resolution rules match
+        SharedMap.wait; events are watched on the owning SharedDirectory
+        (kernel checks keep this path-scoped)."""
+        from .map import wait_for
+        return wait_for(
+            self.directory, "valueChanged",
+            lambda: (key in self.kernel.data, self.kernel.data.get(key)),
+            timeout)
+
     def keys(self) -> Iterator[str]:
         return iter(list(self.kernel.data.keys()))
 
@@ -111,6 +122,9 @@ class SharedDirectory(SharedObject):
 
     def has(self, key):
         return self.root.has(key)
+
+    def wait(self, key, timeout=None):
+        return self.root.wait(key, timeout)
 
     def keys(self):
         return self.root.keys()

@@ -25,6 +25,32 @@ from .shared_object import (
 )
 
 
+def wait_for(emitter, event: str, check, timeout: Optional[float]):
+    """Shared wait machinery for SharedMap.wait / SubDirectory.wait:
+    `check()` returns (present, value). Check, subscribe, RE-check (the
+    value may land on a reader thread between the first check and the
+    listener registration), then block on a Deferred the listener
+    resolves; the listener is always removed afterwards."""
+    from ..core.events import Deferred
+    present, value = check()
+    if present:
+        return value
+    deferred = Deferred()
+
+    def on_event(*args):
+        p, v = check()
+        if p:
+            deferred.resolve(v)
+    listener = emitter.on(event, on_event)
+    try:
+        present, value = check()
+        if present:
+            return value
+        return deferred.result(timeout)
+    finally:
+        emitter.off(event, listener)
+
+
 class _Missing:
     """Sentinel for 'key absent' in valueChanged previous-value payloads
     (distinguishes delete-on-undo from set-None-on-undo)."""
@@ -182,6 +208,17 @@ class SharedMap(SharedObject):
 
     def has(self, key: str) -> bool:
         return key in self.kernel.data
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> Any:
+        """Block until `key` exists and return its value (reference
+        ISharedMap.wait, map.ts). Returns immediately if present. Over
+        in-process drivers a peer's set lands synchronously, so by the time
+        the peer's call returns this resolves without blocking; over
+        network drivers the resolver runs on the reader thread."""
+        return wait_for(
+            self, "valueChanged",
+            lambda: (key in self.kernel.data, self.kernel.data.get(key)),
+            timeout)
 
     def keys(self) -> Iterator[str]:
         return iter(list(self.kernel.data.keys()))

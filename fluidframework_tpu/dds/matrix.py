@@ -133,6 +133,57 @@ class PermutationVector:
             acc += vlen
         raise IndexError(index)
 
+    def changes_for_seq(self, seq: int) -> List[Tuple[int, int]]:
+        """Visible-position deltas applied by the op sequenced at `seq`:
+        [(pos, +count)] for inserts, [(pos, -count)] for removes, in
+        ascending position order. This is how remote axis ops resolve to
+        consumer notifications (reference permutationvector.ts onDelta →
+        rows/colsChanged positions) — the flat segment walk replaces the
+        reference's tracked-segment-group machinery."""
+        tree = self.client.tree
+        out: List[Tuple[int, int]] = []
+        acc = 0
+        for seg in tree.segments:
+            if seg.rem_seq == seq:
+                # Removed by this op. If our own pending remove was
+                # overwritten by it (rem_overlap carries our client id),
+                # the segment was already hidden locally — no view change.
+                if self.client.client_id in seg.rem_overlap:
+                    continue
+                # Position (for the notification) is where it used to sit.
+                if out and out[-1][1] < 0 and out[-1][0] == acc:
+                    out[-1] = (acc, out[-1][1] - seg.length)
+                else:
+                    out.append((acc, -seg.length))
+                continue
+            vlen = tree.visible_length(seg, tree.current_seq,
+                                       self.client.client_id)
+            if seg.ins_seq == seq and vlen > 0:
+                if out and out[-1][1] > 0 and out[-1][0] + out[-1][1] == acc:
+                    out[-1] = (out[-1][0], out[-1][1] + vlen)
+                else:
+                    out.append((acc, vlen))
+            acc += vlen
+        return out
+
+    def index_of_id(self, key: str) -> Optional[int]:
+        """Current visible index of a stable id (None if removed).
+        O(#segments): runs carry contiguous id spans, so one range check
+        per segment replaces materializing every id."""
+        a, b, c = (int(x) for x in key.split("."))
+        tree = self.client.tree
+        acc = 0
+        for seg in tree.segments:
+            vlen = tree.visible_length(seg, tree.current_seq,
+                                       self.client.client_id)
+            if vlen == 0:
+                continue
+            run = seg.text
+            if run.base == (a, b) and run.start <= c < run.start + vlen:
+                return acc + (c - run.start)
+            acc += vlen
+        return None
+
     def snapshot(self) -> dict:
         snap = self.client.snapshot()
         for entry in snap["segments"]:
@@ -149,6 +200,14 @@ class PermutationVector:
 
 
 class SharedMatrix(SharedObject):
+    """The matrix DDS + the IMatrixProducer surface: views register via
+    open_matrix(consumer) and receive rows_changed / cols_changed /
+    cells_changed callbacks for local AND remote changes with resolved
+    visible positions (reference matrix.ts IMatrixProducer/IMatrixConsumer
+    from @tiny-calc/nano; handle recycling is unnecessary here — stable
+    (nonce, counter, offset) ids never get reused, so there is no free
+    list to manage)."""
+
     TYPE = "https://graph.microsoft.com/types/sharedmatrix"
 
     def __init__(self, object_id: str, runtime=None):
@@ -158,6 +217,26 @@ class SharedMatrix(SharedObject):
         # cell key "(rowid,colid)" -> value; pending LWW shadow counts
         self.cells: Dict[str, Any] = {}
         self._pending_cells: Dict[str, int] = {}
+        self._consumers: List[Any] = []
+
+    # -- IMatrixProducer ----------------------------------------------------
+    def open_matrix(self, consumer: Any) -> "SharedMatrix":
+        """Register a change consumer (reference IMatrixProducer.
+        openMatrix). Consumers implement any of rows_changed(pos, delta),
+        cols_changed(pos, delta), cells_changed(row, col, value)."""
+        if consumer not in self._consumers:
+            self._consumers.append(consumer)
+        return self
+
+    def close_matrix(self, consumer: Any) -> None:
+        if consumer in self._consumers:
+            self._consumers.remove(consumer)
+
+    def _notify(self, method: str, *args) -> None:
+        for consumer in list(self._consumers):
+            fn = getattr(consumer, method, None)
+            if fn is not None:
+                fn(*args)
 
     # -- lifecycle ---------------------------------------------------------
     def adopt_client_ordinal(self, ordinal: int) -> None:
@@ -184,11 +263,13 @@ class SharedMatrix(SharedObject):
         op = self.rows.insert_local(pos, count)
         self.submit_local_message({"target": "rows", "op": op})
         self.emit("rowsChanged", pos, count, True, None)
+        self._notify("rows_changed", pos, count)
 
     def insert_cols(self, pos: int, count: int) -> None:
         op = self.cols.insert_local(pos, count)
         self.submit_local_message({"target": "cols", "op": op})
         self.emit("colsChanged", pos, count, True, None)
+        self._notify("cols_changed", pos, count)
 
     def _capture_axis(self, axis: str, pos: int, count: int) -> dict:
         """Cell contents of the rows/cols about to be removed, keyed by the
@@ -214,12 +295,14 @@ class SharedMatrix(SharedObject):
         op = self.rows.remove_local(pos, count)
         self.submit_local_message({"target": "rows", "op": op})
         self.emit("rowsChanged", pos, -count, True, captured)
+        self._notify("rows_changed", pos, -count)
 
     def remove_cols(self, pos: int, count: int) -> None:
         captured = self._capture_axis("cols", pos, count)
         op = self.cols.remove_local(pos, count)
         self.submit_local_message({"target": "cols", "op": op})
         self.emit("colsChanged", pos, -count, True, captured)
+        self._notify("cols_changed", pos, -count)
 
     # -- undo support -------------------------------------------------------
     def restore_rows(self, pos: int, captured: dict) -> None:
@@ -257,6 +340,7 @@ class SharedMatrix(SharedObject):
         self.submit_local_message(
             {"target": "cell", "key": key, "value": value})
         self.emit("cellChanged", row, col, value, True, previous)
+        self._notify("cells_changed", row, col, value)
 
     def set_cells(self, row_start: int, col_start: int, col_count: int,
                   values) -> None:
@@ -295,14 +379,30 @@ class SharedMatrix(SharedObject):
                 return  # pending local write shadows (reference set-vs-set)
             previous = self.cells.get(key)
             self.cells[key] = contents["value"]
-            self.emit("cellChanged", None, None, contents["value"], False,
+            if not self._consumers and \
+                    self.listener_count("cellChanged") == 0:
+                return  # nobody to notify: skip index resolution entirely
+            # Resolve the stable cell id to current visible indices (None
+            # when the row/col has since been removed — the write still
+            # lands by identity and reappears if the axis is restored).
+            row_key, _, col_key = key.partition("|")
+            row = self.rows.index_of_id(row_key)
+            col = self.cols.index_of_id(col_key)
+            self.emit("cellChanged", row, col, contents["value"], False,
                       previous)
+            if row is not None and col is not None:
+                self._notify("cells_changed", row, col, contents["value"])
             return
         vector = self.rows if target == "rows" else self.cols
         if local:
             vector.ack(seq)
         else:
             vector.apply_remote(contents["op"], seq, ref_seq, client_ordinal)
+            event = "rowsChanged" if target == "rows" else "colsChanged"
+            method = "rows_changed" if target == "rows" else "cols_changed"
+            for pos, delta in vector.changes_for_seq(seq):
+                self.emit(event, pos, delta, False, None)
+                self._notify(method, pos, delta)
 
     def resubmit_pending(self) -> List[Any]:
         ops = []

@@ -226,8 +226,14 @@ class NetworkDocumentDeltaConnection(TypedEventEmitter,
     def submit_signal(self, content) -> None:
         if self._closed:
             raise ConnectionError("connection closed")
-        self._ws.send_text(json.dumps(
-            {"type": "submitSignal", "content": content}))
+        try:
+            self._ws.send_text(json.dumps(
+                {"type": "submitSignal", "content": content}))
+        except websocket.WebSocketClosed as exc:
+            # The reader thread flips the websocket's flag before ours:
+            # normalize to the ConnectionError the runtime's drop-don't-
+            # raise contract catches.
+            raise ConnectionError(str(exc)) from exc
 
     def close(self) -> None:
         if self._closed:

@@ -1,0 +1,223 @@
+"""DDS API-depth tests: map/directory wait(), matrix producer/consumer
+change notifications with resolved positions for remote ops (reference
+map.ts wait, matrix.ts IMatrixProducer/IMatrixConsumer)."""
+
+import threading
+import time
+
+import pytest
+
+from fluidframework_tpu.core.events import Deferred
+from fluidframework_tpu.dds.directory import SharedDirectory
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.matrix import SharedMatrix
+from fluidframework_tpu.testing import MockSequencedEnvironment
+
+
+def pair(env, dds_cls, object_id="obj"):
+    r1 = env.create_runtime()
+    r2 = env.create_runtime()
+    ds1 = r1.create_datastore("ds")
+    ds2 = r2.create_datastore("ds")
+    a = ds1.create_channel(object_id, dds_cls.TYPE)
+    b = ds2.create_channel(object_id, dds_cls.TYPE)
+    env.process_all()
+    return r1, r2, a, b
+
+
+class TestDeferred:
+    def test_resolve_and_result(self):
+        d = Deferred()
+        assert not d.settled
+        d.resolve(42)
+        assert d.settled
+        assert d.result(0) == 42
+
+    def test_reject_raises(self):
+        d = Deferred()
+        d.reject(ValueError("nope"))
+        with pytest.raises(ValueError):
+            d.result(0)
+
+    def test_timeout(self):
+        with pytest.raises(TimeoutError):
+            Deferred().result(0.01)
+
+    def test_settles_only_once(self):
+        d = Deferred()
+        d.resolve(1)
+        d.resolve(2)
+        d.reject(RuntimeError("late"))
+        assert d.result(0) == 1
+
+
+class TestMapWait:
+    def test_wait_returns_immediately_when_present(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMap)
+        a.set("k", "v")
+        env.process_all()
+        assert b.wait("k", timeout=0) == "v"
+
+    def test_wait_resolves_on_remote_set_from_another_thread(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMap)
+
+        def setter():
+            time.sleep(0.05)
+            a.set("slow", "arrived")
+            env.process_all()
+        t = threading.Thread(target=setter)
+        t.start()
+        try:
+            assert b.wait("slow", timeout=5) == "arrived"
+        finally:
+            t.join()
+
+    def test_wait_times_out(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMap)
+        with pytest.raises(TimeoutError):
+            b.wait("never", timeout=0.02)
+
+    def test_wait_listener_removed_after_resolution(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMap)
+        before = b.listener_count("valueChanged")
+        a.set("k", 1)
+        env.process_all()
+        b.wait("k", timeout=0)
+        assert b.listener_count("valueChanged") == before
+
+
+class TestDirectoryWait:
+    def test_root_wait(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedDirectory)
+        a.set("k", 9)
+        env.process_all()
+        assert b.wait("k", timeout=0) == 9
+
+    def test_subdirectory_wait_is_path_scoped(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedDirectory)
+        a.create_sub_directory("inner")
+        env.process_all()
+        inner_b = b.get_sub_directory("inner")
+        # A root-level set of the same key must NOT satisfy the subdir wait.
+        a.set("k", "root-value")
+        env.process_all()
+        with pytest.raises(TimeoutError):
+            inner_b.wait("k", timeout=0.02)
+        a.get_sub_directory("inner").set("k", "inner-value")
+        env.process_all()
+        assert inner_b.wait("k", timeout=0) == "inner-value"
+
+
+class Recorder:
+    """An IMatrixConsumer: records every notification."""
+
+    def __init__(self):
+        self.rows = []
+        self.cols = []
+        self.cells = []
+
+    def rows_changed(self, pos, delta):
+        self.rows.append((pos, delta))
+
+    def cols_changed(self, pos, delta):
+        self.cols.append((pos, delta))
+
+    def cells_changed(self, row, col, value):
+        self.cells.append((row, col, value))
+
+
+class TestMatrixConsumers:
+    def test_local_notifications(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMatrix)
+        rec = Recorder()
+        a.open_matrix(rec)
+        a.insert_rows(0, 2)
+        a.insert_cols(0, 3)
+        a.set_cell(1, 2, "x")
+        a.remove_rows(0, 1)
+        assert rec.rows == [(0, 2), (0, -1)]
+        assert rec.cols == [(0, 3)]
+        assert rec.cells == [(1, 2, "x")]
+
+    def test_remote_axis_changes_resolve_positions(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMatrix)
+        a.insert_rows(0, 3)
+        a.insert_cols(0, 1)
+        env.process_all()
+        rec = Recorder()
+        b.open_matrix(rec)
+        a.insert_rows(1, 2)   # remote insert in the middle of b's view
+        a.remove_rows(0, 1)   # then remove the first row
+        env.process_all()
+        assert rec.rows == [(1, 2), (0, -1)]
+
+    def test_remote_cell_changes_resolve_indices(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMatrix)
+        a.insert_rows(0, 2)
+        a.insert_cols(0, 2)
+        env.process_all()
+        rec = Recorder()
+        b.open_matrix(rec)
+        got = []
+        b.on("cellChanged", lambda row, col, value, local, prev:
+             got.append((row, col, value, local)))
+        a.set_cell(1, 0, "val")
+        env.process_all()
+        assert rec.cells == [(1, 0, "val")]
+        assert got == [(1, 0, "val", False)]
+
+    def test_cell_write_to_removed_row_reports_no_indices(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMatrix)
+        a.insert_rows(0, 2)
+        a.insert_cols(0, 1)
+        env.process_all()
+        got = []
+        rec = Recorder()
+        b.open_matrix(rec)
+        b.on("cellChanged", lambda row, col, value, local, prev:
+             got.append((row, col)))
+        # a writes to row 1 while b concurrently removes it: the sequenced
+        # cell op lands after the removal on b's replica.
+        a.set_cell(1, 0, "ghost")
+        b.remove_rows(1, 1)
+        env.process_all()
+        # The event fired with an unresolvable row (col intact); the
+        # consumer (which needs addressable coordinates) was skipped.
+        assert (None, 0) in got
+        assert all(c[0] is not None for c in rec.cells)
+
+    def test_overlapping_remove_emits_no_spurious_change(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMatrix)
+        a.insert_rows(0, 3)
+        a.insert_cols(0, 1)
+        env.process_all()
+        rec = Recorder()
+        b.open_matrix(rec)
+        # Both replicas remove the same row concurrently; b's view already
+        # dropped it locally, so the remote (winning) remove is silent.
+        a.remove_rows(1, 1)
+        b.remove_rows(1, 1)
+        env.process_all()
+        assert rec.rows == [(1, -1)]  # b's own local remove only
+        assert a.extract() == b.extract()
+
+    def test_close_matrix_stops_notifications(self):
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedMatrix)
+        rec = Recorder()
+        a.open_matrix(rec)
+        a.insert_rows(0, 1)
+        a.close_matrix(rec)
+        a.insert_rows(0, 1)
+        assert rec.rows == [(0, 1)]
