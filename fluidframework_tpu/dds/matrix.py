@@ -400,6 +400,8 @@ class SharedMatrix(SharedObject):
             vector.apply_remote(contents["op"], seq, ref_seq, client_ordinal)
             event = "rowsChanged" if target == "rows" else "colsChanged"
             method = "rows_changed" if target == "rows" else "cols_changed"
+            if not self._consumers and self.listener_count(event) == 0:
+                return  # nobody to notify: skip the position walk
             for pos, delta in vector.changes_for_seq(seq):
                 self.emit(event, pos, delta, False, None)
                 self._notify(method, pos, delta)

@@ -252,11 +252,16 @@ class NetworkDocumentDeltaConnection(TypedEventEmitter,
 
 class NetworkDocumentService(IDocumentService):
     def __init__(self, base_url: str, tenant_id: str, document_id: str,
-                 token_provider: Optional[TokenProvider]):
+                 token_provider: Optional[TokenProvider],
+                 mux_pool=None, session_cache=None):
         self.base_url = base_url.rstrip("/")
         self.tenant_id = tenant_id
         self.document_id = document_id
         self.token_provider = token_provider
+        # Set by a multiplexing factory: shared socket pool + join-session
+        # discovery cache (loader/drivers/mux.py).
+        self._mux_pool = mux_pool
+        self._session_cache = session_cache
         without_scheme = self.base_url.split("://", 1)[-1]
         host, _, port = without_scheme.partition(":")
         self._host, self._port = host, int(port or 80)
@@ -278,26 +283,67 @@ class NetworkDocumentService(IDocumentService):
                                           self.document_id)
 
     def connect_to_delta_stream(self, client_details: Optional[dict] = None
-                                ) -> NetworkDocumentDeltaConnection:
-        return NetworkDocumentDeltaConnection(
-            self._host, self._port, self.tenant_id, self.document_id,
-            self._token(), client_details)
+                                ) -> IDocumentDeltaConnection:
+        if self._mux_pool is None:
+            return NetworkDocumentDeltaConnection(
+                self._host, self._port, self.tenant_id, self.document_id,
+                self._token(), client_details)
+        # Multiplexed path: discover the socket endpoint (join-session),
+        # then ride the pooled socket for that endpoint. A dead pooled
+        # socket fails the first attempt; refresh the discovery and retry
+        # once on a fresh socket.
+        for attempt in (0, 1):
+            discovery = self._session_cache.get(self.tenant_id,
+                                                self.document_id)
+            manager = self._mux_pool.manager(
+                discovery["socketHost"], discovery["socketPort"],
+                discovery.get("socketPath", "/socket-mux"))
+            try:
+                return manager.connect_document(
+                    self.tenant_id, self.document_id, self._token(),
+                    client_details)
+            except ConnectionError:
+                self._session_cache.invalidate(self.tenant_id,
+                                               self.document_id)
+                if attempt:
+                    raise
 
 
 class NetworkDocumentServiceFactory(IDocumentServiceFactory):
     """Driver entry point: points at an alfred URL + tenant, mints a
-    document service per document."""
+    document service per document.
+
+    multiplex=True turns on the odsp-style connection management: the
+    delta stream is discovered per document via the join-session REST
+    call and documents on the same endpoint share ONE physical websocket
+    (loader/drivers/mux.py)."""
 
     def __init__(self, base_url: str, tenant_id: str,
-                 token_provider: Optional[TokenProvider] = None):
+                 token_provider: Optional[TokenProvider] = None,
+                 multiplex: bool = False):
         self.base_url = base_url
         self.tenant_id = tenant_id
         self.token_provider = token_provider
+        if multiplex:
+            from .mux import JoinSessionCache, MuxConnectionPool
+            self.mux_pool = MuxConnectionPool()
+            self.session_cache = JoinSessionCache(self._fetch_session)
+        else:
+            self.mux_pool = None
+            self.session_cache = None
+
+    def _fetch_session(self, tenant_id: str, document_id: str) -> dict:
+        token = (self.token_provider(tenant_id, document_id)
+                 if self.token_provider else None)
+        rest = RestWrapper(self.base_url, token)
+        return rest.get(f"/api/v1/session/{_q(tenant_id)}/{_q(document_id)}")
 
     def create_document_service(self, document_id: str
                                 ) -> NetworkDocumentService:
         return NetworkDocumentService(self.base_url, self.tenant_id,
-                                      document_id, self.token_provider)
+                                      document_id, self.token_provider,
+                                      mux_pool=self.mux_pool,
+                                      session_cache=self.session_cache)
 
     def create_document(self, document_id: Optional[str] = None,
                         summary: Optional[SummaryTree] = None) -> str:

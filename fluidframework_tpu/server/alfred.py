@@ -162,6 +162,9 @@ class AlfredService:
     # -- REST --------------------------------------------------------------
     _ROUTES = [
         ("GET", re.compile(r"^/api/v1/ping$"), "_r_ping"),
+        ("GET", re.compile(
+            r"^/api/v1/session/(?P<tenant>[^/]+)/(?P<doc>[^/]+)$"),
+         "_r_join_session"),
         ("POST", re.compile(r"^/documents/(?P<tenant>[^/]+)$"), "_r_create_doc"),
         ("GET", re.compile(r"^/documents/(?P<tenant>[^/]+)/(?P<doc>[^/]+)$"),
          "_r_get_doc"),
@@ -217,6 +220,34 @@ class AlfredService:
 
     def _r_ping(self, handler, params) -> None:
         _send_json(handler, 200, {"ok": True})
+
+    def _r_join_session(self, handler, params, tenant: str,
+                        doc: str) -> None:
+        """Session discovery (the odsp-driver joinSession flow,
+        odsp-driver/src: fetch the socket endpoint before connecting):
+        returns where the delta-stream socket for this document lives and
+        how long the discovery may be cached. One alfred serves every
+        document here, but the indirection is the contract that lets a
+        deployment shard documents across socket front-ends."""
+        claims = self._check_auth(handler, tenant, doc, "doc:read")
+        if claims is None:
+            return
+        # Advertise a host the CLIENT can dial: the bind address is useless
+        # when alfred listens on a wildcard, so prefer what the client
+        # already reached us by (its Host header).
+        host = self.host
+        if host in ("0.0.0.0", "::", ""):
+            req_host = handler.headers.get("Host", "")
+            req_host = req_host.rsplit(":", 1)[0].strip("[]")
+            host = req_host or "127.0.0.1"
+        _send_json(handler, 200, {
+            "socketHost": host,
+            "socketPort": self.port,
+            "socketPath": "/socket-mux",
+            "tenantId": tenant,
+            "documentId": doc,
+            "sessionExpiryMs": 600_000,
+        })
 
     def _check_admin(self, handler) -> bool:
         """Operator gate for riddler routes. Sends the error response when
@@ -412,6 +443,9 @@ class AlfredService:
             _send_json(handler, 400, {"error": "bad upgrade"})
             return
         handler.wfile.flush()
+        if handler.path.partition("?")[0] == "/socket-mux":
+            self._handle_websocket_mux(handler, key)
+            return
         ws = upgrade_server_socket(handler.connection, key)
         conn = None
         try:
@@ -482,6 +516,100 @@ class AlfredService:
             if conn is not None:
                 conn.disconnect()
             ws.close()
+
+    def _handle_websocket_mux(self, handler, key: str) -> None:
+        """Multiplexed delta stream: many documents share ONE websocket
+        (the odsp-driver socket-reference pattern — one physical socket per
+        endpoint, documents keyed by a client-chosen connection id `cid`).
+        Frames are the legacy protocol plus a `cid` field; per-document
+        errors answer on the cid instead of killing the shared socket.
+
+          C->S {"type": "connect_document", "cid", "tenantId",
+                "documentId", "token", "client"}
+          S->C {"type": "connected", "cid", "clientId", "sequenceNumber"}
+          S->C {"type": "connect_error", "cid", "error"}
+          C->S {"type": "submitOp"|"submitSignal", "cid", ...}
+          C->S {"type": "disconnect_document", "cid"}
+          C->S {"type": "disconnect"}   (closes every document + socket)
+        """
+        ws = upgrade_server_socket(handler.connection, key)
+        conns: Dict[int, object] = {}
+
+        def send(payload: dict) -> None:
+            try:
+                ws.send_text(json.dumps(payload))
+            except (OSError, WebSocketClosed):
+                pass  # reader loop will notice the dead socket
+
+        try:
+            while True:
+                msg = json.loads(ws.recv())
+                if msg.get("type") == "disconnect":
+                    break
+                try:
+                    self._handle_mux_frame(msg, conns, send)
+                except (WebSocketClosed, OSError):
+                    raise  # transport dead: tear the socket down
+                except Exception as exc:  # noqa: BLE001 — isolate per doc
+                    # One document's bad frame must never kill the shared
+                    # socket for its siblings: answer on the cid.
+                    send({"type": "error", "cid": msg.get("cid"),
+                          "error": repr(exc)})
+        except (WebSocketClosed, OSError, json.JSONDecodeError):
+            pass
+        finally:
+            for conn in conns.values():
+                conn.disconnect()
+            ws.close()
+
+    def _handle_mux_frame(self, msg: dict, conns: Dict,
+                          send) -> None:
+        mtype = msg.get("type")
+        if mtype == "connect_document":
+            cid = msg.get("cid")
+            tenant_id = msg.get("tenantId", "")
+            document_id = msg.get("documentId", "")
+            err = self._validate_ws_token(tenant_id, document_id,
+                                          msg.get("token"))
+            if err is not None:
+                send({"type": "connect_error", "cid": cid, "error": err})
+                return
+            if cid in conns:
+                send({"type": "connect_error", "cid": cid,
+                      "error": "cid already connected"})
+                return
+            core = self.core(tenant_id)
+            conn = core.connect(document_id, msg.get("client"))
+            conns[cid] = conn
+            conn.on("op", lambda m, c=cid: send(
+                {"type": "op", "cid": c,
+                 "message": sequenced_message_to_dict(m)}))
+            conn.on("nack", lambda n, c=cid: send(
+                {"type": "nack", "cid": c, "nack": nack_to_dict(n)}))
+            conn.on("signal", lambda s, c=cid: send(
+                {"type": "signal", "cid": c,
+                 "clientId": s.client_id, "content": s.content}))
+            send({"type": "connected", "cid": cid,
+                  "clientId": conn.client_id,
+                  "sequenceNumber": core.sequence_number(document_id)})
+            return
+        cid = msg.get("cid")
+        conn = conns.get(cid)
+        if conn is None:
+            send({"type": "error", "cid": cid,
+                  "error": f"unknown cid {cid!r}"})
+            return
+        if mtype == "submitOp":
+            conn.submit([document_message_from_dict(d)
+                         for d in msg.get("messages", [])])
+        elif mtype == "submitSignal":
+            conn.submit_signal(msg.get("content"))
+        elif mtype == "disconnect_document":
+            conns.pop(cid).disconnect()
+            send({"type": "document_disconnected", "cid": cid})
+        else:
+            send({"type": "error", "cid": cid,
+                  "error": f"unknown message {mtype!r}"})
 
 
 def _send_json(handler, status: int, payload: dict) -> None:
