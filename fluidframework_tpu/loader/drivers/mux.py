@@ -70,6 +70,7 @@ class MuxDeltaConnection(TypedEventEmitter, IDocumentDeltaConnection):
         self.client_id = client_id
         self.checkpoint_sequence_number = checkpoint_sequence_number
         self._closed = False
+        self._sock = None  # the physical socket this connection rides
 
     def submit(self, messages: List[DocumentMessage]) -> None:
         if self._closed:
@@ -131,15 +132,16 @@ class MuxSocketManager:
     def document_count(self) -> int:
         return len(self._conns)
 
-    def _ensure_socket(self) -> None:
+    def _ensure_socket(self) -> websocket.WebSocketConnection:
         with self._lock:
             if self.socket_alive:
-                return
+                return self._ws
             self._ws = websocket.connect(self.host, self.port, self.path)
             self._reader = threading.Thread(
                 target=self._read_loop, args=(self._ws,),
                 name=f"ws-mux-{self.host}:{self.port}", daemon=True)
             self._reader.start()
+            return self._ws
 
     def send(self, payload: dict) -> None:
         with self._lock:
@@ -155,7 +157,7 @@ class MuxSocketManager:
                          token: Optional[str],
                          client_details: Optional[dict],
                          timeout: float = 30.0) -> MuxDeltaConnection:
-        self._ensure_socket()
+        ws = self._ensure_socket()
         cid = next(self._cids)
         # Register the connection BEFORE the handshake resolves: the server
         # broadcasts room frames the instant the document is joined, so ops
@@ -165,7 +167,9 @@ class MuxSocketManager:
         # this connection its "disconnect".
         conn = MuxDeltaConnection(self, cid, client_id=None,
                                   checkpoint_sequence_number=0)
+        conn._sock = ws
         deferred = Deferred()
+        deferred.sock = ws  # scope dead-socket cleanup to this socket
         with self._lock:
             self._handshakes[cid] = deferred
             self._conns[cid] = conn
@@ -181,6 +185,13 @@ class MuxSocketManager:
         except BaseException:
             with self._lock:
                 self._conns.pop(cid, None)
+            # The server may have joined the document (e.g. handshake
+            # timeout raced the reply): tell it to let go, or its side of
+            # the cid broadcasts into the void for the socket's lifetime.
+            try:
+                self.send({"type": "disconnect_document", "cid": cid})
+            except ConnectionError:
+                pass
             raise
         finally:
             with self._lock:
@@ -220,22 +231,39 @@ class MuxSocketManager:
                     continue
                 with self._lock:
                     conn = self._conns.get(cid)
-                if conn is not None:
+                if conn is None:
+                    continue
+                try:
                     conn._dispatch(frame)
+                except Exception:  # noqa: BLE001 — isolate per document
+                    # Mirror the legacy per-doc reader (and the server's
+                    # per-cid isolation): a failing op handler (RestError
+                    # on catch-up, malformed contents) drops THAT document
+                    # — its container reconnects — never its siblings.
+                    with self._lock:
+                        self._conns.pop(cid, None)
+                    conn._on_socket_dead()
         except (websocket.WebSocketClosed, OSError,
                 json.JSONDecodeError, ValueError):
             pass
         finally:
+            # Scope cleanup to riders of THIS socket: a replacement socket
+            # may already be live with its own registrations.
             with self._lock:
-                conns = list(self._conns.values())
-                handshakes = list(self._handshakes.values())
-                self._conns.clear()
-                self._handshakes.clear()
+                dead_conns = [c for c in self._conns.values()
+                              if c._sock is ws]
+                dead_handshakes = [h for h in self._handshakes.values()
+                                   if getattr(h, "sock", None) is ws]
+                for c in dead_conns:
+                    self._conns.pop(c._cid, None)
+                self._handshakes = {
+                    cid: h for cid, h in self._handshakes.items()
+                    if getattr(h, "sock", None) is not ws}
                 if self._ws is ws:
                     self._ws = None
-            for handshake in handshakes:
+            for handshake in dead_handshakes:
                 handshake.reject(ConnectionError("mux socket closed"))
-            for conn in conns:
+            for conn in dead_conns:
                 conn._on_socket_dead()
 
 

@@ -31,11 +31,23 @@ from .constants import DEV_NO_REMOVE, DEV_UNASSIGNED
 from .oppack import OpKind, PackedOps
 from .state import DocState
 
-DOC_TILE = 128  # docs per VMEM block (int32 sublane multiple)
-# Above this capacity the resident block (+ loop temporaries) exceeds the
-# ~16MB VMEM budget and Mosaic refuses to compile; callers route larger
-# states to the scan×vmap kernel (pipeline.make_full_step does).
-FUSED_MAX_CAPACITY = 512
+DOC_TILE = 128  # max docs per VMEM block (int32 sublane multiple)
+# Per-plane VMEM element budget for the resident block: 128 docs × 512
+# slots measured to fit (~4.4MB across ~17 planes, ×2 for the aliased
+# in/out windows + loop temporaries under the ~16MB budget). Larger
+# capacities shrink the doc tile instead of falling off the fused path.
+_TILE_ELEMS = 128 * 512
+# Tile floor is 8 docs (int32 sublane multiple), so the fused kernel
+# covers capacities up to _TILE_ELEMS/8; callers route anything larger
+# to the scan×vmap kernel (pipeline.make_full_step does).
+FUSED_MAX_CAPACITY = _TILE_ELEMS // 8
+
+
+def tile_for_capacity(capacity: int) -> int:
+    """Docs per VMEM block at this capacity: full 128-doc tiles up to
+    C=512, then halving so the resident block stays inside VMEM."""
+    tile = min(DOC_TILE, _TILE_ELEMS // max(capacity, 1))
+    return max(8, (tile // 8) * 8)
 
 
 # ---------------------------------------------------------------------------
@@ -331,7 +343,7 @@ def apply_ops_fused_ref(state: DocState, ops: PackedOps) -> DocState:
     return _from_planes(out, k, a)
 
 
-def _kernel(n_state: int, k: int, a: int, names):
+def _kernel(n_state: int, k: int, a: int, names, op3d: bool):
     """Grid = (doc_tiles, T). The state planes' block index is constant in
     t, so Mosaic keeps them VMEM-resident across the whole op stream
     (revisited-block accumulator pattern); each grid step applies ONE op
@@ -354,11 +366,18 @@ def _kernel(n_state: int, k: int, a: int, names):
                 out_refs[i][:] = in_refs[i][:]
 
         st = {name: out_refs[i][:] for i, name in enumerate(names)}
-        # Op columns ride transposed ([T, TILE], resident across t): row t
-        # is a sublane slice (lane-dim dynamic slices must be 128-aligned
-        # in Mosaic), transposed to the [TILE, 1] per-doc scalar shape.
-        op = {f: jnp.transpose(in_refs[n_state + i][pl.ds(t, 1), :])
-              for i, f in enumerate(_OP_FIELDS)}
+        # Op columns ride transposed (doc axis LAST, resident across t):
+        # row t is a sublane slice (lane-dim dynamic slices must be
+        # 128-aligned in Mosaic), transposed to the [TILE, 1] per-doc
+        # scalar shape. At full 128-doc tiles the planes are [T, TILE];
+        # narrower tiles ride [1, T, TILE] blocks (a [T, tile<128] lane
+        # dim is not a legal block shape, but full-array dims always are).
+        if op3d:
+            op = {f: jnp.transpose(in_refs[n_state + i][0, pl.ds(t, 1), :])
+                  for i, f in enumerate(_OP_FIELDS)}
+        else:
+            op = {f: jnp.transpose(in_refs[n_state + i][pl.ds(t, 1), :])
+                  for i, f in enumerate(_OP_FIELDS)}
         out = _apply_one_batched(st, op, k, a,
                                  lambda x, n: pltpu.roll(x, n, 1))
         for i, name in enumerate(names):
@@ -374,7 +393,7 @@ def apply_ops_fused_pallas(state: DocState, ops: PackedOps,
     names = list(st.keys())
     b, c = state.length.shape
     t_steps = ops.kind.shape[-1]
-    tile = DOC_TILE
+    tile = tile_for_capacity(c)
     padded = ((b + tile - 1) // tile) * tile
     pad = padded - b
 
@@ -382,18 +401,30 @@ def apply_ops_fused_pallas(state: DocState, ops: PackedOps,
         return jnp.pad(x, ((0, pad), (0, 0))) if pad else x
 
     st_in = [pad_rows(st[name]) for name in names]
-    op_in = [pad_rows(getattr(ops, f)).T for f in _OP_FIELDS]  # [T, B]
+    op3d = tile < DOC_TILE
+    if op3d:
+        # [B, T] -> [n_tiles, T_pad, tile]: both trailing block dims equal
+        # the array dims, the only always-legal shape at tile < 128.
+        n_tiles = padded // tile
+        t_pad = ((t_steps + 7) // 8) * 8
+        op_in = [
+            jnp.pad(pad_rows(getattr(ops, f)),
+                    ((0, 0), (0, t_pad - t_steps)))
+            .reshape(n_tiles, tile, t_pad).transpose(0, 2, 1)
+            for f in _OP_FIELDS]
+        op_block = pl.BlockSpec((1, t_pad, tile), lambda i, t: (i, 0, 0))
+    else:
+        op_in = [pad_rows(getattr(ops, f)).T for f in _OP_FIELDS]  # [T, B]
+        op_block = pl.BlockSpec((t_steps, tile), lambda i, t: (0, i))
 
     def state_block(cols):
         return pl.BlockSpec((tile, cols), lambda i, t: (i, 0))
-
-    op_block = pl.BlockSpec((t_steps, tile), lambda i, t: (0, i))
 
     grid = (padded // tile, t_steps)
     out_shapes = [jax.ShapeDtypeStruct((padded, x.shape[1]), x.dtype)
                   for x in st_in]
     outs = pl.pallas_call(
-        _kernel(len(names), k, a, names),
+        _kernel(len(names), k, a, names, op3d),
         out_shape=out_shapes,
         grid=grid,
         in_specs=[state_block(x.shape[1]) for x in st_in]

@@ -238,7 +238,10 @@ class AlfredService:
         host = self.host
         if host in ("0.0.0.0", "::", ""):
             req_host = handler.headers.get("Host", "")
-            req_host = req_host.rsplit(":", 1)[0].strip("[]")
+            if req_host.startswith("["):  # [v6]:port or bare [v6]
+                req_host = req_host.partition("]")[0].lstrip("[")
+            elif ":" in req_host:
+                req_host = req_host.rsplit(":", 1)[0]
             host = req_host or "127.0.0.1"
         _send_json(handler, 200, {
             "socketHost": host,

@@ -81,6 +81,19 @@ class TestFusedPallasInterpret:
             *_batched_from_traces(8, 20, 64, seed), interpret=True)
         assert_states_equal(ref, fused)
 
+    def test_narrow_tile_3d_op_path_matches(self):
+        """Capacity above 512 shrinks the doc tile below 128, switching
+        the op columns to the 3D block layout — conformance for that
+        lowering path (tile_for_capacity(1024) == 64)."""
+        assert pallas_apply.tile_for_capacity(512) == 128
+        assert pallas_apply.tile_for_capacity(1024) == 64
+        assert pallas_apply.tile_for_capacity(8192) == 8
+        st, ops = _batched_from_traces(4, 30, 1024, 7)
+        ref = kernel.apply_ops_batched_keep(st, ops)
+        fused = pallas_apply.apply_ops_fused_pallas(
+            *_batched_from_traces(4, 30, 1024, 7), interpret=True)
+        assert_states_equal(ref, fused)
+
 
 class TestFusedAnnotateRing:
     def test_annotate_ring_overflow_matches(self):
