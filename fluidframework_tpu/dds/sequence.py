@@ -48,6 +48,13 @@ class IntervalCollection(TypedEventEmitter):
         self.label = label
         self.sequence = sequence
         self.intervals: Dict[str, SequenceInterval] = {}
+        # Pending-local shadows (reference intervalCollection pendingChange
+        # tracking): a remote change on an interval with an in-flight local
+        # change is ignored — the sequencer orders the local one later, so
+        # every replica converges on it. Counters survive interval deletion
+        # (late acks must still retire them).
+        self._pending_changes: Dict[str, int] = {}
+        self._pending_prop_keys: Dict[str, Dict[str, int]] = {}
 
     # -- local mutations ---------------------------------------------------
     def add(self, start: int, end: int,
@@ -74,6 +81,11 @@ class IntervalCollection(TypedEventEmitter):
         if interval is None:
             return
         self._reanchor(interval, start, end)
+        if self.sequence.attached:
+            # Detached submits are dropped (state ships in the attach
+            # summary) — no ack will ever retire a counter taken here.
+            self._pending_changes[interval_id] = \
+                self._pending_changes.get(interval_id, 0) + 1
         self.sequence._submit_interval_op(self.label, {
             "opName": "change", "intervalId": interval_id,
             "start": start, "end": end})
@@ -84,6 +96,10 @@ class IntervalCollection(TypedEventEmitter):
         if interval is None:
             return
         interval.properties.update(props)
+        if self.sequence.attached:
+            pending = self._pending_prop_keys.setdefault(interval_id, {})
+            for key in props:
+                pending[key] = pending.get(key, 0) + 1
         self.sequence._submit_interval_op(self.label, {
             "opName": "changeProperties", "intervalId": interval_id,
             "properties": props})
@@ -119,10 +135,28 @@ class IntervalCollection(TypedEventEmitter):
     # -- op application ----------------------------------------------------
     def _process(self, op: dict, local: bool, ref_seq: int,
                  client_ordinal: int) -> None:
-        if local:
-            return  # state applied at submit; the op record acks elsewhere
         name = op["opName"]
         iid = op["intervalId"]
+        if local:
+            # Ack: state applied at submit; retire the pending shadow.
+            if name == "change":
+                n = self._pending_changes.get(iid, 0)
+                if n > 1:
+                    self._pending_changes[iid] = n - 1
+                else:
+                    self._pending_changes.pop(iid, None)
+            elif name == "changeProperties":
+                pending = self._pending_prop_keys.get(iid)
+                if pending:
+                    for key in op.get("properties", {}):
+                        n = pending.get(key, 0)
+                        if n > 1:
+                            pending[key] = n - 1
+                        else:
+                            pending.pop(key, None)
+                    if not pending:
+                        self._pending_prop_keys.pop(iid, None)
+            return
         if name == "add":
             interval = self._attach(iid, op["start"], op["end"],
                                     op.get("properties"),
@@ -135,15 +169,20 @@ class IntervalCollection(TypedEventEmitter):
                 self.emit("deleteInterval", interval, False)
         elif name == "change":
             interval = self.intervals.get(iid)
-            if interval is not None:
+            if interval is not None and \
+                    not self._pending_changes.get(iid):
                 self._reanchor(interval, op["start"], op["end"],
                                ref_seq=ref_seq, client=client_ordinal)
                 self.emit("changeInterval", interval, False)
         elif name == "changeProperties":
             interval = self.intervals.get(iid)
             if interval is not None:
-                interval.properties.update(op["properties"])
-                self.emit("changeInterval", interval, False)
+                pending = self._pending_prop_keys.get(iid, {})
+                applied = {k: v for k, v in op["properties"].items()
+                           if not pending.get(k)}
+                if applied:
+                    interval.properties.update(applied)
+                    self.emit("changeInterval", interval, False)
 
     # -- internals ---------------------------------------------------------
     def _attach(self, iid: str, start: int, end: int,

@@ -242,6 +242,14 @@ class MuxSocketManager:
                     # — its container reconnects — never its siblings.
                     with self._lock:
                         self._conns.pop(cid, None)
+                    try:
+                        # Release the server side too, or the document
+                        # stays joined (ghost client in the quorum) for
+                        # the shared socket's lifetime.
+                        self.send({"type": "disconnect_document",
+                                   "cid": cid})
+                    except ConnectionError:
+                        pass
                     conn._on_socket_dead()
         except (websocket.WebSocketClosed, OSError,
                 json.JSONDecodeError, ValueError):

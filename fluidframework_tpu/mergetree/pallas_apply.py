@@ -45,7 +45,8 @@ FUSED_MAX_CAPACITY = _TILE_ELEMS // 8
 
 def tile_for_capacity(capacity: int) -> int:
     """Docs per VMEM block at this capacity: full 128-doc tiles up to
-    C=512, then halving so the resident block stays inside VMEM."""
+    C=512, then proportional (_TILE_ELEMS // C, floored to a multiple of
+    8, min 8) so the resident block stays inside VMEM."""
     tile = min(DOC_TILE, _TILE_ELEMS // max(capacity, 1))
     return max(8, (tile // 8) * 8)
 

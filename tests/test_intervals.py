@@ -180,3 +180,98 @@ class TestIntervalCollections:
         s1.insert_text(0, "___")
         assert s1.local_reference_to_position(ref) == 10
         s1.remove_local_reference_position(ref)
+
+
+class TestIntervalConflicts:
+    """Concurrent interval mutations converge LWW with pending-local
+    shadowing (reference intervalCollection pendingChange tracking)."""
+
+    def _concurrent_pair(self):
+        server, loader, (c1, s1), (c2, s2) = make_string_pair()
+        s1.insert_text(0, "0123456789")
+        coll1 = s1.get_interval_collection("sel")
+        coll2 = s2.get_interval_collection("sel")
+        iv = coll1.add(0, 1)
+        return server, coll1, coll2, iv.interval_id
+
+    def test_concurrent_change_converges_lww(self):
+        server, coll1, coll2, iid = self._concurrent_pair()
+        server.auto_pump = False
+        coll1.change(iid, 1, 2)
+        coll2.change(iid, 5, 6)  # sequenced second: the winner
+        server.auto_pump = True
+        server.pump()
+        assert coll1.endpoints(coll1.get_interval_by_id(iid)) == (5, 6)
+        assert coll2.endpoints(coll2.get_interval_by_id(iid)) == (5, 6)
+
+    def test_concurrent_change_other_order(self):
+        server, coll1, coll2, iid = self._concurrent_pair()
+        server.auto_pump = False
+        coll2.change(iid, 5, 6)
+        coll1.change(iid, 1, 2)  # sequenced second: the winner
+        server.auto_pump = True
+        server.pump()
+        assert coll1.endpoints(coll1.get_interval_by_id(iid)) == (1, 2)
+        assert coll2.endpoints(coll2.get_interval_by_id(iid)) == (1, 2)
+
+    def test_concurrent_property_change_lww_per_key(self):
+        server, coll1, coll2, iid = self._concurrent_pair()
+        server.auto_pump = False
+        coll1.change_properties(iid, {"a": 1, "only1": True})
+        coll2.change_properties(iid, {"a": 2, "b": 3})
+        server.auto_pump = True
+        server.pump()
+        for coll in (coll1, coll2):
+            props = coll.get_interval_by_id(iid).properties
+            assert props["a"] == 2          # last writer
+            assert props["b"] == 3
+            assert props["only1"] is True   # disjoint keys both land
+
+    def test_delete_wins_over_pending_change(self):
+        server, coll1, coll2, iid = self._concurrent_pair()
+        server.auto_pump = False
+        coll1.change(iid, 3, 4)
+        coll2.remove_interval_by_id(iid)
+        server.auto_pump = True
+        server.pump()
+        assert coll1.get_interval_by_id(iid) is None
+        assert coll2.get_interval_by_id(iid) is None
+
+    def test_interval_conflict_farm(self):
+        """Randomized concurrent change/changeProperties/delete churn with
+        batched delivery windows: every replica converges (farm-style, the
+        repo's race-detector pattern)."""
+        import random as _random
+        rng = _random.Random(1234)
+        server, loader, (c1, s1), (c2, s2) = make_string_pair()
+        s1.insert_text(0, "x" * 40)
+        colls = [s1.get_interval_collection("farm"),
+                 s2.get_interval_collection("farm")]
+        base = [colls[0].add(i, i + 2).interval_id for i in range(0, 10, 2)]
+        for _round in range(30):
+            server.auto_pump = False
+            for _ in range(rng.randrange(1, 5)):
+                coll = colls[rng.randrange(2)]
+                live = [iid for iid in base
+                        if coll.get_interval_by_id(iid) is not None]
+                if not live:
+                    break
+                iid = rng.choice(live)
+                action = rng.random()
+                if action < 0.5:
+                    a = rng.randrange(38)
+                    coll.change(iid, a, a + rng.randrange(1, 3))
+                elif action < 0.85:
+                    coll.change_properties(
+                        iid, {rng.choice("abc"): rng.randrange(100)})
+                else:
+                    coll.remove_interval_by_id(iid)
+            server.auto_pump = True
+            server.pump()
+            for iid in base:
+                iv1 = colls[0].get_interval_by_id(iid)
+                iv2 = colls[1].get_interval_by_id(iid)
+                assert (iv1 is None) == (iv2 is None), iid
+                if iv1 is not None:
+                    assert colls[0].endpoints(iv1) == colls[1].endpoints(iv2)
+                    assert iv1.properties == iv2.properties
