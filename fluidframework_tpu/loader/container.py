@@ -183,6 +183,7 @@ class Container(TypedEventEmitter):
         else:
             self.runtime._submit_fn = self.delta_manager.submit
         self.runtime._submit_signal_fn = self.delta_manager.submit_signal
+        self.runtime._submit_batch_fn = self.delta_manager.submit_batch
 
     def _on_approve_proposal(self, seq, key, value, msn) -> None:
         if key == "code":

@@ -50,6 +50,11 @@ class DeltaManager(TypedEventEmitter):
         self.bulk_catchup_threshold = 64
         self._inbound: List[SequencedDocumentMessage] = []
         self._processing = False
+        # Inside an open inbound batch ({"batch": true} seen, closing
+        # marker not yet): scheduler yields are held so the batch applies
+        # atomically within one slice (reference DeltaScheduler batch
+        # handling).
+        self._in_batch = False
         # The "event loop" of this container. In-process drivers deliver ops
         # synchronously on the caller's thread; network drivers deliver on a
         # websocket reader thread. Inbound processing and outbound submission
@@ -74,6 +79,11 @@ class DeltaManager(TypedEventEmitter):
             self.client_details)
         self.client_id = self.connection.client_id
         self.client_sequence_number = 0
+        # A batch left open by a mid-batch disconnect closes via the
+        # refetched tail (batch members are durable contiguously), but the
+        # flag must not leak across connections — and the bulk catch-up
+        # path bypasses per-op metadata tracking entirely.
+        self._in_batch = False
         self.connection.on("op", self._enqueue)
         self.connection.on("nack", lambda nack: self.emit("nack", nack))
         self.connection.on("signal", self._on_signal)
@@ -117,6 +127,41 @@ class DeltaManager(TypedEventEmitter):
             self._op_perf.on_submit(csn)
             self.connection.submit([msg])
             return csn
+
+    def submit_batch(self, items, before_send=None) -> List[int]:
+        """Send several ops as ONE wire submission (reference DeltaManager
+        flush, deltaManager.ts:656-664): the whole list rides one boxcar,
+        so the sequencer tickets it atomically — contiguous sequence
+        numbers, no foreign op interleaved. Batch boundaries are marked in
+        metadata ({"batch": true} on the first, {"batch": false} on the
+        last) so receivers hold scheduler yields until the batch closes.
+        `before_send(csn, contents)` runs per op before the wire push."""
+        with self.lock:
+            if self.connection is None:
+                raise ConnectionError("not connected")
+            msgs: List[DocumentMessage] = []
+            csns: List[int] = []
+            n = len(items)
+            for i, (mtype, contents) in enumerate(items):
+                self.client_sequence_number += 1
+                csn = self.client_sequence_number
+                metadata = None
+                if n > 1:
+                    if i == 0:
+                        metadata = {"batch": True}
+                    elif i == n - 1:
+                        metadata = {"batch": False}
+                msg = DocumentMessage(
+                    client_sequence_number=csn,
+                    reference_sequence_number=self.last_sequence_number,
+                    type=mtype, contents=contents, metadata=metadata)
+                if before_send is not None:
+                    before_send(csn, contents)
+                self._op_perf.on_submit(csn)
+                msgs.append(msg)
+                csns.append(csn)
+            self.connection.submit(msgs)
+            return csns
 
     def _on_signal(self, sig) -> None:
         # Same serialization contract as inbound ops: handlers run under
@@ -172,7 +217,11 @@ class DeltaManager(TypedEventEmitter):
                         self.scheduler.op_started()
                         self._deliver(msg)
                         self.scheduler.op_processed()
-                        if self.scheduler.should_yield():
+                        meta = msg.metadata
+                        if isinstance(meta, dict) and "batch" in meta:
+                            self._in_batch = bool(meta["batch"])
+                        if self.scheduler.should_yield() \
+                                and not self._in_batch:
                             yielding = True
                             break
                     else:
@@ -238,6 +287,8 @@ class DeltaManager(TypedEventEmitter):
                     self.last_sequence_number = live[-1].sequence_number
                     self.minimum_sequence_number = \
                         live[-1].minimum_sequence_number
+                    # The bulk path applied any batch markers wholesale.
+                    self._in_batch = False
                     return
         for msg in tail:
             self._enqueue(msg)

@@ -248,8 +248,8 @@ class MuxSocketManager:
                         # the shared socket's lifetime.
                         self.send({"type": "disconnect_document",
                                    "cid": cid})
-                    except ConnectionError:
-                        pass
+                    except (ConnectionError, OSError):
+                        pass  # half-dead socket: its teardown handles it
                     conn._on_socket_dead()
         except (websocket.WebSocketClosed, OSError,
                 json.JSONDecodeError, ValueError):

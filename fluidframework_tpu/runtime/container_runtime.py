@@ -44,6 +44,10 @@ class ContainerRuntime(TypedEventEmitter):
         super().__init__()
         self._submit_fn = submit_fn  # (type, contents) -> client_seq_number
         self._submit_signal_fn: Optional[Callable[[Any], None]] = None
+        # Batch submission (DeltaManager.submit_batch): one boxcar for a
+        # whole order_sequentially batch => contiguous sequencing. None
+        # under mock runtimes -> falls back to per-op sends.
+        self._submit_batch_fn: Optional[Callable] = None
         # Connected-client roster, set by the owning Container (reference
         # IFluidDataStoreRuntime.getAudience()); None under mock runtimes.
         self.audience = None
@@ -188,6 +192,17 @@ class ContainerRuntime(TypedEventEmitter):
             batch = self._batch
         finally:
             self._batch = None
+        if len(batch) > 1 and self._submit_batch_fn is not None and \
+                not any(len(json.dumps(c)) > self.max_op_size
+                        for c in batch):
+            # One wire submission -> one boxcar -> the sequencer tickets
+            # the whole batch atomically (contiguous seqs, batch-marked).
+            # Oversized members fall back to per-op sends (chunked ops
+            # cannot ride a batch).
+            self._submit_batch_fn(
+                [(MessageType.OPERATION, c) for c in batch],
+                before_send=lambda csn, c: self.pending.on_submit(csn, c))
+            return
         for contents in batch:
             self._send(contents)
 
@@ -225,9 +240,16 @@ class ContainerRuntime(TypedEventEmitter):
         self.pending.drain()
         for info in list(self._pending_store_attach.values()):
             self._send({"attachStore": info})
-        for store_id, store in self.datastores.items():
-            for envelope in store.resubmit_pending():
-                self.submit_datastore_op(store_id, envelope)
+
+        def replay() -> None:
+            for store_id, store in self.datastores.items():
+                for envelope in store.resubmit_pending():
+                    self.submit_datastore_op(store_id, envelope)
+        # Channels regenerate pending ops without their original batch
+        # grouping, so resubmit the WHOLE replay as one batch: at least as
+        # atomic as the original groups (no foreign op interleaves, no
+        # receiver yields mid-replay).
+        self.order_sequentially(replay)
 
     # -- inbound -----------------------------------------------------------
     def process(self, message: SequencedDocumentMessage) -> None:

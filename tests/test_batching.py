@@ -1,0 +1,152 @@
+"""Op batching: order_sequentially rides ONE boxcar so the sequencer
+tickets the whole batch atomically — contiguous sequence numbers, batch
+boundary markers in metadata, and no inbound scheduler yield mid-batch
+(reference containerRuntime batching + DeltaManager flush/messageBuffer,
+deltaManager.ts:656-664,715-718)."""
+
+from fluidframework_tpu.dds.counter import SharedCounter
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.delta_scheduler import DeltaScheduler
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.protocol.messages import MessageType
+from fluidframework_tpu.server.local_server import LocalServer, TpuLocalServer
+
+
+def make_doc(server, doc_id="batch-doc"):
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c = loader.create_detached(doc_id)
+    ds = c.runtime.create_datastore("default")
+    return loader, c, ds
+
+
+class TestContiguousSequencing:
+    def test_batch_survives_concurrent_submitter(self):
+        """A foreign op submitted between batch construction and pump must
+        not interleave inside the batch's sequence numbers."""
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        m1 = ds1.create_channel("map", SharedMap.TYPE)
+        c1.attach()
+        c2 = loader.resolve("batch-doc")
+        m2 = c2.runtime.get_datastore("default").get_channel("map")
+
+        seqs_by_client = []
+        c2.on("op", lambda msg: msg.type == MessageType.OPERATION and
+              seqs_by_client.append((msg.client_id, msg.sequence_number)))
+
+        server.auto_pump = False
+        c1.runtime.order_sequentially(lambda: (
+            m1.set("a", 1), m1.set("b", 2), m1.set("c", 3)))
+        m2.set("foreign", 9)  # lands in the log between the two boxcars
+        server.auto_pump = True
+        server.pump()
+
+        batch_seqs = [s for cid, s in seqs_by_client
+                      if cid == c1.delta_manager.client_id]
+        assert len(batch_seqs) == 3
+        assert batch_seqs == list(range(batch_seqs[0], batch_seqs[0] + 3))
+        assert m1.kernel.data == m2.kernel.data
+
+    def test_batch_markers_on_first_and_last(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        m1 = ds1.create_channel("map", SharedMap.TYPE)
+        c1.attach()
+        c2 = loader.resolve("batch-doc")
+        metas = []
+        c2.on("op", lambda msg: msg.type == MessageType.OPERATION and
+              metas.append(msg.metadata))
+        c1.runtime.order_sequentially(lambda: (
+            m1.set("a", 1), m1.set("b", 2), m1.set("c", 3)))
+        assert metas == [{"batch": True}, None, {"batch": False}]
+
+    def test_single_op_batch_has_no_marker(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        m1 = ds1.create_channel("map", SharedMap.TYPE)
+        c1.attach()
+        c2 = loader.resolve("batch-doc")
+        metas = []
+        c2.on("op", lambda msg: msg.type == MessageType.OPERATION and
+              metas.append(msg.metadata))
+        c1.runtime.order_sequentially(lambda: m1.set("only", 1))
+        assert metas == [None]
+
+    def test_batch_over_tpu_sequencer(self):
+        """The device ticketing path sequences a boxcar'd batch just as
+        atomically as the scalar deli."""
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        m1 = ds1.create_channel("map", SharedMap.TYPE)
+        c1.attach()
+        c2 = loader.resolve("batch-doc")
+        m2 = c2.runtime.get_datastore("default").get_channel("map")
+        seqs = []
+        c2.on("op", lambda msg: msg.type == MessageType.OPERATION and
+              msg.client_id == c1.delta_manager.client_id and
+              seqs.append(msg.sequence_number))
+        server.auto_pump = False
+        c1.runtime.order_sequentially(lambda: (
+            m1.set("x", 1), m1.set("y", 2)))
+        m2.set("z", 3)
+        server.auto_pump = True
+        server.pump()
+        assert seqs == list(range(seqs[0], seqs[0] + 2))
+        assert m1.kernel.data == m2.kernel.data
+
+    def test_nested_order_sequentially_flattens(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        m1 = ds1.create_channel("map", SharedMap.TYPE)
+        c1.attach()
+        c2 = loader.resolve("batch-doc")
+        metas = []
+        c2.on("op", lambda msg: msg.type == MessageType.OPERATION and
+              metas.append(msg.metadata))
+        c1.runtime.order_sequentially(lambda: (
+            m1.set("a", 1),
+            c1.runtime.order_sequentially(lambda: m1.set("b", 2)),
+            m1.set("c", 3)))
+        assert metas == [{"batch": True}, None, {"batch": False}]
+
+    def test_counter_batch_converges(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        k1 = ds1.create_channel("clicks", SharedCounter.TYPE)
+        c1.attach()
+        c2 = loader.resolve("batch-doc")
+        k2 = c2.runtime.get_datastore("default").get_channel("clicks")
+        c1.runtime.order_sequentially(lambda: (
+            k1.increment(1), k1.increment(2), k1.increment(3)))
+        assert k1.value == k2.value == 6
+
+
+class TestNoYieldMidBatch:
+    def test_scheduler_yield_held_until_batch_closes(self):
+        """With a zero-length scheduler quantum (yield after every op),
+        a 3-op inbound batch still applies in one slice."""
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        m1 = ds1.create_channel("map", SharedMap.TYPE)
+        c1.attach()
+
+        c2 = loader.resolve("batch-doc")
+        dm2 = c2.delta_manager
+        dm2.scheduler = DeltaScheduler(quantum_ms=0)  # eager yields
+        yields = []
+        real_on_yield = dm2.scheduler.on_yield
+        dm2.scheduler.on_yield = lambda: (yields.append(
+            dict(m1.kernel.data)), real_on_yield())
+
+        server.auto_pump = False
+        c1.runtime.order_sequentially(lambda: (
+            m1.set("a", 1), m1.set("b", 2), m1.set("c", 3)))
+        server.auto_pump = True
+        server.pump()
+        m2 = c2.runtime.get_datastore("default").get_channel("map")
+        assert m2.kernel.data == {"a": 1, "b": 2, "c": 3}
+        # No yield observed a half-applied batch.
+        for snapshot in yields:
+            batch_keys = {k for k in snapshot if k in ("a", "b", "c")}
+            assert batch_keys in (set(), {"a", "b", "c"})
