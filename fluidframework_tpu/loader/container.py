@@ -47,12 +47,15 @@ class Container(TypedEventEmitter):
 
     def __init__(self, document_id: str, service: IDocumentService,
                  registry: Optional[ChannelRegistry] = None,
-                 code_loader=None):
+                 code_loader=None,
+                 client_details: Optional[dict] = None):
         super().__init__()
         self.document_id = document_id
         self.service = service
+        self.client_details = dict(client_details or {})
+        self.read_only = self.client_details.get("mode") == "read"
         self.storage = service.connect_to_storage()
-        self.delta_manager = DeltaManager(service)
+        self.delta_manager = DeltaManager(service, self.client_details)
         self.protocol = ProtocolOpHandler()
         self.audience = Audience()
         self.runtime = ContainerRuntime(registry=registry)
@@ -88,9 +91,14 @@ class Container(TypedEventEmitter):
     @staticmethod
     def load(document_id: str, service: IDocumentService,
              registry: Optional[ChannelRegistry] = None,
-             code_loader=None) -> "Container":
-        """Reference Container.load (container.ts:186): summary + op tail."""
-        container = Container(document_id, service, registry, code_loader)
+             code_loader=None,
+             client_details: Optional[dict] = None) -> "Container":
+        """Reference Container.load (container.ts:186): summary + op tail.
+        client_details={"mode": "read"} loads a READ-ONLY observer: it
+        follows the live op/signal streams but never joins the quorum,
+        never holds back the MSN, and never submits."""
+        container = Container(document_id, service, registry, code_loader,
+                              client_details)
         summary = container.storage.get_summary()
         if summary is None:
             raise FileNotFoundError(f"document {document_id!r} has no summary")
@@ -184,6 +192,16 @@ class Container(TypedEventEmitter):
             self.runtime._submit_fn = self.delta_manager.submit
         self.runtime._submit_signal_fn = self.delta_manager.submit_signal
         self.runtime._submit_batch_fn = self.delta_manager.submit_batch
+        self.runtime.signals_live = True
+        if self.read_only:
+            # No join op will ever arrive for us, so the runtime never
+            # goes connected; local edits RAISE at the runtime boundary
+            # (an optimistic edit that can never ack would shadow remote
+            # state forever), while ops and signals flow in. The container
+            # itself reports connected immediately.
+            self.runtime.read_only = True
+            self.connected = True
+            self.emit("connected")
 
     def _on_approve_proposal(self, seq, key, value, msn) -> None:
         if key == "code":
@@ -368,7 +386,9 @@ class Loader:
             document_id, service, self.registry, self.code_loader,
             code_details or self.code_details)
 
-    def resolve(self, document_id: str) -> Container:
+    def resolve(self, document_id: str,
+                client_details: Optional[dict] = None) -> Container:
         service = self.factory.create_document_service(document_id)
         return Container.load(document_id, service, self.registry,
-                              self.code_loader)
+                              self.code_loader,
+                              client_details=client_details)

@@ -51,6 +51,14 @@ class ContainerRuntime(TypedEventEmitter):
         # Connected-client roster, set by the owning Container (reference
         # IFluidDataStoreRuntime.getAudience()); None under mock runtimes.
         self.audience = None
+        # Signals flow on any live delta connection — including read-only
+        # containers, which never go op-connected (no join op) but still
+        # broadcast presence (reference: readers submit signals).
+        self.signals_live = False
+        # Read-only containers REJECT local mutations outright: an
+        # optimistic local edit that can never submit would pend forever
+        # and shadow all future remote updates on this replica.
+        self.read_only = False
         self.registry = registry
         self.options = dict(options or {})
         self.max_op_size = int(self.options.get(
@@ -104,6 +112,8 @@ class ContainerRuntime(TypedEventEmitter):
             self.client_id = client_id
         was = self.connected
         self.connected = connected
+        if not connected:
+            self.signals_live = False
         if connected and not was:
             self._resubmit_all()
         elif was and not connected:
@@ -138,6 +148,10 @@ class ContainerRuntime(TypedEventEmitter):
 
     # -- submission --------------------------------------------------------
     def submit_datastore_op(self, store_id: str, envelope: dict) -> None:
+        if self.read_only:
+            raise PermissionError(
+                "read-only container: local edits cannot be submitted "
+                "(and would permanently shadow remote state if applied)")
         if not (self.attached and self.connected):
             return
         contents = {"address": store_id, "contents": envelope}
@@ -153,7 +167,8 @@ class ContainerRuntime(TypedEventEmitter):
         containerRuntime.submitSignal). `address` targets a datastore's
         signal listeners; None stays at container-runtime scope. Dropped
         silently while disconnected — signals carry no delivery guarantee."""
-        if self._submit_signal_fn is None or not self.connected:
+        if self._submit_signal_fn is None or \
+                not (self.connected or self.signals_live):
             return
         try:
             self._submit_signal_fn({"address": address, "type": signal_type,

@@ -28,6 +28,8 @@ from ..protocol.messages import (
     DocumentMessage,
     MessageType,
     Nack,
+    NackContent,
+    NACK_NOT_WRITER,
     SequencedDocumentMessage,
     SignalMessage,
 )
@@ -62,11 +64,21 @@ class Connection(TypedEventEmitter):
         self.document_id = document_id
         self.client_id = client_id
         self.details = details or {}
+        # "read" connections observe the room (ops + signals) without ever
+        # entering the quorum or the MSN calculation (reference read/write
+        # connection modes: only writers order a join op).
+        self.mode = self.details.get("mode", "write")
         self.connected = True
 
     def submit(self, messages: List[DocumentMessage]) -> None:
         if not self.connected:
             raise ConnectionError("connection closed")
+        if self.mode == "read":
+            self.emit("nack", Nack(
+                messages[0] if messages else None, -1,
+                NackContent(NACK_NOT_WRITER,
+                            "read connections cannot submit ops")))
+            return
         self.server._submit_boxcar(Boxcar(
             tenant_id=self.tenant_id, document_id=self.document_id,
             client_id=self.client_id, contents=list(messages)))
@@ -223,13 +235,15 @@ class LocalServer:
             lambda sig, c=conn: c.connected and c.emit("signal", sig)
         self._signal_rooms.setdefault(document_id, []).append(
             conn._signal_listener)
-        # Join op through the sequencer (alfred connect_document).
-        import json
-        self._send_system(document_id, DocumentMessage(
-            client_sequence_number=0, reference_sequence_number=-1,
-            type=MessageType.CLIENT_JOIN,
-            data=json.dumps({"clientId": client_id,
-                             "detail": conn.details})))
+        # Join op through the sequencer (alfred connect_document) — for
+        # WRITERS only: readers never enter the quorum or the MSN window.
+        if conn.mode != "read":
+            import json
+            self._send_system(document_id, DocumentMessage(
+                client_sequence_number=0, reference_sequence_number=-1,
+                type=MessageType.CLIENT_JOIN,
+                data=json.dumps({"clientId": client_id,
+                                 "detail": conn.details})))
         if self.auto_pump:
             self.pump()
         return conn
@@ -245,6 +259,8 @@ class LocalServer:
         sig_listeners = self._signal_rooms.get(conn.document_id, [])
         if conn._signal_listener in sig_listeners:
             sig_listeners.remove(conn._signal_listener)
+        if conn.mode == "read":
+            return  # never joined; nothing to sequence
         self._send_system(conn.document_id, DocumentMessage(
             client_sequence_number=0, reference_sequence_number=-1,
             type=MessageType.CLIENT_LEAVE,
@@ -304,7 +320,12 @@ class TpuLocalServer(LocalServer):
             lam = TpuSequencerLambda(
                 ctx, emit=self._emit_sequenced, nack=self._emit_nack,
                 checkpoints=self.deli_checkpoints, deltas=self.deltas,
-                fresh_log=True)
+                fresh_log=True,
+                # Snapshot seeding: lanes for channels whose base content
+                # shipped in the attach/client summary bootstrap from the
+                # historian instead of overflowing on their first op.
+                storage=lambda doc_id: self.historian.read_summary(
+                    self.tenant_id, doc_id))
             self.tpu_sequencers.append(lam)
             return lam
 

@@ -142,6 +142,7 @@ class MergeLaneStore:
         self.builder = OpBuilder(self.payloads)
         self.where: Dict[tuple, Tuple[int, int]] = {}  # key -> (bucket, lane)
         self.opaque: set = set()  # lanes dropped (unparseable op seen)
+        self.overflow_drops = 0  # lanes degraded after exhausting buckets
         self.flushes_since_compact = 0
         self.compact_every = 8
 
@@ -160,6 +161,37 @@ class MergeLaneStore:
             b, lane = self.where.pop(key)
             self.buckets[b].free(lane)
         self.opaque.add(key)
+
+    def seed(self, key: tuple, entries, min_seq: int,
+             current_seq: int) -> bool:
+        """Bootstrap a lane from snapshot segments (a document whose
+        content shipped via the attach/client summary rather than ops —
+        without this, the first op addressing snapshot content finds an
+        empty lane and overflows every bucket). Picks the smallest bucket
+        with 2x headroom; unmodelable or oversized snapshots degrade the
+        channel to opaque."""
+        from ..mergetree.catchup import Unmodelable, seed_device_state
+        if key in self.where or key in self.opaque:
+            return key in self.where
+        n = len(entries)
+        last = len(self.buckets) - 1
+        for b, bucket in enumerate(self.buckets):
+            if n * 2 > bucket.capacity and not (b == last
+                                                and n <= bucket.capacity):
+                continue
+            try:
+                row = seed_device_state(entries, self.payloads,
+                                        bucket.capacity, min_seq,
+                                        current_seq)
+            except (Unmodelable, ValueError):
+                self.opaque.add(key)
+                return False
+            lane = bucket.alloc(key)
+            bucket.put_row(lane, row)
+            self.where[key] = (b, lane)
+            return True
+        self.opaque.add(key)
+        return False
 
     # -- batched apply with overflow recovery ------------------------------
     def apply(self, streams: Dict[tuple, List[HostOp]]) -> None:
@@ -245,10 +277,13 @@ class MergeLaneStore:
                 self.where[key] = (nb, new_lane)
                 return
             src_row = wide
+        # Exhausted every bucket: degrade THIS channel to opaque instead of
+        # killing the partition pump — sequencing continues for every other
+        # document; only this channel's server-side materialization is lost
+        # (clients are unaffected; they hold their own replicas).
         del self.where[key]
-        raise RuntimeError(
-            f"merge lane {key} overflows the largest capacity bucket "
-            f"{self.capacities[-1]}")
+        self.opaque.add(key)
+        self.overflow_drops += 1
 
     def compact_all(self) -> None:
         """Zamboni every bucket (reference mergeTree.ts:1422, run between
@@ -628,6 +663,63 @@ class _Pending:
         self.client_id = client_id
 
 
+class _SummaryProbe:
+    """Parsed channel snapshots from a document's stored summary:
+    sequence_number (the summary's protocol seq) + per-(store, channel)
+    merge-tree seed payloads (entries, minSeq, seq)."""
+
+    def __init__(self, sequence_number: int,
+                 channels: Dict[Tuple[str, str], tuple]):
+        self.sequence_number = sequence_number
+        self.channels = channels
+
+
+def _parse_summary_probe(tree) -> Optional[_SummaryProbe]:
+    """Walk a container summary (".protocol" blob + ".app" store trees)
+    and extract every chunked merge-tree channel body (sequence
+    summarize_core format: header {seq, minSeq, chunkCount} + body_i)."""
+    import json as _json
+    proto = tree.entries.get(".protocol")
+    app = tree.entries.get(".app")
+    if proto is None or app is None or not hasattr(app, "entries"):
+        return None
+    try:
+        seq = int(_json.loads(proto.content).get("sequenceNumber", 0))
+    except (ValueError, TypeError, AttributeError):
+        # Client-authored content: malformed protocol blob => no seeding,
+        # never a pump crash.
+        return None
+    stores = app.entries.get(".dataStores")
+    if stores is None or not hasattr(stores, "entries"):
+        return None
+    channels: Dict[Tuple[str, str], tuple] = {}
+    for store_id, store_tree in stores.entries.items():
+        if not hasattr(store_tree, "entries"):
+            continue
+        channel_root = store_tree.entries.get(".channels", store_tree)
+        if not hasattr(channel_root, "entries"):
+            continue
+        for channel_id, node in channel_root.entries.items():
+            if not hasattr(node, "entries") or \
+                    "header" not in node.entries:
+                continue
+            try:
+                header = _json.loads(node.entries["header"].content)
+                count = int(header.get("chunkCount", -1))
+                if count < 0:
+                    continue  # not a chunked merge-tree body
+                entries: List[dict] = []
+                for i in range(count):
+                    entries.extend(_json.loads(
+                        node.entries[f"body_{i}"].content))
+                payload = (entries, int(header.get("minSeq", 0)),
+                           int(header.get("seq", 0)))
+            except (ValueError, TypeError, KeyError, AttributeError):
+                continue  # malformed client channel: skip, don't crash
+            channels[(store_id, channel_id)] = payload
+    return _SummaryProbe(seq, channels)
+
+
 class TpuSequencerLambda(IPartitionLambda):
     """Sequences a partition's documents on device (see module docstring).
 
@@ -643,12 +735,21 @@ class TpuSequencerLambda(IPartitionLambda):
                  checkpoints=None, deltas=None, fresh_log: bool = False,
                  materialize: bool = True,
                  merge_store: Optional[MergeLaneStore] = None,
-                 t_buckets: Tuple[int, ...] = (1, 4, 16, 64, 256)):
+                 t_buckets: Tuple[int, ...] = (1, 4, 16, 64, 256),
+                 storage=None):
+        """storage: optional callable doc_id -> SummaryTree | None (the
+        historian's latest summary). Enables snapshot seeding: merge lanes
+        for channels whose base content shipped in a summary bootstrap
+        from it instead of overflowing on the first op."""
         self.context = context
         self.emit = emit
         self.nack = nack
         self.checkpoints = checkpoints
         self.deltas = deltas
+        self.storage = storage
+        # doc_id -> parsed summary probe result (None = no usable summary);
+        # probed at most once per document per process.
+        self._summary_probes: Dict[str, Optional["_SummaryProbe"]] = {}
         # fresh_log=True: this lambda consumes a brand-new MessageLog (a
         # multi-node takeover hands over checkpointed state, not the log);
         # checkpointed offsets index the PREVIOUS core's log and must not
@@ -696,11 +797,27 @@ class TpuSequencerLambda(IPartitionLambda):
         )
         self._rebuild_merge()
 
+    def _probe_summary(self, doc_id: str) -> Optional[_SummaryProbe]:
+        if doc_id in self._summary_probes:
+            return self._summary_probes[doc_id]
+        probe = None
+        if self.storage is not None:
+            try:
+                tree = self.storage(doc_id)
+            except Exception:  # noqa: BLE001 — storage miss = no seed
+                tree = None
+            if tree is not None:
+                probe = _parse_summary_probe(tree)
+        self._summary_probes[doc_id] = probe
+        return probe
+
     def _rebuild_merge(self) -> None:
         """Crash-restart: rebuild the device merge lanes by replaying each
         known document's sequenced deltas through the kernel in bulk — the
         server-side device catch-up path (reference deltaManager.ts:1380
-        fetchMissingDeltas, applied at partition scale)."""
+        fetchMissingDeltas, applied at partition scale). Channels with a
+        stored summary seed from it first, then replay only the tail past
+        the summary's sequence number."""
         if self.deltas is None or not self.materialize or not self.docs:
             return
         from .lambdas.scriptorium import query_deltas
@@ -708,6 +825,17 @@ class TpuSequencerLambda(IPartitionLambda):
         streams: Dict[tuple, List[HostOp]] = {}
         lww_streams: Dict[tuple, List[tuple]] = {}
         for doc_id, dl in self.docs.items():
+            probe = self._probe_summary(doc_id)
+            seeded_before: Dict[tuple, int] = {}
+            if probe is not None:
+                for (store, channel), payload in probe.channels.items():
+                    key = (doc_id, store, channel)
+                    if self.merge.seed(key, *payload):
+                        # The seeded base already reflects ops <= the
+                        # summary seq for THIS merge channel; everything
+                        # else (LWW channels, unseeded merge channels)
+                        # still replays from zero.
+                        seeded_before[key] = probe.sequence_number
             # Bound at the restored checkpoint's last seq: deltas persisted
             # by a flush that crashed before checkpointing will be
             # re-sequenced by the raw-log replay (same seqs, scriptorium
@@ -731,7 +859,8 @@ class TpuSequencerLambda(IPartitionLambda):
                              row["client_id"])
                 self._collect_channel_op(streams, lww_streams, doc_id, p,
                                          row["sequence_number"],
-                                         row["minimum_sequence_number"])
+                                         row["minimum_sequence_number"],
+                                         seeded_before=seeded_before)
         if streams:
             self.merge.apply(streams)
         if lww_streams:
@@ -930,7 +1059,9 @@ class TpuSequencerLambda(IPartitionLambda):
     def _collect_channel_op(self, merge_streams: Dict[tuple, List[HostOp]],
                             lww_streams: Dict[tuple, List[tuple]],
                             doc_id: str, p: _Pending, seq: int,
-                            msn: int) -> None:
+                            msn: int,
+                            seeded_before: Optional[Dict[tuple, int]] = None
+                            ) -> None:
         """Route an admitted channel op to its device lane family:
         merge-tree ops to the segment kernel, map/cell/counter ops to the
         LWW kernel; anything else stays host-only."""
@@ -947,6 +1078,19 @@ class TpuSequencerLambda(IPartitionLambda):
         if looks_like_merge_op(op):
             if key in self.merge.opaque:
                 return
+            if seeded_before is not None and \
+                    seq <= seeded_before.get(key, 0):
+                return  # already reflected in the seeded snapshot base
+            if key not in self.merge.where:
+                # First op for this channel: its base content may have
+                # shipped in the attach/client summary — seed the lane
+                # from storage before applying ops addressed against it.
+                probe = self._probe_summary(doc_id)
+                if probe is not None:
+                    payload = probe.channels.get((contents.get("address"),
+                                                  envelope.get("address")))
+                    if payload is not None and seq > probe.sequence_number:
+                        self.merge.seed(key, *payload)
             try:
                 ops = wire_to_host_ops(self.merge.builder, op, seq,
                                        p.ref_seq, p.ordinal, msn)

@@ -1,0 +1,186 @@
+"""Read-only connections: readers observe the op/signal streams without
+entering the quorum or the MSN window, and cannot submit ops (reference
+read/write connection modes — only writers order a join op; alfred
+rejects submits from read connections)."""
+
+import time
+
+import pytest
+
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.protocol.messages import (DocumentMessage,
+                                                  MessageType,
+                                                  NACK_NOT_WRITER)
+from fluidframework_tpu.server.local_server import LocalServer
+
+
+def wait_until(predicate, timeout=10.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def make_doc(server, doc_id="ro-doc"):
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c = loader.create_detached(doc_id)
+    ds = c.runtime.create_datastore("default")
+    return loader, c, ds
+
+
+class TestServerReadConnections:
+    def test_reader_does_not_hold_back_msn(self):
+        server = LocalServer()
+        writer = server.connect("doc")
+        reader = server.connect("doc", {"mode": "read"})
+        msns = []
+        writer.on("op", lambda m: msns.append(m.minimum_sequence_number))
+        # The writer advances its refSeq; with the reader outside the MSN
+        # window, the MSN must track the writer alone.
+        for i in range(3):
+            writer.submit([DocumentMessage(
+                client_sequence_number=i + 1,
+                reference_sequence_number=server.sequence_number("doc"),
+                type=MessageType.OPERATION, contents={"i": i})])
+        assert msns[-1] >= msns[0] + 2  # tracked the writer's refSeq
+
+    def test_idle_second_writer_pins_msn_control(self):
+        """Control for the test above: an idle WRITER does pin the MSN."""
+        server = LocalServer()
+        writer = server.connect("doc")
+        idle_writer = server.connect("doc")  # joins, never submits
+        msns = []
+        writer.on("op", lambda m: msns.append(m.minimum_sequence_number))
+        pin = server.sequence_number("doc")
+        for i in range(3):
+            writer.submit([DocumentMessage(
+                client_sequence_number=i + 1,
+                reference_sequence_number=server.sequence_number("doc"),
+                type=MessageType.OPERATION, contents={"i": i})])
+        assert msns[-1] <= pin
+
+    def test_reader_receives_ops_and_signals(self):
+        server = LocalServer()
+        writer = server.connect("doc")
+        reader = server.connect("doc", {"mode": "read"})
+        ops, sigs = [], []
+        reader.on("op", ops.append)
+        reader.on("signal", sigs.append)
+        writer.submit([DocumentMessage(
+            client_sequence_number=1, reference_sequence_number=0,
+            type=MessageType.OPERATION, contents={"x": 1})])
+        writer.submit_signal({"hello": True})
+        assert [m.contents for m in ops if m.type == MessageType.OPERATION] \
+            == [{"x": 1}]
+        assert sigs[-1].content == {"hello": True}
+        # Readers may signal too (presence from observers).
+        got = []
+        writer.on("signal", got.append)
+        reader.submit_signal("reader-here")
+        assert got[-1].content == "reader-here"
+
+    def test_reader_submit_is_nacked_not_sequenced(self):
+        server = LocalServer()
+        reader = server.connect("doc", {"mode": "read"})
+        nacks = []
+        reader.on("nack", nacks.append)
+        seq_before = server.sequence_number("doc")
+        reader.submit([DocumentMessage(
+            client_sequence_number=1, reference_sequence_number=0,
+            type=MessageType.OPERATION, contents={"evil": 1})])
+        assert len(nacks) == 1
+        assert nacks[0].content.code == NACK_NOT_WRITER
+        assert server.sequence_number("doc") == seq_before
+
+    def test_reader_join_leave_sequences_nothing(self):
+        server = LocalServer()
+        writer = server.connect("doc")
+        deltas_before = server.get_deltas("doc")
+        reader = server.connect("doc", {"mode": "read"})
+        reader.disconnect()
+        assert server.get_deltas("doc") == deltas_before
+
+
+class TestReadOnlyContainer:
+    def test_reader_container_follows_live_edits(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        text = ds1.create_channel("text", SharedString.TYPE)
+        text.insert_text(0, "start")
+        c1.attach()
+
+        ro = loader.resolve("ro-doc", client_details={"mode": "read"})
+        assert ro.connected and ro.read_only
+        t_ro = ro.runtime.get_datastore("default").get_channel("text")
+        assert t_ro.get_text() == "start"
+        text.insert_text(5, " live")
+        assert t_ro.get_text() == "start live"
+        # The reader is absent from the writer's audience (no join op).
+        assert ro.delta_manager.client_id not in c1.audience.members
+
+    def test_reader_local_edits_rejected(self):
+        """Local mutation on a read-only replica raises — an optimistic
+        edit that can never ack would pend forever and shadow all future
+        remote updates on that key."""
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        m1 = ds1.create_channel("map", SharedMap.TYPE)
+        m1.set("k", "writer")
+        c1.attach()
+        ro = loader.resolve("ro-doc", client_details={"mode": "read"})
+        m_ro = ro.runtime.get_datastore("default").get_channel("map")
+        with pytest.raises(PermissionError):
+            m_ro.set("k", "reader")
+        # Nothing leaked to the writers...
+        c2 = loader.resolve("ro-doc")
+        m2 = c2.runtime.get_datastore("default").get_channel("map")
+        assert m1.get("k") == m2.get("k") == "writer"
+        # ...and the reader keeps following remote edits on other keys
+        # (the rejected edit's optimistic application is local-only).
+        m1.set("k2", "live")
+        assert m_ro.get("k2") == "live"
+
+    def test_reader_signals_flow_both_ways(self):
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        ro = loader.resolve("ro-doc", client_details={"mode": "read"})
+        got_ro, got_w = [], []
+        ro.runtime.on("signal", lambda t, c, local, cid: got_ro.append(t))
+        c1.runtime.on("signal", lambda t, c, local, cid: got_w.append(t))
+        c1.submit_signal("from-writer", None)
+        ro.submit_signal("from-reader", None)
+        assert got_ro == ["from-writer", "from-reader"]
+        assert got_w == ["from-writer", "from-reader"]
+
+
+class TestNetworkReadMode:
+    def test_read_mode_over_real_sockets(self):
+        from fluidframework_tpu.loader.drivers.routerlicious import (
+            NetworkDocumentServiceFactory)
+        from fluidframework_tpu.server.tinylicious import (DEFAULT_TENANT,
+                                                           Tinylicious)
+        with Tinylicious() as t:
+            loader = Loader(
+                NetworkDocumentServiceFactory(t.url, DEFAULT_TENANT))
+            c1 = loader.create_detached("net-ro")
+            ds = c1.runtime.create_datastore("default")
+            text = ds.create_channel("text", SharedString.TYPE)
+            with c1.op_lock:
+                text.insert_text(0, "over the wire")
+                c1.attach()
+            ro = loader.resolve("net-ro", client_details={"mode": "read"})
+            t_ro = ro.runtime.get_datastore("default").get_channel("text")
+            assert t_ro.get_text() == "over the wire"
+            with c1.op_lock:
+                text.insert_text(0, ">> ")
+            assert wait_until(lambda: t_ro.get_text() == ">> over the wire")
+            # Reader is not in the writer's audience.
+            assert ro.delta_manager.client_id not in c1.audience.members
+            c1.close()
+            ro.close()

@@ -651,3 +651,70 @@ class TestMarkersOnServingPath:
                    for e in chunk]
         markers = [e for e in entries if e.get("kind") == 1]
         assert markers and markers[0].get("props", {}).get("kind") == "pg"
+
+
+class TestSnapshotSeededLanes:
+    """Documents whose base content ships in the attach/client summary
+    (not ops): merge lanes bootstrap from the stored summary instead of
+    overflowing on the first op addressed against snapshot content."""
+
+    def _attach_with_content(self, server, doc_id="snap-doc"):
+        loader, c1, ds1 = make_doc(server, doc_id)
+        text = ds1.create_channel("text", SharedString.TYPE)
+        text.insert_text(0, "shipped in the attach summary")
+        c1.attach()
+        return loader, c1, text
+
+    def test_ops_over_snapshot_content_materialize(self):
+        server = TpuLocalServer()
+        loader, c1, text = self._attach_with_content(server)
+        # Edits addressed INSIDE the snapshot-seeded content.
+        text.insert_text(7, "[mid] ")
+        text.remove_text(0, 3)
+        text.insert_text(text.get_length(), " +tail")
+        c2 = loader.resolve("snap-doc")
+        t2 = c2.runtime.get_datastore("default").get_channel("text")
+        assert t2.get_text() == text.get_text()
+        assert server.sequencer().channel_text(
+            "snap-doc", "default", "text") == text.get_text()
+        assert server.sequencer().merge.overflow_drops == 0
+
+    def test_restart_rebuild_seeds_then_replays_tail(self):
+        server = TpuLocalServer()
+        loader, c1, text = self._attach_with_content(server)
+        text.insert_text(0, ">> ")
+        server._deli_mgr.restart()  # rebuild: seed summary + replay tail
+        text.insert_text(text.get_length(), " post-restart")
+        c2 = loader.resolve("snap-doc")
+        t2 = c2.runtime.get_datastore("default").get_channel("text")
+        assert t2.get_text() == text.get_text()
+        assert server.sequencer().channel_text(
+            "snap-doc", "default", "text") == text.get_text()
+
+    def test_bucket_exhaustion_degrades_to_opaque_not_crash(self):
+        """A channel that outgrows the LARGEST capacity bucket loses its
+        server-side materialization (opaque) but sequencing continues for
+        it and for every other document — no partition pump crash."""
+        from fluidframework_tpu.server.tpu_sequencer import MergeLaneStore
+        server = TpuLocalServer()
+        # Shrink the buckets so exhaustion is cheap to reach.
+        server.sequencer().merge = MergeLaneStore(capacities=(4, 8))
+        loader, c1, ds1 = make_doc(server, "grow-doc")
+        text = ds1.create_channel("text", SharedString.TYPE)
+        c1.attach()
+        for i in range(30):  # far beyond 8 segment slots
+            text.insert_text(0, f"{i},")
+        assert server.sequencer().merge.overflow_drops >= 1
+        assert server.sequencer().channel_text(
+            "grow-doc", "default", "text") is None
+        # Sequencing survived: clients still converge...
+        c2 = loader.resolve("grow-doc")
+        t2 = c2.runtime.get_datastore("default").get_channel("text")
+        assert t2.get_text() == text.get_text()
+        # ...and other documents still materialize.
+        loader3, c3, ds3 = make_doc(server, "healthy-doc")
+        t3 = ds3.create_channel("text", SharedString.TYPE)
+        c3.attach()
+        t3.insert_text(0, "fine")
+        assert server.sequencer().channel_text(
+            "healthy-doc", "default", "text") == "fine"
