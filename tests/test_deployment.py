@@ -54,7 +54,7 @@ class TestMultiProcessPipeline:
         procs = [broker]
         try:
             # Wait for the broker socket.
-            deadline = time.time() + 30
+            deadline = time.time() + 60
             while time.time() < deadline:
                 try:
                     socket.create_connection(("127.0.0.1", port),
@@ -91,7 +91,7 @@ class TestMultiProcessPipeline:
             # Sequenced deltas must land in the shared sqlite store.
             db = SqliteDatabaseManager(str(tmp_path / "fluid.sqlite"))
             deltas = db.collection("deltas", unique_key=delta_key)
-            deadline = time.time() + 60
+            deadline = time.time() + 120
             rows = []
             while time.time() < deadline:
                 rows = query_deltas(deltas, "doc")
@@ -139,7 +139,7 @@ class TestBrokerRestart:
 
         def start_broker():
             p = _spawn(["broker", "--config", str(cfg_path)], tmp_path)
-            deadline = time.time() + 30
+            deadline = time.time() + 60
             while time.time() < deadline:
                 try:
                     socket.create_connection(("127.0.0.1", port),
@@ -162,7 +162,7 @@ class TestBrokerRestart:
         db = SqliteDatabaseManager(str(tmp_path / "fluid.sqlite"))
         deltas = db.collection("deltas", unique_key=delta_key)
 
-        def wait_rows(n, worker, timeout=60):
+        def wait_rows(n, worker, timeout=120):
             deadline = time.time() + timeout
             while time.time() < deadline:
                 rows = query_deltas(deltas, "doc")
