@@ -436,6 +436,8 @@ class LwwLaneStore:
         self.buckets = [_LwwBucket(lk, c, lanes_per_bucket)
                         for c in self.capacities]
         self.where: Dict[tuple, Tuple[int, int]] = {}
+        self.opaque: set = set()  # channels dropped after bucket exhaustion
+        self.overflow_drops = 0
         self.key_ids: Dict[str, int] = {}
         self.key_names: List[str] = []
         self.values: List[Any] = []  # payload refs -> raw (encoded) values
@@ -457,6 +459,43 @@ class LwwLaneStore:
             lane = self.buckets[0].alloc(key)
             self.where[key] = (0, lane)
         return self.where[key]
+
+    def seed(self, key: tuple, kind: str, header: Any) -> bool:
+        """Bootstrap a lane from a summary header (map entries / cell
+        value / counter accumulator) as synthetic seq-0 ops — any real op
+        (seq >= 1) wins LWW over the seeded base."""
+        lk = self.lk
+        if key in self.where:
+            return True
+        if key in self.opaque:
+            return False
+        ops: List[tuple] = []
+        try:
+            if kind == "map" and isinstance(header, dict):
+                for k, v in header.items():
+                    ops.append((lk.LwwKind.SET, self.intern_key(k),
+                                self.add_value(v), 0, 0))
+            elif kind == "cell" and isinstance(header, dict):
+                if header.get("hasValue"):
+                    ops.append((lk.LwwKind.SET, self.intern_key(_CELL_KEY),
+                                self.add_value(header.get("value")), 0, 0))
+            elif kind == "counter" and isinstance(header, dict):
+                delta = int(header.get("value", 0))
+                if not (-2**31 <= delta < 2**31):
+                    return False
+                if delta:
+                    ops.append((lk.LwwKind.ADD, -1, -1, delta, 0))
+            else:
+                return False
+        except (ValueError, TypeError):
+            return False
+        if ops:
+            self.apply({key: ops})
+            if key in self.opaque:
+                return False  # oversized snapshot: degraded, not fatal
+        else:
+            self.lane_for(key)  # empty base: allocate so snapshots report
+        return True
 
     def wire_to_op(self, op: dict, seq: int) -> tuple:
         """(kind, key_id, val_id, delta, seq) for one sequenced wire op.
@@ -521,6 +560,8 @@ class LwwLaneStore:
     def _apply_window(self, window: Dict[tuple, List[tuple]]) -> None:
         per_bucket: Dict[int, Dict[int, List[tuple]]] = {}
         for key, ops in window.items():
+            if key in self.opaque:
+                continue  # degraded channel: never re-admit
             b, lane = self.lane_for(key)
             per_bucket.setdefault(b, {})[lane] = ops
         for b, lane_ops in sorted(per_bucket.items()):
@@ -562,10 +603,14 @@ class LwwLaneStore:
                 self.where[key] = (nb, new_lane)
                 return
             row = jax.tree_util.tree_map(lambda x: x[0], wide)
+        # Exhausted every key-capacity bucket: degrade this ONE channel to
+        # opaque (no server-side materialization) instead of crashing the
+        # pump — same discipline as the merge lanes, and it must hold for
+        # client-authored summary seeds too (a crash here would loop on
+        # every restart re-probe of the same stored summary).
         del self.where[key]
-        raise RuntimeError(
-            f"lww lane {key} overflows the largest key capacity "
-            f"{self.capacities[-1]}")
+        self.opaque.add(key)
+        self.overflow_drops += 1
 
     def compact_values(self) -> None:
         """Reclaim unreferenced payloads: memory must track LIVE state, not
@@ -666,12 +711,24 @@ class _Pending:
 class _SummaryProbe:
     """Parsed channel snapshots from a document's stored summary:
     sequence_number (the summary's protocol seq) + per-(store, channel)
-    merge-tree seed payloads (entries, minSeq, seq)."""
+    merge-tree seed payloads (entries, minSeq, seq) and LWW seed payloads
+    (kind, header-data)."""
 
     def __init__(self, sequence_number: int,
-                 channels: Dict[Tuple[str, str], tuple]):
+                 channels: Dict[Tuple[str, str], tuple],
+                 lww_channels: Optional[Dict[Tuple[str, str],
+                                             tuple]] = None):
         self.sequence_number = sequence_number
         self.channels = channels
+        self.lww_channels = lww_channels or {}
+
+
+# Channel types the LWW lanes can seed from a summary header.
+_LWW_SEED_TYPES = {
+    "https://graph.microsoft.com/types/map": "map",
+    "https://graph.microsoft.com/types/cell": "cell",
+    "https://graph.microsoft.com/types/counter": "counter",
+}
 
 
 def _parse_summary_probe(tree) -> Optional[_SummaryProbe]:
@@ -693,6 +750,7 @@ def _parse_summary_probe(tree) -> Optional[_SummaryProbe]:
     if stores is None or not hasattr(stores, "entries"):
         return None
     channels: Dict[Tuple[str, str], tuple] = {}
+    lww_channels: Dict[Tuple[str, str], tuple] = {}
     for store_id, store_tree in stores.entries.items():
         if not hasattr(store_tree, "entries"):
             continue
@@ -703,8 +761,22 @@ def _parse_summary_probe(tree) -> Optional[_SummaryProbe]:
             if not hasattr(node, "entries") or \
                     "header" not in node.entries:
                 continue
+            # A malformed .attributes blob must not cost a channel its
+            # merge seeding — classification just falls back to "".
+            ctype = ""
+            attrs = node.entries.get(".attributes")
+            if attrs is not None:
+                try:
+                    ctype = _json.loads(attrs.content).get("type", "")
+                except (ValueError, TypeError, AttributeError):
+                    ctype = ""
             try:
                 header = _json.loads(node.entries["header"].content)
+                lww_kind = _LWW_SEED_TYPES.get(ctype)
+                if lww_kind is not None:
+                    lww_channels[(store_id, channel_id)] = (lww_kind,
+                                                            header)
+                    continue
                 count = int(header.get("chunkCount", -1))
                 if count < 0:
                     continue  # not a chunked merge-tree body
@@ -717,7 +789,7 @@ def _parse_summary_probe(tree) -> Optional[_SummaryProbe]:
             except (ValueError, TypeError, KeyError, AttributeError):
                 continue  # malformed client channel: skip, don't crash
             channels[(store_id, channel_id)] = payload
-    return _SummaryProbe(seq, channels)
+    return _SummaryProbe(seq, channels, lww_channels)
 
 
 class TpuSequencerLambda(IPartitionLambda):
@@ -809,6 +881,14 @@ class TpuSequencerLambda(IPartitionLambda):
             if tree is not None:
                 probe = _parse_summary_probe(tree)
         self._summary_probes[doc_id] = probe
+        if probe is not None and probe.sequence_number == 0:
+            # Attach summary: NOTHING can predate seq 0, so eagerly seed
+            # every channel — summary-only channels (never touched by a
+            # live op) materialize for server-side reads too.
+            for (store, channel), payload in probe.channels.items():
+                self.merge.seed((doc_id, store, channel), *payload)
+            for (store, channel), payload in probe.lww_channels.items():
+                self.lww.seed((doc_id, store, channel), *payload)
         return probe
 
     def _rebuild_merge(self) -> None:
@@ -832,9 +912,13 @@ class TpuSequencerLambda(IPartitionLambda):
                     key = (doc_id, store, channel)
                     if self.merge.seed(key, *payload):
                         # The seeded base already reflects ops <= the
-                        # summary seq for THIS merge channel; everything
-                        # else (LWW channels, unseeded merge channels)
-                        # still replays from zero.
+                        # summary seq for THIS channel; unseeded channels
+                        # still replay from zero.
+                        seeded_before[key] = probe.sequence_number
+                for (store, channel), payload in \
+                        probe.lww_channels.items():
+                    key = (doc_id, store, channel)
+                    if self.lww.seed(key, *payload):
                         seeded_before[key] = probe.sequence_number
             # Bound at the restored checkpoint's last seq: deltas persisted
             # by a flush that crashed before checkpointing will be
@@ -1099,6 +1183,19 @@ class TpuSequencerLambda(IPartitionLambda):
                 return
             merge_streams.setdefault(key, []).extend(ops)
         elif looks_like_lww_op(op):
+            if key in self.lww.opaque:
+                return
+            if seeded_before is not None and \
+                    seq <= seeded_before.get(key, 0):
+                return  # already reflected in the seeded snapshot base
+            if key not in self.lww.where:
+                probe = self._probe_summary(doc_id)
+                if probe is not None:
+                    payload = probe.lww_channels.get(
+                        (contents.get("address"), envelope.get("address")))
+                    if payload is not None and \
+                            seq > probe.sequence_number:
+                        self.lww.seed(key, *payload)
             try:
                 lww_streams.setdefault(key, []).append(
                     self.lww.wire_to_op(op, seq))

@@ -718,3 +718,79 @@ class TestSnapshotSeededLanes:
         t3.insert_text(0, "fine")
         assert server.sequencer().channel_text(
             "healthy-doc", "default", "text") == "fine"
+
+    def test_lww_channels_seed_from_attach_summary(self):
+        """Map/cell/counter base state that shipped in the attach summary
+        materializes server-side, with live ops layered LWW on top."""
+        from fluidframework_tpu.dds.cell import SharedCell
+        from fluidframework_tpu.dds.counter import SharedCounter
+        from fluidframework_tpu.dds.map import SharedMap
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server, "lww-snap")
+        m = ds1.create_channel("map", SharedMap.TYPE)
+        k = ds1.create_channel("clicks", SharedCounter.TYPE)
+        cell = ds1.create_channel("cell", SharedCell.TYPE)
+        m.set("base", "from-summary")
+        m.set("will-change", 1)
+        k.increment(10)
+        cell.set("cell-base")
+        c1.attach()
+        # Live ops over the seeded base.
+        m.set("will-change", 2)
+        m.set("live", True)
+        k.increment(5)
+        snap = server.sequencer().channel_snapshot("lww-snap", "default",
+                                                   "map")
+        assert snap["entries"] == {"base": "from-summary",
+                                   "will-change": 2, "live": True}
+        ksnap = server.sequencer().channel_snapshot("lww-snap", "default",
+                                                    "clicks")
+        assert ksnap["counter"] == 15
+        csnap = server.sequencer().channel_snapshot("lww-snap", "default",
+                                                    "cell")
+        assert csnap["entries"].get("\x00cell") == "cell-base"
+        # Clients agree.
+        c2 = loader.resolve("lww-snap")
+        m2 = c2.runtime.get_datastore("default").get_channel("map")
+        assert dict(m2.items()) == snap["entries"]
+
+    def test_lww_restart_rebuild_does_not_double_count(self):
+        """Counter rebuild: seeded base + tail replay past the summary seq
+        — pre-summary increments must not re-apply."""
+        from fluidframework_tpu.dds.counter import SharedCounter
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server, "lww-restart")
+        k = ds1.create_channel("clicks", SharedCounter.TYPE)
+        k.increment(7)  # ships in the attach summary (acked base)
+        c1.attach()
+        k.increment(3)  # sequenced op
+        server._deli_mgr.restart()
+        k.increment(1)
+        snap = server.sequencer().channel_snapshot("lww-restart", "default",
+                                                   "clicks")
+        assert snap["counter"] == 11
+        assert k.value == 11
+
+    def test_oversized_lww_summary_degrades_to_opaque(self):
+        """A map summary with more keys than the largest LWW bucket loses
+        materialization for that channel only — no pump crash, no restart
+        crash loop."""
+        from fluidframework_tpu.dds.map import SharedMap
+        server = TpuLocalServer()
+        # Shrink the LWW buckets so exhaustion is cheap.
+        from fluidframework_tpu.server.tpu_sequencer import LwwLaneStore
+        server.sequencer().lww = LwwLaneStore(capacities=(4, 8))
+        loader, c1, ds1 = make_doc(server, "big-map")
+        m = ds1.create_channel("map", SharedMap.TYPE)
+        for i in range(30):  # far beyond 8 key slots
+            m.set(f"k{i}", i)
+        c1.attach()
+        m.set("live", 1)  # first live op triggers the seed attempt
+        lww = server.sequencer().lww
+        assert ("big-map", "default", "map") in lww.opaque
+        assert server.sequencer().channel_snapshot(
+            "big-map", "default", "map") is None
+        # Sequencing survived; clients converge.
+        c2 = loader.resolve("big-map")
+        m2 = c2.runtime.get_datastore("default").get_channel("map")
+        assert m2.get("live") == 1 and m2.get("k7") == 7
