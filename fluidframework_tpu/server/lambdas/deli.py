@@ -70,7 +70,7 @@ class DeliLambda(IPartitionLambda):
                  emit: Callable[[str, SequencedDocumentMessage], None],
                  nack: Callable[[str, str, Nack], None],
                  checkpoints=None, fresh_log: bool = False,
-                 config=None):
+                 config=None, send_system=None):
         """emit(document_id, sequenced_message); nack(document_id,
         client_id, nack). checkpoints: optional Collection for state dumps —
         restored at construction so a crash-restarted lambda resumes from
@@ -103,6 +103,20 @@ class DeliLambda(IPartitionLambda):
         self._uncheckpointed = 0
         self._last_checkpoint_time = time.monotonic()
         self._pending_offset: Optional[int] = None
+        # Ghost-client eviction (reference ClientSequenceTimeout,
+        # clientSeqManager canEvict): a writer that crashes without a
+        # leave op would pin the MSN forever; after clientTimeout of
+        # silence the sequencer synthesizes its leave. 0 disables.
+        # The leave is SENT INTO THE RAW LOG (send_system) rather than
+        # ticketed in place: sequencing inputs must all ride the log, or
+        # a crash-replay would re-derive different sequence numbers than
+        # the ones already broadcast (wall clock is not replayable).
+        self.send_system = send_system
+        self._evicting: Dict[str, set] = {}  # doc -> in-flight evictions
+        self.client_timeout_s = 300.0
+        if config is not None:
+            self.client_timeout_s = float(config.get(
+                "deli.clientTimeoutMsec", 300_000)) / 1000.0
         if checkpoints is not None:
             for row in checkpoints.find(lambda d: "documentId" in d):
                 state = self.load_state(row["state"])
@@ -119,6 +133,7 @@ class DeliLambda(IPartitionLambda):
             return  # replayed message already processed (deli/lambda.ts:143)
         for raw in boxcar.contents:
             self._ticket(doc_id, state, boxcar.client_id, raw)
+        self._evict_ghosts(doc_id, state)
         state.log_offset = message.offset
         self._pending_offset = message.offset
         self._uncheckpointed += 1
@@ -179,15 +194,23 @@ class DeliLambda(IPartitionLambda):
         if mtype == MessageType.CLIENT_JOIN:
             detail = _join_detail(msg)
             joining = detail.get("clientId", client_id)
+            # canEvict=True for ordinary clients (reference upsertClient);
+            # nonEvictable in the join detail opts service identities out
+            # (legitimately silent for long stretches).
+            inner = detail.get("detail") if isinstance(detail, dict) \
+                else None
+            can_evict = not (isinstance(inner, dict)
+                             and inner.get("nonEvictable"))
             state.clients[joining] = ClientSeqState(
                 joining, ref_seq=state.sequence_number, client_seq=0,
-                can_evict=False)
+                can_evict=can_evict)
             self._sequence(doc_id, state, None, msg)
             return
         if mtype == MessageType.CLIENT_LEAVE:
             detail = _join_detail(msg)
             leaving = detail if isinstance(detail, str) else \
                 detail.get("clientId", client_id)
+            self._evicting.get(doc_id, set()).discard(leaving)
             if leaving in state.clients:
                 del state.clients[leaving]
                 self._sequence(doc_id, state, None, msg)
@@ -220,6 +243,31 @@ class DeliLambda(IPartitionLambda):
         entry.ref_seq = msg.reference_sequence_number
         entry.last_update = time.time()
         self._sequence(doc_id, state, client_id, msg)
+
+    def _evict_ghosts(self, doc_id: str, state: DocumentDeliState) -> None:
+        """Synthesize leaves for writers silent past clientTimeout
+        (reference deli client eviction): checked on document activity, so
+        a live document cannot stay pinned behind a dead client. The leave
+        goes through the raw log (replay-deterministic); without a
+        producer it falls back to in-place ticketing (test harnesses)."""
+        if not self.client_timeout_s:
+            return
+        cutoff = time.time() - self.client_timeout_s
+        import json as _json
+        in_flight = self._evicting.setdefault(doc_id, set())
+        for client_id in [cid for cid, c in state.clients.items()
+                          if c.last_update < cutoff and c.can_evict
+                          and cid not in in_flight]:
+            leave = DocumentMessage(
+                client_sequence_number=0, reference_sequence_number=-1,
+                type=MessageType.CLIENT_LEAVE,
+                data=_json.dumps({"clientId": client_id,
+                                  "evicted": True}))
+            if self.send_system is not None:
+                in_flight.add(client_id)
+                self.send_system(doc_id, leave)
+            else:
+                self._ticket(doc_id, state, None, leave)
 
     def _sequence(self, doc_id: str, state: DocumentDeliState,
                   client_id: Optional[str], msg: DocumentMessage) -> None:

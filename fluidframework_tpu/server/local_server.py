@@ -188,7 +188,8 @@ class LocalServer:
                                    nack=self._emit_nack,
                                    checkpoints=self.deli_checkpoints,
                                    fresh_log=True,
-                                   config=self.config),
+                                   config=self.config,
+                                   send_system=self._send_system),
             auto_commit=not deli_batched)
 
     def _emit_sequenced(self, doc_id: str,
@@ -316,6 +317,11 @@ class TpuLocalServer(LocalServer):
     def _build_sequencer(self) -> PartitionManager:
         from .tpu_sequencer import TpuSequencerLambda
 
+        timeout_s = 300.0
+        if self.config is not None:
+            timeout_s = float(self.config.get(
+                "deli.clientTimeoutMsec", 300_000)) / 1000.0
+
         def factory(ctx):
             lam = TpuSequencerLambda(
                 ctx, emit=self._emit_sequenced, nack=self._emit_nack,
@@ -325,7 +331,9 @@ class TpuLocalServer(LocalServer):
                 # shipped in the attach/client summary bootstrap from the
                 # historian instead of overflowing on their first op.
                 storage=lambda doc_id: self.historian.read_summary(
-                    self.tenant_id, doc_id))
+                    self.tenant_id, doc_id),
+                client_timeout_s=timeout_s,
+                send_system=self._send_system)
             self.tpu_sequencers.append(lam)
             return lam
 

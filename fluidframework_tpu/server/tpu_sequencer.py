@@ -26,6 +26,7 @@ doubles pre-flush when a window's join count could exceed it).
 from __future__ import annotations
 
 import json
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -482,12 +483,15 @@ class LwwLaneStore:
             elif kind == "counter" and isinstance(header, dict):
                 delta = int(header.get("value", 0))
                 if not (-2**31 <= delta < 2**31):
-                    return False
+                    raise ValueError("counter base exceeds int32")
                 if delta:
                     ops.append((lk.LwwKind.ADD, -1, -1, delta, 0))
             else:
-                return False
+                raise ValueError(f"unseedable header kind {kind!r}")
         except (ValueError, TypeError):
+            # Unrepresentable base: materializing live ops over an EMPTY
+            # base would serve wrong state — degrade to opaque instead.
+            self.opaque.add(key)
             return False
         if ops:
             self.apply({key: ops})
@@ -668,6 +672,11 @@ class _DocLane:
         self.ordinals: Dict[int, str] = {}
         self.log_offset = -1
         self.next_ordinal = 0
+        # Host mirror of live membership + last activity, for ghost-client
+        # eviction (not persisted; _restore re-stamps from the device
+        # client table). `evicting` dedups in-flight synthesized leaves.
+        self.last_seen: Dict[str, float] = {}
+        self.evicting: set = set()
 
     def intern(self, client_id: str) -> int:
         if client_id not in self.interner:
@@ -808,17 +817,27 @@ class TpuSequencerLambda(IPartitionLambda):
                  materialize: bool = True,
                  merge_store: Optional[MergeLaneStore] = None,
                  t_buckets: Tuple[int, ...] = (1, 4, 16, 64, 256),
-                 storage=None):
+                 storage=None, client_timeout_s: float = 300.0,
+                 send_system=None):
         """storage: optional callable doc_id -> SummaryTree | None (the
         historian's latest summary). Enables snapshot seeding: merge lanes
         for channels whose base content shipped in a summary bootstrap
-        from it instead of overflowing on the first op."""
+        from it instead of overflowing on the first op.
+
+        client_timeout_s: ghost-client eviction window (0 disables) —
+        writers silent this long get a synthesized leave so they stop
+        pinning the MSN (DeliLambda clientTimeout semantics)."""
         self.context = context
         self.emit = emit
         self.nack = nack
         self.checkpoints = checkpoints
         self.deltas = deltas
         self.storage = storage
+        self.client_timeout_s = client_timeout_s
+        # Eviction leaves ride the raw log when a producer is available
+        # (replay-deterministic, DeliLambda semantics); fallback appends
+        # to the in-memory backlog. _DocLane.evicting dedups in-flight.
+        self.send_system = send_system
         # doc_id -> parsed summary probe result (None = no usable summary);
         # probed at most once per document per process.
         self._summary_probes: Dict[str, Optional["_SummaryProbe"]] = {}
@@ -867,6 +886,17 @@ class TpuSequencerLambda(IPartitionLambda):
             min_seq=jnp.asarray(np.asarray(cols["min_seq"], np.int32)),
             overflow=jnp.asarray(np.asarray(cols["overflow"], np.bool_)),
         )
+        # Re-arm ghost eviction for members restored into the device
+        # client table (last_seen is not persisted): a ghost present at
+        # the crash still ages out after restart.
+        now = time.time()
+        ids = np.asarray(self.tstate.client_ids)
+        for dl in self.docs.values():
+            for ordinal in ids[dl.lane]:
+                if int(ordinal) >= 0:
+                    client = dl.ordinals.get(int(ordinal))
+                    if client is not None:
+                        dl.last_seen[client] = now
         self._rebuild_merge()
 
     def _probe_summary(self, doc_id: str) -> Optional[_SummaryProbe]:
@@ -1015,27 +1045,60 @@ class TpuSequencerLambda(IPartitionLambda):
         if msg.type == MessageType.CLIENT_JOIN:
             detail = _detail(msg)
             joining = detail.get("clientId", client_id)
+            dl.last_seen[joining] = time.time()
             return _Pending(tk.MsgKind.JOIN, dl.intern(joining), 0, 0, msg,
                             None)
         if msg.type == MessageType.CLIENT_LEAVE:
             detail = _detail(msg)
             leaving = detail if isinstance(detail, str) else \
                 detail.get("clientId", client_id)
+            dl.last_seen.pop(leaving, None)
+            dl.evicting.discard(leaving)
             return _Pending(tk.MsgKind.LEAVE, dl.intern(leaving), 0, 0, msg,
                             None)
         if client_id is None:
             return _Pending(tk.MsgKind.SYSTEM, -1, 0, 0, msg, None)
+        dl.last_seen[client_id] = time.time()
         return _Pending(tk.MsgKind.OP, dl.intern(client_id),
                         msg.client_sequence_number,
                         msg.reference_sequence_number, msg, client_id)
 
     # -- the device flush --------------------------------------------------
     def flush(self) -> None:
+        self._evict_ghosts()
         # Each window consumes at least one pending message per live doc,
         # so this loop is bounded by the backlog length.
         while any(self.pending.values()):
             self._flush_window()
         self._checkpoint()
+
+    def _evict_ghosts(self) -> None:
+        """Synthesize leaves for writers silent past client_timeout_s
+        (DeliLambda._evict_ghosts, device path). With a raw-log producer
+        the leave rides the log (replay-deterministic); the fallback
+        appends to the in-memory backlog so the NoClient timing and
+        quorum removal stay exact either way."""
+        if not self.client_timeout_s:
+            return
+        cutoff = time.time() - self.client_timeout_s
+        for doc_id, dl in self.docs.items():
+            stale = [cid for cid, ts in dl.last_seen.items()
+                     if ts < cutoff and cid not in dl.evicting]
+            for client_id in stale:
+                leave = DocumentMessage(
+                    client_sequence_number=0,
+                    reference_sequence_number=-1,
+                    type=MessageType.CLIENT_LEAVE,
+                    data=json.dumps({"clientId": client_id,
+                                     "evicted": True}))
+                if self.send_system is not None:
+                    dl.evicting.add(client_id)
+                    self.send_system(doc_id, leave)
+                else:
+                    dl.last_seen.pop(client_id, None)
+                    self.pending.setdefault(doc_id, []).append(_Pending(
+                        tk.MsgKind.LEAVE, dl.intern(client_id), 0, 0,
+                        leave, None))
 
     def _take_window(self) -> Dict[str, List[_Pending]]:
         """Carve the next per-doc message chunks off the backlog: at most

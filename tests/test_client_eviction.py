@@ -1,0 +1,129 @@
+"""Ghost-client eviction: a writer that crashes without a leave op must
+not pin the MSN forever — after clientTimeout of silence the sequencer
+synthesizes its leave (reference deli ClientSequenceTimeout), on BOTH the
+scalar deli and the device ticketing path."""
+
+import json
+import time
+
+from fluidframework_tpu.core.config import ConfigProvider
+from fluidframework_tpu.protocol.messages import (DocumentMessage,
+                                                  MessageType)
+from fluidframework_tpu.server.local_server import LocalServer, TpuLocalServer
+
+
+class TestScalarDeliEviction:
+    def _server(self, timeout_ms):
+        cfg = ConfigProvider({"deli": {"clientTimeoutMsec": timeout_ms}})
+        return LocalServer(config=cfg)
+
+    def test_silent_writer_evicted_and_msn_unpins(self):
+        server = self._server(50)
+        writer = server.connect("doc")
+        ghost = server.connect("doc")  # joins, then crashes silently
+        seen = []
+        writer.on("op", lambda m: seen.append(m))
+        ghost_pin = server.sequence_number("doc")
+
+        def write(i):
+            writer.submit([DocumentMessage(
+                client_sequence_number=i,
+                reference_sequence_number=server.sequence_number("doc"),
+                type=MessageType.OPERATION, contents={"i": i})])
+        write(1)
+        # MSN pinned at/below the ghost's join refSeq while it is live.
+        assert seen[-1].minimum_sequence_number <= ghost_pin
+        time.sleep(0.08)  # ghost crosses the timeout
+        write(2)
+        leaves = [m for m in seen if m.type == MessageType.CLIENT_LEAVE]
+        assert any(json.loads(m.data)["clientId"] == ghost.client_id
+                   and json.loads(m.data).get("evicted")
+                   for m in leaves if m.data)
+        write(3)
+        assert seen[-1].minimum_sequence_number > ghost_pin
+
+    def test_active_writer_not_evicted(self):
+        server = self._server(200)
+        writer = server.connect("doc")
+        seen = []
+        writer.on("op", lambda m: seen.append(m))
+        for i in range(1, 4):
+            time.sleep(0.05)  # each op re-arms the clock
+            writer.submit([DocumentMessage(
+                client_sequence_number=i,
+                reference_sequence_number=server.sequence_number("doc"),
+                type=MessageType.OPERATION, contents={"i": i})])
+        assert not any(m.type == MessageType.CLIENT_LEAVE for m in seen)
+
+    def test_zero_timeout_disables(self):
+        cfg = ConfigProvider({"deli": {"clientTimeoutMsec": 0}})
+        server = LocalServer(config=cfg)
+        writer = server.connect("doc")
+        ghost = server.connect("doc")
+        seen = []
+        writer.on("op", lambda m: seen.append(m))
+        time.sleep(0.05)
+        writer.submit([DocumentMessage(
+            client_sequence_number=1,
+            reference_sequence_number=server.sequence_number("doc"),
+            type=MessageType.OPERATION, contents={})])
+        assert not any(m.type == MessageType.CLIENT_LEAVE for m in seen)
+
+
+class TestDeviceEviction:
+    def _warm(self, server, writer, start):
+        """Run a few writes with the default (300s) timeout so jit
+        compiles finish BEFORE the test arms a short one — otherwise the
+        multi-second first-flush stall makes every client look stale."""
+        for i in range(start, start + 2):
+            writer.submit([DocumentMessage(
+                client_sequence_number=i,
+                reference_sequence_number=server.sequence_number("doc"),
+                type=MessageType.OPERATION, contents={"warm": i})])
+
+    def test_silent_writer_evicted_on_tpu_path(self):
+        server = TpuLocalServer()
+        writer = server.connect("doc")
+        ghost = server.connect("doc")
+        self._warm(server, writer, 1)
+        seen = []
+        writer.on("op", lambda m: seen.append(m))
+        # The ghost's clock re-arms only on ITS activity — it has been
+        # silent since its join; arm a window shorter than that silence.
+        server.sequencer().client_timeout_s = 0.2
+        time.sleep(0.25)
+        writer.submit([DocumentMessage(
+            client_sequence_number=3,
+            reference_sequence_number=server.sequence_number("doc"),
+            type=MessageType.OPERATION, contents={"i": 3})])
+        leaves = [m for m in seen if m.type == MessageType.CLIENT_LEAVE]
+        assert any(json.loads(m.data)["clientId"] == ghost.client_id
+                   and json.loads(m.data).get("evicted")
+                   for m in leaves if m.data)
+        # With the ghost gone the MSN tracks the writer alone.
+        writer.submit([DocumentMessage(
+            client_sequence_number=4,
+            reference_sequence_number=server.sequence_number("doc"),
+            type=MessageType.OPERATION, contents={"i": 4})])
+        assert seen[-1].minimum_sequence_number >= \
+            seen[-1].sequence_number - 2
+
+    def test_eviction_survives_restart(self):
+        """A ghost present at the crash still ages out after restart
+        (last_seen re-stamped from the restored device client table)."""
+        server = TpuLocalServer()
+        writer = server.connect("doc")
+        ghost = server.connect("doc")
+        self._warm(server, writer, 1)
+        server._deli_mgr.restart()
+        server.sequencer().client_timeout_s = 0.2
+        seen = []
+        writer.on("op", lambda m: seen.append(m))
+        time.sleep(0.25)
+        writer.submit([DocumentMessage(
+            client_sequence_number=3,
+            reference_sequence_number=server.sequence_number("doc"),
+            type=MessageType.OPERATION, contents={"i": 3})])
+        leaves = [m for m in seen if m.type == MessageType.CLIENT_LEAVE]
+        assert any(json.loads(m.data)["clientId"] == ghost.client_id
+                   for m in leaves if m.data)

@@ -794,3 +794,23 @@ class TestSnapshotSeededLanes:
         c2 = loader.resolve("big-map")
         m2 = c2.runtime.get_datastore("default").get_channel("map")
         assert m2.get("live") == 1 and m2.get("k7") == 7
+
+    def test_unrepresentable_lww_summary_degrades_to_opaque(self):
+        """A counter whose summary base exceeds int32 must NOT materialize
+        live deltas over an empty base (silently wrong totals) — the
+        channel degrades to opaque instead."""
+        from fluidframework_tpu.dds.counter import SharedCounter
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server, "big-counter")
+        k = ds1.create_channel("clicks", SharedCounter.TYPE)
+        k.increment(3_000_000_000)  # acked base beyond int32
+        c1.attach()
+        k.increment(5)
+        assert server.sequencer().channel_snapshot(
+            "big-counter", "default", "clicks") is None
+        assert ("big-counter", "default", "clicks") in \
+            server.sequencer().lww.opaque
+        # Clients are unaffected.
+        c2 = loader.resolve("big-counter")
+        k2 = c2.runtime.get_datastore("default").get_channel("clicks")
+        assert k2.value == 3_000_000_005
