@@ -10,10 +10,12 @@ connection, refetch, hand the container a fresh client id to resubmit on).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, List, Optional
 
 from ..core.events import TypedEventEmitter
-from ..protocol.messages import DocumentMessage, SequencedDocumentMessage
+from ..protocol.messages import (DocumentMessage, MessageType,
+                                 SequencedDocumentMessage)
 from ..telemetry import ChildLogger, OpRoundTripTelemetry, TelemetryLogger
 from .delta_scheduler import DeltaScheduler
 from .drivers.base import IDocumentService
@@ -55,6 +57,18 @@ class DeltaManager(TypedEventEmitter):
         # atomically within one slice (reference DeltaScheduler batch
         # handling).
         self._in_batch = False
+        # Noop heartbeat (reference deltaManager updateSequenceNumber): a
+        # connected writer that only READS never tells the server its
+        # refSeq advanced, pinning the MSN at its last submission. Send a
+        # NO_OP carrying the fresh refSeq after noop_threshold remote ops
+        # OR noop_idle_s of outbound silence (checked at delivery time) —
+        # the time bound keeps live-but-idle writers well inside the
+        # server's eviction window at any remote op rate. 0 disables each.
+        self.noop_threshold = 25
+        self.noop_idle_s = 2.25
+        self._ops_since_submit = 0
+        self._last_submit_time = time.monotonic()
+        self._catching_up = False
         # The "event loop" of this container. In-process drivers deliver ops
         # synchronously on the caller's thread; network drivers deliver on a
         # websocket reader thread. Inbound processing and outbound submission
@@ -84,6 +98,8 @@ class DeltaManager(TypedEventEmitter):
         # flag must not leak across connections — and the bulk catch-up
         # path bypasses per-op metadata tracking entirely.
         self._in_batch = False
+        self._ops_since_submit = 0
+        self._last_submit_time = time.monotonic()
         self.connection.on("op", self._enqueue)
         self.connection.on("nack", lambda nack: self.emit("nack", nack))
         self.connection.on("signal", self._on_signal)
@@ -125,6 +141,8 @@ class DeltaManager(TypedEventEmitter):
             if before_send is not None:
                 before_send(csn)
             self._op_perf.on_submit(csn)
+            self._ops_since_submit = 0
+            self._last_submit_time = time.monotonic()
             self.connection.submit([msg])
             return csn
 
@@ -160,6 +178,8 @@ class DeltaManager(TypedEventEmitter):
                 self._op_perf.on_submit(csn)
                 msgs.append(msg)
                 csns.append(csn)
+            self._ops_since_submit = 0
+            self._last_submit_time = time.monotonic()
             self.connection.submit(msgs)
             return csns
 
@@ -250,15 +270,50 @@ class DeltaManager(TypedEventEmitter):
         self.last_sequence_number = msg.sequence_number
         self.minimum_sequence_number = msg.minimum_sequence_number
         self._op_perf.on_sequenced(msg)
+        # Count remote non-noop activity only: counting noops would make
+        # two idle clients answer each other's heartbeats forever.
+        if msg.client_id is not None and msg.client_id != self.client_id \
+                and msg.type != MessageType.NO_OP:
+            self._ops_since_submit += 1
         if self._handler is not None:
             self._handler(msg)
         self.emit("op", msg)
+        self._maybe_send_noop()
+
+    def _maybe_send_noop(self) -> None:
+        if self._ops_since_submit == 0:
+            return  # nothing remote since our last submission
+        count_due = (self.noop_threshold
+                     and self._ops_since_submit >= self.noop_threshold)
+        idle_due = (self.noop_idle_s and
+                    time.monotonic() - self._last_submit_time
+                    >= self.noop_idle_s)
+        if not (count_due or idle_due):
+            return
+        if self._inbound or self._catching_up:
+            # Mid-catch-up/drain: our refSeq is still behind the head and
+            # deli would nack it (refSeq < MSN). Defer; the counter keeps
+            # its value, so the heartbeat fires at the head.
+            return
+        if self.connection is None or \
+                self.client_details.get("mode") == "read":
+            self._ops_since_submit = 0  # readers cannot submit
+            return
+        self.submit(MessageType.NO_OP, None)
 
     def catch_up(self) -> None:
         """Fetch + process everything durable past our position
         (deltaManager.ts:1401). A long contiguous tail is handed to the
         bulk handler in one call — the device catch-up path — instead of
         per-op enqueueing; anything irregular falls back per-message."""
+        self._catching_up = True
+        try:
+            self._catch_up()
+        finally:
+            self._catching_up = False
+            self._maybe_send_noop()  # deferred heartbeat fires at the head
+
+    def _catch_up(self) -> None:
         tail: List[SequencedDocumentMessage] = []
         while True:
             from_seq = (tail[-1].sequence_number if tail

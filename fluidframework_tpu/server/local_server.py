@@ -317,11 +317,6 @@ class TpuLocalServer(LocalServer):
     def _build_sequencer(self) -> PartitionManager:
         from .tpu_sequencer import TpuSequencerLambda
 
-        timeout_s = 300.0
-        if self.config is not None:
-            timeout_s = float(self.config.get(
-                "deli.clientTimeoutMsec", 300_000)) / 1000.0
-
         def factory(ctx):
             lam = TpuSequencerLambda(
                 ctx, emit=self._emit_sequenced, nack=self._emit_nack,
@@ -332,7 +327,7 @@ class TpuLocalServer(LocalServer):
                 # historian instead of overflowing on their first op.
                 storage=lambda doc_id: self.historian.read_summary(
                     self.tenant_id, doc_id),
-                client_timeout_s=timeout_s,
+                config=self.config,
                 send_system=self._send_system)
             self.tpu_sequencers.append(lam)
             return lam

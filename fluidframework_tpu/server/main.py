@@ -154,15 +154,12 @@ def build_worker(cfg: dict, stages: List[str]):
                 auto_commit=False))
         elif stage == "tpu-deli":
             from .tpu_sequencer import TpuSequencerLambda
-            timeout_s = float(view.get(
-                "deli.clientTimeoutMsec", 300_000)) / 1000.0
             runner.add(PartitionManager(
                 log, "deli", RAW_TOPIC,
                 lambda ctx: TpuSequencerLambda(
                     ctx, emit=emit_sequenced, nack=emit_nack,
                     checkpoints=deli_ckpt, deltas=deltas,
-                    client_timeout_s=timeout_s,
-                    send_system=send_system),
+                    config=view, send_system=send_system),
                 auto_commit=False))
         elif stage == "scriptorium":
             runner.add(PartitionManager(

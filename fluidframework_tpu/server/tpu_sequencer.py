@@ -818,7 +818,7 @@ class TpuSequencerLambda(IPartitionLambda):
                  merge_store: Optional[MergeLaneStore] = None,
                  t_buckets: Tuple[int, ...] = (1, 4, 16, 64, 256),
                  storage=None, client_timeout_s: float = 300.0,
-                 send_system=None):
+                 send_system=None, config=None):
         """storage: optional callable doc_id -> SummaryTree | None (the
         historian's latest summary). Enables snapshot seeding: merge lanes
         for channels whose base content shipped in a summary bootstrap
@@ -826,7 +826,9 @@ class TpuSequencerLambda(IPartitionLambda):
 
         client_timeout_s: ghost-client eviction window (0 disables) —
         writers silent this long get a synthesized leave so they stop
-        pinning the MSN (DeliLambda clientTimeout semantics)."""
+        pinning the MSN (DeliLambda clientTimeout semantics). config (the
+        same nconf slice DeliLambda takes) overrides it via
+        deli.clientTimeoutMsec."""
         self.context = context
         self.emit = emit
         self.nack = nack
@@ -834,6 +836,9 @@ class TpuSequencerLambda(IPartitionLambda):
         self.deltas = deltas
         self.storage = storage
         self.client_timeout_s = client_timeout_s
+        if config is not None:
+            self.client_timeout_s = float(config.get(
+                "deli.clientTimeoutMsec", 300_000)) / 1000.0
         # Eviction leaves ride the raw log when a producer is available
         # (replay-deterministic, DeliLambda semantics); fallback appends
         # to the in-memory backlog. _DocLane.evicting dedups in-flight.
@@ -1065,14 +1070,18 @@ class TpuSequencerLambda(IPartitionLambda):
 
     # -- the device flush --------------------------------------------------
     def flush(self) -> None:
-        self._evict_ghosts()
+        # Eviction checks only documents with activity in THIS flush —
+        # the scalar deli's per-boxcar scope; a completely quiet document
+        # never evicts (its idle writer had no remote ops to heartbeat
+        # against either).
+        self._evict_ghosts([d for d, q in self.pending.items() if q])
         # Each window consumes at least one pending message per live doc,
         # so this loop is bounded by the backlog length.
         while any(self.pending.values()):
             self._flush_window()
         self._checkpoint()
 
-    def _evict_ghosts(self) -> None:
+    def _evict_ghosts(self, active_docs: List[str]) -> None:
         """Synthesize leaves for writers silent past client_timeout_s
         (DeliLambda._evict_ghosts, device path). With a raw-log producer
         the leave rides the log (replay-deterministic); the fallback
@@ -1081,7 +1090,10 @@ class TpuSequencerLambda(IPartitionLambda):
         if not self.client_timeout_s:
             return
         cutoff = time.time() - self.client_timeout_s
-        for doc_id, dl in self.docs.items():
+        for doc_id in active_docs:
+            dl = self.docs.get(doc_id)
+            if dl is None:
+                continue
             stale = [cid for cid, ts in dl.last_seen.items()
                      if ts < cutoff and cid not in dl.evicting]
             for client_id in stale:
