@@ -299,7 +299,13 @@ class DeltaManager(TypedEventEmitter):
                 self.client_details.get("mode") == "read":
             self._ops_since_submit = 0  # readers cannot submit
             return
-        self.submit(MessageType.NO_OP, None)
+        try:
+            self.submit(MessageType.NO_OP, None)
+        except ConnectionError:
+            # A concurrent disconnect raced the check above (close() nulls
+            # the connection without the lock): a heartbeat is always safe
+            # to drop, and it must never crash the delivery thread.
+            pass
 
     def catch_up(self) -> None:
         """Fetch + process everything durable past our position

@@ -459,18 +459,53 @@ def extract_visible_batched(state: DocState):
     return jax.vmap(_extract_one)(state)
 
 
+@functools.partial(jax.jit, static_argnums=1)
+def _slice_stack(cols, mx):
+    return jnp.stack([c[:, :mx] for c in cols])
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def _slice_rows(x, mx):
+    return x[:, :mx]
+
+
 def fetch_extracted(packed) -> tuple:
     """Host fetch of an extraction result, sliced to the batch's max live
     row count BEFORE the transfer: with left-packed rows everything past
-    max(counts) is padding, so this cuts D2H bytes by C/max_count — the
-    transfer, not the kernel, dominates snapshot extraction cost."""
+    max(counts) is padding, so this cuts D2H bytes by C/max_count — and
+    same-shaped columns ride ONE stacked transfer, because per-array RPC
+    overhead (not bandwidth) dominates over a tunneled device (measured
+    5.3s -> 2.5s for 10k docs). The slice width buckets to a multiple of
+    32 so the jitted slice/stack programs cache across calls (up to
+    capacity/32 variants — counts drift slowly, so in practice a handful;
+    tighter than power-of-two slicing by up to 37% of the bytes)."""
     import numpy as np
 
     counts = np.asarray(packed[-1])
     mx = max(int(counts.max()) if counts.size else 0, 1)
-    return tuple(
-        np.asarray(x[:, :mx]) if getattr(x, "ndim", 0) >= 2 else np.asarray(x)
-        for x in packed[:-1]) + (counts,)
+    capacity = packed[0].shape[1]
+    # Bucket the slice width to a multiple of 32: bounded jit-cache
+    # variants without inflating the transfer much beyond max(counts).
+    mx = min(((mx + 31) // 32) * 32, capacity)
+
+    cols = packed[:-1]
+    # Group stackable columns: same (ndim, dtype) 2-D planes stack into
+    # one [n, B, mx] transfer; anything else (e.g. 3-D anno) goes alone.
+    by_kind = {}
+    for i, x in enumerate(cols):
+        key = (x.ndim, str(x.dtype)) if x.ndim == 2 else ("solo", i)
+        by_kind.setdefault(key, []).append(i)
+    fetched: dict = {}
+    for key, idxs in by_kind.items():
+        if key[0] == 2 and len(idxs) > 1:
+            arr = np.asarray(_slice_stack(
+                tuple(cols[i] for i in idxs), mx))
+            for j, i in enumerate(idxs):
+                fetched[i] = arr[j]
+        else:
+            for i in idxs:
+                fetched[i] = np.asarray(_slice_rows(cols[i], mx))
+    return tuple(fetched[i] for i in range(len(cols))) + (counts,)
 
 
 # ---------------------------------------------------------------------------

@@ -837,8 +837,11 @@ class TpuSequencerLambda(IPartitionLambda):
         self.storage = storage
         self.client_timeout_s = client_timeout_s
         if config is not None:
-            self.client_timeout_s = float(config.get(
-                "deli.clientTimeoutMsec", 300_000)) / 1000.0
+            configured = config.get("deli.clientTimeoutMsec", None)
+            if configured is not None:
+                # Override only when actually configured — an explicit
+                # client_timeout_s argument survives an unrelated config.
+                self.client_timeout_s = float(configured) / 1000.0
         # Eviction leaves ride the raw log when a producer is available
         # (replay-deterministic, DeliLambda semantics); fallback appends
         # to the in-memory backlog. _DocLane.evicting dedups in-flight.

@@ -163,6 +163,7 @@ class TestNoopHeartbeat:
     def test_no_heartbeat_without_threshold(self):
         server, c1, m1, c2 = self._pair()
         c2.delta_manager.noop_threshold = 0
+        c2.delta_manager.noop_idle_s = 0  # no wall-clock trigger either
         seen = []
         c1.on("op", lambda m: seen.append(m.type))
         for i in range(12):
@@ -173,6 +174,8 @@ class TestNoopHeartbeat:
         server, c1, m1, c2 = self._pair()
         c1.delta_manager.noop_threshold = 3
         c2.delta_manager.noop_threshold = 3
+        c1.delta_manager.noop_idle_s = 0  # count-trigger only: the noop
+        c2.delta_manager.noop_idle_s = 0  # bound below must be exact
         seen = []
         c1.on("op", lambda m: seen.append(m.type))
         for i in range(9):
