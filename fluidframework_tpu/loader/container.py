@@ -283,20 +283,34 @@ class Container(TypedEventEmitter):
         while i < len(tail):
             run_key = self._bulk_key(tail[i])
             j = i
-            while run_key is not None and j < len(tail) and \
-                    self._bulk_key(tail[j]) == run_key:
-                j += 1
+            n_ops = 0
+            while run_key is not None and j < len(tail):
+                if self._bulk_key(tail[j]) == run_key:
+                    n_ops += 1
+                    j += 1
+                    continue
+                if tail[j].type == MessageType.NO_OP:
+                    # Heartbeats are channel-neutral: they ride the run
+                    # (processed protocol-side below) instead of cutting
+                    # it — noops every ~25 ops would otherwise cap every
+                    # run under the bulk threshold.
+                    j += 1
+                    continue
+                break
             if run_key is not None and \
-                    j - i >= self.delta_manager.bulk_catchup_threshold:
+                    n_ops >= self.delta_manager.bulk_catchup_threshold:
+                run = tail[i:j]
+                channel_msgs = [m for m in run
+                                if m.type != MessageType.NO_OP]
                 try:
-                    self.runtime.process_channel_bulk(tail[i:j])
-                    for msg in tail[i:j]:
+                    self.runtime.process_channel_bulk(channel_msgs)
+                    for msg in run:
                         self.protocol.process_message(msg)
                 except (BulkApplyUnsupported, ValueError):
                     # Channel state untouched: process the WHOLE detected
                     # run scalar (re-attempting bulk on its suffix would
                     # fail identically, O(N^2) for a long run).
-                    for msg in tail[i:j]:
+                    for msg in run:
                         self._process(msg)
                 i = j
                 continue

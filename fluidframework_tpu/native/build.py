@@ -52,7 +52,11 @@ def ensure_built(name: str, force: bool = False) -> str:
                 and os.path.getmtime(out) >= os.path.getmtime(src)):
             return out
         os.makedirs(LIB_DIR, exist_ok=True)
-        cmd = [_CXX, *_FLAGS, src, "-o", out]
+        # CPython-C-API sources (loaded with ctypes.PyDLL) need the
+        # interpreter headers; the include dir is harmless for the rest.
+        import sysconfig
+        cmd = [_CXX, *_FLAGS, "-I" + sysconfig.get_paths()["include"],
+               src, "-o", out]
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise NativeBuildError(

@@ -1,0 +1,73 @@
+// Native op packer: per-document HostOp streams -> packed int32 columns.
+//
+// The ingest path's hot host loop (mergetree/oppack.py pack_ops) converts
+// ~1M Python ints per 100k ops; pure-Python/numpy conversion measured ~18x
+// slower than the device applies the same ops (PERF.md ingest note). This
+// walks the object graph once with the CPython C API (HostOp is a
+// NamedTuple, i.e. a tuple: PyTuple_GET_ITEM + PyLong_AsLong per field)
+// and writes straight into a caller-owned [n_fields, B, T] int32 buffer.
+//
+// Loaded with ctypes.PyDLL (GIL held throughout: we touch Python objects).
+// Returns 0 on success; d+1 when document d overflows t steps; a negative
+// code when the input shape is not the expected list-of-lists-of-tuples
+// (callers fall back to the Python path).
+
+#include <Python.h>
+
+#include <cstdint>
+
+extern "C" long pack_into(PyObject* streams, int32_t* dst, long b, long t,
+                          long nf) {
+    PyObject* fast_streams =
+        PySequence_Fast(streams, "streams must be a sequence");
+    if (fast_streams == nullptr) {
+        PyErr_Clear();
+        return -1;
+    }
+    if (PySequence_Fast_GET_SIZE(fast_streams) != b) {
+        Py_DECREF(fast_streams);
+        return -2;
+    }
+    long rc = 0;
+    for (long d = 0; d < b && rc == 0; ++d) {
+        PyObject* stream = PySequence_Fast_GET_ITEM(fast_streams, d);
+        PyObject* fs = PySequence_Fast(stream, "stream must be a sequence");
+        if (fs == nullptr) {
+            PyErr_Clear();
+            rc = -1;
+            break;
+        }
+        const long n = PySequence_Fast_GET_SIZE(fs);
+        if (n > t) {
+            Py_DECREF(fs);
+            rc = d + 1;  // overflow report: which document
+            break;
+        }
+        for (long i = 0; i < n && rc == 0; ++i) {
+            PyObject* op = PySequence_Fast_GET_ITEM(fs, i);
+            if (!PyTuple_Check(op) || PyTuple_GET_SIZE(op) != nf) {
+                rc = -3;
+                break;
+            }
+            for (long f = 0; f < nf; ++f) {
+                const long v = PyLong_AsLong(PyTuple_GET_ITEM(op, f));
+                if (v == -1 && PyErr_Occurred()) {
+                    PyErr_Clear();
+                    rc = -4;
+                    break;
+                }
+                if (v < INT32_MIN || v > INT32_MAX) {
+                    // The Python fallback raises OverflowError here; a
+                    // silent wrap could alias sentinel values. Hand the
+                    // input back to the fallback to get the same error.
+                    rc = -5;
+                    break;
+                }
+                dst[(f * b + d) * t + i] = static_cast<int32_t>(v);
+            }
+        }
+        Py_DECREF(fs);
+    }
+    Py_DECREF(fast_streams);
+    return rc;
+}

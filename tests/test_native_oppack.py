@@ -1,0 +1,67 @@
+"""Native op packer (native/src/oppack.cpp): C-speed HostOp-stream
+packing, bit-identical to the pure-Python fallback."""
+
+import random
+
+import numpy as np
+import pytest
+
+import fluidframework_tpu.mergetree.oppack as oppack
+from fluidframework_tpu.mergetree.oppack import (HostOp, OpKind, _FIELDS,
+                                                 pack_ops)
+
+
+def random_streams(rng, b=17, t_max=9):
+    streams = []
+    for d in range(b):
+        n = rng.randrange(0, t_max)
+        streams.append([HostOp(
+            kind=rng.randrange(0, 6), seq=rng.randrange(0, 10_000),
+            ref_seq=rng.randrange(0, 10_000), client=rng.randrange(-1, 8),
+            pos1=rng.randrange(0, 500), pos2=rng.randrange(0, 500),
+            op_id=rng.randrange(-1, 1000), new_len=rng.randrange(0, 64),
+            local_seq=rng.randrange(0, 100), msn=rng.randrange(0, 10_000))
+            for _ in range(n)])
+    return streams
+
+
+@pytest.fixture
+def native():
+    fn = oppack._native_pack()
+    if fn is None:
+        pytest.skip("native toolchain unavailable")
+    return fn
+
+
+class TestNativePacker:
+    def test_matches_python_fallback(self, native):
+        rng = random.Random(42)
+        streams = random_streams(rng)
+        fast = pack_ops(streams)
+        oppack._NATIVE_PACK = False
+        try:
+            ref = pack_ops(streams)
+        finally:
+            oppack._NATIVE_PACK = None
+        for f in _FIELDS:
+            np.testing.assert_array_equal(np.asarray(getattr(fast, f)),
+                                          np.asarray(getattr(ref, f)), f)
+
+    def test_empty_and_ragged_streams(self, native):
+        packed = pack_ops([[], [HostOp(kind=OpKind.INSERT, seq=1,
+                                       ref_seq=0, client=0, new_len=2)], []])
+        assert packed.kind.shape == (3, 1)
+        assert int(np.asarray(packed.new_len)[1, 0]) == 2
+        assert int(np.asarray(packed.kind)[0, 0]) == OpKind.NOOP
+
+    def test_oversized_stream_reports_doc(self, native):
+        ops = [HostOp(kind=OpKind.NOOP, seq=i, ref_seq=0, client=0)
+               for i in range(5)]
+        with pytest.raises(ValueError, match="doc 1"):
+            pack_ops([[], ops], steps=3)
+
+    def test_out_of_int32_falls_back_and_raises(self, native):
+        bad = [HostOp(kind=OpKind.INSERT, seq=2**31 + 7, ref_seq=0,
+                      client=0)]
+        with pytest.raises(OverflowError):
+            pack_ops([bad])
