@@ -89,6 +89,77 @@ def run_baseline(cols, sample_docs: int, n_ops: int) -> float:
     return total / elapsed
 
 
+def _serving_ingest_rate(docs: int = 1024, ops_per_doc: int = 24) -> float:
+    """End-to-end SERVING ingest throughput: pre-built wire boxcars
+    through the real TpuSequencerLambda — parse, native op-pack, device
+    ticketing + merge-lane apply. This is the whole partition-lambda
+    path, host overheads included (the headline metric times only the
+    device pipeline). ops_per_doc stays under the first capacity bucket
+    so the metric reflects steady-state ingest; overflow bursts take the
+    batched group-promotion recovery (MergeLaneStore._recover_batch),
+    which is correct but not the rate this number represents."""
+    if os.environ.get("BENCH_INGEST", "1") == "0":
+        return 0.0
+    import json as _json
+    import random as _random
+
+    from fluidframework_tpu.mergetree.client import OP_INSERT
+    from fluidframework_tpu.protocol.messages import (Boxcar,
+                                                      DocumentMessage,
+                                                      MessageType)
+    from fluidframework_tpu.server.log import QueuedMessage
+    from fluidframework_tpu.server.tpu_sequencer import TpuSequencerLambda
+
+    class _Ctx:
+        def checkpoint(self, *_):
+            pass
+
+        def error(self, err, restart=False):
+            raise err
+
+    def build_messages():
+        rng = _random.Random(17)
+        out = []
+        for d in range(docs):
+            doc = f"d{d}"
+            contents = [DocumentMessage(
+                client_sequence_number=0, reference_sequence_number=-1,
+                type=MessageType.CLIENT_JOIN,
+                data=_json.dumps({"clientId": f"c{d}", "detail": {}}))]
+            length = 0
+            for i in range(ops_per_doc):
+                n = rng.randrange(1, 4)
+                pos = rng.randrange(length + 1)
+                length += n
+                contents.append(DocumentMessage(
+                    client_sequence_number=i + 1,
+                    reference_sequence_number=0,
+                    type=MessageType.OPERATION,
+                    contents={"address": "s", "contents": {
+                        "address": "t", "contents": {
+                            "type": OP_INSERT, "pos1": pos,
+                            "seg": {"text": "x" * n}}}}))
+            out.append(QueuedMessage(
+                topic="rawdeltas", partition=0, offset=d, key=doc,
+                value=Boxcar(tenant_id="b", document_id=doc,
+                             client_id=f"c{d}", contents=contents)))
+        return out
+
+    def run():
+        msgs = build_messages()
+        lam = TpuSequencerLambda(_Ctx(), emit=lambda *a: None,
+                                 nack=lambda *a: None)
+        t0 = time.perf_counter()
+        for qm in msgs:
+            lam.handler(qm)
+        lam.flush()
+        return time.perf_counter() - t0
+
+    run()  # compile warmup (same shapes: same doc count + T bucket)
+    elapsed = run()
+    return round(docs * ops_per_doc / elapsed, 1)
+
+
 def _init_backend_or_fallback():
     """Initialize the jax backend, falling back to CPU on failure OR hang.
 
@@ -254,6 +325,11 @@ def main() -> None:
     ragged_overflow = any(bool(np.asarray(r[1].overflow).any())
                           for r in routs)
     ragged_rate = round(ragged_ops / ragged_s, 1) if ragged_s else 0.0
+
+    # End-to-end SERVING ingest: wire DocumentMessages through the real
+    # TpuSequencerLambda (parse -> native pack -> device ticket+apply) —
+    # the whole partition-lambda path, not just the device half.
+    ingest_rate = _serving_ingest_rate()
     result = {
         "metric": "merge-tree ops applied/sec across "
                   f"{n_docs} docs (ticket+apply+summary-len)",
@@ -273,6 +349,7 @@ def main() -> None:
             "ragged_docs": sum(rb for rb, _, _ in ragged_buckets),
             "ragged_total_ops": ragged_ops,
             "ragged_overflow": ragged_overflow,
+            "serving_ingest_ops_per_sec": ingest_rate,
             "overflow": overflow,
         },
     }

@@ -480,3 +480,46 @@ class SharedString(SharedSegmentSequence):
 
     def get_text(self) -> str:
         return self.client.get_text()
+
+    # -- marker queries (reference mergeTree getMarkerFromId /
+    #    searchForMarker via tile labels) -----------------------------------
+    def _visible_markers(self):
+        """Yield (position, props) per visible marker, ascending."""
+        from ..mergetree.constants import SEG_MARKER
+        tree = self.client.tree
+        acc = 0
+        for seg in tree.segments:
+            vlen = tree.visible_length(seg, tree.current_seq,
+                                       self.client.client_id)
+            if vlen == 0:
+                continue
+            if seg.kind == SEG_MARKER:
+                yield acc, (seg.props or {})
+            acc += vlen
+
+    def get_marker_from_id(self, marker_id: str) -> Optional[tuple]:
+        """(position, props) of the visible marker whose props carry
+        {"markerId": marker_id} (reference reservedMarkerIdKey), or None."""
+        for pos, props in self._visible_markers():
+            if props.get("markerId") == marker_id:
+                return pos, props
+        return None
+
+    def search_for_marker(self, start_pos: int, label: str,
+                          forwards: bool = True) -> Optional[tuple]:
+        """Nearest visible marker at/after (forwards) or at/before
+        (backwards) start_pos whose {"tileLabels": [...]} props contain
+        `label` (reference searchForMarker over tile labels). Returns
+        (position, props) or None."""
+        best = None
+        for pos, props in self._visible_markers():
+            if label not in (props.get("tileLabels") or []):
+                continue
+            if forwards:
+                if pos >= start_pos:
+                    return pos, props
+            elif pos <= start_pos:
+                best = (pos, props)  # keep scanning: last one wins
+            else:
+                break
+        return best

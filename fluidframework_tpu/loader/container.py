@@ -306,6 +306,12 @@ class Container(TypedEventEmitter):
                     self.runtime.process_channel_bulk(channel_msgs)
                     for msg in run:
                         self.protocol.process_message(msg)
+                    # The bulk path bypasses runtime.process, so advance
+                    # its seq bookkeeping explicitly — a summarize right
+                    # after catch-up stamps these into .metadata.
+                    self.runtime.sequence_number = run[-1].sequence_number
+                    self.runtime.minimum_sequence_number = \
+                        run[-1].minimum_sequence_number
                 except (BulkApplyUnsupported, ValueError):
                     # Channel state untouched: process the WHOLE detected
                     # run scalar (re-attempting bulk on its suffix would

@@ -106,18 +106,19 @@ class _MergeBucket:
             lambda b, r: b.at[lane].set(r), self.state, row)
 
 
-def _repad_row(row: DocState, capacity: int) -> DocState:
-    """Re-pad a single-doc state to a larger capacity (bucket promotion)."""
-    base = make_state(capacity, anno_slots=row.anno_slots,
-                      overlap_slots=row.rem_clients.shape[-1])
-    c = row.capacity
+def _repad_batch(rows: DocState, capacity: int) -> DocState:
+    """Re-pad a [n, ...] sub-batch to a larger capacity (group promotion)."""
+    n = rows.length.shape[0]
+    base = make_state(capacity, anno_slots=rows.anno_slots,
+                      overlap_slots=rows.rem_clients.shape[-1], batch=n)
+    c = rows.capacity
 
     def widen(dst, src):
-        if src.ndim == 0:
+        if src.ndim <= 1:
             return src
-        return dst.at[:c].set(src)
+        return dst.at[:, :c].set(src)
 
-    return jax.tree_util.tree_map(widen, base, row)
+    return jax.tree_util.tree_map(widen, base, rows)
 
 
 # Non-donating applies (kernel.apply_ops*_keep): the serving path keeps the
@@ -125,8 +126,6 @@ def _repad_row(row: DocState, capacity: int) -> DocState:
 # rebuilt on the recovery path (jax arrays are immutable; retaining the
 # input is free).
 _apply_keep_batched = kernel.apply_ops_batched_keep
-_apply_keep_single = kernel.apply_ops_keep
-_compact_single = kernel.compact
 
 
 class MergeLaneStore:
@@ -242,49 +241,94 @@ class MergeLaneStore:
                     new_state = jax.tree_util.tree_map(
                         lambda bcol, r: bcol.at[i].set(r), new_state, row)
             bucket.state = new_state
-            for i in flagged:
-                self._recover(b, i, lane_ops[i])
+            if flagged:
+                # One BATCHED compact->rerun->promote per level — per-lane
+                # device round-trips over a thin host link turn a 1k-lane
+                # overflow burst into minutes. Lane counts pad to powers of
+                # two so the compiled shapes stay bounded.
+                self._recover_batch(b, {i: lane_ops[i] for i in flagged})
 
         self.flushes_since_compact += 1
         if self.flushes_since_compact >= self.compact_every:
             self.compact_all()
 
-    def _recover(self, b: int, lane: int, ops: List[HostOp]) -> None:
-        """Overflowed lane: zamboni-compact and re-run in place; if it still
-        overflows, promote to the next capacity bucket (repeat upward)."""
+    @staticmethod
+    def _pad_pow2(sub: DocState, packed: PackedOps, n: int,
+                  capacity: int):
+        """Pad a recovery sub-batch to a power-of-two lane count with
+        empty rows + NOOP streams: the compiled (lanes, capacity, t)
+        shapes stay bounded at log2 variants instead of one per distinct
+        overflow-burst size."""
+        tm = jax.tree_util.tree_map
+        n_pad = 1 << max(n - 1, 0).bit_length()
+        if n_pad == n:
+            return sub, packed
+        base = make_state(capacity, anno_slots=sub.anno_slots,
+                          overlap_slots=sub.rem_clients.shape[-1],
+                          batch=n_pad)
+        sub = tm(lambda full, s: full.at[:n].set(s)
+                 if getattr(full, "ndim", 0) else s, base, sub)
+        packed = tm(lambda x: jnp.concatenate(
+            [x, jnp.zeros((n_pad - n,) + x.shape[1:], x.dtype)], 0), packed)
+        return sub, packed
+
+    def _recover_batch(self, b: int,
+                       lane_ops: Dict[int, List[HostOp]]) -> None:
+        """Batched overflow recovery (the only recovery path — one lane is
+        a batch of one): stack the flagged lanes' pre-flush rows into a
+        sub-batch, compact + re-run them together, then group-promote the
+        still-overflowing remainder upward; opaque at exhaustion."""
+        tm = jax.tree_util.tree_map
+        lanes = sorted(lane_ops)
+        n = len(lanes)
         bucket = self.buckets[b]
-        key = bucket.used[lane]
-        row = bucket.row(lane)
-        t = _bucket(len(ops), self.t_buckets)
-        packed = pack_ops([ops], steps=t)
-        single = PackedOps(**{f: getattr(packed, f)[0]
-                              for f in PackedOps._fields})
-        # Attempt 1: compact in place (frees min_seq-passed tombstones).
-        compacted = _compact_single(row)
-        redone = _apply_keep_single(compacted, single)
-        if not bool(np.asarray(redone.overflow)):
-            bucket.put_row(lane, redone)
-            return
-        # Promote upward until it fits.
-        bucket.free(lane)
-        src_row = compacted
+        take = np.asarray(lanes)
+        sub = tm(lambda x: x[take] if getattr(x, "ndim", 0) else x,
+                 bucket.state)
+        t = _bucket(max(len(v) for v in lane_ops.values()), self.t_buckets)
+        packed = pack_ops([lane_ops[i] for i in lanes], steps=t)
+        sub, packed = self._pad_pow2(sub, packed, n, bucket.capacity)
+        # Attempt 1: compact in place and re-run at this capacity.
+        compacted = kernel.compact_batched(sub)
+        redone = _apply_keep_batched(compacted, packed)
+        over = np.asarray(redone.overflow)
+        carried: List[tuple] = []   # keys still overflowing
+        keep: List[int] = []        # their row indices into src/packed
+        for j, i in enumerate(lanes):
+            if over[j]:
+                carried.append(bucket.used[i])
+                keep.append(j)
+                bucket.free(i)
+            else:
+                bucket.put_row(i, tm(lambda x: x[j], redone))
+        src = compacted
         for nb in range(b + 1, len(self.buckets)):
-            target = self.buckets[nb]
-            wide = _repad_row(src_row, target.capacity)
-            redone = _apply_keep_single(wide, single)
-            if not bool(np.asarray(redone.overflow)):
-                new_lane = target.alloc(key)
-                target.put_row(new_lane, redone)
-                self.where[key] = (nb, new_lane)
+            if not carried:
                 return
-            src_row = wide
-        # Exhausted every bucket: degrade THIS channel to opaque instead of
-        # killing the partition pump — sequencing continues for every other
-        # document; only this channel's server-side materialization is lost
-        # (clients are unaffected; they hold their own replicas).
-        del self.where[key]
-        self.opaque.add(key)
-        self.overflow_drops += 1
+            n = len(keep)
+            sel = np.asarray(keep)
+            src = tm(lambda x: x[sel] if getattr(x, "ndim", 0) else x, src)
+            packed = tm(lambda x: x[sel], packed)
+            target = self.buckets[nb]
+            wide = _repad_batch(src, target.capacity)
+            wide, packed = self._pad_pow2(wide, packed, n, target.capacity)
+            redone = _apply_keep_batched(wide, packed)
+            over = np.asarray(redone.overflow)
+            next_carried, next_keep = [], []
+            for k, key in enumerate(carried):
+                if not over[k]:
+                    new_lane = target.alloc(key)
+                    target.put_row(new_lane, tm(lambda x: x[k], redone))
+                    self.where[key] = (nb, new_lane)
+                else:
+                    next_carried.append(key)
+                    next_keep.append(k)
+            carried, keep = next_carried, next_keep
+            src = wide
+        for key in carried:
+            del self.where[key]
+            self.opaque.add(key)
+            self.overflow_drops += 1
 
     def compact_all(self) -> None:
         """Zamboni every bucket (reference mergeTree.ts:1422, run between

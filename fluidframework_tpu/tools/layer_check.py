@@ -23,7 +23,9 @@ ALLOWED: Dict[str, Set[str]] = {
     "protocol": {"core"},
     "telemetry": {"core", "protocol"},
     "parallel": {"core"},
-    "mergetree": {"core", "protocol", "telemetry", "parallel"},
+    # mergetree's oppack rides the native C packer when the toolchain is
+    # present (native/src/oppack.cpp — the ingest hot path).
+    "mergetree": {"core", "protocol", "telemetry", "parallel", "native"},
     # native is the C++ transport under the server; it shares the server's
     # queued-message types (the reference's librdkafka binding lives inside
     # the services package the same way).

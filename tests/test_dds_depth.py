@@ -221,3 +221,38 @@ class TestMatrixConsumers:
         a.close_matrix(rec)
         a.insert_rows(0, 1)
         assert rec.rows == [(0, 1)]
+
+
+class TestMarkerQueries:
+    def _string(self):
+        from fluidframework_tpu.dds.sequence import SharedString
+        env = MockSequencedEnvironment()
+        r1, r2, a, b = pair(env, SharedString, "text")
+        return env, a, b
+
+    def test_get_marker_from_id(self):
+        env, a, b = self._string()
+        a.insert_text(0, "hello world")
+        a.insert_marker(5, {"markerId": "sep", "style": "line"})
+        env.process_all()
+        pos, props = b.get_marker_from_id("sep")
+        assert pos == 5 and props["style"] == "line"
+        assert b.get_marker_from_id("ghost") is None
+        # Markers keep their identity through concurrent edits.
+        b.insert_text(0, ">> ")
+        env.process_all()
+        assert a.get_marker_from_id("sep")[0] == 8
+
+    def test_search_for_marker_both_directions(self):
+        env, a, b = self._string()
+        a.insert_text(0, "0123456789")
+        a.insert_marker(2, {"tileLabels": ["pg"], "n": 1})
+        a.insert_marker(7, {"tileLabels": ["pg"], "n": 2})
+        a.insert_marker(9, {"tileLabels": ["hdr"], "n": 3})
+        env.process_all()
+        assert b.search_for_marker(0, "pg")[1]["n"] == 1
+        assert b.search_for_marker(3, "pg")[1]["n"] == 2
+        assert b.search_for_marker(8, "pg", forwards=False)[1]["n"] == 2
+        assert b.search_for_marker(1, "pg", forwards=False) is None
+        assert b.search_for_marker(3, "hdr")[1]["n"] == 3
+        assert b.search_for_marker(12, "pg") is None

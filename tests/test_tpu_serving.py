@@ -814,3 +814,26 @@ class TestSnapshotSeededLanes:
         c2 = loader.resolve("big-counter")
         k2 = c2.runtime.get_datastore("default").get_channel("clicks")
         assert k2.value == 3_000_000_005
+
+    def test_mass_overflow_batch_promotes_all_lanes(self):
+        """A burst overflowing MANY lanes at once recovers via the batched
+        compact->rerun->group-promote path with identical results to the
+        per-lane recovery."""
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server, "burst")
+        texts = [ds1.create_channel(f"t{i}", SharedString.TYPE)
+                 for i in range(6)]
+        c1.attach()
+        server.auto_pump = False
+        for i, tx in enumerate(texts):
+            for j in range(80):  # ~80+ segments: overflows the 64 bucket
+                tx.insert_text(0, f"{i}.{j},")
+        server.auto_pump = True
+        server.pump()
+        sq = server.sequencer()
+        assert sq.merge.overflow_drops == 0
+        for i, tx in enumerate(texts):
+            mat = sq.channel_text("burst", "default", f"t{i}")
+            assert mat == tx.get_text(), f"t{i}"
+            b, _lane = sq.merge.where[("burst", "default", f"t{i}")]
+            assert sq.merge.capacities[b] > 64  # promoted out of bucket 0
