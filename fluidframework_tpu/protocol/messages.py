@@ -130,6 +130,7 @@ class SequencedDocumentMessage:
 # Nack reason codes (reference INackContent semantics: deli/lambda.ts nacks).
 NACK_BAD_REF_SEQ = 400
 NACK_DUPLICATE = 409
+NACK_TOO_LARGE = 413
 NACK_THROTTLED = 429
 NACK_NOT_WRITER = 403
 
@@ -148,6 +149,20 @@ class Nack:
     operation: Optional[DocumentMessage]
     sequence_number: int
     content: NackContent
+
+
+def op_size(msg: "DocumentMessage") -> int:
+    """Approximate serialized size of one client message — the wire-level
+    op-size ceiling (NACK_TOO_LARGE) measures with this at the server
+    front door. In-process drivers may carry payloads json cannot
+    measure; those pass (the network door only admits JSON frames)."""
+    try:
+        n = len(json.dumps(msg.contents)) if msg.contents is not None else 0
+        if msg.data is not None:
+            n += len(msg.data)
+        return n
+    except (TypeError, ValueError):
+        return 0
 
 
 @dataclass

@@ -30,8 +30,10 @@ from ..protocol.messages import (
     Nack,
     NackContent,
     NACK_NOT_WRITER,
+    NACK_TOO_LARGE,
     SequencedDocumentMessage,
     SignalMessage,
+    op_size,
 )
 from .database import DatabaseManager
 from .lambdas import (
@@ -79,6 +81,19 @@ class Connection(TypedEventEmitter):
                 NackContent(NACK_NOT_WRITER,
                             "read connections cannot submit ops")))
             return
+        # Op-size ceiling at the front door (reference alfred
+        # maxMessageSize): oversized content nacks 413 before entering the
+        # pipeline — the ONE choke point both sequencer paths sit behind,
+        # off the partition-lambda hot path. Clients chunk far below it.
+        limit = self.server.max_op_bytes
+        if limit:
+            for msg in messages:
+                if op_size(msg) > limit:
+                    self.emit("nack", Nack(
+                        msg, -1, NackContent(
+                            NACK_TOO_LARGE,
+                            f"op exceeds {limit} bytes")))
+                    return
         self.server._submit_boxcar(Boxcar(
             tenant_id=self.tenant_id, document_id=self.document_id,
             client_id=self.client_id, contents=list(messages)))
@@ -125,6 +140,11 @@ class LocalServer:
         self.tenant_id = tenant_id
         self.auto_pump = auto_pump
         self.overlapped = overlapped
+        # Front-door op-size ceiling (alfred.maxMessageSize; 0 disables).
+        self.max_op_bytes = 1024 * 1024
+        if config is not None:
+            self.max_op_bytes = int(config.get(
+                "alfred.maxMessageSize", self.max_op_bytes))
         self.log = make_message_log(default_partitions=partitions,
                                     native=native_log)
         self.db = db if db is not None else DatabaseManager()

@@ -200,3 +200,49 @@ class TestServerInternals:
         c1.attach()
         m.set("a", 1)
         assert len(server.raw_deltas) >= 2
+
+
+class TestOpSizeCeiling:
+    """Server-side max-op-size enforcement (reference alfred
+    maxMessageSize): oversized content nacks 413 on BOTH sequencer paths;
+    well-behaved clients chunk long before the ceiling."""
+
+    def _giant_and_ok(self, server):
+        from fluidframework_tpu.protocol.messages import (DocumentMessage,
+                                                          MessageType,
+                                                          NACK_TOO_LARGE)
+        conn = server.connect("doc")
+        nacks = []
+        conn.on("nack", nacks.append)
+        seq_before = server.sequence_number("doc")
+        conn.submit([DocumentMessage(
+            client_sequence_number=1, reference_sequence_number=0,
+            type=MessageType.OPERATION,
+            contents={"blob": "x" * (2 * 1024 * 1024)})])
+        assert nacks and nacks[-1].content.code == NACK_TOO_LARGE
+        assert server.sequence_number("doc") == seq_before
+        # A normal op still sequences afterwards.
+        conn.submit([DocumentMessage(
+            client_sequence_number=2, reference_sequence_number=0,
+            type=MessageType.OPERATION, contents={"ok": 1})])
+        assert server.sequence_number("doc") == seq_before + 1
+
+    def test_scalar_deli_nacks_oversized(self):
+        self._giant_and_ok(LocalServer())
+
+    def test_tpu_sequencer_nacks_oversized(self):
+        from fluidframework_tpu.server.local_server import TpuLocalServer
+        self._giant_and_ok(TpuLocalServer())
+
+    def test_chunked_large_op_still_round_trips(self):
+        """The client chunking path keeps every wire message under the
+        ceiling, so app-level ops far above 1MB still work end-to-end."""
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        m1 = ds1.create_channel("map", SharedMap.TYPE)
+        c1.attach()
+        c2 = loader.resolve("doc")
+        big = "y" * (3 * 1024 * 1024)
+        m1.set("big", big)
+        m2 = c2.runtime.get_datastore("default").get_channel("map")
+        assert m2.get("big") == big
