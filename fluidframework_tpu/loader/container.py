@@ -68,6 +68,9 @@ class Container(TypedEventEmitter):
         self._code_details: Optional[dict] = None
         self._last_summary_handle: Optional[str] = None
         self._summary_waiters: List[Callable[[str, bool, Any], None]] = []
+        import threading as _threading
+        self._nack_gate = _threading.Lock()
+        self._nack_recovery_live = False
 
     @property
     def op_lock(self):
@@ -213,9 +216,45 @@ class Container(TypedEventEmitter):
         self.emit("disconnected")
 
     def _on_nack(self, nack) -> None:
-        # Reconnect with a fresh identity and resubmit (deltaManager nack
-        # path: resubmit or fatal close; we resubmit).
+        """Nack dispatch (reference deltaManager: retryable -> resubmit,
+        non-retryable -> close):
+        - 413 (too large): resubmitting the identical op can never
+          succeed — close the container with an "error" event instead of
+          reconnect-looping forever.
+        - 429 (throttled): honor retryAfter on a WORKER thread — the nack
+          can arrive synchronously inside submit with the container lock
+          held, and sleeping there would stall every other thread.
+        - anything else: immediate reconnect + resubmit."""
+        from ..protocol.messages import NACK_THROTTLED, NACK_TOO_LARGE
+        content = getattr(nack, "content", None)
+        code = getattr(content, "code", None)
+        if code == NACK_TOO_LARGE:
+            self.emit("error", nack)
+            self.close()
+            return
+        if code == NACK_THROTTLED:
+            with self._nack_gate:
+                if self._nack_recovery_live:
+                    return  # one recovery in flight absorbs the storm
+                self._nack_recovery_live = True
+            import threading as _threading
+            _threading.Thread(
+                target=self._throttle_recover,
+                args=(getattr(content, "retry_after_s", None),),
+                daemon=True).start()
+            return
         self.reconnect()
+
+    def _throttle_recover(self, retry_after) -> None:
+        try:
+            if retry_after:
+                import time as _time
+                _time.sleep(min(float(retry_after), 5.0))
+            if not self.closed:
+                self.reconnect()
+        finally:
+            with self._nack_gate:
+                self._nack_recovery_live = False
 
     def reconnect(self) -> None:
         self._on_disconnect()

@@ -152,10 +152,35 @@ class Nack:
 
 
 def op_size(msg: "DocumentMessage") -> int:
-    """Approximate serialized size of one client message — the wire-level
-    op-size ceiling (NACK_TOO_LARGE) measures with this at the server
-    front door. In-process drivers may carry payloads json cannot
-    measure; those pass (the network door only admits JSON frames)."""
+    """CHEAP lower bound on one client message's payload size — the
+    op-size ceiling (NACK_TOO_LARGE) screens with this at the in-process
+    front door without re-serializing every op. Follows the envelope
+    "contents" chain (store -> channel -> op) summing string payloads at
+    each level, which covers every shape that actually gets big: text
+    inserts, LWW values, chunked-op pieces, system `data`. It is a
+    screen, not an exact measure — the network ingress additionally
+    applies `op_size_exact` to wire-parsed messages."""
+    n = len(msg.data) if isinstance(msg.data, str) else 0
+    node = msg.contents
+    depth = 0
+    while isinstance(node, dict) and depth < 8:
+        for key, value in node.items():
+            # The followed "contents" tail is measured at ITS level (or as
+            # the final string) — counting it here too would double-bill.
+            if key != "contents" and isinstance(value, str):
+                n += len(value)
+        node = node.get("contents")
+        depth += 1
+    if isinstance(node, str):
+        n += len(node)
+    return n
+
+
+def op_size_exact(msg: "DocumentMessage") -> int:
+    """Exact serialized payload size (full dumps) — the network ingress
+    measure, where one extra serialization is noise next to the socket
+    I/O. Unserializable in-process payloads screen as 0 (they never
+    arrive via the wire)."""
     try:
         n = len(json.dumps(msg.contents)) if msg.contents is not None else 0
         if msg.data is not None:

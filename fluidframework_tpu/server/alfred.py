@@ -65,8 +65,12 @@ class AlfredService:
                  host: str = "127.0.0.1", port: int = 0,
                  require_auth: bool = True,
                  partitions: int = 1,
-                 admin_key: Optional[str] = None):
+                 admin_key: Optional[str] = None,
+                 config=None):
+        """config: the nconf-style provider handed to each tenant core
+        (throttling, op-size ceiling, deli checkpoint/eviction knobs)."""
         self.tenants = tenants or TenantManager()
+        self.config = config
         self.require_auth = require_auth
         # Riddler's tenant CRUD/key routes are operator-only (the reference
         # deploys riddler on an internal network); when auth is on they
@@ -120,7 +124,8 @@ class AlfredService:
         with self._cores_lock:
             if tenant_id not in self._cores:
                 self._cores[tenant_id] = LocalServer(
-                    tenant_id=tenant_id, partitions=self.partitions)
+                    tenant_id=tenant_id, partitions=self.partitions,
+                    config=self.config)
             return self._cores[tenant_id]
 
     # -- auth --------------------------------------------------------------
@@ -503,8 +508,13 @@ class AlfredService:
                 msg = json.loads(ws.recv())
                 mtype = msg.get("type")
                 if mtype == "submitOp":
-                    conn.submit([document_message_from_dict(d)
-                                 for d in msg.get("messages", [])])
+                    messages = [document_message_from_dict(d)
+                                for d in msg.get("messages", [])]
+                    oversized = _oversized_of(messages, core.max_op_bytes)
+                    if oversized is not None:
+                        on_nack(oversized)
+                    else:
+                        conn.submit(messages)
                 elif mtype == "submitSignal":
                     conn.submit_signal(msg.get("content"))
                 elif mtype == "disconnect":
@@ -603,8 +613,16 @@ class AlfredService:
                   "error": f"unknown cid {cid!r}"})
             return
         if mtype == "submitOp":
-            conn.submit([document_message_from_dict(d)
-                         for d in msg.get("messages", [])])
+            messages = [document_message_from_dict(d)
+                        for d in msg.get("messages", [])]
+            oversized = _oversized_of(messages,
+                                      self.core(conn.tenant_id)
+                                      .max_op_bytes)
+            if oversized is not None:
+                send({"type": "nack", "cid": cid,
+                      "nack": nack_to_dict(oversized)})
+            else:
+                conn.submit(messages)
         elif mtype == "submitSignal":
             conn.submit_signal(msg.get("content"))
         elif mtype == "disconnect_document":
@@ -613,6 +631,20 @@ class AlfredService:
         else:
             send({"type": "error", "cid": cid,
                   "error": f"unknown message {mtype!r}"})
+
+
+def _oversized_of(messages, limit: int):
+    """Exact wire-side size screen: the Nack for the first message over
+    the ceiling, or None when all fit (reference alfred maxMessageSize)."""
+    from ..protocol.messages import (Nack, NackContent, NACK_TOO_LARGE,
+                                     op_size_exact)
+    if not limit:
+        return None
+    for m in messages:
+        if op_size_exact(m) > limit:
+            return Nack(m, -1, NackContent(
+                NACK_TOO_LARGE, f"op exceeds {limit} bytes"))
+    return None
 
 
 def _send_json(handler, status: int, payload: dict) -> None:

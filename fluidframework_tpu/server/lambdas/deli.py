@@ -287,5 +287,3 @@ def _join_detail(msg: DocumentMessage):
     if msg.data is not None:
         return json.loads(msg.data)
     return msg.contents or {}
-
-

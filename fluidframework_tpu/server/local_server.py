@@ -30,6 +30,7 @@ from ..protocol.messages import (
     Nack,
     NackContent,
     NACK_NOT_WRITER,
+    NACK_THROTTLED,
     NACK_TOO_LARGE,
     SequencedDocumentMessage,
     SignalMessage,
@@ -53,6 +54,35 @@ RAW_TOPIC = "rawdeltas"
 DELTAS_TOPIC = "deltas"
 
 
+class _TokenBucket:
+    """Per-connection op-rate limiter (reference alfred throttler):
+    refills at `rate` ops/s up to `burst`; take() returns 0.0 when
+    admitted or the seconds to wait (the 429 retryAfter)."""
+
+    def __init__(self, rate: float, burst: float):
+        import time as _time
+        self.rate = rate
+        self.burst = burst
+        self.tokens = burst
+        self._now = _time.monotonic
+        self.last = self._now()
+
+    def take(self, n: int = 1) -> float:
+        """Debt model: admitted whenever at least one token is available,
+        going negative for n > balance — a boxcar'd resubmit batch larger
+        than the burst must still be admittable EVENTUALLY (batches are
+        atomic and cannot split), it just pays the debt in future waits.
+        Classic take-n-or-nack would livelock such a batch forever."""
+        now = self._now()
+        self.tokens = min(self.burst,
+                          self.tokens + (now - self.last) * self.rate)
+        self.last = now
+        if self.tokens >= 1.0:
+            self.tokens -= n
+            return 0.0
+        return (1.0 - self.tokens) / self.rate
+
+
 class Connection(TypedEventEmitter):
     """A client's delta connection (the "websocket"). Events: "op"
     (SequencedDocumentMessage), "nack" (Nack), "signal" (SignalMessage),
@@ -70,6 +100,10 @@ class Connection(TypedEventEmitter):
         # entering the quorum or the MSN calculation (reference read/write
         # connection modes: only writers order a join op).
         self.mode = self.details.get("mode", "write")
+        # Throttle bucket is per DOCUMENT and lives on the server (the
+        # reference alfred throttler keys tenant+document): a client
+        # cannot mint a fresh budget by reconnecting.
+        self.bucket = server._throttle_bucket(document_id)
         self.connected = True
 
     def submit(self, messages: List[DocumentMessage]) -> None:
@@ -94,6 +128,16 @@ class Connection(TypedEventEmitter):
                             NACK_TOO_LARGE,
                             f"op exceeds {limit} bytes")))
                     return
+        if self.bucket is not None:
+            wait = self.bucket.take(len(messages))
+            if wait > 0:
+                # Reference alfred throttler: nack 429 with retryAfter;
+                # the client backs off and resubmits.
+                self.emit("nack", Nack(
+                    messages[0] if messages else None, -1,
+                    NackContent(NACK_THROTTLED, "op rate limit",
+                                retry_after_s=wait)))
+                return
         self.server._submit_boxcar(Boxcar(
             tenant_id=self.tenant_id, document_id=self.document_id,
             client_id=self.client_id, contents=list(messages)))
@@ -142,9 +186,20 @@ class LocalServer:
         self.overlapped = overlapped
         # Front-door op-size ceiling (alfred.maxMessageSize; 0 disables).
         self.max_op_bytes = 1024 * 1024
+        # Per-connection op-rate throttling (reference alfred throttler):
+        # disabled unless configured — in-process tests and benches hammer
+        # ops by design.
+        self.throttle_ops_per_s = 0.0
+        self.throttle_burst = 0.0
+        self._throttle_buckets: Dict[str, _TokenBucket] = {}
         if config is not None:
             self.max_op_bytes = int(config.get(
                 "alfred.maxMessageSize", self.max_op_bytes))
+            self.throttle_ops_per_s = float(config.get(
+                "alfred.throttling.opsPerSecond", 0))
+            self.throttle_burst = float(config.get(
+                "alfred.throttling.burst",
+                max(self.throttle_ops_per_s * 2, 10)))
         self.log = make_message_log(default_partitions=partitions,
                                     native=native_log)
         self.db = db if db is not None else DatabaseManager()
@@ -235,6 +290,16 @@ class LocalServer:
                           signal: SignalMessage) -> None:
         for listener in list(self._signal_rooms.get(document_id, [])):
             listener(signal)
+
+    def _throttle_bucket(self, document_id: str) -> Optional[_TokenBucket]:
+        if not self.throttle_ops_per_s:
+            return None
+        bucket = self._throttle_buckets.get(document_id)
+        if bucket is None:
+            bucket = _TokenBucket(self.throttle_ops_per_s,
+                                  self.throttle_burst)
+            self._throttle_buckets[document_id] = bucket
+        return bucket
 
     # -- the Alfred surface (connect/disconnect, catch-up, storage) --------
     def connect(self, document_id: str,

@@ -25,13 +25,13 @@ class Tinylicious:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  require_auth: bool = False, partitions: int = 1,
-                 admin_key: Optional[str] = None):
+                 admin_key: Optional[str] = None, config=None):
         self.tenants = TenantManager()
         self.tenants.create_tenant(DEFAULT_TENANT, key=DEFAULT_KEY)
         self.service = AlfredService(self.tenants, host=host, port=port,
                                      require_auth=require_auth,
                                      partitions=partitions,
-                                     admin_key=admin_key)
+                                     admin_key=admin_key, config=config)
 
     @property
     def admin_key(self) -> str:

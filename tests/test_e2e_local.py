@@ -246,3 +246,114 @@ class TestOpSizeCeiling:
         m1.set("big", big)
         m2 = c2.runtime.get_datastore("default").get_channel("map")
         assert m2.get("big") == big
+
+
+class TestThrottling:
+    """Per-connection op-rate limiting (reference alfred throttler):
+    429 nacks with retryAfter; clients back off and converge."""
+
+    def _server(self, rate, burst):
+        from fluidframework_tpu.core.config import ConfigProvider
+        cfg = ConfigProvider({"alfred": {"throttling": {
+            "opsPerSecond": rate, "burst": burst}}})
+        return LocalServer(config=cfg)
+
+    def test_burst_exceeded_nacks_429_with_retry_after(self):
+        from fluidframework_tpu.protocol.messages import (DocumentMessage,
+                                                          MessageType,
+                                                          NACK_THROTTLED)
+        server = self._server(rate=5, burst=3)
+        conn = server.connect("doc")
+        nacks = []
+        conn.on("nack", nacks.append)
+        for i in range(6):
+            conn.submit([DocumentMessage(
+                client_sequence_number=i + 1,
+                reference_sequence_number=0,
+                type=MessageType.OPERATION, contents={"i": i})])
+        assert nacks, "burst of 6 over burst=3 must throttle"
+        assert nacks[0].content.code == NACK_THROTTLED
+        assert nacks[0].content.retry_after_s > 0
+        # Admitted ops sequenced; throttled ones did not.
+        assert 0 < server.sequence_number("doc") - 1 < 6  # -1: the join
+
+    def test_bucket_refills_over_time(self):
+        import time as _time
+        server = self._server(rate=50, burst=2)
+        conn = server.connect("doc")
+        nacks = []
+        conn.on("nack", nacks.append)
+        from fluidframework_tpu.protocol.messages import (DocumentMessage,
+                                                          MessageType)
+
+        def push(i):
+            conn.submit([DocumentMessage(
+                client_sequence_number=i, reference_sequence_number=0,
+                type=MessageType.OPERATION, contents={})])
+        push(1)
+        push(2)
+        push(3)  # bucket empty: throttled
+        assert len(nacks) == 1
+        _time.sleep(0.1)  # 50/s refill: ~5 tokens
+        push(4)
+        assert len(nacks) == 1  # admitted after refill
+
+    def test_container_backs_off_and_converges(self):
+        import time as _time
+        server = self._server(rate=200, burst=5)
+        loader, c1, ds1 = make_doc(server)
+        m1 = ds1.create_channel("map", SharedMap.TYPE)
+        c1.attach()
+        for i in range(12):  # exceeds burst: nack -> retryAfter -> resubmit
+            m1.set(f"k{i}", i)
+        # Throttle recovery waits retryAfter on a worker thread, then
+        # reconnects + resubmits: wait for convergence.
+        want = {f"k{i}": i for i in range(12)}
+        deadline = _time.time() + 20
+        while _time.time() < deadline and dict(m1.items()) != want:
+            _time.sleep(0.05)
+        c2 = loader.resolve("doc")
+        m2 = c2.runtime.get_datastore("default").get_channel("map")
+        deadline = _time.time() + 20
+        while _time.time() < deadline and dict(m2.items()) != want:
+            _time.sleep(0.05)
+        assert dict(m2.items()) == want
+
+    def test_per_document_bucket_survives_reconnect(self):
+        """Reconnecting must not mint a fresh throttle budget (the bucket
+        is keyed by document on the server)."""
+        from fluidframework_tpu.protocol.messages import (DocumentMessage,
+                                                          MessageType,
+                                                          NACK_THROTTLED)
+        server = self._server(rate=1, burst=3)
+        conn = server.connect("doc")
+        nacks = []
+        conn.on("nack", nacks.append)
+        for i in range(3):
+            conn.submit([DocumentMessage(
+                client_sequence_number=i + 1,
+                reference_sequence_number=0,
+                type=MessageType.OPERATION, contents={})])
+        conn.disconnect()
+        conn2 = server.connect("doc")  # same doc: same (drained) bucket
+        nacks2 = []
+        conn2.on("nack", nacks2.append)
+        conn2.submit([DocumentMessage(
+            client_sequence_number=1, reference_sequence_number=0,
+            type=MessageType.OPERATION, contents={})])
+        assert nacks2 and nacks2[0].content.code == NACK_THROTTLED
+
+
+class TestOversizedNonRetryable:
+    def test_unchunkable_oversized_op_closes_container(self):
+        """A 413 is non-retryable: the container surfaces an error and
+        closes instead of reconnect-looping with the identical op."""
+        loader, c1, ds1 = make_doc(LocalServer())
+        m1 = ds1.create_channel("map", SharedMap.TYPE)
+        c1.attach()
+        c1.runtime.max_op_size = 8 * 1024 * 1024  # defeat client chunking
+        errors = []
+        c1.on("error", errors.append)
+        m1.set("too-big", "x" * (2 * 1024 * 1024))
+        assert errors and errors[0].content.code == 413
+        assert c1.closed
