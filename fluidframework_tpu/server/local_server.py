@@ -198,8 +198,12 @@ class LocalServer:
             self.throttle_ops_per_s = float(config.get(
                 "alfred.throttling.opsPerSecond", 0))
             self.throttle_burst = float(config.get(
-                "alfred.throttling.burst",
-                max(self.throttle_ops_per_s * 2, 10)))
+                "alfred.throttling.burst", 0))
+            if self.throttle_ops_per_s and self.throttle_burst <= 0:
+                # An explicit burst of 0 with a live rate would nack every
+                # op forever (empty bucket can never refill past 0):
+                # treat non-positive as "derive a sane default".
+                self.throttle_burst = max(self.throttle_ops_per_s * 2, 10)
         self.log = make_message_log(default_partitions=partitions,
                                     native=native_log)
         self.db = db if db is not None else DatabaseManager()

@@ -1,0 +1,130 @@
+"""Chaos farms: every subsystem interacting at once — random edits,
+reconnects, client churn, signals, aggressive heartbeats + ghost
+eviction + throttling — with convergence asserted EVERY round, plus the
+TPU serving path's device materialization byte-agreement. This is the
+cross-feature race detector; the per-feature farms live next to their
+features."""
+
+import random
+import time
+
+from fluidframework_tpu.core.config import ConfigProvider
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.server.local_server import LocalServer, TpuLocalServer
+
+
+def _chans(c):
+    d = c.runtime.get_datastore("default")
+    return d.get_channel("text"), d.get_channel("meta")
+
+
+class TestChaosFarm:
+    def test_all_features_interacting_converge(self):
+        rng = random.Random(991)
+        cfg = ConfigProvider({"deli": {"clientTimeoutMsec": 1500},
+                              "alfred": {"throttling": {
+                                  "opsPerSecond": 5000, "burst": 200}}})
+        server = LocalServer(config=cfg)
+        loader = Loader(LocalDocumentServiceFactory(server))
+        c0 = loader.create_detached("chaos")
+        ds = c0.runtime.create_datastore("default")
+        ds.create_channel("text", SharedString.TYPE)
+        ds.create_channel("meta", SharedMap.TYPE)
+        c0.attach()
+        clients = [c0] + [loader.resolve("chaos") for _ in range(3)]
+        for c in clients:
+            c.delta_manager.noop_threshold = 4
+            c.delta_manager.noop_idle_s = 0.3
+
+        for round_no in range(60):
+            for _ in range(rng.randrange(1, 7)):
+                c = rng.choice(clients)
+                if not c.connected:
+                    continue
+                t, m = _chans(c)
+                roll = rng.random()
+                try:
+                    if roll < 0.5:
+                        t.insert_text(
+                            rng.randrange(t.get_length() + 1),
+                            rng.choice("abcdef") * rng.randrange(1, 4))
+                    elif roll < 0.7 and t.get_length() > 2:
+                        a = rng.randrange(t.get_length() - 1)
+                        t.remove_text(a, min(t.get_length(),
+                                             a + rng.randrange(1, 3)))
+                    elif roll < 0.85:
+                        m.set(rng.choice("xyz"), rng.randrange(100))
+                    else:
+                        c.submit_signal("ping", round_no)
+                except ConnectionError:
+                    pass  # raced a churn action below
+            act = rng.random()
+            if act < 0.1:
+                rng.choice(clients).reconnect()
+            elif act < 0.15:
+                idx = rng.randrange(1, len(clients))
+                clients[idx].close()
+                clients[idx] = loader.resolve("chaos")
+                clients[idx].delta_manager.noop_threshold = 4
+                clients[idx].delta_manager.noop_idle_s = 0.3
+            if round_no % 17 == 0:
+                time.sleep(0.05)  # let eviction/heartbeat clocks tick
+            texts = {_chans(c)[0].get_text()
+                     for c in clients if c.connected}
+            assert len(texts) <= 1, (round_no, texts)
+            metas = [dict(_chans(c)[1].items())
+                     for c in clients if c.connected]
+            assert all(m == metas[0] for m in metas), round_no
+
+        late = loader.resolve("chaos")
+        assert _chans(late)[0].get_text() == _chans(clients[0])[0].get_text()
+        assert dict(_chans(late)[1].items()) == \
+            dict(_chans(clients[0])[1].items())
+
+    def test_tpu_serving_materialization_tracks_chaos(self):
+        rng = random.Random(77)
+        server = TpuLocalServer()
+        loader = Loader(LocalDocumentServiceFactory(server))
+        c0 = loader.create_detached("chaos2")
+        ds = c0.runtime.create_datastore("default")
+        t = ds.create_channel("text", SharedString.TYPE)
+        t.insert_text(0, "seeded-before-attach ")  # snapshot seeding path
+        ds.create_channel("meta", SharedMap.TYPE)
+        c0.attach()
+        clients = [c0] + [loader.resolve("chaos2") for _ in range(2)]
+
+        for round_no in range(30):
+            for _ in range(rng.randrange(1, 6)):
+                c = rng.choice(clients)
+                if not c.connected:
+                    continue
+                tx, m = _chans(c)
+                roll = rng.random()
+                try:
+                    if roll < 0.55:
+                        tx.insert_text(
+                            rng.randrange(tx.get_length() + 1),
+                            rng.choice("pqrs") * rng.randrange(1, 4))
+                    elif roll < 0.75 and tx.get_length() > 2:
+                        a = rng.randrange(tx.get_length() - 1)
+                        tx.remove_text(a, min(tx.get_length(),
+                                              a + rng.randrange(1, 3)))
+                    else:
+                        m.set(rng.choice("uvw"), rng.randrange(50))
+                except ConnectionError:
+                    pass
+            if rng.random() < 0.1:
+                rng.choice(clients).reconnect()
+            texts = {_chans(c)[0].get_text()
+                     for c in clients if c.connected}
+            assert len(texts) <= 1, round_no
+            mat = server.sequencer().channel_text("chaos2", "default",
+                                                  "text")
+            assert mat == _chans(clients[0])[0].get_text(), round_no
+        snap = server.sequencer().channel_snapshot("chaos2", "default",
+                                                   "meta")
+        assert snap["entries"] == dict(_chans(clients[0])[1].items())
+        assert server.sequencer().merge.overflow_drops == 0
