@@ -192,12 +192,19 @@ class TestChaosSeedSweep:
                 i = rng.randrange(1, len(clients))
                 clients[i].close()
                 clients[i] = loader.resolve("doc")
+                clients[i].delta_manager.noop_threshold = 5
+                clients[i].delta_manager.noop_idle_s = 0
             texts = {_chans(c)[0].get_text()
                      for c in clients if c.connected}
             assert len(texts) <= 1, (seed, rnd, server_cls.__name__)
+            metas = [dict(_chans(c)[1].items())
+                     for c in clients if c.connected]
+            assert all(m == metas[0] for m in metas), (seed, rnd)
         late = loader.resolve("doc")
         assert _chans(late)[0].get_text() == \
             _chans(clients[0])[0].get_text()
+        assert dict(_chans(late)[1].items()) == \
+            dict(_chans(clients[0])[1].items())
         if server_cls is TpuLocalServer:
             key = ("doc", "default", "text")
             sq = server.sequencer()
