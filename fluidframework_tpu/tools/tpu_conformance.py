@@ -1,0 +1,119 @@
+"""On-chip kernel conformance: fused Pallas apply vs scan kernel,
+bit-identity on the REAL device.
+
+The test suite proves fused==scan under the Pallas interpreter on CPU
+(tests/test_pallas_apply.py); this tool re-proves it on actual TPU
+hardware, where Mosaic lowering — not the interpreter — executes the
+kernel. Run before trusting a new chip/toolchain/jax version:
+
+    python -m fluidframework_tpu.tools.tpu_conformance          # quick
+    python -m fluidframework_tpu.tools.tpu_conformance --heavy  # +cap-1024
+
+Exits nonzero on any mismatch. Timing uses jitted chained reps (eager
+dispatch over a tunneled device pays a ~30-70 ms RPC floor per call and
+produces phantom numbers — PERF.md measurement note)."""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import time
+
+
+def _traces(b: int, t: int, seed: int, removes: bool = True):
+    from fluidframework_tpu.mergetree.oppack import HostOp, OpKind
+
+    rng = random.Random(seed)
+    out = []
+    for d in range(b):
+        ops, length, seq = [], 0, 0
+        for i in range(t):
+            seq += 1
+            if removes and length > 4 and rng.random() < 0.25:
+                a = rng.randrange(length - 2)
+                width = rng.randrange(1, 3)
+                ops.append(HostOp(kind=OpKind.REMOVE, seq=seq,
+                                  ref_seq=seq - 1, client=d % 3,
+                                  pos1=a, pos2=a + width, op_id=i))
+                length -= width
+                continue
+            n = rng.randrange(1, 4)
+            ops.append(HostOp(kind=OpKind.INSERT, seq=seq, ref_seq=seq - 1,
+                              client=d % 3, pos1=rng.randrange(length + 1),
+                              op_id=i, new_len=n))
+            length += n
+        out.append(ops)
+    return out
+
+
+def check(b: int, t: int, cap: int, seed: int) -> bool:
+    import jax
+    import numpy as np
+
+    from fluidframework_tpu.mergetree import kernel
+    from fluidframework_tpu.mergetree.oppack import pack_ops
+    from fluidframework_tpu.mergetree.pallas_apply import (
+        apply_ops_fused_pallas, tile_for_capacity)
+    from fluidframework_tpu.mergetree.state import make_state
+
+    packed = jax.device_put(pack_ops(_traces(b, t, seed)))
+    scan_j = jax.jit(lambda s, o: kernel.apply_ops_batched_keep(s, o))
+    fused_j = jax.jit(apply_ops_fused_pallas)
+
+    results = {}
+    for name, fn in (("scan", scan_j), ("fused", fused_j)):
+        st = jax.device_put(make_state(cap, 2, batch=b))
+        out = fn(st, packed)
+        jax.device_get(out.count)  # full completion
+        t0 = time.perf_counter()
+        chained = fn(jax.device_put(make_state(cap, 2, batch=b)), packed)
+        for _ in range(2):
+            chained = fn(chained._replace(overflow=out.overflow), packed)
+        jax.device_get(chained.count)
+        results[name] = (out, (time.perf_counter() - t0) / 3)
+
+    ref, scan_dt = results["scan"]
+    got, fused_dt = results["fused"]
+    ok = True
+    for f in ref._fields:
+        a, c = np.asarray(jax.device_get(getattr(ref, f))), \
+            np.asarray(jax.device_get(getattr(got, f)))
+        if not (a == c).all():
+            print(f"  MISMATCH in {f} (b={b} t={t} cap={cap} seed={seed})")
+            ok = False
+    tile = tile_for_capacity(cap)
+    print(f"  b={b} t={t} cap={cap} tile={tile}: "
+          f"{'OK' if ok else 'FAIL'}  scan {scan_dt*1e3:.1f}ms "
+          f"fused {fused_dt*1e3:.1f}ms")
+    return ok
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--heavy", action="store_true")
+    args = parser.parse_args()
+
+    import jax
+    backend = jax.default_backend()
+    print(f"backend: {backend}")
+    if backend not in ("tpu", "axon"):
+        print("no TPU reachable — run tests/test_pallas_apply.py for the "
+              "interpreter conformance instead")
+        return 2
+    from fluidframework_tpu.mergetree.pallas_apply import fused_available
+    if not fused_available():
+        print("fused kernel failed its probe on this backend")
+        return 3
+
+    shapes = [(512, 64, 256, 0), (2048, 100, 256, 1), (128, 48, 512, 2)]
+    if args.heavy:
+        shapes.append((512, 128, 1024, 3))   # narrow-tile 3-D op path
+    results = [check(*s) for s in shapes]  # run EVERY shape
+    ok = all(results)
+    print("CONFORMANCE", "OK" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
