@@ -102,6 +102,11 @@ class MuxDeltaConnection(TypedEventEmitter, IDocumentDeltaConnection):
             self.emit("signal", SignalMessage(
                 client_id=frame.get("clientId"),
                 content=frame.get("content")))
+        elif ftype == "error":
+            # Server-side per-document error (alfred's cid isolation):
+            # surface it to whoever listens; an unobserved error frame is
+            # at least observable, not silently identical to a dropped op.
+            self.emit("error", frame.get("error"))
 
     def _on_socket_dead(self) -> None:
         if not self._closed:
@@ -157,7 +162,6 @@ class MuxSocketManager:
                          token: Optional[str],
                          client_details: Optional[dict],
                          timeout: float = 30.0) -> MuxDeltaConnection:
-        ws = self._ensure_socket()
         cid = next(self._cids)
         # Register the connection BEFORE the handshake resolves: the server
         # broadcasts room frames the instant the document is joined, so ops
@@ -167,10 +171,16 @@ class MuxSocketManager:
         # this connection its "disconnect".
         conn = MuxDeltaConnection(self, cid, client_id=None,
                                   checkpoint_sequence_number=0)
-        conn._sock = ws
         deferred = Deferred()
-        deferred.sock = ws  # scope dead-socket cleanup to this socket
         with self._lock:
+            # Socket acquisition and registration are ONE atomic step: a
+            # concurrent last-rider detach either sees this registration
+            # (and keeps the socket) or finishes releasing the socket
+            # first (and _ensure_socket dials a fresh one) — it can never
+            # close the socket under a half-registered handshake.
+            ws = self._ensure_socket()
+            conn._sock = ws
+            deferred.sock = ws  # scope dead-socket cleanup to this socket
             self._handshakes[cid] = deferred
             self._conns[cid] = conn
         try:
@@ -183,15 +193,14 @@ class MuxSocketManager:
                     f"connect_document rejected: "
                     f"{hello.get('error', hello)}")
         except BaseException:
+            # Unregister the handshake BEFORE detaching so detach's
+            # last-rider count sees the truth; detach then tells the
+            # server to let go of the document (it may have joined — e.g.
+            # handshake timeout raced the reply) AND releases the socket +
+            # reader thread if this failed connect was the only rider.
             with self._lock:
-                self._conns.pop(cid, None)
-            # The server may have joined the document (e.g. handshake
-            # timeout raced the reply): tell it to let go, or its side of
-            # the cid broadcasts into the void for the socket's lifetime.
-            try:
-                self.send({"type": "disconnect_document", "cid": cid})
-            except ConnectionError:
-                pass
+                self._handshakes.pop(cid, None)
+            self.detach(cid)
             raise
         finally:
             with self._lock:
@@ -201,20 +210,28 @@ class MuxSocketManager:
         return conn
 
     def detach(self, cid: int) -> None:
+        # The last-rider DECISION commits under the lock by unpublishing
+        # the socket (racing connect_documents then dial fresh instead of
+        # adopting a socket mid-teardown), but the teardown I/O itself
+        # runs outside it — a blocked send must not stall the reader
+        # thread's per-frame lock acquisitions for sibling documents.
         with self._lock:
             self._conns.pop(cid, None)
             last = not self._conns and not self._handshakes
             ws = self._ws
-        if ws is None or ws.closed:
-            return
+            if ws is None or ws.closed:
+                return
+            if last:
+                self._ws = None  # released: no new rider adopts it
         try:
-            self.send({"type": "disconnect_document", "cid": cid})
+            ws.send_text(json.dumps(
+                {"type": "disconnect_document", "cid": cid}))
             if last:
                 # Last rider gone: release the physical socket (odsp
                 # socket-reference refcount reaching zero).
-                self.send({"type": "disconnect"})
+                ws.send_text(json.dumps({"type": "disconnect"}))
                 ws.close()
-        except ConnectionError:
+        except (websocket.WebSocketClosed, OSError):
             pass
 
     def _read_loop(self, ws: websocket.WebSocketConnection) -> None:
@@ -223,12 +240,18 @@ class MuxSocketManager:
                 frame = json.loads(ws.recv())
                 cid = frame.get("cid")
                 ftype = frame.get("type")
-                if ftype in ("connected", "connect_error"):
+                if ftype in ("connected", "connect_error", "error"):
+                    # Generic "error" frames settle a pending handshake on
+                    # the same cid too (an older/foreign server answering a
+                    # failed connect_document that way must fail the
+                    # connect fast, not let it sit out the 30s timeout).
                     with self._lock:
                         handshake = self._handshakes.get(cid)
                     if handshake is not None:
                         handshake.resolve(frame)
-                    continue
+                        continue
+                    if ftype != "error":
+                        continue
                 with self._lock:
                     conn = self._conns.get(cid)
                 if conn is None:

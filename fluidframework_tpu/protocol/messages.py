@@ -160,7 +160,18 @@ def op_size(msg: "DocumentMessage") -> int:
     inserts, LWW values, chunked-op pieces, system `data`. It is a
     screen, not an exact measure — the network ingress additionally
     applies `op_size_exact` to wire-parsed messages."""
-    n = len(msg.data) if isinstance(msg.data, str) else 0
+    def _bytes(s: str) -> int:
+        # The wire serializer is json.dumps with ensure_ascii, so every
+        # non-ASCII char costs 6+ bytes (\\uXXXX). unicode_escape is a
+        # cheap LOWER bound of that (4-10 bytes/char escaped, ASCII ~1:1)
+        # and far tighter than char count, keeping the front-door screen
+        # close to what the websocket ingress will bill exactly.
+        try:
+            return len(s.encode("unicode_escape"))
+        except UnicodeEncodeError:  # defensive: bill 1 byte/char
+            return len(s)
+
+    n = _bytes(msg.data) if isinstance(msg.data, str) else 0
     node = msg.contents
     depth = 0
     while isinstance(node, dict) and depth < 8:
@@ -168,11 +179,11 @@ def op_size(msg: "DocumentMessage") -> int:
             # The followed "contents" tail is measured at ITS level (or as
             # the final string) — counting it here too would double-bill.
             if key != "contents" and isinstance(value, str):
-                n += len(value)
+                n += _bytes(value)
         node = node.get("contents")
         depth += 1
     if isinstance(node, str):
-        n += len(node)
+        n += _bytes(node)
     return n
 
 
@@ -182,9 +193,12 @@ def op_size_exact(msg: "DocumentMessage") -> int:
     I/O. Unserializable in-process payloads screen as 0 (they never
     arrive via the wire)."""
     try:
+        # json.dumps default ensure_ascii escapes non-ASCII, so its char
+        # count IS its byte count — and `data` is serialized inside the
+        # same dumps on the wire (wire.py), so it is billed escaped too.
         n = len(json.dumps(msg.contents)) if msg.contents is not None else 0
         if msg.data is not None:
-            n += len(msg.data)
+            n += len(json.dumps(msg.data)) - 2  # minus the quotes
         return n
     except (TypeError, ValueError):
         return 0

@@ -591,8 +591,17 @@ class AlfredService:
                 send({"type": "connect_error", "cid": cid,
                       "error": "cid already connected"})
                 return
-            core = self.core(tenant_id)
-            conn = core.connect(document_id, msg.get("client"))
+            try:
+                core = self.core(tenant_id)
+                conn = core.connect(document_id, msg.get("client"))
+            except Exception as exc:  # noqa: BLE001 — fail the handshake
+                # Answer with connect_error, not the generic error frame:
+                # the client routes only connect_error/connected to the
+                # pending handshake, so anything else leaves
+                # connect_document blocked for its full timeout.
+                send({"type": "connect_error", "cid": cid,
+                      "error": repr(exc)})
+                return
             conns[cid] = conn
             conn.on("op", lambda m, c=cid: send(
                 {"type": "op", "cid": c,

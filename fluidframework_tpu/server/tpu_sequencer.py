@@ -78,6 +78,7 @@ class _MergeBucket:
         self.lanes = lanes
         self.state: DocState = make_state(capacity, batch=lanes)
         self.used: List[Optional[tuple]] = [None] * lanes  # lane key or None
+        self._blank_row: Optional[DocState] = None  # built lazily, reused
 
     def alloc(self, key: tuple) -> int:
         for i, k in enumerate(self.used):
@@ -95,7 +96,15 @@ class _MergeBucket:
         return old
 
     def free(self, lane: int) -> None:
+        # Zero the row too: alloc() hands freed lanes to NEW channels, and
+        # a dirty lane's stale segments would leak into the next channel's
+        # materialization (summaries, catch-up seeds, LWW empty-base seed).
         self.used[lane] = None
+        if self._blank_row is None:
+            self._blank_row = make_state(
+                self.capacity, anno_slots=self.state.anno_slots,
+                overlap_slots=self.state.rem_clients.shape[-1])
+        self.put_row(lane, self._blank_row)
 
     def row(self, lane: int) -> DocState:
         """Extract one lane as a single-doc DocState (host-side gather)."""
@@ -437,6 +446,7 @@ class _LwwBucket:
         self.lanes = lanes
         self.state = lk.make_lww_state(capacity, batch=lanes)
         self.used: List[Optional[tuple]] = [None] * lanes
+        self._blank_row = None  # built lazily, reused across frees
 
     def alloc(self, key: tuple) -> int:
         for i, k in enumerate(self.used):
@@ -453,7 +463,12 @@ class _LwwBucket:
         return old
 
     def free(self, lane: int) -> None:
+        # Zero on free: reused lanes must not expose the previous
+        # channel's keys/values (see _MergeBucket.free).
         self.used[lane] = None
+        if self._blank_row is None:
+            self._blank_row = self.lk.make_lww_state(self.capacity)
+        self.put_row(lane, self._blank_row)
 
     def row(self, lane: int):
         return jax.tree_util.tree_map(lambda x: x[lane], self.state)

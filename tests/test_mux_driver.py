@@ -226,3 +226,30 @@ class TestSocketSharing:
                 clicks.increment(1)
             assert clicks.value == 1
             c1.close()
+
+
+class TestFailedConnectReleasesSocket:
+    def test_last_rider_connect_failure_closes_socket(self, server,
+                                                      monkeypatch):
+        """A failed connect_document that was the socket's ONLY rider must
+        release the physical socket and reader thread (the same refcount-
+        zero path detach takes) — not leak them for the process lifetime."""
+        from urllib.parse import urlparse
+        from fluidframework_tpu.loader.drivers import mux as mux_mod
+        u = urlparse(server.url)
+        mgr = mux_mod.MuxSocketManager(u.hostname, u.port)
+        monkeypatch.setattr(
+            mux_mod.Deferred, "result",
+            lambda self, timeout=None: (_ for _ in ()).throw(
+                TimeoutError("forced handshake failure")))
+        with pytest.raises(TimeoutError):
+            mgr.connect_document(DEFAULT_TENANT, "leak-doc", None, {},
+                                 timeout=1.0)
+        monkeypatch.undo()
+        assert mgr.document_count == 0
+        assert not mgr._handshakes
+        assert not mgr.socket_alive, "failed last-rider connect leaked ws"
+        # The manager recovers: a later connect dials a fresh socket.
+        conn = mgr.connect_document(DEFAULT_TENANT, "leak-doc", None, {})
+        assert mgr.socket_alive and conn.client_id
+        conn.close()

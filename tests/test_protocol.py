@@ -134,3 +134,41 @@ class TestSummaryTree:
         s = SequencedDocumentMessage.from_document_message(m, "A", 10, 4)
         assert s.sequence_number == 10 and s.minimum_sequence_number == 4
         assert s.contents == {"x": 1} and s.client_id == "A"
+
+
+class TestOpSizeBilling:
+    """The 413 screens: the cheap front-door lower bound must never exceed
+    the wire-exact measure, and both must bill non-ASCII at escaped wire
+    width (json.dumps ensure_ascii), not char count."""
+
+    def test_multibyte_billed_at_wire_width(self):
+        from fluidframework_tpu.protocol.messages import (
+            DocumentMessage, op_size, op_size_exact)
+        cjk = "你好" * 100  # 200 chars, 1200 wire bytes escaped
+        m = DocumentMessage(client_sequence_number=1,
+                            reference_sequence_number=0,
+                            type="op", contents={"contents": cjk})
+        assert op_size(m) == 1200
+        assert op_size_exact(m) >= 1200
+        assert op_size(m) <= op_size_exact(m)
+
+    def test_data_field_billed_escaped(self):
+        from fluidframework_tpu.protocol.messages import (
+            DocumentMessage, op_size, op_size_exact)
+        m = DocumentMessage(client_sequence_number=1,
+                            reference_sequence_number=0,
+                            type="join", contents=None,
+                            data="é" * 50)
+        # Wire carries é x50 = 300 bytes inside the dumps.
+        assert op_size_exact(m) == 300
+        # The screen stays a lower bound (unicode_escape: 4 bytes/char).
+        assert 200 <= op_size(m) <= 300
+
+    def test_ascii_unchanged(self):
+        from fluidframework_tpu.protocol.messages import (
+            DocumentMessage, op_size, op_size_exact)
+        m = DocumentMessage(client_sequence_number=1,
+                            reference_sequence_number=0,
+                            type="op", contents={"contents": "x" * 100})
+        assert op_size(m) == 100
+        assert op_size(m) <= op_size_exact(m)

@@ -468,6 +468,40 @@ class TestOverflowRecovery:
             store.buckets[b].state.overflow)[lane]), "overflow flag leaked"
         assert server.sequencer().channel_text(*key) == text.get_text()
 
+    def test_freed_merge_lane_zeroed_before_reuse(self):
+        """A freed lane (drop/promotion) hands CLEAN state to the next
+        channel that allocates it — the previous channel's segments must
+        not leak into the new channel's materialization."""
+        from fluidframework_tpu.server.tpu_sequencer import MergeLaneStore
+        store = MergeLaneStore(capacities=(8,), lanes_per_bucket=1)
+        a, b = ("d", "s", "a"), ("d", "s", "b")
+        store.apply({a: [store.builder.insert_text(0, "SECRET", 0, 0, 1)]})
+        assert store.text(a) == "SECRET"
+        store.drop(a)  # degraded: lane freed
+        store.apply({b: [store.builder.insert_text(0, "clean", 0, 0, 2)]})
+        assert store.where[b] == (0, 0), "expected the recycled lane"
+        assert store.text(b) == "clean"
+        snap = store.extract_all()[b]
+        joined = "".join(e.get("text") or ""
+                         for chunk in snap["chunks"] for e in chunk
+                         if e.get("removedSeq") is None)
+        assert joined == "clean"
+
+    def test_freed_lww_lane_zeroed_before_reuse(self):
+        """Same hygiene for LWW lanes: a promotion frees the bucket-0 lane
+        and the next channel allocating it must not see stale keys."""
+        from fluidframework_tpu.server.tpu_sequencer import LwwLaneStore
+        store = LwwLaneStore(capacities=(4, 8), lanes_per_bucket=1)
+        lk = store.lk
+        a, b = ("d", "s", "a"), ("d", "s", "b")
+        store.apply({a: [(lk.LwwKind.SET, store.intern_key(f"k{i}"),
+                          store.add_value(i), 0, i + 1) for i in range(6)]})
+        assert store.where[a][0] == 1, "lane should have promoted"
+        store.apply({b: [(lk.LwwKind.SET, store.intern_key("mine"),
+                          store.add_value("v"), 0, 10)]})
+        assert store.where[b] == (0, 0), "expected the recycled lane"
+        assert store.snapshot(b)["entries"] == {"mine": "v"}
+
     def test_compaction_avoids_promotion_for_transient_growth(self):
         """Insert/remove churn inside the collab window stays in-bucket via
         zamboni compaction (tombstones freed once min_seq passes)."""
