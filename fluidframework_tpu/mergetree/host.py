@@ -37,6 +37,71 @@ class AnnotatePayload:
     seq: int  # updated on ack; pending = DEV_UNASSIGNED
 
 
+class MergeArenaBlock:
+    """One flush's merge payloads in columnar form (the native wire pump's
+    output, server/pump.py): text lives as byte slices of a shared arena,
+    props as raw JSON spans of the retained wire buffers. Payload OBJECTS
+    materialize lazily (and are cached) only when extraction touches a
+    segment — the admitted fast path never builds one.
+
+    Column arrays are indexed by block-local op index; `seqs` (annotate
+    LWW order) is assigned after the window's ticket results arrive."""
+
+    __slots__ = ("base", "kinds", "marker", "textoff", "textlen", "arena",
+                 "bufs", "pbuf", "pstart", "pend", "seqs", "_cache")
+
+    # kinds codes (block-local)
+    K_TEXT, K_MARKER, K_ANNOTATE, K_NONE = 0, 1, 2, 3
+
+    def __init__(self, kinds, textoff, textlen, arena, bufs, pbuf, pstart,
+                 pend):
+        self.base = -1  # assigned by PayloadTable.add_block
+        self.kinds = kinds
+        self.textoff = textoff
+        self.textlen = textlen
+        self.arena = arena
+        self.bufs = bufs
+        self.pbuf = pbuf
+        self.pstart = pstart
+        self.pend = pend
+        self.seqs = None  # [n] int32, annotate seq — set post-ticketing
+        self._cache: Dict[int, Any] = {}
+
+    def __len__(self) -> int:
+        return len(self.kinds)
+
+    def _props(self, i: int) -> Optional[dict]:
+        s = int(self.pstart[i])
+        if s < 0:
+            return None
+        raw = self.bufs[int(self.pbuf[i])][s:int(self.pend[i])]
+        import json as _json
+        decoded = _json.loads(raw)
+        return decoded if isinstance(decoded, dict) else None
+
+    def resolve(self, op_id: int):
+        i = op_id - self.base
+        hit = self._cache.get(i)
+        if hit is not None:
+            return hit
+        kind = int(self.kinds[i])
+        if kind == self.K_ANNOTATE:
+            seq = int(self.seqs[i]) if self.seqs is not None else 0
+            out = AnnotatePayload(self._props(i) or {}, seq)
+        elif kind == self.K_MARKER:
+            out = InsertPayload(SEG_MARKER, "", self._props(i))
+        elif kind == self.K_TEXT:
+            off = int(self.textoff[i])
+            text = self.arena[off:off + int(self.textlen[i])].decode(
+                "utf-8")
+            out = InsertPayload(SEG_TEXT, text, self._props(i))
+        else:  # K_NONE: a remove's placeholder id — never referenced by
+            # device state, but resolve defensively.
+            out = InsertPayload(SEG_TEXT, "", None)
+        self._cache[i] = out
+        return out
+
+
 @dataclass
 class PayloadTable:
     """Global op_id -> payload registry shared by a batch of documents."""
@@ -52,8 +117,20 @@ class PayloadTable:
         self.entries.append(AnnotatePayload(dict(props), seq))
         return len(self.entries) - 1
 
+    def add_block(self, block: MergeArenaBlock) -> int:
+        """Register a whole flush's payloads at once; returns the base
+        op_id (block-local index i maps to op_id base + i)."""
+        import itertools
+        base = len(self.entries)
+        block.base = base
+        self.entries.extend(itertools.repeat(block, len(block)))
+        return base
+
     def get(self, op_id: int):
-        return self.entries[op_id]
+        e = self.entries[op_id]
+        if type(e) is MergeArenaBlock:
+            return e.resolve(op_id)
+        return e
 
 
 class OpBuilder:

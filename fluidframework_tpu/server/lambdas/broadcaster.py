@@ -31,7 +31,15 @@ class BroadcasterLambda(IPartitionLambda):
             room.remove(listener)
 
     def handler(self, message: QueuedMessage) -> None:
-        doc_id, sequenced = message.value
+        value = message.value
+        if hasattr(value, "messages"):
+            # SequencedWindow: one record per flush; fan out per room.
+            for doc_id, sequenced in value.messages():
+                for listener in list(self.rooms.get(doc_id, [])):
+                    listener(sequenced)
+            self.context.checkpoint(message.offset)
+            return
+        doc_id, sequenced = value
         for listener in list(self.rooms.get(doc_id, [])):
             listener(sequenced)
         self.context.checkpoint(message.offset)

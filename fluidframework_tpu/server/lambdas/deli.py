@@ -126,6 +126,12 @@ class DeliLambda(IPartitionLambda):
 
     # -- lambda ------------------------------------------------------------
     def handler(self, message: QueuedMessage) -> None:
+        if isinstance(message.value, (bytes, bytearray)):
+            from ..wire import boxcar_from_wire
+            message = QueuedMessage(
+                topic=message.topic, partition=message.partition,
+                offset=message.offset, key=message.key,
+                value=boxcar_from_wire(message.value))
         boxcar: Boxcar = message.value
         doc_id = boxcar.document_id
         state = self.docs.setdefault(doc_id, DocumentDeliState())

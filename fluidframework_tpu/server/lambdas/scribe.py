@@ -47,7 +47,27 @@ class ScribeLambda(IPartitionLambda):
                     self.log_offsets[row["documentId"]] = -1
 
     def handler(self, message: QueuedMessage) -> None:
-        doc_id, sequenced = message.value
+        value = message.value
+        if hasattr(value, "messages"):
+            # SequencedWindow: one record per flush; process per message
+            # with the same per-document replay guard, checkpoint each
+            # touched document once at the end.
+            touched = set()
+            for doc_id, sequenced in value.messages():
+                if message.offset <= self.log_offsets.get(doc_id, -1):
+                    continue
+                handler = self.handlers.setdefault(doc_id,
+                                                   ProtocolOpHandler())
+                handler.process_message(sequenced)
+                if sequenced.type == MessageType.SUMMARIZE:
+                    self._handle_summarize(doc_id, sequenced)
+                touched.add(doc_id)
+            for doc_id in touched:
+                self.log_offsets[doc_id] = message.offset
+                self._checkpoint_doc(doc_id, message.offset)
+            self.context.checkpoint(message.offset)
+            return
+        doc_id, sequenced = value
         if message.offset <= self.log_offsets.get(doc_id, -1):
             return  # replayed message already handled (mirrors deli's guard)
         handler = self.handlers.setdefault(doc_id, ProtocolOpHandler())
@@ -56,15 +76,19 @@ class ScribeLambda(IPartitionLambda):
             self._handle_summarize(doc_id, sequenced)
         self.log_offsets[doc_id] = message.offset
         self.context.checkpoint(message.offset)
-        if self.checkpoints is not None:
-            snap = handler.snapshot()
-            self.checkpoints.upsert(
-                lambda d, _id=doc_id: d.get("documentId") == _id,
-                {"documentId": doc_id,
-                 "sequenceNumber": snap.sequence_number,
-                 "minimumSequenceNumber": snap.minimum_sequence_number,
-                 "quorum": snap.quorum_snapshot,
-                 "logOffset": message.offset})
+        self._checkpoint_doc(doc_id, message.offset)
+
+    def _checkpoint_doc(self, doc_id: str, offset: int) -> None:
+        if self.checkpoints is None:
+            return
+        snap = self.handlers[doc_id].snapshot()
+        self.checkpoints.upsert(
+            lambda d, _id=doc_id: d.get("documentId") == _id,
+            {"documentId": doc_id,
+             "sequenceNumber": snap.sequence_number,
+             "minimumSequenceNumber": snap.minimum_sequence_number,
+             "quorum": snap.quorum_snapshot,
+             "logOffset": offset})
 
     def _handle_summarize(self, doc_id: str,
                           sequenced: SequencedDocumentMessage) -> None:

@@ -19,14 +19,27 @@ class ScriptoriumLambda(IPartitionLambda):
         self.deltas = deltas
 
     def handler(self, message: QueuedMessage) -> None:
-        doc_id, sequenced = message.value
+        value = message.value
+        if hasattr(value, "messages"):
+            # A SequencedWindow (tpu_sequencer fast path): ONE log record
+            # per flush; persist every admitted message it carries — the
+            # reference's insertMany batch, naturally window-sized.
+            for doc_id, sequenced in value.messages():
+                self._persist(doc_id, sequenced)
+            self.context.checkpoint(message.offset)
+            return
+        doc_id, sequenced = value
+        self._persist(doc_id, sequenced)
+        self.context.checkpoint(message.offset)
+
+    def _persist(self, doc_id: str,
+                 sequenced: SequencedDocumentMessage) -> None:
         record = asdict(sequenced)
         record["traces"] = []  # strip latency traces before persisting
         record["documentId"] = doc_id
         # The in-memory collection makes the reference's batched async
         # insertMany a synchronous insert; duplicates (replay) are ignored.
         self.deltas.insert_one(record)
-        self.context.checkpoint(message.offset)
 
 
 def delta_key(doc: dict):

@@ -91,6 +91,13 @@ class MessageLog:
     def send(self, topic: str, key: str, value: Any) -> QueuedMessage:
         return self.topic(topic).partition_for(key).append(key, value)
 
+    def send_to(self, topic: str, partition: int, key: str,
+                value: Any) -> QueuedMessage:
+        """Produce to an EXPLICIT partition (bypassing key hashing) — for
+        records that span many keys, like a sequencer window, which must
+        land on the partition its source documents hash to."""
+        return self.topic(topic).partitions[partition].append(key, value)
+
     # -- consumer ----------------------------------------------------------
     def poll(self, group: str, topic: str, partition: int = 0,
              limit: int = 1000) -> List[QueuedMessage]:

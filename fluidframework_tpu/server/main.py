@@ -154,13 +154,25 @@ def build_worker(cfg: dict, stages: List[str]):
                 auto_commit=False))
         elif stage == "tpu-deli":
             from .tpu_sequencer import TpuSequencerLambda
-            runner.add(PartitionManager(
-                log, "deli", RAW_TOPIC,
-                lambda ctx: TpuSequencerLambda(
+
+            def make_tpu_deli(ctx):
+                lam = TpuSequencerLambda(
                     ctx, emit=emit_sequenced, nack=emit_nack,
                     checkpoints=deli_ckpt, deltas=deltas,
-                    config=view, send_system=send_system),
-                auto_commit=False))
+                    config=view, send_system=send_system)
+                # Batched emit: ONE deltas-topic produce per fast flush
+                # window (downstream lambdas fan it out), matching the
+                # reference's per-message produce amortized per window.
+                # Produced to the SAME partition index the window's source
+                # documents hash to (raw and deltas topics share the
+                # partition count), so per-doc ordering and consumer
+                # affinity hold with multi-partition brokers.
+                lam.emit_window = lambda w, p=ctx.partition: log.send_to(
+                    DELTAS_TOPIC, p, "__window__", w)
+                return lam
+
+            runner.add(PartitionManager(
+                log, "deli", RAW_TOPIC, make_tpu_deli, auto_commit=False))
         elif stage == "scriptorium":
             runner.add(PartitionManager(
                 log, "scriptorium", DELTAS_TOPIC,

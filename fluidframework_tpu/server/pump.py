@@ -1,0 +1,140 @@
+"""Python face of the native wire->tensor pump (native/src/wirepump.cpp).
+
+One `parse()` call turns a flush's worth of raw boxcar bytes into numpy
+columns + a text arena + intern deltas; everything downstream
+(tpu_sequencer._flush_fast) is vectorized numpy + device dispatch. The
+reference's analog is the native kafka consume -> deli ticket hot loop
+(deli/lambda.ts:142); here the parse/intern half is C++ and the ticket
+half is the device kernel.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+# Column indices — MUST match the Col enum in native/src/wirepump.cpp.
+DOC, KIND, CLIENT, CSEQ, REFSEQ, FAMILY, CHAN, MKIND, POS1, POS2, \
+    TEXTOFF, TEXTLEN, CHARLEN, FLAGS, BUF, MSTART, MEND, PSTART, PEND = \
+    range(19)
+NF = 19
+
+F_FALLBACK, F_MARKER, F_PROPS, F_VALUE = 1, 2, 4, 8
+FAM_NONE, FAM_MERGE, FAM_LWW = 0, 1, 2
+
+
+class Parsed(NamedTuple):
+    """One flush's parsed staging."""
+
+    cols: np.ndarray          # [NF, n] int32
+    arena: bytes              # unescaped insert text payloads
+    bufs: List[bytes]         # the raw inputs (spans index into these)
+    new_docs: list            # [(ord, doc_id)]
+    new_clients: list         # [(doc_ord, ord, client_id)]
+    new_channels: list        # [(ord, doc_ord, store, channel)]
+    new_keys: list            # [(ord, key)]
+
+    @property
+    def n(self) -> int:
+        return self.cols.shape[1]
+
+
+_LIB = None
+
+
+def _load():
+    global _LIB
+    if _LIB is None:
+        try:
+            from ..native.build import ensure_built
+            lib = ctypes.PyDLL(ensure_built("wirepump"))
+            lib.pump_new.restype = ctypes.c_void_p
+            lib.pump_free.argtypes = [ctypes.c_void_p]
+            lib.pump_parse.argtypes = [ctypes.c_void_p, ctypes.py_object]
+            lib.pump_parse.restype = ctypes.c_long
+            lib.pump_fill.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                      ctypes.c_long]
+            lib.pump_fill.restype = ctypes.c_long
+            lib.pump_arena_size.argtypes = [ctypes.c_void_p]
+            lib.pump_arena_size.restype = ctypes.c_long
+            lib.pump_fill_arena.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_void_p, ctypes.c_long]
+            lib.pump_fill_arena.restype = ctypes.c_long
+            for name in ("pump_take_new_docs", "pump_take_new_clients",
+                         "pump_take_new_channels", "pump_take_new_keys"):
+                fn = getattr(lib, name)
+                fn.argtypes = [ctypes.c_void_p]
+                fn.restype = ctypes.py_object
+            lib.pump_preload_doc.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_char_p]
+            lib.pump_preload_doc.restype = ctypes.c_long
+            lib.pump_preload_client.argtypes = [
+                ctypes.c_void_p, ctypes.c_long, ctypes.c_char_p,
+                ctypes.c_long]
+            lib.pump_preload_client.restype = ctypes.c_long
+            lib.pump_nf.restype = ctypes.c_long
+            if lib.pump_nf() != NF:
+                raise RuntimeError("wirepump NF mismatch — rebuild needed")
+            _LIB = lib
+        except Exception:  # noqa: BLE001 — no toolchain: pump unavailable
+            _LIB = False
+    return _LIB or None
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class WirePump:
+    """Stateful pump: holds the intern tables for one sequencer lambda."""
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native wirepump unavailable")
+        self._lib = lib
+        self._ctx = lib.pump_new()
+
+    def __del__(self):
+        ctx = getattr(self, "_ctx", None)
+        if ctx:
+            self._lib.pump_free(ctx)
+            self._ctx = None
+
+    def parse(self, bufs: List[bytes]) -> Parsed:
+        lib = self._lib
+        n = lib.pump_parse(self._ctx, bufs)
+        if n < 0:
+            raise ValueError(f"pump_parse failed rc={n}")
+        cols = np.empty((NF, n), np.int32)
+        if n and lib.pump_fill(self._ctx, cols.ctypes.data, n) != 0:
+            raise RuntimeError("pump_fill size mismatch")
+        asize = lib.pump_arena_size(self._ctx)
+        arena = ctypes.create_string_buffer(asize)
+        if asize and lib.pump_fill_arena(self._ctx, arena, asize) != 0:
+            raise RuntimeError("pump_fill_arena size mismatch")
+        return Parsed(
+            cols=cols,
+            arena=arena.raw[:asize],
+            bufs=bufs,
+            new_docs=lib.pump_take_new_docs(self._ctx),
+            new_clients=lib.pump_take_new_clients(self._ctx),
+            new_channels=lib.pump_take_new_channels(self._ctx),
+            new_keys=lib.pump_take_new_keys(self._ctx),
+        )
+
+    # -- checkpoint-restore preloads ---------------------------------------
+    def preload_doc(self, doc_id: str) -> int:
+        """Intern a restored document; returns its pump ordinal. The
+        caller must treat it as 'new' (it will not reappear in new_docs)."""
+        return int(self._lib.pump_preload_doc(
+            self._ctx, doc_id.encode("utf-8")))
+
+    def preload_client(self, doc_ord: int, client_id: str,
+                       ordinal: int) -> None:
+        rc = self._lib.pump_preload_client(
+            self._ctx, doc_ord, client_id.encode("utf-8"), ordinal)
+        if rc != 0:
+            raise ValueError(f"preload_client({doc_ord}) rc={rc}")

@@ -1,0 +1,84 @@
+"""The fast serving flush's device program (tpu_sequencer._flush_raw).
+
+One fused jit per window: [B, T] deli ticketing for the whole partition,
+then per capacity bucket the merge/LWW apply — each op's assigned seq/msn
+gathered from the ticket output by (doc lane, step), the admitted-ops-only
+discipline of pipeline.full_step generalized to channel lanes that live in
+a different lane space than documents — and finally everything the host
+needs packed into ONE int32 vector (per-op seq/msn, nack flags, per-doc
+next_seq, overflow summary bits). Over a tunneled device every dispatch
+and every fetch pays a serialized RPC (~70 ms floor, PERF.md), so the
+window is exactly one dispatch and one D2H.
+
+Reference analog: deli/lambda.ts:142 ticket() feeding downstream lambdas;
+the merge/LWW applies play Scribe's materialization role fused into the
+same device window.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..mergetree import kernel
+from ..mergetree.oppack import OpKind, PackedOps
+from . import lww_kernel as lk
+from . import ticket_kernel as tk
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def serve_window(tstate, ticket_cols, merge_states, merge_cols,
+                 lww_states, lww_cols):
+    """The WHOLE fast window in one device program — over a tunneled
+    device every extra dispatch pays a serialized RPC, so ticketing, every
+    bucket's merge/LWW apply, and the result packing fuse into a single
+    jit (retraced per bucket-set structure, which is bounded).
+
+    ticket_cols: [4, B, T] int32 (kind, client, cseq, refseq) — ONE H2D.
+    merge_cols:  per bucket [12, lanes, Tm] (10 PackedOps columns +
+                 doc_idx + t_idx) — ONE H2D each.
+    lww_cols:    per bucket [6, lanes, Tm] (kind, key, val, delta,
+                 doc_idx, t_idx).
+    Returns (tstate', merge_states', lww_states', flat) with flat =
+    [seq B*T | msn B*T | flags B*T | next_seq B | overflow bits]."""
+    raw = tk.RawOps(client=ticket_cols[1], client_seq=ticket_cols[2],
+                    ref_seq=ticket_cols[3], kind=ticket_cols[0])
+    tstate, ticketed = tk._scan_tickets(tstate, raw, batched=True,
+                                        require_join=True)
+    seq_bt, msn_bt = ticketed.seq, ticketed.min_seq
+
+    new_merge = []
+    for mstate, mc in zip(merge_states, merge_cols):
+        packed = PackedOps(kind=mc[0], seq=mc[1], ref_seq=mc[2],
+                           client=mc[3], pos1=mc[4], pos2=mc[5],
+                           op_id=mc[6], new_len=mc[7], local_seq=mc[8],
+                           msn=mc[9])
+        seq_g = seq_bt[mc[10], mc[11]]
+        msn_g = msn_bt[mc[10], mc[11]]
+        ok = (packed.kind != OpKind.NOOP) & (seq_g > 0)
+        ops2 = packed._replace(
+            kind=jnp.where(ok, packed.kind, OpKind.NOOP),
+            seq=jnp.where(ok, seq_g, 0),
+            msn=jnp.where(ok, msn_g, 0))
+        new_merge.append(kernel._scan_ops(mstate, ops2, batched=True))
+
+    new_lww = []
+    for lstate, lc in zip(lww_states, lww_cols):
+        seq_g = seq_bt[lc[4], lc[5]]
+        ok = (lc[0] != lk.LwwKind.NOOP) & (seq_g > 0)
+        ops = lk.LwwOps(kind=jnp.where(ok, lc[0], lk.LwwKind.NOOP),
+                        key=lc[1], val=lc[2], delta=lc[3],
+                        seq=jnp.where(ok, seq_g, 0))
+        new_lww.append(lk._scan(lstate, ops, batched=True))
+
+    flags = ticketed.nacked.astype(jnp.int32) | \
+        (ticketed.not_joined.astype(jnp.int32) << 1)
+    bits = [tstate.overflow.any()[None].astype(jnp.int32)]
+    bits += [s.overflow.any()[None].astype(jnp.int32) for s in new_merge]
+    bits += [s.overflow.any()[None].astype(jnp.int32) for s in new_lww]
+    flat = jnp.concatenate(
+        [seq_bt.ravel(), msn_bt.ravel(), flags.ravel(),
+         tstate.next_seq.astype(jnp.int32)] + bits)
+    return tstate, new_merge, new_lww, flat

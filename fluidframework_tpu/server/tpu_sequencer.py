@@ -79,32 +79,58 @@ class _MergeBucket:
         self.state: DocState = make_state(capacity, batch=lanes)
         self.used: List[Optional[tuple]] = [None] * lanes  # lane key or None
         self._blank_row: Optional[DocState] = None  # built lazily, reused
+        self._free: List[int] = []  # explicitly freed lanes (zeroed)
+        self._next = 0              # frontier: lanes >= _next never used
 
-    def alloc(self, key: tuple) -> int:
-        for i, k in enumerate(self.used):
-            if k is None:
-                self.used[i] = key
-                return i
-        # Grow the batch axis (pad with empty lanes).
+    def _grow(self) -> None:
         old = self.lanes
         grown = make_state(self.capacity, batch=old * 2)
         self.state = jax.tree_util.tree_map(
-            lambda g, s: g.at[:old].set(s) if g.ndim else s, grown, self.state)
+            lambda g, s: g.at[:old].set(s) if g.ndim else s,
+            grown, self.state)
         self.used.extend([None] * old)
         self.lanes = old * 2
-        self.used[old] = key
-        return old
+
+    def alloc(self, key: tuple) -> int:
+        # Free-list + frontier: O(1) per alloc (a linear first-None scan
+        # is O(lanes^2) across a flush that admits thousands of channels).
+        if self._free:
+            i = self._free.pop()
+        else:
+            if self._next >= self.lanes:
+                self._grow()
+            i = self._next
+            self._next += 1
+        self.used[i] = key
+        return i
 
     def free(self, lane: int) -> None:
-        # Zero the row too: alloc() hands freed lanes to NEW channels, and
+        self.free_many([lane])
+
+    def free_many(self, lanes: List[int]) -> None:
+        # Zero the rows too: alloc() hands freed lanes to NEW channels, and
         # a dirty lane's stale segments would leak into the next channel's
         # materialization (summaries, catch-up seeds, LWW empty-base seed).
-        self.used[lane] = None
+        # Batched: a recovery burst frees thousands of lanes and per-lane
+        # scatters cost one device dispatch each.
+        if not lanes:
+            return
+        for i in lanes:
+            self.used[i] = None
+        self._free.extend(lanes)
         if self._blank_row is None:
             self._blank_row = make_state(
                 self.capacity, anno_slots=self.state.anno_slots,
                 overlap_slots=self.state.rem_clients.shape[-1])
-        self.put_row(lane, self._blank_row)
+        idx = jnp.asarray(np.asarray(lanes, np.int32))
+        k = len(lanes)
+        self.state = jax.tree_util.tree_map(
+            lambda col, blank: col.at[idx].set(
+                jnp.broadcast_to(blank, (k,) + blank.shape)),
+            self.state, self._blank_row)
+
+    def alloc_many(self, keys: List[tuple]) -> List[int]:
+        return [self.alloc(key) for key in keys]
 
     def row(self, lane: int) -> DocState:
         """Extract one lane as a single-doc DocState (host-side gather)."""
@@ -113,6 +139,12 @@ class _MergeBucket:
     def put_row(self, lane: int, row: DocState) -> None:
         self.state = jax.tree_util.tree_map(
             lambda b, r: b.at[lane].set(r), self.state, row)
+
+    def put_rows(self, lanes: List[int], rows: DocState) -> None:
+        """Scatter a [k, ...] sub-batch into k lanes in ONE pass."""
+        idx = jnp.asarray(np.asarray(lanes, np.int32))
+        self.state = jax.tree_util.tree_map(
+            lambda col, r: col.at[idx].set(r), self.state, rows)
 
 
 def _repad_batch(rows: DocState, capacity: int) -> DocState:
@@ -244,11 +276,11 @@ class MergeLaneStore:
                        if over[i] and i in lane_ops]
             if flagged:
                 # Adopt the clean lanes; roll flagged lanes back to their
-                # pre-flush rows, then recover each individually.
-                for i in flagged:
-                    row = jax.tree_util.tree_map(lambda x: x[i], pre)
-                    new_state = jax.tree_util.tree_map(
-                        lambda bcol, r: bcol.at[i].set(r), new_state, row)
+                # pre-flush rows (one batched scatter), then recover them.
+                idx = jnp.asarray(np.asarray(flagged, np.int32))
+                new_state = jax.tree_util.tree_map(
+                    lambda bcol, p: bcol.at[idx].set(p[idx]),
+                    new_state, pre)
             bucket.state = new_state
             if flagged:
                 # One BATCHED compact->rerun->promote per level — per-lane
@@ -301,15 +333,15 @@ class MergeLaneStore:
         compacted = kernel.compact_batched(sub)
         redone = _apply_keep_batched(compacted, packed)
         over = np.asarray(redone.overflow)
-        carried: List[tuple] = []   # keys still overflowing
-        keep: List[int] = []        # their row indices into src/packed
-        for j, i in enumerate(lanes):
-            if over[j]:
-                carried.append(bucket.used[i])
-                keep.append(j)
-                bucket.free(i)
-            else:
-                bucket.put_row(i, tm(lambda x: x[j], redone))
+        ok_j = [j for j in range(len(lanes)) if not over[j]]
+        bad_j = [j for j in range(len(lanes)) if over[j]]
+        if ok_j:
+            sel = np.asarray(ok_j)
+            bucket.put_rows([lanes[j] for j in ok_j],
+                            tm(lambda x: x[sel], redone))
+        carried = [bucket.used[lanes[j]] for j in bad_j]  # keys carrying up
+        keep = bad_j                 # their row indices into src/packed
+        bucket.free_many([lanes[j] for j in bad_j])
         src = compacted
         for nb in range(b + 1, len(self.buckets)):
             if not carried:
@@ -323,16 +355,15 @@ class MergeLaneStore:
             wide, packed = self._pad_pow2(wide, packed, n, target.capacity)
             redone = _apply_keep_batched(wide, packed)
             over = np.asarray(redone.overflow)
-            next_carried, next_keep = [], []
-            for k, key in enumerate(carried):
-                if not over[k]:
-                    new_lane = target.alloc(key)
-                    target.put_row(new_lane, tm(lambda x: x[k], redone))
-                    self.where[key] = (nb, new_lane)
-                else:
-                    next_carried.append(key)
-                    next_keep.append(k)
-            carried, keep = next_carried, next_keep
+            ok_k = [k for k in range(len(carried)) if not over[k]]
+            if ok_k:
+                new_lanes = target.alloc_many([carried[k] for k in ok_k])
+                sel_ok = np.asarray(ok_k)
+                target.put_rows(new_lanes, tm(lambda x: x[sel_ok], redone))
+                for k, nl in zip(ok_k, new_lanes):
+                    self.where[carried[k]] = (nb, nl)
+            keep = [k for k in range(len(carried)) if over[k]]
+            carried = [carried[k] for k in keep]
             src = wide
         for key in carried:
             del self.where[key]
@@ -447,25 +478,32 @@ class _LwwBucket:
         self.state = lk.make_lww_state(capacity, batch=lanes)
         self.used: List[Optional[tuple]] = [None] * lanes
         self._blank_row = None  # built lazily, reused across frees
+        self._free: List[int] = []
+        self._next = 0
 
     def alloc(self, key: tuple) -> int:
-        for i, k in enumerate(self.used):
-            if k is None:
-                self.used[i] = key
-                return i
-        old = self.lanes
-        grown = self.lk.make_lww_state(self.capacity, batch=old * 2)
-        self.state = jax.tree_util.tree_map(
-            lambda g, s: g.at[:old].set(s), grown, self.state)
-        self.used.extend([None] * old)
-        self.lanes = old * 2
-        self.used[old] = key
-        return old
+        # Free-list + frontier (see _MergeBucket.alloc).
+        if self._free:
+            i = self._free.pop()
+        else:
+            if self._next >= self.lanes:
+                old = self.lanes
+                grown = self.lk.make_lww_state(self.capacity,
+                                               batch=old * 2)
+                self.state = jax.tree_util.tree_map(
+                    lambda g, s: g.at[:old].set(s), grown, self.state)
+                self.used.extend([None] * old)
+                self.lanes = old * 2
+            i = self._next
+            self._next += 1
+        self.used[i] = key
+        return i
 
     def free(self, lane: int) -> None:
         # Zero on free: reused lanes must not expose the previous
         # channel's keys/values (see _MergeBucket.free).
         self.used[lane] = None
+        self._free.append(lane)
         if self._blank_row is None:
             self._blank_row = self.lk.make_lww_state(self.capacity)
         self.put_row(lane, self._blank_row)
@@ -513,6 +551,19 @@ class LwwLaneStore:
     def add_value(self, value: Any) -> int:
         self.values.append(value)
         return len(self.values) - 1
+
+    def add_value_block(self, block: "_LwwValueBlock") -> int:
+        """Register a whole flush's values at once (fast-path ingest);
+        value id = base + block-local index, decoded lazily."""
+        import itertools
+        base = len(self.values)
+        block.base = base
+        self.values.extend(itertools.repeat(block, len(block)))
+        return base
+
+    def value(self, vid: int) -> Any:
+        v = self.values[vid]
+        return v.resolve(vid) if type(v) is _LwwValueBlock else v
 
     def lane_for(self, key: tuple) -> Tuple[int, int]:
         if key not in self.where:
@@ -638,10 +689,9 @@ class LwwLaneStore:
             flagged = [i for i in range(bucket.lanes)
                        if over[i] and i in lane_ops]
             if flagged:
-                for i in flagged:
-                    row = jax.tree_util.tree_map(lambda x: x[i], pre)
-                    new = jax.tree_util.tree_map(
-                        lambda bcol, r: bcol.at[i].set(r), new, row)
+                idx = jnp.asarray(np.asarray(flagged, np.int32))
+                new = jax.tree_util.tree_map(
+                    lambda bcol, p: bcol.at[idx].set(p[idx]), new, pre)
             bucket.state = new
             for i in flagged:
                 self._promote(b, i, lane_ops[i], t)
@@ -684,7 +734,9 @@ class LwwLaneStore:
                 vals = np.asarray(bucket.state.val)
                 referenced.update(int(v) for v in np.unique(vals) if v >= 0)
         remap = {old: new for new, old in enumerate(sorted(referenced))}
-        self.values = [self.values[old] for old in sorted(referenced)]
+        # Materialize through value(): block entries must decode before
+        # the id space is renumbered (resolve() keys off the old base).
+        self.values = [self.value(old) for old in sorted(referenced)]
         for bucket in self.buckets:
             if not any(k is not None for k in bucket.used):
                 continue
@@ -710,12 +762,103 @@ class LwwLaneStore:
         for kid, vid in zip(keys, vals):
             if int(kid) >= 0:
                 entries[self.key_names[int(kid)]] = (
-                    self.values[int(vid)] if int(vid) >= 0 else None)
+                    self.value(int(vid)) if int(vid) >= 0 else None)
         return {
             "entries": entries,
             "counter": int(np.asarray(state.counter[lane])),
             "sequenceNumber": int(np.asarray(state.last_seq[lane])),
         }
+
+
+class _LwwValueBlock:
+    """One flush's LWW values as raw JSON spans of the retained wire
+    buffers, decoded lazily (and cached) at read time — snapshots touch a
+    handful of values; the ingest path touches none."""
+
+    __slots__ = ("base", "bufs", "vbuf", "vstart", "vend", "_cache")
+
+    def __init__(self, bufs, vbuf, vstart, vend):
+        self.base = -1  # assigned by LwwLaneStore.add_value_block
+        self.bufs = bufs
+        self.vbuf = vbuf
+        self.vstart = vstart
+        self.vend = vend
+        self._cache: Dict[int, Any] = {}
+
+    def __len__(self) -> int:
+        return len(self.vbuf)
+
+    def resolve(self, vid: int) -> Any:
+        i = vid - self.base
+        if i in self._cache:
+            return self._cache[i]
+        s = int(self.vstart[i])
+        v = None if s < 0 else json.loads(
+            self.bufs[int(self.vbuf[i])][s:int(self.vend[i])])
+        self._cache[i] = v
+        return v
+
+
+def _cumcount(groups: np.ndarray) -> np.ndarray:
+    """Per-row occurrence index within its group value, preserving row
+    order (vectorized groupby-cumcount)."""
+    n = len(groups)
+    if n == 0:
+        return np.zeros(0, np.int64)
+    order = np.argsort(groups, kind="stable")
+    sg = groups[order]
+    starts = np.flatnonzero(np.r_[True, sg[1:] != sg[:-1]])
+    counts = np.diff(np.r_[starts, n])
+    pos_sorted = np.arange(n) - np.repeat(starts, counts)
+    out = np.empty(n, np.int64)
+    out[order] = pos_sorted
+    return out
+
+
+class SequencedWindow:
+    """One fast flush's admitted messages, materialized lazily.
+
+    The slow path produces one SequencedDocumentMessage per op at flush
+    time; the fast path hands downstream ONE window per flush (the
+    reference's per-message kafka produce batched per flush window) and
+    builds message objects only when a consumer iterates. Columns are
+    numpy views over the flush's pump output; payload JSON stays in the
+    retained wire buffers until touched."""
+
+    def __init__(self, bufs: List[bytes], doc_ids: List[str],
+                 ordinals: List[Dict[int, str]], rows: np.ndarray,
+                 cols: np.ndarray, seqs: np.ndarray, msns: np.ndarray):
+        self.bufs = bufs
+        self.doc_ids = doc_ids          # row index -> document id
+        self.ordinals = ordinals        # row index -> ordinal->client map
+        self.rows = rows                # row indices into cols (in order)
+        self.cols = cols
+        self.seqs = seqs                # per-row assigned seq (0 = dropped)
+        self.msns = msns
+
+    def __len__(self) -> int:
+        return int((self.seqs > 0).sum())
+
+    def messages(self):
+        """Yield (doc_id, SequencedDocumentMessage) for every admitted
+        message, per-document order preserved."""
+        from . import pump as P
+        from .wire import document_message_from_dict
+        c = self.cols
+        for j, row in enumerate(self.rows.tolist()):
+            seq = int(self.seqs[j])
+            if seq <= 0:
+                continue
+            buf = self.bufs[int(c[P.BUF, row])]
+            msg = document_message_from_dict(json.loads(
+                buf[int(c[P.MSTART, row]):int(c[P.MEND, row])]))
+            client_id = None
+            if int(c[P.KIND, row]) == tk.MsgKind.OP:
+                client_id = self.ordinals[j].get(int(c[P.CLIENT, row]))
+            out = SequencedDocumentMessage.from_document_message(
+                msg, client_id, seq, int(self.msns[j]))
+            out.traces.append(ITrace.now("deli", "sequence"))
+            yield self.doc_ids[j], out
 
 
 # ---------------------------------------------------------------------------
@@ -925,6 +1068,31 @@ class TpuSequencerLambda(IPartitionLambda):
             MergeLaneStore(t_buckets=t_buckets)
         self.lww = LwwLaneStore(t_buckets=t_buckets)
         self._pending_offset: Optional[int] = None
+        # Fast-path (raw wire bytes) ingest state: the native pump + its
+        # ordinal mirrors. emit_window, when set, receives ONE
+        # SequencedWindow per fast flush instead of per-message emits.
+        self.emit_window: Optional[Callable[[SequencedWindow], None]] = None
+        self._raw_backlog: List[Tuple[int, str, bytes]] = []
+        self._raw_offsets: Dict[str, int] = {}
+        # Pipelined mode (opt-in): a clean single-window fast flush defers
+        # its result fetch/emit to the next flush's drain(), overlapping
+        # the tunnel transfer with the next backlog's native parse.
+        self.pipelined = False
+        self._inflight: Optional[dict] = None
+        self._pump = None
+        self._pump_ord: Dict[str, int] = {}     # doc id -> pump ordinal
+        self._pump_synced: Dict[str, int] = {}  # doc id -> synced ordinals
+        self._pump_known: set = set()
+        self._pump_docs: List[Optional[str]] = []   # pump ord -> doc id
+        self._pump_lane = np.full(64, -1, np.int32)  # pump ord -> lane
+        self._pump_chan: List[tuple] = []           # chan ord -> key tuple
+        self._lww_key_map = np.full(64, -1, np.int32)  # key ord -> kid
+        try:
+            from . import pump as _pump_mod
+            if _pump_mod.available():
+                self._pump = _pump_mod.WirePump()
+        except Exception:  # noqa: BLE001 — no toolchain: object path only
+            self._pump = None
         self._restore()
 
     # -- checkpoint/restore ------------------------------------------------
@@ -1064,6 +1232,9 @@ class TpuSequencerLambda(IPartitionLambda):
 
     # -- ingestion ---------------------------------------------------------
     def handler(self, message: QueuedMessage) -> None:
+        if isinstance(message.value, (bytes, bytearray)):
+            # Wire-serialized boxcar off the raw log: the native-pump path.
+            return self.handler_raw(message)
         boxcar: Boxcar = message.value
         doc_id = boxcar.document_id
         dl = self._doc(doc_id)
@@ -1074,6 +1245,54 @@ class TpuSequencerLambda(IPartitionLambda):
             queue.append(self._parse(dl, boxcar.client_id, msg))
         dl.log_offset = message.offset
         self._pending_offset = message.offset
+
+    def handler_raw(self, message: QueuedMessage) -> None:
+        """Raw-log ingest: message.value is a serialized wire boxcar
+        (server/wire.py boxcar_to_wire), message.key the document id.
+        Bytes are staged as-is; the native pump parses them at flush
+        time, so per-message host cost here is a dict probe and a list
+        append — the reference's thin socket->kafka producer hop
+        (alfred/index.ts:305)."""
+        if self._pump is None:
+            from .wire import boxcar_from_wire
+            self.handler(QueuedMessage(
+                topic=message.topic, partition=message.partition,
+                offset=message.offset, key=message.key,
+                value=boxcar_from_wire(message.value)))
+            return
+        doc_id = message.key
+        last = self._raw_offsets.get(doc_id)
+        if last is None:
+            dl = self.docs.get(doc_id)
+            last = dl.log_offset if dl is not None else -1
+        if message.offset <= last:
+            return  # checkpointed replay (deli/lambda.ts:143)
+        if doc_id not in self._pump_known:
+            self._register_pump_doc(doc_id)
+        self._raw_backlog.append((message.offset, doc_id, message.value))
+        self._raw_offsets[doc_id] = message.offset
+        self._pending_offset = message.offset
+
+    def _register_pump_doc(self, doc_id: str) -> None:
+        """Sync an existing (or brand-new) document into the pump's
+        intern tables so pump client ordinals continue any numbering the
+        object path or a checkpoint restore already assigned."""
+        ord_ = self._pump.preload_doc(doc_id)
+        while len(self._pump_docs) <= ord_:
+            self._pump_docs.append(None)
+        self._pump_docs[ord_] = doc_id
+        self._pump_ord[doc_id] = ord_
+        self._pump_known.add(doc_id)
+        dl = self._doc(doc_id)
+        if ord_ >= len(self._pump_lane):
+            grown = np.full(max(len(self._pump_lane) * 2, ord_ + 1), -1,
+                            np.int32)
+            grown[:len(self._pump_lane)] = self._pump_lane
+            self._pump_lane = grown
+        self._pump_lane[ord_] = dl.lane
+        for cid, o in dl.interner.items():
+            self._pump.preload_client(ord_, cid, o)
+        self._pump_synced[doc_id] = dl.next_ordinal
 
     def _doc(self, doc_id: str) -> _DocLane:
         dl = self.docs.get(doc_id)
@@ -1132,16 +1351,632 @@ class TpuSequencerLambda(IPartitionLambda):
 
     # -- the device flush --------------------------------------------------
     def flush(self) -> None:
+        fast_active: List[str] = []
+        if self._raw_backlog:
+            fast_active = self._flush_raw()
+        else:
+            self.drain()
         # Eviction checks only documents with activity in THIS flush —
         # the scalar deli's per-boxcar scope; a completely quiet document
         # never evicts (its idle writer had no remote ops to heartbeat
         # against either).
-        self._evict_ghosts([d for d, q in self.pending.items() if q])
+        self._evict_ghosts(sorted(
+            {d for d, q in self.pending.items() if q} | set(fast_active)))
+        if any(self.pending.values()):
+            # Slow windows touch the same merge/LWW lanes a deferred fast
+            # window's recovery might roll back — settle it first.
+            self.drain()
         # Each window consumes at least one pending message per live doc,
         # so this loop is bounded by the backlog length.
         while any(self.pending.values()):
             self._flush_window()
+        if self._inflight is None:
+            self._checkpoint()
+        # else: the deferred window's drain checkpoints its own offset.
+
+    # -- the fast (native-pump) flush --------------------------------------
+    def _flush_raw(self) -> List[str]:
+        """Flush the raw-bytes backlog through the native pump + fused
+        device windows. Documents with shapes the pump cannot model
+        (leaves, group ops, items payloads, malformed frames) — or with
+        older object-path messages still pending — route their WHOLE
+        backlog through the object slow path this flush, preserving
+        per-document ordering and exact slow-path semantics."""
+        from . import pump as P
+        from .wire import boxcar_from_wire
+
+        backlog = self._raw_backlog
+        self._raw_backlog = []
+        bufs = [b for _, _, b in backlog]
+        # Re-sync pump client interners for docs the SLOW path interned
+        # into since the last flush (fallback joins, eviction, restore
+        # replay): the pump must never hand out an ordinal the host side
+        # already assigned to a different client.
+        for doc_id in self._pump_known:
+            dl = self.docs.get(doc_id)
+            if dl is None:
+                continue
+            synced = self._pump_synced.get(doc_id, 0)
+            if dl.next_ordinal > synced:
+                ord_ = self._pump_ord[doc_id]
+                for cid, o in dl.interner.items():
+                    if o >= synced:
+                        self._pump.preload_client(ord_, cid, o)
+                self._pump_synced[doc_id] = dl.next_ordinal
+        # The native parse overlaps the PREVIOUS deferred window's result
+        # transfer (pipelined mode); everything lane-state-dependent waits
+        # for drain() just below.
+        parsed = self._pump.parse(bufs)
+        cols = parsed.cols
+        self._mirror_pump_interns(parsed)
+        self.drain()
+
+        # --- fallback routing (doc granularity) ---------------------------
+        flags = cols[P.FLAGS]
+        doc_col = cols[P.DOC]
+        fb_rows = (flags & P.F_FALLBACK) != 0
+        slow_ids: set = set()
+        for o in np.unique(doc_col[fb_rows]).tolist():
+            if o >= 0:
+                slow_ids.add(self._pump_docs[o])
+        for row in np.flatnonzero(fb_rows & (doc_col < 0)).tolist():
+            slow_ids.add(backlog[int(cols[P.BUF, row])][1])
+        # Docs with object-path messages still queued must stay ordered.
+        slow_ids |= {d for d, q in self.pending.items() if q}
+
+        doc_active: Dict[str, int] = {}
+        for off, doc_id, _ in backlog:
+            doc_active[doc_id] = max(doc_active.get(doc_id, -1), off)
+        for off, doc_id, buf in backlog:
+            if doc_id in slow_ids:
+                self.handler(QueuedMessage(
+                    topic="rawdeltas", partition=0, offset=off, key=doc_id,
+                    value=boxcar_from_wire(buf)))
+        for doc_id, off in doc_active.items():
+            if doc_id not in slow_ids:
+                self.docs[doc_id].log_offset = max(
+                    self.docs[doc_id].log_offset, off)
+
+        # --- fast row selection -------------------------------------------
+        n = parsed.n
+        fast = ~fb_rows & (cols[P.KIND] != tk.MsgKind.NOOP)
+        if slow_ids:
+            slow_ords = np.array(
+                [o for o, name in enumerate(self._pump_docs)
+                 if name in slow_ids], np.int32)
+            fast &= ~np.isin(doc_col, slow_ords)
+        rows = np.flatnonzero(fast)
+        now = time.time()
+        if rows.size == 0:
+            return sorted(doc_active.keys() - slow_ids)
+
+        # last-seen stamps for eviction (unique (doc, client) pairs).
+        dc = (doc_col[rows].astype(np.int64) << 32) | \
+            (cols[P.CLIENT, rows].astype(np.int64) & 0xFFFFFFFF)
+        for pair in np.unique(dc[cols[P.CLIENT, rows] >= 0]).tolist():
+            dl = self.docs[self._pump_docs[pair >> 32]]
+            cid = dl.ordinals.get(pair & 0xFFFFFFFF)
+            if cid is not None:
+                dl.last_seen[cid] = now
+
+        # Pre-size the client table (invariant: overflow on device means a
+        # sizing bug, exactly as in the slow path).
+        need_k = max((dl.next_ordinal for dl in self.docs.values()),
+                     default=0)
+        while self.k < need_k:
+            self._grow_clients()
+
+        # --- window assignment --------------------------------------------
+        lanes_r = self._pump_lane[doc_col[rows]]
+        pos = _cumcount(lanes_r)
+        max_per_doc = int(pos.max()) + 1
+        max_t = self.t_buckets[-1]
+        T = _bucket(min(max_per_doc, max_t), self.t_buckets)
+        win = (pos // T).astype(np.int64)
+        slot = (pos % T).astype(np.int64)
+        n_windows = int(win.max()) + 1
+
+        # Payload blocks for the whole flush (op ids + value ids).
+        merge_all = np.flatnonzero(
+            fast & (cols[P.FAMILY] == P.FAM_MERGE))
+        mbase, chan_ok, chan_b, chan_l = self._merge_block_and_lanes(
+            parsed, merge_all)
+        lww_all = np.flatnonzero(fast & (cols[P.FAMILY] == P.FAM_LWW))
+        vbase, lchan_ok, lchan_b, lchan_l = self._lww_block_and_lanes(
+            parsed, lww_all)
+
+        row_seq = np.zeros(rows.size, np.int32)
+        row_msn = np.zeros(rows.size, np.int32)
+        # Pipelining: a single clean fast window may defer its result
+        # fetch + emit to the NEXT flush (whose native parse then overlaps
+        # this window's transfer). Multi-window flushes and flushes with
+        # slow-routed docs stay synchronous — their later work touches the
+        # same lane state the deferred recovery might roll back.
+        defer_ok = (self.pipelined and n_windows == 1 and not slow_ids
+                    and not any(self.pending.values()))
+        for w in range(n_windows):
+            sel = win == w
+            self._dispatch_fast_window(
+                parsed, backlog, rows[sel], lanes_r[sel], slot[sel], T,
+                mbase, chan_ok, chan_b, chan_l,
+                vbase, lchan_ok, lchan_b, lchan_l,
+                row_seq, sel, row_msn, defer=defer_ok)
+
+        emit_args = (bufs,
+                     [self._pump_docs[int(o)] for o in doc_col[rows]],
+                     rows, cols, row_seq, row_msn)
+        if self._inflight is not None:
+            self._inflight["emit_args"] = emit_args
+        else:
+            self._emit_fast_window(emit_args)
+        return sorted(doc_active.keys() - slow_ids)
+
+    def _emit_fast_window(self, emit_args) -> None:
+        bufs, doc_ids_r, rows, cols, row_seq, row_msn = emit_args
+        ordinals_r = [self.docs[d].ordinals for d in doc_ids_r]
+        window = SequencedWindow(bufs, doc_ids_r, ordinals_r, rows, cols,
+                                 row_seq, row_msn)
+        if self.emit_window is not None:
+            self.emit_window(window)
+        else:
+            for doc_id, msg in window.messages():
+                self.emit(doc_id, msg)
+        # Compaction cadence bookkeeping (the fast path bypasses
+        # MergeLaneStore.apply / LwwLaneStore.apply which normally tick).
+        self.merge.flushes_since_compact += 1
+        if self.merge.flushes_since_compact >= self.merge.compact_every:
+            self.merge.compact_all()
+        self.lww.windows_since_value_compact += 1
+        if self.lww.windows_since_value_compact >= \
+                self.lww.value_compact_every:
+            self.lww.compact_values()
+
+    def drain(self) -> None:
+        """Finish the deferred fast window, if any: join the result
+        transfer, then nacks, overflow recovery, batched emit, and the
+        window's checkpoint — always on the caller's thread, so lane
+        stores are never touched concurrently."""
+        ctx = self._inflight
+        if ctx is None:
+            return
+        self._inflight = None
+        ctx["thread"].join()
+        if "error" in ctx:
+            raise ctx["error"]
+        self._finish_window(ctx)
+        self._emit_fast_window(ctx["emit_args"])
+        # Commit only the offsets this window covered; offsets staged
+        # after the deferral belong to a window that has not sequenced
+        # yet and must survive a crash for replay.
+        newer = self._pending_offset
+        self._pending_offset = ctx["offset"]
         self._checkpoint()
+        if newer is not None and (ctx["offset"] is None
+                                  or newer > ctx["offset"]):
+            self._pending_offset = newer
+
+    def _mirror_pump_interns(self, parsed) -> None:
+        for ord_, name in parsed.new_docs:
+            # Normally empty (handler_raw preloads by queue key); covers
+            # a boxcar whose documentId differs from its queue key.
+            while len(self._pump_docs) <= ord_:
+                self._pump_docs.append(None)
+            self._pump_docs[ord_] = name
+            dl = self._doc(name)
+            if ord_ >= len(self._pump_lane):
+                grown = np.full(max(len(self._pump_lane) * 2, ord_ + 1),
+                                -1, np.int32)
+                grown[:len(self._pump_lane)] = self._pump_lane
+                self._pump_lane = grown
+            self._pump_lane[ord_] = dl.lane
+            self._pump_ord[name] = ord_
+            self._pump_synced[name] = dl.next_ordinal
+            self._pump_known.add(name)
+        for doc_ord, ord_, cid in parsed.new_clients:
+            name = self._pump_docs[doc_ord]
+            dl = self.docs[name]
+            if cid not in dl.interner:
+                dl.interner[cid] = ord_
+                dl.ordinals[ord_] = cid
+                dl.next_ordinal = max(dl.next_ordinal, ord_ + 1)
+            # Pump-assigned ordinals are by definition in sync.
+            self._pump_synced[name] = max(
+                self._pump_synced.get(name, 0), ord_ + 1)
+        for chan_ord, doc_ord, store, chan in parsed.new_channels:
+            assert chan_ord == len(self._pump_chan)
+            self._pump_chan.append(
+                (self._pump_docs[doc_ord], store, chan))
+        for ord_, key in parsed.new_keys:
+            kid = self.lww.intern_key(key)
+            if ord_ >= len(self._lww_key_map):
+                grown = np.full(max(len(self._lww_key_map) * 2, ord_ + 1),
+                                -1, np.int32)
+                grown[:len(self._lww_key_map)] = self._lww_key_map
+                self._lww_key_map = grown
+            self._lww_key_map[ord_] = kid
+
+    def _merge_block_and_lanes(self, parsed, merge_rows: np.ndarray):
+        """Register the flush's merge payload block and resolve each
+        channel's (bucket, lane), seeding new channels from stored
+        summaries exactly as the slow path does. Returns (op-id base,
+        per-row ok mask, bucket array, lane array) aligned to
+        merge_rows."""
+        from ..mergetree.host import MergeArenaBlock
+        from . import pump as P
+        cols = parsed.cols
+        self._flush_merge_rows = merge_rows
+        if merge_rows.size == 0:
+            self._flush_merge_block = MergeArenaBlock(
+                kinds=np.zeros(0, np.int8), textoff=np.zeros(0, np.int32),
+                textlen=np.zeros(0, np.int32), arena=b"", bufs=[],
+                pbuf=np.zeros(0, np.int32), pstart=np.zeros(0, np.int32),
+                pend=np.zeros(0, np.int32))
+            self._flush_merge_block.seqs = np.zeros(0, np.int32)
+            return 0, np.zeros(0, bool), np.zeros(0, np.int32), \
+                np.zeros(0, np.int32)
+        mk = cols[P.MKIND, merge_rows]
+        fl = cols[P.FLAGS, merge_rows]
+        kinds = np.full(merge_rows.size, MergeArenaBlock.K_NONE, np.int8)
+        kinds[(mk == 1) & ((fl & P.F_MARKER) != 0)] = MergeArenaBlock.K_MARKER
+        kinds[(mk == 1) & ((fl & P.F_MARKER) == 0)] = MergeArenaBlock.K_TEXT
+        kinds[mk == 3] = MergeArenaBlock.K_ANNOTATE
+        block = MergeArenaBlock(
+            kinds=kinds,
+            textoff=cols[P.TEXTOFF, merge_rows].copy(),
+            textlen=cols[P.TEXTLEN, merge_rows].copy(),
+            arena=parsed.arena, bufs=parsed.bufs,
+            pbuf=cols[P.BUF, merge_rows].copy(),
+            pstart=cols[P.PSTART, merge_rows].copy(),
+            pend=cols[P.PEND, merge_rows].copy())
+        block.seqs = np.zeros(merge_rows.size, np.int32)
+        mbase = self.merge.payloads.add_block(block)
+        self._flush_merge_block = block
+        self._flush_merge_rows = merge_rows
+
+        chans = cols[P.CHAN, merge_rows]
+        uniq, inv = np.unique(chans, return_inverse=True)
+        ok_u = np.zeros(uniq.size, bool)
+        b_u = np.zeros(uniq.size, np.int32)
+        l_u = np.zeros(uniq.size, np.int32)
+        for j, ch in enumerate(uniq.tolist()):
+            key = self._pump_chan[ch]
+            if key in self.merge.opaque:
+                continue
+            if key not in self.merge.where and self.storage is not None:
+                probe = self._probe_summary(key[0])
+                if probe is not None:
+                    payload = probe.channels.get((key[1], key[2]))
+                    if payload is not None:
+                        self.merge.seed(key, *payload)
+                        if key in self.merge.opaque:
+                            continue
+            bb, ll = self.merge.lane_for(key)
+            ok_u[j] = True
+            b_u[j] = bb
+            l_u[j] = ll
+        return mbase, ok_u[inv], b_u[inv], l_u[inv]
+
+    def _lww_block_and_lanes(self, parsed, lww_rows: np.ndarray):
+        from . import pump as P
+        cols = parsed.cols
+        self._flush_lww_rows = lww_rows
+        if lww_rows.size == 0:
+            return 0, np.zeros(0, bool), np.zeros(0, np.int32), \
+                np.zeros(0, np.int32)
+        vstart = np.where((cols[P.FLAGS, lww_rows] & P.F_VALUE) != 0,
+                          cols[P.PSTART, lww_rows], -1)
+        block = _LwwValueBlock(parsed.bufs, cols[P.BUF, lww_rows].copy(),
+                               vstart, cols[P.PEND, lww_rows].copy())
+        vbase = self.lww.add_value_block(block)
+
+        chans = cols[P.CHAN, lww_rows]
+        uniq, inv = np.unique(chans, return_inverse=True)
+        ok_u = np.zeros(uniq.size, bool)
+        b_u = np.zeros(uniq.size, np.int32)
+        l_u = np.zeros(uniq.size, np.int32)
+        for j, ch in enumerate(uniq.tolist()):
+            key = self._pump_chan[ch]
+            if key in self.lww.opaque:
+                continue
+            if key not in self.lww.where and self.storage is not None:
+                probe = self._probe_summary(key[0])
+                if probe is not None:
+                    payload = probe.lww_channels.get((key[1], key[2]))
+                    if payload is not None:
+                        self.lww.seed(key, *payload)
+                        if key in self.lww.opaque:
+                            continue
+            bb, ll = self.lww.lane_for(key)
+            ok_u[j] = True
+            b_u[j] = bb
+            l_u[j] = ll
+        return vbase, ok_u[inv], b_u[inv], l_u[inv]
+
+    def _dispatch_fast_window(self, parsed, backlog, rows, lanes, slot, T,
+                              mbase, chan_ok, chan_b, chan_l,
+                              vbase, lchan_ok, lchan_b, lchan_l,
+                              row_seq, flush_sel, row_msn,
+                              defer: bool = False) -> None:
+        """One fast window: staging + ONE fused device dispatch, then
+        either an immediate result fetch (_finish_window) or — pipelined —
+        a background transfer joined by the next flush's drain().
+        `rows`/`lanes`/`slot` are aligned arrays for this window's
+        messages, in arrival order."""
+        from . import pump as P
+        from . import serve_step
+        cols = parsed.cols
+        B = self.lanes
+
+        ticket_cols = np.zeros((4, B, T), np.int32)
+        ticket_cols[1] = -1
+        ticket_cols[0, lanes, slot] = cols[P.KIND, rows]
+        ticket_cols[1, lanes, slot] = cols[P.CLIENT, rows]
+        ticket_cols[2, lanes, slot] = cols[P.CSEQ, rows]
+        ticket_cols[3, lanes, slot] = cols[P.REFSEQ, rows]
+
+        merge_jobs = self._build_merge(parsed, rows, lanes, slot,
+                                       mbase, chan_ok, chan_b, chan_l)
+        lww_jobs = self._build_lww(parsed, rows, lanes, slot,
+                                   vbase, lchan_ok, lchan_b, lchan_l)
+
+        # ONE fused device program for the whole window (every extra
+        # dispatch is a serialized tunnel RPC), then ONE host sync.
+        self.tstate, new_merge, new_lww, flat_dev = serve_step.serve_window(
+            self.tstate, jnp.asarray(ticket_cols),
+            [self.merge.buckets[j["bucket"]].state for j in merge_jobs],
+            [jnp.asarray(j["cols"]) for j in merge_jobs],
+            [self.lww.buckets[j["bucket"]].state for j in lww_jobs],
+            [jnp.asarray(j["cols"]) for j in lww_jobs])
+        for j, post in zip(merge_jobs, new_merge):
+            j["post"] = post
+            self.merge.buckets[j["bucket"]].state = post
+        for j, post in zip(lww_jobs, new_lww):
+            j["post"] = post
+            self.lww.buckets[j["bucket"]].state = post
+
+        ctx = {"parsed": parsed, "B": B, "T": T, "rows": rows,
+               "lanes": lanes, "slot": slot,
+               "idx": np.flatnonzero(flush_sel),
+               "merge_jobs": merge_jobs, "lww_jobs": lww_jobs,
+               "mbase": mbase, "block": self._flush_merge_block,
+               "row_seq": row_seq, "row_msn": row_msn,
+               # The offsets THIS window covers: drain() must commit
+               # exactly these — the live _pending_offset may already
+               # include a newer, not-yet-dispatched backlog.
+               "offset": self._pending_offset}
+        if defer:
+            import threading
+
+            def fetch():
+                try:
+                    ctx["flat"] = np.asarray(flat_dev)
+                except Exception as err:  # noqa: BLE001 — surface at join
+                    ctx["error"] = err
+
+            ctx["thread"] = threading.Thread(target=fetch, daemon=True)
+            ctx["thread"].start()
+            self._inflight = ctx
+        else:
+            ctx["flat"] = np.asarray(flat_dev)  # the window's ONE sync
+            self._finish_window(ctx)
+
+    def _finish_window(self, ctx) -> None:
+        """The post-fetch half of a fast window: seq/msn distribution,
+        invariant checks, nack emission, (rare) overflow recovery."""
+        from . import pump as P
+        parsed = ctx["parsed"]
+        cols = parsed.cols
+        B, T = ctx["B"], ctx["T"]
+        rows, lanes, slot = ctx["rows"], ctx["lanes"], ctx["slot"]
+        flat = ctx["flat"]
+        merge_jobs, lww_jobs = ctx["merge_jobs"], ctx["lww_jobs"]
+
+        bt = B * T
+        seq_bt = flat[:bt].reshape(B, T)
+        msn_bt = flat[bt:2 * bt].reshape(B, T)
+        fl_bt = flat[2 * bt:3 * bt].reshape(B, T)
+        next_seq = flat[3 * bt:3 * bt + B]
+        bits = flat[3 * bt + B:]
+        if bits[0]:
+            raise RuntimeError("ticket client table overflow despite "
+                               "pre-flush growth — invariant violation")
+
+        ctx["row_seq"][ctx["idx"]] = seq_bt[lanes, slot]
+        ctx["row_msn"][ctx["idx"]] = msn_bt[lanes, slot]
+        # Annotate LWW ordering needs each merge op's assigned seq.
+        block = ctx["block"]
+        for job in merge_jobs:
+            block.seqs[job["op_ids"] - ctx["mbase"]] = \
+                seq_bt[job["doc_lane"], job["slot"]]
+
+        # Nacks (rare): materialize the offending message from its span.
+        row_flags = fl_bt[lanes, slot]
+        for k in np.flatnonzero(row_flags != 0).tolist():
+            from .wire import document_message_from_dict
+            r = int(rows[k])
+            buf = parsed.bufs[int(cols[P.BUF, r])]
+            msg = document_message_from_dict(json.loads(
+                buf[int(cols[P.MSTART, r]):int(cols[P.MEND, r])]))
+            doc_id = self._pump_docs[int(cols[P.DOC, r])]
+            dl = self.docs[doc_id]
+            reason = ("client not joined" if row_flags[k] & 2
+                      else "refSeq below minimum sequence number")
+            self.nack(doc_id,
+                      dl.ordinals.get(int(cols[P.CLIENT, r]), ""),
+                      Nack(msg, int(next_seq[dl.lane]) - 1,
+                           NackContent(NACK_BAD_REF_SEQ, reason)))
+
+        # Overflow recovery (rare): roll flagged lanes back to their
+        # pre-window rows and reuse the batched slow-path recovery.
+        bit_i = 1
+        for job in merge_jobs:
+            if bits[bit_i]:
+                self._recover_fast_merge(parsed, job, seq_bt, msn_bt)
+            bit_i += 1
+        for job in lww_jobs:
+            if bits[bit_i]:
+                self._recover_fast_lww(parsed, job, seq_bt)
+            bit_i += 1
+
+    def _build_merge(self, parsed, rows, lanes, slot,
+                     mbase, chan_ok, chan_b, chan_l):
+        """Per-bucket merge window staging ([12, lanes, Tm]: 10 PackedOps
+        columns + doc_idx + t_idx, one array => one H2D); returns job
+        records carrying what the (rare) recovery path needs."""
+        from . import pump as P
+        cols = parsed.cols
+        flush_rows = self._flush_merge_rows
+        in_window = np.isin(flush_rows, rows)
+        sel = in_window & chan_ok
+        jobs = []
+        if not sel.any():
+            return jobs
+        mrows = flush_rows[sel]
+        mb = chan_b[sel]
+        ml = chan_l[sel]
+        cpos = _cumcount(cols[P.CHAN, mrows])
+        op_ids = mbase + np.flatnonzero(sel)
+        # Window-local position of each selected merge row (rows sorted).
+        wrow = np.searchsorted(rows, mrows)
+        for b in np.unique(mb).tolist():
+            bsel = mb == b
+            bucket = self.merge.buckets[b]
+            Tm = _bucket(int(cpos[bsel].max()) + 1, self.t_buckets)
+            mc = np.zeros((12, bucket.lanes, Tm), np.int32)
+            rl = ml[bsel]
+            rp = cpos[bsel]
+            rr = mrows[bsel]
+            # Layout matches serve_step.serve_window: kind seq ref client
+            # pos1 pos2 op_id new_len local_seq msn doc_idx t_idx.
+            mc[0, rl, rp] = cols[P.MKIND, rr]
+            mc[2, rl, rp] = cols[P.REFSEQ, rr]
+            mc[3, rl, rp] = cols[P.CLIENT, rr]
+            mc[4, rl, rp] = cols[P.POS1, rr]
+            mc[5, rl, rp] = cols[P.POS2, rr]
+            mc[6, rl, rp] = op_ids[bsel]
+            mc[7, rl, rp] = cols[P.CHARLEN, rr]
+            doc_lane = lanes[wrow[bsel]]
+            tslot = slot[wrow[bsel]]
+            mc[10, rl, rp] = doc_lane
+            mc[11, rl, rp] = tslot
+            jobs.append({"bucket": b, "pre": bucket.state, "cols": mc,
+                         "rows": rr, "lanes": rl, "op_ids": op_ids[bsel],
+                         "doc_lane": doc_lane, "slot": tslot})
+        return jobs
+
+    def _build_lww(self, parsed, rows, lanes, slot,
+                   vbase, chan_ok, chan_b, chan_l):
+        """Per-bucket LWW staging ([6, lanes, Tm]: kind key val delta
+        doc_idx t_idx)."""
+        from . import pump as P
+        cols = parsed.cols
+        lk = self.lww.lk
+        flush_rows = self._flush_lww_rows
+        in_window = np.isin(flush_rows, rows)
+        sel = in_window & chan_ok
+        jobs = []
+        if not sel.any():
+            return jobs
+        lrows = flush_rows[sel]
+        lb = chan_b[sel]
+        ll = chan_l[sel]
+        cpos = _cumcount(cols[P.CHAN, lrows])
+        val_ids = vbase + np.flatnonzero(sel)
+        wrow = np.searchsorted(rows, lrows)
+        for b in np.unique(lb).tolist():
+            bsel = lb == b
+            bucket = self.lww.buckets[b]
+            Tm = _bucket(int(cpos[bsel].max()) + 1, self.t_buckets)
+            lc = np.zeros((6, bucket.lanes, Tm), np.int32)
+            lc[1] = -1
+            lc[2] = -1
+            rl = ll[bsel]
+            rp = cpos[bsel]
+            rr = lrows[bsel]
+            lc[0, rl, rp] = cols[P.MKIND, rr]
+            kord = cols[P.POS1, rr]
+            lc[1, rl, rp] = np.where(kord >= 0, self._lww_key_map[kord],
+                                     -1)
+            is_set = cols[P.MKIND, rr] == lk.LwwKind.SET
+            lc[2, rl, rp] = np.where(is_set, val_ids[bsel], -1)
+            lc[3, rl, rp] = cols[P.POS2, rr]
+            doc_lane = lanes[wrow[bsel]]
+            tslot = slot[wrow[bsel]]
+            lc[4, rl, rp] = doc_lane
+            lc[5, rl, rp] = tslot
+            jobs.append({"bucket": b, "pre": bucket.state, "cols": lc,
+                         "rows": rr, "lanes": rl, "val_ids": val_ids[bsel],
+                         "doc_lane": doc_lane, "slot": tslot})
+        return jobs
+
+    def _recover_fast_merge(self, parsed, job, seq_bt, msn_bt) -> None:
+        """A merge bucket overflowed in a fast window: rebuild HostOp
+        streams for the flagged lanes from the pump columns, roll those
+        lanes back to their pre-window rows, and run the slow path's
+        batched recovery."""
+        from . import pump as P
+        cols = parsed.cols
+        b = job["bucket"]
+        bucket = self.merge.buckets[b]
+        over = np.asarray(job["post"].overflow)
+        tm = jax.tree_util.tree_map
+        lane_ops: Dict[int, List[HostOp]] = {}
+        for k, i in enumerate(job["lanes"].tolist()):
+            if not over[i]:
+                continue
+            r = int(job["rows"][k])
+            # seq/msn were assigned by the ticket pass regardless of the
+            # merge overflow; reuse them for the re-run.
+            seq = int(seq_bt[job["doc_lane"][k], job["slot"][k]])
+            msn = int(msn_bt[job["doc_lane"][k], job["slot"][k]])
+            if seq <= 0:
+                continue
+            lane_ops.setdefault(i, []).append(HostOp(
+                kind=int(cols[P.MKIND, r]), seq=seq,
+                ref_seq=int(cols[P.REFSEQ, r]),
+                client=int(cols[P.CLIENT, r]),
+                pos1=int(cols[P.POS1, r]), pos2=int(cols[P.POS2, r]),
+                op_id=int(job["op_ids"][k]),
+                new_len=int(cols[P.CHARLEN, r]),
+                local_seq=0, msn=msn))
+        if not lane_ops:
+            return
+        idx = jnp.asarray(np.asarray(sorted(lane_ops), np.int32))
+        bucket.state = tm(lambda col, p: col.at[idx].set(p[idx]),
+                          bucket.state, job["pre"])
+        self.merge._recover_batch(b, lane_ops)
+
+    def _recover_fast_lww(self, parsed, job, seq_bt) -> None:
+        from . import pump as P
+        cols = parsed.cols
+        lk = self.lww.lk
+        b = job["bucket"]
+        bucket = self.lww.buckets[b]
+        over = np.asarray(job["post"].overflow)
+        tm = jax.tree_util.tree_map
+        lane_ops: Dict[int, List[tuple]] = {}
+        for k, i in enumerate(job["lanes"].tolist()):
+            if not over[i]:
+                continue
+            r = int(job["rows"][k])
+            seq = int(seq_bt[job["doc_lane"][k], job["slot"][k]])
+            if seq <= 0:
+                continue
+            kord = int(cols[P.POS1, r])
+            kid = int(self._lww_key_map[kord]) if kord >= 0 else -1
+            mk = int(cols[P.MKIND, r])
+            lane_ops.setdefault(i, []).append(
+                (mk, kid,
+                 int(job["val_ids"][k]) if mk == lk.LwwKind.SET else -1,
+                 int(cols[P.POS2, r]), seq))
+        if not lane_ops:
+            return
+        idx = jnp.asarray(np.asarray(sorted(lane_ops), np.int32))
+        bucket.state = tm(lambda col, p: col.at[idx].set(p[idx]),
+                          bucket.state, job["pre"])
+        for i, ops in lane_ops.items():
+            t = _bucket(len(ops), self.t_buckets)
+            self.lww._promote(b, i, ops, t)
 
     def _evict_ghosts(self, active_docs: List[str]) -> None:
         """Synthesize leaves for writers silent past client_timeout_s
@@ -1345,6 +2180,7 @@ class TpuSequencerLambda(IPartitionLambda):
         """Chunked snapshots of every materialized channel — merge-tree
         lanes (one batched device extraction per capacity bucket) AND LWW
         lanes (map/cell/counter entries + counter accumulator)."""
+        self.drain()  # settle any deferred window before reading lanes
         out = self.merge.extract_all(chunk_chars)
         for key in self.lww.where:
             snap = self.lww.snapshot(key)
@@ -1369,6 +2205,7 @@ class TpuSequencerLambda(IPartitionLambda):
         replacing the lane states cannot corrupt an in-flight summary."""
         import threading
 
+        self.drain()  # settle any deferred window before reading lanes
         jobs = self.merge.extract_dispatch()
 
         def work():
@@ -1383,12 +2220,14 @@ class TpuSequencerLambda(IPartitionLambda):
                      channel: str) -> Optional[str]:
         """Server-materialized text for a channel (device state + host
         payload table) — the batched-summarization read path."""
+        self.drain()
         return self.merge.text((doc_id, store, channel))
 
     def channel_snapshot(self, doc_id: str, store: str,
                          channel: str) -> Optional[dict]:
         """Server-materialized LWW channel state (map entries / cell value
         under the reserved key / counter accumulator)."""
+        self.drain()
         return self.lww.snapshot((doc_id, store, channel))
 
     def document_seq(self, doc_id: str) -> int:
@@ -1401,6 +2240,7 @@ class TpuSequencerLambda(IPartitionLambda):
         # Graceful close persists progress; pending (unflushed) messages are
         # NOT emitted here — a crash-restart replays them from the last
         # committed offset, the same at-least-once window as the scalar deli.
+        self.drain()
         self._checkpoint()
 
 

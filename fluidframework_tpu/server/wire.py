@@ -13,6 +13,7 @@ from dataclasses import asdict
 from typing import List, Optional
 
 from ..protocol.messages import (
+    Boxcar,
     DocumentMessage,
     ITrace,
     Nack,
@@ -81,3 +82,29 @@ def nack_from_dict(d: dict) -> Nack:
 
 def delta_rows_to_messages(rows: List[dict]) -> List[SequencedDocumentMessage]:
     return [sequenced_message_from_dict(r) for r in rows]
+
+
+def boxcar_to_wire(boxcar: "Boxcar") -> bytes:
+    """Canonical raw-log encoding of a boxcar (the shape a production
+    Kafka topic carries; reference IBoxcarMessage JSON). Key order is part
+    of the contract: the native pump (native/src/wirepump.cpp) requires
+    documentId/clientId before contents, which json.dumps preserves."""
+    import json as _json
+    return _json.dumps({
+        "tenantId": boxcar.tenant_id,
+        "documentId": boxcar.document_id,
+        "clientId": boxcar.client_id,
+        "contents": [asdict(m) for m in boxcar.contents],
+    }).encode("utf-8")
+
+
+def boxcar_from_wire(raw: bytes) -> "Boxcar":
+    import json as _json
+    d = _json.loads(raw)
+    return Boxcar(
+        tenant_id=d.get("tenantId", ""),
+        document_id=d.get("documentId", ""),
+        client_id=d.get("clientId"),
+        contents=[document_message_from_dict(m)
+                  for m in d.get("contents", [])],
+    )
