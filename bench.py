@@ -328,6 +328,23 @@ def main() -> None:
     summarize_extract_ms = (time.perf_counter() - t0) * 1000.0
     live_segments = int(packed_np[-1].sum())
 
+    # Incremental summarization: with 1% of documents dirty, the device
+    # gathers only those lanes into a sub-batch before extraction, so
+    # compute and the D2H transfer scale with the dirty count (the
+    # MergeLaneStore.extract_dispatch(only=...) path at kernel level).
+    dirty_idx = jnp.arange(0, n_docs, 100, dtype=jnp.int32)  # 1% of docs
+
+    def extract_dirty():
+        # The FULL incremental path per call: gather the dirty lanes into
+        # a sub-batch on device, extract, fetch.
+        sub = jax.tree_util.tree_map(lambda x: x[dirty_idx], mt_state)
+        return kernel.fetch_extracted(kernel.extract_visible_batched(sub))
+
+    extract_dirty()  # warm compiles
+    t0 = time.perf_counter()
+    extract_dirty()
+    summarize_extract_dirty1pct_ms = (time.perf_counter() - t0) * 1000.0
+
     # Ragged mixed-size workload (SURVEY.md §7 hard part #3): documents of
     # wildly different sizes route to capacity buckets — one compiled
     # program per (docs, ops, capacity) bucket, all three dispatched
@@ -387,6 +404,8 @@ def main() -> None:
             "baseline_single_thread_ops_s": round(baseline_ops_per_sec, 1),
             "summary_catchup_p50_ms": round(catchup_p50_ms, 2),
             "summarize_extract_ms": round(summarize_extract_ms, 2),
+            "summarize_extract_dirty1pct_ms": round(
+                summarize_extract_dirty1pct_ms, 2),
             "summarize_live_segments": live_segments,
             "ragged_ops_per_sec": ragged_rate,
             "ragged_docs": sum(rb for rb, _, _ in ragged_buckets),

@@ -169,7 +169,12 @@ class Container(TypedEventEmitter):
             return
         for store in self.runtime.datastores.values():
             store.connect()
-        self.storage.upload_summary(self._assemble_summary(), initial=True)
+        handle = self.storage.upload_summary(self._assemble_summary(),
+                                             initial=True)
+        # The attach summary IS the ref head and exactly this state: it is
+        # the incremental baseline from the very first client summary.
+        self._last_summary_handle = handle
+        self.runtime.baseline_epochs()
         self.attached = True
         self.connect()
 
@@ -288,6 +293,8 @@ class Container(TypedEventEmitter):
                         waiter["summary_seq"] = message.sequence_number
         elif mtype == MessageType.SUMMARY_ACK:
             self._last_summary_handle = message.contents["handle"]
+            # The acked upload's epochs become the incremental baseline.
+            self.runtime.on_summary_ack(message.contents["handle"])
             self._notify_summary(True, message.contents)
             self.emit("summaryAck", message.contents)
         elif mtype == MessageType.SUMMARY_NACK:
@@ -379,7 +386,7 @@ class Container(TypedEventEmitter):
                                        message.client_id)
 
     # -- summaries ---------------------------------------------------------
-    def _assemble_summary(self) -> SummaryTree:
+    def _assemble_summary(self, incremental: bool = False) -> SummaryTree:
         root = SummaryTree()
         snap = self.protocol.snapshot()
         root.add_blob(".protocol", json.dumps({
@@ -387,15 +394,28 @@ class Container(TypedEventEmitter):
             "minimumSequenceNumber": snap.minimum_sequence_number,
             "quorum": snap.quorum_snapshot,
         }))
-        root.entries[".app"] = self.runtime.summarize()
+        root.entries[".app"] = self.runtime.summarize(
+            incremental=incremental)
         return root
 
     def summarize(self, on_result: Optional[Callable[[str, bool, Any], None]]
                   = None) -> str:
         """Client summarize: upload -> summarize op -> scribe ack
-        (SURVEY.md §3.5). Returns the uploaded commit handle."""
+        (SURVEY.md §3.5). Returns the uploaded commit handle.
+
+        Incremental when a parent summary exists: channels (and whole
+        datastores) unchanged since the last ACKED summary serialize as
+        SummaryHandles the storage layer resolves against the parent
+        commit — only deltas upload (reference trackState/SummaryTracker,
+        sharedObject.ts:210-244, containerRuntime.ts:1317-1383)."""
+        # Capture epochs BEFORE assembly: ops racing the (possibly slow,
+        # network) upload bump past this snapshot and re-upload next time.
+        epochs = self.runtime.all_channel_epochs()
         handle = self.storage.upload_summary(
-            self._assemble_summary(), parent=self._last_summary_handle)
+            self._assemble_summary(
+                incremental=self._last_summary_handle is not None),
+            parent=self._last_summary_handle)
+        self.runtime.record_upload(handle, epochs)
         # Register the waiter inside before_send: over an in-process service
         # the sequenced SUMMARIZE op AND its ack can both arrive synchronously
         # within submit(), and the waiter must exist (with its csn) by then.

@@ -19,11 +19,23 @@ import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ...protocol.messages import SequencedDocumentMessage
-from ...protocol.summary import (SummaryTree, summary_tree_from_dict,
+from ...protocol.summary import (SummaryHandle, SummaryTree,
+                                 summary_tree_from_dict,
                                  summary_tree_to_dict)
 from .base import (IDocumentDeltaStorageService, IDocumentService,
                    IDocumentServiceFactory, IDocumentStorageService)
 from .file import message_from_json, message_to_json
+
+
+def _has_handles(node) -> bool:
+    """True when an (incremental) summary tree contains SummaryHandles —
+    such a tree is not self-contained and must not be cached as a load
+    source."""
+    if isinstance(node, SummaryHandle):
+        return True
+    if isinstance(node, SummaryTree):
+        return any(_has_handles(child) for child in node.entries.values())
+    return False
 
 
 class PersistentCache:
@@ -113,10 +125,17 @@ class CachingStorageService(IDocumentStorageService):
                        initial: bool = False) -> str:
         handle = self.inner.upload_summary(summary, parent=parent,
                                            initial=initial)
-        self.cache.put(self.key, {
-            "version": handle,
-            "summary": summary_tree_to_dict(summary),
-            "ops": []})
+        if _has_handles(summary):
+            # An incremental upload is NOT a full tree (handles resolve
+            # server-side at write time); caching it would serve a
+            # handle-bearing tree to the next boot's load. Drop the entry
+            # — get_summary refetches the resolved tree on demand.
+            self.cache.remove(self.key)
+        else:
+            self.cache.put(self.key, {
+                "version": handle,
+                "summary": summary_tree_to_dict(summary),
+                "ops": []})
         return handle
 
     def get_versions(self, count: int = 1) -> List[str]:

@@ -365,6 +365,11 @@ class ContainerRuntime(TypedEventEmitter):
                           self._ordinals[m.client_id],
                           m.minimum_sequence_number))
         channel.process_bulk_core(batch)
+        # The bulk path bypasses SharedObject.process, which is where
+        # change_epoch normally bumps — an incremental summary after
+        # catch-up must NOT emit a handle for this channel (that would
+        # durably persist the pre-catch-up content).
+        channel.change_epoch += 1
 
     def _on_self_join(self) -> None:
         """Adopt our quorum-assigned ordinal in every channel's perspective
@@ -384,15 +389,28 @@ class ContainerRuntime(TypedEventEmitter):
             epochs.update(store.channel_epochs())
         return epochs
 
-    def record_upload(self, handle: str) -> None:
+    def record_upload(self, handle: str,
+                      epochs: Optional[Dict[str, int]] = None) -> None:
         """Remember the epochs a just-uploaded summary serialized; they
-        become the acked baseline if/when that summary is acked."""
-        self._upload_epochs[handle] = self.all_channel_epochs()
+        become the acked baseline if/when that summary is acked. Callers
+        pass epochs captured BEFORE assembly: an op applied mid-upload
+        bumps past the captured value, so that channel re-uploads next
+        time (the safe direction) instead of being wrongly marked
+        durable."""
+        self._upload_epochs[handle] = (
+            epochs if epochs is not None else self.all_channel_epochs())
 
     def on_summary_ack(self, handle: Optional[str]) -> None:
         if handle in self._upload_epochs:
             self._acked_epochs = self._upload_epochs.pop(handle)
             self._upload_epochs.clear()  # older proposals are dead
+        else:
+            # ANOTHER client's summary became the parent: our epoch
+            # baseline does not describe its tree, so the next summary
+            # must be full — emitting handles against epochs we never
+            # uploaded could alias stale content.
+            self._acked_epochs = {}
+            self._upload_epochs.clear()
 
     def baseline_epochs(self) -> None:
         """The current state IS durable (attach upload or fresh load):
@@ -400,12 +418,24 @@ class ContainerRuntime(TypedEventEmitter):
         self._acked_epochs = self.all_channel_epochs()
 
     def summarize(self, incremental: bool = False) -> SummaryTree:
+        from ..protocol.summary import SummaryHandle
         gc = self.run_gc()
         tree = SummaryTree()
         stores = tree.add_tree(".dataStores")
         for store_id, store in sorted(self.datastores.items()):
-            stores.entries[store_id] = store.summarize(
-                incremental=incremental, acked_epochs=self._acked_epochs)
+            eps = store.channel_epochs()
+            acked_keys = {k for k in self._acked_epochs
+                          if k.startswith(f"{store_id}/")}
+            if incremental and eps and set(eps) == acked_keys and all(
+                    self._acked_epochs.get(k) == v for k, v in eps.items()):
+                # Whole datastore unchanged since the acked baseline: ONE
+                # handle for its entire subtree (containerRuntime.ts
+                # trackState at datastore granularity).
+                stores.entries[store_id] = SummaryHandle("/")
+            else:
+                stores.entries[store_id] = store.summarize(
+                    incremental=incremental,
+                    acked_epochs=self._acked_epochs)
         if len(self.blob_manager):
             tree.entries[".blobs"] = self.blob_manager.summarize()
         tree.add_blob(".metadata", json.dumps({

@@ -434,18 +434,60 @@ class TpuLocalServer(LocalServer):
     def sequence_number(self, document_id: str) -> int:
         return self.sequencer().document_seq(document_id)
 
-    def write_materialized_snapshots(self, ref: str = "materialized"
+    def write_materialized_snapshots(self, ref: str = "materialized",
+                                     incremental: bool = True
                                      ) -> Dict[str, str]:
         """Commit the server-materialized chunked snapshots to git storage
         under their own ref (per doc): the server-side summarization path —
         no client summarizer involved (reference Scribe writes CLIENT
         summaries, scribe/lambda.ts:162; this writes the sequencer's own
-        device state). Returns {document_id: commit_sha}."""
+        device state). Returns {document_id: commit_sha}.
+
+        incremental=True (the default): only channels DIRTY since the last
+        write extract + upload; clean channels serialize as SummaryHandles
+        into the doc's previous materialized commit, and documents with no
+        dirty channels skip the write entirely — extraction compute, D2H
+        traffic, and blob uploads all scale with the changed set
+        (reference trackState/SummaryTracker, server-side)."""
         import json as _json
 
-        from ..protocol.summary import SummaryTree
+        from ..protocol.summary import SummaryHandle, SummaryTree
 
-        snaps = self.sequencer().summarize_documents()
+        seq = self.sequencer()
+        seq.drain()
+        merge_keys = set(seq.merge.where)
+        lww_keys = set(seq.lww.where)
+        all_keys = merge_keys | lww_keys
+
+        prev_sha: Dict[str, Optional[str]] = {}
+        for doc_id in {k[0] for k in all_keys}:
+            prev_sha[doc_id] = self.historian.store(
+                self.tenant_id, doc_id).get_ref(ref) if incremental \
+                else None
+
+        # Dirty = change generation advanced past what THIS ref last
+        # wrote (per-ref: writes to another ref must not mark channels
+        # clean here).
+        gen_now: Dict[tuple, int] = dict(seq.merge.change_gen)
+        gen_now.update(seq.lww.change_gen)
+        seen_by_ref = getattr(self, "_materialized_gen", None)
+        if seen_by_ref is None:
+            seen_by_ref = self._materialized_gen = {}
+        ref_seen: Dict[tuple, int] = seen_by_ref.setdefault(ref, {})
+        if incremental:
+            dirty = {k for k in all_keys
+                     if gen_now.get(k, 0) > ref_seen.get(k, 0)}
+            # Docs without a previous commit have nothing to point handles
+            # at: extract them fully.
+            full_docs = {d for d, sha in prev_sha.items() if sha is None}
+            want = {k for k in all_keys
+                    if k in dirty or k[0] in full_docs}
+        else:
+            want = all_keys
+        write_docs = {k[0] for k in want}
+
+        snaps = seq.summarize_documents(only=want)
+
         by_doc: Dict[str, SummaryTree] = {}
         for (doc_id, store_id, channel_id), snap in snaps.items():
             root = by_doc.setdefault(doc_id, SummaryTree())
@@ -461,12 +503,31 @@ class TpuLocalServer(LocalServer):
                 node.add_blob("lww", _json.dumps(
                     {"entries": snap["entries"],
                      "counter": snap["counter"]}, sort_keys=True))
-        out = {}
+        # Clean channels of written docs ride as handles into the doc's
+        # previous materialized commit (same tree position).
+        for (doc_id, store_id, channel_id) in all_keys - want:
+            if doc_id not in write_docs:
+                continue
+            root = by_doc.setdefault(doc_id, SummaryTree())
+            store_node = root.entries.get(store_id)
+            if store_node is None:
+                store_node = root.add_tree(store_id)
+            store_node.entries[channel_id] = SummaryHandle("/")
+
+        out: Dict[str, str] = {}
         for doc_id, tree in by_doc.items():
             gstore = self.historian.store(self.tenant_id, doc_id)
             # The sequencer's own state is authoritative (no client-proposal
             # validation cycle to wait for): advance the ref directly.
             out[doc_id] = gstore.write_summary(
                 tree, ref=ref, message="server-materialized snapshot",
-                advance_ref=True)
+                base_commit=prev_sha.get(doc_id), advance_ref=True)
+        # Unchanged docs keep their previous commit in the returned map.
+        for doc_id, sha in prev_sha.items():
+            if doc_id not in out and sha is not None:
+                out[doc_id] = sha
+        # Only the channels actually persisted become clean FOR THIS REF,
+        # at the generation captured before extraction.
+        for k in want:
+            ref_seen[k] = gen_now.get(k, 0)
         return out

@@ -186,6 +186,12 @@ class MergeLaneStore:
         self.overflow_drops = 0  # lanes degraded after exhausting buckets
         self.flushes_since_compact = 0
         self.compact_every = 8
+        # Monotone change generations per channel — incremental
+        # summarization extracts (and transfers) only channels whose
+        # generation advanced past a consumer's last-written snapshot
+        # (per-ref, reference SummaryTracker/trackState server-side).
+        self.change_gen: Dict[tuple, int] = {}
+        self._gen_counter = 0
 
     # -- lane admission ----------------------------------------------------
     def lane_for(self, key: tuple) -> Tuple[int, int]:
@@ -194,6 +200,10 @@ class MergeLaneStore:
             lane = self.buckets[bucket].alloc(key)
             self.where[key] = (bucket, lane)
         return self.where[key]
+
+    def mark_dirty(self, key: tuple) -> None:
+        self._gen_counter += 1
+        self.change_gen[key] = self._gen_counter
 
     def drop(self, key: tuple) -> None:
         """Mark a channel opaque: an op arrived the server cannot model
@@ -230,6 +240,7 @@ class MergeLaneStore:
             lane = bucket.alloc(key)
             bucket.put_row(lane, row)
             self.where[key] = (b, lane)
+            self.mark_dirty(key)
             return True
         self.opaque.add(key)
         return False
@@ -261,6 +272,7 @@ class MergeLaneStore:
             if key in self.opaque or not ops:
                 continue
             b, lane = self.lane_for(key)
+            self.mark_dirty(key)
             per_bucket.setdefault(b, {})[lane] = ops
 
         for b, lane_ops in sorted(per_bucket.items()):
@@ -379,22 +391,42 @@ class MergeLaneStore:
         self.flushes_since_compact = 0
 
     # -- batched summary extraction ----------------------------------------
-    def extract_dispatch(self) -> List[tuple]:
+    def extract_dispatch(self, only: Optional[set] = None) -> List[tuple]:
         """Phase 1 (device, async): launch ONE extraction pass per bucket
         (mask + prefix-sum packing, kernel.extract_visible_batched). The
         returned jobs hold in-flight device arrays — jax dispatch is
         asynchronous, so the caller can keep sequencing the next window
         while these execute (the reference's pipeline-stage overlap,
-        kafka-service/README.md:58-60)."""
+        kafka-service/README.md:58-60).
+
+        only: restrict to these channel keys (incremental summarization):
+        the dirty lanes gather into a pow2-padded sub-batch on device, so
+        extraction compute AND the D2H transfer scale with the dirty
+        count, not the fleet size."""
         jobs = []
         for bucket in self.buckets:
             lanes = [(i, key) for i, key in enumerate(bucket.used)
-                     if key is not None]
+                     if key is not None and (only is None or key in only)]
             if not lanes:
                 continue
-            packed = kernel.extract_visible_batched(bucket.state)
-            jobs.append((packed, lanes, bucket.state.seq,
-                         bucket.state.min_seq))
+            if only is None or len(lanes) == bucket.lanes:
+                packed = kernel.extract_visible_batched(bucket.state)
+                jobs.append((packed, lanes, bucket.state.seq,
+                             bucket.state.min_seq))
+            else:
+                take = np.asarray([i for i, _ in lanes], np.int32)
+                n_pad = 1 << max(len(take) - 1, 0).bit_length()
+                take_p = np.concatenate(
+                    [take, np.zeros(n_pad - len(take), np.int32)])
+                idx = jnp.asarray(take_p)
+                sub = jax.tree_util.tree_map(lambda x: x[idx],
+                                             bucket.state)
+                packed = kernel.extract_visible_batched(sub)
+                # Lane indices become sub-batch rows.
+                jobs.append((packed,
+                             [(j, key) for j, (_, key)
+                              in enumerate(lanes)],
+                             sub.seq, sub.min_seq))
         return jobs
 
     def extract_assemble(self, jobs: List[tuple],
@@ -429,8 +461,10 @@ class MergeLaneStore:
                 }
         return out
 
-    def extract_all(self, chunk_chars: int = 10000) -> Dict[tuple, dict]:
-        return self.extract_assemble(self.extract_dispatch(), chunk_chars)
+    def extract_all(self, chunk_chars: int = 10000,
+                    only: Optional[set] = None) -> Dict[tuple, dict]:
+        return self.extract_assemble(self.extract_dispatch(only),
+                                     chunk_chars)
 
     # -- queries -----------------------------------------------------------
     def text(self, key: tuple) -> Optional[str]:
@@ -536,6 +570,8 @@ class LwwLaneStore:
         self.where: Dict[tuple, Tuple[int, int]] = {}
         self.opaque: set = set()  # channels dropped after bucket exhaustion
         self.overflow_drops = 0
+        self.change_gen: Dict[tuple, int] = {}  # see MergeLaneStore
+        self._gen_counter = 0
         self.key_ids: Dict[str, int] = {}
         self.key_names: List[str] = []
         self.values: List[Any] = []  # payload refs -> raw (encoded) values
@@ -570,6 +606,10 @@ class LwwLaneStore:
             lane = self.buckets[0].alloc(key)
             self.where[key] = (0, lane)
         return self.where[key]
+
+    def mark_dirty(self, key: tuple) -> None:
+        self._gen_counter += 1
+        self.change_gen[key] = self._gen_counter
 
     def seed(self, key: tuple, kind: str, header: Any) -> bool:
         """Bootstrap a lane from a summary header (map entries / cell
@@ -609,6 +649,7 @@ class LwwLaneStore:
                 return False  # oversized snapshot: degraded, not fatal
         else:
             self.lane_for(key)  # empty base: allocate so snapshots report
+            self.mark_dirty(key)
         return True
 
     def wire_to_op(self, op: dict, seq: int) -> tuple:
@@ -677,6 +718,7 @@ class LwwLaneStore:
             if key in self.opaque:
                 continue  # degraded channel: never re-admit
             b, lane = self.lane_for(key)
+            self.mark_dirty(key)
             per_bucket.setdefault(b, {})[lane] = ops
         for b, lane_ops in sorted(per_bucket.items()):
             bucket = self.buckets[b]
@@ -1651,6 +1693,7 @@ class TpuSequencerLambda(IPartitionLambda):
                         if key in self.merge.opaque:
                             continue
             bb, ll = self.merge.lane_for(key)
+            self.merge.mark_dirty(key)
             ok_u[j] = True
             b_u[j] = bb
             l_u[j] = ll
@@ -1687,6 +1730,7 @@ class TpuSequencerLambda(IPartitionLambda):
                         if key in self.lww.opaque:
                             continue
             bb, ll = self.lww.lane_for(key)
+            self.lww.mark_dirty(key)
             ok_u[j] = True
             b_u[j] = bb
             l_u[j] = ll
@@ -2175,14 +2219,18 @@ class TpuSequencerLambda(IPartitionLambda):
                 pass
 
     # -- batched server-side summarization ---------------------------------
-    def summarize_documents(self, chunk_chars: int = 10000
+    def summarize_documents(self, chunk_chars: int = 10000,
+                            only: Optional[set] = None
                             ) -> Dict[tuple, dict]:
         """Chunked snapshots of every materialized channel — merge-tree
         lanes (one batched device extraction per capacity bucket) AND LWW
-        lanes (map/cell/counter entries + counter accumulator)."""
+        lanes (map/cell/counter entries + counter accumulator). `only`
+        restricts to the given channel keys (incremental path)."""
         self.drain()  # settle any deferred window before reading lanes
-        out = self.merge.extract_all(chunk_chars)
+        out = self.merge.extract_all(chunk_chars, only=only)
         for key in self.lww.where:
+            if only is not None and key not in only:
+                continue
             snap = self.lww.snapshot(key)
             if snap is not None:
                 out[key] = {

@@ -1,0 +1,339 @@
+"""Incremental summaries end-to-end (reference trackState/SummaryTracker,
+sharedObject.ts:210-244, containerRuntime.ts:1317-1383).
+
+Client side: channels (and whole datastores) unchanged since the last
+ACKED summary serialize as SummaryHandles; storage resolves them against
+the parent commit, so only deltas upload. Server side: the sequencer's
+materialized snapshots extract + upload only DIRTY channels; clean ones
+ride as handles into the previous materialized commit."""
+
+import json
+
+import pytest
+
+from fluidframework_tpu.dds.counter import SharedCounter
+from fluidframework_tpu.dds.map import SharedMap
+from fluidframework_tpu.dds.sequence import SharedString
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
+from fluidframework_tpu.protocol.summary import (
+    SummaryHandle,
+    SummaryTree,
+)
+from fluidframework_tpu.server.local_server import LocalServer, TpuLocalServer
+
+
+def make_doc(server, doc_id="doc"):
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c = loader.create_detached(doc_id)
+    ds = c.runtime.create_datastore("default")
+    return loader, c, ds
+
+
+def _tree_shapes(tree: SummaryTree, path=""):
+    """Flatten a summary tree into {path: 'handle'|'blob'|'tree'}."""
+    out = {}
+    for k, v in tree.entries.items():
+        p = f"{path}/{k}"
+        if isinstance(v, SummaryHandle):
+            out[p] = "handle"
+        elif isinstance(v, SummaryTree):
+            out[p] = "tree"
+            out.update(_tree_shapes(v, p))
+        else:
+            out[p] = "blob"
+    return out
+
+
+class TestClientIncrementalSummaries:
+    def _summarize_acked(self, c):
+        results = []
+        c.summarize(lambda h, ack, _: results.append((h, ack)))
+        assert results and results[-1][1], "summary was not acked"
+        return results[-1][0]
+
+    def test_clean_channels_become_handles(self, monkeypatch):
+        server = LocalServer()
+        loader, c, ds = make_doc(server)
+        text = ds.create_channel("text", SharedString.TYPE)
+        m = ds.create_channel("meta", SharedMap.TYPE)
+        k = ds.create_channel("clicks", SharedCounter.TYPE)
+        text.insert_text(0, "hello")
+        m.set("a", 1)
+        k.increment(2)
+        c.attach()
+
+        uploads = []
+        orig = c.storage.upload_summary
+
+        def spy(tree, parent=None, initial=False):
+            uploads.append((tree, parent))
+            return orig(tree, parent=parent, initial=initial)
+
+        monkeypatch.setattr(c.storage, "upload_summary", spy)
+
+        # Change ONLY the map; attach summary is the baseline.
+        m.set("b", 2)
+        self._summarize_acked(c)
+        shapes = _tree_shapes(uploads[-1][0])
+        assert shapes["/.app/.dataStores/default/.channels/meta"] == "tree"
+        assert shapes["/.app/.dataStores/default/.channels/text"] == \
+            "handle"
+        assert shapes["/.app/.dataStores/default/.channels/clicks"] == \
+            "handle"
+
+        # Nothing changed at all: the whole datastore collapses to ONE
+        # handle.
+        self._summarize_acked(c)
+        shapes = _tree_shapes(uploads[-1][0])
+        assert shapes["/.app/.dataStores/default"] == "handle"
+
+        # The stored (resolved) tree is complete: a fresh client loads
+        # full content through the handles.
+        c2 = loader.resolve("doc")
+        ds2 = c2.runtime.get_datastore("default")
+        assert ds2.get_channel("text").get_text() == "hello"
+        assert dict(ds2.get_channel("meta").items()) == {"a": 1, "b": 2}
+        assert ds2.get_channel("clicks").value == 2
+
+    def test_foreign_ack_forces_full_summary(self, monkeypatch):
+        """After ANOTHER client's summary is acked, our epoch baseline no
+        longer describes the parent tree: the next summary must be full."""
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server)
+        t1 = ds1.create_channel("text", SharedString.TYPE)
+        t1.insert_text(0, "x")
+        c1.attach()
+        c2 = loader.resolve("doc")
+
+        # c2 summarizes (acked): c1 sees a foreign ack.
+        done = []
+        c2.summarize(lambda h, ack, _: done.append(ack))
+        assert done and done[-1]
+
+        uploads = []
+        orig = c1.storage.upload_summary
+
+        def spy(tree, parent=None, initial=False):
+            uploads.append(tree)
+            return orig(tree, parent=parent, initial=initial)
+
+        monkeypatch.setattr(c1.storage, "upload_summary", spy)
+        t1.insert_text(1, "y")
+        done2 = []
+        c1.summarize(lambda h, ack, _: done2.append(ack))
+        assert done2 and done2[-1]
+        shapes = _tree_shapes(uploads[-1])
+        assert "handle" not in shapes.values(), \
+            "foreign-parent summary must not carry handles"
+
+    def test_repeat_incremental_round_trips(self):
+        """Several incremental summaries in a row, interleaved edits:
+        every reload sees exactly the live state."""
+        server = LocalServer()
+        loader, c, ds = make_doc(server)
+        text = ds.create_channel("text", SharedString.TYPE)
+        m = ds.create_channel("meta", SharedMap.TYPE)
+        c.attach()
+        for i in range(4):
+            if i % 2 == 0:
+                text.insert_text(0, f"t{i}")
+            else:
+                m.set(f"k{i}", i)
+            done = []
+            c.summarize(lambda h, ack, _: done.append(ack))
+            assert done and done[-1]
+            c2 = loader.resolve("doc")
+            ds2 = c2.runtime.get_datastore("default")
+            assert ds2.get_channel("text").get_text() == text.get_text()
+            assert dict(ds2.get_channel("meta").items()) == dict(m.items())
+            c2.close()
+
+
+class TestServerIncrementalMaterialization:
+    def _blob_counter(self, server, monkeypatch):
+        counts = {"n": 0}
+        from fluidframework_tpu.server import storage as storage_mod
+        orig = storage_mod.GitStore.put_blob
+
+        def spy(self_store, content):
+            counts["n"] += 1
+            return orig(self_store, content)
+
+        monkeypatch.setattr(storage_mod.GitStore, "put_blob", spy)
+        return counts
+
+    def test_only_dirty_docs_rewrite(self, monkeypatch):
+        server = TpuLocalServer()
+        loader = Loader(LocalDocumentServiceFactory(server))
+        texts = {}
+        for d in range(8):
+            c = loader.create_detached(f"doc{d}")
+            ds = c.runtime.create_datastore("default")
+            t = ds.create_channel("text", SharedString.TYPE)
+            c.attach()
+            t.insert_text(0, f"content-{d} " * 20)
+            texts[f"doc{d}"] = t
+        shas1 = server.write_materialized_snapshots()
+        assert set(shas1) == {f"doc{d}" for d in range(8)}
+
+        counts = self._blob_counter(server, monkeypatch)
+        texts["doc3"].insert_text(0, "EDIT ")
+        shas2 = server.write_materialized_snapshots()
+        # Only the dirty doc re-committed; the rest kept their shas.
+        assert shas2["doc3"] != shas1["doc3"]
+        for d in range(8):
+            if d != 3:
+                assert shas2[f"doc{d}"] == shas1[f"doc{d}"]
+        # Blob traffic ~ one doc (header + body + tree nodes), nowhere
+        # near the full fleet's.
+        assert 0 < counts["n"] <= 6, counts["n"]
+
+        # The incremental commit still reads back COMPLETE.
+        store = server.historian.store(server.tenant_id, "doc3")
+        tree = store.read_summary(shas2["doc3"])
+        body = json.loads(tree.entries["default"].entries["text"]
+                          .entries["chunk_0"].content)
+        joined = "".join(e.get("text") or "" for e in body
+                         if e.get("removedSeq") is None)
+        assert joined == texts["doc3"].get_text()
+
+    def test_unchanged_fleet_skips_all_writes(self, monkeypatch):
+        server = TpuLocalServer()
+        loader = Loader(LocalDocumentServiceFactory(server))
+        for d in range(4):
+            c = loader.create_detached(f"q{d}")
+            ds = c.runtime.create_datastore("default")
+            t = ds.create_channel("text", SharedString.TYPE)
+            c.attach()
+            t.insert_text(0, "stable")
+        shas1 = server.write_materialized_snapshots()
+        counts = self._blob_counter(server, monkeypatch)
+        shas2 = server.write_materialized_snapshots()
+        assert shas2 == shas1
+        assert counts["n"] == 0, "clean fleet wrote blobs"
+
+    def test_mixed_families_incremental(self, monkeypatch):
+        """A doc with a dirty LWW channel and a clean merge channel
+        uploads only the LWW blob; the merge channel rides a handle."""
+        server = TpuLocalServer()
+        loader, c, ds = make_doc(server, "mix")
+        t = ds.create_channel("text", SharedString.TYPE)
+        m = ds.create_channel("meta", SharedMap.TYPE)
+        c.attach()
+        t.insert_text(0, "fixed text " * 50)
+        m.set("v", 1)
+        server.write_materialized_snapshots()
+        counts = self._blob_counter(server, monkeypatch)
+        m.set("v", 2)
+        shas = server.write_materialized_snapshots()
+        assert counts["n"] <= 4, counts["n"]  # lww blob + small trees
+        store = server.historian.store(server.tenant_id, "mix")
+        tree = store.read_summary(shas["mix"])
+        lww = json.loads(tree.entries["default"].entries["meta"]
+                         .entries["lww"].content)
+        assert lww["entries"]["v"] == 2
+        body = json.loads(tree.entries["default"].entries["text"]
+                          .entries["chunk_0"].content)
+        joined = "".join(e.get("text") or "" for e in body
+                         if e.get("removedSeq") is None)
+        assert joined == t.get_text()
+
+    def test_per_ref_dirty_tracking(self):
+        """Writing to one ref must not mark channels clean for another:
+        handles are only valid against the ref's own previous commit."""
+        server = TpuLocalServer()
+        loader, c, ds = make_doc(server, "refs")
+        t = ds.create_channel("text", SharedString.TYPE)
+        c.attach()
+        t.insert_text(0, "v1")
+        server.write_materialized_snapshots(ref="a")
+        m = ds.create_channel("late", SharedMap.TYPE)
+        m.set("k", 1)
+        server.write_materialized_snapshots(ref="b")
+        # ref "a" has never seen "late": it must be extracted (not a
+        # handle into a commit that lacks it).
+        shas = server.write_materialized_snapshots(ref="a")
+        store = server.historian.store(server.tenant_id, "refs")
+        tree = store.read_summary(shas["refs"])
+        lww = json.loads(tree.entries["default"].entries["late"]
+                         .entries["lww"].content)
+        assert lww["entries"]["k"] == 1
+
+    def test_bulk_catchup_bumps_epoch(self):
+        """A summarizer that caught up via the device bulk path must NOT
+        emit a handle for the caught-up channel — that would persist the
+        pre-catch-up content durably."""
+        server = LocalServer()
+        loader, c1, ds1 = make_doc(server, "bulkdoc")
+        t1 = ds1.create_channel("text", SharedString.TYPE)
+        c1.attach()
+        t1.insert_text(0, "base")
+        done = []
+        c1.summarize(lambda h, ack, _: done.append(ack))
+        assert done[-1]
+        # A second client builds a long remote tail...
+        c2 = loader.resolve("bulkdoc")
+        t2 = c2.runtime.get_datastore("default").get_channel("text")
+        for i in range(120):
+            t2.insert_text(0, f"{i % 10}")
+        # ...and a third catches up over the bulk kernel path, then
+        # summarizes incrementally.
+        c3 = loader.resolve("bulkdoc")
+        t3 = c3.runtime.get_datastore("default").get_channel("text")
+        assert t3.get_text() == t2.get_text()
+        done3 = []
+        c3.summarize(lambda h, ack, _: done3.append(ack))
+        assert done3[-1]
+        c4 = loader.resolve("bulkdoc")
+        t4 = c4.runtime.get_datastore("default").get_channel("text")
+        assert t4.get_text() == t2.get_text()
+
+    def test_caching_driver_never_caches_handle_trees(self, tmp_path):
+        """An incremental upload is not self-contained; the caching driver
+        must not serve it as a boot summary."""
+        from fluidframework_tpu.loader.drivers.caching import (
+            CachingDocumentServiceFactory,
+            PersistentCache,
+        )
+        server = LocalServer()
+        cache = PersistentCache(str(tmp_path / "cache.json"))
+        factory = CachingDocumentServiceFactory(
+            LocalDocumentServiceFactory(server), cache)
+        loader = Loader(factory)
+        c = loader.create_detached("cached")
+        ds = c.runtime.create_datastore("default")
+        t = ds.create_channel("text", SharedString.TYPE)
+        c.attach()
+        t.insert_text(0, "alpha ")
+        done = []
+        c.summarize(lambda h, ack, _: done.append(ack))
+        assert done[-1]
+        t.insert_text(6, "beta")
+        done2 = []
+        c.summarize(lambda h, ack, _: done2.append(ack))  # incremental
+        assert done2[-1]
+        # A fresh boot through the same cache loads FULL content.
+        c2 = Loader(factory).resolve("cached")
+        t2 = c2.runtime.get_datastore("default").get_channel("text")
+        assert t2.get_text() == "alpha beta"
+
+    def test_dirty_subset_extraction_matches_full(self):
+        """extract_dispatch(only=...) returns byte-identical snapshots to
+        the full extraction for the selected channels."""
+        server = TpuLocalServer()
+        loader = Loader(LocalDocumentServiceFactory(server))
+        for d in range(6):
+            c = loader.create_detached(f"e{d}")
+            ds = c.runtime.create_datastore("default")
+            t = ds.create_channel("text", SharedString.TYPE)
+            c.attach()
+            for i in range(10):
+                t.insert_text(0, f"{d}:{i} ")
+        merge = server.sequencer().merge
+        full = merge.extract_all()
+        subset_keys = {("e1", "default", "text"), ("e4", "default", "text")}
+        sub = merge.extract_all(only=subset_keys)
+        assert set(sub) == subset_keys
+        for key in subset_keys:
+            assert sub[key] == full[key]
