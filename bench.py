@@ -263,6 +263,19 @@ def main() -> None:
     baseline_sample = min(16, n_docs)
     baseline_ops_per_sec = run_baseline(cols, baseline_sample, n_ops)
 
+    # Pinned baseline (BASELINE_PINNED.json): a fixed, methodology-
+    # documented measurement (256 docs, seed-0 trace, median of 3) so the
+    # headline ratio has a stable denominator — the per-run 16-doc sample
+    # above swings ±25% with host noise and is reported alongside.
+    pinned_baseline = None
+    try:
+        with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "BASELINE_PINNED.json")) as f:
+            pinned_baseline = float(
+                json.load(f)["baseline_ops_per_sec"])
+    except (OSError, ValueError, KeyError):
+        pass
+
     import jax.numpy as jnp
     ops = PackedOps(**{f: jnp.asarray(cols[f]) for f in PackedOps._fields})
     raw = tk.RawOps(client=ops.client,
@@ -395,13 +408,17 @@ def main() -> None:
                   f"{n_docs} docs (ticket+apply+summary-len)",
         "value": round(ops_per_sec, 1),
         "unit": "ops/s",
-        "vs_baseline": round(ops_per_sec / baseline_ops_per_sec, 2),
+        "vs_baseline": round(
+            ops_per_sec / (pinned_baseline or baseline_ops_per_sec), 2),
         "extra": {
             "backend": jax.default_backend(),
             "fused_apply": use_fused,
             "elapsed_s": round(elapsed, 4),
             "docs": n_docs, "ops_per_doc": n_ops,
             "baseline_single_thread_ops_s": round(baseline_ops_per_sec, 1),
+            "baseline_pinned_ops_s": pinned_baseline,
+            "vs_baseline_sampled": round(
+                ops_per_sec / baseline_ops_per_sec, 2),
             "summary_catchup_p50_ms": round(catchup_p50_ms, 2),
             "summarize_extract_ms": round(summarize_extract_ms, 2),
             "summarize_extract_dirty1pct_ms": round(

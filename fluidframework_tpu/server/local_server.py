@@ -401,7 +401,15 @@ class TpuLocalServer(LocalServer):
     device-resident segment tables (server/tpu_sequencer.py) — the
     TPU-batched partition lambda of the north star on the real serving
     path. Scriptorium/Scribe/Broadcaster/Copier are unchanged (host I/O).
+
+    mesh: an optional jax.sharding.Mesh — the sequencer's ticket lanes
+    and merge/LWW channel lanes shard over its 'dp' axis (multi-chip
+    serving; parallel/mesh.py).
     """
+
+    def __init__(self, *args, mesh=None, **kwargs):
+        self.mesh = mesh
+        super().__init__(*args, **kwargs)
 
     def _build_sequencer(self) -> PartitionManager:
         from .tpu_sequencer import TpuSequencerLambda
@@ -410,7 +418,7 @@ class TpuLocalServer(LocalServer):
             lam = TpuSequencerLambda(
                 ctx, emit=self._emit_sequenced, nack=self._emit_nack,
                 checkpoints=self.deli_checkpoints, deltas=self.deltas,
-                fresh_log=True,
+                fresh_log=True, mesh=getattr(self, "mesh", None),
                 # Snapshot seeding: lanes for channels whose base content
                 # shipped in the attach/client summary bootstrap from the
                 # historian instead of overflowing on their first op.
@@ -470,9 +478,14 @@ class TpuLocalServer(LocalServer):
         # clean here).
         gen_now: Dict[tuple, int] = dict(seq.merge.change_gen)
         gen_now.update(seq.lww.change_gen)
-        seen_by_ref = getattr(self, "_materialized_gen", None)
+        # The watermark map lives ON the sequencer lambda: a crash-restart
+        # replaces the lambda (fresh generation counters starting at 0),
+        # and comparing new counters against a previous instance's high
+        # watermarks would silently treat every post-restart edit as
+        # clean.
+        seen_by_ref = getattr(seq, "_materialized_gen", None)
         if seen_by_ref is None:
-            seen_by_ref = self._materialized_gen = {}
+            seen_by_ref = seq._materialized_gen = {}
         ref_seen: Dict[tuple, int] = seen_by_ref.setdefault(ref, {})
         if incremental:
             dirty = {k for k in all_keys

@@ -81,8 +81,9 @@ class _MergeBucket:
         self._blank_row: Optional[DocState] = None  # built lazily, reused
         self._free: List[int] = []  # explicitly freed lanes (zeroed)
         self._next = 0              # frontier: lanes >= _next never used
+        self.placer = None          # optional dp-mesh placement callable
 
-    def _grow(self) -> None:
+    def grow(self) -> None:
         old = self.lanes
         grown = make_state(self.capacity, batch=old * 2)
         self.state = jax.tree_util.tree_map(
@@ -90,6 +91,8 @@ class _MergeBucket:
             grown, self.state)
         self.used.extend([None] * old)
         self.lanes = old * 2
+        if self.placer is not None:
+            self.state = self.placer(self.state)
 
     def alloc(self, key: tuple) -> int:
         # Free-list + frontier: O(1) per alloc (a linear first-None scan
@@ -98,7 +101,7 @@ class _MergeBucket:
             i = self._free.pop()
         else:
             if self._next >= self.lanes:
-                self._grow()
+                self.grow()
             i = self._next
             self._next += 1
         self.used[i] = key
@@ -514,6 +517,17 @@ class _LwwBucket:
         self._blank_row = None  # built lazily, reused across frees
         self._free: List[int] = []
         self._next = 0
+        self.placer = None  # optional dp-mesh placement callable
+
+    def grow(self) -> None:
+        old = self.lanes
+        grown = self.lk.make_lww_state(self.capacity, batch=old * 2)
+        self.state = jax.tree_util.tree_map(
+            lambda g, s: g.at[:old].set(s), grown, self.state)
+        self.used.extend([None] * old)
+        self.lanes = old * 2
+        if self.placer is not None:
+            self.state = self.placer(self.state)
 
     def alloc(self, key: tuple) -> int:
         # Free-list + frontier (see _MergeBucket.alloc).
@@ -521,13 +535,7 @@ class _LwwBucket:
             i = self._free.pop()
         else:
             if self._next >= self.lanes:
-                old = self.lanes
-                grown = self.lk.make_lww_state(self.capacity,
-                                               batch=old * 2)
-                self.state = jax.tree_util.tree_map(
-                    lambda g, s: g.at[:old].set(s), grown, self.state)
-                self.used.extend([None] * old)
-                self.lanes = old * 2
+                self.grow()
             i = self._next
             self._next += 1
         self.used[i] = key
@@ -1062,7 +1070,7 @@ class TpuSequencerLambda(IPartitionLambda):
                  merge_store: Optional[MergeLaneStore] = None,
                  t_buckets: Tuple[int, ...] = (1, 4, 16, 64, 256),
                  storage=None, client_timeout_s: float = 300.0,
-                 send_system=None, config=None):
+                 send_system=None, config=None, mesh=None):
         """storage: optional callable doc_id -> SummaryTree | None (the
         historian's latest summary). Enables snapshot seeding: merge lanes
         for channels whose base content shipped in a summary bootstrap
@@ -1099,16 +1107,37 @@ class TpuSequencerLambda(IPartitionLambda):
         # gate replay of the new one (DeliLambda fresh_log semantics).
         self.fresh_log = fresh_log
         self.t_buckets = tuple(t_buckets)
+        # Multi-chip serving: with a mesh, the ticket lanes AND the
+        # merge/LWW channel lanes shard over 'dp' — lanes are
+        # embarrassingly parallel, so GSPMD partitions the whole fused
+        # window with no inter-device traffic beyond the small ticket
+        # gather (reference analog: one deli consumer per partition,
+        # partitionManager.ts:22, collapsed onto one mesh).
+        self.mesh = mesh
+        if mesh is not None:
+            # Lane counts must be dp-divisible to shard; doubling growth
+            # preserves divisibility afterwards.
+            dp = int(mesh.shape.get("dp", 1))
+            lanes = ((max(lanes, dp) + dp - 1) // dp) * dp
         self.lanes = lanes
         self.k = clients_capacity
-        self.tstate: tk.TicketState = tk.make_ticket_state(self.k,
-                                                           batch=lanes)
+        self.tstate: tk.TicketState = self._place(
+            tk.make_ticket_state(self.k, batch=lanes))
         self.docs: Dict[str, _DocLane] = {}
         self.pending: Dict[str, List[_Pending]] = {}
         self.materialize = materialize
         self.merge = merge_store if merge_store is not None else \
             MergeLaneStore(t_buckets=t_buckets)
         self.lww = LwwLaneStore(t_buckets=t_buckets)
+        if mesh is not None:
+            dp = int(mesh.shape.get("dp", 1))
+            for bucket in self.merge.buckets + self.lww.buckets:
+                # Grow to a dp multiple BEFORE placing (a 16-chip mesh
+                # cannot shard the default 8 lanes).
+                while bucket.lanes % dp != 0 or bucket.lanes < dp:
+                    bucket.grow()
+                bucket.placer = self._place
+                bucket.state = self._place(bucket.state)
         self._pending_offset: Optional[int] = None
         # Fast-path (raw wire bytes) ingest state: the native pump + its
         # ordinal mirrors. emit_window, when set, receives ONE
@@ -1154,7 +1183,7 @@ class TpuSequencerLambda(IPartitionLambda):
         cols = dump["tstate"]
         self.lanes = len(cols["next_seq"])
         self.k = len(cols["client_ids"][0]) if cols["client_ids"] else self.k
-        self.tstate = tk.TicketState(
+        self.tstate = self._place(tk.TicketState(
             client_ids=jnp.asarray(np.asarray(cols["client_ids"], np.int32)),
             client_ref=jnp.asarray(np.asarray(cols["client_ref"], np.int32)),
             client_cseq=jnp.asarray(np.asarray(cols["client_cseq"],
@@ -1162,7 +1191,7 @@ class TpuSequencerLambda(IPartitionLambda):
             next_seq=jnp.asarray(np.asarray(cols["next_seq"], np.int32)),
             min_seq=jnp.asarray(np.asarray(cols["min_seq"], np.int32)),
             overflow=jnp.asarray(np.asarray(cols["overflow"], np.bool_)),
-        )
+        ))
         # Re-arm ghost eviction for members restored into the device
         # client table (last_seen is not persisted): a ghost present at
         # the crash still ages out after restart.
@@ -1288,6 +1317,25 @@ class TpuSequencerLambda(IPartitionLambda):
         dl.log_offset = message.offset
         self._pending_offset = message.offset
 
+    def _place(self, tree):
+        """Shard a batched pytree's leading (lane) axis over 'dp'; no-op
+        without a mesh."""
+        if self.mesh is None:
+            return tree
+        from ..parallel.mesh import shard_docs
+        return shard_docs(self.mesh, tree)
+
+    def _place_cols(self, arr: np.ndarray, lane_axis: int = 1):
+        """H2D a staging array with its lane axis sharded over 'dp'."""
+        x = jnp.asarray(arr)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            spec = [None] * x.ndim
+            spec[lane_axis] = "dp"
+            x = jax.device_put(
+                x, NamedSharding(self.mesh, PartitionSpec(*spec)))
+        return x
+
     def handler_raw(self, message: QueuedMessage) -> None:
         """Raw-log ingest: message.value is a serialized wire boxcar
         (server/wire.py boxcar_to_wire), message.key the document id.
@@ -1349,8 +1397,8 @@ class TpuSequencerLambda(IPartitionLambda):
     def _grow_lanes(self) -> None:
         old = self.lanes
         grown = tk.make_ticket_state(self.k, batch=old * 2)
-        self.tstate = jax.tree_util.tree_map(
-            lambda g, s: g.at[:old].set(s), grown, self.tstate)
+        self.tstate = self._place(jax.tree_util.tree_map(
+            lambda g, s: g.at[:old].set(s), grown, self.tstate))
         self.lanes = old * 2
 
     def _grow_clients(self) -> None:
@@ -1361,11 +1409,11 @@ class TpuSequencerLambda(IPartitionLambda):
             out = jnp.full((self.lanes, k2), fill, col.dtype)
             return out.at[:, :self.k].set(col)
 
-        self.tstate = t._replace(
+        self.tstate = self._place(t._replace(
             client_ids=widen(t.client_ids, -1),
             client_ref=widen(t.client_ref, tk.INT32_MAX),
             client_cseq=widen(t.client_cseq, 0),
-        )
+        ))
         self.k = k2
 
     def _parse(self, dl: _DocLane, client_id: Optional[str],
@@ -1766,11 +1814,11 @@ class TpuSequencerLambda(IPartitionLambda):
         # ONE fused device program for the whole window (every extra
         # dispatch is a serialized tunnel RPC), then ONE host sync.
         self.tstate, new_merge, new_lww, flat_dev = serve_step.serve_window(
-            self.tstate, jnp.asarray(ticket_cols),
+            self.tstate, self._place_cols(ticket_cols),
             [self.merge.buckets[j["bucket"]].state for j in merge_jobs],
-            [jnp.asarray(j["cols"]) for j in merge_jobs],
+            [self._place_cols(j["cols"]) for j in merge_jobs],
             [self.lww.buckets[j["bucket"]].state for j in lww_jobs],
-            [jnp.asarray(j["cols"]) for j in lww_jobs])
+            [self._place_cols(j["cols"]) for j in lww_jobs])
         for j, post in zip(merge_jobs, new_merge):
             j["post"] = post
             self.merge.buckets[j["bucket"]].state = post
