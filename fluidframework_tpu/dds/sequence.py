@@ -15,7 +15,7 @@ from typing import Any, Dict, Iterator, List, Optional
 
 from ..core.events import TypedEventEmitter
 from ..mergetree.client import MergeTreeClient
-from ..mergetree.constants import SNAPSHOT_CHUNK_SIZE
+from ..mergetree.constants import SEG_MARKER, SNAPSHOT_CHUNK_SIZE
 from ..mergetree.oracle import REF_SLIDE_ON_REMOVE, LocalReference
 from ..protocol.summary import SummaryTree
 from .shared_object import SharedObject
@@ -221,6 +221,12 @@ class SharedSegmentSequence(SharedObject):
 
     def __init__(self, object_id: str, runtime=None):
         super().__init__(object_id, runtime)
+        # Lazy snapshot load (reference sequence.ts:489,664): when set,
+        # body chunks have NOT been parsed — (tree, header) pending.
+        self._lazy = None
+        self._lazy_len = 0
+        self._lazy_ordinal: Optional[int] = None
+        self._deferred_remote: List[tuple] = []
         self.client = MergeTreeClient(client_id=self.local_client_id)
         self.client.on("delta", lambda args, local:
                        self.emit("sequenceDelta", args, local))
@@ -236,18 +242,103 @@ class SharedSegmentSequence(SharedObject):
         # Adopt the runtime's client ordinal (retags pending segments too).
         self.client.update_client_id(runtime.client_ordinal)
 
+    # -- lazy body ---------------------------------------------------------
+    @property
+    def client(self) -> MergeTreeClient:
+        """Anything touching merge-tree state materializes a pending lazy
+        body first; header-only queries (get_length) never come here."""
+        if self._lazy is not None:
+            self._materialize_body()
+        return self._client
+
+    @client.setter
+    def client(self, value: MergeTreeClient) -> None:
+        self._client = value
+
+    def _materialize_body(self) -> None:
+        tree, header = self._lazy
+        self._lazy = None
+        segments: List[dict] = []
+        for i in range(header["chunkCount"]):
+            segments.extend(json.loads(tree.entries[f"body_{i}"].content))
+        segments = self._decode_snapshot_segments(segments)
+        self._client = MergeTreeClient.load(
+            {"segments": segments, "seq": header["seq"],
+             "minSeq": header["minSeq"]},
+            client_id=self.local_client_id)
+        self._client.on("delta", lambda args, local:
+                        self.emit("sequenceDelta", args, local))
+        if self._lazy_ordinal is not None:
+            self._client.update_client_id(self._lazy_ordinal)
+            self._lazy_ordinal = None
+        if "intervals" in tree.entries:
+            payload = json.loads(tree.entries["intervals"].content)
+            for label, entries in payload.items():
+                coll = self.get_interval_collection(label)
+                for entry in entries:
+                    coll._attach(entry["intervalId"], entry["start"],
+                                 entry["end"], entry.get("properties"))
+        # Ops deferred while the body was pending replay in order.
+        deferred, self._deferred_remote = self._deferred_remote, []
+        for contents, seq, ref_seq, ordinal, min_seq in deferred:
+            self._client.apply_msg(contents, seq, ref_seq, ordinal,
+                                   min_seq=min_seq)
+
+    @staticmethod
+    def _op_len_delta(contents) -> Optional[int]:
+        """Visible-length delta of a wire op, computable WITHOUT the body
+        (None = shape unknown: materialize instead of deferring)."""
+        if not isinstance(contents, dict):
+            return None
+        t = contents.get("type")
+        if t == 0:  # insert
+            seg = contents.get("seg") or {}
+            if seg.get("marker"):
+                return 1
+            if isinstance(seg.get("text"), str):
+                return len(seg["text"])
+            if isinstance(seg.get("items"), list):
+                return len(seg["items"])
+            return None
+        if t == 1:
+            # Removes NEVER defer: a concurrent remove overlapping an
+            # already-removed span shrinks by less than pos2-pos1 (the
+            # oracle skips removed segments), which only the body knows.
+            return None
+        if t == 2:  # annotate
+            return 0
+        if t == 3:  # group
+            total = 0
+            for sub in contents.get("ops", []):
+                d = SharedSegmentSequence._op_len_delta(sub)
+                if d is None:
+                    return None
+                total += d
+            return total
+        return None
+
     # -- queries -----------------------------------------------------------
     def get_length(self) -> int:
-        return self.client.get_length()
+        if self._lazy is not None:
+            # Header-only: totalLength adjusted by deferred remote ops.
+            return self._lazy_len
+        return self._client.get_length()
 
     # -- lifecycle ---------------------------------------------------------
     def adopt_client_ordinal(self, ordinal: int) -> None:
+        if self._lazy is not None:
+            self._lazy_ordinal = ordinal  # applied at materialization
+            return
         self.client.update_client_id(ordinal)
 
     def connect(self) -> None:
-        if not self.attached and self.client.tree.pending_groups:
+        # A lazily-loaded channel is fresh from a snapshot: it cannot have
+        # detached edits, so the pending-groups probe must not defeat the
+        # lazy body by touching merge-tree state.
+        if self._lazy is None and not self.attached and \
+                self._client.tree.pending_groups:
             # Detached edits fold into the attach summary, not ops.
-            self.client.commit_detached()
+            self._client.commit_detached()
         super().connect()
 
     # -- local references (client.ts createLocalReferencePosition) --------
@@ -280,6 +371,18 @@ class SharedSegmentSequence(SharedObject):
     # -- channel plumbing --------------------------------------------------
     def process_core(self, contents, local, seq, ref_seq, client_ordinal,
                      min_seq) -> None:
+        if self._lazy is not None and not local:
+            # Body still pending: queue remote ops whose length effect is
+            # computable from the wire shape (reference: incoming ops are
+            # deferred until the needed body chunk arrives,
+            # sequence.ts:664); anything else materializes first.
+            delta = self._op_len_delta(contents)
+            if delta is not None:
+                self._deferred_remote.append(
+                    (contents, seq, ref_seq, client_ordinal, min_seq))
+                self._lazy_len += delta
+                self.change_epoch += 1  # deferred != unchanged
+                return
         if isinstance(contents, dict) and \
                 contents.get("type") == "intervalCollection":
             if local:
@@ -318,6 +421,10 @@ class SharedSegmentSequence(SharedObject):
         self.bulk_catchup_count += 1
 
     def resubmit_pending(self) -> List[Any]:
+        if self._lazy is not None:
+            # Lazily loaded = fresh from snapshot: no merge-tree pendings
+            # can exist, and the probe must not materialize the body.
+            return list(self._pending_interval_ops.values())
         return (self.client.regenerate_pending_ops()
                 + list(self._pending_interval_ops.values()))
 
@@ -325,6 +432,11 @@ class SharedSegmentSequence(SharedObject):
         """Chunked snapshot: header with collab window + body chunks of
         bounded size (reference snapshotV1.ts chunking, chunkSize=10000)."""
         snap = self.client.snapshot()
+        # Measured BEFORE encoding: _encode_snapshot_segments mutates
+        # payloads in place (Items -> {"items": [...]}).
+        total = sum(self._segment_visible_len(seg)
+                    for seg in snap["segments"]
+                    if seg.get("removedSeq") is None)
         segments = self._encode_snapshot_segments(snap["segments"])
         chunks: List[List[dict]] = [[]]
         size = 0
@@ -342,6 +454,8 @@ class SharedSegmentSequence(SharedObject):
             "seq": snap["seq"],
             "minSeq": snap["minSeq"],
             "chunkCount": len(chunks),
+            # Enables header-only get_length on lazy load.
+            "totalLength": total,
         }))
         for i, chunk in enumerate(chunks):
             tree.add_blob(f"body_{i}", json.dumps(chunk))
@@ -359,6 +473,14 @@ class SharedSegmentSequence(SharedObject):
             tree.add_blob("intervals", json.dumps(payload))
         return tree
 
+    def _segment_visible_len(self, seg: dict) -> int:
+        """Visible-length contribution of a DECODED snapshot segment
+        (header totalLength; item sequences count items, not payload
+        encoding)."""
+        if seg.get("kind") == SEG_MARKER:
+            return 1
+        return len(seg.get("text", ""))
+
     def _encode_snapshot_segments(self, segments: List[dict]) -> List[dict]:
         """Hook: make segment payloads JSON-safe (item sequences override)."""
         return segments
@@ -368,23 +490,18 @@ class SharedSegmentSequence(SharedObject):
 
     def load_core(self, tree: SummaryTree) -> None:
         header = json.loads(tree.entries["header"].content)
-        segments: List[dict] = []
-        for i in range(header["chunkCount"]):
-            segments.extend(json.loads(tree.entries[f"body_{i}"].content))
-        segments = self._decode_snapshot_segments(segments)
-        self.client = MergeTreeClient.load(
-            {"segments": segments, "seq": header["seq"],
-             "minSeq": header["minSeq"]},
-            client_id=self.local_client_id)
-        self.client.on("delta", lambda args, local:
-                       self.emit("sequenceDelta", args, local))
-        if "intervals" in tree.entries:
-            payload = json.loads(tree.entries["intervals"].content)
-            for label, entries in payload.items():
-                coll = self.get_interval_collection(label)
-                for entry in entries:
-                    coll._attach(entry["intervalId"], entry["start"],
-                                 entry["end"], entry.get("properties"))
+        if "totalLength" in header and "intervals" not in tree.entries:
+            # Header-first lazy load: body chunks parse (and, with a lazy
+            # storage tree, transfer) only when merge-tree state is first
+            # touched; catch-up memory stays proportional to the header.
+            # Interval-bearing snapshots load eagerly — interval anchors
+            # resolve against live segments.
+            self._lazy = (tree, header)
+            self._lazy_len = int(header["totalLength"])
+            return
+        # Legacy snapshot (no totalLength): eager load.
+        self._lazy = (tree, header)
+        self._materialize_body()
 
 
 class SharedItemsSequence(SharedSegmentSequence):
@@ -420,6 +537,15 @@ class SharedItemsSequence(SharedSegmentSequence):
                 if isinstance(seg.text, Items):
                     out.extend(seg.text.values)
         return out[start:end]
+
+    def _segment_visible_len(self, seg: dict) -> int:
+        from ..mergetree.oracle import Items
+        text = seg.get("text")
+        if isinstance(text, Items):
+            return len(text.values)
+        if isinstance(text, dict) and "items" in text:
+            return len(text["items"])
+        return super()._segment_visible_len(seg)
 
     # Items payloads are not JSON until wrapped (snapshot wire shape
     # mirrors matrix.py's Run encoding: {"items": [...]}).

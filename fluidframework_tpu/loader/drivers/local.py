@@ -44,8 +44,11 @@ class LocalDocumentStorageService(IDocumentStorageService):
     def get_summary(self, version: Optional[str] = None):
         # Reads ride the historian cache (reference: drivers talk to
         # historian, the caching proxy, never to gitrest directly).
+        # lazy: blob contents resolve on first access, so a lazy-loading
+        # channel (sequence body chunks) defers their transfer entirely.
         return self.server.historian.read_summary(
-            self.server.tenant_id, self.document_id, commit_sha=version)
+            self.server.tenant_id, self.document_id, commit_sha=version,
+            lazy=True)
 
     def upload_summary(self, summary: SummaryTree,
                        parent: Optional[str] = None,

@@ -29,6 +29,27 @@ class SummaryBlob:
     type: str = SummaryType.BLOB
 
 
+class LazySummaryBlob(SummaryBlob):
+    """A blob whose content fetches on first access (lazy snapshot load:
+    the reference defers 10k-char body chunks, snapshotV1.ts:33-40 +
+    sequence.ts:489). isinstance(x, SummaryBlob) holds; `.content` is a
+    property resolved through the fetch callable, so consumers that never
+    touch a chunk never pay its transfer."""
+
+    def __init__(self, fetch):
+        # No super().__init__: `content` stays a CLASS-level property
+        # (the dataclass would write an instance attribute over it).
+        self._fetch = fetch
+        self._content = None
+        self.type = SummaryType.BLOB
+
+    @property
+    def content(self):
+        if self._content is None:
+            self._content = self._fetch()
+        return self._content
+
+
 @dataclass
 class SummaryHandle:
     """Reference to a path in the *previous* summary (incremental summaries)."""

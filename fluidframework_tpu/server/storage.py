@@ -157,27 +157,47 @@ class GitStore:
         return sha
 
     def read_summary(self, commit_sha: Optional[str] = None,
-                     ref: str = "main") -> Optional[SummaryTree]:
+                     ref: str = "main",
+                     lazy: bool = False) -> Optional[SummaryTree]:
+        """lazy=True: blob entries resolve their content on first access
+        (LazySummaryBlob) and `self.blob_fetches` counts resolutions —
+        the loader's header-first / body-on-demand snapshot load."""
         sha = commit_sha or self.get_ref(ref)
         if sha is None:
             return None
         commit = self.get(sha)
         if not isinstance(commit, GitCommit):
             return None  # unknown/garbage version
-        return self._read_tree(commit.tree_sha)
+        return self._read_tree(commit.tree_sha, lazy)
 
-    def _read_tree(self, tree_sha: str) -> SummaryTree:
+    blob_fetches = 0  # lazy-blob resolutions (per-store instance counter)
+
+    def _fetch_blob(self, sha: str):
+        self.blob_fetches += 1
+        blob = self.get(sha)
+        try:
+            return blob.content.decode()
+        except UnicodeDecodeError:
+            return blob.content
+
+    def _read_tree(self, tree_sha: str, lazy: bool = False) -> SummaryTree:
+        from ..protocol.summary import LazySummaryBlob
         tree = self.get(tree_sha)
         out = SummaryTree()
         for name, (kind, sha) in tree.entries.items():
             if kind == "blob":
-                blob = self.get(sha)
-                try:
-                    out.entries[name] = SummaryBlob(blob.content.decode())
-                except UnicodeDecodeError:
-                    out.entries[name] = SummaryBlob(blob.content)
+                if lazy:
+                    out.entries[name] = LazySummaryBlob(
+                        lambda s=sha: self._fetch_blob(s))
+                else:
+                    blob = self.get(sha)
+                    try:
+                        out.entries[name] = SummaryBlob(
+                            blob.content.decode())
+                    except UnicodeDecodeError:
+                        out.entries[name] = SummaryBlob(blob.content)
             else:
-                out.entries[name] = self._read_tree(sha)
+                out.entries[name] = self._read_tree(sha, lazy)
         return out
 
     def list_commits(self, ref: str = "main", limit: int = 50) -> List[GitCommit]:
@@ -224,12 +244,16 @@ class Historian:
                 self._cache[sha] = obj
         return obj
 
+    blob_fetches = 0  # lazy-blob resolutions through this historian
+
     def read_summary(self, tenant_id: str, document_id: str,
                      commit_sha: Optional[str] = None,
-                     ref: str = "main") -> Optional[SummaryTree]:
+                     ref: str = "main",
+                     lazy: bool = False) -> Optional[SummaryTree]:
         """The drivers' summary download path: identical semantics to
         GitStore.read_summary but every object fetch rides the cache, so a
-        summary shared by N loading clients hits storage once."""
+        summary shared by N loading clients hits storage once. lazy=True
+        defers blob content to first access (LazySummaryBlob)."""
         store = self.store(tenant_id, document_id)
         sha = commit_sha or store.get_ref(ref)
         if sha is None:
@@ -237,20 +261,38 @@ class Historian:
         commit = self.get_cached(sha, tenant_id, document_id)
         if not isinstance(commit, GitCommit):
             return None
-        return self._read_tree_cached(commit.tree_sha, tenant_id, document_id)
+        return self._read_tree_cached(commit.tree_sha, tenant_id,
+                                      document_id, lazy)
+
+    def _fetch_blob_cached(self, sha: str, tenant_id: str,
+                           document_id: str):
+        self.blob_fetches += 1
+        blob = self.get_cached(sha, tenant_id, document_id)
+        try:
+            return blob.content.decode()
+        except UnicodeDecodeError:
+            return blob.content
 
     def _read_tree_cached(self, tree_sha: str, tenant_id: str,
-                          document_id: str) -> SummaryTree:
+                          document_id: str,
+                          lazy: bool = False) -> SummaryTree:
+        from ..protocol.summary import LazySummaryBlob
         tree = self.get_cached(tree_sha, tenant_id, document_id)
         out = SummaryTree()
         for name, (kind, sha) in tree.entries.items():
             if kind == "blob":
-                blob = self.get_cached(sha, tenant_id, document_id)
-                try:
-                    out.entries[name] = SummaryBlob(blob.content.decode())
-                except UnicodeDecodeError:
-                    out.entries[name] = SummaryBlob(blob.content)
+                if lazy:
+                    out.entries[name] = LazySummaryBlob(
+                        lambda s=sha: self._fetch_blob_cached(
+                            s, tenant_id, document_id))
+                else:
+                    blob = self.get_cached(sha, tenant_id, document_id)
+                    try:
+                        out.entries[name] = SummaryBlob(
+                            blob.content.decode())
+                    except UnicodeDecodeError:
+                        out.entries[name] = SummaryBlob(blob.content)
             else:
                 out.entries[name] = self._read_tree_cached(
-                    sha, tenant_id, document_id)
+                    sha, tenant_id, document_id, lazy)
         return out
