@@ -285,9 +285,29 @@ class SharedSegmentSequence(SharedObject):
                                    min_seq=min_seq)
 
     @staticmethod
-    def _op_len_delta(contents) -> Optional[int]:
+    def _op_contains_remove(contents) -> bool:
+        if not isinstance(contents, dict):
+            return True  # unknown shape: treat as removing (conservative)
+        t = contents.get("type")
+        if t == 1:
+            return True
+        if t == 3:
+            return any(SharedSegmentSequence._op_contains_remove(sub)
+                       for sub in contents.get("ops", []))
+        return t not in (0, 2)
+
+    def _op_len_delta(self, contents, ref_seq=None,
+                      ordinal=None) -> Optional[int]:
         """Visible-length delta of a wire op, computable WITHOUT the body
-        (None = shape unknown: materialize instead of deferring)."""
+        (None = shape unknown: materialize instead of deferring).
+
+        Removes defer only when provably whole: if the remover saw the
+        snapshot and every other client's deferred remove
+        (ref_seq >= their seqs), its visible range [pos1, pos2) is
+        entirely live text — concurrent unseen inserts land inside the
+        range but survive a merge-tree remove — so the length shrinks by
+        exactly pos2-pos1. Otherwise the range may overlap an
+        already-removed span (which only the body knows), so materialize."""
         if not isinstance(contents, dict):
             return None
         t = contents.get("type")
@@ -300,17 +320,30 @@ class SharedSegmentSequence(SharedObject):
             if isinstance(seg.get("items"), list):
                 return len(seg["items"])
             return None
-        if t == 1:
-            # Removes NEVER defer: a concurrent remove overlapping an
-            # already-removed span shrinks by less than pos2-pos1 (the
-            # oracle skips removed segments), which only the body knows.
-            return None
+        if t == 1:  # remove
+            if ref_seq is None or self._lazy is None:
+                return None
+            if ref_seq < int(self._lazy[1].get("seq", 0)):
+                return None  # may overlap removes baked into the snapshot
+            # Deferrals append in ascending seq order: walk the unseen
+            # suffix only (seq > ref_seq) so absorbing a long catch-up
+            # tail stays O(tail x window), not O(tail^2).
+            for _c, s2, _r, o2, _m in reversed(self._deferred_remote):
+                if s2 <= ref_seq:
+                    break
+                if o2 != ordinal and self._op_contains_remove(_c):
+                    return None  # unseen concurrent remove: overlap unknown
+            p1, p2 = contents.get("pos1"), contents.get("pos2")
+            if not isinstance(p1, int) or not isinstance(p2, int) or \
+                    p2 < p1 or isinstance(p1, bool) or isinstance(p2, bool):
+                return None
+            return p1 - p2
         if t == 2:  # annotate
             return 0
         if t == 3:  # group
             total = 0
             for sub in contents.get("ops", []):
-                d = SharedSegmentSequence._op_len_delta(sub)
+                d = self._op_len_delta(sub, ref_seq, ordinal)
                 if d is None:
                     return None
                 total += d
@@ -376,7 +409,7 @@ class SharedSegmentSequence(SharedObject):
             # computable from the wire shape (reference: incoming ops are
             # deferred until the needed body chunk arrives,
             # sequence.ts:664); anything else materializes first.
-            delta = self._op_len_delta(contents)
+            delta = self._op_len_delta(contents, ref_seq, client_ordinal)
             if delta is not None:
                 self._deferred_remote.append(
                     (contents, seq, ref_seq, client_ordinal, min_seq))
@@ -409,6 +442,40 @@ class SharedSegmentSequence(SharedObject):
 
         if self._interval_collections or self._pending_interval_ops:
             raise Unmodelable("interval collections require per-op apply")
+        if self._lazy is not None:
+            # Lazy body pending: absorb the run as deferrals so the doc
+            # STAYS lazy through catch-up (touching self.client below
+            # would materialize just to probe preconditions; a fresh
+            # snapshot load has no local refs or pendings, so those
+            # probes are vacuous while lazy). All-or-nothing: on any
+            # non-deferrable op the tentative deferrals roll back so the
+            # fallback path — scalar (Unmodelable) or kernel-over-the-
+            # full-run — never applies an op twice.
+            mark = len(self._deferred_remote)
+            len0, ok, has_interval = self._lazy_len, True, False
+            for contents, seq, ref_seq, ordinal, min_seq in batch:
+                if isinstance(contents, dict) and \
+                        contents.get("type") == "intervalCollection":
+                    ok, has_interval = False, True
+                    break
+                d = self._op_len_delta(contents, ref_seq, ordinal)
+                if d is None:
+                    ok = False
+                    break
+                self._deferred_remote.append(
+                    (contents, seq, ref_seq, ordinal, min_seq))
+                self._lazy_len += d
+            if ok:
+                self.change_epoch += 1
+                self.bulk_catchup_count += 1  # whole run absorbed lazily
+                return
+            del self._deferred_remote[mark:]
+            self._lazy_len = len0
+            if has_interval:
+                raise Unmodelable("interval op in bulk run")
+            # Tail needs the body: self.client below materializes
+            # (replaying only previously deferred ops), then the kernel
+            # pass takes the whole run.
         if any(seg.local_refs for seg in self.client.tree.segments):
             raise Unmodelable("local references require per-op sliding")
         tail = []

@@ -71,6 +71,8 @@ class Container(TypedEventEmitter):
         import threading as _threading
         self._nack_gate = _threading.Lock()
         self._nack_recovery_live = False
+        self._nack_rearm = False  # throttle nack landed mid-recovery
+        self._nack_rearm_after = None
 
     @property
     def op_lock(self):
@@ -240,7 +242,14 @@ class Container(TypedEventEmitter):
         if code == NACK_THROTTLED:
             with self._nack_gate:
                 if self._nack_recovery_live:
-                    return  # one recovery in flight absorbs the storm
+                    # One recovery in flight absorbs the storm — but the
+                    # resubmission itself may be what got nacked, so
+                    # re-arm: the recovery loop runs another round after
+                    # its reconnect instead of losing the wakeup.
+                    self._nack_rearm = True
+                    self._nack_rearm_after = getattr(
+                        content, "retry_after_s", None)
+                    return
                 self._nack_recovery_live = True
             import threading as _threading
             _threading.Thread(
@@ -251,15 +260,32 @@ class Container(TypedEventEmitter):
         self.reconnect()
 
     def _throttle_recover(self, retry_after) -> None:
-        try:
-            if retry_after:
-                import time as _time
-                _time.sleep(min(float(retry_after), 5.0))
-            if not self.closed:
-                self.reconnect()
-        finally:
+        import time as _time
+        while True:
+            try:
+                if retry_after:
+                    _time.sleep(min(float(retry_after), 5.0))
+                if not self.closed:
+                    self.reconnect()
+            except BaseException:
+                # The recovery thread is dying: release the gate so a
+                # future nack can start a fresh recovery (a stuck True
+                # would silence throttle recovery forever).
+                with self._nack_gate:
+                    self._nack_recovery_live = False
+                    self._nack_rearm = False
+                    self._nack_rearm_after = None
+                raise
             with self._nack_gate:
-                self._nack_recovery_live = False
+                rearmed = self._nack_rearm and not self.closed
+                # Server gave no retryAfter: floor the re-arm backoff at
+                # 1s — a 429 path must never tight-loop the server.
+                retry_after = self._nack_rearm_after or 1.0
+                self._nack_rearm = False
+                self._nack_rearm_after = None
+                if not rearmed:
+                    self._nack_recovery_live = False
+                    return
 
     def reconnect(self) -> None:
         self._on_disconnect()
@@ -317,57 +343,76 @@ class Container(TypedEventEmitter):
         self.emit("signal", signal, local)
 
     def _process_bulk(self, tail) -> None:
-        """Catch-up tail processing with the device fast path: maximal runs
-        of remote OPERATION messages addressed to one bulk-capable channel
-        apply through the merge-tree kernel in one pass (mergetree/
-        catchup.py); everything else takes the normal per-message path.
-        Per-op events coalesce into one "bulkCatchUp" delta per run, the
-        reference's deferred-ops load behavior (sequence.ts:664)."""
+        """Catch-up tail processing with the device fast path.
+
+        Ops on DIFFERENT channels commute (channel isolation), so the tail
+        partitions into per-channel buffers that accumulate across
+        interleavings — a document whose history alternates between two
+        channels still reaches the bulk threshold on each (a contiguity
+        requirement never would: real docs interleave every channel).
+        Protocol bookkeeping stays strictly in tail order (buffered ops
+        process protocol-side at buffer time). Any scalar-processed
+        message except a heartbeat is a runtime-visible boundary
+        (self-join ordinal adoption, client_left hooks, own-op acks on a
+        buffered channel): all buffers flush before it so runtime-level
+        ordering is preserved. Per-op events coalesce into one
+        "bulkCatchUp" delta per channel, the reference's deferred-ops
+        load behavior (sequence.ts:664)."""
         from ..core.errors import BulkApplyUnsupported
 
-        i = 0
-        while i < len(tail):
-            run_key = self._bulk_key(tail[i])
-            j = i
-            n_ops = 0
-            while run_key is not None and j < len(tail):
-                if self._bulk_key(tail[j]) == run_key:
-                    n_ops += 1
-                    j += 1
-                    continue
-                if tail[j].type == MessageType.NO_OP:
-                    # Heartbeats are channel-neutral: they ride the run
-                    # (processed protocol-side below) instead of cutting
-                    # it — noops every ~25 ops would otherwise cap every
-                    # run under the bulk threshold.
-                    j += 1
-                    continue
-                break
-            if run_key is not None and \
-                    n_ops >= self.delta_manager.bulk_catchup_threshold:
-                run = tail[i:j]
-                channel_msgs = [m for m in run
-                                if m.type != MessageType.NO_OP]
-                try:
-                    self.runtime.process_channel_bulk(channel_msgs)
-                    for msg in run:
-                        self.protocol.process_message(msg)
-                    # The bulk path bypasses runtime.process, so advance
-                    # its seq bookkeeping explicitly — a summarize right
-                    # after catch-up stamps these into .metadata.
-                    self.runtime.sequence_number = run[-1].sequence_number
-                    self.runtime.minimum_sequence_number = \
-                        run[-1].minimum_sequence_number
-                except (BulkApplyUnsupported, ValueError):
-                    # Channel state untouched: process the WHOLE detected
-                    # run scalar (re-attempting bulk on its suffix would
-                    # fail identically, O(N^2) for a long run).
-                    for msg in run:
-                        self._process(msg)
-                i = j
+        buffers: dict = {}  # key -> [msgs]; insertion order = first seen
+        hi_seq = [0, 0]  # highest (seq, msn) applied via a bulk buffer
+
+        def flush() -> None:
+            threshold = self.delta_manager.bulk_catchup_threshold
+            # Messages the walk already applied scalar (joins, noops) may
+            # sit PAST the buffered seqs: never let the restore below
+            # regress what runtime.process already advanced to.
+            hi_seq[0] = max(hi_seq[0], self.runtime.sequence_number)
+            hi_seq[1] = max(hi_seq[1],
+                            self.runtime.minimum_sequence_number)
+            scalar_msgs = []
+            for msgs in buffers.values():
+                done = False
+                if len(msgs) >= threshold:
+                    try:
+                        self.runtime.process_channel_bulk(msgs)
+                        done = True
+                    except (BulkApplyUnsupported, ValueError):
+                        done = False  # state untouched: scalar fallback
+                if not done:
+                    scalar_msgs.extend(msgs)
+                hi_seq[0] = max(hi_seq[0], msgs[-1].sequence_number)
+                hi_seq[1] = max(hi_seq[1],
+                                msgs[-1].minimum_sequence_number)
+            buffers.clear()
+            # Fallback buffers replay in GLOBAL sequence order (channel
+            # isolation makes any order state-safe, but "op" listeners —
+            # last_edited, summarizer — expect monotonic seqs). Protocol
+            # side already ran at buffer time: runtime half only.
+            scalar_msgs.sort(key=lambda m: m.sequence_number)
+            for m in scalar_msgs:
+                self.runtime.process(m)
+                self.emit("op", m)
+            # Bulk bypasses runtime.process (and scalar replay may end on
+            # an earlier-seq buffer): pin the post-flush bookkeeping to
+            # the true high-water mark — a summarize right after catch-up
+            # stamps these into .metadata.
+            if hi_seq[0] > self.runtime.sequence_number:
+                self.runtime.sequence_number = hi_seq[0]
+            if hi_seq[1] > self.runtime.minimum_sequence_number:
+                self.runtime.minimum_sequence_number = hi_seq[1]
+
+        for msg in tail:
+            key = self._bulk_key(msg)
+            if key is not None:
+                self.protocol.process_message(msg)
+                buffers.setdefault(key, []).append(msg)
                 continue
-            self._process(tail[i])
-            i += 1
+            if msg.type != MessageType.NO_OP and buffers:
+                flush()
+            self._process(msg)
+        flush()
 
     def _bulk_key(self, message) -> tuple | None:
         """(store, channel) when the message can join a device bulk run."""
@@ -375,6 +420,8 @@ class Container(TypedEventEmitter):
             return None
         if message.client_id == self.delta_manager.client_id:
             return None  # local acks need pending-state pairing
+        if self.runtime.pending.has_prior(message.client_id):
+            return None  # ours under a previous connection id: same
         contents = message.contents
         if not isinstance(contents, dict) or "attachStore" in contents:
             return None

@@ -61,6 +61,44 @@ class TestLazySnapshotLoad:
         # Materialize: deferred ops replay in order.
         assert t2.get_text() == t.get_text()
 
+    def test_lazy_survives_bulk_catchup(self):
+        """A ≥64-op contiguous catch-up tail routes through
+        process_bulk_core and is absorbed as deferrals — the doc STAYS
+        lazy (round-3 regression: the bulk preconditions touched
+        self.client and materialized the body just to probe)."""
+        server = LocalServer()
+        loader, c, t = make_big_doc(server, chunks=10)
+        for i in range(70):  # > bulk_catchup_threshold (64)
+            t.insert_text(0, f"e{i}-")
+        c2 = loader.resolve("big")
+        t2 = c2.runtime.get_datastore("default").get_channel("text")
+        assert t2.bulk_catchup_count >= 1, "tail did not take the bulk path"
+        assert t2._lazy is not None, "bulk catch-up materialized the body"
+        assert t2.get_length() == t.get_length()
+        assert t2.get_text() == t.get_text()  # materialize: replay in order
+        assert t2._lazy is None
+
+    def test_deferred_remove_overlapping_unseen_remove_materializes(self):
+        """Safety valve: a remove whose client had NOT seen a prior
+        deferred remove from another client may overlap already-removed
+        text, so it must materialize, not defer."""
+        from fluidframework_tpu.protocol.messages import MessageType
+        server = LocalServer()
+        loader, c, t = make_big_doc(server, chunks=5)
+        c2 = loader.resolve("big")
+        t2 = c2.runtime.get_datastore("default").get_channel("text")
+        assert t2._lazy is not None
+        seen_seq = c2.delta_manager.last_sequence_number
+        t.remove_text(0, 10)  # defers on c2 (t saw everything)
+        assert t2._lazy is not None
+        # Hand-deliver a remove stamped ref_seq BEFORE t's remove: the
+        # wire shape alone cannot bound its overlap, so c2 materializes.
+        ds2 = c2.runtime.get_datastore("default")
+        t2.process_core({"type": 1, "pos1": 0, "pos2": 5}, False,
+                        c2.delta_manager.last_sequence_number + 1,
+                        seen_seq, 99, None)
+        assert t2._lazy is None
+
     def test_local_edit_materializes_first(self):
         server = LocalServer()
         loader, c, t = make_big_doc(server, chunks=5)
