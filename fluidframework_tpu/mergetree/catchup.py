@@ -54,14 +54,20 @@ class Unmodelable(BulkApplyUnsupported):
 
 
 def wire_to_host_ops(builder: OpBuilder, op: dict, seq: int, ref_seq: int,
-                     client: int, msn: int) -> List[HostOp]:
-    """One sequenced wire op (client.py shape) -> kernel HostOps."""
+                     client: int, msn: int,
+                     allow_items: bool = False) -> List[HostOp]:
+    """One sequenced wire op (client.py shape) -> kernel HostOps.
+
+    allow_items: client bulk catch-up models item payloads (the device
+    tracks only lengths/offsets; Items slices like str). The SERVER lane
+    path keeps them Unmodelable — its summarize/extract pipeline emits
+    text chunks, so an items lane degrades to opaque there."""
     t = op.get("type")
     if t == OP_GROUP:
         out: List[HostOp] = []
         for sub in op.get("ops", []):
             out.extend(wire_to_host_ops(builder, sub, seq, ref_seq, client,
-                                        msn))
+                                        msn, allow_items=allow_items))
         return out
     if t == OP_INSERT:
         seg = op.get("seg") or {}
@@ -72,7 +78,14 @@ def wire_to_host_ops(builder: OpBuilder, op: dict, seq: int, ref_seq: int,
             return [builder.insert_text(op["pos1"], seg["text"], ref_seq,
                                         client, seq, props=seg.get("props"),
                                         msn=msn)]
-        raise Unmodelable("insert payload is not text/marker")
+        if allow_items and isinstance(seg.get("items"), list):
+            # Item sequences ride the kernel too (reference
+            # sharedSequence.ts SubSequence<T>).
+            from .oracle import Items
+            return [builder.insert_text(op["pos1"], Items(seg["items"]),
+                                        ref_seq, client, seq,
+                                        props=seg.get("props"), msn=msn)]
+        raise Unmodelable("insert payload is not text/marker/items")
     if t == OP_REMOVE:
         return [builder.remove(op["pos1"], op["pos2"], ref_seq, client, seq,
                                msn=msn)]
@@ -97,8 +110,8 @@ def looks_like_merge_op(op: Any) -> bool:
 # ---------------------------------------------------------------------------
 
 def seed_device_state(entries: Sequence[dict], payloads: PayloadTable,
-                      capacity: int, min_seq: int,
-                      current_seq: int) -> DocState:
+                      capacity: int, min_seq: int, current_seq: int,
+                      anno_slots: int = None) -> DocState:
     """Snapshot-format segments (oracle.snapshot_segments) -> a single-doc
     DocState whose visibility math reproduces the snapshot perspective."""
     n = len(entries)
@@ -106,6 +119,7 @@ def seed_device_state(entries: Sequence[dict], payloads: PayloadTable,
         raise ValueError(f"{n} segments exceed capacity {capacity}")
     cols = {name: np.zeros(n, np.int32)
             for name in ("length", "ins_seq", "ins_client", "rem_seq",
+                         "local_seq", "rem_local_seq",
                          "origin_op", "origin_off")}
     rem_client = np.full(n, -1, np.int32)
     cols["rem_seq"][:] = DEV_NO_REMOVE
@@ -116,14 +130,22 @@ def seed_device_state(entries: Sequence[dict], payloads: PayloadTable,
             length = 1
             op_id = payloads.add_insert(SEG_MARKER, "", e.get("props"))
         else:
-            if not isinstance(text, str):
-                raise Unmodelable("items payloads stay on the scalar path")
+            # Any sliceable payload works (str text, Items runs): the
+            # device tracks only lengths/offsets; content stays host-side.
             length = len(text)
             op_id = payloads.add_insert(SEG_TEXT, text, e.get("props"))
         cols["length"][i] = length
-        cols["ins_seq"][i] = e.get("seq", UNIVERSAL_SEQ)
+        if e.get("localSeq") is not None:  # pending local insert
+            cols["ins_seq"][i] = DEV_UNASSIGNED
+            cols["local_seq"][i] = e["localSeq"]
+        else:
+            cols["ins_seq"][i] = e.get("seq", UNIVERSAL_SEQ)
         cols["ins_client"][i] = e.get("client", -1)
-        if e.get("removedSeq") is not None:
+        if e.get("removedLocalSeq") is not None:  # pending local remove
+            cols["rem_seq"][i] = DEV_UNASSIGNED
+            cols["rem_local_seq"][i] = e["removedLocalSeq"]
+            rem_client[i] = e.get("removedClient", -1)
+        elif e.get("removedSeq") is not None:
             cols["rem_seq"][i] = e["removedSeq"]
             rem_client[i] = e.get("removedClient", -1)
         cols["origin_op"][i] = op_id
@@ -131,7 +153,10 @@ def seed_device_state(entries: Sequence[dict], payloads: PayloadTable,
     cols["rem_client"] = rem_client
     from .state import state_from_numpy
     import jax.numpy as jnp
-    state = state_from_numpy(cols, capacity)
+    if anno_slots is None:
+        from .state import DEFAULT_ANNO_SLOTS
+        anno_slots = DEFAULT_ANNO_SLOTS
+    state = state_from_numpy(cols, capacity, anno_slots=anno_slots)
     return state._replace(min_seq=jnp.asarray(min_seq, jnp.int32),
                           seq=jnp.asarray(current_seq, jnp.int32))
 
@@ -142,16 +167,16 @@ def extract_entries(state: DocState, payloads: PayloadTable,
     insert/remove metadata above min_seq), adoptable by
     MergeTreeOracle.load_segments. Mirrors oracle.snapshot_segments."""
     cols = {name: np.asarray(getattr(state, name))
-            for name in ("length", "ins_seq", "ins_client", "rem_seq",
+            for name in ("length", "ins_seq", "ins_client", "local_seq",
+                         "rem_seq", "rem_local_seq",
                          "rem_clients", "origin_op", "origin_off", "anno")}
     count = int(np.asarray(state.count))
     out: List[dict] = []
     for i in range(count):
         rem_seq = int(cols["rem_seq"][i])
-        if rem_seq != DEV_NO_REMOVE and rem_seq <= min_seq:
+        if rem_seq != DEV_NO_REMOVE and rem_seq != DEV_UNASSIGNED \
+                and rem_seq <= min_seq:
             continue  # zamboni-equivalent: tombstone past the window
-        if int(cols["ins_seq"][i]) == DEV_UNASSIGNED:
-            raise Unmodelable("pending segments cannot appear in catch-up")
         payload = payloads.get(int(cols["origin_op"][i]))
         entry: Dict[str, Any] = {"kind": payload.kind}
         if payload.kind == SEG_MARKER:
@@ -163,10 +188,16 @@ def extract_entries(state: DocState, payloads: PayloadTable,
         if props:
             entry["props"] = props
         ins_seq = int(cols["ins_seq"][i])
-        if ins_seq > min_seq:
+        if ins_seq == DEV_UNASSIGNED:  # pending local insert
+            entry["localSeq"] = int(cols["local_seq"][i])
+            entry["client"] = int(cols["ins_client"][i])
+        elif ins_seq > min_seq:
             entry["seq"] = ins_seq
             entry["client"] = int(cols["ins_client"][i])
-        if rem_seq != DEV_NO_REMOVE:
+        if rem_seq == DEV_UNASSIGNED:  # pending local remove
+            entry["removedLocalSeq"] = int(cols["rem_local_seq"][i])
+            entry["removedClient"] = int(cols["rem_clients"][i][0])
+        elif rem_seq != DEV_NO_REMOVE:
             entry["removedSeq"] = rem_seq
             entry["removedClient"] = int(cols["rem_clients"][i][0])
         out.append(entry)
@@ -202,6 +233,37 @@ def _resolve_props(payload, anno_row, payloads: PayloadTable
 # the bulk apply
 # ---------------------------------------------------------------------------
 
+def _entry_foldable(e: dict) -> bool:
+    return (e.get("kind", SEG_TEXT) == SEG_TEXT
+            and "seq" not in e and "localSeq" not in e
+            and "removedSeq" not in e and "removedLocalSeq" not in e)
+
+
+def coalesce_entries(entries: Sequence[dict]) -> List[dict]:
+    """Merge adjacent fully-acked, unremoved, same-props text entries —
+    the host half of zamboni's pack step (reference mergeTree.ts:1289
+    scour/pack; oracle.zamboni coalesces identically). The device compact
+    cannot do this (payload contents live host-side as origin slices), so
+    without it a keystroke-granularity tail fragments the row space one
+    char per op and outgrows every capacity bucket."""
+    from .oracle import Items
+
+    out: List[dict] = []
+    for e in entries:
+        if out and _entry_foldable(e) and _entry_foldable(out[-1]) \
+                and out[-1].get("props") == e.get("props"):
+            pt = out[-1].get("text", "")
+            et = e.get("text", "")
+            if isinstance(pt, str) and isinstance(et, str):
+                out[-1]["text"] = pt + et
+                continue
+            if isinstance(pt, Items) and isinstance(et, Items):
+                out[-1]["text"] = Items(pt.values + et.values)
+                continue
+        out.append(dict(e))
+    return out
+
+
 def device_apply_tail(entries: Sequence[dict],
                       tail: Sequence[Tuple[dict, int, int, int, int]],
                       min_seq: int, current_seq: int) -> List[dict]:
@@ -217,7 +279,7 @@ def device_apply_tail(entries: Sequence[dict],
         if client < 0:
             raise Unmodelable("op without a client ordinal")
         host_ops.extend(wire_to_host_ops(builder, op, seq, ref_seq, client,
-                                         msn))
+                                         msn, allow_items=True))
 
     def capacity_for(rows: int, chunk: int) -> int:
         need = rows + 2 * chunk + 8
@@ -227,38 +289,65 @@ def device_apply_tail(entries: Sequence[dict],
         raise Unmodelable(f"{rows} live segments exceed the largest "
                           f"catch-up capacity {CAPACITY_BUCKETS[-1]}")
 
+    from .state import DEFAULT_ANNO_SLOTS
+
     cur_entries = list(entries)
     state = None
     pos = 0
+    anno_slots = DEFAULT_ANNO_SLOTS
+    rows_ub = len(cur_entries)  # host-tracked row bound: no per-chunk sync
     while pos < len(host_ops) or state is None:
         chunk = host_ops[pos:pos + CHUNK_T]
         if state is None:
             cap = capacity_for(len(cur_entries), len(chunk) or 1)
             state = seed_device_state(cur_entries, payloads, cap, min_seq,
-                                      current_seq)
+                                      current_seq, anno_slots=anno_slots)
         if not chunk:
             break
+        if rows_ub + 2 * len(chunk) + 8 > state.capacity:
+            # Row space is (by the host bound) close to full: fold on the
+            # host — extraction resolves annotate rings into props,
+            # coalesce_entries packs acked runs back together — and
+            # reseed at the bucket the folded row count actually needs.
+            compacted = kernel.compact(state)
+            mseq = int(np.asarray(compacted.min_seq))
+            cseq = int(np.asarray(compacted.seq))
+            cur = coalesce_entries(extract_entries(compacted, payloads,
+                                                   mseq))
+            cap = capacity_for(len(cur), len(chunk))
+            state = seed_device_state(cur, payloads, cap, mseq, cseq,
+                                      anno_slots=anno_slots)
+            rows_ub = len(cur)
         t = CHUNK_T if len(chunk) == CHUNK_T else _pow2(len(chunk))
         packed = pack_single(chunk, steps=t)
         new_state = kernel.apply_ops_keep(state, packed)
-        if bool(np.asarray(new_state.overflow)):
-            # Compact (window may have advanced) and retry this chunk; if
-            # the compacted row count still needs more room, escalate the
-            # capacity bucket and retry from the compacted state.
-            compacted = kernel.compact(state)
-            rows = int(np.asarray(compacted.count))
-            cap = capacity_for(rows, len(chunk))
-            if cap > compacted.capacity:
-                mseq = int(np.asarray(compacted.min_seq))
-                cseq = int(np.asarray(compacted.seq))
-                cur = extract_entries(compacted, payloads, mseq)
-                state = seed_device_state(cur, payloads, cap, mseq, cseq)
-            else:
-                state = compacted
-            new_state = kernel.apply_ops_keep(state, packed)
-            if bool(np.asarray(new_state.overflow)):
+        rows_ub += 2 * len(chunk)
+        tries = 0
+        while bool(np.asarray(new_state.overflow)):
+            # Overflow: either row capacity or a per-segment annotate ring
+            # filled. Fold-and-reseed resolves both — extraction folds the
+            # annotate rings into entry props (emptying every ring) and
+            # the capacity bucket escalates to the compacted row count.
+            # If THIS chunk alone can fill a ring (editor format sweeps
+            # hammering one span), the ring depth doubles per retry,
+            # bounded by the chunk length = the most annotates a chunk
+            # can push.
+            tries += 1
+            if tries > 4 or (tries > 1 and anno_slots >= t):
                 raise Unmodelable("catch-up chunk overflowed after "
                                   "escalation — invariant violation")
+            compacted = kernel.compact(state)
+            if tries > 1:
+                anno_slots = min(2 * anno_slots, t)
+            mseq = int(np.asarray(compacted.min_seq))
+            cseq = int(np.asarray(compacted.seq))
+            cur = coalesce_entries(extract_entries(compacted, payloads,
+                                                   mseq))
+            cap = capacity_for(len(cur), len(chunk))
+            state = seed_device_state(cur, payloads, cap, mseq, cseq,
+                                      anno_slots=anno_slots)
+            rows_ub = len(cur) + 2 * len(chunk)
+            new_state = kernel.apply_ops_keep(state, packed)
         state = kernel.compact(new_state)
         pos += len(chunk)
     final_min = int(np.asarray(state.min_seq))

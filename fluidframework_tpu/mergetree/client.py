@@ -191,23 +191,53 @@ class MergeTreeClient(TypedEventEmitter):
         tail: [(wire_op_dict, seq, ref_seq, client_ordinal, msn)], strictly
         ordered, all remote. Raises catchup.Unmodelable (caller falls back
         to per-op apply_msg) when the tail or current state contains content
-        the kernel cannot represent, or ValueError when this replica has
-        pending local state (bulk adoption would drop it)."""
+        the kernel cannot represent. Pending local inserts/removes ride
+        along (the kernel models DEV_UNASSIGNED segments; remote
+        perspectives never see them) — the pending groups are rebuilt from
+        the round-tripped localSeq tags. Pending ANNOTATES fall back: their
+        per-key pending_props counters have no device column."""
         from .catchup import Unmodelable, device_apply_tail
 
-        if self.tree.pending_groups:
-            raise ValueError("bulk catch-up with pending local ops")
+        pending = self.tree.pending_groups
+        if any(kind == "annotate" for kind, _, _ in pending):
+            raise Unmodelable("pending annotates require per-op apply")
         if not tail:
             return
-        entries = self.tree.snapshot_segments()
+        if any(cl == self.client_id for _, _, _, cl, _ in tail):
+            # An op of OURS sequenced into the tail is an ack, not a fresh
+            # remote op — it needs scalar pending-group pairing.
+            raise Unmodelable("own sequenced ops in tail need ack pairing")
+        entries = (self.tree.collab_segments() if pending
+                   else self.tree.snapshot_segments())
         new_entries = device_apply_tail(
             entries, tail, min_seq=self.tree.min_seq,
             current_seq=self.tree.current_seq)
         last_seq = tail[-1][1]
         last_msn = tail[-1][4]
-        self.tree = MergeTreeOracle.load_segments(
+        tree = MergeTreeOracle.load_segments(
             new_entries, local_client=self.client_id,
             min_seq=max(self.tree.min_seq, last_msn), current_seq=last_seq)
+        if pending:
+            tree.local_seq_counter = max(self.tree.local_seq_counter,
+                                         tree.local_seq_counter)
+            # Rebuild the pending groups from the round-tripped localSeq
+            # tags, preserving the ORIGINAL group order and extras — a
+            # still-in-flight ack pairs FIFO, so a group whose pending
+            # remove a remote remove overwrote mid-tail must keep its
+            # slot (as an empty group: ack and regenerate both no-op over
+            # it, matching the scalar path's "a remote remove won").
+            by_key: dict = {}
+            for seg in tree.segments:
+                if seg.ins_seq == UNASSIGNED_SEQ and seg.local_seq:
+                    by_key.setdefault(
+                        ("insert", seg.local_seq), []).append(seg)
+                if seg.rem_seq == UNASSIGNED_SEQ and seg.rem_local_seq:
+                    by_key.setdefault(
+                        ("remove", seg.rem_local_seq), []).append(seg)
+            tree.pending_groups = [
+                (kind, by_key.get((kind, extra["local_seq"]), []), extra)
+                for kind, group, extra in pending]
+        self.tree = tree
         self.emit("delta", {"op": "bulkCatchUp", "count": len(tail),
                             "seq": last_seq}, False)
 

@@ -673,6 +673,33 @@ class MergeTreeOracle:
             out.append(entry)
         return out
 
+    def collab_segments(self) -> List[dict]:
+        """snapshot_segments INCLUDING pending local state: pending inserts
+        carry "localSeq", pending removes "removedLocalSeq" — the
+        full-fidelity serialization bulk catch-up uses to round-trip a tree
+        with in-flight local ops (load_segments restores both)."""
+        self.zamboni()
+        out = []
+        for seg in self.segments:
+            entry: Dict[str, Any] = {"kind": seg.kind, "text": seg.text}
+            if seg.props:
+                entry["props"] = dict(seg.props)
+            if seg.ins_seq == UNASSIGNED_SEQ:
+                entry["localSeq"] = seg.local_seq
+                entry["client"] = seg.ins_client
+            elif seg.ins_seq > self.min_seq:
+                entry["seq"] = seg.ins_seq
+                entry["client"] = seg.ins_client
+            if seg.rem_seq is not None:
+                if seg.rem_seq == UNASSIGNED_SEQ:
+                    entry["removedLocalSeq"] = seg.rem_local_seq
+                    entry["removedClient"] = seg.rem_client
+                else:
+                    entry["removedSeq"] = seg.rem_seq
+                    entry["removedClient"] = seg.rem_client
+            out.append(entry)
+        return out
+
     @staticmethod
     def load_segments(entries: List[dict], local_client: int = -1,
                       min_seq: int = 0, current_seq: int = 0
@@ -680,18 +707,30 @@ class MergeTreeOracle:
         tree = MergeTreeOracle(local_client=local_client)
         tree.min_seq = min_seq
         tree.current_seq = current_seq
+        max_local = 0
         for e in entries:
+            pending_ins = e.get("localSeq") is not None
+            pending_rem = e.get("removedLocalSeq") is not None
             seg = Segment(
                 kind=e.get("kind", SEG_TEXT),
                 text=e.get("text", ""),
-                ins_seq=e.get("seq", UNIVERSAL_SEQ),
+                ins_seq=(UNASSIGNED_SEQ if pending_ins
+                         else e.get("seq", UNIVERSAL_SEQ)),
                 ins_client=e.get("client", -1),
-                rem_seq=e.get("removedSeq"),
+                rem_seq=(UNASSIGNED_SEQ if pending_rem
+                         else e.get("removedSeq")),
                 rem_client=e.get("removedClient"),
                 props=dict(e["props"]) if e.get("props") else None,
                 uid=tree._next_uid(),
             )
+            if pending_ins:
+                seg.local_seq = e["localSeq"]
+                max_local = max(max_local, seg.local_seq)
+            if pending_rem:
+                seg.rem_local_seq = e["removedLocalSeq"]
+                max_local = max(max_local, seg.rem_local_seq)
             tree.segments.append(seg)
             if seg.rem_seq is None:
                 tree._local_len += seg.length
+        tree.local_seq_counter = max(tree.local_seq_counter, max_local)
         return tree

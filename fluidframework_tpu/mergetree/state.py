@@ -124,7 +124,9 @@ def state_from_numpy(columns: dict, capacity: int,
         length=put("length", base.length),
         ins_seq=put("ins_seq", base.ins_seq),
         ins_client=put("ins_client", base.ins_client),
+        local_seq=put("local_seq", base.local_seq),
         rem_seq=put("rem_seq", base.rem_seq),
+        rem_local_seq=put("rem_local_seq", base.rem_local_seq),
         origin_op=put("origin_op", base.origin_op),
         origin_off=put("origin_off", base.origin_off),
         rem_clients=jnp.asarray(rem_clients),

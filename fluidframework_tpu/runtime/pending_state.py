@@ -36,6 +36,12 @@ class PendingStateManager:
     def count(self) -> int:
         return len(self._pending) + sum(len(v) for v in self._prior.values())
 
+    def has_prior(self, client_id) -> bool:
+        """True when ops of OURS may still arrive under this (previous
+        connection's) client id — such messages need try_prior_ack
+        pairing, so they must never ride a remote bulk run."""
+        return client_id in self._prior
+
     def on_submit(self, client_sequence_number: int, contents: Any) -> None:
         self._pending.append(PendingOp(client_sequence_number, contents))
 

@@ -101,20 +101,112 @@ class TestEngine:
 
         assert flat(bulk) == flat(scalar)
 
-    def test_pending_local_state_refuses_bulk(self):
+    def test_pending_local_state_rides_bulk(self):
+        """A replica with pending (unacked) local inserts AND removes
+        bulk-applies a remote tail; text, regenerated resubmission ops,
+        and subsequent ack handling all match the scalar path."""
+        _, tail = sequenced_schedule(300, seed=7)
+        head, rest = tail[:40], tail[40:]
+        bulk = MergeTreeClient(client_id=99)
+        scalar = MergeTreeClient(client_id=99)
+        for c in (bulk, scalar):
+            for op, s, r, cl, m in head:
+                c.apply_msg(op, s, r, cl, min_seq=m)
+            c.insert_text_local(2, "PEND")
+            c.remove_range_local(0, 2)
+        bulk.apply_bulk(rest)
+        for op, s, r, cl, m in rest:
+            scalar.apply_msg(op, s, r, cl, min_seq=m)
+        assert bulk.get_text() == scalar.get_text()
+        assert bulk.regenerate_pending_ops() == \
+            scalar.regenerate_pending_ops()
+        assert bulk.get_text() == scalar.get_text()
+
+    def test_remote_won_remove_keeps_group_slot(self):
+        """A remote remove that overwrites our pending remove mid-tail:
+        the pending group must keep its FIFO slot (empty) so a later ack
+        of our own sequenced remove pairs with the right group."""
+        seed_op = make_insert_op(0, text_seg("abcdefghij"))
+        bulk = MergeTreeClient(client_id=9)
+        scalar = MergeTreeClient(client_id=9)
+        for c in (bulk, scalar):
+            c.apply_msg(seed_op, 1, 0, 1, min_seq=0)
+            c.remove_range_local(2, 5)  # group 1 (pending remove)
+            c.insert_text_local(0, "Z")  # group 2 (pending insert)
+        # Remote tail: client 2 (saw only seq 1) removes [1, 7) — covers
+        # our pending remove's range — plus filler inserts.
+        tail = [(make_remove_op(1, 7), 2, 1, 2, 0)]
+        tail += [(make_insert_op(0, text_seg(f"[{i}]")), 3 + i, 2 + i, 2, 0)
+                 for i in range(20)]
+        bulk.apply_bulk(tail)
+        for op, s, r, cl, m in tail:
+            scalar.apply_msg(op, s, r, cl, min_seq=m)
+        assert bulk.get_text() == scalar.get_text()
+        last = tail[-1][1]
+        # Server sequences OUR ops: remove first (group 1), insert next.
+        for c in (bulk, scalar):
+            c.apply_msg(make_remove_op(2, 5), last + 1, 1, 9, min_seq=0)
+            c.apply_msg(make_insert_op(0, text_seg("Z")), last + 2, 1, 9,
+                        min_seq=0)
+        assert bulk.get_text() == scalar.get_text()
+        assert not bulk.tree.pending_groups
+        assert not scalar.tree.pending_groups
+
+    def test_own_sequenced_ops_refuse_bulk(self):
+        from fluidframework_tpu.mergetree.catchup import Unmodelable
         client = MergeTreeClient(client_id=1)
         client.insert_text_local(0, "pending")
-        _, tail = sequenced_schedule(10)
-        with pytest.raises(ValueError):
-            client.apply_bulk(tail)
-
-    def test_items_payloads_fall_back(self):
-        from fluidframework_tpu.mergetree.catchup import Unmodelable
-        from fluidframework_tpu.mergetree.client import items_seg
-        client = MergeTreeClient(client_id=1)
-        tail = [(make_insert_op(0, items_seg([1, 2, 3])), 1, 0, 0, 0)]
+        tail = [(make_insert_op(0, text_seg("x")), 1, 0, 1, 0)]
         with pytest.raises(Unmodelable):
             client.apply_bulk(tail)
+
+    def test_pending_annotates_fall_back(self):
+        from fluidframework_tpu.mergetree.catchup import Unmodelable
+        client = MergeTreeClient(client_id=1)
+        client.apply_msg(make_insert_op(0, text_seg("hello")), 1, 0, 0,
+                         min_seq=0)
+        client.annotate_range_local(0, 3, {"bold": True})
+        _, tail = sequenced_schedule(10)
+        with pytest.raises(Unmodelable):
+            client.apply_bulk(tail)
+
+    def test_items_payloads_ride_bulk(self):
+        """Item-sequence tails take the kernel path: values round-trip
+        through the device as sliceable Items runs."""
+        from fluidframework_tpu.mergetree.client import items_seg
+        rng = random.Random(3)
+        bulk = MergeTreeClient(client_id=99)
+        scalar = MergeTreeClient(client_id=99)
+        tail = []
+        count = 0
+        for i in range(200):
+            seq = i + 1
+            if count > 4 and rng.random() < 0.3:
+                a = rng.randrange(count - 2)
+                b = a + 1 + rng.randrange(2)
+                op = make_remove_op(a, b)
+                count -= b - a
+            else:
+                vals = [i * 10 + j for j in range(rng.randrange(1, 4))]
+                op = make_insert_op(rng.randrange(count + 1),
+                                    items_seg(vals))
+                count += len(vals)
+            tail.append((op, seq, seq - 1, 1 + i % 2, max(0, seq - 8)))
+        bulk.apply_bulk(tail)
+        for op, s, r, cl, m in tail:
+            scalar.apply_msg(op, s, r, cl, min_seq=m)
+
+        def flat_items(client):
+            out = []
+            for e in client.tree.snapshot_segments():
+                if e.get("removedSeq") is not None:
+                    continue
+                t = e.get("text")
+                out.extend(t.values if hasattr(t, "values") else t)
+            return out
+
+        assert flat_items(bulk) == flat_items(scalar)
+        assert bulk.get_length() == scalar.get_length()
 
 
 class TestLoaderE2E:
@@ -137,6 +229,30 @@ class TestLoaderE2E:
             else:
                 text.insert_text(rng.randrange(n + 1) if n else 0, f"[{i}]")
         return loader, text
+
+    def test_interleaved_channels_both_take_kernel_path(self):
+        """A doc whose history ALTERNATES between two bulk-capable
+        channels must bulk-catch-up on both: ops on different channels
+        commute, so the tail partitions per channel instead of requiring
+        contiguous same-channel runs (which interleaving never yields)."""
+        from fluidframework_tpu.dds.sequence import SharedNumberSequence
+        server = LocalServer()
+        loader = Loader(LocalDocumentServiceFactory(server))
+        c1 = loader.create_detached("doc")
+        ds1 = c1.runtime.create_datastore("default")
+        c1.attach()
+        text = ds1.create_channel("text", SharedString.TYPE)
+        nums = ds1.create_channel("nums", SharedNumberSequence.TYPE)
+        for i in range(90):  # 180 ops, perfectly interleaved
+            text.insert_text(0, f"[{i}]")
+            nums.insert_range(0, [i, i + 1])
+        late = loader.resolve("doc")
+        lt = late.runtime.get_datastore("default").get_channel("text")
+        ln = late.runtime.get_datastore("default").get_channel("nums")
+        assert lt.get_text() == text.get_text()
+        assert ln.get_items() == nums.get_items()
+        assert lt.bulk_catchup_count >= 1, "text fell back scalar"
+        assert ln.bulk_catchup_count >= 1, "items fell back scalar"
 
     def test_late_loader_catches_up_via_device(self):
         server = LocalServer()
