@@ -203,6 +203,146 @@ def _serving_ingest_rate(docs: int = 4096, ops_per_doc: int = 32) -> float:
     return round(total / elapsed, 1)
 
 
+def _singledoc_trace_rate(n_ops: int = 100_000) -> dict:
+    """BASELINE config #2: one SharedString, a keystroke-level 100k-op
+    editing trace (bursts at a moving cursor, backspaces, word deletes,
+    pastes, format sweeps — testing/traces.py), replayed through the
+    device bulk catch-up path (MergeTreeClient.apply_bulk, chunked kernel
+    applies) vs the single-threaded scalar oracle on a sample."""
+    import jax as _jax
+
+    from fluidframework_tpu.mergetree.client import MergeTreeClient
+    from fluidframework_tpu.testing.traces import keystroke_trace
+
+    if _jax.default_backend() not in ("tpu", "axon"):
+        n_ops = min(n_ops, 20_000)
+    n_ops = int(os.environ.get("BENCH_TRACE_OPS", n_ops))
+    tail = keystroke_trace(n_ops, seed=12)
+
+    # Scalar baseline on a leading sample (the per-op path cost is
+    # position-dependent but near-linear in ops at fixed doc size).
+    sample = min(4000, n_ops)
+    scalar = MergeTreeClient(client_id=99)
+    t0 = time.perf_counter()
+    for op, s, r, c, m in tail[:sample]:
+        scalar.apply_msg(op, s, r, c, min_seq=m)
+    scalar_rate = sample / (time.perf_counter() - t0)
+
+    bulk = MergeTreeClient(client_id=99)
+    t0 = time.perf_counter()
+    bulk.apply_bulk(tail)
+    elapsed = time.perf_counter() - t0
+    # Correctness rides along: the device replay must match the scalar
+    # sample prefix's content at the same seq... full-trace equality is
+    # checked in tests; here guard length sanity only (cheap).
+    if bulk.get_length() <= 0:
+        raise RuntimeError("single-doc trace replay produced empty doc")
+    return {
+        "singledoc_trace_ops_per_sec": round(n_ops / elapsed, 1),
+        "singledoc_trace_ops": n_ops,
+        "singledoc_trace_scalar_ops_per_sec": round(scalar_rate, 1),
+        "singledoc_trace_final_len": bulk.get_length(),
+    }
+
+
+def _matrix_storm_rate(rows: int = 1000, cols: int = 1000,
+                       n_ops: int = 50_000) -> dict:
+    """BASELINE config #3: 1k×1k SharedMatrix row/col insert + cell-set
+    storm (testing/traces.py matrix_storm) through a live two-client
+    local service; reports applied ops/s on the editing client including
+    sequencing + echo + remote apply on the observer."""
+    import jax as _jax
+
+    from fluidframework_tpu.dds.matrix import SharedMatrix
+    from fluidframework_tpu.loader.container import Loader
+    from fluidframework_tpu.loader.drivers.local import (
+        LocalDocumentServiceFactory)
+    from fluidframework_tpu.server.local_server import LocalServer
+    from fluidframework_tpu.testing.traces import matrix_storm
+
+    if _jax.default_backend() not in ("tpu", "axon"):
+        n_ops = min(n_ops, 8_000)
+    n_ops = int(os.environ.get("BENCH_MATRIX_OPS", n_ops))
+    server = LocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.create_detached("bench-matrix")
+    ds = c1.runtime.create_datastore("default")
+    m1 = ds.create_channel("grid", SharedMatrix.TYPE)
+    m1.insert_rows(0, rows)
+    m1.insert_cols(0, cols)
+    c1.attach()
+    c2 = loader.resolve("bench-matrix")
+    m2 = c2.runtime.get_datastore("default").get_channel("grid")
+    script = matrix_storm(rows, cols, n_ops, seed=4)
+    t0 = time.perf_counter()
+    for cmd in script:
+        if cmd[0] == "set":
+            m1.set_cell(cmd[1], cmd[2], cmd[3])
+        else:
+            getattr(m1, cmd[0])(cmd[1], cmd[2])
+    elapsed = time.perf_counter() - t0
+    if (m2.row_count, m2.col_count) != (m1.row_count, m1.col_count):
+        raise RuntimeError("matrix storm diverged between clients")
+    return {
+        "matrix_storm_ops_per_sec": round(n_ops / elapsed, 1),
+        "matrix_storm_ops": n_ops,
+        "matrix_storm_shape": [m1.row_count, m1.col_count],
+    }
+
+
+def _directory_merge_rate(n_ops: int = 40_000) -> dict:
+    """BASELINE config #4: nested-subtree merges — 4 concurrent editors
+    writing into a depth-3 directory tree through a live local service
+    (testing/traces.py directory_merge_script); reports sequenced ops/s
+    with all replicas converging."""
+    import jax as _jax
+
+    from fluidframework_tpu.dds.directory import SharedDirectory
+    from fluidframework_tpu.loader.container import Loader
+    from fluidframework_tpu.loader.drivers.local import (
+        LocalDocumentServiceFactory)
+    from fluidframework_tpu.server.local_server import LocalServer
+    from fluidframework_tpu.testing.traces import directory_merge_script
+
+    if _jax.default_backend() not in ("tpu", "axon"):
+        n_ops = min(n_ops, 8_000)
+    n_ops = int(os.environ.get("BENCH_DIR_OPS", n_ops))
+    server = LocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.create_detached("bench-dir")
+    ds = c1.runtime.create_datastore("default")
+    ds.create_channel("tree", SharedDirectory.TYPE)
+    c1.attach()
+    clients = [c1] + [loader.resolve("bench-dir") for _ in range(3)]
+    dirs = [c.runtime.get_datastore("default").get_channel("tree")
+            for c in clients]
+    script = directory_merge_script(n_ops, n_clients=len(clients), seed=9)
+    t0 = time.perf_counter()
+    for entry in script:
+        c, path, cmd = entry[0], entry[1], entry[2]
+        d = dirs[c]
+        node = d
+        for name in path:
+            node = node.create_sub_directory(name)
+        if cmd == "set":
+            node.set(entry[3], entry[4])
+        elif cmd == "delete":
+            node.delete(entry[3])
+        elif cmd == "set_subdir_key":
+            node.create_sub_directory(entry[3]).set(entry[4], entry[5])
+        else:
+            node.clear()
+    elapsed = time.perf_counter() - t0
+    views = [d.root.to_dict() for d in dirs]
+    if any(v != views[0] for v in views[1:]):
+        raise RuntimeError("directory merge diverged between replicas")
+    return {
+        "directory_merge_ops_per_sec": round(n_ops / elapsed, 1),
+        "directory_merge_ops": n_ops,
+        "directory_merge_clients": len(clients),
+    }
+
+
 def _init_backend_or_fallback():
     """Initialize the jax backend, falling back to CPU on failure OR hang.
 
@@ -225,23 +365,30 @@ def _init_backend_or_fallback():
         jax.config.update("jax_platforms", platform)
         return None
 
-    # One attempt only: a hung tunnel will not recover on a quick retry,
-    # and a second 90s stall would risk tripping the harness's own timeout
-    # (the failure mode this probe exists to avoid).
-    timeout_s = int(os.environ.get("BENCH_INIT_TIMEOUT", "90"))
+    # Bounded retry: a transient tunnel blip recovers on the second try,
+    # while a hard-down tunnel costs at most attempts*timeout+backoff =
+    # 45+5+45 = 95s before the CPU fallback — at the ~90s budget the
+    # single-attempt probe used, still under the harness's own timeout.
+    timeout_s = int(os.environ.get("BENCH_INIT_TIMEOUT", "45"))
+    attempts = max(1, int(os.environ.get("BENCH_INIT_RETRIES", "2")))
     probe = "import jax; jax.devices(); print(jax.default_backend())"
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c", probe],
-            timeout=timeout_s, capture_output=True, text=True)
-        if r.returncode == 0:
-            return None  # accelerator healthy; init it in-process
-        tail = (r.stderr or r.stdout).strip().splitlines()[-1:]
-        last_err = tail[0] if tail else f"rc={r.returncode}"
-    except subprocess.TimeoutExpired:
-        last_err = f"backend init hung >{timeout_s}s"
+    last_err = "unknown"
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(5 * attempt)  # linear backoff between probes
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", probe],
+                timeout=timeout_s, capture_output=True, text=True)
+            if r.returncode == 0:
+                return None  # accelerator healthy; init it in-process
+            tail = (r.stderr or r.stdout).strip().splitlines()[-1:]
+            last_err = tail[0] if tail else f"rc={r.returncode}"
+        except subprocess.TimeoutExpired:
+            last_err = f"backend init hung >{timeout_s}s"
     jax.config.update("jax_platforms", "cpu")
-    return f"accelerator backend unavailable ({last_err}); ran on CPU"
+    return (f"accelerator backend unavailable after {attempts} probes "
+            f"({last_err}); ran on CPU")
 
 
 def main() -> None:
@@ -250,6 +397,10 @@ def main() -> None:
     capacity = int(os.environ.get("BENCH_CAPACITY", "256"))
 
     import jax
+
+    from fluidframework_tpu.core.platform import enable_compile_cache
+
+    enable_compile_cache()  # repeated runs skip recompilation
 
     backend_error = _init_backend_or_fallback()
     if backend_error and "BENCH_DOCS" not in os.environ:
@@ -403,6 +554,14 @@ def main() -> None:
     # TpuSequencerLambda (parse -> native pack -> device ticket+apply) —
     # the whole partition-lambda path, not just the device half.
     ingest_rate = _serving_ingest_rate()
+
+    # Real-workload configs (BASELINE.md #2-4): keystroke-level single-doc
+    # trace, matrix op storm, concurrent directory merges.
+    workload_extras = {}
+    if os.environ.get("BENCH_CONFIGS", "1") != "0":
+        workload_extras.update(_singledoc_trace_rate())
+        workload_extras.update(_matrix_storm_rate())
+        workload_extras.update(_directory_merge_rate())
     result = {
         "metric": "merge-tree ops applied/sec across "
                   f"{n_docs} docs (ticket+apply+summary-len)",
@@ -430,6 +589,7 @@ def main() -> None:
             "ragged_overflow": ragged_overflow,
             "serving_ingest_ops_per_sec": ingest_rate,
             "overflow": overflow,
+            **workload_extras,
         },
     }
     prior_error = os.environ.get("BENCH_ERROR") or backend_error

@@ -1,0 +1,174 @@
+"""TableDocument: SharedMatrix cells composed with SEQUENCE-backed axes
+(reference examples/data-objects/table-document/src/document.ts:34 —
+SparseMatrix + SharedNumberSequence rows/cols + interval cell ranges).
+
+The composition is the point: row/col structure changes touch BOTH the
+matrix (permutation runs) and the axis sequences (merge-tree items) in one
+logical edit, axis annotations ride merge-tree annotate sweeps, and named
+cell ranges anchor to interval collections on the row axis so they slide
+with concurrent structural churn — three DDS engines converging together
+(chaos-farm coverage in tests/test_table_document.py)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple
+
+from fluidframework_tpu.dds.matrix import SharedMatrix
+from fluidframework_tpu.dds.sequence import SharedNumberSequence
+from fluidframework_tpu.framework.container_factories import (
+    ContainerRuntimeFactoryWithDefaultDataStore)
+from fluidframework_tpu.framework.data_object import (DataObject,
+                                                      DataObjectFactory)
+from fluidframework_tpu.loader.code_loader import CodeLoader
+from fluidframework_tpu.loader.container import Loader
+
+TABLE_DOCUMENT_TYPE = "@fluid-example/table-document"
+
+
+class TableDocument(DataObject):
+    """Cells + row axis + col axis, edited as one table."""
+
+    def initializing_first_time(self):
+        matrix = self.store.create_channel("matrix", SharedMatrix.TYPE)
+        self.store.create_channel("rows", SharedNumberSequence.TYPE)
+        self.store.create_channel("cols", SharedNumberSequence.TYPE)
+        del matrix
+
+    # -- channels ----------------------------------------------------------
+    @property
+    def matrix(self) -> SharedMatrix:
+        return self.store.get_channel("matrix")
+
+    @property
+    def rows(self) -> SharedNumberSequence:
+        return self.store.get_channel("rows")
+
+    @property
+    def cols(self) -> SharedNumberSequence:
+        return self.store.get_channel("cols")
+
+    @property
+    def num_rows(self) -> int:
+        return self.rows.get_item_count()
+
+    @property
+    def num_cols(self) -> int:
+        return self.cols.get_item_count()
+
+    # -- structure: matrix AND axis move together (document.ts:120-139) ---
+    def insert_rows(self, at: int, count: int) -> None:
+        self.matrix.insert_rows(at, count)
+        self.rows.insert_range(at, [0] * count)
+
+    def remove_rows(self, at: int, count: int) -> None:
+        self.matrix.remove_rows(at, count)
+        self.rows.remove_range(at, at + count)
+
+    def insert_cols(self, at: int, count: int) -> None:
+        self.matrix.insert_cols(at, count)
+        self.cols.insert_range(at, [0] * count)
+
+    def remove_cols(self, at: int, count: int) -> None:
+        self.matrix.remove_cols(at, count)
+        self.cols.remove_range(at, at + count)
+
+    # -- cells -------------------------------------------------------------
+    def set_cell(self, row: int, col: int, value: Any) -> None:
+        self.matrix.set_cell(row, col, value)
+
+    def get_cell(self, row: int, col: int) -> Any:
+        return self.matrix.get_cell(row, col)
+
+    def extract(self) -> List[List[Any]]:
+        return self.matrix.extract()
+
+    # -- axis annotations (document.ts:87-101) -----------------------------
+    def annotate_rows(self, start: int, end: int, props: dict) -> None:
+        self.rows.annotate_range(start, end, props)
+
+    def annotate_cols(self, start: int, end: int, props: dict) -> None:
+        self.cols.annotate_range(start, end, props)
+
+    @staticmethod
+    def _axis_props(seq: SharedNumberSequence, index: int) -> dict:
+        from fluidframework_tpu.mergetree.oracle import Items
+        tree = seq.client.tree
+        acc = 0
+        for seg in tree.segments:
+            vlen = tree.visible_length(seg, tree.current_seq,
+                                       seq.client.client_id)
+            if vlen <= 0:
+                continue
+            if acc <= index < acc + vlen and isinstance(seg.text, Items):
+                return dict(seg.props) if seg.props else {}
+            acc += vlen
+        return {}
+
+    def get_row_properties(self, row: int) -> dict:
+        return self._axis_props(self.rows, row)
+
+    def get_col_properties(self, col: int) -> dict:
+        return self._axis_props(self.cols, col)
+
+    # -- named row ranges: intervals on the row axis slide with churn
+    #    (document.ts:111-117 createInterval over the matrix position
+    #    space; here anchored on the row sequence) -------------------------
+    def create_range(self, label: str, start_row: int, end_row: int) -> None:
+        self.rows.get_interval_collection("ranges").add(
+            start_row, end_row, {"label": label})
+
+    def resolve_range(self, label: str) -> Optional[Tuple[int, int]]:
+        coll = self.rows.get_interval_collection("ranges")
+        for iv in coll:
+            if (iv.properties or {}).get("label") == label:
+                return coll.endpoints(iv)
+        return None
+
+
+TableDocumentFactory = DataObjectFactory(TABLE_DOCUMENT_TYPE, TableDocument)
+
+CODE_DETAILS = {"package": "@examples/table-document", "version": "^1.0.0"}
+
+
+def make_loader(service_factory) -> Loader:
+    code_loader = CodeLoader()
+    code_loader.register(
+        "@examples/table-document", "1.0.0",
+        ContainerRuntimeFactoryWithDefaultDataStore(TableDocumentFactory))
+    return Loader(service_factory, code_loader=code_loader,
+                  code_details=CODE_DETAILS)
+
+
+def demo() -> dict:
+    """Two clients edit one table concurrently through a local service."""
+    from fluidframework_tpu.loader.drivers.local import (
+        LocalDocumentServiceFactory)
+    from fluidframework_tpu.server.local_server import LocalServer
+
+    server = LocalServer()
+    loader = make_loader(LocalDocumentServiceFactory(server))
+    c1 = loader.create_detached("table")
+    t1 = c1.request("/")
+    t1.insert_rows(0, 3)
+    t1.insert_cols(0, 3)
+    t1.set_cell(0, 0, "Q1")
+    t1.set_cell(1, 1, 42)
+    c1.attach()
+
+    c2 = make_loader(LocalDocumentServiceFactory(server)).resolve("table")
+    t2 = c2.request("/")
+    t2.insert_rows(1, 1)  # concurrent structural edit
+    t1.annotate_rows(0, 1, {"header": True})
+    t1.create_range("totals", 1, 3)
+    t2.set_cell(3, 2, "sum")
+
+    assert t1.extract() == t2.extract()
+    assert t1.num_rows == t2.num_rows == 4
+    return {"rows": t1.num_rows, "cols": t1.num_cols,
+            "grid": t1.extract(),
+            "row0": t1.get_row_properties(0),
+            "totals": t1.resolve_range("totals")}
+
+
+if __name__ == "__main__":
+    print(demo())

@@ -58,3 +58,21 @@ def force_host_platform(n_devices: int = 8) -> None:
                 "before any jax.devices()/jit use")
         return
     jax.config.update("jax_platforms", "cpu")
+
+
+def enable_compile_cache(path: str = None) -> None:
+    """Persistent XLA compilation cache shared by the test suite and
+    bench.py: the same kernel shapes (scan×vmap per (capacity, T),
+    catch-up buckets) recompile every process otherwise."""
+    import os
+
+    try:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            path or os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                   "/tmp/fluid_tpu_xla_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:  # pragma: no cover - cache is best-effort
+        pass

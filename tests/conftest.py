@@ -17,3 +17,10 @@ try:
     force_host_platform(8)
 except ImportError:  # pragma: no cover - jax-less env: pure-Python tests only
     pass
+
+try:
+    from fluidframework_tpu.core.platform import enable_compile_cache
+
+    enable_compile_cache()
+except ImportError:  # pragma: no cover
+    pass

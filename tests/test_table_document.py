@@ -1,0 +1,131 @@
+"""TableDocument composite (examples/table_document.py): SharedMatrix
+cells + sequence-backed axes + interval ranges converging TOGETHER under
+chaos-farm churn — the cross-DDS composition proof (reference
+examples/data-objects/table-document/src/document.ts:34; farm strategy
+from client.conflictFarm.spec.ts:20-57)."""
+
+import random
+
+import pytest
+
+from examples.table_document import TableDocument, demo
+from fluidframework_tpu.testing import MockSequencedEnvironment
+
+N_CLIENTS = 3
+
+
+def make_tables(env):
+    out = []
+    for i in range(N_CLIENTS):
+        r = env.create_runtime()
+        ds = r.create_datastore("ds")
+        t = TableDocument(ds)
+        # Mock env replicas each create the same-id channels locally
+        # (tests/test_dds_farms.py make_replicas pattern).
+        t.initialize(existing=False)
+        out.append((r, t))
+        env.process_all()
+    return out
+
+
+def churn(env, rng, tables, p_disconnect=0.1):
+    env.process_some(rng, limit=rng.randrange(0, 14))
+    if rng.random() < p_disconnect:
+        runtime, _ = rng.choice(tables)
+        state = env._state_of(runtime)
+        if state.connected:
+            env.disconnect(runtime)
+        else:
+            env.reconnect(runtime)
+
+
+def settle(env, rng, tables):
+    for runtime, _ in tables:
+        if not env._state_of(runtime).connected:
+            env.reconnect(runtime)
+    env.process_all(rng)
+    while env.process_all(rng):
+        pass
+
+
+class TestTableDocumentFarm:
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3])
+    def test_structure_cells_and_axes_converge(self, seed):
+        """Concurrent row/col structure changes, cell writes, and axis
+        annotations across 3 clients with partial delivery + reconnect
+        churn: matrix grids, axis lengths, AND axis props all converge —
+        and the matrix dimensions always match the axis sequences
+        (the two engines moved together)."""
+        rng = random.Random(seed + 13)
+        env = MockSequencedEnvironment()
+        tables = make_tables(env)
+        t0 = tables[0][1]
+        t0.insert_rows(0, 3)
+        t0.insert_cols(0, 3)
+        env.process_all()
+        for step in range(70):
+            _, t = rng.choice(tables)
+            if t.num_rows != t.matrix.row_count or \
+                    t.num_cols != t.matrix.col_count:
+                # Another client's composite edit is half-delivered (the
+                # matrix and axis halves are separate messages): a
+                # consistent reader waits — acting on the skewed view
+                # would aim structure ops past one engine's bounds, the
+                # same contract the reference sample's consumers observe.
+                churn(env, rng, tables)
+                continue
+            rows, cols = t.num_rows, t.num_cols
+            r = rng.random()
+            if r < 0.12 and rows < 10:
+                t.insert_rows(rng.randrange(rows + 1), rng.randrange(1, 3))
+            elif r < 0.2 and cols < 10:
+                t.insert_cols(rng.randrange(cols + 1), 1)
+            elif r < 0.28 and rows > 2:
+                t.remove_rows(rng.randrange(rows - 1), 1)
+            elif r < 0.34 and cols > 2:
+                t.remove_cols(rng.randrange(cols - 1), 1)
+            elif r < 0.45 and rows > 0:
+                a = rng.randrange(rows)
+                t.annotate_rows(a, min(rows, a + 2), {"band": step % 3})
+            elif rows and cols:
+                t.set_cell(rng.randrange(rows), rng.randrange(cols),
+                           (step, rng.randrange(5)))
+            churn(env, rng, tables)
+        settle(env, rng, tables)
+        grids = [t.extract() for _, t in tables]
+        assert grids[0] == grids[1] == grids[2]
+        for _, t in tables:
+            # Composition invariant: axes and matrix agree on shape.
+            assert t.num_rows == t.matrix.row_count
+            assert t.num_cols == t.matrix.col_count
+        props = [[t.get_row_properties(i) for i in range(t.num_rows)]
+                 for _, t in tables]
+        assert props[0] == props[1] == props[2]
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_ranges_slide_with_structural_churn(self, seed):
+        """A named range anchored on the row axis stays consistent across
+        replicas while rows insert/remove around (and inside) it."""
+        rng = random.Random(seed + 101)
+        env = MockSequencedEnvironment()
+        tables = make_tables(env)
+        t0 = tables[0][1]
+        t0.insert_rows(0, 6)
+        t0.insert_cols(0, 2)
+        t0.create_range("body", 2, 5)
+        env.process_all()
+        for step in range(40):
+            _, t = rng.choice(tables)
+            rows = t.num_rows
+            if rng.random() < 0.5 and rows < 14:
+                t.insert_rows(rng.randrange(rows + 1), 1)
+            elif rows > 4:
+                t.remove_rows(rng.randrange(rows - 1), 1)
+            churn(env, rng, tables, p_disconnect=0.2)
+        settle(env, rng, tables)
+        spans = {t.resolve_range("body") for _, t in tables}
+        assert len(spans) == 1, f"range divergence: {spans}"
+
+    def test_demo_runs(self):
+        out = demo()
+        assert out["rows"] == 4 and out["row0"] == {"header": True}

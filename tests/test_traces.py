@@ -1,0 +1,91 @@
+"""Realistic-workload traces (testing/traces.py): the keystroke-level
+editing trace replays identically through the device bulk path and the
+scalar oracle; the matrix/directory scripts stay valid against live DDSes.
+
+Reference analog: packages/test/snapshots/src/replayMultipleFiles.ts (op
+log replay w/ cross-version comparison) and service-load-test/src/
+nodeStressTest.ts:24-33 (stress profiles)."""
+
+from fluidframework_tpu.mergetree.client import MergeTreeClient
+from fluidframework_tpu.testing.traces import (
+    directory_merge_script,
+    keystroke_trace,
+    matrix_storm,
+)
+
+
+class TestKeystrokeTrace:
+    def test_bulk_replay_matches_scalar(self):
+        tail = keystroke_trace(800, seed=12)
+        bulk = MergeTreeClient(client_id=99)
+        bulk.apply_bulk(tail)
+        scalar = MergeTreeClient(client_id=99)
+        for op, s, r, c, m in tail:
+            scalar.apply_msg(op, s, r, c, min_seq=m)
+        assert bulk.get_text() == scalar.get_text()
+
+    def test_concurrent_editors_replay_matches_scalar(self):
+        tail = keystroke_trace(600, seed=3, n_clients=4)
+        bulk = MergeTreeClient(client_id=99)
+        bulk.apply_bulk(tail)
+        scalar = MergeTreeClient(client_id=99)
+        for op, s, r, c, m in tail:
+            scalar.apply_msg(op, s, r, c, min_seq=m)
+        assert bulk.get_text() == scalar.get_text()
+
+    def test_trace_is_deterministic_and_burstful(self):
+        a = keystroke_trace(2000, seed=5)
+        b = keystroke_trace(2000, seed=5)
+        assert a == b
+        # Keystroke bursts: most inserts are single-char.
+        inserts = [op for op, *_ in a if op["type"] == 0]
+        single = sum(1 for op in inserts
+                     if len(op["seg"].get("text", "")) == 1)
+        assert single / len(inserts) > 0.8
+        # Position locality: consecutive single-char inserts mostly
+        # continue at the prior position + 1 (cursor advance).
+        adjacent = 0
+        pairs = 0
+        prev = None
+        for op, *_ in a:
+            if op["type"] == 0 and len(op["seg"].get("text", "")) == 1:
+                if prev is not None:
+                    pairs += 1
+                    if op["pos1"] == prev + 1:
+                        adjacent += 1
+                prev = op["pos1"]
+            else:
+                prev = None
+        assert adjacent / pairs > 0.5
+
+    def test_annotates_present(self):
+        a = keystroke_trace(3000, seed=1)
+        assert any(op["type"] == 2 for op, *_ in a)
+
+
+class TestStormScripts:
+    def test_matrix_storm_commands_stay_valid(self):
+        r, c = 40, 40
+        for cmd in matrix_storm(40, 40, 3000, seed=2):
+            if cmd[0] == "insert_rows":
+                assert 0 <= cmd[1] <= r
+                r += cmd[2]
+            elif cmd[0] == "insert_cols":
+                assert 0 <= cmd[1] <= c
+                c += cmd[2]
+            elif cmd[0] == "remove_rows":
+                assert 0 <= cmd[1] + cmd[2] <= r
+                r -= cmd[2]
+            elif cmd[0] == "remove_cols":
+                assert 0 <= cmd[1] + cmd[2] <= c
+                c -= cmd[2]
+            else:
+                assert cmd[0] == "set"
+                assert 0 <= cmd[1] < r and 0 <= cmd[2] < c
+
+    def test_directory_script_shape(self):
+        script = directory_merge_script(2000, n_clients=3, seed=2)
+        assert len(script) == 2000
+        cmds = {e[2] for e in script}
+        assert {"set", "delete", "set_subdir_key", "clear"} <= cmds
+        assert {e[0] for e in script} == {0, 1, 2}
