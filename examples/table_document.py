@@ -29,10 +29,9 @@ class TableDocument(DataObject):
     """Cells + row axis + col axis, edited as one table."""
 
     def initializing_first_time(self):
-        matrix = self.store.create_channel("matrix", SharedMatrix.TYPE)
+        self.store.create_channel("matrix", SharedMatrix.TYPE)
         self.store.create_channel("rows", SharedNumberSequence.TYPE)
         self.store.create_channel("cols", SharedNumberSequence.TYPE)
-        del matrix
 
     # -- channels ----------------------------------------------------------
     @property

@@ -28,9 +28,9 @@ from . import lww_kernel as lk
 from . import ticket_kernel as tk
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(6,))
 def serve_window(tstate, ticket_cols, merge_states, merge_cols,
-                 lww_states, lww_cols):
+                 lww_states, lww_cols, fused=False):
     """The WHOLE fast window in one device program — over a tunneled
     device every extra dispatch pays a serialized RPC, so ticketing, every
     bucket's merge/LWW apply, and the result packing fuse into a single
@@ -41,8 +41,13 @@ def serve_window(tstate, ticket_cols, merge_states, merge_cols,
                  doc_idx + t_idx) — ONE H2D each.
     lww_cols:    per bucket [6, lanes, Tm] (kind, key, val, delta,
                  doc_idx, t_idx).
-    Returns (tstate', merge_states', lww_states', flat) with flat =
-    [seq B*T | msn B*T | flags B*T | next_seq B | overflow bits]."""
+    Returns (tstate', merge_states', lww_states', flat16, msn32) where
+    flat16 is the NARROW int16 result the host fetches every window:
+    [seq_delta B*T | msn_delta B*T | flags B*T | next_seq as (lo B, hi B)
+    | msn_base as (lo B, hi B) | msn_ok bit | overflow bits], decoded by
+    tpu_sequencer._finish_window; msn32 is the exact int32 msn plane,
+    fetched ONLY when the window's msn span overflows the delta (msn_ok
+    == 0; one global bit for the whole window)."""
     raw = tk.RawOps(client=ticket_cols[1], client_seq=ticket_cols[2],
                     ref_seq=ticket_cols[3], kind=ticket_cols[0])
     tstate, ticketed = tk._scan_tickets(tstate, raw, batched=True,
@@ -62,7 +67,17 @@ def serve_window(tstate, ticket_cols, merge_states, merge_cols,
             kind=jnp.where(ok, packed.kind, OpKind.NOOP),
             seq=jnp.where(ok, seq_g, 0),
             msn=jnp.where(ok, msn_g, 0))
-        new_merge.append(kernel._scan_ops(mstate, ops2, batched=True))
+        from ..mergetree.pallas_apply import (FUSED_MAX_CAPACITY,
+                                             apply_ops_fused_pallas)
+        if fused and mstate.capacity <= FUSED_MAX_CAPACITY:
+            # VMEM-resident fused apply: the bucket's lane block stays
+            # on-core across the whole op stream — the T-step HBM
+            # re-read/re-write of the scan kernel (the serving apply's
+            # dominant cost) collapses to one read + one write.
+            # Bit-identical to the scan kernel (tests/test_pallas_apply).
+            new_merge.append(apply_ops_fused_pallas(mstate, ops2))
+        else:
+            new_merge.append(kernel._scan_ops(mstate, ops2, batched=True))
 
     new_lww = []
     for lstate, lc in zip(lww_states, lww_cols):
@@ -78,7 +93,36 @@ def serve_window(tstate, ticket_cols, merge_states, merge_cols,
     bits = [tstate.overflow.any()[None].astype(jnp.int32)]
     bits += [s.overflow.any()[None].astype(jnp.int32) for s in new_merge]
     bits += [s.overflow.any()[None].astype(jnp.int32) for s in new_lww]
-    flat = jnp.concatenate(
-        [seq_bt.ravel(), msn_bt.ravel(), flags.ravel(),
-         tstate.next_seq.astype(jnp.int32)] + bits)
-    return tstate, new_merge, new_lww, flat
+
+    # NARROW result packing: the window result is the serving path's one
+    # D2H, and over a tunneled device transfer bytes are throughput
+    # (PERF.md: ~25 MB/s, per-array RPC floor => ONE int16 array).
+    #   seq  -> delta from the lane's post-window next_seq: bounded by
+    #           ops-per-lane <= T (structural); -1 = not admitted.
+    #   msn  -> delta from the lane's min admitted msn; a catch-up jump
+    #           can exceed int16 (rare) => one window-global ok bit, host
+    #           refetches the int32 plane only then.
+    #   int32 lane scalars ride as (lo, hi) int16 halves.
+    admitted = seq_bt > 0
+    next32 = tstate.next_seq.astype(jnp.int32)
+    seq_d = jnp.where(admitted, next32[:, None] - seq_bt, -1)
+    big = jnp.int32(1 << 30)
+    msn_base = jnp.min(jnp.where(admitted, msn_bt, big), axis=1)
+    msn_base = jnp.where(msn_base == big, 0, msn_base)
+    msn_d = jnp.where(admitted, msn_bt - msn_base[:, None], 0)
+    msn_ok = (jnp.max(msn_d) < 32000).astype(jnp.int32)
+    msn_d = jnp.minimum(msn_d, 32000)
+
+    def halves(x32):
+        # lo may land negative in int16 (bit 15): host re-masks & 0xFFFF.
+        return [(x32 & 0xFFFF).astype(jnp.int16),
+                (x32 >> 16).astype(jnp.int16)]
+
+    flat16 = jnp.concatenate(
+        [seq_d.ravel().astype(jnp.int16),
+         msn_d.ravel().astype(jnp.int16),
+         flags.ravel().astype(jnp.int16)]
+        + halves(next32) + halves(msn_base)
+        + [jnp.concatenate([msn_ok[None]] + bits).astype(jnp.int16)])
+    # Fetched ONLY when msn_ok == 0 (second RPC on the rare path).
+    return tstate, new_merge, new_lww, flat16, msn_bt

@@ -1150,6 +1150,12 @@ class TpuSequencerLambda(IPartitionLambda):
         # the tunnel transfer with the next backlog's native parse.
         self.pipelined = False
         self._inflight: Optional[dict] = None
+        # Fused VMEM-resident merge apply inside the fast window (lazy
+        # probe on first fast flush; scan kernel wherever Mosaic is
+        # unavailable or a bucket exceeds the fused VMEM budget). Mesh
+        # sharding keeps the scan path — the fused kernel is single-chip.
+        self._fused_serve: Optional[bool] = False if mesh is not None \
+            else None
         self._pump = None
         self._pump_ord: Dict[str, int] = {}     # doc id -> pump ordinal
         self._pump_synced: Dict[str, int] = {}  # doc id -> synced ordinals
@@ -1811,14 +1817,23 @@ class TpuSequencerLambda(IPartitionLambda):
         lww_jobs = self._build_lww(parsed, rows, lanes, slot,
                                    vbase, lchan_ok, lchan_b, lchan_l)
 
+        if self._fused_serve is None:
+            from ..mergetree.pallas_apply import fused_available
+            import jax as _jax
+            self._fused_serve = (_jax.default_backend() in ("tpu", "axon")
+                                 and fused_available())
         # ONE fused device program for the whole window (every extra
-        # dispatch is a serialized tunnel RPC), then ONE host sync.
-        self.tstate, new_merge, new_lww, flat_dev = serve_step.serve_window(
+        # dispatch is a serialized tunnel RPC), then ONE host sync of the
+        # narrow int16 result (msn32_dev is fetched only on the rare
+        # msn-span overflow).
+        (self.tstate, new_merge, new_lww, flat_dev,
+         msn32_dev) = serve_step.serve_window(
             self.tstate, self._place_cols(ticket_cols),
             [self.merge.buckets[j["bucket"]].state for j in merge_jobs],
             [self._place_cols(j["cols"]) for j in merge_jobs],
             [self.lww.buckets[j["bucket"]].state for j in lww_jobs],
-            [self._place_cols(j["cols"]) for j in lww_jobs])
+            [self._place_cols(j["cols"]) for j in lww_jobs],
+            self._fused_serve)
         for j, post in zip(merge_jobs, new_merge):
             j["post"] = post
             self.merge.buckets[j["bucket"]].state = post
@@ -1832,6 +1847,7 @@ class TpuSequencerLambda(IPartitionLambda):
                "merge_jobs": merge_jobs, "lww_jobs": lww_jobs,
                "mbase": mbase, "block": self._flush_merge_block,
                "row_seq": row_seq, "row_msn": row_msn,
+               "msn32_dev": msn32_dev,
                # The offsets THIS window covers: drain() must commit
                # exactly these — the live _pending_offset may already
                # include a newer, not-yet-dispatched backlog.
@@ -1864,11 +1880,31 @@ class TpuSequencerLambda(IPartitionLambda):
         merge_jobs, lww_jobs = ctx["merge_jobs"], ctx["lww_jobs"]
 
         bt = B * T
-        seq_bt = flat[:bt].reshape(B, T)
-        msn_bt = flat[bt:2 * bt].reshape(B, T)
+
+        def u32(lo, hi):
+            return ((hi.astype(np.int64) << 16)
+                    | (lo.astype(np.int64) & 0xFFFF)).astype(np.int64)
+
+        # Narrow layout (serve_step.serve_window): int16 deltas + int32
+        # lane scalars as (lo, hi) halves + [msn_ok | overflow bits].
+        seq_d = flat[:bt].reshape(B, T).astype(np.int64)
+        msn_d = flat[bt:2 * bt].reshape(B, T).astype(np.int64)
         fl_bt = flat[2 * bt:3 * bt].reshape(B, T)
-        next_seq = flat[3 * bt:3 * bt + B]
-        bits = flat[3 * bt + B:]
+        p = 3 * bt
+        next_seq = u32(flat[p:p + B], flat[p + B:p + 2 * B])
+        msn_base = u32(flat[p + 2 * B:p + 3 * B],
+                       flat[p + 3 * B:p + 4 * B])
+        tailbits = flat[p + 4 * B:]
+        msn_ok, bits = tailbits[0], tailbits[1:]
+        admitted = seq_d >= 0
+        seq_bt = np.where(admitted, next_seq[:, None] - seq_d, 0)
+        if msn_ok:
+            msn_bt = np.where(admitted, msn_base[:, None] + msn_d, 0)
+        else:
+            # A catch-up msn jump exceeded the int16 delta: fetch the
+            # exact int32 plane (rare second RPC).
+            msn_bt = np.asarray(ctx["msn32_dev"]).astype(np.int64)
+            msn_bt = np.where(admitted, msn_bt, 0)
         if bits[0]:
             raise RuntimeError("ticket client table overflow despite "
                                "pre-flush growth — invariant violation")

@@ -92,11 +92,33 @@ def build_sequence_document() -> Container:
     return _commit_detached(c)
 
 
+def build_trace_document() -> Container:
+    """A realistic editing session (keystroke bursts, backspaces, word
+    deletes, pastes, format sweeps — testing/traces.py) pinned end-state:
+    the corpus analog of the reference's recorded-log replay
+    (packages/test/snapshots/src/replayMultipleFiles.ts)."""
+    from .traces import keystroke_trace
+
+    c = _detached("pin-trace")
+    ds = c.runtime.create_datastore("default")
+    text = ds.create_channel("text", SharedString.TYPE)
+    for op, *_ in keystroke_trace(1500, seed=77):
+        if op["type"] == 0:
+            text.insert_text(op["pos1"], op["seg"]["text"],
+                             op["seg"].get("props"))
+        elif op["type"] == 1:
+            text.remove_text(op["pos1"], op["pos2"])
+        else:
+            text.annotate_range(op["pos1"], op["pos2"], op["props"])
+    return _commit_detached(c)
+
+
 BUILDERS: Dict[str, Callable[[], Container]] = {
     "text": build_text_document,
     "kv": build_kv_document,
     "matrix": build_matrix_document,
     "number-sequence": build_sequence_document,
+    "keystroke-trace": build_trace_document,
 }
 
 

@@ -160,9 +160,10 @@ def directory_merge_script(n_ops: int, n_clients: int = 4, depth: int = 3,
     Returns [(client, path_tuple, command, *args)]."""
     rng = random.Random(seed)
     paths = [()]
+    last = [()]
     for _ in range(depth):
-        paths = paths + [p + (f"d{i}",) for p in paths[-len(paths):]
-                         for i in range(fanout)]
+        last = [p + (f"d{i}",) for p in last for i in range(fanout)]
+        paths += last
     homes = [rng.choice(paths) for _ in range(n_clients)]
     out = []
     for i in range(n_ops):

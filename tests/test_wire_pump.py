@@ -526,3 +526,111 @@ class TestPumpRestart:
         B.flush()
         assert [e[1] for e in eb] == [3]  # continues the seq numbering
         assert eb[0][4] == "c0"  # correct client id via restored interner
+
+
+class TestFusedServeConformance:
+    def test_fused_window_matches_scan_window(self, monkeypatch):
+        """serve_window(fused=True) — the VMEM-resident merge apply on
+        the serving fast path — is bit-indistinguishable from the scan
+        kernel: same emits, same materialized channels. CPU runs the
+        Pallas body in interpret mode; on TPU the same test exercises
+        Mosaic via tools/tpu_conformance."""
+        import functools
+
+        import jax
+
+        from fluidframework_tpu.mergetree import pallas_apply
+
+        if jax.default_backend() not in ("tpu", "axon"):
+            # CPU: run the Pallas body in interpret mode. On TPU the
+            # patch is skipped so the REAL Mosaic kernel is what's
+            # conformance-checked.
+            monkeypatch.setattr(
+                pallas_apply, "apply_ops_fused_pallas",
+                functools.partial(pallas_apply.apply_ops_fused_pallas,
+                                  interpret=True))
+
+        def traffic():
+            out = []
+            for d in range(3):
+                doc = f"d{d}"
+                msgs = [_join(f"c{d}")]
+                for i in range(1, 9):
+                    if i % 4 == 0:
+                        op = {"type": OP_REMOVE, "pos1": 0, "pos2": 2}
+                    elif i % 5 == 0:
+                        op = {"type": OP_ANNOTATE, "pos1": 0, "pos2": 3,
+                              "props": {"b": i}}
+                    else:
+                        op = {"type": OP_INSERT, "pos1": 0,
+                              "seg": {"text": f"x{i}"}}
+                    msgs.append(_merge_op(i, op))
+                msgs.append(_lww_op(9, {"type": "set", "key": "k",
+                                        "value": d}))
+                out.append((doc, Boxcar("t", doc, f"c{d}", msgs)))
+            return out
+
+        ea, na, eb, nb = [], [], [], []
+        A = _lam(lambda d, m: ea.append(_emit_key(d, m)),
+                 lambda d, c, n: na.append((d, c, n.content.code)))
+        B = _lam(lambda d, m: eb.append(_emit_key(d, m)),
+                 lambda d, c, n: nb.append((d, c, n.content.code)))
+        A._fused_serve = False
+        B._fused_serve = True
+        for i, (doc, box) in enumerate(traffic()):
+            A.handler_raw(_qm(i, doc, box, raw=True))
+            B.handler_raw(_qm(i, doc, box, raw=True))
+        A.flush()
+        B.flush()
+        A.drain()
+        B.drain()
+        assert_equivalent(A, B, (ea, eb), (na, nb),
+                          [(f"d{d}", "s", "t") for d in range(3)])
+
+
+class TestNarrowResultPacking:
+    def test_msn_span_overflow_falls_back_to_exact_plane(self):
+        """A catch-up msn jump wider than the int16 delta within one
+        window flips msn_ok: the host must refetch the exact int32 msn
+        plane (serve_step narrow packing's rare second RPC) and still
+        stamp exact msns."""
+        import jax.numpy as jnp
+
+        from fluidframework_tpu.server import serve_step
+        from fluidframework_tpu.server import ticket_kernel as tk
+
+        B, T, K = 1, 2, 4
+        tstate = tk.make_ticket_state(K, batch=B)
+        # Surgery: one doc deep into its history (seq 50k) with two
+        # clients — a laggard at ref 3 and a caught-up one at 49,999.
+        tstate = tstate._replace(
+            client_ids=jnp.array([[7, 8, -1, -1]], jnp.int32),
+            client_ref=jnp.array([[3, 49_999, 2**31 - 1, 2**31 - 1]],
+                                 jnp.int32),
+            client_cseq=jnp.array([[5, 9, 0, 0]], jnp.int32),
+            next_seq=jnp.array([50_000], jnp.int32),
+            min_seq=jnp.array([3], jnp.int32),
+        )
+        cols = np.zeros((4, B, T), np.int32)
+        cols[0, 0] = tk.MsgKind.OP
+        # op 1 from the laggard (msn stays 3), then the laggard's ref
+        # leaps to 49,000: msn jumps by ~49k > int16 within ONE window.
+        cols[1, 0] = [7, 7]
+        cols[2, 0] = [6, 7]
+        cols[3, 0] = [4, 49_000]
+        out = serve_step.serve_window(tstate, jnp.asarray(cols),
+                                      [], [], [], [], False)
+        _, _, _, flat16, msn32 = out
+        flat = np.asarray(flat16)
+        bt = B * T
+        p = 3 * bt
+        tailbits = flat[p + 4 * B:]
+        assert tailbits[0] == 0, "msn_ok should flag the wide span"
+        exact = np.asarray(msn32)
+        assert exact[0, 0] == 4 and exact[0, 1] == 49_000
+        # And the narrow seq deltas still reconstruct exactly.
+        next_seq = ((flat[p + B:p + 2 * B].astype(np.int64) << 16)
+                    | (flat[p:p + B].astype(np.int64) & 0xFFFF))
+        seq_d = flat[:bt].reshape(B, T).astype(np.int64)
+        seq = np.where(seq_d >= 0, next_seq[:, None] - seq_d, 0)
+        assert seq[0].tolist() == [50_000, 50_001]
