@@ -280,6 +280,19 @@ def device_apply_tail(entries: Sequence[dict],
             raise Unmodelable("op without a client ordinal")
         host_ops.extend(wire_to_host_ops(builder, op, seq, ref_seq, client,
                                          msn, allow_items=True))
+    return apply_host_ops(entries, host_ops, payloads, min_seq,
+                          current_seq)
+
+
+def apply_host_ops(entries: Sequence[dict], host_ops: Sequence[HostOp],
+                   payloads: PayloadTable, min_seq: int,
+                   current_seq: int) -> List[dict]:
+    """The chunked kernel applier over already-built HostOps: seeds device
+    state from entries, applies in T-bucketed chunks with host
+    fold-between-chunks (coalesce + annotate-ring resolution) and
+    capacity/ring escalation on overflow. Shared by client bulk catch-up
+    (device_apply_tail) and the server lane stores' last-resort overflow
+    rescue."""
 
     def capacity_for(rows: int, chunk: int) -> int:
         need = rows + 2 * chunk + 8
@@ -291,6 +304,7 @@ def device_apply_tail(entries: Sequence[dict],
 
     from .state import DEFAULT_ANNO_SLOTS
 
+    host_ops = list(host_ops)
     cur_entries = list(entries)
     state = None
     pos = 0

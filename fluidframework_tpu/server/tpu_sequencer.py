@@ -355,6 +355,12 @@ class MergeLaneStore:
             bucket.put_rows([lanes[j] for j in ok_j],
                             tm(lambda x: x[sel], redone))
         carried = [bucket.used[lanes[j]] for j in bad_j]  # keys carrying up
+        # Pre-apply row index + this window's ops per carried key: the
+        # host-fold rescue (rare: only lanes that exhaust every capacity
+        # promotion) slices `compacted` lazily — no per-lane gathers on
+        # the batched path.
+        rescue_src = {bucket.used[lanes[j]]: (j, lane_ops[lanes[j]])
+                      for j in bad_j}
         keep = bad_j                 # their row indices into src/packed
         bucket.free_many([lanes[j] for j in bad_j])
         src = compacted
@@ -381,9 +387,58 @@ class MergeLaneStore:
             carried = [carried[k] for k in keep]
             src = wide
         for key in carried:
-            del self.where[key]
+            j, ops = rescue_src[key]
+            row = tm(lambda x: x[j] if getattr(x, "ndim", 0) else x,
+                     compacted)
+            if self._rescue_lane(key, row, ops):
+                continue
+            self.where.pop(key, None)
             self.opaque.add(key)
             self.overflow_drops += 1
+
+    def _rescue_lane(self, key: tuple, row: DocState, ops) -> bool:
+        """Last resort before opaque: fold the lane on the HOST — annotate
+        rings resolve into props, acked runs coalesce — re-apply this
+        window's ops with the chunked escalating applier, and reseed into
+        the smallest fitting bucket. Capacity promotion alone cannot fix
+        ring-ACCUMULATION overflow (ring depth is fixed per bucket); the
+        fold empties every ring, so only >anno_slots annotates on one
+        segment within a single window can still defeat it."""
+        from ..mergetree.catchup import (Unmodelable, apply_host_ops,
+                                         coalesce_entries, extract_entries,
+                                         seed_device_state)
+        try:
+            mseq = int(np.asarray(row.min_seq))
+            cseq = int(np.asarray(row.seq))
+            entries = coalesce_entries(
+                extract_entries(row, self.payloads, mseq))
+            new_entries = coalesce_entries(
+                apply_host_ops(entries, ops, self.payloads, mseq, cseq))
+        except (Unmodelable, ValueError):
+            return False
+        from ..mergetree.constants import DEV_UNASSIGNED, UNASSIGNED_SEQ
+        mseq2 = max([mseq] + [op.msn for op in ops])
+        cseq2 = max([cseq] + [op.seq for op in ops
+                              if op.seq not in (DEV_UNASSIGNED,
+                                                UNASSIGNED_SEQ)])
+        # seed()'s bucket policy: smallest with 2x headroom (a +8 fit
+        # would re-overflow on the very next busy window and thrash the
+        # whole recovery cascade per flush); the widest bucket accepts a
+        # plain fit as the final fallback.
+        n = len(new_entries)
+        last = len(self.buckets) - 1
+        for nb, bucket in enumerate(self.buckets):
+            if n * 2 > bucket.capacity and not (nb == last
+                                                and n + 8 <= bucket.capacity):
+                continue
+            row2 = seed_device_state(new_entries, self.payloads,
+                                     bucket.capacity, mseq2, cseq2)
+            lane = bucket.alloc(key)
+            bucket.put_row(lane, row2)
+            self.where[key] = (nb, lane)
+            self.mark_dirty(key)
+            return True
+        return False
 
     def compact_all(self) -> None:
         """Zamboni every bucket (reference mergeTree.ts:1422, run between

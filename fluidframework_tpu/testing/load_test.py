@@ -32,6 +32,11 @@ class LoadProfile:
     # Op mix weights: (map set, string insert, string remove, counter inc)
     weights: tuple = (4, 3, 1, 2)
     reconnect_probability: float = 0.0  # per-op chance to drop + resubmit
+    # True: string edits follow the keystroke model (bursts at a moving
+    # cursor, backspaces, word deletes, pastes, format sweeps —
+    # testing/traces.py) instead of uniform-random positions; the
+    # position-locality distribution real editors produce.
+    keystroke_trace: bool = False
 
 
 @dataclass
@@ -56,6 +61,7 @@ class LoadRunner:
 
     def __init__(self, loader_factory: Callable[[], Loader]):
         self.loader_factory = loader_factory
+        self._cursors: Dict[tuple, int] = {}  # (doc, client) -> position
 
     def _setup_document(self, doc_id: str, n_clients: int
                         ) -> List[Container]:
@@ -97,6 +103,49 @@ class LoadRunner:
         else:
             ds.get_channel("counter").increment(rng.randrange(1, 5))
 
+    def _trace_op(self, rng: random.Random, doc_id: str, client_index: int,
+                  container: Container) -> None:
+        """One keystroke-model edit against the live channel (the editing
+        shape of traces.keystroke_trace, driven interactively)."""
+        from .traces import WORDS
+
+        text = container.runtime.get_datastore("load").get_channel("text")
+        length = text.get_length()
+        key = (doc_id, client_index)
+        cur = min(self._cursors.get(key, 0), length)
+        roll = rng.random()
+        if roll < 0.74:  # keystroke
+            word = rng.choice(WORDS)
+            ch = word[rng.randrange(len(word))] if rng.random() < 0.85 \
+                else " "
+            text.insert_text(cur, ch)
+            cur += 1
+        elif roll < 0.84:  # backspace
+            if cur > 0:
+                text.remove_text(cur - 1, cur)
+                cur -= 1
+        elif roll < 0.90:  # word/selection delete
+            if length >= 4:
+                span = min(rng.randrange(2, 25), length)
+                start = max(0, min(cur - span // 2, length - span))
+                text.remove_text(start, start + span)
+                cur = start
+        elif roll < 0.94:  # paste
+            n = rng.randrange(20, 121)
+            blob = " ".join(rng.choice(WORDS)
+                            for _ in range(max(1, n // 6)))[:n]
+            text.insert_text(cur, blob)
+            cur += len(blob)
+        elif roll < 0.98:  # format sweep
+            if length >= 2:
+                span = min(rng.randrange(5, 81), length)
+                start = max(0, min(cur - span // 2, length - span))
+                text.annotate_range(start, start + span,
+                                    {"fmt": rng.randrange(4)})
+        else:  # cursor jump
+            cur = rng.randrange(length + 1) if length else 0
+        self._cursors[key] = cur
+
     def run(self, profile: Optional[LoadProfile] = None) -> LoadResult:
         profile = profile or LoadProfile()
         result = LoadResult(documents=profile.documents)
@@ -113,8 +162,11 @@ class LoadRunner:
                     if (profile.reconnect_probability
                             and rng.random() < profile.reconnect_probability):
                         container.reconnect()
-                    self._one_op(rng, client_index, op_index, container,
-                                 profile)
+                    if profile.keystroke_trace:
+                        self._trace_op(rng, doc_id, client_index, container)
+                    else:
+                        self._one_op(rng, client_index, op_index, container,
+                                     profile)
                     result.total_ops += 1
         result.elapsed_s = time.perf_counter() - started
         # -- convergence audit (the race detector role) ---------------------

@@ -728,7 +728,13 @@ class TestSnapshotSeededLanes:
     def test_bucket_exhaustion_degrades_to_opaque_not_crash(self):
         """A channel that outgrows the LARGEST capacity bucket loses its
         server-side materialization (opaque) but sequencing continues for
-        it and for every other document — no partition pump crash."""
+        it and for every other document — no partition pump crash.
+
+        A SECOND connected client that never advances its refSeq pins the
+        MSN at its join, so every segment stays contended (inside the
+        collab window) — the host-fold rescue cannot coalesce contended
+        rows, making exhaustion genuine. (Acked single-client growth is
+        now RESCUED by the fold instead: TestAnnotateRingRescue.)"""
         from fluidframework_tpu.server.tpu_sequencer import MergeLaneStore
         server = TpuLocalServer()
         # Shrink the buckets so exhaustion is cheap to reach.
@@ -736,6 +742,10 @@ class TestSnapshotSeededLanes:
         loader, c1, ds1 = make_doc(server, "grow-doc")
         text = ds1.create_channel("text", SharedString.TYPE)
         c1.attach()
+        # The MSN-pinning laggard: joins, then never sends another ref.
+        stalled = Loader(
+            LocalDocumentServiceFactory(server)).resolve("grow-doc")
+        stalled.delta_manager.disconnect = lambda: None  # keep it joined
         for i in range(30):  # far beyond 8 segment slots
             text.insert_text(0, f"{i},")
         assert server.sequencer().merge.overflow_drops >= 1
@@ -871,3 +881,68 @@ class TestSnapshotSeededLanes:
             assert mat == tx.get_text(), f"t{i}"
             b, _lane = sq.merge.where[("burst", "default", f"t{i}")]
             assert sq.merge.capacities[b] > 64  # promoted out of bucket 0
+
+
+class TestKeystrokeTraceStress:
+    def test_trace_load_converges_and_server_materializes(self):
+        """Service-load stress with the keystroke editing model (the
+        position-locality real editors produce) against the DEVICE
+        serving path: every replica converges AND the server's own
+        merge-lane materialization matches the clients — the
+        nodeStressTest analog on realistic traffic."""
+        from fluidframework_tpu.testing.load_test import (LoadProfile,
+                                                          LoadRunner)
+
+        server = TpuLocalServer()
+        runner = LoadRunner(
+            lambda: Loader(LocalDocumentServiceFactory(server)))
+        result = runner.run(LoadProfile(
+            documents=2, clients_per_document=3, ops_per_client=60,
+            seed=11, keystroke_trace=True))
+        assert result.total_ops == 2 * 3 * 60
+        assert result.converged, result.divergences
+        sq = server.sequencer()
+        for d in range(2):
+            doc_id = f"load-doc-{d}"
+            loader = Loader(LocalDocumentServiceFactory(server))
+            text = loader.resolve(doc_id).runtime.get_datastore(
+                "load").get_channel("text")
+            assert sq.channel_text(doc_id, "load", "text") == \
+                text.get_text(), doc_id
+
+    def test_trace_load_with_reconnect_churn(self):
+        from fluidframework_tpu.testing.load_test import (LoadProfile,
+                                                          LoadRunner)
+
+        server = TpuLocalServer()
+        runner = LoadRunner(
+            lambda: Loader(LocalDocumentServiceFactory(server)))
+        result = runner.run(LoadProfile(
+            documents=1, clients_per_document=3, ops_per_client=50,
+            seed=3, keystroke_trace=True, reconnect_probability=0.05))
+        assert result.converged, result.divergences
+
+
+class TestAnnotateRingRescue:
+    def test_annotate_accumulation_survives_via_host_fold(self):
+        """>anno_slots annotates accumulating on one span across flushes
+        overflow the per-segment ring; capacity promotion can't widen
+        rings, so the lane must take the host-fold rescue
+        (MergeLaneStore._rescue_lane) instead of going opaque."""
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server, "anno")
+        t = ds1.create_channel("text", SharedString.TYPE)
+        c1.attach()
+        t.insert_text(0, "abcdefghij")
+        for i in range(12):  # each flush pushes one more ring entry
+            t.annotate_range(2, 7, {"w": i})
+        t.insert_text(0, "Z")  # lane must still be live for new ops
+        sq = server.sequencer()
+        key = ("anno", "default", "text")
+        assert key not in sq.merge.opaque, "lane went opaque"
+        assert sq.channel_text("anno", "default", "text") == t.get_text()
+        import json
+
+        summary = sq.summarize_documents(only={key})
+        blob = json.dumps(summary[key])
+        assert '"w": 11' in blob, "folded props lost"
