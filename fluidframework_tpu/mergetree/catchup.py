@@ -294,31 +294,48 @@ def apply_host_ops(entries: Sequence[dict], host_ops: Sequence[HostOp],
     (device_apply_tail) and the server lane stores' last-resort overflow
     rescue."""
 
-    def capacity_for(rows: int, chunk: int) -> int:
-        need = rows + 2 * chunk + 8
+    def capacity_for(rows: int, need_rows: int) -> int:
+        need = rows + need_rows + 8
         for c in CAPACITY_BUCKETS:
             if need <= c:
                 return c
         raise Unmodelable(f"{rows} live segments exceed the largest "
                           f"catch-up capacity {CAPACITY_BUCKETS[-1]}")
 
+    from .oppack import RUN_K, RunSlot, pack_run_slots, pack_slots
     from .state import DEFAULT_ANNO_SLOTS
 
+    # Insert-run packing (PERF.md lever 3): cursor-advance typing bursts
+    # collapse to one INSERT_RUN step each — exact semantics, and the
+    # editing-trace tails this path serves are mostly such bursts. The
+    # runs kernel variant costs every step an extra full-width shift +
+    # RUN_K selects (and a second compiled flavor per shape), so when
+    # packing would collapse <6% of the steps the runs flatten back to
+    # plain inserts and the stream takes the lean variant.
     host_ops = list(host_ops)
+    slots = pack_run_slots(host_ops, base_seq=current_seq)
+    steps_saved = len(host_ops) - len(slots)
+    if 0 < steps_saved * 16 < len(host_ops):
+        slots = host_ops
+
+    def chunk_rows(chunk) -> int:
+        return sum(RUN_K + 1 if isinstance(s_, RunSlot) else 2
+                   for s_ in chunk)
+
     cur_entries = list(entries)
     state = None
     pos = 0
     anno_slots = DEFAULT_ANNO_SLOTS
     rows_ub = len(cur_entries)  # host-tracked row bound: no per-chunk sync
-    while pos < len(host_ops) or state is None:
-        chunk = host_ops[pos:pos + CHUNK_T]
+    while pos < len(slots) or state is None:
+        chunk = slots[pos:pos + CHUNK_T]
         if state is None:
-            cap = capacity_for(len(cur_entries), len(chunk) or 1)
+            cap = capacity_for(len(cur_entries), chunk_rows(chunk) or 2)
             state = seed_device_state(cur_entries, payloads, cap, min_seq,
                                       current_seq, anno_slots=anno_slots)
         if not chunk:
             break
-        if rows_ub + 2 * len(chunk) + 8 > state.capacity:
+        if rows_ub + chunk_rows(chunk) + 8 > state.capacity:
             # Row space is (by the host bound) close to full: fold on the
             # host — extraction resolves annotate rings into props,
             # coalesce_entries packs acked runs back together — and
@@ -328,14 +345,17 @@ def apply_host_ops(entries: Sequence[dict], host_ops: Sequence[HostOp],
             cseq = int(np.asarray(compacted.seq))
             cur = coalesce_entries(extract_entries(compacted, payloads,
                                                    mseq))
-            cap = capacity_for(len(cur), len(chunk))
+            cap = capacity_for(len(cur), chunk_rows(chunk))
             state = seed_device_state(cur, payloads, cap, mseq, cseq,
                                       anno_slots=anno_slots)
             rows_ub = len(cur)
         t = CHUNK_T if len(chunk) == CHUNK_T else _pow2(len(chunk))
-        packed = pack_single(chunk, steps=t)
-        new_state = kernel.apply_ops_keep(state, packed)
-        rows_ub += 2 * len(chunk)
+        if any(isinstance(s_, RunSlot) for s_ in chunk):
+            packed, runs = pack_slots(chunk, steps=t)
+        else:
+            packed, runs = pack_single(chunk, steps=t), None
+        new_state = kernel.apply_ops_keep(state, packed, runs)
+        rows_ub += chunk_rows(chunk)
         tries = 0
         while bool(np.asarray(new_state.overflow)):
             # Overflow: either row capacity or a per-segment annotate ring
@@ -357,11 +377,11 @@ def apply_host_ops(entries: Sequence[dict], host_ops: Sequence[HostOp],
             cseq = int(np.asarray(compacted.seq))
             cur = coalesce_entries(extract_entries(compacted, payloads,
                                                    mseq))
-            cap = capacity_for(len(cur), len(chunk))
+            cap = capacity_for(len(cur), chunk_rows(chunk))
             state = seed_device_state(cur, payloads, cap, mseq, cseq,
                                       anno_slots=anno_slots)
-            rows_ub = len(cur) + 2 * len(chunk)
-            new_state = kernel.apply_ops_keep(state, packed)
+            rows_ub = len(cur) + chunk_rows(chunk)
+            new_state = kernel.apply_ops_keep(state, packed, runs)
         state = kernel.compact(new_state)
         pos += len(chunk)
     final_min = int(np.asarray(state.min_seq))

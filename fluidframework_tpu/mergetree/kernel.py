@@ -90,29 +90,7 @@ def _shift_right_at(s: DocState, slot, do) -> DocState:
     """Shift all segment rows at indices >= slot right by one (the row at
     slot duplicates its left neighbor, i.e. out[slot] == in[slot-1]) when
     `do`; identity otherwise. out[j] = in[j] for j < slot."""
-    c = s.capacity
-    j = jnp.arange(c, dtype=jnp.int32)
-
-    def shift(x):
-        rolled = jnp.roll(x, 1, axis=0)
-        mask = (j >= slot) & do
-        if x.ndim > 1:
-            mask = mask.reshape(mask.shape + (1,) * (x.ndim - 1))
-        return jnp.where(mask, rolled, x)
-
-    return s._replace(
-        length=shift(s.length),
-        ins_seq=shift(s.ins_seq),
-        ins_client=shift(s.ins_client),
-        local_seq=shift(s.local_seq),
-        rem_seq=shift(s.rem_seq),
-        rem_local_seq=shift(s.rem_local_seq),
-        rem_clients=shift(s.rem_clients),
-        origin_op=shift(s.origin_op),
-        origin_off=shift(s.origin_off),
-        anno=shift(s.anno),
-        count=s.count + do.astype(jnp.int32),
-    )
+    return _shift_right_by(s, slot, do, 1)
 
 
 def _masked_scalar(values, mask):
@@ -179,6 +157,93 @@ def _insert_phase(s: DocState, op: PackedOps, t, enabled, view) -> DocState:
         rem_local_seq=jnp.where(here, 0, g.rem_local_seq),
         rem_clients=jnp.where(hereK, -1, g.rem_clients),
         origin_op=jnp.where(here, op.op_id[t], g.origin_op),
+        origin_off=jnp.where(here, 0, g.origin_off),
+        anno=jnp.where(hereK, -1, g.anno),
+        overflow=g.overflow | bad,
+    )
+
+
+def _shift_right_by(s: DocState, slot, do, k: int) -> DocState:
+    """_shift_right_at generalized to a STATIC shift width k: rows at
+    indices >= slot move right by k (rows [slot, slot+k) become stale
+    copies — the caller overwrites all k); count grows by k."""
+    c = s.capacity
+    j = jnp.arange(c, dtype=jnp.int32)
+
+    def shift(x):
+        rolled = jnp.roll(x, k, axis=0)
+        mask = (j >= slot) & do
+        if x.ndim > 1:
+            mask = mask.reshape(mask.shape + (1,) * (x.ndim - 1))
+        return jnp.where(mask, rolled, x)
+
+    return s._replace(
+        length=shift(s.length),
+        ins_seq=shift(s.ins_seq),
+        ins_client=shift(s.ins_client),
+        local_seq=shift(s.local_seq),
+        rem_seq=shift(s.rem_seq),
+        rem_local_seq=shift(s.rem_local_seq),
+        rem_clients=shift(s.rem_clients),
+        origin_op=shift(s.origin_op),
+        origin_off=shift(s.origin_off),
+        anno=shift(s.anno),
+        count=s.count + do.astype(jnp.int32) * k,
+    )
+
+
+def _insert_run_phase(s: DocState, op: PackedOps, runs, t, enabled,
+                      view) -> DocState:
+    """INSERT_RUN (oppack.RUN_K packing): k cursor-advance inserts by one
+    (client, refSeq) land as k contiguous rows at ONE tie-break slot —
+    the slot the first insert's breakTie scan picks; each subsequent
+    insert's scan provably lands immediately after its predecessor (its
+    tie-run starts at the predecessor's right boundary, whose first stop
+    row is the original target). One visibility pass + one static
+    shift-by-K + K masked fills replace k full apply steps. Padding rows
+    (length 0) are born dead (rem_seq 0): invisible at every perspective
+    and zamboni'd by the next compact."""
+    from .oppack import RUN_K
+
+    r, cl, p = op.ref_seq[t], op.client[t], op.pos1[t]
+    vis, vlen, cum = view
+    c = s.capacity
+    j = jnp.arange(c, dtype=jnp.int32)
+    in_run = cum == p
+    tomb = s.rem_seq <= r
+    acked_ins = s.ins_seq != DEV_UNASSIGNED
+    stop = in_run & (vis | (~tomb & acked_ins) | (j >= s.count))
+    found = jnp.any(stop)
+    bad = enabled & ~found
+    enabled = enabled & found
+    slot = jnp.argmax(stop).astype(jnp.int32)
+    g = _shift_right_by(s, slot, enabled, RUN_K)
+    rel = j - slot
+    here = enabled & (rel >= 0) & (rel < RUN_K)
+
+    def pick(col16, pad):
+        # col16: [K] per-sub values; select by rel with K static terms.
+        out = jnp.full((c,), pad, jnp.int32)
+        for k in range(RUN_K):
+            out = jnp.where(rel == k, col16[k], out)
+        return out
+
+    row_len = pick(runs.length[t], 0)
+    row_seq = pick(runs.seq[t], 0)
+    row_id = pick(runs.op_id[t], -1)
+    live = here & (row_len > 0)
+    dead = here & (row_len == 0)
+    hereK = here[:, None]
+    return g._replace(
+        length=jnp.where(here, row_len, g.length),
+        ins_seq=jnp.where(live, row_seq, jnp.where(dead, 0, g.ins_seq)),
+        ins_client=jnp.where(live, cl, jnp.where(dead, -1, g.ins_client)),
+        local_seq=jnp.where(here, 0, g.local_seq),
+        rem_seq=jnp.where(live, DEV_NO_REMOVE,
+                          jnp.where(dead, 0, g.rem_seq)),
+        rem_local_seq=jnp.where(here, 0, g.rem_local_seq),
+        rem_clients=jnp.where(hereK, -1, g.rem_clients),
+        origin_op=jnp.where(here, row_id, g.origin_op),
         origin_off=jnp.where(here, 0, g.origin_off),
         anno=jnp.where(hereK, -1, g.anno),
         overflow=g.overflow | bad,
@@ -275,19 +340,25 @@ def _ack_phase(s: DocState, op: PackedOps, t, kind) -> DocState:
 # one step
 # ---------------------------------------------------------------------------
 
-def apply_one(s: DocState, op: PackedOps, t, sp_shards: int = 1) -> DocState:
+def apply_one(s: DocState, op: PackedOps, t, sp_shards: int = 1,
+              runs=None) -> DocState:
     """Apply op column t to a single document's state."""
+    from .oppack import RUN_K
+
     kind = op.kind[t]
+    is_run = (kind == OpKind.INSERT_RUN) if runs is not None else False
     is_edit = (kind == OpKind.INSERT) | (kind == OpKind.REMOVE) | \
-        (kind == OpKind.ANNOTATE)
+        (kind == OpKind.ANNOTATE) | is_run
     is_range = (kind == OpKind.REMOVE) | (kind == OpKind.ANNOTATE)
-    # Capacity guard: an edit may create up to 2 new slots. Overflowing ops
-    # become no-ops with the overflow flag set; the host re-runs that doc
-    # at higher capacity.
-    fits = s.count + 2 <= s.capacity
+    # Capacity guard: an edit may create up to 2 new slots (an insert run
+    # up to RUN_K + 1). Overflowing ops become no-ops with the overflow
+    # flag set; the host re-runs that doc at higher capacity.
+    need = jnp.where(is_run, RUN_K + 1, 2) if runs is not None else 2
+    fits = s.count + need <= s.capacity
     s = s._replace(overflow=s.overflow | (is_edit & ~fits))
     is_edit = is_edit & fits
     is_range = is_range & fits
+    is_run = is_run & fits
 
     r, cl = op.ref_seq[t], op.client[t]
     s1 = _ensure_boundary(s, op.pos1[t], r, cl, is_edit, sp_shards)
@@ -300,6 +371,8 @@ def apply_one(s: DocState, op: PackedOps, t, sp_shards: int = 1) -> DocState:
     view2 = visibility(s2, r, cl, sp_shards)
     s_ins = _insert_phase(s2, op, t, is_edit & (kind == OpKind.INSERT),
                           view2)
+    if runs is not None:
+        s_ins = _insert_run_phase(s_ins, op, runs, t, is_run, view2)
     s_rem = _remove_phase(s_ins, op, t, is_range & (kind == OpKind.REMOVE),
                           view2)
     s_ann = _annotate_phase(s_rem, op, t,
@@ -321,15 +394,19 @@ def apply_one(s: DocState, op: PackedOps, t, sp_shards: int = 1) -> DocState:
 # document batch axis, scan drives the time axis.
 
 def _scan_ops(state: DocState, ops: PackedOps, batched: bool,
-              sp_shards: int = 1) -> DocState:
+              sp_shards: int = 1, runs=None) -> DocState:
     steps = ops.steps
 
     def body(s, t):
         if batched:
-            s2 = jax.vmap(lambda sd, od: apply_one(sd, od, t, sp_shards)
-                          )(s, ops)
+            if runs is not None:
+                s2 = jax.vmap(lambda sd, od, rd: apply_one(
+                    sd, od, t, sp_shards, runs=rd))(s, ops, runs)
+            else:
+                s2 = jax.vmap(lambda sd, od: apply_one(sd, od, t, sp_shards)
+                              )(s, ops)
         else:
-            s2 = apply_one(s, ops, t, sp_shards)
+            s2 = apply_one(s, ops, t, sp_shards, runs=runs)
         return s2, None
 
     out, _ = jax.lax.scan(body, state, jnp.arange(steps, dtype=jnp.int32))
@@ -352,8 +429,8 @@ def apply_ops_batched(state: DocState, ops: PackedOps) -> DocState:
 # (overflow recovery / bulk catch-up retry at a larger capacity): jax arrays
 # are immutable, so keeping the input alive costs nothing extra.
 @jax.jit
-def apply_ops_keep(state: DocState, ops: PackedOps) -> DocState:
-    return _scan_ops(state, ops, batched=False)
+def apply_ops_keep(state: DocState, ops: PackedOps, runs=None) -> DocState:
+    return _scan_ops(state, ops, batched=False, runs=runs)
 
 
 @jax.jit

@@ -20,6 +20,26 @@ class OpKind:
     ANNOTATE = 3
     ACK_INSERT = 4
     ACK_REMOVE = 5
+    INSERT_RUN = 6  # up to RUN_K packed cursor-advance inserts, one step
+
+
+# Insert-run packing (PERF.md lever 3): a same-(client, refSeq) typing
+# burst with cursor-advancing positions is exactly k contiguous segments
+# at ONE tie-break slot, so it applies in one kernel step — one
+# visibility pass + one static shift-by-K + K masked row fills — with
+# EXACT semantics (every row keeps its own seq/op_id/length; padding
+# rows are born dead: length 0, rem_seq 0, zamboni'd at next compact).
+RUN_K = 8
+RUN_MIN = 5  # shorter runs stay plain inserts (padding would cost rows)
+
+
+class RunCols(NamedTuple):
+    """Per-step sub-insert columns for INSERT_RUN ops: [B, T, K] (or
+    [T, K] unbatched) int32; length 0 marks padding slots."""
+
+    length: jnp.ndarray
+    seq: jnp.ndarray
+    op_id: jnp.ndarray
 
 
 class HostOp(NamedTuple):
@@ -120,3 +140,101 @@ def pack_single(stream: List[HostOp], steps: Optional[int] = None) -> PackedOps:
     """Pack one document's ops into unbatched [T] columns."""
     packed = pack_ops([stream], steps)
     return PackedOps(**{f: getattr(packed, f)[0] for f in _FIELDS})
+
+
+class RunSlot(NamedTuple):
+    """A packed insert run: 5..RUN_K cursor-advance inserts, one step."""
+
+    ops: tuple  # HostOps, in order
+
+
+def pack_run_slots(host_ops: List[HostOp],
+                   base_seq: Optional[int] = None) -> List:
+    """Greedy maximal-run detection over ONE CHANNEL's sequenced stream:
+    consecutive ACKED INSERTs by one client whose positions advance with
+    the cursor (pos_{i+1} == pos_i + len_i) collapse into RunSlots of up
+    to RUN_K; runs shorter than RUN_MIN (and every other op) stay plain.
+
+    Exactness with ADVANCING refs: the packed phase applies every member
+    at the FIRST member's perspective (r_1, client). That is only equal
+    to per-op application if no segment's ins/rem seq falls in
+    (r_1, r_i] for a foreign client — i.e. no other client's op on THIS
+    tree was sequenced there. Two stream-visible conditions guarantee it:
+      * r_1 >= the previous stream op's seq (`base_seq` seeds the stream
+        head = the state's current_seq): nothing foreign sits in
+        (r_1, s_1) — in-between seqs belong to other channels, which
+        never touch this tree;
+      * members are stream-consecutive with monotone refs: seqs in
+        [s_1, r_i] on this tree are the run's own members, visible to
+        their own client at every perspective."""
+    from .constants import DEV_UNASSIGNED
+
+    slots: List = []
+    i, n = 0, len(host_ops)
+    last_seq = base_seq  # seq of the last preceding op in this stream
+    while i < n:
+        op = host_ops[i]
+        j = i + 1
+        if (op.kind == OpKind.INSERT and op.seq != DEV_UNASSIGNED
+                and op.new_len > 0
+                and last_seq is not None and op.ref_seq >= last_seq):
+            cursor = op.pos1 + op.new_len
+            prev_seq = op.seq
+            prev_ref = op.ref_seq
+            while j < n:
+                nxt = host_ops[j]
+                if (nxt.kind == OpKind.INSERT
+                        and nxt.seq != DEV_UNASSIGNED
+                        and nxt.client == op.client
+                        and nxt.seq > prev_seq
+                        and prev_ref <= nxt.ref_seq < nxt.seq
+                        and nxt.pos1 == cursor and nxt.new_len > 0):
+                    cursor += nxt.new_len
+                    prev_seq = nxt.seq
+                    prev_ref = nxt.ref_seq
+                    j += 1
+                    continue
+                break
+        run = list(host_ops[i:j])
+        while len(run) >= RUN_K:
+            slots.append(RunSlot(tuple(run[:RUN_K])))
+            run = run[RUN_K:]
+        if len(run) >= RUN_MIN:
+            slots.append(RunSlot(tuple(run)))
+        else:
+            slots.extend(run)
+        for o in host_ops[i:j]:
+            if o.seq != DEV_UNASSIGNED:
+                last_seq = o.seq if last_seq is None \
+                    else max(last_seq, o.seq)
+        i = j
+    return slots
+
+
+def pack_slots(slots: List, steps: Optional[int] = None):
+    """Pack a mixed plain-op/RunSlot stream into unbatched [T] PackedOps
+    + [T, RUN_K] RunCols (zeros where the step is not a run)."""
+    t = steps if steps is not None else max(len(slots), 1)
+    base: List[HostOp] = []
+    for s in slots:
+        if isinstance(s, RunSlot):
+            base.append(HostOp(
+                kind=OpKind.INSERT_RUN, seq=s.ops[-1].seq,
+                ref_seq=s.ops[0].ref_seq, client=s.ops[0].client,
+                pos1=s.ops[0].pos1, pos2=0, op_id=-1,
+                new_len=sum(o.new_len for o in s.ops),
+                local_seq=0, msn=s.ops[-1].msn))
+        else:
+            base.append(s)
+    packed = pack_single(base, steps=t)
+    rl = np.zeros((t, RUN_K), np.int32)
+    rs = np.zeros((t, RUN_K), np.int32)
+    ri = np.full((t, RUN_K), -1, np.int32)
+    for idx, s in enumerate(slots):
+        if isinstance(s, RunSlot):
+            for k, op in enumerate(s.ops):
+                rl[idx, k] = op.new_len
+                rs[idx, k] = op.seq
+                ri[idx, k] = op.op_id
+    return packed, RunCols(jnp.asarray(rl), jnp.asarray(rs),
+                           jnp.asarray(ri))

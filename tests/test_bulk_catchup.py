@@ -284,3 +284,88 @@ class TestLoaderE2E:
         t2 = late.runtime.get_datastore("default").get_channel("text")
         assert t2.get_text() == text.get_text()
         assert t2.bulk_catchup_count == 0
+
+
+class TestInsertRunPacking:
+    """INSERT_RUN packing (oppack.pack_run_slots + kernel._insert_run_phase):
+    typing bursts apply as one step with EXACT semantics."""
+
+    def _host_ops(self, tail):
+        from fluidframework_tpu.mergetree.catchup import wire_to_host_ops
+        from fluidframework_tpu.mergetree.host import OpBuilder, PayloadTable
+        builder = OpBuilder(PayloadTable())
+        out = []
+        for op, s, r, c, m in tail:
+            out.extend(wire_to_host_ops(builder, op, s, r, c, m,
+                                        allow_items=True))
+        return out
+
+    def test_typing_burst_packs_and_matches(self):
+        from fluidframework_tpu.mergetree.oppack import (RunSlot,
+                                                         pack_run_slots)
+        from fluidframework_tpu.testing.traces import keystroke_trace
+        tail = keystroke_trace(600, seed=21)
+        slots = pack_run_slots(self._host_ops(tail), base_seq=0)
+        assert any(isinstance(s, RunSlot) for s in slots), "nothing packed"
+        bulk = MergeTreeClient(client_id=99)
+        bulk.apply_bulk(tail)
+        scalar = MergeTreeClient(client_id=99)
+        for op, s, r, c, m in tail:
+            scalar.apply_msg(op, s, r, c, min_seq=m)
+        assert bulk.get_text() == scalar.get_text()
+
+    def test_foreign_op_blocks_run_head(self):
+        """A run may only start when r_1 covers the previous stream op —
+        otherwise a foreign tombstone in (r_1, s_1) would classify
+        differently at the packed perspective."""
+        from fluidframework_tpu.mergetree.oppack import (HostOp, OpKind,
+                                                         RunSlot,
+                                                         pack_run_slots)
+        mk = lambda seq, ref, pos: HostOp(  # noqa: E731
+            kind=OpKind.INSERT, seq=seq, ref_seq=ref, client=1, pos1=pos,
+            op_id=seq, new_len=1)
+        # Foreign remove at seq 10; our burst refs 5 (< 10): no packing.
+        stream = [HostOp(kind=OpKind.REMOVE, seq=10, ref_seq=9, client=2,
+                         pos1=0, pos2=1)]
+        stream += [mk(11 + i, 5, i) for i in range(8)]
+        slots = pack_run_slots(stream, base_seq=4)
+        assert not any(isinstance(s, RunSlot) for s in slots)
+        # Same burst whose refs cover the remove: packs.
+        stream2 = [stream[0]] + [mk(11 + i, 10 + i, i) for i in range(8)]
+        slots2 = pack_run_slots(stream2, base_seq=4)
+        assert any(isinstance(s, RunSlot) for s in slots2)
+
+    def test_concurrent_insert_at_run_boundary_matches(self):
+        """Another client inserting at the SAME position as a packed run
+        (sequenced after it, ref before it): the tie-break order must
+        match the scalar path exactly."""
+        from fluidframework_tpu.mergetree.client import make_insert_op
+        base = [(make_insert_op(0, text_seg("0123456789")), 1, 0, 1, 0)]
+        burst = [(make_insert_op(3 + i, text_seg(chr(97 + i))), 2 + i,
+                  1 + i, 1, 0) for i in range(8)]
+        rival = [(make_insert_op(3, text_seg("RIVAL")), 10, 1, 2, 0)]
+        tail = base + burst + rival
+        bulk = MergeTreeClient(client_id=99)
+        bulk.apply_bulk(tail)
+        scalar = MergeTreeClient(client_id=99)
+        for op, s, r, c, m in tail:
+            scalar.apply_msg(op, s, r, c, min_seq=m)
+        assert bulk.get_text() == scalar.get_text()
+
+    def test_run_overflow_escalates_cleanly(self):
+        """A run needs K+1 rows of headroom; the capacity-guard overflow
+        path must retry at a wider bucket, not corrupt."""
+        from fluidframework_tpu.mergetree.client import make_insert_op
+        tail = []
+        pos = 0
+        for i in range(400):  # long bursts -> many run slots
+            tail.append((make_insert_op(pos, text_seg("ab")), i + 1, i, 1,
+                         max(0, i - 60)))
+            pos += 2
+        bulk = MergeTreeClient(client_id=99)
+        bulk.apply_bulk(tail)
+        scalar = MergeTreeClient(client_id=99)
+        for op, s, r, c, m in tail:
+            scalar.apply_msg(op, s, r, c, min_seq=m)
+        assert bulk.get_text() == scalar.get_text()
+        assert bulk.get_length() == 800
