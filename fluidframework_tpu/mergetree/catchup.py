@@ -14,10 +14,11 @@ snapshot_segments/load_segments), so adoption into a live client is a
 state swap, conformance-locked by byte-comparing against the scalar path.
 
 Capacity discipline: chunks are T-bucketed (one compiled program per
-(capacity, T) pair); an edit can add at most 2 segment rows (kernel.py
-apply_one guard), so capacity >= rows + 2*T never overflows — the bucket is
-chosen accordingly and escalates if compaction between chunks cannot keep
-the row count down.
+(capacity, T) pair); a plain edit can add at most 2 segment rows and an
+INSERT_RUN step up to RUN_K+1 (kernel.py apply_one guard), so capacity >=
+rows + chunk_rows(chunk) never overflows — the bucket is chosen
+accordingly (apply_host_ops.chunk_rows) and escalates if compaction
+between chunks cannot keep the row count down.
 """
 
 from __future__ import annotations
