@@ -149,9 +149,12 @@ def _serving_ingest_rate(docs: int = 4096, ops_per_doc: int = 32) -> float:
                 n = rng.randrange(1, 4)
                 contents.append(DocumentMessage(
                     client_sequence_number=base_csn + i + 1,
-                    # refSeq tracks the doc's own prior seq (join=1, op k at
-                    # seq k+1) so the MSN/collab window advances naturally.
-                    reference_sequence_number=base_csn + i,
+                    # The whole boxcar rides ONE ref (the client typed the
+                    # burst without processing anything in between — the
+                    # real editor shape; also what lets the fast path pack
+                    # the burst as INSERT_RUN slots). Refs advance per
+                    # WAVE, so the MSN/collab window still moves.
+                    reference_sequence_number=base_csn,
                     type=MessageType.OPERATION,
                     contents={"address": "s", "contents": {
                         "address": "t", "contents": {

@@ -30,7 +30,7 @@ from . import ticket_kernel as tk
 
 @functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(6,))
 def serve_window(tstate, ticket_cols, merge_states, merge_cols,
-                 lww_states, lww_cols, fused=False):
+                 lww_states, lww_cols, fused=False, merge_runs=None):
     """The WHOLE fast window in one device program — over a tunneled
     device every extra dispatch pays a serialized RPC, so ticketing, every
     bucket's merge/LWW apply, and the result packing fuse into a single
@@ -54,8 +54,10 @@ def serve_window(tstate, ticket_cols, merge_states, merge_cols,
                                         require_join=True)
     seq_bt, msn_bt = ticketed.seq, ticketed.min_seq
 
+    if merge_runs is None:
+        merge_runs = [None] * len(merge_cols)
     new_merge = []
-    for mstate, mc in zip(merge_states, merge_cols):
+    for mstate, mc, mr in zip(merge_states, merge_cols, merge_runs):
         packed = PackedOps(kind=mc[0], seq=mc[1], ref_seq=mc[2],
                            client=mc[3], pos1=mc[4], pos2=mc[5],
                            op_id=mc[6], new_len=mc[7], local_seq=mc[8],
@@ -63,13 +65,41 @@ def serve_window(tstate, ticket_cols, merge_states, merge_cols,
         seq_g = seq_bt[mc[10], mc[11]]
         msn_g = msn_bt[mc[10], mc[11]]
         ok = (packed.kind != OpKind.NOOP) & (seq_g > 0)
+        runs = None
+        over_extra = None
+        if mr is not None:
+            # INSERT_RUN slots: every member gathers ITS OWN ticketed
+            # seq; a member the ticket pass nacked (dup/stale — the host
+            # packed on a prediction) voids the WHOLE slot and flags the
+            # lane, which then takes the standard overflow rollback +
+            # scalar re-run. mr: [4, lanes, Tm, K] = len, op_id,
+            # doc_lane, t_idx per member (len 0 = padding).
+            from ..mergetree.oppack import RunCols
+            sub_len, sub_oid = mr[0], mr[1]
+            sub_seq = seq_bt[mr[2], mr[3]]
+            expected = sub_len > 0
+            is_run = packed.kind == OpKind.INSERT_RUN
+            mispredict = is_run & jnp.any(expected & (sub_seq <= 0),
+                                          axis=-1)
+            ok = ok & ~mispredict
+            runs = RunCols(length=sub_len,
+                           seq=jnp.where(expected, sub_seq, 0),
+                           op_id=sub_oid)
+            over_extra = jnp.any(mispredict, axis=-1)
         ops2 = packed._replace(
             kind=jnp.where(ok, packed.kind, OpKind.NOOP),
             seq=jnp.where(ok, seq_g, 0),
             msn=jnp.where(ok, msn_g, 0))
         from ..mergetree.pallas_apply import (FUSED_MAX_CAPACITY,
                                              apply_ops_fused_pallas)
-        if fused and mstate.capacity <= FUSED_MAX_CAPACITY:
+        if runs is not None:
+            # The fused Mosaic kernel has no run phase (yet): run-bearing
+            # buckets take the scan kernel, whose per-step cost the
+            # packing itself collapses.
+            out = kernel._scan_ops(mstate, ops2, batched=True, runs=runs)
+            out = out._replace(overflow=out.overflow | over_extra)
+            new_merge.append(out)
+        elif fused and mstate.capacity <= FUSED_MAX_CAPACITY:
             # VMEM-resident fused apply: the bucket's lane block stays
             # on-core across the whole op stream — the T-step HBM
             # re-read/re-write of the scan kernel (the serving apply's

@@ -40,7 +40,7 @@ from ..mergetree.catchup import (
     wire_to_host_ops,
 )
 from ..mergetree.host import OpBuilder, PayloadTable, extract_text
-from ..mergetree.oppack import HostOp, PackedOps, pack_ops
+from ..mergetree.oppack import HostOp, OpKind, PackedOps, pack_ops
 from ..mergetree.state import DocState, make_state
 from ..protocol.messages import (
     Boxcar,
@@ -904,6 +904,100 @@ class _LwwValueBlock:
         return v
 
 
+def _pack_lane_runs(lanes, kind, client, ref, pos1, length, K, run_min):
+    """Vectorized insert-run detection over ONE flush's merge rows
+    (oppack.pack_run_slots semantics, numpy over pump columns).
+
+    Rows arrive in stream order with lanes interleaved; runs are
+    CONSECUTIVE same-lane INSERT rows by one client at ONE refSeq whose
+    positions chain as append (pos_{i+1} == pos_i + len_i) or prepend
+    (pos_{i+1} == pos_i) — equal refs make the packed single-perspective
+    apply exact (any in-window foreign seq is > every member's ref, so
+    classification is identical at all members' perspectives). Runs of
+    >= run_min members chunk into INSERT_RUN slots of up to K; prepend
+    slots lay their members out REVERSED (each later prepend lands
+    before its predecessor, exactly the scalar tie-break order).
+
+    Returns per-row arrays:
+      slot       int  — the row's op slot within its lane
+      sub        int  — layout index within the slot (-1 = plain op)
+      head       bool — stream-first member of a run slot (provides the
+                        slot's pos1/ref/client columns)
+      tail       bool — stream-last member (provides the slot's
+                        doc_lane/t_idx, i.e. its seq/msn gather source)
+    Plain rows have head == tail == True and sub == -1."""
+    n = len(lanes)
+    if n == 0:
+        z = np.zeros(0, np.int64)
+        return z, z - 1, np.zeros(0, bool), np.zeros(0, bool)
+    idx = np.arange(n)
+    order = np.lexsort((idx, lanes))  # lane-grouped, stream-ordered
+    la, ki, cl, rf, p1, ln = (a[order] for a in
+                              (lanes, kind, client, ref, pos1, length))
+    ins = (ki == OpKind.INSERT) & (ln > 0)
+    same = np.zeros(n, bool)
+    same[1:] = ((la[1:] == la[:-1]) & ins[1:] & ins[:-1]
+                & (cl[1:] == cl[:-1]) & (rf[1:] == rf[:-1]))
+    app = np.zeros(n, bool)
+    pre = np.zeros(n, bool)
+    app[1:] = same[1:] & (p1[1:] == p1[:-1] + ln[:-1])
+    pre[1:] = same[1:] & (p1[1:] == p1[:-1])
+    link = np.where(app, 1, np.where(pre, 2, 0))
+    # A link continues the run only if it matches the run's first link
+    # type; since consecutive links must agree pairwise for a uniform
+    # chain, "same type as previous link" suffices (the first link sets
+    # the type; a type flip breaks).
+    cont = link > 0
+    cont[2:] &= (link[2:] == link[1:-1]) | (link[1:-1] == 0)
+    start = ~cont
+    run_id = np.cumsum(start) - 1
+    # Member position within the run, run sizes.
+    q = idx - np.maximum.accumulate(np.where(start, idx, 0))
+    run_sizes = np.bincount(run_id, minlength=run_id[-1] + 1)
+    size_of = run_sizes[run_id]
+    # Runs below run_min (or singletons) stay plain.
+    member = size_of >= run_min
+    # Chunk runs into slots of K.
+    slot_in_run = q // K
+    sub_stream = q % K
+    # Per-slot member count (last chunk may be short). A remainder
+    # chunk below run_min is not worth a padded slot: demote to plain
+    # (pack_run_slots does the same).
+    chunk = np.minimum(size_of - slot_in_run * K, K)
+    member = member & (chunk >= run_min)
+    run_type = np.zeros(n, np.int64)
+    # type of the run = type of its second element's link (first link).
+    first_link_idx = np.maximum.accumulate(np.where(start, idx, 0)) + 1
+    valid_fl = first_link_idx < n
+    fl = np.where(valid_fl, np.minimum(first_link_idx, n - 1), n - 1)
+    run_type = np.where(member, link[fl], 0)
+    sub = np.where(run_type == 2, chunk - 1 - sub_stream, sub_stream)
+    sub = np.where(member, sub, -1)
+    # Slot numbering within the lane: plain rows and stream-first chunk
+    # members start a slot.
+    starts_slot = ~member | (sub_stream == 0)
+    # cumcount of slot starts per lane (rows already lane-grouped).
+    lane_start = np.zeros(n, bool)
+    lane_start[0] = True
+    lane_start[1:] = la[1:] != la[:-1]
+    slot_cum = np.cumsum(starts_slot)
+    adj = np.maximum.accumulate(
+        np.where(lane_start, slot_cum - starts_slot.astype(np.int64), 0))
+    slot_sorted = slot_cum - 1 - adj
+    head = ~member | (sub_stream == 0)
+    tail = ~member | (sub_stream == chunk - 1)
+    # Map back to original row order.
+    slot = np.empty(n, np.int64)
+    sub_o = np.empty(n, np.int64)
+    head_o = np.empty(n, bool)
+    tail_o = np.empty(n, bool)
+    slot[order] = slot_sorted
+    sub_o[order] = sub
+    head_o[order] = head
+    tail_o[order] = tail
+    return slot, sub_o, head_o, tail_o
+
+
 def _cumcount(groups: np.ndarray) -> np.ndarray:
     """Per-row occurrence index within its group value, preserving row
     order (vectorized groupby-cumcount)."""
@@ -1205,6 +1299,11 @@ class TpuSequencerLambda(IPartitionLambda):
         # the tunnel transfer with the next backlog's native parse.
         self.pipelined = False
         self._inflight: Optional[dict] = None
+        # Insert-run packing on the fast path (PERF.md lever 3): typing
+        # bursts in a window collapse to INSERT_RUN slots; a mispredicted
+        # member admission (rare: dup/stale nack inside a run) flags the
+        # lane and takes the standard overflow rollback + scalar re-run.
+        self.pack_runs = True
         # Fused VMEM-resident merge apply inside the fast window (lazy
         # probe on first fast flush; scan kernel wherever Mosaic is
         # unavailable or a bucket exceeds the fused VMEM budget). Mesh
@@ -1888,7 +1987,9 @@ class TpuSequencerLambda(IPartitionLambda):
             [self._place_cols(j["cols"]) for j in merge_jobs],
             [self.lww.buckets[j["bucket"]].state for j in lww_jobs],
             [self._place_cols(j["cols"]) for j in lww_jobs],
-            self._fused_serve)
+            self._fused_serve,
+            [None if j["runs"] is None else self._place_cols(j["runs"])
+             for j in merge_jobs])
         for j, post in zip(merge_jobs, new_merge):
             j["post"] = post
             self.merge.buckets[j["bucket"]].state = post
@@ -2024,25 +2125,69 @@ class TpuSequencerLambda(IPartitionLambda):
         for b in np.unique(mb).tolist():
             bsel = mb == b
             bucket = self.merge.buckets[b]
-            Tm = _bucket(int(cpos[bsel].max()) + 1, self.t_buckets)
-            mc = np.zeros((12, bucket.lanes, Tm), np.int32)
             rl = ml[bsel]
-            rp = cpos[bsel]
             rr = mrows[bsel]
-            # Layout matches serve_step.serve_window: kind seq ref client
-            # pos1 pos2 op_id new_len local_seq msn doc_idx t_idx.
-            mc[0, rl, rp] = cols[P.MKIND, rr]
-            mc[2, rl, rp] = cols[P.REFSEQ, rr]
-            mc[3, rl, rp] = cols[P.CLIENT, rr]
-            mc[4, rl, rp] = cols[P.POS1, rr]
-            mc[5, rl, rp] = cols[P.POS2, rr]
-            mc[6, rl, rp] = op_ids[bsel]
-            mc[7, rl, rp] = cols[P.CHARLEN, rr]
             doc_lane = lanes[wrow[bsel]]
             tslot = slot[wrow[bsel]]
-            mc[10, rl, rp] = doc_lane
-            mc[11, rl, rp] = tslot
+            b_kind = cols[P.MKIND, rr]
+            b_client = cols[P.CLIENT, rr]
+            b_ref = cols[P.REFSEQ, rr]
+            b_pos1 = cols[P.POS1, rr]
+            b_len = cols[P.CHARLEN, rr]
+            runs_rc = None
+            if self.pack_runs:
+                from ..mergetree.oppack import RUN_K, RUN_MIN
+                rp, sub, head, tail = _pack_lane_runs(
+                    rl, b_kind, b_client, b_ref, b_pos1, b_len,
+                    RUN_K, RUN_MIN)
+                rp = rp.astype(np.int64)
+                is_member = sub >= 0
+            else:
+                rp = cpos[bsel]
+                sub = np.full(rr.size, -1, np.int64)
+                head = tail = np.ones(rr.size, bool)
+                is_member = np.zeros(rr.size, bool)
+            Tm = _bucket(int(rp.max()) + 1 if rr.size else 1,
+                         self.t_buckets)
+            mc = np.zeros((12, bucket.lanes, Tm), np.int32)
+            # Layout matches serve_step.serve_window: kind seq ref client
+            # pos1 pos2 op_id new_len local_seq msn doc_idx t_idx.
+            # Run slots: the stream-FIRST member provides pos1/ref/client
+            # (writes below land head-last per slot via masked ordering),
+            # the stream-LAST member provides doc_idx/t_idx (seq/msn
+            # gather source); kind becomes INSERT_RUN, op_id -1, new_len
+            # the member total.
+            hsel = head  # plain rows AND run heads define op columns
+            mc[0, rl[hsel], rp[hsel]] = np.where(
+                is_member[hsel], OpKind.INSERT_RUN, b_kind[hsel])
+            mc[2, rl[hsel], rp[hsel]] = b_ref[hsel]
+            mc[3, rl[hsel], rp[hsel]] = b_client[hsel]
+            mc[4, rl[hsel], rp[hsel]] = b_pos1[hsel]
+            mc[5, rl[hsel], rp[hsel]] = cols[P.POS2, rr][hsel]
+            mc[6, rl[hsel], rp[hsel]] = np.where(
+                is_member[hsel], -1, op_ids[bsel][hsel])
+            run_total = np.zeros(rr.size, np.int64)
+            if is_member.any():
+                # total member length per (lane, slot), read back per row
+                key = rl * Tm + rp
+                sums = np.zeros(bucket.lanes * Tm, np.int64)
+                np.add.at(sums, key[is_member], b_len[is_member])
+                run_total = sums[key]
+            mc[7, rl[hsel], rp[hsel]] = np.where(
+                is_member[hsel], run_total[hsel], b_len[hsel])
+            tsel = tail
+            mc[10, rl[tsel], rp[tsel]] = doc_lane[tsel]
+            mc[11, rl[tsel], rp[tsel]] = tslot[tsel]
+            if is_member.any():
+                rc = np.zeros((4, bucket.lanes, Tm, RUN_K), np.int32)
+                msel = is_member
+                rc[0, rl[msel], rp[msel], sub[msel]] = b_len[msel]
+                rc[1, rl[msel], rp[msel], sub[msel]] = op_ids[bsel][msel]
+                rc[2, rl[msel], rp[msel], sub[msel]] = doc_lane[msel]
+                rc[3, rl[msel], rp[msel], sub[msel]] = tslot[msel]
+                runs_rc = rc
             jobs.append({"bucket": b, "pre": bucket.state, "cols": mc,
+                         "runs": runs_rc,
                          "rows": rr, "lanes": rl, "op_ids": op_ids[bsel],
                          "doc_lane": doc_lane, "slot": tslot})
         return jobs

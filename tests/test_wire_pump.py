@@ -634,3 +634,84 @@ class TestNarrowResultPacking:
         seq_d = flat[:bt].reshape(B, T).astype(np.int64)
         seq = np.where(seq_d >= 0, next_seq[:, None] - seq_d, 0)
         assert seq[0].tolist() == [50_000, 50_001]
+
+
+class TestServingRunPacking:
+    def _burst_traffic(self, prepend=False, docs=2, k=12):
+        # A typing burst inside one boxcar: the client's ref is FROZEN
+        # (it has processed nothing since) — the packable shape.
+        out = []
+        for d in range(docs):
+            doc = f"d{d}"
+            msgs = [_join(f"c{d}")]
+            pos = 0
+            for i in range(1, k + 1):
+                text = chr(96 + i) * 2
+                msgs.append(DocumentMessage(
+                    client_sequence_number=i,
+                    reference_sequence_number=0,
+                    type=MessageType.OPERATION,
+                    contents={"address": "s", "contents": {
+                        "address": "t", "contents": {
+                            "type": OP_INSERT, "pos1": pos,
+                            "seg": {"text": text}}}}))
+                if not prepend:
+                    pos += len(text)
+            out.append((doc, Boxcar("t", doc, f"c{d}", msgs)))
+        return out
+
+    def test_append_bursts_pack_and_match(self):
+        A, B, emits, nacks = run_both(self._burst_traffic(prepend=False))
+        assert_equivalent(A, B, emits, nacks,
+                          [(f"d{d}", "s", "t") for d in range(2)])
+
+    def test_prepend_bursts_pack_and_match(self):
+        A, B, emits, nacks = run_both(self._burst_traffic(prepend=True))
+        assert_equivalent(A, B, emits, nacks,
+                          [(f"d{d}", "s", "t") for d in range(2)])
+
+    def test_runs_actually_fire(self):
+        """Guard against the packer silently never-packing: a burst
+        window must stage at least one INSERT_RUN slot."""
+        from fluidframework_tpu.mergetree.oppack import OpKind
+        seen = {"run": False}
+        orig = TpuSequencerLambda._build_merge
+
+        def spy(self, parsed, rows, lanes, slot, *a):
+            jobs = orig(self, parsed, rows, lanes, slot, *a)
+            for j in jobs:
+                if (j["cols"][0] == OpKind.INSERT_RUN).any():
+                    seen["run"] = True
+            return jobs
+
+        TpuSequencerLambda._build_merge = spy
+        try:
+            A, B, emits, nacks = run_both(self._burst_traffic())
+        finally:
+            TpuSequencerLambda._build_merge = orig
+        assert seen["run"], "no INSERT_RUN slot staged for a typing burst"
+        assert_equivalent(A, B, emits, nacks,
+                          [(f"d{d}", "s", "t") for d in range(2)])
+
+    def test_nacked_member_mid_run_rolls_back(self):
+        """A duplicate csn INSIDE a packed run gets nacked by ticketing:
+        the mispredicted slot must void, the lane must roll back, and the
+        scalar re-run must land the admitted members — fast == object."""
+        doc = "d0"
+        msgs = [_join("c0")]
+        pos = 0
+        for i in range(1, 13):
+            dup = 6 if i == 7 else i  # csn 6 repeats mid-burst
+            text = chr(96 + i) * 2
+            msgs.append(DocumentMessage(
+                client_sequence_number=dup,
+                reference_sequence_number=0,
+                type=MessageType.OPERATION,
+                contents={"address": "s", "contents": {
+                    "address": "t", "contents": {
+                        "type": OP_INSERT, "pos1": pos,
+                        "seg": {"text": text}}}}))
+            pos += len(text)
+        A, B, emits, nacks = run_both([(doc, Boxcar("t", doc, "c0",
+                                                    msgs))])
+        assert_equivalent(A, B, emits, nacks, [(doc, "s", "t")])
