@@ -643,6 +643,10 @@ def main() -> None:
             ops_per_sec / (pinned_baseline or baseline_ops_per_sec), 2),
         "extra": {
             "backend": jax.default_backend(),
+            # CPU-fallback numbers exist to prove the harness runs, not
+            # for trend lines: host contention swings them ±40% run to
+            # run (VERDICT r3 weak #7). Compare device runs only.
+            "comparable": jax.default_backend() in ("tpu", "axon"),
             "fused_apply": use_fused,
             "elapsed_s": round(elapsed, 4),
             "docs": n_docs, "ops_per_doc": n_ops,
