@@ -100,11 +100,11 @@ _SEG_PLANES = ("length", "ins_seq", "ins_client", "local_seq", "rem_seq",
                "rem_local_seq", "origin_op", "origin_off")
 
 
-def _shift_right(st, shift_mask, k_slots, a_slots, roll):
+def _shift_right(st, shift_mask, k_slots, a_slots, roll, by: int = 1):
     out = dict(st)
     for name in _SEG_PLANES + tuple(f"rc{i}" for i in range(k_slots)) + \
             tuple(f"an{i}" for i in range(a_slots)):
-        out[name] = jnp.where(shift_mask, roll(st[name], 1), st[name])
+        out[name] = jnp.where(shift_mask, roll(st[name], by), st[name])
     return out
 
 
@@ -154,6 +154,59 @@ def _insert_phase(st, op, enabled, view, k_slots, a_slots, roll):
     g["rem_seq"] = jnp.where(here, DEV_NO_REMOVE, g["rem_seq"])
     g["rem_local_seq"] = jnp.where(here, 0, g["rem_local_seq"])
     g["origin_op"] = jnp.where(here, op["op_id"], g["origin_op"])
+    g["origin_off"] = jnp.where(here, 0, g["origin_off"])
+    for i in range(k_slots):
+        g[f"rc{i}"] = jnp.where(here, -1, g[f"rc{i}"])
+    for i in range(a_slots):
+        g[f"an{i}"] = jnp.where(here, -1, g[f"an{i}"])
+    g["overflow"] = g["overflow"] | bad
+    return g
+
+
+def _insert_run_phase(st, op, enabled, view, k_slots, a_slots, roll):
+    """kernel._insert_run_phase on planes: up to RUN_K packed
+    cursor-advance inserts land as contiguous rows at ONE tie-break slot
+    — one shift-by-K + K masked fills; padding rows (len 0) born dead."""
+    from .oppack import RUN_K
+
+    vis, vlen, cum = view
+    c = st["length"].shape[-1]
+    lane = _lane_iota(st["length"].shape)
+    in_run = cum == op["pos1"]
+    tomb = st["rem_seq"] <= op["ref_seq"]
+    acked_ins = st["ins_seq"] != DEV_UNASSIGNED
+    stop = in_run & (vis | (~tomb & acked_ins) | (lane >= st["count"]))
+    found = _any_lane(stop)
+    bad = enabled & ~found
+    enabled = enabled & found
+    slot = _first_true(stop, c)
+    g = _shift_right(st, (lane >= slot) & enabled, k_slots, a_slots, roll,
+                     by=RUN_K)
+    g["count"] = st["count"] + enabled.astype(jnp.int32) * RUN_K
+    rel = lane - slot
+    here = enabled & (rel >= 0) & (rel < RUN_K)
+
+    def pick(prefix, pad):
+        out = jnp.full_like(st["length"], pad)
+        for k_i in range(RUN_K):
+            out = jnp.where(rel == k_i, op[f"{prefix}{k_i}"], out)
+        return out
+
+    row_len = pick("rl", 0)
+    row_seq = pick("rs", 0)
+    row_id = pick("ri", -1)
+    live = here & (row_len > 0)
+    dead = here & (row_len == 0)
+    g["length"] = jnp.where(here, row_len, g["length"])
+    g["ins_seq"] = jnp.where(live, row_seq,
+                             jnp.where(dead, 0, g["ins_seq"]))
+    g["ins_client"] = jnp.where(live, op["client"],
+                                jnp.where(dead, -1, g["ins_client"]))
+    g["local_seq"] = jnp.where(here, 0, g["local_seq"])
+    g["rem_seq"] = jnp.where(live, DEV_NO_REMOVE,
+                             jnp.where(dead, 0, g["rem_seq"]))
+    g["rem_local_seq"] = jnp.where(here, 0, g["rem_local_seq"])
+    g["origin_op"] = jnp.where(here, row_id, g["origin_op"])
     g["origin_off"] = jnp.where(here, 0, g["origin_off"])
     for i in range(k_slots):
         g[f"rc{i}"] = jnp.where(here, -1, g[f"rc{i}"])
@@ -243,18 +296,23 @@ def _ack_phase(st, op):
     return g
 
 
-def _apply_one_batched(st, op, k_slots, a_slots, roll):
+def _apply_one_batched(st, op, k_slots, a_slots, roll, with_runs=False):
     """kernel.apply_one with a leading doc axis; op fields are [B, 1]."""
+    from .oppack import RUN_K
+
     kind = op["kind"]
+    is_run = (kind == OpKind.INSERT_RUN) if with_runs else False
     is_edit = (kind == OpKind.INSERT) | (kind == OpKind.REMOVE) | \
-        (kind == OpKind.ANNOTATE)
+        (kind == OpKind.ANNOTATE) | is_run
     is_range = (kind == OpKind.REMOVE) | (kind == OpKind.ANNOTATE)
     c = st["length"].shape[-1]
-    fits = st["count"] + 2 <= c
+    need = jnp.where(is_run, RUN_K + 1, 2) if with_runs else 2
+    fits = st["count"] + need <= c
     st = dict(st)
     st["overflow"] = st["overflow"] | (is_edit & ~fits)
     is_edit = is_edit & fits
     is_range = is_range & fits
+    is_run = is_run & fits
 
     r, cl = op["ref_seq"], op["client"]
     s1 = _ensure_boundary(st, op["pos1"], r, cl, is_edit, k_slots, a_slots,
@@ -264,6 +322,9 @@ def _apply_one_batched(st, op, k_slots, a_slots, roll):
     view2 = _visibility(s2, r, cl, k_slots, roll)
     s_ins = _insert_phase(s2, op, is_edit & (kind == OpKind.INSERT), view2,
                           k_slots, a_slots, roll)
+    if with_runs:
+        s_ins = _insert_run_phase(s_ins, op, is_run, view2, k_slots,
+                                  a_slots, roll)
     s_rem = _remove_phase(s_ins, op, is_range & (kind == OpKind.REMOVE),
                           view2, k_slots, roll)
     s_ann = _annotate_phase(s_rem, op, is_range & (kind == OpKind.ANNOTATE),
@@ -344,18 +405,24 @@ def apply_ops_fused_ref(state: DocState, ops: PackedOps) -> DocState:
     return _from_planes(out, k, a)
 
 
-def _kernel(n_state: int, k: int, a: int, names, op3d: bool):
+def _kernel(n_state: int, k: int, a: int, names, op3d: bool,
+            op_fields=None):
     """Grid = (doc_tiles, T). The state planes' block index is constant in
     t, so Mosaic keeps them VMEM-resident across the whole op stream
     (revisited-block accumulator pattern); each grid step applies ONE op
-    whose scalars arrive as [TILE, 1] blocks — no dynamic slicing."""
+    whose scalars arrive as [TILE, 1] blocks — no dynamic slicing.
+
+    op_fields extends the per-step scalars with the INSERT_RUN sub
+    columns (rl*/rs*/ri*) when run packing is active."""
+    op_fields = tuple(op_fields) if op_fields is not None else _OP_FIELDS
+    with_runs = len(op_fields) > len(_OP_FIELDS)
 
     def kern(*refs):
         from jax.experimental import pallas as pl
         from jax.experimental.pallas import tpu as pltpu
 
-        in_refs = refs[:n_state + len(_OP_FIELDS)]
-        out_refs = refs[n_state + len(_OP_FIELDS):]
+        in_refs = refs[:n_state + len(op_fields)]
+        out_refs = refs[n_state + len(op_fields):]
         t = pl.program_id(1)
 
         # The output VMEM window is NOT loaded from HBM on first visit —
@@ -375,19 +442,21 @@ def _kernel(n_state: int, k: int, a: int, names, op3d: bool):
         # dim is not a legal block shape, but full-array dims always are).
         if op3d:
             op = {f: jnp.transpose(in_refs[n_state + i][0, pl.ds(t, 1), :])
-                  for i, f in enumerate(_OP_FIELDS)}
+                  for i, f in enumerate(op_fields)}
         else:
             op = {f: jnp.transpose(in_refs[n_state + i][pl.ds(t, 1), :])
-                  for i, f in enumerate(_OP_FIELDS)}
+                  for i, f in enumerate(op_fields)}
         out = _apply_one_batched(st, op, k, a,
-                                 lambda x, n: pltpu.roll(x, n, 1))
+                                 lambda x, n: pltpu.roll(x, n, 1),
+                                 with_runs=with_runs)
         for i, name in enumerate(names):
             out_refs[i][:] = out[name]
     return kern
 
 
 def apply_ops_fused_pallas(state: DocState, ops: PackedOps,
-                           interpret: bool = False) -> DocState:
+                           interpret: bool = False,
+                           runs=None) -> DocState:
     from jax.experimental import pallas as pl
 
     st, k, a = _to_planes(state)
@@ -402,6 +471,17 @@ def apply_ops_fused_pallas(state: DocState, ops: PackedOps,
         return jnp.pad(x, ((0, pad), (0, 0))) if pad else x
 
     st_in = [pad_rows(st[name]) for name in names]
+    # INSERT_RUN sub columns ride as extra per-step op scalars.
+    op_cols = {f: getattr(ops, f) for f in _OP_FIELDS}
+    op_fields = list(_OP_FIELDS)
+    if runs is not None:
+        from .oppack import RUN_K
+        for prefix, arr in (("rl", runs.length), ("rs", runs.seq),
+                            ("ri", runs.op_id)):
+            for k_i in range(RUN_K):
+                name = f"{prefix}{k_i}"
+                op_fields.append(name)
+                op_cols[name] = arr[..., k_i]
     op3d = tile < DOC_TILE
     if op3d:
         # [B, T] -> [n_tiles, T_pad, tile]: both trailing block dims equal
@@ -409,13 +489,13 @@ def apply_ops_fused_pallas(state: DocState, ops: PackedOps,
         n_tiles = padded // tile
         t_pad = ((t_steps + 7) // 8) * 8
         op_in = [
-            jnp.pad(pad_rows(getattr(ops, f)),
+            jnp.pad(pad_rows(op_cols[f]),
                     ((0, 0), (0, t_pad - t_steps)))
             .reshape(n_tiles, tile, t_pad).transpose(0, 2, 1)
-            for f in _OP_FIELDS]
+            for f in op_fields]
         op_block = pl.BlockSpec((1, t_pad, tile), lambda i, t: (i, 0, 0))
     else:
-        op_in = [pad_rows(getattr(ops, f)).T for f in _OP_FIELDS]  # [T, B]
+        op_in = [pad_rows(op_cols[f]).T for f in op_fields]  # [T, B]
         op_block = pl.BlockSpec((t_steps, tile), lambda i, t: (0, i))
 
     def state_block(cols):
@@ -425,7 +505,7 @@ def apply_ops_fused_pallas(state: DocState, ops: PackedOps,
     out_shapes = [jax.ShapeDtypeStruct((padded, x.shape[1]), x.dtype)
                   for x in st_in]
     outs = pl.pallas_call(
-        _kernel(len(names), k, a, names, op3d),
+        _kernel(len(names), k, a, names, op3d, op_fields),
         out_shape=out_shapes,
         grid=grid,
         in_specs=[state_block(x.shape[1]) for x in st_in]
@@ -458,6 +538,40 @@ def fused_available() -> bool:
         except Exception:  # noqa: BLE001 — any Mosaic failure => fallback
             _FUSED_OK = False
     return _FUSED_OK
+
+
+_FUSED_RUNS_OK = None
+
+
+def fused_runs_available() -> bool:
+    """Probe the INSERT_RUN variant separately (its Mosaic lowering adds
+    the shift-by-K and the K-term pick selects)."""
+    global _FUSED_RUNS_OK
+    if _FUSED_RUNS_OK is None:
+        try:
+            from .oppack import (HostOp, RUN_K, RunCols, RunSlot,
+                                 pack_slots)
+            from .state import make_state
+
+            if not fused_available():
+                _FUSED_RUNS_OK = False
+                return False
+            tiny = make_state(16, 1, batch=1)
+            members = tuple(
+                HostOp(kind=OpKind.INSERT, seq=i + 1, ref_seq=0, client=0,
+                       pos1=i, op_id=i, new_len=1)
+                for i in range(5))
+            packed, runs = pack_slots([RunSlot(members)])
+            batched = packed._replace(**{f: getattr(packed, f)[None]
+                                         for f in packed._fields})
+            bruns = RunCols(length=runs.length[None], seq=runs.seq[None],
+                            op_id=runs.op_id[None])
+            out = apply_ops_fused_pallas(tiny, batched, runs=bruns)
+            jax.block_until_ready(out.length)
+            _FUSED_RUNS_OK = int(jax.device_get(out.count)[0]) == RUN_K
+        except Exception:  # noqa: BLE001 — any Mosaic failure => fallback
+            _FUSED_RUNS_OK = False
+    return _FUSED_RUNS_OK
 
 
 def apply_ops_fused(state: DocState, ops: PackedOps) -> DocState:

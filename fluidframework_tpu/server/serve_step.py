@@ -92,14 +92,20 @@ def serve_window(tstate, ticket_cols, merge_states, merge_cols,
             msn=jnp.where(ok, msn_g, 0))
         from ..mergetree.pallas_apply import (FUSED_MAX_CAPACITY,
                                              apply_ops_fused_pallas)
+        use_fused = fused and mstate.capacity <= FUSED_MAX_CAPACITY
         if runs is not None:
-            # The fused Mosaic kernel has no run phase (yet): run-bearing
-            # buckets take the scan kernel, whose per-step cost the
-            # packing itself collapses.
-            out = kernel._scan_ops(mstate, ops2, batched=True, runs=runs)
+            # Run-bearing buckets: the fused kernel's INSERT_RUN variant
+            # when Mosaic lowers it (fused == "both probes passed", see
+            # tpu_sequencer), else the scan kernel — whose per-step cost
+            # the packing itself collapses.
+            if use_fused:
+                out = apply_ops_fused_pallas(mstate, ops2, runs=runs)
+            else:
+                out = kernel._scan_ops(mstate, ops2, batched=True,
+                                       runs=runs)
             out = out._replace(overflow=out.overflow | over_extra)
             new_merge.append(out)
-        elif fused and mstate.capacity <= FUSED_MAX_CAPACITY:
+        elif use_fused:
             # VMEM-resident fused apply: the bucket's lane block stays
             # on-core across the whole op stream — the T-step HBM
             # re-read/re-write of the scan kernel (the serving apply's

@@ -1959,6 +1959,19 @@ class TpuSequencerLambda(IPartitionLambda):
         cols = parsed.cols
         B = self.lanes
 
+        if self._fused_serve is None:
+            from ..mergetree.pallas_apply import (fused_available,
+                                                 fused_runs_available)
+            import jax as _jax
+            base = (_jax.default_backend() in ("tpu", "axon")
+                    and fused_available())
+            if base and self.pack_runs and not fused_runs_available():
+                # The INSERT_RUN Mosaic variant failed to lower on this
+                # backend: keep the fused kernel (the round-3 lever) and
+                # drop packing rather than forfeit fused for scan+runs.
+                self.pack_runs = False
+            self._fused_serve = base
+
         ticket_cols = np.zeros((4, B, T), np.int32)
         ticket_cols[1] = -1
         ticket_cols[0, lanes, slot] = cols[P.KIND, rows]
@@ -1971,25 +1984,36 @@ class TpuSequencerLambda(IPartitionLambda):
         lww_jobs = self._build_lww(parsed, rows, lanes, slot,
                                    vbase, lchan_ok, lchan_b, lchan_l)
 
-        if self._fused_serve is None:
-            from ..mergetree.pallas_apply import fused_available
-            import jax as _jax
-            self._fused_serve = (_jax.default_backend() in ("tpu", "axon")
-                                 and fused_available())
         # ONE fused device program for the whole window (every extra
         # dispatch is a serialized tunnel RPC), then ONE host sync of the
         # narrow int16 result (msn32_dev is fetched only on the rare
         # msn-span overflow).
-        (self.tstate, new_merge, new_lww, flat_dev,
-         msn32_dev) = serve_step.serve_window(
-            self.tstate, self._place_cols(ticket_cols),
-            [self.merge.buckets[j["bucket"]].state for j in merge_jobs],
-            [self._place_cols(j["cols"]) for j in merge_jobs],
-            [self.lww.buckets[j["bucket"]].state for j in lww_jobs],
-            [self._place_cols(j["cols"]) for j in lww_jobs],
-            self._fused_serve,
-            [None if j["runs"] is None else self._place_cols(j["runs"])
-             for j in merge_jobs])
+        def dispatch(fused):
+            return serve_step.serve_window(
+                self.tstate, self._place_cols(ticket_cols),
+                [self.merge.buckets[j["bucket"]].state
+                 for j in merge_jobs],
+                [self._place_cols(j["cols"]) for j in merge_jobs],
+                [self.lww.buckets[j["bucket"]].state for j in lww_jobs],
+                [self._place_cols(j["cols"]) for j in lww_jobs],
+                fused,
+                [None if j["runs"] is None else self._place_cols(j["runs"])
+                 for j in merge_jobs])
+
+        try:
+            (self.tstate, new_merge, new_lww, flat_dev,
+             msn32_dev) = dispatch(self._fused_serve)
+        except Exception:
+            if not self._fused_serve:
+                raise
+            # Mosaic lowering failed at THIS production shape (the small
+            # probe passed — e.g. the runs variant's 24 extra op columns
+            # blew the VMEM budget at a large (capacity, T)): degrade to
+            # the scan path permanently and retry the window. Lowering
+            # fails before execution, so the donated buffers are intact.
+            self._fused_serve = False
+            (self.tstate, new_merge, new_lww, flat_dev,
+             msn32_dev) = dispatch(False)
         for j, post in zip(merge_jobs, new_merge):
             j["post"] = post
             self.merge.buckets[j["bucket"]].state = post

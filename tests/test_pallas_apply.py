@@ -110,3 +110,56 @@ class TestFusedAnnotateRing:
         fused = pallas_apply.apply_ops_fused_ref(
             make_state(256, 1, batch=1), packed)
         assert_states_equal(ref, fused)
+
+
+class TestFusedInsertRun:
+    def test_interpret_run_variant_matches_scan_with_runs(self):
+        """The Mosaic INSERT_RUN variant (fused kernel + run sub-columns)
+        is bit-identical to the scan kernel with the same RunCols."""
+        import numpy as np
+
+        from fluidframework_tpu.mergetree import kernel
+        from fluidframework_tpu.mergetree.catchup import wire_to_host_ops
+        from fluidframework_tpu.mergetree.host import (OpBuilder,
+                                                       PayloadTable)
+        from fluidframework_tpu.mergetree.oppack import (RunCols,
+                                                         pack_run_slots,
+                                                         pack_slots)
+        from fluidframework_tpu.mergetree.pallas_apply import (
+            apply_ops_fused_pallas)
+        from fluidframework_tpu.mergetree.state import make_state
+        from fluidframework_tpu.testing.traces import keystroke_trace
+
+        docs = []
+        t_max = 0
+        for d in range(4):
+            tail = keystroke_trace(60, seed=300 + d)
+            builder = OpBuilder(PayloadTable())
+            ops = []
+            for op, s, r, c, m in tail:
+                ops.extend(wire_to_host_ops(builder, op, s, r, c, m))
+            slots = pack_run_slots(ops, base_seq=0)
+            docs.append(slots)
+            t_max = max(t_max, len(slots))
+        packed_all, runs_all = [], []
+        for slots in docs:
+            p, rn = pack_slots(slots, steps=t_max)
+            packed_all.append(p)
+            runs_all.append(rn)
+        import jax.numpy as jnp
+        packed = type(packed_all[0])(*[
+            jnp.stack([getattr(p, f) for p in packed_all])
+            for f in packed_all[0]._fields])
+        runs = RunCols(*[jnp.stack([getattr(r, f) for r in runs_all])
+                         for f in RunCols._fields])
+        state_a = make_state(512, 4, batch=len(docs))
+        state_b = make_state(512, 4, batch=len(docs))
+        out_scan = kernel._scan_ops(state_a, packed, batched=True,
+                                    runs=runs)
+        out_fused = apply_ops_fused_pallas(state_b, packed,
+                                           interpret=True, runs=runs)
+        for f in ("length", "ins_seq", "ins_client", "rem_seq",
+                  "origin_op", "origin_off", "count", "anno"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(out_scan, f)),
+                np.asarray(getattr(out_fused, f)), err_msg=f)

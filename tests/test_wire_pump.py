@@ -715,3 +715,56 @@ class TestServingRunPacking:
         A, B, emits, nacks = run_both([(doc, Boxcar("t", doc, "c0",
                                                     msgs))])
         assert_equivalent(A, B, emits, nacks, [(doc, "s", "t")])
+
+
+class TestFusedRunsServeConformance:
+    def test_fused_runs_window_matches_scan(self, monkeypatch):
+        """serve_window with fused=True AND run-packed bursts: the Mosaic
+        INSERT_RUN variant path (interpret mode on CPU) must match the
+        scan+runs path message-for-message and byte-for-byte."""
+        import functools
+
+        import jax
+
+        from fluidframework_tpu.mergetree import pallas_apply
+
+        if jax.default_backend() not in ("tpu", "axon"):
+            monkeypatch.setattr(
+                pallas_apply, "apply_ops_fused_pallas",
+                functools.partial(pallas_apply.apply_ops_fused_pallas,
+                                  interpret=True))
+
+        def burst(doc, cid, k=11, prepend=False):
+            msgs = [_join(cid)]
+            pos = 0
+            for i in range(1, k + 1):
+                text = chr(96 + i)
+                msgs.append(DocumentMessage(
+                    client_sequence_number=i,
+                    reference_sequence_number=0,
+                    type=MessageType.OPERATION,
+                    contents={"address": "s", "contents": {
+                        "address": "t", "contents": {
+                            "type": OP_INSERT, "pos1": pos,
+                            "seg": {"text": text}}}}))
+                if not prepend:
+                    pos += len(text)
+            return (doc, Boxcar("t", doc, cid, msgs))
+
+        traffic = [burst("d0", "c0"), burst("d1", "c1", prepend=True)]
+        ea, na, eb, nb = [], [], [], []
+        A = _lam(lambda d, m: ea.append(_emit_key(d, m)),
+                 lambda d, c, n: na.append((d, c, n.content.code)))
+        B = _lam(lambda d, m: eb.append(_emit_key(d, m)),
+                 lambda d, c, n: nb.append((d, c, n.content.code)))
+        A._fused_serve = False   # scan + runs
+        B._fused_serve = True    # fused runs variant + runs
+        for i, (doc, box) in enumerate(traffic):
+            A.handler_raw(_qm(i, doc, box, raw=True))
+            B.handler_raw(_qm(i, doc, box, raw=True))
+        A.flush()
+        B.flush()
+        A.drain()
+        B.drain()
+        assert_equivalent(A, B, (ea, eb), (na, nb),
+                          [("d0", "s", "t"), ("d1", "s", "t")])
