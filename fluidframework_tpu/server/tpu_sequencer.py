@@ -2003,17 +2003,45 @@ class TpuSequencerLambda(IPartitionLambda):
         try:
             (self.tstate, new_merge, new_lww, flat_dev,
              msn32_dev) = dispatch(self._fused_serve)
-        except Exception:
+        except Exception as err:  # noqa: BLE001 — degrade, never crash
             if not self._fused_serve:
                 raise
-            # Mosaic lowering failed at THIS production shape (the small
+            # The fused path failed at THIS production shape (the small
             # probe passed — e.g. the runs variant's 24 extra op columns
-            # blew the VMEM budget at a large (capacity, T)): degrade to
-            # the scan path permanently and retry the window. Lowering
-            # fails before execution, so the donated buffers are intact.
-            self._fused_serve = False
-            (self.tstate, new_merge, new_lww, flat_dev,
-             msn32_dev) = dispatch(False)
+            # blew the VMEM budget at a large (capacity, T)). Failures
+            # happen at lowering, before execution, so the donated
+            # buffers are intact. Degrade in probe-policy order: if this
+            # window carries runs, drop PACKING (keep the fused kernel
+            # for plain buckets) and re-stage; else forfeit fused. Either
+            # way, log loudly — a silent degrade would hide both a
+            # Mosaic regression and the perf cliff.
+            import logging
+            had_runs = any(j["runs"] is not None for j in merge_jobs)
+            if had_runs and self.pack_runs:
+                self.pack_runs = False
+                logging.getLogger(__name__).warning(
+                    "fused INSERT_RUN variant failed at a production "
+                    "shape; disabling run packing (%r)", err)
+                merge_jobs = self._build_merge(parsed, rows, lanes, slot,
+                                               mbase, chan_ok, chan_b,
+                                               chan_l)
+                try:
+                    (self.tstate, new_merge, new_lww, flat_dev,
+                     msn32_dev) = dispatch(self._fused_serve)
+                except Exception as err2:  # noqa: BLE001
+                    self._fused_serve = False
+                    logging.getLogger(__name__).warning(
+                        "fused serving failed without runs too; scan "
+                        "path from now on (%r)", err2)
+                    (self.tstate, new_merge, new_lww, flat_dev,
+                     msn32_dev) = dispatch(False)
+            else:
+                self._fused_serve = False
+                logging.getLogger(__name__).warning(
+                    "fused serving apply failed; scan path from now on "
+                    "(%r)", err)
+                (self.tstate, new_merge, new_lww, flat_dev,
+                 msn32_dev) = dispatch(False)
         for j, post in zip(merge_jobs, new_merge):
             j["post"] = post
             self.merge.buckets[j["bucket"]].state = post

@@ -107,9 +107,21 @@ class TestMultiProcessPipeline:
             op_rows = [r for r in rows
                        if r["type"] == MessageType.OPERATION]
             assert [r["contents"]["n"] for r in op_rows] == [1, 2, 3, 4, 5]
-            # Copier persisted the raw (pre-sequencing) stream too.
+            # Copier persisted the raw (pre-sequencing) stream too. It
+            # runs as its own consumer group and can lag the deltas
+            # check under load: poll within the same deadline instead of
+            # asserting a snapshot (observed ~1-in-10 full-suite flake).
             raw = db.collection("rawdeltas")
-            assert len(raw) >= 6
+            # Fresh grace window: the deltas poll above may have consumed
+            # most of the shared deadline under exactly the load that
+            # makes the copier lag.
+            deadline = max(deadline, time.time() + 30)
+            while time.time() < deadline and len(raw) < 6:
+                if worker.poll() is not None:
+                    raise AssertionError(
+                        worker.stdout.read().decode()[-2000:])
+                time.sleep(0.2)
+            assert len(raw) >= 6, f"only {len(raw)} raw messages copied"
         finally:
             for p in procs:
                 p.terminate()
