@@ -463,6 +463,7 @@ def _init_backend_or_fallback():
 
 
 def main() -> None:
+    bench_t0 = time.perf_counter()
     n_docs = int(os.environ.get("BENCH_DOCS", "10000"))
     n_ops = int(os.environ.get("BENCH_OPS", "100"))
     capacity = int(os.environ.get("BENCH_CAPACITY", "256"))
@@ -630,10 +631,23 @@ def main() -> None:
     # trace, matrix op storm, concurrent directory merges.
     workload_extras = {}
     if os.environ.get("BENCH_CONFIGS", "1") != "0":
-        workload_extras.update(_keystroke_batch_rate(step))
-        workload_extras.update(_singledoc_trace_rate())
-        workload_extras.update(_matrix_storm_rate())
-        workload_extras.update(_directory_merge_rate())
+        # Soft deadline: a cold compile cache can make the optional
+        # workload configs slow on a first on-chip run; the core metrics
+        # above must land in the JSON even if the driver's own timeout
+        # looms, so later extras are skipped (with a marker) once the
+        # budget is spent rather than risking a timeout kill that emits
+        # NOTHING (round-1 failure mode).
+        soft_deadline = bench_t0 + float(
+            os.environ.get("BENCH_DEADLINE_S", "1200"))
+        for name, call in (
+                ("keystroke_batch", lambda: _keystroke_batch_rate(step)),
+                ("singledoc_trace", _singledoc_trace_rate),
+                ("matrix_storm", _matrix_storm_rate),
+                ("directory_merge", _directory_merge_rate)):
+            if time.perf_counter() > soft_deadline:
+                workload_extras[f"{name}_skipped"] = "bench soft deadline"
+                continue
+            workload_extras.update(call())
     result = {
         "metric": "merge-tree ops applied/sec across "
                   f"{n_docs} docs (ticket+apply+summary-len)",
