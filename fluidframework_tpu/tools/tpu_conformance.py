@@ -89,6 +89,77 @@ def check(b: int, t: int, cap: int, seed: int) -> bool:
     return ok
 
 
+def check_runs(b: int, t_ops: int, cap: int, seed: int) -> bool:
+    """INSERT_RUN Mosaic conformance: pack typing-burst streams and
+    compare the fused runs variant against the scan kernel WITH the same
+    RunCols — the packed apply itself differential-checked on chip."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fluidframework_tpu.mergetree import kernel
+    from fluidframework_tpu.mergetree.oppack import (HostOp, OpKind,
+                                                     RunCols,
+                                                     pack_run_slots,
+                                                     pack_slots)
+    from fluidframework_tpu.mergetree.pallas_apply import (
+        apply_ops_fused_pallas)
+    from fluidframework_tpu.mergetree.state import make_state
+
+    rng = random.Random(seed)
+    docs = []
+    for d in range(b):
+        ops, length, seq = [], 0, 0
+        while len(ops) < t_ops:
+            if rng.random() < 0.7:  # typing burst, frozen ref
+                ref = seq
+                pos = rng.randrange(length + 1) if length else 0
+                for _ in range(rng.randrange(3, 12)):
+                    seq += 1
+                    ops.append(HostOp(kind=OpKind.INSERT, seq=seq,
+                                      ref_seq=ref, client=1, pos1=pos,
+                                      op_id=len(ops), new_len=1))
+                    pos += 1
+                    length += 1
+            elif length > 4:
+                seq += 1
+                a = rng.randrange(length - 2)
+                ops.append(HostOp(kind=OpKind.REMOVE, seq=seq,
+                                  ref_seq=seq - 1, client=1, pos1=a,
+                                  pos2=a + 1, op_id=len(ops)))
+                length -= 1
+            else:
+                seq += 1
+                ops.append(HostOp(kind=OpKind.INSERT, seq=seq,
+                                  ref_seq=seq - 1, client=1, pos1=0,
+                                  op_id=len(ops), new_len=2))
+                length += 2
+        docs.append(pack_run_slots(ops[:t_ops], base_seq=0))
+    t_slots = max(len(s) for s in docs)
+    packed_l, runs_l = zip(*(pack_slots(s, steps=t_slots) for s in docs))
+    packed = type(packed_l[0])(*[
+        jnp.stack([getattr(p, f) for p in packed_l])
+        for f in packed_l[0]._fields])
+    runs = RunCols(*[jnp.stack([getattr(r, f) for r in runs_l])
+                     for f in RunCols._fields])
+    packed, runs = jax.device_put((packed, runs))
+
+    out_scan = kernel._scan_ops(jax.device_put(make_state(cap, 2, batch=b)),
+                                packed, batched=True, runs=runs)
+    out_fused = apply_ops_fused_pallas(
+        jax.device_put(make_state(cap, 2, batch=b)), packed, runs=runs)
+    ok = True
+    for f in out_scan._fields:
+        a = np.asarray(jax.device_get(getattr(out_scan, f)))
+        c = np.asarray(jax.device_get(getattr(out_fused, f)))
+        if not (a == c).all():
+            print(f"  RUNS MISMATCH in {f} (b={b} t={t_ops} cap={cap} "
+                  f"seed={seed})")
+            ok = False
+    print(f"  runs b={b} t={t_ops} cap={cap}: {'OK' if ok else 'FAIL'}")
+    return ok
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--heavy", action="store_true")
@@ -110,6 +181,16 @@ def main() -> int:
     if args.heavy:
         shapes.append((512, 128, 1024, 3))   # narrow-tile 3-D op path
     results = [check(*s) for s in shapes]  # run EVERY shape
+    # INSERT_RUN Mosaic variant (round 4): probe, then differential.
+    from fluidframework_tpu.mergetree.pallas_apply import (
+        fused_runs_available)
+    if fused_runs_available():
+        results.append(check_runs(256, 64, 256, 7))
+        if args.heavy:
+            results.append(check_runs(512, 96, 512, 8))
+    else:
+        print("fused INSERT_RUN variant failed its probe on this backend "
+              "(serving will pack on the scan path)")
     ok = all(results)
     print("CONFORMANCE", "OK" if ok else "FAILED")
     return 0 if ok else 1
