@@ -22,7 +22,7 @@ Dispatch + runtime probe mirror pallas_ops.summary_lengths.
 from __future__ import annotations
 
 import functools
-from typing import Dict
+from typing import Callable, Dict, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -52,40 +52,71 @@ def tile_for_capacity(capacity: int) -> int:
 
 
 # ---------------------------------------------------------------------------
-# the batched body (pure jnp on [B, C] planes; `roll` injected per mode)
+# the batched body (pure jnp on [B, C] planes; lane primitives injected
+# per mode via a Lanes context)
 # ---------------------------------------------------------------------------
 
-def _lane_iota(shape):
+class Lanes(NamedTuple):
+    """The lane-axis primitives the batched body is written against.
+
+    Everything the fused formulation does along the segment axis funnels
+    through these seven operations, so swapping the context retargets the
+    SAME body: local jnp (reference), Pallas/Mosaic (TPU VMEM kernel), a
+    GSPMD two-level scan (sp-sharded capacity under jit), or shard_map
+    collectives (fused_sp.py — per-shard lane tiles with explicit
+    cross-shard exchange). `total` is the GLOBAL lane count: `iota`
+    returns global lane indices and `first_true` uses `total` as its
+    no-match sentinel, so per-doc scalars (count/slot/...) stay global
+    under every context."""
+
+    total: int
+    iota: Callable        # (local_shape) -> global lane indices
+    any_lane: Callable    # (mask[B, Cl]) -> bool [B, 1] (global any)
+    first_true: Callable  # (mask[B, Cl]) -> first global lane, else total
+    masked_scalar: Callable  # (values, mask) -> global masked sum [B, 1]
+    cumsum_excl: Callable    # exclusive prefix sum along global lanes
+    roll: Callable           # cyclic shift along the global lane axis
+    roll_many: Callable      # ([arrays], n) -> batched roll (one exchange)
+
+
+def _local_iota(shape):
     return jax.lax.broadcasted_iota(jnp.int32, shape, 1)
 
 
-def _any_lane(mask):
-    return jnp.sum(mask.astype(jnp.int32), axis=1, keepdims=True) > 0
+def local_lanes(total: int, roll) -> Lanes:
+    """Single-shard context: the full lane axis is resident (jnp driver
+    and the Pallas kernel, which injects pltpu.roll)."""
+
+    def cumsum_excl(x):
+        # Hillis-Steele doubling: log2(C) shift+adds, Mosaic-friendly
+        # (jnp.cumsum does not lower on the lane axis in the kernel).
+        lane = _local_iota(x.shape)
+        t = x
+        k = 1
+        while k < total:
+            t = t + jnp.where(lane >= k, roll(t, k), 0)
+            k *= 2
+        return t - x
+
+    return Lanes(
+        total=total,
+        iota=_local_iota,
+        any_lane=lambda m: jnp.sum(m.astype(jnp.int32), axis=1,
+                                   keepdims=True) > 0,
+        first_true=lambda m: jnp.min(
+            jnp.where(m, _local_iota(m.shape), total), axis=1,
+            keepdims=True),
+        masked_scalar=lambda v, m: jnp.sum(jnp.where(m, v, 0), axis=1,
+                                           keepdims=True),
+        cumsum_excl=cumsum_excl,
+        roll=roll,
+        roll_many=lambda xs, n: [roll(x, n) for x in xs],
+    )
 
 
-def _first_true(mask, c):
-    idx = _lane_iota(mask.shape)
-    return jnp.min(jnp.where(mask, idx, c), axis=1, keepdims=True)
-
-
-def _masked_scalar(values, mask):
-    return jnp.sum(jnp.where(mask, values, 0), axis=1, keepdims=True)
-
-
-def _cumsum_excl(x, roll):
-    """Exclusive prefix sum along lanes: Hillis-Steele doubling."""
-    c = x.shape[-1]
-    lane = _lane_iota(x.shape)
-    total = x
-    k = 1
-    while k < c:
-        total = total + jnp.where(lane >= k, roll(total, k), 0)
-        k *= 2
-    return total - x
-
-
-def _visibility(st: Dict[str, jnp.ndarray], ref, client, k_slots, roll):
-    lane = _lane_iota(st["length"].shape)
+def _visibility(st: Dict[str, jnp.ndarray], ref, client, k_slots,
+                ln: Lanes):
+    lane = ln.iota(st["length"].shape)
     valid = lane < st["count"]
     inserted = (st["ins_seq"] <= ref) | (st["ins_client"] == client)
     removed = st["rem_seq"] <= ref
@@ -93,31 +124,33 @@ def _visibility(st: Dict[str, jnp.ndarray], ref, client, k_slots, roll):
         removed = removed | (st[f"rc{i}"] == client)
     vis = valid & inserted & ~removed
     vlen = jnp.where(vis, st["length"], 0)
-    return vis, vlen, _cumsum_excl(vlen, roll)
+    return vis, vlen, ln.cumsum_excl(vlen)
 
 
 _SEG_PLANES = ("length", "ins_seq", "ins_client", "local_seq", "rem_seq",
                "rem_local_seq", "origin_op", "origin_off")
 
 
-def _shift_right(st, shift_mask, k_slots, a_slots, roll, by: int = 1):
+def _shift_right(st, shift_mask, k_slots, a_slots, ln: Lanes, by: int = 1):
+    names = _SEG_PLANES + tuple(f"rc{i}" for i in range(k_slots)) + \
+        tuple(f"an{i}" for i in range(a_slots))
+    rolled = ln.roll_many([st[name] for name in names], by)
     out = dict(st)
-    for name in _SEG_PLANES + tuple(f"rc{i}" for i in range(k_slots)) + \
-            tuple(f"an{i}" for i in range(a_slots)):
-        out[name] = jnp.where(shift_mask, roll(st[name], by), st[name])
+    for name, r in zip(names, rolled):
+        out[name] = jnp.where(shift_mask, r, st[name])
     return out
 
 
-def _ensure_boundary(st, pos, ref, client, enabled, k_slots, a_slots, roll):
-    vis, vlen, cum = _visibility(st, ref, client, k_slots, roll)
+def _ensure_boundary(st, pos, ref, client, enabled, k_slots, a_slots,
+                     ln: Lanes):
+    vis, vlen, cum = _visibility(st, ref, client, k_slots, ln)
     inside = vis & (cum < pos) & (pos < cum + vlen)
-    do = enabled & _any_lane(inside)
-    c = st["length"].shape[-1]
-    slot = _first_true(inside, c)
-    off = pos - _masked_scalar(cum, inside)
-    parent_len = _masked_scalar(st["length"], inside)
-    lane = _lane_iota(st["length"].shape)
-    g = _shift_right(st, (lane >= slot + 1) & do, k_slots, a_slots, roll)
+    do = enabled & ln.any_lane(inside)
+    slot = ln.first_true(inside)
+    off = pos - ln.masked_scalar(cum, inside)
+    parent_len = ln.masked_scalar(st["length"], inside)
+    lane = ln.iota(st["length"].shape)
+    g = _shift_right(st, (lane >= slot + 1) & do, k_slots, a_slots, ln)
     g["count"] = st["count"] + do.astype(jnp.int32)
     is_left = do & (lane == slot)
     is_right = do & (lane == slot + 1)
@@ -129,21 +162,20 @@ def _ensure_boundary(st, pos, ref, client, enabled, k_slots, a_slots, roll):
     return g
 
 
-def _insert_phase(st, op, enabled, view, k_slots, a_slots, roll):
+def _insert_phase(st, op, enabled, view, k_slots, a_slots, ln: Lanes):
     vis, vlen, cum = view
-    c = st["length"].shape[-1]
-    lane = _lane_iota(st["length"].shape)
+    lane = ln.iota(st["length"].shape)
     is_local = op["seq"] == DEV_UNASSIGNED
     in_run = cum == op["pos1"]
     tomb = st["rem_seq"] <= op["ref_seq"]
     acked_ins = st["ins_seq"] != DEV_UNASSIGNED
     stop = in_run & (vis | (~tomb & (is_local | acked_ins))
                      | (lane >= st["count"]))
-    found = _any_lane(stop)
+    found = ln.any_lane(stop)
     bad = enabled & ~found
     enabled = enabled & found
-    slot = _first_true(stop, c)
-    g = _shift_right(st, (lane >= slot) & enabled, k_slots, a_slots, roll)
+    slot = ln.first_true(stop)
+    g = _shift_right(st, (lane >= slot) & enabled, k_slots, a_slots, ln)
     g["count"] = st["count"] + enabled.astype(jnp.int32)
     here = enabled & (lane == slot)
     g["length"] = jnp.where(here, op["new_len"], g["length"])
@@ -163,24 +195,23 @@ def _insert_phase(st, op, enabled, view, k_slots, a_slots, roll):
     return g
 
 
-def _insert_run_phase(st, op, enabled, view, k_slots, a_slots, roll):
+def _insert_run_phase(st, op, enabled, view, k_slots, a_slots, ln: Lanes):
     """kernel._insert_run_phase on planes: up to RUN_K packed
     cursor-advance inserts land as contiguous rows at ONE tie-break slot
     — one shift-by-K + K masked fills; padding rows (len 0) born dead."""
     from .oppack import RUN_K
 
     vis, vlen, cum = view
-    c = st["length"].shape[-1]
-    lane = _lane_iota(st["length"].shape)
+    lane = ln.iota(st["length"].shape)
     in_run = cum == op["pos1"]
     tomb = st["rem_seq"] <= op["ref_seq"]
     acked_ins = st["ins_seq"] != DEV_UNASSIGNED
     stop = in_run & (vis | (~tomb & acked_ins) | (lane >= st["count"]))
-    found = _any_lane(stop)
+    found = ln.any_lane(stop)
     bad = enabled & ~found
     enabled = enabled & found
-    slot = _first_true(stop, c)
-    g = _shift_right(st, (lane >= slot) & enabled, k_slots, a_slots, roll,
+    slot = ln.first_true(stop)
+    g = _shift_right(st, (lane >= slot) & enabled, k_slots, a_slots, ln,
                      by=RUN_K)
     g["count"] = st["count"] + enabled.astype(jnp.int32) * RUN_K
     rel = lane - slot
@@ -237,7 +268,7 @@ def _append_overlap(st, need, client, k_slots):
     return placed
 
 
-def _remove_phase(st, op, enabled, view, k_slots, roll):
+def _remove_phase(st, op, enabled, view, k_slots, ln: Lanes):
     target = _range_targets(st, op, view) & enabled
     is_local = op["seq"] == DEV_UNASSIGNED
     fresh = target & (st["rem_seq"] == DEV_NO_REMOVE)
@@ -264,15 +295,15 @@ def _remove_phase(st, op, enabled, view, k_slots, roll):
     landed = jnp.zeros_like(already)
     for i in range(k_slots):
         landed = landed | (g3[f"rc{i}"] == want)
-    over = _any_lane((displaced | need) & ~landed)
+    over = ln.any_lane((displaced | need) & ~landed)
     g3["overflow"] = st["overflow"] | over
     return g3
 
 
-def _annotate_phase(st, op, enabled, view, a_slots):
+def _annotate_phase(st, op, enabled, view, a_slots, ln: Lanes):
     target = _range_targets(st, op, view) & enabled
     g = dict(st)
-    over = _any_lane(target & (st[f"an{a_slots - 1}"] != -1))
+    over = ln.any_lane(target & (st[f"an{a_slots - 1}"] != -1))
     for i in range(a_slots - 1, 0, -1):
         g[f"an{i}"] = jnp.where(target, st[f"an{i - 1}"], st[f"an{i}"])
     g["an0"] = jnp.where(target, op["op_id"], st["an0"])
@@ -296,7 +327,8 @@ def _ack_phase(st, op):
     return g
 
 
-def _apply_one_batched(st, op, k_slots, a_slots, roll, with_runs=False):
+def _apply_one_batched(st, op, k_slots, a_slots, ln: Lanes,
+                       with_runs=False):
     """kernel.apply_one with a leading doc axis; op fields are [B, 1]."""
     from .oppack import RUN_K
 
@@ -305,9 +337,8 @@ def _apply_one_batched(st, op, k_slots, a_slots, roll, with_runs=False):
     is_edit = (kind == OpKind.INSERT) | (kind == OpKind.REMOVE) | \
         (kind == OpKind.ANNOTATE) | is_run
     is_range = (kind == OpKind.REMOVE) | (kind == OpKind.ANNOTATE)
-    c = st["length"].shape[-1]
     need = jnp.where(is_run, RUN_K + 1, 2) if with_runs else 2
-    fits = st["count"] + need <= c
+    fits = st["count"] + need <= ln.total
     st = dict(st)
     st["overflow"] = st["overflow"] | (is_edit & ~fits)
     is_edit = is_edit & fits
@@ -316,19 +347,19 @@ def _apply_one_batched(st, op, k_slots, a_slots, roll, with_runs=False):
 
     r, cl = op["ref_seq"], op["client"]
     s1 = _ensure_boundary(st, op["pos1"], r, cl, is_edit, k_slots, a_slots,
-                          roll)
+                          ln)
     s2 = _ensure_boundary(s1, op["pos2"], r, cl, is_range, k_slots, a_slots,
-                          roll)
-    view2 = _visibility(s2, r, cl, k_slots, roll)
+                          ln)
+    view2 = _visibility(s2, r, cl, k_slots, ln)
     s_ins = _insert_phase(s2, op, is_edit & (kind == OpKind.INSERT), view2,
-                          k_slots, a_slots, roll)
+                          k_slots, a_slots, ln)
     if with_runs:
         s_ins = _insert_run_phase(s_ins, op, is_run, view2, k_slots,
-                                  a_slots, roll)
+                                  a_slots, ln)
     s_rem = _remove_phase(s_ins, op, is_range & (kind == OpKind.REMOVE),
-                          view2, k_slots, roll)
+                          view2, k_slots, ln)
     s_ann = _annotate_phase(s_rem, op, is_range & (kind == OpKind.ANNOTATE),
-                            view2, a_slots)
+                            view2, a_slots, ln)
     out = _ack_phase(s_ann, op)
 
     acked = (kind != OpKind.NOOP) & (op["seq"] != DEV_UNASSIGNED)
@@ -344,6 +375,25 @@ def _apply_one_batched(st, op, k_slots, a_slots, roll, with_runs=False):
 # ---------------------------------------------------------------------------
 
 _OP_FIELDS = PackedOps._fields
+
+
+def op_cols(ops: PackedOps, runs):
+    """Flatten PackedOps (+ optional RunCols) into named [..., T] columns:
+    the INSERT_RUN sub columns (rl*/rs*/ri*) ride as extra per-step op
+    scalars. Shared by the Pallas, jnp, and fused-sp drivers so the run
+    layout has exactly one definition."""
+    from .oppack import RUN_K
+
+    fields = list(_OP_FIELDS)
+    cols = {f: getattr(ops, f) for f in _OP_FIELDS}
+    if runs is not None:
+        for prefix, arr in (("rl", runs.length), ("rs", runs.seq),
+                            ("ri", runs.op_id)):
+            for i in range(RUN_K):
+                name = f"{prefix}{i}"
+                fields.append(name)
+                cols[name] = arr[..., i]
+    return fields, cols
 
 
 def _to_planes(state: DocState):
@@ -378,13 +428,14 @@ def _from_planes(st, k, a) -> DocState:
 # drivers
 # ---------------------------------------------------------------------------
 
-def _stream_loop(st, t_steps, get_op, k, a, roll):
+def _stream_loop(st, t_steps, get_op, k, a, ln: Lanes, with_runs=False):
     """Apply all T ops to the resident planes. get_op(t) fetches the op
     scalars as [B, 1] — from a value in the jnp driver, from the VMEM ref
     in the Pallas kernel (Mosaic supports dynamic slicing only on refs)."""
 
     def body(t, carry):
-        return _apply_one_batched(carry, get_op(t), k, a, roll)
+        return _apply_one_batched(carry, get_op(t), k, a, ln,
+                                  with_runs=with_runs)
 
     return jax.lax.fori_loop(0, t_steps, body, st)
 
@@ -400,8 +451,9 @@ def apply_ops_fused_ref(state: DocState, ops: PackedOps) -> DocState:
         return {f: jax.lax.dynamic_slice_in_dim(op_cols[f], t, 1, axis=1)
                 for f in _OP_FIELDS}
 
-    out = _stream_loop(st, ops.kind.shape[-1], get_op, k, a,
-                       lambda x, n: jnp.roll(x, n, axis=1))
+    c = state.length.shape[-1]
+    ln = local_lanes(c, lambda x, n: jnp.roll(x, n, axis=1))
+    out = _stream_loop(st, ops.kind.shape[-1], get_op, k, a, ln)
     return _from_planes(out, k, a)
 
 
@@ -434,6 +486,8 @@ def _kernel(n_state: int, k: int, a: int, names, op3d: bool,
                 out_refs[i][:] = in_refs[i][:]
 
         st = {name: out_refs[i][:] for i, name in enumerate(names)}
+        ln = local_lanes(st["length"].shape[-1],
+                         lambda x, n: pltpu.roll(x, n, 1))
         # Op columns ride transposed (doc axis LAST, resident across t):
         # row t is a sublane slice (lane-dim dynamic slices must be
         # 128-aligned in Mosaic), transposed to the [TILE, 1] per-doc
@@ -446,9 +500,7 @@ def _kernel(n_state: int, k: int, a: int, names, op3d: bool,
         else:
             op = {f: jnp.transpose(in_refs[n_state + i][pl.ds(t, 1), :])
                   for i, f in enumerate(op_fields)}
-        out = _apply_one_batched(st, op, k, a,
-                                 lambda x, n: pltpu.roll(x, n, 1),
-                                 with_runs=with_runs)
+        out = _apply_one_batched(st, op, k, a, ln, with_runs=with_runs)
         for i, name in enumerate(names):
             out_refs[i][:] = out[name]
     return kern
@@ -471,17 +523,7 @@ def apply_ops_fused_pallas(state: DocState, ops: PackedOps,
         return jnp.pad(x, ((0, pad), (0, 0))) if pad else x
 
     st_in = [pad_rows(st[name]) for name in names]
-    # INSERT_RUN sub columns ride as extra per-step op scalars.
-    op_cols = {f: getattr(ops, f) for f in _OP_FIELDS}
-    op_fields = list(_OP_FIELDS)
-    if runs is not None:
-        from .oppack import RUN_K
-        for prefix, arr in (("rl", runs.length), ("rs", runs.seq),
-                            ("ri", runs.op_id)):
-            for k_i in range(RUN_K):
-                name = f"{prefix}{k_i}"
-                op_fields.append(name)
-                op_cols[name] = arr[..., k_i]
+    op_fields, cols = op_cols(ops, runs)
     op3d = tile < DOC_TILE
     if op3d:
         # [B, T] -> [n_tiles, T_pad, tile]: both trailing block dims equal
@@ -489,13 +531,13 @@ def apply_ops_fused_pallas(state: DocState, ops: PackedOps,
         n_tiles = padded // tile
         t_pad = ((t_steps + 7) // 8) * 8
         op_in = [
-            jnp.pad(pad_rows(op_cols[f]),
+            jnp.pad(pad_rows(cols[f]),
                     ((0, 0), (0, t_pad - t_steps)))
             .reshape(n_tiles, tile, t_pad).transpose(0, 2, 1)
             for f in op_fields]
         op_block = pl.BlockSpec((1, t_pad, tile), lambda i, t: (i, 0, 0))
     else:
-        op_in = [pad_rows(op_cols[f]).T for f in op_fields]  # [T, B]
+        op_in = [pad_rows(cols[f]).T for f in op_fields]  # [T, B]
         op_block = pl.BlockSpec((t_steps, tile), lambda i, t: (0, i))
 
     def state_block(cols):

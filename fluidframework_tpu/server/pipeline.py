@@ -30,9 +30,10 @@ def make_full_step(sp_shards: int = 1, fused_apply: bool = False):
 
     fused_apply=True routes the merge apply through the VMEM-resident
     Pallas kernel (mergetree/pallas_apply.py — one HBM read+write for the
-    whole op stream); single-chip only (no sp sharding)."""
-    if fused_apply and sp_shards > 1:
-        raise ValueError("fused_apply is a single-shard kernel")
+    whole op stream). fused_apply with sp_shards > 1 composes the SAME
+    fused formulation with sequence-axis sharding (mergetree/fused_sp.py):
+    per-shard lane tiles with two-level collective prefix sums, so long
+    documents and the flagship kernel are no longer mutually exclusive."""
 
     def full_step(tstate, mstate, raw, ops):
         """(ticket_state, merge_state, RawOps, PackedOps) ->
@@ -45,7 +46,10 @@ def make_full_step(sp_shards: int = 1, fused_apply: bool = False):
             msn=jnp.where(admitted, ticketed.min_seq, ops.msn),
         )
         from ..mergetree.pallas_apply import FUSED_MAX_CAPACITY
-        if fused_apply and mstate.capacity <= FUSED_MAX_CAPACITY:
+        if fused_apply and sp_shards > 1:
+            from ..mergetree.fused_sp import _fused_sp_body
+            mstate = _fused_sp_body(mstate, ops2, sp_shards)
+        elif fused_apply and mstate.capacity <= FUSED_MAX_CAPACITY:
             from ..mergetree.pallas_apply import apply_ops_fused_pallas
             mstate = apply_ops_fused_pallas(mstate, ops2)
         else:
