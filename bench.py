@@ -206,6 +206,131 @@ def _serving_ingest_rate(docs: int = 4096, ops_per_doc: int = 32) -> float:
     return round(total / elapsed, 1)
 
 
+def _matrix_serving_ingest_rate(docs: int = 1024,
+                                ops_per_doc: int = 32) -> dict:
+    """SharedMatrix traffic through the SERVING fast path: raw wire
+    boxcars of axis run-inserts / axis removes / cell writes through
+    TpuSequencerLambda — the matrix decomposes into two merge lanes + an
+    LWW cell-store lane per channel (tpu_sequencer.matrix_route), so the
+    storm rides the same fused device windows as text. Complements
+    matrix_storm (BASELINE #3), which measures the live two-client object
+    path."""
+    if os.environ.get("BENCH_INGEST", "1") == "0":
+        return {}
+    import jax as _jax
+    import json as _json
+    import random as _random
+
+    from fluidframework_tpu.protocol.messages import (Boxcar,
+                                                      DocumentMessage,
+                                                      MessageType)
+    from fluidframework_tpu.server.log import QueuedMessage
+    from fluidframework_tpu.server.tpu_sequencer import TpuSequencerLambda
+    from fluidframework_tpu.server.wire import boxcar_to_wire
+
+    if _jax.default_backend() not in ("tpu", "axon"):
+        docs, ops_per_doc = 256, 16
+    docs = int(os.environ.get("BENCH_MATRIX_INGEST_DOCS", docs))
+    ops_per_doc = int(os.environ.get("BENCH_MATRIX_INGEST_OPS",
+                                     ops_per_doc))
+
+    class _Ctx:
+        def checkpoint(self, *_):
+            pass
+
+        def error(self, err, restart=False):
+            raise err
+
+    nonce = (1 << 46) + 7
+    axis_len = {}  # (doc, axis) -> visible length, host-tracked
+
+    def build_wave(wave: int):
+        rng = _random.Random(41 + wave)
+        out = []
+        base_csn = wave * ops_per_doc
+        for d in range(docs):
+            doc = f"m{d}"
+            contents = []
+            if wave == 0:
+                contents.append(DocumentMessage(
+                    client_sequence_number=0,
+                    reference_sequence_number=-1,
+                    type=MessageType.CLIENT_JOIN,
+                    data=_json.dumps({"clientId": f"c{d}",
+                                      "detail": {}})))
+            for i in range(ops_per_doc):
+                csn = base_csn + i + 1
+                r = rng.random()
+                counter = wave * ops_per_doc + i + 1
+                if r < 0.45 or axis_len.get((d, "rows"), 0) < 2:
+                    axis = "rows" if rng.random() < 0.6 else "cols"
+                    n = rng.randrange(1, 5)
+                    pos = rng.randrange(
+                        axis_len.get((d, axis), 0) + 1)
+                    op = {"target": axis, "op": {
+                        "type": 0, "pos1": pos,
+                        "seg": {"run": [nonce + d, counter, 0, n]}}}
+                    axis_len[(d, axis)] = \
+                        axis_len.get((d, axis), 0) + n
+                elif r < 0.55 and axis_len.get((d, "rows"), 0) > 2:
+                    ln = axis_len[(d, "rows")]
+                    pos = rng.randrange(ln - 1)
+                    op = {"target": "rows", "op": {
+                        "type": 1, "pos1": pos, "pos2": pos + 1}}
+                    axis_len[(d, "rows")] = ln - 1
+                else:
+                    key = (f"{nonce + d}.{rng.randrange(1, counter + 1)}"
+                           f".0|{nonce + d}"
+                           f".{rng.randrange(1, counter + 1)}.0")
+                    op = {"target": "cell", "key": key, "value": i}
+                contents.append(DocumentMessage(
+                    client_sequence_number=csn,
+                    reference_sequence_number=base_csn,
+                    type=MessageType.OPERATION,
+                    contents={"address": "s", "contents": {
+                        "address": "grid", "contents": op}}))
+            out.append(QueuedMessage(
+                topic="rawdeltas", partition=0, offset=wave * docs + d,
+                key=doc,
+                value=boxcar_to_wire(Boxcar(
+                    tenant_id="b", document_id=doc, client_id=f"c{d}",
+                    contents=contents))))
+        return out
+
+    nacks = []
+    lam = TpuSequencerLambda(_Ctx(), emit=lambda *a: None,
+                             nack=lambda *a: nacks.append(a),
+                             client_timeout_s=0.0)
+    lam.emit_window = lambda w: None
+    lam.pipelined = True
+    if lam._pump is None:
+        raise RuntimeError("native wirepump unavailable for matrix bench")
+    for wave in (0, 1):
+        for qm in build_wave(wave):
+            lam.handler(qm)
+        lam.flush()
+    lam.drain()
+    steady = [build_wave(w) for w in (2, 3)]
+    t0 = time.perf_counter()
+    for msgs in steady:
+        for qm in msgs:
+            lam.handler(qm)
+        lam.flush()
+    lam.drain()
+    elapsed = time.perf_counter() - t0
+    if nacks:
+        raise RuntimeError(f"matrix ingest bench nacked {len(nacks)} ops")
+    from fluidframework_tpu.server.tpu_sequencer import MATRIX_ROWS_SUFFIX
+    if ("m0", "s", "grid" + MATRIX_ROWS_SUFFIX) not in lam.merge.where:
+        raise RuntimeError("matrix ops did not reach the device lanes")
+    total = 2 * docs * ops_per_doc
+    return {
+        "matrix_serving_ops_per_sec": round(total / elapsed, 1),
+        "matrix_serving_ops": total,
+        "matrix_serving_docs": docs,
+    }
+
+
 def _keystroke_batch_rate(step, n_docs: int = 2048,
                           n_ops: int = 100) -> dict:
     """The headline pipeline on REALISTIC traffic: a batch of documents
@@ -643,6 +768,7 @@ def main() -> None:
                 ("keystroke_batch", lambda: _keystroke_batch_rate(step)),
                 ("singledoc_trace", _singledoc_trace_rate),
                 ("matrix_storm", _matrix_storm_rate),
+                ("matrix_serving", _matrix_serving_ingest_rate),
                 ("directory_merge", _directory_merge_rate)):
             if time.perf_counter() > soft_deadline:
                 workload_extras[f"{name}_skipped"] = "bench soft deadline"

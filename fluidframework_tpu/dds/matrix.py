@@ -18,50 +18,9 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..mergetree.client import MergeTreeClient, OP_INSERT, OP_REMOVE
 from ..mergetree.constants import SEG_TEXT, UNASSIGNED_SEQ
+from ..mergetree.runs import Run, id_key as _id_key
 from ..protocol.summary import SummaryTree
 from .shared_object import SharedObject, collect_handles
-
-
-class Run:
-    """A sliceable run of stable ids: (base, start+k) for k < length.
-
-    base = (client_ordinal, per-client-run counter) makes ids globally
-    unique and replica-consistent without coordination.
-    """
-
-    __slots__ = ("base", "start", "length")
-
-    def __init__(self, base: Tuple[int, int], start: int, length: int):
-        self.base = base
-        self.start = start
-        self.length = length
-
-    def __len__(self) -> int:
-        return self.length
-
-    def __getitem__(self, key):
-        if isinstance(key, slice):
-            lo, hi, step = key.indices(self.length)
-            assert step == 1
-            return Run(self.base, self.start + lo, max(0, hi - lo))
-        if key < 0:
-            key += self.length
-        return (self.base[0], self.base[1], self.start + key)
-
-    def ids(self) -> List[Tuple[int, int, int]]:
-        return [(self.base[0], self.base[1], self.start + k)
-                for k in range(self.length)]
-
-    def encode(self) -> list:
-        return [self.base[0], self.base[1], self.start, self.length]
-
-    @staticmethod
-    def decode(data: list) -> "Run":
-        return Run((data[0], data[1]), data[2], data[3])
-
-
-def _id_key(stable_id: Tuple[int, int, int]) -> str:
-    return f"{stable_id[0]}.{stable_id[1]}.{stable_id[2]}"
 
 
 class PermutationVector:

@@ -56,19 +56,26 @@ class Unmodelable(BulkApplyUnsupported):
 
 def wire_to_host_ops(builder: OpBuilder, op: dict, seq: int, ref_seq: int,
                      client: int, msn: int,
-                     allow_items: bool = False) -> List[HostOp]:
+                     allow_items: bool = False,
+                     allow_runs: bool = False) -> List[HostOp]:
     """One sequenced wire op (client.py shape) -> kernel HostOps.
 
     allow_items: client bulk catch-up models item payloads (the device
     tracks only lengths/offsets; Items slices like str). The SERVER lane
     path keeps them Unmodelable — its summarize/extract pipeline emits
-    text chunks, so an items lane degrades to opaque there."""
+    text chunks, so an items lane degrades to opaque there.
+
+    allow_runs: ONLY the matrix axis sub-lanes model stable-id runs
+    (their extract path emits runs back); a run insert on an ordinary
+    text channel stays Unmodelable so the lane degrades instead of
+    planting a non-text payload in a text extraction pipeline."""
     t = op.get("type")
     if t == OP_GROUP:
         out: List[HostOp] = []
         for sub in op.get("ops", []):
             out.extend(wire_to_host_ops(builder, sub, seq, ref_seq, client,
-                                        msn, allow_items=allow_items))
+                                        msn, allow_items=allow_items,
+                                        allow_runs=allow_runs))
         return out
     if t == OP_INSERT:
         seg = op.get("seg") or {}
@@ -86,6 +93,16 @@ def wire_to_host_ops(builder: OpBuilder, op: dict, seq: int, ref_seq: int,
             return [builder.insert_text(op["pos1"], Items(seg["items"]),
                                         ref_seq, client, seq,
                                         props=seg.get("props"), msn=msn)]
+        if allow_runs and isinstance(seg.get("run"), list) \
+                and len(seg["run"]) == 4:
+            # Stable-id runs (SharedMatrix permutation axes) slice like
+            # text; the matrix serving lanes extract them back as runs,
+            # so — unlike items — they are modelable on the SERVER path
+            # too (reference permutationvector.ts:126 PermutationVector
+            # extends Client).
+            from .runs import Run
+            return [builder.insert_text(op["pos1"], Run.decode(seg["run"]),
+                                        ref_seq, client, seq, msn=msn)]
         raise Unmodelable("insert payload is not text/marker/items")
     if t == OP_REMOVE:
         return [builder.remove(op["pos1"], op["pos2"], ref_seq, client, seq,
@@ -112,9 +129,15 @@ def looks_like_merge_op(op: Any) -> bool:
 
 def seed_device_state(entries: Sequence[dict], payloads: PayloadTable,
                       capacity: int, min_seq: int, current_seq: int,
-                      anno_slots: int = None) -> DocState:
+                      anno_slots: int = None,
+                      allow_runs: bool = False) -> DocState:
     """Snapshot-format segments (oracle.snapshot_segments) -> a single-doc
-    DocState whose visibility math reproduces the snapshot perspective."""
+    DocState whose visibility math reproduces the snapshot perspective.
+
+    allow_runs gates decoding wire-encoded {"run": ...} payloads (matrix
+    axis snapshots only); any other non-sliceable payload raises
+    Unmodelable so a malformed client summary degrades the lane instead
+    of planting a crash in the extraction pipeline."""
     n = len(entries)
     if n > capacity:
         raise ValueError(f"{n} segments exceed capacity {capacity}")
@@ -124,9 +147,19 @@ def seed_device_state(entries: Sequence[dict], payloads: PayloadTable,
                          "origin_op", "origin_off")}
     rem_client = np.full(n, -1, np.int32)
     cols["rem_seq"][:] = DEV_NO_REMOVE
+    from .oracle import Items
+    from .runs import Run
     for i, e in enumerate(entries):
         kind = e.get("kind", SEG_TEXT)
         text = e.get("text", "")
+        if allow_runs and isinstance(text, dict) and "run" in text \
+                and isinstance(text["run"], list) \
+                and len(text["run"]) == 4:
+            # Matrix-axis snapshot entries carry wire-encoded id runs
+            # (PermutationVector.snapshot form).
+            text = Run.decode(text["run"])
+        if kind != SEG_MARKER and not isinstance(text, (str, Items, Run)):
+            raise Unmodelable(f"unsliceable snapshot payload {type(text)}")
         if kind == SEG_MARKER:
             length = 1
             op_id = payloads.add_insert(SEG_MARKER, "", e.get("props"))
@@ -248,6 +281,7 @@ def coalesce_entries(entries: Sequence[dict]) -> List[dict]:
     without it a keystroke-granularity tail fragments the row space one
     char per op and outgrows every capacity bucket."""
     from .oracle import Items
+    from .runs import Run
 
     out: List[dict] = []
     for e in entries:
@@ -260,6 +294,14 @@ def coalesce_entries(entries: Sequence[dict]) -> List[dict]:
                 continue
             if isinstance(pt, Items) and isinstance(et, Items):
                 out[-1]["text"] = Items(pt.values + et.values)
+                continue
+            if isinstance(pt, Run) and isinstance(et, Run) \
+                    and pt.base == et.base \
+                    and pt.start + pt.length == et.start:
+                # Only CONTIGUOUS id spans re-join (a split run healing);
+                # distinct runs stay separate rows.
+                out[-1]["text"] = Run(pt.base, pt.start,
+                                      pt.length + et.length)
                 continue
         out.append(dict(e))
     return out

@@ -51,7 +51,7 @@ class MergeArenaBlock:
                  "bufs", "pbuf", "pstart", "pend", "seqs", "_cache")
 
     # kinds codes (block-local)
-    K_TEXT, K_MARKER, K_ANNOTATE, K_NONE = 0, 1, 2, 3
+    K_TEXT, K_MARKER, K_ANNOTATE, K_NONE, K_RUN = 0, 1, 2, 3, 4
 
     def __init__(self, kinds, textoff, textlen, arena, bufs, pbuf, pstart,
                  pend):
@@ -95,6 +95,16 @@ class MergeArenaBlock:
             text = self.arena[off:off + int(self.textlen[i])].decode(
                 "utf-8")
             out = InsertPayload(SEG_TEXT, text, self._props(i))
+        elif kind == self.K_RUN:
+            # Matrix-axis stable-id run: the raw wire span holds the
+            # encoded [nonce, counter, start, length] array.
+            import json as _json
+
+            from .runs import Run
+            s = int(self.pstart[i])
+            raw = self.bufs[int(self.pbuf[i])][s:int(self.pend[i])]
+            out = InsertPayload(SEG_TEXT, Run.decode(_json.loads(raw)),
+                                None)
         else:  # K_NONE: a remove's placeholder id — never referenced by
             # device state, but resolve defensively.
             out = InsertPayload(SEG_TEXT, "", None)

@@ -445,11 +445,11 @@ def apply_ops_fused_ref(state: DocState, ops: PackedOps) -> DocState:
     """jnp reference of the fused formulation (also the non-TPU fallback).
     Non-donating, matching the documented apply_ops_fused contract."""
     st, k, a = _to_planes(state)
-    op_cols = {f: getattr(ops, f) for f in _OP_FIELDS}
+    fields, cols = op_cols(ops, None)
 
     def get_op(t):
-        return {f: jax.lax.dynamic_slice_in_dim(op_cols[f], t, 1, axis=1)
-                for f in _OP_FIELDS}
+        return {f: jax.lax.dynamic_slice_in_dim(cols[f], t, 1, axis=1)
+                for f in fields}
 
     c = state.length.shape[-1]
     ln = local_lanes(c, lambda x, n: jnp.roll(x, n, axis=1))

@@ -19,6 +19,7 @@
 // Loaded with ctypes.PyDLL (GIL held: we touch Python objects at the
 // boundary only; the parse core runs on raw char buffers).
 
+#define PY_SSIZE_T_CLEAN  // '#' formats take Py_ssize_t lengths
 #include <Python.h>
 
 #include <cstdint>
@@ -58,6 +59,8 @@ enum Flag {
   F_MARKER = 2,    // merge insert is a marker segment
   F_PROPS = 4,     // PSTART/PEND span is present
   F_VALUE = 8,     // lww op carried a "value" key
+  F_RUN = 16,      // merge insert payload is a stable-id run (matrix axis);
+                   // PSTART/PEND span the raw run array
 };
 
 // MsgKind (server/ticket_kernel.py)
@@ -401,6 +404,11 @@ struct OpFields {
   Span value;  // raw JSON span of op.value
   bool has_pid = false;
   bool has_ops = false;  // group op
+  // SharedMatrix envelope (dds/matrix.py): {"target": ..., "op"|"key"/...}
+  int mx = 0;                  // 0 none, 1 rows, 2 cols, 3 cell
+  bool has_inner = false;      // "op": {...} parsed into *inner
+  bool seg_run = false;        // seg carried a "run" id-span array
+  Span seg_run_span;           // raw span (validated by parse_run_array)
 };
 
 bool raw_span(P& c, Span* out) {
@@ -409,6 +417,30 @@ bool raw_span(P& c, Span* out) {
   if (!skip_value(c)) return false;
   out->b = static_cast<int32_t>(c.p - c.s);
   return true;
+}
+
+// Validate a matrix run payload span "[nonce, counter, start, length]"
+// (mergetree/runs.py Run.encode) and extract the length. The first two
+// elements may exceed int32 (48-bit nonce) — they stay in the raw span for
+// Python-side decoding; only the length must fit the device column.
+bool parse_run_array(const char* a, const char* b, long* len_out) {
+  P rc{a, a, b};
+  ws(rc);
+  if (!peek(rc, '[')) return false;
+  ++rc.p;
+  long vals[4];
+  for (int i = 0; i < 4; ++i) {
+    bool isnum;
+    if (!int_token(rc, &vals[i], &isnum)) return false;
+    if (i < 3) {
+      if (!eat(rc, ',')) return false;
+    }
+  }
+  if (!eat(rc, ']')) return false;
+  ws(rc);
+  if (rc.p != rc.e) return false;
+  *len_out = vals[3];
+  return vals[3] > 0;
 }
 
 bool parse_seg(P& c, OpFields* f) {
@@ -426,7 +458,10 @@ bool parse_seg(P& c, OpFields* f) {
       c.bad = true;
       return false;
     }
-    if (key_is(c, k, "text")) {
+    if (key_is(c, k, "run")) {
+      if (!raw_span(c, &f->seg_run_span)) return false;
+      f->seg_run = true;
+    } else if (key_is(c, k, "text")) {
       if (!peek(c, '"')) {
         f->seg_other = true;  // non-string text (items ride "items" anyway)
         if (!skip_value(c)) return false;
@@ -451,7 +486,7 @@ bool parse_seg(P& c, OpFields* f) {
   }
 }
 
-bool parse_op_object(P& c, OpFields* f) {
+bool parse_op_object(P& c, OpFields* f, OpFields* inner = nullptr) {
   ws(c);
   if (!peek(c, '{')) return skip_value(c);  // non-dict op: family none
   ++c.p;
@@ -463,7 +498,31 @@ bool parse_op_object(P& c, OpFields* f) {
       c.bad = true;
       return false;
     }
-    if (key_is(c, k, "type")) {
+    if (key_is(c, k, "target")) {
+      // SharedMatrix envelope discriminator (dds/matrix.py).
+      ws(c);
+      if (peek(c, '"')) {
+        Span sp;
+        bool e2;
+        if (!str_token(c, &sp, &e2)) return false;
+        std::string t;
+        if (span_str(c, sp, e2, &t)) {
+          if (t == "rows") f->mx = 1;
+          else if (t == "cols") f->mx = 2;
+          else if (t == "cell") f->mx = 3;
+        }
+      } else {
+        if (!skip_value(c)) return false;
+      }
+    } else if (key_is(c, k, "op") && inner != nullptr) {
+      ws(c);
+      if (peek(c, '{')) {
+        f->has_inner = true;
+        if (!parse_op_object(c, inner)) return false;  // depth 1 only
+      } else {
+        if (!skip_value(c)) return false;
+      }
+    } else if (key_is(c, k, "type")) {
       ws(c);
       if (peek(c, '"')) {
         if (!str_token(c, &f->type_s, &f->type_esc)) return false;
@@ -573,8 +632,11 @@ int32_t intern_channel(Ctx* ctx, int32_t doc, const std::string& store,
   if (it != ctx->channels.end()) return it->second;
   int32_t ord = static_cast<int32_t>(ctx->channels.size());
   ctx->channels.emplace(std::move(key), ord);
-  PyObject* t = Py_BuildValue("(iiss)", ord, doc, store.c_str(),
-                              chan.c_str());
+  // s# (length-explicit): matrix sub-lane names carry an embedded NUL
+  // ("chan\0mx:rows"), which plain "s" would silently truncate.
+  PyObject* t = Py_BuildValue("(iiss#)", ord, doc, store.c_str(),
+                              chan.data(),
+                              static_cast<Py_ssize_t>(chan.size()));
   if (t != nullptr) {
     PyList_Append(ctx->new_channels, t);
     Py_DECREF(t);
@@ -587,7 +649,9 @@ int32_t intern_lww_key(Ctx* ctx, const std::string& k) {
   if (it != ctx->lww_keys.end()) return it->second;
   int32_t ord = static_cast<int32_t>(ctx->lww_keys.size());
   ctx->lww_keys.emplace(k, ord);
-  PyObject* t = Py_BuildValue("(is)", ord, k.c_str());
+  // s#: the reserved cell key "\0cell" has an embedded NUL.
+  PyObject* t = Py_BuildValue("(is#)", ord, k.data(),
+                              static_cast<Py_ssize_t>(k.size()));
   if (t != nullptr) {
     PyList_Append(ctx->new_keys, t);
     Py_DECREF(t);
@@ -645,6 +709,7 @@ bool parse_envelope(Ctx* ctx, P& c, int32_t doc, Row* r, ChanMemo* memo) {
   bool have_store = false, have_chan = false;
   bool have_op = false;
   OpFields f;
+  OpFields fi;  // matrix inner axis op ({"target": ..., "op": {...}})
   while (true) {
     Span k;
     bool esc;
@@ -696,7 +761,7 @@ bool parse_envelope(Ctx* ctx, P& c, int32_t doc, Row* r, ChanMemo* memo) {
               }
             } else if (key_is(c, k2, "contents")) {
               have_op = true;
-              if (!parse_op_object(c, &f)) return false;
+              if (!parse_op_object(c, &f, &fi)) return false;
             } else {
               if (!skip_value(c)) return false;
             }
@@ -727,6 +792,66 @@ bool parse_envelope(Ctx* ctx, P& c, int32_t doc, Row* r, ChanMemo* memo) {
   };
 
   if (!have_store || !have_chan || !have_op) return true;  // family none
+
+  // SharedMatrix envelope (tpu_sequencer.matrix_route): axis ops become
+  // merge rows on suffixed channels, cell writes LWW rows on the cells
+  // channel. Shapes outside the dds/matrix.py submit set FALL BACK.
+  if (f.mx != 0) {
+    static const std::string kRowsSuffix("\0mx:rows", 8);
+    static const std::string kColsSuffix("\0mx:cols", 8);
+    static const std::string kCellsSuffix("\0mx:cells", 9);
+    if (f.mx == 3) {  // cell write
+      if (!f.has_key) return true;  // not a matrix cell shape: none
+      std::string key;
+      if (!span_str(c, f.key, f.key_esc, &key)) return true;
+      r->v[C_FAMILY] = FAM_LWW;
+      r->v[C_CHAN] = intern_channel(ctx, doc, store, chan + kCellsSuffix);
+      r->v[C_MKIND] = LW_SET;
+      r->v[C_POS1] = intern_lww_key(ctx, key);
+      if (f.has_value) {
+        r->v[C_FLAGS] |= F_VALUE;
+        r->v[C_PSTART] = f.value.a;
+        r->v[C_PEND] = f.value.b;
+      }
+      return true;
+    }
+    if (!f.has_inner || !fi.clean || !fi.type_is_int || !fi.has_pos1 ||
+        !fits32(fi.pos1) || !fits32(fi.pos2)) {
+      r->v[C_FLAGS] |= F_FALLBACK;
+      return true;
+    }
+    const std::string& suffix = (f.mx == 1) ? kRowsSuffix : kColsSuffix;
+    if (fi.type_i == 0 && fi.has_seg && fi.seg_run && !fi.seg_other &&
+        !fi.seg_text_present && !fi.seg_marker) {
+      long run_len = -1;
+      if (!parse_run_array(c.s + fi.seg_run_span.a,
+                           c.s + fi.seg_run_span.b, &run_len) ||
+          !fits32(run_len)) {
+        r->v[C_FLAGS] |= F_FALLBACK;
+        return true;
+      }
+      r->v[C_FAMILY] = FAM_MERGE;
+      r->v[C_CHAN] = intern_channel(ctx, doc, store, chan + suffix);
+      r->v[C_MKIND] = M_INSERT;
+      r->v[C_FLAGS] |= F_RUN;
+      r->v[C_POS1] = static_cast<int32_t>(fi.pos1);
+      r->v[C_CHARLEN] = static_cast<int32_t>(run_len);
+      r->v[C_PSTART] = fi.seg_run_span.a;
+      r->v[C_PEND] = fi.seg_run_span.b;
+      return true;
+    }
+    if (fi.type_i == 1 && fi.has_pos2) {
+      r->v[C_FAMILY] = FAM_MERGE;
+      r->v[C_CHAN] = intern_channel(ctx, doc, store, chan + suffix);
+      r->v[C_MKIND] = M_REMOVE;
+      r->v[C_POS1] = static_cast<int32_t>(fi.pos1);
+      r->v[C_POS2] = static_cast<int32_t>(fi.pos2);
+      return true;
+    }
+    // axis annotate / text insert / group: not a dds/matrix shape
+    r->v[C_FLAGS] |= F_FALLBACK;
+    return true;
+  }
 
   // Classification mirrors catchup.looks_like_merge_op /
   // tpu_sequencer.looks_like_lww_op exactly; merge-looking shapes the

@@ -461,11 +461,19 @@ class TpuLocalServer(LocalServer):
 
         from ..protocol.summary import SummaryHandle, SummaryTree
 
+        from .tpu_sequencer import matrix_base_key
+
         seq = self.sequencer()
         seq.drain()
         merge_keys = set(seq.merge.where)
         lww_keys = set(seq.lww.where)
         all_keys = merge_keys | lww_keys
+        # Matrix sub-lanes (axis merge lanes + cell store) version and
+        # persist ATOMICALLY under their base channel key: a dirty row
+        # axis must re-extract the cols/cells too, or the composed
+        # snapshot would silently drop the unextracted parts.
+        base_of = {k: (matrix_base_key(k) or k) for k in all_keys}
+        display_keys = set(base_of.values())
 
         prev_sha: Dict[str, Optional[str]] = {}
         for doc_id in {k[0] for k in all_keys}:
@@ -487,16 +495,21 @@ class TpuLocalServer(LocalServer):
         if seen_by_ref is None:
             seen_by_ref = seq._materialized_gen = {}
         ref_seen: Dict[tuple, int] = seen_by_ref.setdefault(ref, {})
+        gen_display: Dict[tuple, int] = {}
+        for k in all_keys:
+            gen_display[base_of[k]] = max(gen_display.get(base_of[k], 0),
+                                          gen_now.get(k, 0))
         if incremental:
-            dirty = {k for k in all_keys
-                     if gen_now.get(k, 0) > ref_seen.get(k, 0)}
+            dirty = {dk for dk in display_keys
+                     if gen_display.get(dk, 0) > ref_seen.get(dk, 0)}
             # Docs without a previous commit have nothing to point handles
             # at: extract them fully.
             full_docs = {d for d, sha in prev_sha.items() if sha is None}
-            want = {k for k in all_keys
-                    if k in dirty or k[0] in full_docs}
+            want_display = {dk for dk in display_keys
+                            if dk in dirty or dk[0] in full_docs}
         else:
-            want = all_keys
+            want_display = display_keys
+        want = {k for k in all_keys if base_of[k] in want_display}
         write_docs = {k[0] for k in want}
 
         snaps = seq.summarize_documents(only=want)
@@ -512,13 +525,20 @@ class TpuLocalServer(LocalServer):
             if "chunks" in snap:  # merge-tree channel: chunked body
                 for i, chunk in enumerate(snap["chunks"]):
                     node.add_blob(f"chunk_{i}", _json.dumps(chunk))
+            elif snap["header"].get("kind") == "matrix":
+                # Composed matrix channel: axis snapshots + cell map in
+                # the dds/matrix.py load_core blob layout.
+                node.add_blob("rows", _json.dumps(snap["rows"]))
+                node.add_blob("cols", _json.dumps(snap["cols"]))
+                node.add_blob("cells", _json.dumps(snap["cells"],
+                                                   sort_keys=True))
             else:  # LWW channel: entries + counter in one blob
                 node.add_blob("lww", _json.dumps(
                     {"entries": snap["entries"],
                      "counter": snap["counter"]}, sort_keys=True))
         # Clean channels of written docs ride as handles into the doc's
         # previous materialized commit (same tree position).
-        for (doc_id, store_id, channel_id) in all_keys - want:
+        for (doc_id, store_id, channel_id) in display_keys - want_display:
             if doc_id not in write_docs:
                 continue
             root = by_doc.setdefault(doc_id, SummaryTree())
@@ -541,6 +561,6 @@ class TpuLocalServer(LocalServer):
                 out[doc_id] = sha
         # Only the channels actually persisted become clean FOR THIS REF,
         # at the generation captured before extraction.
-        for k in want:
-            ref_seen[k] = gen_now.get(k, 0)
+        for dk in want_display:
+            ref_seen[dk] = gen_display.get(dk, 0)
         return out

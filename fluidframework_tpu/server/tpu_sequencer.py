@@ -229,6 +229,7 @@ class MergeLaneStore:
             return key in self.where
         n = len(entries)
         last = len(self.buckets) - 1
+        allow_runs = matrix_base_key(key) is not None
         for b, bucket in enumerate(self.buckets):
             if n * 2 > bucket.capacity and not (b == last
                                                 and n <= bucket.capacity):
@@ -236,7 +237,8 @@ class MergeLaneStore:
             try:
                 row = seed_device_state(entries, self.payloads,
                                         bucket.capacity, min_seq,
-                                        current_seq)
+                                        current_seq,
+                                        allow_runs=allow_runs)
             except (Unmodelable, ValueError):
                 self.opaque.add(key)
                 return False
@@ -532,6 +534,19 @@ class MergeLaneStore:
         b, lane = self.where[key]
         return extract_text(self.buckets[b].row(lane), self.payloads)
 
+    def entries(self, key: tuple) -> Optional[list]:
+        """Full-fidelity snapshot entries for one lane (host gather of a
+        single row — read path for composite channels like matrix axes,
+        whose payloads are runs rather than text)."""
+        from ..mergetree.catchup import extract_entries
+
+        if key not in self.where:
+            return None
+        b, lane = self.where[key]
+        row = self.buckets[b].row(lane)
+        return extract_entries(row, self.payloads,
+                               int(np.asarray(row.min_seq)))
+
     def lane_count(self) -> int:
         return len(self.where)
 
@@ -541,6 +556,88 @@ class MergeLaneStore:
 # ---------------------------------------------------------------------------
 
 _CELL_KEY = "\x00cell"  # SharedCell = a one-key LWW map
+
+# SharedMatrix serving lanes: one matrix channel materializes as TWO merge
+# lanes (the permutation axes ARE merge-tree clients — reference
+# packages/dds/matrix/src/permutationvector.ts:126) plus one LWW lane for
+# the sparse cell store keyed by stable (row_id|col_id). The sub-lanes key
+# under suffixed channel names ("\x00" cannot appear in real channel ids).
+MATRIX_ROWS_SUFFIX = "\x00mx:rows"
+MATRIX_COLS_SUFFIX = "\x00mx:cols"
+MATRIX_CELLS_SUFFIX = "\x00mx:cells"
+_MATRIX_TYPE = "https://graph.microsoft.com/types/sharedmatrix"
+
+
+_MATRIX_SUFFIXES = ((MATRIX_ROWS_SUFFIX, "rows"),
+                    (MATRIX_COLS_SUFFIX, "cols"),
+                    (MATRIX_CELLS_SUFFIX, "cells"))
+
+
+def matrix_base_key(key: tuple) -> Optional[tuple]:
+    """(doc, store, chan+suffix) -> (doc, store, chan) for matrix
+    sub-lane keys; None for ordinary channel keys."""
+    chan = key[2]
+    if isinstance(chan, str) and "\x00mx:" in chan:
+        for suffix, _ in _MATRIX_SUFFIXES:
+            if chan.endswith(suffix):
+                return (key[0], key[1], chan[:-len(suffix)])
+    return None
+
+
+def _compose_matrix_channels(out: Dict[tuple, dict]) -> None:
+    """Recombine suffixed matrix sub-lane snapshots into ONE channel
+    snapshot per matrix, keyed by the real channel name: the two axis
+    snapshots in dds/matrix.py load_core's blob format (segments with
+    wire-encoded runs) + the sparse cell map. Mutates `out` in place."""
+    from ..mergetree.runs import encode_entry_payloads
+
+    groups: Dict[tuple, Dict[str, dict]] = {}
+    for key in [k for k in out
+                if isinstance(k[2], str) and "\x00mx:" in k[2]]:
+        for suffix, name in _MATRIX_SUFFIXES:
+            if key[2].endswith(suffix):
+                base = (key[0], key[1], key[2][:-len(suffix)])
+                groups.setdefault(base, {})[name] = out.pop(key)
+                break
+    for base, parts in groups.items():
+        composed: Dict[str, Any] = {
+            "header": {"kind": "matrix", "sequenceNumber": 0}}
+        seq = 0
+        for axis in ("rows", "cols"):
+            part = parts.get(axis)
+            if part is None:
+                composed[axis] = {"segments": [], "seq": 0, "minSeq": 0}
+                continue
+            hdr = part["header"]
+            segs = [e for chunk in part["chunks"] for e in chunk]
+            composed[axis] = {
+                "segments": encode_entry_payloads(segs),
+                "seq": hdr["sequenceNumber"],
+                "minSeq": hdr["minimumSequenceNumber"],
+            }
+            seq = max(seq, hdr["sequenceNumber"])
+        cells = parts.get("cells")
+        composed["cells"] = dict(cells["entries"]) if cells else {}
+        if cells:
+            seq = max(seq, cells["header"]["sequenceNumber"])
+        composed["header"]["sequenceNumber"] = seq
+        out[base] = composed
+
+
+def matrix_route(op: Any) -> Optional[str]:
+    """Classify a SharedMatrix wire op (dds/matrix.py submit shapes):
+    'rows'/'cols' for axis merge ops, 'cell' for cell writes, None for
+    anything else."""
+    from ..mergetree.catchup import looks_like_merge_op as _merge
+
+    if not isinstance(op, dict):
+        return None
+    target = op.get("target")
+    if target in ("rows", "cols") and _merge(op.get("op")):
+        return target
+    if target == "cell" and isinstance(op.get("key"), str):
+        return "cell"
+    return None
 
 
 def looks_like_lww_op(op: Any) -> bool:
@@ -1168,8 +1265,7 @@ def _parse_summary_probe(tree) -> Optional[_SummaryProbe]:
         if not hasattr(channel_root, "entries"):
             continue
         for channel_id, node in channel_root.entries.items():
-            if not hasattr(node, "entries") or \
-                    "header" not in node.entries:
+            if not hasattr(node, "entries"):
                 continue
             # A malformed .attributes blob must not cost a channel its
             # merge seeding — classification just falls back to "".
@@ -1180,6 +1276,34 @@ def _parse_summary_probe(tree) -> Optional[_SummaryProbe]:
                     ctype = _json.loads(attrs.content).get("type", "")
                 except (ValueError, TypeError, AttributeError):
                     ctype = ""
+            if ctype == _MATRIX_TYPE:
+                # Matrix snapshots (dds/matrix.py summarize_core): two
+                # axis snapshots seed merge lanes under suffixed names,
+                # the cells blob seeds the LWW cell-store lane. Parsed
+                # into locals FIRST and committed atomically: a malformed
+                # blob must skip the WHOLE matrix (a partially seeded
+                # matrix would serve axes inconsistent with its cells).
+                try:
+                    axis_payloads = {}
+                    for blob, suffix in (("rows", MATRIX_ROWS_SUFFIX),
+                                         ("cols", MATRIX_COLS_SUFFIX)):
+                        snap = _json.loads(node.entries[blob].content)
+                        axis_payloads[suffix] = (
+                            snap["segments"], int(snap.get("minSeq", 0)),
+                            int(snap.get("seq", 0)))
+                    cells = _json.loads(node.entries["cells"].content)
+                    if not isinstance(cells, dict):
+                        raise ValueError("cells blob is not a map")
+                except (ValueError, TypeError, KeyError, AttributeError):
+                    continue  # malformed client channel: skip, don't crash
+                for suffix, payload in axis_payloads.items():
+                    channels[(store_id, channel_id + suffix)] = payload
+                lww_channels[(store_id,
+                              channel_id + MATRIX_CELLS_SUFFIX)] = (
+                    "map", cells)
+                continue
+            if "header" not in node.entries:
+                continue
             try:
                 header = _json.loads(node.entries["header"].content)
                 lww_kind = _LWW_SEED_TYPES.get(ctype)
@@ -1869,6 +1993,7 @@ class TpuSequencerLambda(IPartitionLambda):
         kinds = np.full(merge_rows.size, MergeArenaBlock.K_NONE, np.int8)
         kinds[(mk == 1) & ((fl & P.F_MARKER) != 0)] = MergeArenaBlock.K_MARKER
         kinds[(mk == 1) & ((fl & P.F_MARKER) == 0)] = MergeArenaBlock.K_TEXT
+        kinds[(mk == 1) & ((fl & P.F_RUN) != 0)] = MergeArenaBlock.K_RUN
         kinds[mk == 3] = MergeArenaBlock.K_ANNOTATE
         block = MergeArenaBlock(
             kinds=kinds,
@@ -2511,48 +2636,76 @@ class TpuSequencerLambda(IPartitionLambda):
             return
         op = envelope.get("contents")
         key = (doc_id, contents.get("address"), envelope.get("address"))
-        if looks_like_merge_op(op):
-            if key in self.merge.opaque:
-                return
-            if seeded_before is not None and \
-                    seq <= seeded_before.get(key, 0):
-                return  # already reflected in the seeded snapshot base
-            if key not in self.merge.where:
-                # First op for this channel: its base content may have
-                # shipped in the attach/client summary — seed the lane
-                # from storage before applying ops addressed against it.
-                probe = self._probe_summary(doc_id)
-                if probe is not None:
-                    payload = probe.channels.get((contents.get("address"),
-                                                  envelope.get("address")))
-                    if payload is not None and seq > probe.sequence_number:
-                        self.merge.seed(key, *payload)
-            try:
-                ops = wire_to_host_ops(self.merge.builder, op, seq,
-                                       p.ref_seq, p.ordinal, msn)
-            except Unmodelable:
-                self.merge.drop(key)
-                return
-            merge_streams.setdefault(key, []).extend(ops)
+        route = matrix_route(op)
+        if route is not None:
+            # SharedMatrix: axis ops ride merge lanes under suffixed
+            # channel keys, cell writes ride an LWW lane — the matrix
+            # decomposes into the two families the device already serves.
+            store, chan = key[1], key[2]
+            if route == "cell":
+                self._route_lww(
+                    lww_streams, (doc_id, store, chan + MATRIX_CELLS_SUFFIX),
+                    {"type": "set", "key": op["key"],
+                     "value": op.get("value")},
+                    seq, seeded_before)
+            else:
+                suffix = MATRIX_ROWS_SUFFIX if route == "rows" \
+                    else MATRIX_COLS_SUFFIX
+                self._route_merge(
+                    merge_streams, (doc_id, store, chan + suffix),
+                    op["op"], p, seq, msn, seeded_before)
+        elif looks_like_merge_op(op):
+            self._route_merge(merge_streams, key, op, p, seq, msn,
+                              seeded_before)
         elif looks_like_lww_op(op):
-            if key in self.lww.opaque:
-                return
-            if seeded_before is not None and \
-                    seq <= seeded_before.get(key, 0):
-                return  # already reflected in the seeded snapshot base
-            if key not in self.lww.where:
-                probe = self._probe_summary(doc_id)
-                if probe is not None:
-                    payload = probe.lww_channels.get(
-                        (contents.get("address"), envelope.get("address")))
-                    if payload is not None and \
-                            seq > probe.sequence_number:
-                        self.lww.seed(key, *payload)
-            try:
-                lww_streams.setdefault(key, []).append(
-                    self.lww.wire_to_op(op, seq))
-            except Unmodelable:
-                pass
+            self._route_lww(lww_streams, key, op, seq, seeded_before)
+
+    def _route_merge(self, merge_streams: Dict[tuple, List[HostOp]],
+                     key: tuple, op: dict, p: _Pending, seq: int, msn: int,
+                     seeded_before: Optional[Dict[tuple, int]]) -> None:
+        if key in self.merge.opaque:
+            return
+        # Run payloads are modelable ONLY on matrix axis sub-lanes (their
+        # extract path emits runs back); elsewhere they stay Unmodelable.
+        allow_runs = matrix_base_key(key) is not None
+        if seeded_before is not None and seq <= seeded_before.get(key, 0):
+            return  # already reflected in the seeded snapshot base
+        if key not in self.merge.where:
+            # First op for this channel: its base content may have
+            # shipped in the attach/client summary — seed the lane
+            # from storage before applying ops addressed against it.
+            probe = self._probe_summary(key[0])
+            if probe is not None:
+                payload = probe.channels.get((key[1], key[2]))
+                if payload is not None and seq > probe.sequence_number:
+                    self.merge.seed(key, *payload)
+        try:
+            ops = wire_to_host_ops(self.merge.builder, op, seq,
+                                   p.ref_seq, p.ordinal, msn,
+                                   allow_runs=allow_runs)
+        except Unmodelable:
+            self.merge.drop(key)
+            return
+        merge_streams.setdefault(key, []).extend(ops)
+
+    def _route_lww(self, lww_streams: Dict[tuple, List[tuple]], key: tuple,
+                   op: dict, seq: int,
+                   seeded_before: Optional[Dict[tuple, int]]) -> None:
+        if key in self.lww.opaque:
+            return
+        if seeded_before is not None and seq <= seeded_before.get(key, 0):
+            return  # already reflected in the seeded snapshot base
+        if key not in self.lww.where:
+            probe = self._probe_summary(key[0])
+            if probe is not None:
+                payload = probe.lww_channels.get((key[1], key[2]))
+                if payload is not None and seq > probe.sequence_number:
+                    self.lww.seed(key, *payload)
+        try:
+            lww_streams.setdefault(key, []).append(
+                self.lww.wire_to_op(op, seq))
+        except Unmodelable:
+            pass
 
     # -- batched server-side summarization ---------------------------------
     def summarize_documents(self, chunk_chars: int = 10000,
@@ -2577,6 +2730,7 @@ class TpuSequencerLambda(IPartitionLambda):
                     "entries": snap["entries"],
                     "counter": snap["counter"],
                 }
+        _compose_matrix_channels(out)
         return out
 
     def summarize_documents_async(self, on_done,
@@ -2591,9 +2745,24 @@ class TpuSequencerLambda(IPartitionLambda):
 
         self.drain()  # settle any deferred window before reading lanes
         jobs = self.merge.extract_dispatch()
+        # LWW snapshots are host-cheap: capture them now so the composed
+        # output matches the synchronous path (matrix cell stores).
+        lww_part: Dict[tuple, dict] = {}
+        for key in self.lww.where:
+            snap = self.lww.snapshot(key)
+            if snap is not None:
+                lww_part[key] = {
+                    "header": {"kind": "lww",
+                               "sequenceNumber": snap["sequenceNumber"]},
+                    "entries": snap["entries"],
+                    "counter": snap["counter"],
+                }
 
         def work():
-            on_done(self.merge.extract_assemble(jobs, chunk_chars))
+            out = self.merge.extract_assemble(jobs, chunk_chars)
+            out.update(lww_part)
+            _compose_matrix_channels(out)
+            on_done(out)
 
         th = threading.Thread(target=work, daemon=True)
         th.start()
@@ -2613,6 +2782,42 @@ class TpuSequencerLambda(IPartitionLambda):
         under the reserved key / counter accumulator)."""
         self.drain()
         return self.lww.snapshot((doc_id, store, channel))
+
+    def channel_matrix(self, doc_id: str, store: str,
+                       channel: str) -> Optional[list]:
+        """Server-materialized matrix grid (rows-in-order × cols-in-order
+        of cell values) from the two axis merge lanes + the cell-store
+        LWW lane — comparable 1:1 with SharedMatrix.extract() on a caught-
+        up client. None if no matrix sub-lane exists for the channel."""
+        from ..mergetree.runs import Run, id_key
+
+        self.drain()
+
+        def axis_ids(suffix: str) -> list:
+            entries = self.merge.entries((doc_id, store, channel + suffix))
+            ids: list = []
+            for e in entries or []:
+                if e.get("removedSeq") is not None or \
+                        e.get("removedLocalSeq") is not None:
+                    continue
+                text = e.get("text")
+                if isinstance(text, Run):
+                    ids.extend(text.ids())
+            return ids
+
+        rows_known = (doc_id, store,
+                      channel + MATRIX_ROWS_SUFFIX) in self.merge.where
+        cols_known = (doc_id, store,
+                      channel + MATRIX_COLS_SUFFIX) in self.merge.where
+        cells_snap = self.lww.snapshot(
+            (doc_id, store, channel + MATRIX_CELLS_SUFFIX))
+        if not rows_known and not cols_known and cells_snap is None:
+            return None
+        cells = cells_snap["entries"] if cells_snap else {}
+        row_ids = axis_ids(MATRIX_ROWS_SUFFIX)
+        col_ids = axis_ids(MATRIX_COLS_SUFFIX)
+        return [[cells.get(id_key(r) + "|" + id_key(c))
+                 for c in col_ids] for r in row_ids]
 
     def document_seq(self, doc_id: str) -> int:
         dl = self.docs.get(doc_id)

@@ -1,0 +1,330 @@
+"""SharedMatrix on the device serving path: a matrix channel materializes
+as TWO merge lanes (the permutation axes are merge-tree clients —
+reference packages/dds/matrix/src/permutationvector.ts:126) plus one LWW
+lane for the sparse cell store. These tests differential-lock the serving
+materialization against the client object path (extract()), the raw
+fast path against the object slow path (wire-pump suite discipline), and
+the composed summary against dds/matrix.py load_core."""
+
+import json
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.matrix import SharedMatrix
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.local import (
+    LocalDocumentServiceFactory,
+)
+from fluidframework_tpu.protocol.messages import (
+    Boxcar,
+    DocumentMessage,
+    MessageType,
+)
+from fluidframework_tpu.server import pump as pump_mod
+from fluidframework_tpu.server.local_server import TpuLocalServer
+from fluidframework_tpu.server.log import QueuedMessage
+from fluidframework_tpu.server.tpu_sequencer import (
+    MATRIX_CELLS_SUFFIX,
+    MATRIX_ROWS_SUFFIX,
+    TpuSequencerLambda,
+    matrix_route,
+)
+from fluidframework_tpu.server.wire import boxcar_to_wire
+
+
+def make_doc(server, doc_id="doc"):
+    loader = Loader(LocalDocumentServiceFactory(server))
+    container = loader.create_detached(doc_id)
+    ds = container.runtime.create_datastore("default")
+    return loader, container, ds
+
+
+class TestMatrixServingE2E:
+    def test_server_materializes_matrix_on_device_lanes(self):
+        """The serving win for matrices: the sequencer's axis merge lanes
+        + cell LWW lane hold the authoritative grid, equal to every
+        client replica's extract()."""
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        m1 = ds1.create_channel("grid", SharedMatrix.TYPE)
+        c2 = loader.resolve("doc")
+        m2 = c2.runtime.get_datastore("default").get_channel("grid")
+
+        m1.insert_rows(0, 3)
+        m1.insert_cols(0, 2)
+        m2.insert_rows(1, 1)  # concurrent axis edit from the other client
+        m1.set_cell(0, 0, "a")
+        m2.set_cell(2, 1, {"v": 7})
+        m1.remove_rows(1, 1)
+        m2.set_cell(0, 1, None)
+
+        seq = server.sequencer()
+        assert ("doc", "default",
+                "grid" + MATRIX_ROWS_SUFFIX) in seq.merge.where
+        assert ("doc", "default",
+                "grid" + MATRIX_CELLS_SUFFIX) in seq.lww.where
+        grid = seq.channel_matrix("doc", "default", "grid")
+        assert grid == m1.extract() == m2.extract()
+        assert any(v is not None for row in grid for v in row)
+
+    def test_random_matrix_storm_matches_clients(self):
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        m1 = ds1.create_channel("grid", SharedMatrix.TYPE)
+        c2 = loader.resolve("doc")
+        m2 = c2.runtime.get_datastore("default").get_channel("grid")
+        rng = random.Random(11)
+        for step in range(80):
+            m = rng.choice([m1, m2])
+            r, c = m.row_count, m.col_count
+            act = rng.random()
+            if act < 0.25 or r == 0:
+                m.insert_rows(rng.randint(0, r), rng.randint(1, 3))
+            elif act < 0.5 or c == 0:
+                m.insert_cols(rng.randint(0, c), rng.randint(1, 2))
+            elif act < 0.6 and r > 1:
+                pos = rng.randrange(r - 1)
+                m.remove_rows(pos, 1)
+            elif act < 0.7 and c > 1:
+                pos = rng.randrange(c - 1)
+                m.remove_cols(pos, 1)
+            else:
+                m.set_cell(rng.randrange(r), rng.randrange(c), step)
+        assert m1.extract() == m2.extract()
+        grid = server.sequencer().channel_matrix("doc", "default", "grid")
+        assert grid == m1.extract()
+
+    def test_attach_summary_seeds_matrix_lanes(self):
+        """Detached-populated matrix content ships in the attach summary;
+        the first post-attach op must seed the axis lanes + cell store
+        from storage before applying (mid-stream admission)."""
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        m1 = ds1.create_channel("grid", SharedMatrix.TYPE)
+        m1.insert_rows(0, 2)
+        m1.insert_cols(0, 2)
+        m1.set_cell(0, 0, "offline")
+        c1.attach()
+
+        c2 = loader.resolve("doc")
+        m2 = c2.runtime.get_datastore("default").get_channel("grid")
+        assert m2.get_cell(0, 0) == "offline"
+        m2.set_cell(1, 1, "online")
+        m1.insert_rows(2, 1)
+        m1.set_cell(2, 0, "tail")
+
+        grid = server.sequencer().channel_matrix("doc", "default", "grid")
+        assert grid == m1.extract() == m2.extract()
+
+    def test_restart_rebuilds_matrix_lanes(self):
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        m1 = ds1.create_channel("grid", SharedMatrix.TYPE)
+        m1.insert_rows(0, 2)
+        m1.insert_cols(0, 2)
+        m1.set_cell(0, 0, 1)
+        server._deli_mgr.restart()  # lambda rebuilt from checkpoint
+        m1.set_cell(1, 1, 2)
+        m1.insert_rows(1, 1)
+        c2 = loader.resolve("doc")
+        m2 = c2.runtime.get_datastore("default").get_channel("grid")
+        assert m1.extract() == m2.extract()
+        grid = server.sequencer().channel_matrix("doc", "default", "grid")
+        assert grid == m1.extract()
+
+    def test_composed_summary_loads_into_client_matrix(self):
+        """summarize_documents emits ONE composed snapshot per matrix
+        (axis snapshots + cells) under the real channel key, loadable by
+        SharedMatrix.load_core."""
+        from fluidframework_tpu.protocol.summary import SummaryTree
+
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        m1 = ds1.create_channel("grid", SharedMatrix.TYPE)
+        m1.insert_rows(0, 2)
+        m1.insert_cols(0, 3)
+        m1.set_cell(0, 2, "x")
+        m1.remove_cols(0, 1)
+
+        snaps = server.sequencer().summarize_documents()
+        key = ("doc", "default", "grid")
+        assert key in snaps
+        snap = snaps[key]
+        assert snap["header"]["kind"] == "matrix"
+        assert not any("\x00mx:" in k[2] for k in snaps)  # composed away
+
+        tree = SummaryTree()
+        tree.add_blob("rows", json.dumps(snap["rows"]))
+        tree.add_blob("cols", json.dumps(snap["cols"]))
+        tree.add_blob("cells", json.dumps(snap["cells"]))
+        loaded = SharedMatrix("loaded")
+        loaded.load_core(tree)
+        assert loaded.extract() == m1.extract()
+
+    def test_materialized_snapshot_write_includes_matrix(self):
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        m1 = ds1.create_channel("grid", SharedMatrix.TYPE)
+        m1.insert_rows(0, 1)
+        m1.insert_cols(0, 1)
+        m1.set_cell(0, 0, 42)
+        shas = server.write_materialized_snapshots()
+        assert "doc" in shas
+        # A second write with no edits skips cleanly (incremental path
+        # groups the three sub-lanes under one display key).
+        shas2 = server.write_materialized_snapshots()
+        assert shas2["doc"] == shas["doc"]
+
+
+# ---------------------------------------------------------------------------
+# fast path (raw bytes through the native pump) vs object path
+# ---------------------------------------------------------------------------
+
+pytestmark_fast = pytest.mark.skipif(
+    not pump_mod.available(), reason="native wirepump unavailable")
+
+
+class _Ctx:
+    def checkpoint(self, *_):
+        pass
+
+    def error(self, err, restart=False):
+        raise err
+
+
+def _lam(emit, nack, **kw):
+    kw.setdefault("client_timeout_s", 0.0)
+    return TpuSequencerLambda(_Ctx(), emit=emit, nack=nack, **kw)
+
+
+def _qm(offset, doc, box, raw=False):
+    value = boxcar_to_wire(box) if raw else box
+    return QueuedMessage(topic="rawdeltas", partition=0, offset=offset,
+                         key=doc, value=value)
+
+
+def _mx_op(csn, op, chan="grid"):
+    return DocumentMessage(
+        client_sequence_number=csn, reference_sequence_number=csn - 1,
+        type=MessageType.OPERATION,
+        contents={"address": "s", "contents": {"address": chan,
+                                               "contents": op}})
+
+
+def _join(cid):
+    return DocumentMessage(0, -1, MessageType.CLIENT_JOIN,
+                           data=json.dumps({"clientId": cid,
+                                            "detail": {}}))
+
+
+def _matrix_traffic():
+    """Synthetic matrix wire traffic: axis run inserts (48-bit nonces),
+    axis removes, and cell writes, from one client."""
+    nonce = (1 << 47) + 12345
+    ops = []
+    csn = 1
+    ops.append(_mx_op(csn, {"target": "rows", "op": {
+        "type": 0, "pos1": 0, "seg": {"run": [nonce, 1, 0, 3]}}})); csn += 1
+    ops.append(_mx_op(csn, {"target": "cols", "op": {
+        "type": 0, "pos1": 0, "seg": {"run": [nonce, 2, 0, 2]}}})); csn += 1
+    ops.append(_mx_op(csn, {"target": "cell",
+                            "key": f"{nonce}.1.0|{nonce}.2.1",
+                            "value": {"v": 9}})); csn += 1
+    ops.append(_mx_op(csn, {"target": "rows", "op": {
+        "type": 1, "pos1": 1, "pos2": 2}})); csn += 1
+    ops.append(_mx_op(csn, {"target": "cell",
+                            "key": f"{nonce}.1.2|{nonce}.2.0",
+                            "value": "z"})); csn += 1
+    ops.append(_mx_op(csn, {"target": "rows", "op": {
+        "type": 0, "pos1": 2, "seg": {"run": [nonce, 3, 0, 1]}}})); csn += 1
+    return ops
+
+
+@pytestmark_fast
+class TestMatrixFastPath:
+    def test_fast_path_matches_object_path_without_fallback(self):
+        ea, eb = [], []
+        lam_a = _lam(lambda d, m: ea.append((d, m.sequence_number,
+                                            m.client_sequence_number)),
+                     lambda *a: None)
+        lam_b = _lam(lambda d, m: eb.append((d, m.sequence_number,
+                                            m.client_sequence_number)),
+                     lambda *a: None)
+        slow_calls = []
+        orig_handler = lam_b.handler
+        lam_b.handler = lambda msg: (slow_calls.append(msg),
+                                     orig_handler(msg))[1]
+
+        msgs = [_join("c1")] + _matrix_traffic()
+        for i, m in enumerate(msgs):
+            box = Boxcar("t", "doc",
+                         None if m.type != MessageType.OPERATION else "c1",
+                         [m])
+            lam_a.handler(_qm(i, "doc", box))
+            lam_b.handler_raw(_qm(i, "doc", box, raw=True))
+        lam_a.flush()
+        lam_b.flush()
+        lam_b.drain()
+
+        assert ea == eb and len(ea) == len(msgs)
+        # The fast path admitted the matrix rows natively — no slow-path
+        # fallback routing.
+        assert not slow_calls
+        ga = lam_a.channel_matrix("doc", "s", "grid")
+        gb = lam_b.channel_matrix("doc", "s", "grid")
+        assert ga == gb and ga is not None
+        assert any(v is not None for row in ga for v in row)
+
+    def test_malformed_matrix_shapes_fall_back_identically(self):
+        """Axis annotates / text-seg inserts / truncated runs are not
+        dds/matrix.py shapes: both paths must agree (fallback on the fast
+        path, host-object routing on the slow path)."""
+        bad_ops = [
+            {"target": "rows", "op": {"type": 2, "pos1": 0, "pos2": 1,
+                                      "props": {"x": 1}}},
+            {"target": "cols", "op": {"type": 0, "pos1": 0,
+                                      "seg": {"text": "zz"}}},
+            {"target": "rows", "op": {"type": 0, "pos1": 0,
+                                      "seg": {"run": [1, 2, 3]}}},
+        ]
+        ea, eb = [], []
+        lam_a = _lam(lambda d, m: ea.append((m.sequence_number,
+                                            m.client_sequence_number)),
+                     lambda *a: None)
+        lam_b = _lam(lambda d, m: eb.append((m.sequence_number,
+                                            m.client_sequence_number)),
+                     lambda *a: None)
+        msgs = [_join("c1")] + [_mx_op(i + 1, op)
+                                for i, op in enumerate(bad_ops)]
+        for i, m in enumerate(msgs):
+            box = Boxcar("t", "doc",
+                         None if m.type != MessageType.OPERATION else "c1",
+                         [m])
+            lam_a.handler(_qm(i, "doc", box))
+            lam_b.handler_raw(_qm(i, "doc", box, raw=True))
+        lam_a.flush()
+        lam_b.flush()
+        lam_b.drain()
+        assert ea == eb and len(ea) == len(msgs)
+        assert lam_a.channel_matrix("doc", "s", "grid") == \
+            lam_b.channel_matrix("doc", "s", "grid")
+
+
+class TestMatrixRoute:
+    def test_classification(self):
+        assert matrix_route({"target": "rows", "op": {
+            "type": 0, "pos1": 0, "seg": {"run": [1, 2, 0, 3]}}}) == "rows"
+        assert matrix_route({"target": "cols", "op": {
+            "type": 1, "pos1": 0, "pos2": 1}}) == "cols"
+        assert matrix_route({"target": "cell", "key": "a|b",
+                             "value": 1}) == "cell"
+        assert matrix_route({"target": "cell"}) is None
+        assert matrix_route({"type": 0, "pos1": 0,
+                             "seg": {"text": "x"}}) is None
+        assert matrix_route("nope") is None
