@@ -331,6 +331,131 @@ def _matrix_serving_ingest_rate(docs: int = 1024,
     }
 
 
+def _directory_serving_ingest_rate(docs: int = 1024,
+                                   ops_per_doc: int = 32) -> dict:
+    """SharedDirectory traffic through the SERVING path: root set/delete
+    ride the native fast path (FAM_LWW with composite path\\x1ekey
+    interning); pathed sets and structural ops route through the slow
+    path's host structure gate onto the same device LWW lanes.
+    Complements directory_merge (BASELINE #4), the live object-path
+    config."""
+    if os.environ.get("BENCH_INGEST", "1") == "0":
+        return {}
+    import jax as _jax
+    import json as _json
+    import random as _random
+
+    from fluidframework_tpu.protocol.messages import (Boxcar,
+                                                      DocumentMessage,
+                                                      MessageType)
+    from fluidframework_tpu.server.log import QueuedMessage
+    from fluidframework_tpu.server.tpu_sequencer import TpuSequencerLambda
+    from fluidframework_tpu.server.wire import boxcar_to_wire
+
+    if _jax.default_backend() not in ("tpu", "axon"):
+        docs, ops_per_doc = 256, 16
+    docs = int(os.environ.get("BENCH_DIR_INGEST_DOCS", docs))
+    ops_per_doc = int(os.environ.get("BENCH_DIR_INGEST_OPS", ops_per_doc))
+
+    class _Ctx:
+        def checkpoint(self, *_):
+            pass
+
+        def error(self, err, restart=False):
+            raise err
+
+    def build_wave(wave: int):
+        # Fallback routing is DOC-granular per flush: one pathed op routes
+        # a document's whole boxcar slow. Segregating roles per document
+        # (90% root-only docs = pure fast-path shapes, 10% pathed docs =
+        # slow path onto the same device lanes) keeps the measured mix
+        # actually exercising the native pump instead of 0.9^T of it.
+        rng = _random.Random(59 + wave)
+        out = []
+        base_csn = wave * ops_per_doc
+        for d in range(docs):
+            doc = f"dd{d}"
+            pathed_doc = d % 10 == 9
+            contents = []
+            if wave == 0:
+                contents.append(DocumentMessage(
+                    client_sequence_number=0,
+                    reference_sequence_number=-1,
+                    type=MessageType.CLIENT_JOIN,
+                    data=_json.dumps({"clientId": f"c{d}",
+                                      "detail": {}})))
+                contents.append(DocumentMessage(
+                    client_sequence_number=1,
+                    reference_sequence_number=0,
+                    type=MessageType.OPERATION,
+                    contents={"address": "s", "contents": {
+                        "address": "dir", "contents": {
+                            "type": "createSubDirectory", "path": "/",
+                            "name": "sub"}}}))
+            for i in range(ops_per_doc - (2 if wave == 0 else 0)):
+                csn = base_csn + i + 2
+                r = rng.random()
+                if pathed_doc and r < 0.5:
+                    # pathed sets: slow-path routed, same device lane
+                    op = {"type": "storage", "path": "/sub", "op": {
+                        "type": "set", "key": f"d{rng.randrange(16)}",
+                        "value": i, "pid": csn}}
+                elif r < 0.85:  # root sets: the fast-path shape
+                    op = {"type": "storage", "path": "/", "op": {
+                        "type": "set", "key": f"k{rng.randrange(32)}",
+                        "value": i, "pid": csn}}
+                else:
+                    op = {"type": "storage", "path": "/", "op": {
+                        "type": "delete",
+                        "key": f"k{rng.randrange(32)}", "pid": csn}}
+                contents.append(DocumentMessage(
+                    client_sequence_number=csn,
+                    reference_sequence_number=base_csn,
+                    type=MessageType.OPERATION,
+                    contents={"address": "s", "contents": {
+                        "address": "dir", "contents": op}}))
+            out.append(QueuedMessage(
+                topic="rawdeltas", partition=0, offset=wave * docs + d,
+                key=doc,
+                value=boxcar_to_wire(Boxcar(
+                    tenant_id="b", document_id=doc, client_id=f"c{d}",
+                    contents=contents))))
+        return out
+
+    nacks = []
+    lam = TpuSequencerLambda(_Ctx(), emit=lambda *a: None,
+                             nack=lambda *a: nacks.append(a),
+                             client_timeout_s=0.0)
+    lam.emit_window = lambda w: None
+    lam.pipelined = True
+    if lam._pump is None:
+        raise RuntimeError("native wirepump unavailable for dir bench")
+    for wave in (0, 1):
+        for qm in build_wave(wave):
+            lam.handler(qm)
+        lam.flush()
+    lam.drain()
+    steady = [build_wave(w) for w in (2, 3)]
+    t0 = time.perf_counter()
+    for msgs in steady:
+        for qm in msgs:
+            lam.handler(qm)
+        lam.flush()
+    lam.drain()
+    elapsed = time.perf_counter() - t0
+    if nacks:
+        raise RuntimeError(f"dir ingest bench nacked {len(nacks)} ops")
+    from fluidframework_tpu.server.tpu_sequencer import DIR_SUFFIX
+    if ("dd0", "s", "dir" + DIR_SUFFIX) not in lam.lww.where:
+        raise RuntimeError("directory ops did not reach the device lane")
+    total = 2 * docs * ops_per_doc
+    return {
+        "directory_serving_ops_per_sec": round(total / elapsed, 1),
+        "directory_serving_ops": total,
+        "directory_serving_docs": docs,
+    }
+
+
 def _keystroke_batch_rate(step, n_docs: int = 2048,
                           n_ops: int = 100) -> dict:
     """The headline pipeline on REALISTIC traffic: a batch of documents
@@ -769,7 +894,8 @@ def main() -> None:
                 ("singledoc_trace", _singledoc_trace_rate),
                 ("matrix_storm", _matrix_storm_rate),
                 ("matrix_serving", _matrix_serving_ingest_rate),
-                ("directory_merge", _directory_merge_rate)):
+                ("directory_merge", _directory_merge_rate),
+                ("directory_serving", _directory_serving_ingest_rate)):
             if time.perf_counter() > soft_deadline:
                 workload_extras[f"{name}_skipped"] = "bench soft deadline"
                 continue

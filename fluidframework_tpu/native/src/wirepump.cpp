@@ -404,6 +404,10 @@ struct OpFields {
   Span value;  // raw JSON span of op.value
   bool has_pid = false;
   bool has_ops = false;  // group op
+  // SharedDirectory envelope: {"type":"storage","path":...,"op":{...}}
+  bool has_path = false;
+  Span path;
+  bool path_esc = false;
   // SharedMatrix envelope (dds/matrix.py): {"target": ..., "op"|"key"/...}
   int mx = 0;                  // 0 none, 1 rows, 2 cols, 3 cell
   bool has_inner = false;      // "op": {...} parsed into *inner
@@ -498,7 +502,15 @@ bool parse_op_object(P& c, OpFields* f, OpFields* inner = nullptr) {
       c.bad = true;
       return false;
     }
-    if (key_is(c, k, "target")) {
+    if (key_is(c, k, "path")) {
+      ws(c);
+      if (peek(c, '"')) {
+        if (!str_token(c, &f->path, &f->path_esc)) return false;
+        f->has_path = true;
+      } else {
+        if (!skip_value(c)) return false;
+      }
+    } else if (key_is(c, k, "target")) {
       // SharedMatrix envelope discriminator (dds/matrix.py).
       ws(c);
       if (peek(c, '"')) {
@@ -949,6 +961,39 @@ bool parse_envelope(Ctx* ctx, P& c, int32_t doc, Row* r, ChanMemo* memo) {
   if (f.type_is_str) {
     std::string t;
     if (!span_str(c, f.type_s, f.type_esc, &t)) return true;
+    // SharedDirectory envelope (tpu_sequencer.directory_route): ROOT
+    // set/delete ride the LWW lane directly ("/" always exists, so the
+    // host path-existence gate is trivially satisfied); pathed ops,
+    // clears (expand to per-key deletes), and structural ops need the
+    // slow path's host structure tracking.
+    if (t == "storage" || t == "createSubDirectory" ||
+        t == "deleteSubDirectory") {
+      if (t == "storage" && f.has_path && f.has_inner) {
+        std::string path;
+        if (!span_str(c, f.path, f.path_esc, &path)) return true;
+        std::string it;
+        bool inner_str = fi.type_is_str &&
+            span_str(c, fi.type_s, fi.type_esc, &it);
+        if (path == "/" && inner_str && (it == "set" || it == "delete") &&
+            fi.has_key && fi.has_pid) {
+          std::string key;
+          if (!span_str(c, fi.key, fi.key_esc, &key)) return true;
+          static const std::string kDirSuffix("\0dir", 4);
+          r->v[C_FAMILY] = FAM_LWW;
+          r->v[C_CHAN] = intern_channel(ctx, doc, store, chan + kDirSuffix);
+          r->v[C_MKIND] = (it == "set") ? LW_SET : LW_DELETE;
+          r->v[C_POS1] = intern_lww_key(ctx, "/\x1e" + key);
+          if (it == "set" && fi.has_value) {
+            r->v[C_FLAGS] |= F_VALUE;
+            r->v[C_PSTART] = fi.value.a;
+            r->v[C_PEND] = fi.value.b;
+          }
+          return true;
+        }
+      }
+      r->v[C_FLAGS] |= F_FALLBACK;
+      return true;
+    }
     auto lww_common = [&](int kind, int32_t key_ord) {
       r->v[C_FAMILY] = FAM_LWW;
       r->v[C_CHAN] = memo_chan();

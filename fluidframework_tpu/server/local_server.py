@@ -461,7 +461,7 @@ class TpuLocalServer(LocalServer):
 
         from ..protocol.summary import SummaryHandle, SummaryTree
 
-        from .tpu_sequencer import matrix_base_key
+        from .tpu_sequencer import lane_base_key
 
         seq = self.sequencer()
         seq.drain()
@@ -472,7 +472,7 @@ class TpuLocalServer(LocalServer):
         # persist ATOMICALLY under their base channel key: a dirty row
         # axis must re-extract the cols/cells too, or the composed
         # snapshot would silently drop the unextracted parts.
-        base_of = {k: (matrix_base_key(k) or k) for k in all_keys}
+        base_of = {k: (lane_base_key(k) or k) for k in all_keys}
         display_keys = set(base_of.values())
 
         prev_sha: Dict[str, Optional[str]] = {}
@@ -521,6 +521,14 @@ class TpuLocalServer(LocalServer):
             if store_node is None:
                 store_node = root.add_tree(store_id)
             node = store_node.add_tree(channel_id)
+            if snap["header"].get("kind") == "directory":
+                # Composed directory channel in the EXACT summarize_core
+                # layout (dds/directory.py load_core reads the nested
+                # tree from the "header" blob — a different blob name
+                # would load as an empty directory).
+                node.add_blob("header", _json.dumps(snap["directory"],
+                                                    sort_keys=True))
+                continue
             node.add_blob("header", _json.dumps(snap["header"]))
             if "chunks" in snap:  # merge-tree channel: chunked body
                 for i, chunk in enumerate(snap["chunks"]):

@@ -584,6 +584,20 @@ def matrix_base_key(key: tuple) -> Optional[tuple]:
     return None
 
 
+def lane_base_key(key: tuple) -> Optional[tuple]:
+    """Base channel key for ANY composite sub-lane (matrix axes/cells,
+    directory); None for ordinary channels. Sub-lanes of one channel
+    must version and persist atomically (local_server incremental
+    summaries group by this)."""
+    base = matrix_base_key(key)
+    if base is not None:
+        return base
+    chan = key[2]
+    if isinstance(chan, str) and chan.endswith(DIR_SUFFIX):
+        return (key[0], key[1], chan[:-len(DIR_SUFFIX)])
+    return None
+
+
 def _compose_matrix_channels(out: Dict[tuple, dict]) -> None:
     """Recombine suffixed matrix sub-lane snapshots into ONE channel
     snapshot per matrix, keyed by the real channel name: the two axis
@@ -622,6 +636,94 @@ def _compose_matrix_channels(out: Dict[tuple, dict]) -> None:
             seq = max(seq, cells["header"]["sequenceNumber"])
         composed["header"]["sequenceNumber"] = seq
         out[base] = composed
+
+
+# SharedDirectory serving lane: the whole nested tree rides ONE LWW lane
+# with (path, key) pairs interned as composite keys (path "\x1e" key —
+# paths cannot contain the separator: subdirectory creates with such
+# names degrade the channel), plus a host-tracked set of existing paths
+# that gates storage ops exactly like the object path's
+# get_working_directory drop (reference packages/dds/map/src/
+# directory.ts:1624 subdirectory-scoped storage ops).
+DIR_SUFFIX = "\x00dir"
+DIR_SEP = "\x1e"
+_DIRECTORY_TYPE = "https://graph.microsoft.com/types/directory"
+
+
+def directory_route(op: Any) -> Optional[str]:
+    """Classify a SharedDirectory wire op (dds/directory.py submit
+    shapes): 'storage' / 'createSubDirectory' / 'deleteSubDirectory',
+    None for anything else."""
+    if not isinstance(op, dict):
+        return None
+    t = op.get("type")
+    if t == "storage" and isinstance(op.get("path"), str) \
+            and isinstance(op.get("op"), dict):
+        return "storage"
+    if t in ("createSubDirectory", "deleteSubDirectory") \
+            and isinstance(op.get("path"), str) \
+            and isinstance(op.get("name"), str):
+        return t
+    return None
+
+
+def _child_path(parent: str, name: str) -> str:
+    return parent.rstrip("/") + "/" + name
+
+
+def _norm_path(path: str) -> str:
+    """Canonical form matching SharedDirectory.get_working_directory's
+    resolution (empty segments skipped): '/sub/', '//sub' -> '/sub';
+    '', '/' -> '/'."""
+    parts = [p for p in path.strip("/").split("/") if p]
+    return "/" + "/".join(parts) if parts else "/"
+
+
+def _flatten_directory(data: dict):
+    """root.to_dict() nested form -> ({composite_key: value}, {paths}).
+    Raises ValueError on separator-bearing subdirectory names."""
+    entries: Dict[str, Any] = {}
+    paths = set()
+
+    def walk(node, path):
+        if not isinstance(node, dict):
+            raise ValueError("malformed directory node")
+        paths.add(path)
+        storage = node.get("storage", {})
+        if not isinstance(storage, dict):
+            raise ValueError("malformed directory storage")
+        for k, v in storage.items():
+            entries[path + DIR_SEP + k] = v
+        subs = node.get("subdirectories", {})
+        if not isinstance(subs, dict):
+            raise ValueError("malformed subdirectories")
+        for name, sub in subs.items():
+            if DIR_SEP in name:
+                raise ValueError("separator in subdirectory name")
+            walk(sub, _child_path(path, name))
+
+    walk(data, "/")
+    return entries, paths
+
+
+def _nest_directory(entries: Dict[str, Any], paths) -> dict:
+    """Inverse of _flatten_directory: lane entries + path set ->
+    root.to_dict() nested form."""
+    nodes = {"/": {"storage": {}, "subdirectories": {}}}
+    for p in sorted(paths, key=len):
+        if p == "/" or p in nodes:
+            continue
+        parent, _, name = p.rpartition("/")
+        parent = parent or "/"
+        node = {"storage": {}, "subdirectories": {}}
+        if parent in nodes:
+            nodes[parent]["subdirectories"][name] = node
+            nodes[p] = node
+    for comp, v in entries.items():
+        path, sep, key = comp.partition(DIR_SEP)
+        if sep and path in nodes:
+            nodes[path]["storage"][key] = v
+    return nodes["/"]
 
 
 def matrix_route(op: Any) -> Optional[str]:
@@ -770,6 +872,14 @@ class LwwLaneStore:
     def mark_dirty(self, key: tuple) -> None:
         self._gen_counter += 1
         self.change_gen[key] = self._gen_counter
+
+    def drop(self, key: tuple) -> None:
+        """Degrade a channel to opaque (unmodelable content): its device
+        lane is abandoned (mirrors MergeLaneStore.drop)."""
+        self.opaque.add(key)
+        if key in self.where:
+            b, lane = self.where.pop(key)
+            self.buckets[b].free(lane)
 
     def seed(self, key: tuple, kind: str, header: Any) -> bool:
         """Bootstrap a lane from a summary header (map entries / cell
@@ -1224,10 +1334,14 @@ class _SummaryProbe:
     def __init__(self, sequence_number: int,
                  channels: Dict[Tuple[str, str], tuple],
                  lww_channels: Optional[Dict[Tuple[str, str],
-                                             tuple]] = None):
+                                             tuple]] = None,
+                 dir_paths: Optional[Dict[Tuple[str, str], set]] = None):
         self.sequence_number = sequence_number
         self.channels = channels
         self.lww_channels = lww_channels or {}
+        # (store, chan+DIR_SUFFIX) -> existing-path set for directory
+        # channels (seeded alongside the flattened LWW entries).
+        self.dir_paths = dir_paths or {}
 
 
 # Channel types the LWW lanes can seed from a summary header.
@@ -1258,6 +1372,7 @@ def _parse_summary_probe(tree) -> Optional[_SummaryProbe]:
         return None
     channels: Dict[Tuple[str, str], tuple] = {}
     lww_channels: Dict[Tuple[str, str], tuple] = {}
+    dir_paths: Dict[Tuple[str, str], set] = {}
     for store_id, store_tree in stores.entries.items():
         if not hasattr(store_tree, "entries"):
             continue
@@ -1302,6 +1417,26 @@ def _parse_summary_probe(tree) -> Optional[_SummaryProbe]:
                               channel_id + MATRIX_CELLS_SUFFIX)] = (
                     "map", cells)
                 continue
+            if ctype == _DIRECTORY_TYPE:
+                # Directory snapshots (dds/directory.py summarize_core):
+                # the nested tree flattens into one LWW seed + the
+                # existing-path set that gates storage ops.
+                lane_name = channel_id + DIR_SUFFIX
+                try:
+                    data = _json.loads(node.entries["header"].content)
+                    entries, paths = _flatten_directory(data)
+                except (ValueError, TypeError, KeyError, AttributeError):
+                    # Unflattenable snapshot (separator-bearing names,
+                    # malformed tree): DEGRADE the lane, don't skip —
+                    # a fresh empty lane would silently serve a tree
+                    # missing the snapshot content. The unknown seed
+                    # kind makes lww.seed mark the channel opaque.
+                    lww_channels[(store_id, lane_name)] = (
+                        "unmodelable-directory", None)
+                    continue
+                lww_channels[(store_id, lane_name)] = ("map", entries)
+                dir_paths[(store_id, lane_name)] = paths
+                continue
             if "header" not in node.entries:
                 continue
             try:
@@ -1323,7 +1458,7 @@ def _parse_summary_probe(tree) -> Optional[_SummaryProbe]:
             except (ValueError, TypeError, KeyError, AttributeError):
                 continue  # malformed client channel: skip, don't crash
             channels[(store_id, channel_id)] = payload
-    return _SummaryProbe(seq, channels, lww_channels)
+    return _SummaryProbe(seq, channels, lww_channels, dir_paths)
 
 
 class TpuSequencerLambda(IPartitionLambda):
@@ -1442,6 +1577,9 @@ class TpuSequencerLambda(IPartitionLambda):
         self._pump_lane = np.full(64, -1, np.int32)  # pump ord -> lane
         self._pump_chan: List[tuple] = []           # chan ord -> key tuple
         self._lww_key_map = np.full(64, -1, np.int32)  # key ord -> kid
+        # Directory lanes: lane key -> set of existing subdirectory paths
+        # (host structure; rebuilt by replay, seeded from summaries).
+        self._dir_paths: Dict[tuple, set] = {}
         try:
             from . import pump as _pump_mod
             if _pump_mod.available():
@@ -1508,8 +1646,19 @@ class TpuSequencerLambda(IPartitionLambda):
             for (store, channel), payload in probe.channels.items():
                 self.merge.seed((doc_id, store, channel), *payload)
             for (store, channel), payload in probe.lww_channels.items():
-                self.lww.seed((doc_id, store, channel), *payload)
+                self._seed_lww((doc_id, store, channel), payload, probe)
         return probe
+
+    def _seed_lww(self, key: tuple, payload: tuple,
+                  probe: _SummaryProbe) -> bool:
+        """lww.seed + directory path-set installation: a directory lane's
+        existence gate must come up with the same snapshot the entries
+        seeded from."""
+        ok = self.lww.seed(key, *payload)
+        if ok and key[2].endswith(DIR_SUFFIX) and key not in self._dir_paths:
+            self._dir_paths[key] = set(
+                probe.dir_paths.get((key[1], key[2]), {"/"}))
+        return ok
 
     def _rebuild_merge(self) -> None:
         """Crash-restart: rebuild the device merge lanes by replaying each
@@ -1538,7 +1687,7 @@ class TpuSequencerLambda(IPartitionLambda):
                 for (store, channel), payload in \
                         probe.lww_channels.items():
                     key = (doc_id, store, channel)
-                    if self.lww.seed(key, *payload):
+                    if self._seed_lww(key, payload, probe):
                         seeded_before[key] = probe.sequence_number
             # Bound at the restored checkpoint's last seq: deltas persisted
             # by a flush that crashed before checkpointing will be
@@ -2059,7 +2208,7 @@ class TpuSequencerLambda(IPartitionLambda):
                 if probe is not None:
                     payload = probe.lww_channels.get((key[1], key[2]))
                     if payload is not None:
-                        self.lww.seed(key, *payload)
+                        self._seed_lww(key, payload, probe)
                         if key in self.lww.opaque:
                             continue
             bb, ll = self.lww.lane_for(key)
@@ -2636,6 +2785,13 @@ class TpuSequencerLambda(IPartitionLambda):
             return
         op = envelope.get("contents")
         key = (doc_id, contents.get("address"), envelope.get("address"))
+        droute = directory_route(op)
+        if droute is not None:
+            self._route_directory(
+                lww_streams,
+                (doc_id, key[1], key[2] + DIR_SUFFIX),
+                droute, op, seq, seeded_before)
+            return
         route = matrix_route(op)
         if route is not None:
             # SharedMatrix: axis ops ride merge lanes under suffixed
@@ -2700,12 +2856,112 @@ class TpuSequencerLambda(IPartitionLambda):
             if probe is not None:
                 payload = probe.lww_channels.get((key[1], key[2]))
                 if payload is not None and seq > probe.sequence_number:
-                    self.lww.seed(key, *payload)
+                    self._seed_lww(key, payload, probe)
         try:
             lww_streams.setdefault(key, []).append(
                 self.lww.wire_to_op(op, seq))
         except Unmodelable:
             pass
+
+    def _route_directory(self, lww_streams: Dict[tuple, List[tuple]],
+                         key: tuple, kind: str, op: dict, seq: int,
+                         seeded_before: Optional[Dict[tuple, int]]
+                         ) -> None:
+        """SharedDirectory op -> the channel's LWW lane: (path, key)
+        pairs intern as composite keys, a pathed clear expands to
+        per-key deletes, and structural ops evolve the host path set
+        that gates storage ops (object-path drop semantics for
+        since-deleted subdirectories). Reference
+        packages/dds/map/src/directory.ts:1624."""
+        if key in self.lww.opaque:
+            return
+        if seeded_before is not None and seq <= seeded_before.get(key, 0):
+            return  # already reflected in the seeded snapshot base
+        if key not in self.lww.where:
+            probe = self._probe_summary(key[0])
+            if probe is not None:
+                payload = probe.lww_channels.get((key[1], key[2]))
+                if payload is not None and seq > probe.sequence_number:
+                    self._seed_lww(key, payload, probe)
+                    if key in self.lww.opaque:
+                        return
+        paths = self._dir_paths.setdefault(key, {"/"})
+
+        def emit(wire_op):
+            try:
+                lww_streams.setdefault(key, []).append(
+                    self.lww.wire_to_op(wire_op, seq))
+            except Unmodelable:
+                pass
+
+        def lane_keys():
+            """The channel's live composite keys: lane state + anything
+            emitted earlier in THIS batch (not yet applied). Bounds
+            clear/subtree-delete expansion to the channel, never the
+            server-wide intern table."""
+            names = set()
+            snap = self.lww.snapshot(key)
+            if snap is not None:
+                names.update(snap["entries"])
+            for (k_kind, kid, *_rest) in lww_streams.get(key, []):
+                if kid >= 0:
+                    name = self.lww.key_names[kid]
+                    if k_kind == self.lww.lk.LwwKind.SET:
+                        names.add(name)
+                    else:
+                        names.discard(name)
+            return names
+
+        if kind == "storage":
+            path, kop = _norm_path(op["path"]), op["op"]
+            if path not in paths:
+                return  # object semantics: target subdir no longer exists
+            t = kop.get("type")
+            if t == "set" and isinstance(kop.get("key"), str):
+                emit({"type": "set", "key": path + DIR_SEP + kop["key"],
+                      "value": kop.get("value")})
+            elif t == "delete" and isinstance(kop.get("key"), str):
+                emit({"type": "delete",
+                      "key": path + DIR_SEP + kop["key"]})
+            elif t == "clear":
+                # Path-scoped clear: expand to deletes over the
+                # channel's keys under this exact path.
+                prefix = path + DIR_SEP
+                for name in sorted(lane_keys()):
+                    if name.startswith(prefix):
+                        emit({"type": "delete", "key": name})
+            else:
+                # Unknown storage-kernel shape: the lane can no longer
+                # track the object path — degrade this one channel.
+                self.lww.drop(key)
+                self._dir_paths.pop(key, None)
+        elif kind == "createSubDirectory":
+            parent, name = _norm_path(op["path"]), op["name"]
+            if DIR_SEP in name or "/" in name:
+                # A separator-bearing name would make composite keys
+                # ambiguous; a slash-bearing name is unresolvable by
+                # get_working_directory on the clients themselves.
+                # Degrade: the host object path remains authoritative.
+                self.lww.drop(key)
+                self._dir_paths.pop(key, None)
+                return
+            if parent in paths:
+                paths.add(_child_path(parent, name))
+            self.lww.lane_for(key)
+            self.lww.mark_dirty(key)
+        else:  # deleteSubDirectory
+            child = _child_path(_norm_path(op["path"]), op["name"])
+            gone = {p for p in paths
+                    if p == child or p.startswith(child + "/")}
+            if not gone:
+                return
+            paths -= gone
+            for name in sorted(lane_keys()):
+                p, sep, _ = name.partition(DIR_SEP)
+                if sep and p in gone:
+                    emit({"type": "delete", "key": name})
+            self.lww.lane_for(key)
+            self.lww.mark_dirty(key)
 
     # -- batched server-side summarization ---------------------------------
     def summarize_documents(self, chunk_chars: int = 10000,
@@ -2731,7 +2987,26 @@ class TpuSequencerLambda(IPartitionLambda):
                     "counter": snap["counter"],
                 }
         _compose_matrix_channels(out)
+        self._compose_directory_channels(out)
         return out
+
+    def _compose_directory_channels(self, out: Dict[tuple, dict]) -> None:
+        """Recombine directory lane snapshots (flattened composite-key
+        entries + the host path set) into the nested root.to_dict() form
+        under the real channel key."""
+        for key in [k for k in out
+                    if isinstance(k[2], str) and k[2].endswith(DIR_SUFFIX)]:
+            part = out.pop(key)
+            base = (key[0], key[1], key[2][:-len(DIR_SUFFIX)])
+            out[base] = {
+                "header": {
+                    "kind": "directory",
+                    "sequenceNumber": part["header"]["sequenceNumber"],
+                },
+                "directory": _nest_directory(
+                    part.get("entries", {}),
+                    self._dir_paths.get(key, {"/"})),
+            }
 
     def summarize_documents_async(self, on_done,
                                   chunk_chars: int = 10000):
@@ -2757,6 +3032,9 @@ class TpuSequencerLambda(IPartitionLambda):
                     "entries": snap["entries"],
                     "counter": snap["counter"],
                 }
+        # Directory composition reads the live path sets — do it now,
+        # synchronously, so the worker thread never races a later flush.
+        self._compose_directory_channels(lww_part)
 
         def work():
             out = self.merge.extract_assemble(jobs, chunk_chars)
@@ -2818,6 +3096,20 @@ class TpuSequencerLambda(IPartitionLambda):
         col_ids = axis_ids(MATRIX_COLS_SUFFIX)
         return [[cells.get(id_key(r) + "|" + id_key(c))
                  for c in col_ids] for r in row_ids]
+
+    def channel_directory(self, doc_id: str, store: str,
+                          channel: str) -> Optional[dict]:
+        """Server-materialized directory tree in root.to_dict() form
+        (nested storage + subdirectories) from the channel's LWW lane +
+        host path set — comparable 1:1 with SharedDirectory.root.to_dict()
+        on a caught-up client. None when no directory lane exists."""
+        self.drain()
+        key = (doc_id, store, channel + DIR_SUFFIX)
+        snap = self.lww.snapshot(key)
+        if snap is None and key not in self._dir_paths:
+            return None
+        return _nest_directory(snap["entries"] if snap else {},
+                               self._dir_paths.get(key, {"/"}))
 
     def document_seq(self, doc_id: str) -> int:
         dl = self.docs.get(doc_id)

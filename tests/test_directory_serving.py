@@ -1,0 +1,326 @@
+"""SharedDirectory on the device serving path: the nested tree rides ONE
+LWW lane with (path, key) pairs interned as composite keys + a
+host-tracked path set gating storage ops (reference
+packages/dds/map/src/directory.ts:1624 subdirectory-scoped ops).
+Differential-locked against the client object path (root.to_dict()), and
+the raw fast path against the object slow path."""
+
+import json
+import random
+
+import pytest
+
+from fluidframework_tpu.dds.directory import SharedDirectory
+from fluidframework_tpu.loader.container import Loader
+from fluidframework_tpu.loader.drivers.local import (
+    LocalDocumentServiceFactory,
+)
+from fluidframework_tpu.protocol.messages import (
+    Boxcar,
+    DocumentMessage,
+    MessageType,
+)
+from fluidframework_tpu.server import pump as pump_mod
+from fluidframework_tpu.server.local_server import TpuLocalServer
+from fluidframework_tpu.server.log import QueuedMessage
+from fluidframework_tpu.server.tpu_sequencer import (
+    DIR_SUFFIX,
+    TpuSequencerLambda,
+    directory_route,
+)
+from fluidframework_tpu.server.wire import boxcar_to_wire
+
+
+def make_doc(server, doc_id="doc"):
+    loader = Loader(LocalDocumentServiceFactory(server))
+    container = loader.create_detached(doc_id)
+    ds = container.runtime.create_datastore("default")
+    return loader, container, ds
+
+
+class TestDirectoryServingE2E:
+    def test_server_materializes_nested_directory(self):
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        d1 = ds1.create_channel("dir", SharedDirectory.TYPE)
+        c2 = loader.resolve("doc")
+        d2 = c2.runtime.get_datastore("default").get_channel("dir")
+
+        d1.set("rootkey", 1)
+        sub = d1.create_sub_directory("a")
+        sub.set("x", "deep")
+        nested = sub.create_sub_directory("b")
+        nested.set("y", [1, 2])
+        d2.set("rootkey", 2)  # LWW overwrite from the other client
+        d2.get_working_directory("/a").delete("x")
+
+        seq = server.sequencer()
+        assert ("doc", "default", "dir" + DIR_SUFFIX) in seq.lww.where
+        tree = seq.channel_directory("doc", "default", "dir")
+        assert tree == d1.root.to_dict() == d2.root.to_dict()
+        assert tree["subdirectories"]["a"]["subdirectories"]["b"][
+            "storage"]["y"] == [1, 2]
+
+    def test_clear_and_subtree_delete(self):
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        d1 = ds1.create_channel("dir", SharedDirectory.TYPE)
+        c2 = loader.resolve("doc")
+        d2 = c2.runtime.get_datastore("default").get_channel("dir")
+
+        sub = d1.create_sub_directory("s")
+        sub.set("k1", 1)
+        sub.set("k2", 2)
+        deep = sub.create_sub_directory("d")
+        deep.set("k3", 3)
+        d1.set("keep", "root")
+        # Path-scoped clear: only /s keys, not /s/d or root.
+        d2.get_working_directory("/s").clear()
+        tree = server.sequencer().channel_directory("doc", "default", "dir")
+        assert tree == d1.root.to_dict() == d2.root.to_dict()
+        assert tree["storage"] == {"keep": "root"}
+        assert tree["subdirectories"]["s"]["storage"] == {}
+        assert tree["subdirectories"]["s"]["subdirectories"]["d"][
+            "storage"] == {"k3": 3}
+        # Subtree delete removes structure AND values.
+        d1.root.delete_sub_directory("s")
+        tree = server.sequencer().channel_directory("doc", "default", "dir")
+        assert tree == d1.root.to_dict() == d2.root.to_dict()
+        assert tree["subdirectories"] == {}
+
+    def test_storage_op_on_deleted_path_drops(self):
+        """A set addressed to a since-deleted subdirectory must be
+        dropped on the serving lane exactly as the object path drops it
+        (get_working_directory returns None)."""
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        d1 = ds1.create_channel("dir", SharedDirectory.TYPE)
+        c2 = loader.resolve("doc")
+        d2 = c2.runtime.get_datastore("default").get_channel("dir")
+        sub1 = d1.create_sub_directory("gone")
+        sub1.set("a", 1)
+        # c2's view of /gone before the delete:
+        sub2 = d2.get_working_directory("/gone")
+        d1.root.delete_sub_directory("gone")
+        sub2.set("b", 2)  # sequenced AFTER the delete: dropped everywhere
+        tree = server.sequencer().channel_directory("doc", "default", "dir")
+        assert tree == d1.root.to_dict() == d2.root.to_dict()
+        assert tree["subdirectories"] == {}
+
+    def test_random_directory_merge_matches_clients(self):
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        d1 = ds1.create_channel("dir", SharedDirectory.TYPE)
+        c2 = loader.resolve("doc")
+        d2 = c2.runtime.get_datastore("default").get_channel("dir")
+        rng = random.Random(5)
+        names = ["a", "b", "c"]
+        for step in range(120):
+            d = rng.choice([d1, d2])
+            act = rng.random()
+            paths = ["/"]
+            for n1 in names:
+                if d.get_working_directory("/" + n1) is not None:
+                    paths.append("/" + n1)
+                    for n2 in names:
+                        if d.get_working_directory(
+                                f"/{n1}/{n2}") is not None:
+                            paths.append(f"/{n1}/{n2}")
+            path = rng.choice(paths)
+            wd = d.root if path == "/" else d.get_working_directory(path)
+            if act < 0.15 and path.count("/") < 3:
+                wd.create_sub_directory(rng.choice(names))
+            elif act < 0.22 and path != "/":
+                parent, _, name = path.rpartition("/")
+                pd = d.root if not parent else \
+                    d.get_working_directory(parent)
+                if pd is not None:
+                    pd.delete_sub_directory(name)
+            elif act < 0.3:
+                wd.clear()
+            elif act < 0.4:
+                wd.delete(f"k{rng.randrange(4)}")
+            else:
+                wd.set(f"k{rng.randrange(4)}", step)
+        assert d1.root.to_dict() == d2.root.to_dict()
+        tree = server.sequencer().channel_directory("doc", "default", "dir")
+        assert tree == d1.root.to_dict()
+
+    def test_attach_summary_seeds_directory_lane(self):
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        d1 = ds1.create_channel("dir", SharedDirectory.TYPE)
+        d1.set("pre", "attach")
+        sub = d1.create_sub_directory("s")
+        sub.set("deep", True)
+        c1.attach()
+        c2 = loader.resolve("doc")
+        d2 = c2.runtime.get_datastore("default").get_channel("dir")
+        assert d2.get("pre") == "attach"
+        d2.get_working_directory("/s").set("post", 1)
+        d1.set("pre", "updated")
+        tree = server.sequencer().channel_directory("doc", "default", "dir")
+        assert tree == d1.root.to_dict() == d2.root.to_dict()
+
+    def test_restart_rebuilds_directory_lane(self):
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        d1 = ds1.create_channel("dir", SharedDirectory.TYPE)
+        d1.set("k", 1)
+        sub = d1.create_sub_directory("s")
+        sub.set("x", 2)
+        server._deli_mgr.restart()
+        sub.set("y", 3)
+        d1.delete("k")
+        c2 = loader.resolve("doc")
+        d2 = c2.runtime.get_datastore("default").get_channel("dir")
+        assert d1.root.to_dict() == d2.root.to_dict()
+        tree = server.sequencer().channel_directory("doc", "default", "dir")
+        assert tree == d1.root.to_dict()
+
+    def test_composed_summary_loads_into_client_directory(self):
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        d1 = ds1.create_channel("dir", SharedDirectory.TYPE)
+        d1.set("r", 0)
+        sub = d1.create_sub_directory("s")
+        sub.set("x", {"nested": True})
+        snaps = server.sequencer().summarize_documents()
+        key = ("doc", "default", "dir")
+        assert key in snaps
+        snap = snaps[key]
+        assert snap["header"]["kind"] == "directory"
+        assert not any(k[2].endswith(DIR_SUFFIX) for k in snaps)
+        loaded = SharedDirectory("loaded")
+        loaded.root.load_dict(snap["directory"])
+        assert loaded.root.to_dict() == d1.root.to_dict()
+
+    def test_materialized_snapshot_write_includes_directory(self):
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        d1 = ds1.create_channel("dir", SharedDirectory.TYPE)
+        d1.create_sub_directory("s").set("x", 1)
+        shas = server.write_materialized_snapshots()
+        assert "doc" in shas
+        shas2 = server.write_materialized_snapshots()
+        assert shas2["doc"] == shas["doc"]
+
+
+# ---------------------------------------------------------------------------
+# fast path vs object path
+# ---------------------------------------------------------------------------
+
+pytestmark_fast = pytest.mark.skipif(
+    not pump_mod.available(), reason="native wirepump unavailable")
+
+
+class _Ctx:
+    def checkpoint(self, *_):
+        pass
+
+    def error(self, err, restart=False):
+        raise err
+
+
+def _lam(emit):
+    return TpuSequencerLambda(_Ctx(), emit=emit, nack=lambda *a: None,
+                              client_timeout_s=0.0)
+
+
+def _dir_op(csn, op, chan="dir"):
+    return DocumentMessage(
+        client_sequence_number=csn, reference_sequence_number=csn - 1,
+        type=MessageType.OPERATION,
+        contents={"address": "s", "contents": {"address": chan,
+                                               "contents": op}})
+
+
+def _join(cid):
+    return DocumentMessage(0, -1, MessageType.CLIENT_JOIN,
+                           data=json.dumps({"clientId": cid,
+                                            "detail": {}}))
+
+
+def _run_both(ops):
+    ea, eb = [], []
+    lam_a = _lam(lambda d, m: ea.append((m.sequence_number,
+                                         m.client_sequence_number)))
+    lam_b = _lam(lambda d, m: eb.append((m.sequence_number,
+                                         m.client_sequence_number)))
+    fallbacks = []
+    orig = lam_b.handler
+    lam_b.handler = lambda qm: (fallbacks.append(qm), orig(qm))[1]
+    msgs = [_join("c1")] + [_dir_op(i + 1, op)
+                            for i, op in enumerate(ops)]
+    for i, m in enumerate(msgs):
+        box = Boxcar("t", "doc",
+                     None if m.type != MessageType.OPERATION else "c1",
+                     [m])
+        lam_a.handler(QueuedMessage("rawdeltas", 0, i, "doc", box))
+        lam_b.handler_raw(QueuedMessage("rawdeltas", 0, i, "doc",
+                                        boxcar_to_wire(box)))
+    lam_a.flush()
+    lam_b.flush()
+    lam_b.drain()
+    assert ea == eb and len(ea) == len(msgs)
+    return lam_a, lam_b, fallbacks
+
+
+@pytestmark_fast
+class TestDirectoryFastPath:
+    def test_root_sets_ride_fast_without_fallback(self):
+        ops = [
+            {"type": "storage", "path": "/", "op": {
+                "type": "set", "key": "a", "value": 1, "pid": 1}},
+            {"type": "storage", "path": "/", "op": {
+                "type": "set", "key": "b", "value": {"x": [1]}, "pid": 2}},
+            {"type": "storage", "path": "/", "op": {
+                "type": "delete", "key": "a", "pid": 3}},
+        ]
+        A, B, fallbacks = _run_both(ops)
+        assert not fallbacks  # root set/delete admitted natively
+        ta = A.channel_directory("doc", "s", "dir")
+        tb = B.channel_directory("doc", "s", "dir")
+        assert ta == tb == {"storage": {"b": {"x": [1]}},
+                            "subdirectories": {}}
+
+    def test_pathed_and_structural_ops_fall_back_identically(self):
+        ops = [
+            {"type": "createSubDirectory", "path": "/", "name": "s"},
+            {"type": "storage", "path": "/s", "op": {
+                "type": "set", "key": "x", "value": 9, "pid": 1}},
+            {"type": "storage", "path": "/", "op": {
+                "type": "set", "key": "r", "value": 0, "pid": 2}},
+            {"type": "storage", "path": "/s", "op": {
+                "type": "clear", "pid": 3}},
+            {"type": "deleteSubDirectory", "path": "/", "name": "s"},
+        ]
+        A, B, fallbacks = _run_both(ops)
+        assert fallbacks  # structural/pathed ops routed slow (by design)
+        ta = A.channel_directory("doc", "s", "dir")
+        tb = B.channel_directory("doc", "s", "dir")
+        assert ta == tb == {"storage": {"r": 0}, "subdirectories": {}}
+
+
+class TestDirectoryRoute:
+    def test_classification(self):
+        assert directory_route({"type": "storage", "path": "/",
+                                "op": {"type": "set", "key": "k",
+                                       "pid": 1}}) == "storage"
+        assert directory_route({"type": "createSubDirectory",
+                                "path": "/", "name": "a"}) == \
+            "createSubDirectory"
+        assert directory_route({"type": "deleteSubDirectory",
+                                "path": "/", "name": "a"}) == \
+            "deleteSubDirectory"
+        assert directory_route({"type": "set", "key": "k",
+                                "pid": 1}) is None
+        assert directory_route({"type": "storage", "path": 3,
+                                "op": {}}) is None
