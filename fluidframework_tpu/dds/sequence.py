@@ -216,6 +216,18 @@ class IntervalCollection(TypedEventEmitter):
             end, REF_SLIDE_ON_REMOVE, ref_seq=ref_seq, client=client)
 
 
+class _ShapeCheckBuilder:
+    """Payload-free OpBuilder stand-in: wire_to_host_ops drives it for
+    PREVALIDATION only — every shape branch still runs (so Unmodelable
+    raises exactly as in the real conversion) but nothing is built or
+    retained."""
+
+    def _noop(self, *args, **kwargs):
+        return None
+
+    insert_text = insert_marker = remove = annotate = _noop
+
+
 class SharedSegmentSequence(SharedObject):
     TYPE = "https://graph.microsoft.com/types/mergeTree"
 
@@ -432,31 +444,42 @@ class SharedSegmentSequence(SharedObject):
     def process_bulk_core(self, batch) -> None:
         """Device bulk catch-up: apply a run of remote sequenced ops
         [(contents, seq, ref_seq, client_ordinal, min_seq)] through the
-        merge-tree kernel in one pass (mergetree/catchup.py; reference
+        merge-tree kernel (mergetree/catchup.py; reference
         deltaManager.ts:1380-1401 catch-up, vectorized).
 
-        Raises Unmodelable/ValueError — with channel state untouched — when
-        the scalar path is required: interval ops in the run, live local
-        references (they slide per-op), or pending local state."""
-        from ..mergetree.catchup import Unmodelable
+        Interval ops never touch segment state, so the batch SPLITS at
+        them: merge runs ride the kernel, interval ops apply host-side
+        between runs at their own (ref_seq, client) perspectives — the
+        reference's shape-agnostic catch-up without giving up the device
+        path for the whole tail. Merge runs executed while live local
+        references exist (interval anchors created earlier in this very
+        batch, or pre-existing) go scalar per-op instead: references
+        slide per-op and do not survive the kernel round trip.
 
-        if self._interval_collections or self._pending_interval_ops:
-            raise Unmodelable("interval collections require per-op apply")
+        Raises Unmodelable/ValueError — with channel state UNTOUCHED —
+        only from prevalidation (own sequenced merge ops, unmodelable
+        shapes); once application starts, a surprise kernel refusal
+        finishes the remaining runs scalar rather than raising."""
+        from ..mergetree.catchup import Unmodelable, wire_to_host_ops
+
+        def is_interval(contents) -> bool:
+            return isinstance(contents, dict) and \
+                contents.get("type") == "intervalCollection"
+
         if self._lazy is not None:
             # Lazy body pending: absorb the run as deferrals so the doc
             # STAYS lazy through catch-up (touching self.client below
             # would materialize just to probe preconditions; a fresh
             # snapshot load has no local refs or pendings, so those
             # probes are vacuous while lazy). All-or-nothing: on any
-            # non-deferrable op the tentative deferrals roll back so the
-            # fallback path — scalar (Unmodelable) or kernel-over-the-
-            # full-run — never applies an op twice.
+            # non-deferrable op (incl. interval ops, which need live
+            # anchors) the tentative deferrals roll back, the body
+            # materializes, and the run-splitting path below takes over.
             mark = len(self._deferred_remote)
-            len0, ok, has_interval = self._lazy_len, True, False
+            len0, ok = self._lazy_len, True
             for contents, seq, ref_seq, ordinal, min_seq in batch:
-                if isinstance(contents, dict) and \
-                        contents.get("type") == "intervalCollection":
-                    ok, has_interval = False, True
+                if is_interval(contents):
+                    ok = False
                     break
                 d = self._op_len_delta(contents, ref_seq, ordinal)
                 if d is None:
@@ -471,21 +494,75 @@ class SharedSegmentSequence(SharedObject):
                 return
             del self._deferred_remote[mark:]
             self._lazy_len = len0
-            if has_interval:
-                raise Unmodelable("interval op in bulk run")
-            # Tail needs the body: self.client below materializes
-            # (replaying only previously deferred ops), then the kernel
-            # pass takes the whole run.
-        if any(seg.local_refs for seg in self.client.tree.segments):
-            raise Unmodelable("local references require per-op sliding")
-        tail = []
-        for contents, seq, ref_seq, ordinal, min_seq in batch:
-            if isinstance(contents, dict) and \
-                    contents.get("type") == "intervalCollection":
-                raise Unmodelable("interval op in bulk run")
-            tail.append((contents, seq, ref_seq, ordinal, min_seq))
-        self.client.apply_bulk(tail)
-        self.bulk_catchup_count += 1
+
+        # --- split into alternating merge runs / interval ops ------------
+        runs: List[tuple] = []
+        for item in batch:
+            if is_interval(item[0]):
+                runs.append(("interval", item))
+            else:
+                if not runs or runs[-1][0] != "merge":
+                    runs.append(("merge", []))
+                runs[-1][1].append(item)
+
+        # --- prevalidation (the all-or-nothing contract) ------------------
+        my_ordinal = self.client.client_id
+        shape_check = _ShapeCheckBuilder()
+        for kind, data in runs:
+            if kind != "merge":
+                continue
+            for contents, seq, ref_seq, ordinal, min_seq in data:
+                if ordinal == my_ordinal:
+                    raise Unmodelable(
+                        "own sequenced ops in tail need ack pairing")
+                # Payload-free shape check — raises Unmodelable on
+                # content the kernel cannot represent, BEFORE any state
+                # changes (the real conversion happens once, inside
+                # apply_bulk).
+                wire_to_host_ops(shape_check, contents, seq, ref_seq,
+                                 ordinal, min_seq or 0, allow_items=True)
+
+        # --- apply --------------------------------------------------------
+        # Past this point nothing may raise Unmodelable/ValueError: the
+        # container's scalar fallback assumes channel state is untouched,
+        # and earlier runs HAVE applied — an escaping error would
+        # double-apply the batch. Unexpected errors surface as
+        # RuntimeError, which the fallback does not catch.
+        kernel_used = False
+        try:
+            for kind, data in runs:
+                if kind == "interval":
+                    contents, seq, ref_seq, ordinal, min_seq = data
+                    local = ordinal == my_ordinal
+                    if local:
+                        self._pending_interval_ops.pop(
+                            contents.get("uid"), None)
+                    self.get_interval_collection(
+                        contents["label"])._process(
+                        contents["op"], local, ref_seq, ordinal)
+                    self.client.tree.update_seq(seq)
+                    if min_seq is not None and \
+                            min_seq > self.client.tree.min_seq:
+                        self.client.tree.set_min_seq(min_seq)
+                    continue
+                scalar = any(seg.local_refs
+                             for seg in self.client.tree.segments)
+                if not scalar:
+                    try:
+                        self.client.apply_bulk(data)
+                        kernel_used = True
+                        continue
+                    except (Unmodelable, ValueError):
+                        scalar = True  # rare late refusal (capacity
+                        # ceiling): finish this run per-op
+                for contents, seq, ref_seq, ordinal, min_seq in data:
+                    self.client.apply_msg(contents, seq, ref_seq, ordinal,
+                                          min_seq=min_seq)
+        except (Unmodelable, ValueError) as err:
+            raise RuntimeError(
+                f"bulk catch-up failed mid-application: {err}") from err
+        if kernel_used:
+            self.bulk_catchup_count += 1
 
     def resubmit_pending(self) -> List[Any]:
         if self._lazy is not None:

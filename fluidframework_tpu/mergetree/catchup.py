@@ -147,6 +147,24 @@ def seed_device_state(entries: Sequence[dict], payloads: PayloadTable,
                          "origin_op", "origin_off")}
     rem_client = np.full(n, -1, np.int32)
     cols["rem_seq"][:] = DEV_NO_REMOVE
+    if anno_slots is None:
+        from .state import DEFAULT_ANNO_SLOTS
+        anno_slots = DEFAULT_ANNO_SLOTS
+    # Pending local annotates seed the device ring as DEV_UNASSIGNED
+    # annotate payloads — ONE op id per localSeq (an annotate spans
+    # segments), allocated in ascending localSeq order so the extraction
+    # fold's PENDING_ORDER_BASE tie-break reproduces submit order.
+    pending_props: Dict[int, dict] = {}
+    for e in entries:
+        for pa in e.get("pendingAnnotates", []):
+            pending_props.setdefault(pa["localSeq"], pa["props"])
+    pending_ids = {
+        ls: payloads.add_annotate(pending_props[ls], DEV_UNASSIGNED,
+                                  local_seq=ls)
+        for ls in sorted(pending_props)}
+    # Materialized only when pendings exist: the anno column costs a
+    # full [capacity, anno_slots] host round-trip per seed otherwise.
+    anno = np.full((n, anno_slots), -1, np.int32) if pending_ids else None
     from .oracle import Items
     from .runs import Run
     for i, e in enumerate(entries):
@@ -184,12 +202,21 @@ def seed_device_state(entries: Sequence[dict], payloads: PayloadTable,
             rem_client[i] = e.get("removedClient", -1)
         cols["origin_op"][i] = op_id
         cols["origin_off"][i] = 0
+        pendings = e.get("pendingAnnotates", [])
+        if pendings:
+            if len(pendings) > anno_slots:
+                raise Unmodelable(
+                    f"{len(pendings)} pending annotates exceed the "
+                    f"ring depth {anno_slots}")
+            # Ring is newest-first: highest localSeq in slot 0.
+            for j, pa in enumerate(sorted(pendings,
+                                          key=lambda a: -a["localSeq"])):
+                anno[i, j] = pending_ids[pa["localSeq"]]
     cols["rem_client"] = rem_client
+    if anno is not None:
+        cols["anno"] = anno
     from .state import state_from_numpy
     import jax.numpy as jnp
-    if anno_slots is None:
-        from .state import DEFAULT_ANNO_SLOTS
-        anno_slots = DEFAULT_ANNO_SLOTS
     state = state_from_numpy(cols, capacity, anno_slots=anno_slots)
     return state._replace(min_seq=jnp.asarray(min_seq, jnp.int32),
                           seq=jnp.asarray(current_seq, jnp.int32))
@@ -218,9 +245,11 @@ def extract_entries(state: DocState, payloads: PayloadTable,
         else:
             off = int(cols["origin_off"][i])
             entry["text"] = payload.text[off:off + int(cols["length"][i])]
-        props = _resolve_props(payload, cols["anno"][i], payloads)
+        props, pendings = _resolve_props(payload, cols["anno"][i], payloads)
         if props:
             entry["props"] = props
+        if pendings:
+            entry["pendingAnnotates"] = pendings
         ins_seq = int(cols["ins_seq"][i])
         if ins_seq == DEV_UNASSIGNED:  # pending local insert
             entry["localSeq"] = int(cols["local_seq"][i])
@@ -238,12 +267,16 @@ def extract_entries(state: DocState, payloads: PayloadTable,
     return out
 
 
-def _resolve_props(payload, anno_row, payloads: PayloadTable
-                   ) -> Optional[dict]:
+def _resolve_props(payload, anno_row, payloads: PayloadTable):
     """Resolve a segment's property set from its annotate op-id ring by
-    ascending seq (host.extract_segments semantics)."""
+    ascending seq (host.extract_segments semantics). Returns
+    (props-or-None, pending-annotate descriptors ascending by localSeq) —
+    pending ring entries FOLD into props (their values are live on the
+    local view, matching the oracle's apply-at-submit) AND surface as
+    metadata so pending groups/shadow counters rebuild after adoption."""
     props = dict(payload.props) if payload.props else {}
     chain = []
+    pendings = []
     for op_id in anno_row:
         op_id = int(op_id)
         if op_id < 0:
@@ -252,6 +285,8 @@ def _resolve_props(payload, anno_row, payloads: PayloadTable
         seq = ann.seq
         if seq == DEV_UNASSIGNED:
             seq = PENDING_ORDER_BASE + op_id
+            pendings.append({"localSeq": getattr(ann, "local_seq", 0),
+                             "props": dict(ann.props)})
         chain.append((seq, ann.props))
     chain.sort(key=lambda kv: kv[0])
     for _, pset in chain:
@@ -260,7 +295,8 @@ def _resolve_props(payload, anno_row, payloads: PayloadTable
                 props.pop(key, None)
             else:
                 props[key] = value
-    return props or None
+    pendings.sort(key=lambda a: a["localSeq"])
+    return props or None, pendings
 
 
 # ---------------------------------------------------------------------------
@@ -270,7 +306,8 @@ def _resolve_props(payload, anno_row, payloads: PayloadTable
 def _entry_foldable(e: dict) -> bool:
     return (e.get("kind", SEG_TEXT) == SEG_TEXT
             and "seq" not in e and "localSeq" not in e
-            and "removedSeq" not in e and "removedLocalSeq" not in e)
+            and "removedSeq" not in e and "removedLocalSeq" not in e
+            and "pendingAnnotates" not in e)
 
 
 def coalesce_entries(entries: Sequence[dict]) -> List[dict]:
@@ -369,6 +406,12 @@ def apply_host_ops(entries: Sequence[dict], host_ops: Sequence[HostOp],
     state = None
     pos = 0
     anno_slots = DEFAULT_ANNO_SLOTS
+    # Pending local annotates occupy ring slots from the start: size the
+    # ring so the seed fits with headroom for the tail's own annotates.
+    max_pending = max((len(e.get("pendingAnnotates", []))
+                       for e in cur_entries), default=0)
+    while anno_slots < max_pending + 2:
+        anno_slots *= 2
     rows_ub = len(cur_entries)  # host-tracked row bound: no per-chunk sync
     while pos < len(slots) or state is None:
         chunk = slots[pos:pos + CHUNK_T]

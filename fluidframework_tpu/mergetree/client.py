@@ -193,14 +193,12 @@ class MergeTreeClient(TypedEventEmitter):
         to per-op apply_msg) when the tail or current state contains content
         the kernel cannot represent. Pending local inserts/removes ride
         along (the kernel models DEV_UNASSIGNED segments; remote
-        perspectives never see them) — the pending groups are rebuilt from
-        the round-tripped localSeq tags. Pending ANNOTATES fall back: their
-        per-key pending_props counters have no device column."""
+        perspectives never see them), and pending ANNOTATES ride as
+        DEV_UNASSIGNED ring entries (collab_segments pendingAnnotates) —
+        all pending groups rebuild from the round-tripped localSeq tags."""
         from .catchup import Unmodelable, device_apply_tail
 
         pending = self.tree.pending_groups
-        if any(kind == "annotate" for kind, _, _ in pending):
-            raise Unmodelable("pending annotates require per-op apply")
         if not tail:
             return
         if any(cl == self.client_id for _, _, _, cl, _ in tail):
@@ -227,13 +225,16 @@ class MergeTreeClient(TypedEventEmitter):
             # slot (as an empty group: ack and regenerate both no-op over
             # it, matching the scalar path's "a remote remove won").
             by_key: dict = {}
-            for seg in tree.segments:
+            for seg, entry in zip(tree.segments, new_entries):
                 if seg.ins_seq == UNASSIGNED_SEQ and seg.local_seq:
                     by_key.setdefault(
                         ("insert", seg.local_seq), []).append(seg)
                 if seg.rem_seq == UNASSIGNED_SEQ and seg.rem_local_seq:
                     by_key.setdefault(
                         ("remove", seg.rem_local_seq), []).append(seg)
+                for pa in entry.get("pendingAnnotates", []):
+                    by_key.setdefault(
+                        ("annotate", pa["localSeq"]), []).append(seg)
             tree.pending_groups = [
                 (kind, by_key.get((kind, extra["local_seq"]), []), extra)
                 for kind, group, extra in pending]

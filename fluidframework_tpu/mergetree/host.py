@@ -35,6 +35,8 @@ class InsertPayload:
 class AnnotatePayload:
     props: Dict[str, Any]
     seq: int  # updated on ack; pending = DEV_UNASSIGNED
+    local_seq: int = 0  # pending local annotate's localSeq (round-trips
+    # through bulk catch-up so pending groups rebuild after adoption)
 
 
 class MergeArenaBlock:
@@ -123,8 +125,9 @@ class PayloadTable:
         self.entries.append(InsertPayload(kind, text, props))
         return len(self.entries) - 1
 
-    def add_annotate(self, props: Dict[str, Any], seq: int) -> int:
-        self.entries.append(AnnotatePayload(dict(props), seq))
+    def add_annotate(self, props: Dict[str, Any], seq: int,
+                     local_seq: int = 0) -> int:
+        self.entries.append(AnnotatePayload(dict(props), seq, local_seq))
         return len(self.entries) - 1
 
     def add_block(self, block: MergeArenaBlock) -> int:

@@ -679,6 +679,19 @@ class MergeTreeOracle:
         full-fidelity serialization bulk catch-up uses to round-trip a tree
         with in-flight local ops (load_segments restores both)."""
         self.zamboni()
+        # Pending local annotates serialize per segment as
+        # [{"localSeq", "props"}] (ascending localSeq): the bulk
+        # catch-up kernel models them as DEV_UNASSIGNED ring entries and
+        # round-trips them back, so pending groups and per-key shadow
+        # counters rebuild after adoption.
+        pending_anno: Dict[int, List[dict]] = {}
+        for kind, group, extra in self.pending_groups:
+            if kind != "annotate":
+                continue
+            for seg in group:
+                pending_anno.setdefault(id(seg), []).append(
+                    {"localSeq": extra["local_seq"],
+                     "props": dict(extra["props"])})
         out = []
         for seg in self.segments:
             entry: Dict[str, Any] = {"kind": seg.kind, "text": seg.text}
@@ -697,6 +710,9 @@ class MergeTreeOracle:
                 else:
                     entry["removedSeq"] = seg.rem_seq
                     entry["removedClient"] = seg.rem_client
+            if id(seg) in pending_anno:
+                entry["pendingAnnotates"] = sorted(
+                    pending_anno[id(seg)], key=lambda a: a["localSeq"])
             out.append(entry)
         return out
 
@@ -729,6 +745,15 @@ class MergeTreeOracle:
             if pending_rem:
                 seg.rem_local_seq = e["removedLocalSeq"]
                 max_local = max(max_local, seg.rem_local_seq)
+            for pa in e.get("pendingAnnotates", []):
+                # Restore the per-key shadow counters (props values are
+                # already baked into entry["props"]).
+                if seg.pending_props is None:
+                    seg.pending_props = {}
+                for key in pa["props"]:
+                    seg.pending_props[key] = \
+                        seg.pending_props.get(key, 0) + 1
+                max_local = max(max_local, pa["localSeq"])
             tree.segments.append(seg)
             if seg.rem_seq is None:
                 tree._local_len += seg.length

@@ -120,7 +120,13 @@ def state_from_numpy(columns: dict, capacity: int,
     if "rem_client" in columns:
         rem_clients = rem_clients.copy()
         rem_clients[:n, 0] = np.asarray(columns["rem_client"], np.int32)
+    anno = base.anno
+    if "anno" in columns:
+        host_anno = np.asarray(base.anno).copy()
+        host_anno[:n] = np.asarray(columns["anno"], np.int32)
+        anno = jnp.asarray(host_anno)
     return base._replace(
+        anno=anno,
         length=put("length", base.length),
         ins_seq=put("ins_seq", base.ins_seq),
         ins_client=put("ins_client", base.ins_client),

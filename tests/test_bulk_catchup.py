@@ -160,15 +160,45 @@ class TestEngine:
         with pytest.raises(Unmodelable):
             client.apply_bulk(tail)
 
-    def test_pending_annotates_fall_back(self):
-        from fluidframework_tpu.mergetree.catchup import Unmodelable
-        client = MergeTreeClient(client_id=1)
-        client.apply_msg(make_insert_op(0, text_seg("hello")), 1, 0, 0,
-                         min_seq=0)
-        client.annotate_range_local(0, 3, {"bold": True})
-        _, tail = sequenced_schedule(10)
-        with pytest.raises(Unmodelable):
-            client.apply_bulk(tail)
+    def test_pending_annotates_ride_bulk(self):
+        """Pending local annotates ride the kernel path (DEV_UNASSIGNED
+        ring entries, VERDICT r4 catch-up completeness): bulk == scalar
+        through apply, shadow semantics, ack, and regenerate."""
+        bulk = MergeTreeClient(client_id=1)
+        scalar = MergeTreeClient(client_id=1)
+        for c in (bulk, scalar):
+            c.apply_msg(make_insert_op(0, text_seg("hello world")), 1, 0,
+                        0, min_seq=0)
+            c.annotate_range_local(0, 5, {"bold": True})
+            c.annotate_range_local(3, 8, {"size": 12})
+        tail = [(make_insert_op(i % 10, text_seg("x")), i + 2, 1, 7, 0)
+                for i in range(20)]
+        # A remote annotate to a SHADOWED key mid-tail must stay shadowed.
+        tail.insert(10, (make_annotate_op(0, 6, {"bold": False,
+                                                 "other": 1}), 12, 1, 7, 0))
+        tail = [(op, i + 2, 1, 7, 0)
+                for i, (op, _, _, _, _) in enumerate(tail)]
+        bulk.apply_bulk(tail)
+        for op, s, r, cl, m in tail:
+            scalar.apply_msg(op, s, r, cl, min_seq=m)
+        assert bulk.get_text() == scalar.get_text()
+        assert bulk.tree.snapshot_segments() == \
+            scalar.tree.snapshot_segments()
+        # regenerate_pending_ops renumbers groups in place: compare on
+        # copies so the FIFO ack pairing below still sees the originals.
+        import copy
+        assert copy.deepcopy(bulk).regenerate_pending_ops() == \
+            copy.deepcopy(scalar).regenerate_pending_ops()
+        # Acks pair FIFO identically after adoption.
+        last = tail[-1][1]
+        for c in (bulk, scalar):
+            c.apply_msg(make_annotate_op(0, 5, {"bold": True}), last + 1,
+                        1, 1, min_seq=0)
+            c.apply_msg(make_annotate_op(3, 8, {"size": 12}), last + 2,
+                        1, 1, min_seq=0)
+        assert bulk.tree.snapshot_segments() == \
+            scalar.tree.snapshot_segments()
+        assert not bulk.tree.pending_groups
 
     def test_items_payloads_ride_bulk(self):
         """Item-sequence tails take the kernel path: values round-trip
@@ -266,7 +296,11 @@ class TestLoaderE2E:
         text.insert_text(text.get_length(), "/end")
         assert t2.get_text() == text.get_text()
 
-    def test_interval_ops_in_tail_fall_back_correctly(self):
+    def test_interval_ops_in_tail_ride_bulk(self):
+        """Interval ops split out of the kernel run and apply host-side
+        at their own perspectives (they never touch segment state) —
+        the tail's merge history still rides the device (VERDICT r4:
+        shape-agnostic catch-up, deltaManager.ts:1380)."""
         server = LocalServer()
         loader, text = self._build_history(server, n_ops=80)
         ic = text.get_interval_collection("bookmarks")
@@ -274,8 +308,35 @@ class TestLoaderE2E:
         late = loader.resolve("doc")
         t2 = late.runtime.get_datastore("default").get_channel("text")
         assert t2.get_text() == text.get_text()
-        assert t2.bulk_catchup_count == 0  # scalar fallback
-        assert len(t2.get_interval_collection("bookmarks")) == 1
+        assert t2.bulk_catchup_count >= 1  # kernel path kept
+        lc = t2.get_interval_collection("bookmarks")
+        assert len(lc) == 1
+        # The adopted interval anchors track further edits identically.
+        iv_src = next(iter(ic))
+        iv_late = next(iter(lc))
+        assert lc.endpoints(iv_late) == ic.endpoints(iv_src)
+        text.insert_text(0, "shift>")
+        assert lc.endpoints(iv_late) == ic.endpoints(iv_src)
+
+    def test_interval_ops_mid_history_keep_merge_runs_on_device(self):
+        """Interval ops INTERLEAVED with merge history: runs after an
+        interval-add go scalar (live anchors), runs before ride the
+        kernel; end state matches the editing client exactly."""
+        server = LocalServer()
+        loader, text = self._build_history(server, n_ops=60)
+        ic = text.get_interval_collection("marks")
+        ic.add(2, 6, {"n": 1})
+        for i in range(40):
+            text.insert_text(text.get_length() % 7, f"{i%10}")
+        ic.change(next(iter(ic)).interval_id, 1, 3)
+        late = loader.resolve("doc")
+        t2 = late.runtime.get_datastore("default").get_channel("text")
+        assert t2.get_text() == text.get_text()
+        assert t2.bulk_catchup_count >= 1
+        lc = t2.get_interval_collection("marks")
+        assert len(lc) == 1
+        assert lc.endpoints(next(iter(lc))) == \
+            ic.endpoints(next(iter(ic)))
 
     def test_short_tail_stays_scalar(self):
         server = LocalServer()
