@@ -16,6 +16,7 @@ from typing import Any, Dict, Iterator, List, Optional
 from ..core.events import TypedEventEmitter
 from ..mergetree.client import MergeTreeClient
 from ..mergetree.constants import SEG_MARKER, SNAPSHOT_CHUNK_SIZE
+from ..mergetree.costmodel import device_bulk_wins
 from ..mergetree.oracle import REF_SLIDE_ON_REMOVE, LocalReference
 from ..protocol.summary import SummaryTree
 from .shared_object import SharedObject
@@ -545,8 +546,16 @@ class SharedSegmentSequence(SharedObject):
                             min_seq > self.client.tree.min_seq:
                         self.client.tree.set_min_seq(min_seq)
                     continue
-                scalar = any(seg.local_refs
-                             for seg in self.client.tree.segments)
+                # Route per run: the device path must actually win for
+                # this (backend, tail length, live segments) — the B=1
+                # kernel loses to scalar on CPU and under the TPU
+                # dispatch floor for short tails (mergetree/costmodel.py,
+                # round-4 verdict's 4x single-doc pessimization).
+                scalar = (any(seg.local_refs
+                              for seg in self.client.tree.segments)
+                          or not device_bulk_wins(
+                              len(data),
+                              len(self.client.tree.segments)))
                 if not scalar:
                     try:
                         self.client.apply_bulk(data)

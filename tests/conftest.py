@@ -11,6 +11,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# The suite runs on the forced-CPU mesh, where the cost model would route
+# every single-lane catch-up scalar (mergetree/costmodel.py: the B=1
+# kernel never wins on CPU). Force the device path so the kernel
+# machinery stays exercised; routing itself is tested explicitly with
+# the override cleared (tests/test_bulk_catchup.py::TestCostModel).
+os.environ.setdefault("FLUID_TPU_FORCE_BULK", "1")
+
 try:
     from fluidframework_tpu.core.platform import force_host_platform
 
