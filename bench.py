@@ -331,6 +331,44 @@ def _matrix_serving_ingest_rate(docs: int = 1024,
     }
 
 
+def _recorded_replay_rate() -> dict:
+    """Replay the RECORDED session corpora (tests/corpus/ — real
+    multi-client sessions captured through the alfred websocket stack,
+    testing/corpus.py) against their pinned end-state digests; reports
+    replay throughput per workload. A digest mismatch is a hard error:
+    the bench must never report a rate for a wrong replay."""
+    import time as _time
+
+    from fluidframework_tpu.testing import corpus as C
+
+    out = {}
+    try:
+        pins = C.load_pins()
+    except OSError:
+        return {"recorded_replay_skipped": "no corpus checked in"}
+    for workload, pin in sorted(pins.items()):
+        # Per-corpus containment: a missing/corrupt file or a stale pin
+        # must surface as a marker, never crash the bench out of its
+        # result JSON (round-1 "emits nothing" failure mode).
+        try:
+            header, rows = C.read_corpus(
+                os.path.join(C.CORPUS_DIR, pin["file"]))
+            applied = sum(1 for _ in C.channel_ops(header, rows))
+            t0 = _time.perf_counter()  # replay only: IO/digest excluded
+            channel = C.replay(header, rows)
+            dt = _time.perf_counter() - t0
+            d = C.digest(C._channel_digest_state(header["channel_type"],
+                                                 channel))
+            if d != pin["digest"]:
+                out[f"recorded_{workload}_error"] = "digest mismatch"
+                continue
+            out[f"recorded_{workload}_ops_per_sec"] = round(applied / dt, 1)
+        except Exception as err:  # noqa: BLE001 — marker, not a crash
+            out[f"recorded_{workload}_error"] = \
+                f"{type(err).__name__}: {err}"[:200]
+    return out
+
+
 def _directory_serving_ingest_rate(docs: int = 1024,
                                    ops_per_doc: int = 32) -> dict:
     """SharedDirectory traffic through the SERVING path: root set/delete
@@ -911,7 +949,8 @@ def main() -> None:
                 ("matrix_storm", _matrix_storm_rate),
                 ("matrix_serving", _matrix_serving_ingest_rate),
                 ("directory_merge", _directory_merge_rate),
-                ("directory_serving", _directory_serving_ingest_rate)):
+                ("directory_serving", _directory_serving_ingest_rate),
+                ("recorded_replay", _recorded_replay_rate)):
             if time.perf_counter() > soft_deadline:
                 workload_extras[f"{name}_skipped"] = "bench soft deadline"
                 continue

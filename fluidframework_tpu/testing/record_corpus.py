@@ -1,0 +1,228 @@
+"""Record real multi-client sessions into pinned replay corpora.
+
+Drives seeded workloads (testing/traces.py) from multiple clients over
+the REAL alfred websocket + REST stack (server/tinylicious.py — the
+LocalServer lambda pipeline behind actual sockets), then fetches the
+sequenced op log back through alfred's own /deltas catch-up route and
+writes it under tests/corpus/ with a pinned end-state digest
+(testing/corpus.py). The replay digest is cross-checked against the
+LIVE clients' end state at record time, so the checked-in pin holds the
+replay harness and the recording session to the same truth.
+
+Reference analog: the captured-log snapshot regression corpus,
+packages/test/snapshots/src/replayMultipleFiles.ts:1.
+
+Usage: python -m fluidframework_tpu.testing.record_corpus [outdir]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import time
+
+
+def _wait_until(predicate, timeout=30.0, interval=0.01):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def _session(server, doc_id: str, channel: str, channel_type: str,
+             n_clients: int):
+    from ..loader.container import Loader
+    from ..loader.drivers.routerlicious import NetworkDocumentServiceFactory
+    from ..server.tinylicious import DEFAULT_TENANT
+
+    factory = NetworkDocumentServiceFactory(server.url, DEFAULT_TENANT)
+    loader = Loader(factory)
+    c1 = loader.create_detached(doc_id)
+    ds = c1.runtime.create_datastore("default")
+    types = {
+        "sequence": "https://graph.microsoft.com/types/mergeTree/string",
+        "matrix": "https://graph.microsoft.com/types/sharedmatrix",
+        "directory": "https://graph.microsoft.com/types/directory",
+    }
+    ch1 = ds.create_channel(channel, types[channel_type])
+    c1.attach()
+    containers = [c1]
+    channels = [ch1]
+    for _ in range(n_clients - 1):
+        c = loader.resolve(doc_id)
+        containers.append(c)
+        channels.append(
+            c.runtime.get_datastore("default").get_channel(channel))
+    return containers, channels
+
+
+def _settle(containers, check, timeout=30.0):
+    assert _wait_until(check, timeout), "session did not converge"
+    for c in containers:
+        c.close()
+
+
+def record_text(server, outdir: str, n_ops: int = 1500,
+                seed: int = 2026) -> dict:
+    """Two-editor keystroke-style text session with annotate sweeps."""
+    containers, (t1, t2) = _session(server, "corpus-text", "text",
+                                    "sequence", 2)
+    rng = random.Random(seed)
+    editors = [(containers[0], t1), (containers[1], t2)]
+    for i in range(n_ops):
+        c, t = editors[i % 2 if rng.random() < 0.7 else rng.randrange(2)]
+        with c.op_lock:
+            n = t.get_length()
+            r = rng.random()
+            if r < 0.7 or n < 10:
+                pos = min(n, max(0, int(rng.gauss(n * 0.7, 4))))
+                t.insert_text(pos, rng.choice("abcdefgh ,.!\n"))
+            elif r < 0.85:
+                a = rng.randrange(n - 2)
+                t.remove_text(a, min(n, a + rng.randrange(1, 6)))
+            else:
+                a = rng.randrange(n - 2)
+                t.annotate_range(a, min(n, a + rng.randrange(1, 9)),
+                                 {"style": i % 5})
+    _settle(containers, lambda: t1.get_text() == t2.get_text())
+    return {"doc": "corpus-text", "channel": "text",
+            "channel_type": "sequence", "workload": "keystroke",
+            "seed": seed, "clients": 2,
+            "live_state": {"text": t1.get_text()}}
+
+
+def record_matrix(server, outdir: str, n_ops: int = 1200,
+                  seed: int = 7) -> dict:
+    from .traces import matrix_storm
+
+    containers, (m1, m2) = _session(server, "corpus-matrix", "grid",
+                                    "matrix", 2)
+    with containers[0].op_lock:
+        m1.insert_rows(0, 24)
+        m1.insert_cols(0, 12)
+    _wait_until(lambda: (m2.row_count, m2.col_count) == (24, 12))
+    script = matrix_storm(24, 12, n_ops, seed=seed)
+    rng = random.Random(seed + 1)
+    mats = [(containers[0], m1), (containers[1], m2)]
+    for cmd in script:
+        c, m = mats[rng.randrange(2)]
+        with c.op_lock:
+            # The script tracks dimensions for a SERIAL session; across
+            # two async clients a view can lag, so commands clamp to the
+            # acting client's live dimensions (the log stays realistic —
+            # that is what concurrent editors actually submit).
+            r, co = m.row_count, m.col_count
+            if cmd[0] == "set":
+                if r and co:
+                    m.set_cell(min(cmd[1], r - 1), min(cmd[2], co - 1),
+                               cmd[3])
+            elif cmd[0] == "insert_rows":
+                m.insert_rows(min(cmd[1], r), cmd[2])
+            elif cmd[0] == "insert_cols":
+                m.insert_cols(min(cmd[1], co), cmd[2])
+            elif cmd[0] == "remove_rows" and r > 2:
+                m.remove_rows(min(cmd[1], r - 1), 1)
+            elif cmd[0] == "remove_cols" and co > 2:
+                m.remove_cols(min(cmd[1], co - 1), 1)
+    _settle(containers,
+            lambda: m1.extract() == m2.extract())
+    return {"doc": "corpus-matrix", "channel": "grid",
+            "channel_type": "matrix", "workload": "matrix_storm",
+            "seed": seed, "clients": 2,
+            "live_state": m1.extract()}
+
+
+def record_directory(server, outdir: str, n_ops: int = 1200,
+                     seed: int = 9) -> dict:
+    from .traces import directory_merge_script
+
+    containers, channels = _session(server, "corpus-dir", "dir",
+                                    "directory", 4)
+    script = directory_merge_script(n_ops, n_clients=4, seed=seed)
+
+    def workdir(d, path):
+        node = d.root
+        for part in path:
+            sub = node.get_sub_directory(part)
+            if sub is None:
+                sub = node.create_sub_directory(part)
+            node = sub
+        return node
+
+    for cmd in script:
+        cidx, path = cmd[0], cmd[1]
+        c, d = containers[cidx], channels[cidx]
+        with c.op_lock:
+            wd = workdir(d, path)
+            if cmd[2] == "set":
+                wd.set(cmd[3], cmd[4])
+            elif cmd[2] == "delete":
+                wd.delete(cmd[3])
+            elif cmd[2] == "set_subdir_key":
+                sub = wd.get_sub_directory(cmd[3]) or \
+                    wd.create_sub_directory(cmd[3])
+                sub.set(cmd[4], cmd[5])
+            else:
+                wd.clear()
+    d0 = channels[0]
+    _settle(containers,
+            lambda: all(d.root.to_dict() == d0.root.to_dict()
+                        for d in channels))
+    return {"doc": "corpus-dir", "channel": "dir",
+            "channel_type": "directory", "workload": "directory_merge",
+            "seed": seed, "clients": 4,
+            "live_state": d0.root.to_dict()}
+
+
+def main(outdir: str | None = None) -> None:
+    from ..core.platform import force_host_platform
+    force_host_platform(8)
+
+    from ..server.tinylicious import DEFAULT_TENANT, Tinylicious
+    from ..loader.drivers.routerlicious import RestWrapper
+    from . import corpus as C
+
+    outdir = outdir or C.CORPUS_DIR
+    os.makedirs(outdir, exist_ok=True)
+    pins = {}
+    with Tinylicious() as server:
+        rest = RestWrapper(server.url)
+        for rec in (record_text, record_matrix, record_directory):
+            header = rec(server, outdir)
+            rows = rest.get(
+                f"/deltas/{DEFAULT_TENANT}/{header['doc']}")["deltas"]
+            live_state = header.pop("live_state")
+            path = os.path.join(outdir, f"{header['workload']}.jsonl.gz")
+            C.write_corpus(path, header, rows)
+            # The pin must hold BOTH the recording and the replay
+            # harness to the same truth: a fresh replica replaying the
+            # checked-in log must reach the live clients' end state.
+            hdr, rrows = C.read_corpus(path)
+            chan = C.replay(hdr, rrows)
+            replay_state = C._channel_digest_state(hdr["channel_type"],
+                                                   chan)
+            if hdr["channel_type"] == "sequence":
+                assert replay_state["text"] == live_state["text"], \
+                    "replayed text diverges from the live session"
+            else:
+                assert replay_state == live_state, \
+                    f"{header['workload']}: replay diverges from live"
+            pins[header["workload"]] = {
+                "file": os.path.basename(path),
+                "digest": C.digest(replay_state),
+                "ops": len(rows),
+                "recorded": time.strftime("%Y-%m-%d"),
+            }
+            print(f"recorded {header['workload']}: {len(rows)} rows -> "
+                  f"{pins[header['workload']]['digest'][:16]}...")
+    with open(os.path.join(outdir, "pins.json"), "w") as f:
+        json.dump(pins, f, indent=2, sort_keys=True)
+    print(f"pins written to {outdir}/pins.json")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else None)

@@ -1,0 +1,53 @@
+"""Recorded-session corpus regression (testing/corpus.py): checked-in op
+logs captured from real multi-client sessions over the alfred websocket
+stack replay to PINNED end-state digests — cross-version drift in
+sequencing or op-application semantics breaks the pin (reference
+packages/test/snapshots/src/replayMultipleFiles.ts:1 replay corpus)."""
+
+import os
+
+import pytest
+
+from fluidframework_tpu.testing import corpus as C
+
+try:
+    PINS = C.load_pins()
+except OSError:  # no corpus checked out: skip, don't error collection
+    PINS = {}
+    pytestmark = pytest.mark.skip(reason="tests/corpus/pins.json missing")
+
+
+@pytest.mark.parametrize("workload", sorted(PINS))
+def test_replay_matches_pin(workload):
+    pin = PINS[workload]
+    path = os.path.join(C.CORPUS_DIR, pin["file"])
+    assert C.replay_digest(path) == pin["digest"]
+
+
+def test_corpus_rows_are_wellformed():
+    for workload, pin in PINS.items():
+        header, rows = C.read_corpus(
+            os.path.join(C.CORPUS_DIR, pin["file"]))
+        assert len(rows) == pin["ops"]
+        seqs = [r["sequence_number"] for r in rows]
+        assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+        assert header["channel_type"] in ("sequence", "matrix",
+                                          "directory")
+
+
+def test_text_corpus_bulk_replay_matches_scalar():
+    """The keystroke corpus through the device bulk path equals the
+    scalar replay — the recorded log doubles as a kernel-conformance
+    corpus (FLUID_TPU_FORCE_BULK=1 from conftest keeps the kernel on).
+    Both paths consume corpus.channel_ops, the one canonical row walk."""
+    from fluidframework_tpu.mergetree.client import MergeTreeClient
+
+    pin = PINS["keystroke"]
+    header, rows = C.read_corpus(os.path.join(C.CORPUS_DIR, pin["file"]))
+    scalar_chan = C.replay(header, rows)
+    tail = [(contents, seq, ref, ordinal, msn or 0)
+            for contents, seq, ref, ordinal, msn
+            in C.channel_ops(header, rows)]
+    bulk = MergeTreeClient(client_id=999)
+    bulk.apply_bulk(tail)
+    assert bulk.get_text() == scalar_chan.get_text()
