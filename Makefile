@@ -1,0 +1,24 @@
+# Repeatable entry points (VERDICT r4 #8: the randomized-evidence ritual
+# must be a one-liner anyone can repeat).
+
+.PHONY: test soak bench dryrun record-corpus
+
+test:
+	python -m pytest tests/ -q
+
+# The round-end randomized-evidence ritual: 50-trial soaks over every
+# differential surface (bulk catch-up, serving fast path, matrix/
+# directory lanes, interval catch-up) + the chaos seed sweep. Run before
+# the final commit of a round; record the counts in the round notes.
+soak:
+	SOAK=1 SOAK_TRIALS=50 CHAOS_SWEEP=1 python -m pytest \
+		tests/test_soak.py tests/test_chaos.py -q
+
+bench:
+	python bench.py
+
+dryrun:
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+record-corpus:
+	python -m fluidframework_tpu.testing.record_corpus

@@ -47,7 +47,7 @@ def read_corpus(path: str) -> Tuple[dict, List[dict]]:
     return json.loads(lines[0]), [json.loads(ln) for ln in lines[1:]]
 
 
-def _make_channel(channel_type: str):
+def make_channel(channel_type: str):
     if channel_type == "sequence":
         from ..dds.sequence import SharedString
         return SharedString("replay")
@@ -60,7 +60,7 @@ def _make_channel(channel_type: str):
     raise ValueError(f"unknown corpus channel type {channel_type!r}")
 
 
-def _channel_digest_state(channel_type: str, channel) -> Any:
+def channel_state(channel_type: str, channel) -> Any:
     """Canonical end state for digesting/pinning."""
     if channel_type == "sequence":
         return {
@@ -132,7 +132,7 @@ def replay(header: dict, rows: List[dict],
     """Replay a recorded log into a fresh replica channel: sequenced
     messages apply remote-side exactly as a catching-up client would.
     Returns the channel."""
-    channel = _make_channel(header["channel_type"])
+    channel = make_channel(header["channel_type"])
     for contents, seq, ref_seq, ordinal, min_seq in channel_ops(
             header, rows, channel_address):
         channel.process_core(contents, False, seq, ref_seq, ordinal,
@@ -140,10 +140,14 @@ def replay(header: dict, rows: List[dict],
     return channel
 
 
+def channel_digest(channel_type: str, channel) -> str:
+    return digest(channel_state(channel_type, channel))
+
+
 def replay_digest(path: str, channel_address: str | None = None) -> str:
     header, rows = read_corpus(path)
     channel = replay(header, rows, channel_address)
-    return digest(_channel_digest_state(header["channel_type"], channel))
+    return channel_digest(header["channel_type"], channel)
 
 
 def load_pins() -> dict:

@@ -203,8 +203,7 @@ def main(outdir: str | None = None) -> None:
             # checked-in log must reach the live clients' end state.
             hdr, rrows = C.read_corpus(path)
             chan = C.replay(hdr, rrows)
-            replay_state = C._channel_digest_state(hdr["channel_type"],
-                                                   chan)
+            replay_state = C.channel_state(hdr["channel_type"], chan)
             if hdr["channel_type"] == "sequence":
                 assert replay_state["text"] == live_state["text"], \
                     "replayed text diverges from the live session"

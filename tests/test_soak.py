@@ -229,3 +229,147 @@ class TestServingSoak:
         for d in {t[0] for t in tr}:
             assert A.channel_text(d, "s", "t") == \
                 B.channel_text(d, "s", "t"), d
+
+
+def _tpu_session(channel_type):
+    from fluidframework_tpu.loader.container import Loader
+    from fluidframework_tpu.loader.drivers.local import (
+        LocalDocumentServiceFactory)
+    from fluidframework_tpu.server.local_server import TpuLocalServer
+
+    server = TpuLocalServer()
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c1 = loader.create_detached("doc")
+    ds = c1.runtime.create_datastore("default")
+    ch1 = ds.create_channel("ch", channel_type)
+    c1.attach()
+    c2 = loader.resolve("doc")
+    ch2 = c2.runtime.get_datastore("default").get_channel("ch")
+    return server, (c1, c2), (ch1, ch2)
+
+
+class TestMatrixServingSoak:
+    """Round-5 surface: SharedMatrix device serving lanes under random
+    concurrent sessions with mid-session sequencer restarts."""
+
+    @pytest.mark.parametrize("trial", range(TRIALS))
+    def test_random_matrix_sessions_match(self, trial):
+        from fluidframework_tpu.dds.matrix import SharedMatrix
+
+        rng = random.Random(91_000 + trial)
+        server, _, (m1, m2) = _tpu_session(SharedMatrix.TYPE)
+        for step in range(rng.randrange(40, 120)):
+            m = rng.choice([m1, m2])
+            r, c = m.row_count, m.col_count
+            act = rng.random()
+            if act < 0.25 or r == 0:
+                m.insert_rows(rng.randint(0, r), rng.randint(1, 3))
+            elif act < 0.5 or c == 0:
+                m.insert_cols(rng.randint(0, c), rng.randint(1, 2))
+            elif act < 0.6 and r > 1:
+                m.remove_rows(rng.randrange(r - 1), 1)
+            elif act < 0.65 and c > 1:
+                m.remove_cols(rng.randrange(c - 1), 1)
+            else:
+                m.set_cell(rng.randrange(r), rng.randrange(c), step)
+            if rng.random() < 0.02:
+                server._deli_mgr.restart()
+        assert m1.extract() == m2.extract()
+        grid = server.sequencer().channel_matrix("doc", "default", "ch")
+        assert grid == m1.extract()
+
+
+class TestDirectoryServingSoak:
+    """Round-5 surface: SharedDirectory LWW lane + path-set gating under
+    random nested sessions with restarts."""
+
+    @pytest.mark.parametrize("trial", range(TRIALS))
+    def test_random_directory_sessions_match(self, trial):
+        from fluidframework_tpu.dds.directory import SharedDirectory
+
+        rng = random.Random(93_000 + trial)
+        server, _, (d1, d2) = _tpu_session(SharedDirectory.TYPE)
+        names = ["a", "b", "c"]
+        for step in range(rng.randrange(60, 160)):
+            d = rng.choice([d1, d2])
+            paths = ["/"]
+            for n1 in names:
+                if d.get_working_directory("/" + n1) is not None:
+                    paths.append("/" + n1)
+                    for n2 in names:
+                        if d.get_working_directory(
+                                f"/{n1}/{n2}") is not None:
+                            paths.append(f"/{n1}/{n2}")
+            path = rng.choice(paths)
+            wd = d.root if path == "/" else d.get_working_directory(path)
+            act = rng.random()
+            if act < 0.15 and path.count("/") < 3:
+                wd.create_sub_directory(rng.choice(names))
+            elif act < 0.22 and path != "/":
+                parent, _, name = path.rpartition("/")
+                pd = d.root if not parent else \
+                    d.get_working_directory(parent)
+                if pd is not None:
+                    pd.delete_sub_directory(name)
+            elif act < 0.28:
+                wd.clear()
+            elif act < 0.4:
+                wd.delete(f"k{rng.randrange(4)}")
+            else:
+                wd.set(f"k{rng.randrange(4)}", step)
+            if rng.random() < 0.02:
+                server._deli_mgr.restart()
+        assert d1.root.to_dict() == d2.root.to_dict()
+        tree = server.sequencer().channel_directory("doc", "default", "ch")
+        assert tree == d1.root.to_dict()
+
+
+class TestIntervalCatchupSoak:
+    """Round-5 surface: interval ops interleaved with merge history
+    through the run-splitting bulk catch-up."""
+
+    @pytest.mark.parametrize("trial", range(TRIALS))
+    def test_random_interval_histories_catch_up(self, trial):
+        from fluidframework_tpu.dds.sequence import SharedString
+        from fluidframework_tpu.loader.container import Loader
+        from fluidframework_tpu.loader.drivers.local import (
+            LocalDocumentServiceFactory)
+        from fluidframework_tpu.server.local_server import LocalServer
+
+        rng = random.Random(95_000 + trial)
+        server = LocalServer()
+        loader = Loader(LocalDocumentServiceFactory(server))
+        c1 = loader.create_detached("doc")
+        ds = c1.runtime.create_datastore("default")
+        text = ds.create_channel("text", SharedString.TYPE)
+        c1.attach()
+        ic = text.get_interval_collection("marks")
+        ids = []
+        for i in range(rng.randrange(80, 200)):
+            n = text.get_length()
+            act = rng.random()
+            if act < 0.6 or n < 8:
+                text.insert_text(rng.randrange(n + 1) if n else 0,
+                                 f"[{i % 10}]")
+            elif act < 0.8:
+                a = rng.randrange(n - 2)
+                text.remove_text(a, min(n, a + rng.randrange(1, 4)))
+            elif act < 0.9 and n > 4:
+                iv = ic.add(rng.randrange(n - 2), rng.randrange(2, n),
+                            {"i": i})
+                ids.append(iv.interval_id)
+            elif ids:
+                iid = rng.choice(ids)
+                if rng.random() < 0.5 and text.get_length() > 4:
+                    ic.change(iid, 1, text.get_length() - 1)
+                else:
+                    ic.remove_interval_by_id(iid)
+                    ids.remove(iid)
+        late = loader.resolve("doc")
+        t2 = late.runtime.get_datastore("default").get_channel("text")
+        assert t2.get_text() == text.get_text()
+        lc = t2.get_interval_collection("marks")
+        assert len(lc) == len(ic)
+        src = {iv.interval_id: ic.endpoints(iv) for iv in ic}
+        got = {iv.interval_id: lc.endpoints(iv) for iv in lc}
+        assert got == src
