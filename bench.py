@@ -356,9 +356,7 @@ def _recorded_replay_rate() -> dict:
             ops = list(C.channel_ops(header, rows))
             channel = C.make_channel(header["channel_type"])
             t0 = time.perf_counter()
-            for contents, seq, ref_seq, ordinal, min_seq in ops:
-                channel.process_core(contents, False, seq, ref_seq,
-                                     ordinal, min_seq)
+            C.apply_ops(channel, ops)
             dt = time.perf_counter() - t0
             if C.channel_digest(header["channel_type"], channel) != \
                     pin["digest"]:

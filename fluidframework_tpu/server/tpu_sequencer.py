@@ -565,7 +565,12 @@ _CELL_KEY = "\x00cell"  # SharedCell = a one-key LWW map
 MATRIX_ROWS_SUFFIX = "\x00mx:rows"
 MATRIX_COLS_SUFFIX = "\x00mx:cols"
 MATRIX_CELLS_SUFFIX = "\x00mx:cells"
-_MATRIX_TYPE = "https://graph.microsoft.com/types/sharedmatrix"
+# SparseMatrix extends SharedMatrix (same wire shapes), so both types
+# seed/compose through the matrix lanes.
+_MATRIX_TYPES = {
+    "https://graph.microsoft.com/types/sharedmatrix",
+    "https://graph.microsoft.com/types/mergeTree/sparse-matrix",
+}
 
 
 _MATRIX_SUFFIXES = ((MATRIX_ROWS_SUFFIX, "rows"),
@@ -1391,7 +1396,7 @@ def _parse_summary_probe(tree) -> Optional[_SummaryProbe]:
                     ctype = _json.loads(attrs.content).get("type", "")
                 except (ValueError, TypeError, AttributeError):
                     ctype = ""
-            if ctype == _MATRIX_TYPE:
+            if ctype in _MATRIX_TYPES:
                 # Matrix snapshots (dds/matrix.py summarize_core): two
                 # axis snapshots seed merge lanes under suffixed names,
                 # the cells blob seeds the LWW cell-store lane. Parsed

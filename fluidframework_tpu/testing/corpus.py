@@ -127,16 +127,21 @@ def channel_ops(header: dict, rows: List[dict],
                row.get("minimum_sequence_number"))
 
 
+def apply_ops(channel, ops) -> None:
+    """Apply materialized channel_ops tuples remote-side — the ONE
+    corpus apply loop (replay and the bench's timed region share it)."""
+    for contents, seq, ref_seq, ordinal, min_seq in ops:
+        channel.process_core(contents, False, seq, ref_seq, ordinal,
+                             min_seq)
+
+
 def replay(header: dict, rows: List[dict],
            channel_address: str | None = None):
     """Replay a recorded log into a fresh replica channel: sequenced
     messages apply remote-side exactly as a catching-up client would.
     Returns the channel."""
     channel = make_channel(header["channel_type"])
-    for contents, seq, ref_seq, ordinal, min_seq in channel_ops(
-            header, rows, channel_address):
-        channel.process_core(contents, False, seq, ref_seq, ordinal,
-                             min_seq)
+    apply_ops(channel, channel_ops(header, rows, channel_address))
     return channel
 
 

@@ -191,9 +191,36 @@ def main() -> int:
     else:
         print("fused INSERT_RUN variant failed its probe on this backend "
               "(serving will pack on the scan path)")
+    results.append(check_fused_sp(64, 48, 256, 11))
     ok = all(results)
     print("CONFORMANCE", "OK" if ok else "FAILED")
     return 0 if ok else 1
+
+
+def check_fused_sp(b: int, t: int, cap: int, seed: int) -> bool:
+    """Round-5: the fused×sp GSPMD body (mergetree/fused_sp.py —
+    two-level reshape prefix sums) lowers through real XLA:TPU, not the
+    interpreter; a single chip executes the sp>1 formulation with the
+    collectives degenerating, so this validates the LOWERING now and the
+    multi-chip placement stays covered by dryrun_multichip."""
+    import numpy as np
+
+    from fluidframework_tpu.mergetree import fused_sp, kernel
+    from fluidframework_tpu.mergetree.oppack import pack_ops
+    from fluidframework_tpu.mergetree.state import make_state
+
+    packed = pack_ops(_traces(b, t, seed))
+    ref = kernel.apply_ops_batched_keep(make_state(cap, 2, batch=b),
+                                        packed)
+    out = fused_sp.apply_ops_fused_sp(make_state(cap, 2, batch=b),
+                                      packed, 4)
+    ok = all(
+        bool(np.array_equal(np.asarray(getattr(ref, f)),
+                            np.asarray(getattr(out, f))))
+        for f in ref._fields)
+    print(f"fused_sp b={b} t={t} cap={cap} sp=4: "
+          f"{'OK' if ok else 'MISMATCH'}")
+    return ok
 
 
 if __name__ == "__main__":

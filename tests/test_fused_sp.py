@@ -208,3 +208,19 @@ class TestPipelineFusedSp:
         assert_states_equal(ref[1], out[1])
         np.testing.assert_array_equal(np.asarray(ref[3]),
                                       np.asarray(out[3]))
+
+
+class TestFusedSpLongDocument:
+    def test_large_capacity_sharded_lane_axis(self):
+        """Long-document shape: a 4096-lane capacity axis over sp=8 (the
+        per-shard tile is 512 lanes — VMEM-class on TPU). Bit-identity
+        against the single-shard fused reference at a scale where the
+        two-level scan structure actually matters."""
+        mesh = make_mesh(dp=1, sp=8)
+        st, ops = _batched_from_traces(2, 48, 4096, 19)
+        ref = pallas_apply.apply_ops_fused_ref(st, ops)
+        g = fused_sp.apply_ops_fused_sp(st, ops, 8)
+        sm = fused_sp.apply_ops_fused_shardmap(st, ops, mesh,
+                                               dp_axis="dp")
+        assert_states_equal(ref, g)
+        assert_states_equal(ref, sm)

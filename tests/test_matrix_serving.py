@@ -328,3 +328,27 @@ class TestMatrixRoute:
         assert matrix_route({"type": 0, "pos1": 0,
                              "seg": {"text": "x"}}) is None
         assert matrix_route("nope") is None
+
+
+class TestSparseMatrixServing:
+    def test_sparse_matrix_rides_matrix_lanes(self):
+        """SparseMatrix extends SharedMatrix (identical wire shapes), so
+        its channels materialize on the same axis merge lanes + cell
+        store — including detached-content seeding from the attach
+        summary (type-set probe)."""
+        from fluidframework_tpu.dds.sparse_matrix import SparseMatrix
+
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        m1 = ds1.create_channel("sheet", SparseMatrix.TYPE)
+        m1.insert_rows(0, 3)
+        m1.set_items(0, 2, ["a", "b"])  # auto-extends cols
+        c1.attach()
+        c2 = loader.resolve("doc")
+        m2 = c2.runtime.get_datastore("default").get_channel("sheet")
+        assert m2.get_item(0, 2) == "a"
+        m2.set_items(2, 0, [7])
+        m1.insert_rows(1, 1)
+        grid = server.sequencer().channel_matrix("doc", "default", "sheet")
+        assert grid == m1.extract() == m2.extract()
+        assert grid[0][2] == "a" and grid[3][0] == 7

@@ -231,21 +231,26 @@ class TestServingSoak:
                 B.channel_text(d, "s", "t"), d
 
 
-def _tpu_session(channel_type):
+def _soak_session(channel_type, server_cls=None, n_clients=2):
+    """One session bring-up for every soak class: server + loader + N
+    channel replicas."""
     from fluidframework_tpu.loader.container import Loader
     from fluidframework_tpu.loader.drivers.local import (
         LocalDocumentServiceFactory)
-    from fluidframework_tpu.server.local_server import TpuLocalServer
+    from fluidframework_tpu.server.local_server import (LocalServer,
+                                                        TpuLocalServer)
 
-    server = TpuLocalServer()
+    server = (server_cls or TpuLocalServer)()
     loader = Loader(LocalDocumentServiceFactory(server))
     c1 = loader.create_detached("doc")
     ds = c1.runtime.create_datastore("default")
-    ch1 = ds.create_channel("ch", channel_type)
+    channels = [ds.create_channel("ch", channel_type)]
     c1.attach()
-    c2 = loader.resolve("doc")
-    ch2 = c2.runtime.get_datastore("default").get_channel("ch")
-    return server, (c1, c2), (ch1, ch2)
+    for _ in range(n_clients - 1):
+        c = loader.resolve("doc")
+        channels.append(c.runtime.get_datastore("default")
+                        .get_channel("ch"))
+    return server, loader, channels
 
 
 class TestMatrixServingSoak:
@@ -257,7 +262,7 @@ class TestMatrixServingSoak:
         from fluidframework_tpu.dds.matrix import SharedMatrix
 
         rng = random.Random(91_000 + trial)
-        server, _, (m1, m2) = _tpu_session(SharedMatrix.TYPE)
+        server, _, (m1, m2) = _soak_session(SharedMatrix.TYPE)
         for step in range(rng.randrange(40, 120)):
             m = rng.choice([m1, m2])
             r, c = m.row_count, m.col_count
@@ -288,7 +293,7 @@ class TestDirectoryServingSoak:
         from fluidframework_tpu.dds.directory import SharedDirectory
 
         rng = random.Random(93_000 + trial)
-        server, _, (d1, d2) = _tpu_session(SharedDirectory.TYPE)
+        server, _, (d1, d2) = _soak_session(SharedDirectory.TYPE)
         names = ["a", "b", "c"]
         for step in range(rng.randrange(60, 160)):
             d = rng.choice([d1, d2])
@@ -331,18 +336,11 @@ class TestIntervalCatchupSoak:
     @pytest.mark.parametrize("trial", range(TRIALS))
     def test_random_interval_histories_catch_up(self, trial):
         from fluidframework_tpu.dds.sequence import SharedString
-        from fluidframework_tpu.loader.container import Loader
-        from fluidframework_tpu.loader.drivers.local import (
-            LocalDocumentServiceFactory)
         from fluidframework_tpu.server.local_server import LocalServer
 
         rng = random.Random(95_000 + trial)
-        server = LocalServer()
-        loader = Loader(LocalDocumentServiceFactory(server))
-        c1 = loader.create_detached("doc")
-        ds = c1.runtime.create_datastore("default")
-        text = ds.create_channel("text", SharedString.TYPE)
-        c1.attach()
+        server, loader, (text,) = _soak_session(
+            SharedString.TYPE, server_cls=LocalServer, n_clients=1)
         ic = text.get_interval_collection("marks")
         ids = []
         for i in range(rng.randrange(80, 200)):
@@ -366,7 +364,7 @@ class TestIntervalCatchupSoak:
                     ic.remove_interval_by_id(iid)
                     ids.remove(iid)
         late = loader.resolve("doc")
-        t2 = late.runtime.get_datastore("default").get_channel("text")
+        t2 = late.runtime.get_datastore("default").get_channel("ch")
         assert t2.get_text() == text.get_text()
         lc = t2.get_interval_collection("marks")
         assert len(lc) == len(ic)
