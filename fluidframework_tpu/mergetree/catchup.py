@@ -50,8 +50,9 @@ from ..core.errors import BulkApplyUnsupported
 
 
 class Unmodelable(BulkApplyUnsupported):
-    """Wire content the device kernel cannot represent (items payloads,
-    unknown op types): callers fall back to the scalar path."""
+    """Wire content the device kernel cannot represent (unknown op
+    types, ungated run/items payloads): callers fall back to the scalar
+    path."""
 
 
 def wire_to_host_ops(builder: OpBuilder, op: dict, seq: int, ref_seq: int,
@@ -60,10 +61,11 @@ def wire_to_host_ops(builder: OpBuilder, op: dict, seq: int, ref_seq: int,
                      allow_runs: bool = False) -> List[HostOp]:
     """One sequenced wire op (client.py shape) -> kernel HostOps.
 
-    allow_items: client bulk catch-up models item payloads (the device
-    tracks only lengths/offsets; Items slices like str). The SERVER lane
-    path keeps them Unmodelable — its summarize/extract pipeline emits
-    text chunks, so an items lane degrades to opaque there.
+    allow_items: item payloads ride the kernel (the device tracks only
+    lengths/offsets; Items slices like str). Client bulk catch-up AND
+    the server lane path both enable it (round 5: the server's
+    summarize/extract pipeline wire-encodes Items back out, so items
+    lanes materialize instead of degrading to opaque).
 
     allow_runs: ONLY the matrix axis sub-lanes model stable-id runs
     (their extract path emits runs back); a run insert on an ordinary
@@ -130,12 +132,15 @@ def looks_like_merge_op(op: Any) -> bool:
 def seed_device_state(entries: Sequence[dict], payloads: PayloadTable,
                       capacity: int, min_seq: int, current_seq: int,
                       anno_slots: int = None,
-                      allow_runs: bool = False) -> DocState:
+                      allow_runs: bool = False,
+                      allow_items: bool = False) -> DocState:
     """Snapshot-format segments (oracle.snapshot_segments) -> a single-doc
     DocState whose visibility math reproduces the snapshot perspective.
 
     allow_runs gates decoding wire-encoded {"run": ...} payloads (matrix
-    axis snapshots only); any other non-sliceable payload raises
+    axis snapshots only); allow_items gates {"items": [...]} (sequence
+    channel summaries — the server lane path enables it so item
+    sequences materialize). Any other non-sliceable payload raises
     Unmodelable so a malformed client summary degrades the lane instead
     of planting a crash in the extraction pipeline."""
     n = len(entries)
@@ -176,6 +181,11 @@ def seed_device_state(entries: Sequence[dict], payloads: PayloadTable,
             # Matrix-axis snapshot entries carry wire-encoded id runs
             # (PermutationVector.snapshot form).
             text = Run.decode(text["run"])
+        elif allow_items and isinstance(text, dict) \
+                and isinstance(text.get("items"), list):
+            # Item-sequence snapshot entries (sharedSequence
+            # SubSequence wire form).
+            text = Items(text["items"])
         if kind != SEG_MARKER and not isinstance(text, (str, Items, Run)):
             raise Unmodelable(f"unsliceable snapshot payload {type(text)}")
         if kind == SEG_MARKER:

@@ -69,12 +69,19 @@ def id_key(stable_id: Tuple[int, int, int]) -> str:
 def encode_entry_payloads(entries: List[dict]) -> List[dict]:
     """JSON-safe copies of snapshot entries: Run payloads become
     {"run": [nonce, counter, start, length]} (PermutationVector.snapshot
-    wire form). Non-run entries pass through unchanged."""
+    wire form) and Items payloads {"items": [...]} (sharedSequence
+    SubSequence wire form). Plain text passes through unchanged."""
+    from .oracle import Items
+
     out = []
     for e in entries:
-        if isinstance(e.get("text"), Run):
+        text = e.get("text")
+        if isinstance(text, Run):
             e = dict(e)
-            e["text"] = {"run": e["text"].encode()}
+            e["text"] = {"run": text.encode()}
+        elif isinstance(text, Items):
+            e = dict(e)
+            e["text"] = {"items": text.encode()}
         out.append(e)
     return out
 
@@ -82,11 +89,16 @@ def encode_entry_payloads(entries: List[dict]) -> List[dict]:
 def decode_entry_payloads(entries: List[dict]) -> List[dict]:
     """Inverse of encode_entry_payloads (tolerates already-decoded
     entries)."""
+    from .oracle import Items
+
     out = []
     for e in entries:
         text = e.get("text")
         if isinstance(text, dict) and "run" in text:
             e = dict(e)
             e["text"] = Run.decode(text["run"])
+        elif isinstance(text, dict) and "items" in text:
+            e = dict(e)
+            e["text"] = Items(text["items"])
         out.append(e)
     return out

@@ -238,7 +238,8 @@ class MergeLaneStore:
                 row = seed_device_state(entries, self.payloads,
                                         bucket.capacity, min_seq,
                                         current_seq,
-                                        allow_runs=allow_runs)
+                                        allow_runs=allow_runs,
+                                        allow_items=not allow_runs)
             except (Unmodelable, ValueError):
                 self.opaque.add(key)
                 return False
@@ -503,13 +504,17 @@ class MergeLaneStore:
             packed = kernel.fetch_extracted(packed)
             seqs = np.asarray(seq_dev)
             min_seqs = np.asarray(min_seq_dev)
+            from ..mergetree.runs import encode_entry_payloads
             for lane, key in lanes:
                 entries = assemble_entries(packed, self.payloads, lane,
                                            min_seq=int(min_seqs[lane]))
-                chunks = chunk_entries(entries, chunk_chars)
                 total = sum(
                     (1 if e["kind"] == SEG_MARKER else len(e["text"]))
                     for e in entries if e.get("removedSeq") is None)
+                # JSON-safe chunks: Items/Run payloads wire-encode (the
+                # materialized-snapshot writer json.dumps these).
+                chunks = [encode_entry_payloads(c)
+                          for c in chunk_entries(entries, chunk_chars)]
                 out[key] = {
                     "header": {
                         "sequenceNumber": int(seqs[lane]),
@@ -528,11 +533,17 @@ class MergeLaneStore:
 
     # -- queries -----------------------------------------------------------
     def text(self, key: tuple) -> Optional[str]:
-        """Materialized text for a channel (None if opaque/unknown)."""
+        """Materialized text for a channel: None if opaque/unknown, or
+        when extraction hits non-text payloads (item sequences read via
+        entries(); an items lane with no visible payloads reads as "" —
+        exactly what a text view of it would say)."""
         if key not in self.where:
             return None
         b, lane = self.where[key]
-        return extract_text(self.buckets[b].row(lane), self.payloads)
+        try:
+            return extract_text(self.buckets[b].row(lane), self.payloads)
+        except TypeError:  # items/run payloads: not a text channel
+            return None
 
     def entries(self, key: tuple) -> Optional[list]:
         """Full-fidelity snapshot entries for one lane (host gather of a
@@ -628,9 +639,11 @@ def _compose_matrix_channels(out: Dict[tuple, dict]) -> None:
                 composed[axis] = {"segments": [], "seq": 0, "minSeq": 0}
                 continue
             hdr = part["header"]
-            segs = [e for chunk in part["chunks"] for e in chunk]
+            # Chunks arrive already wire-encoded (extract_assemble owns
+            # the payload wire format).
             composed[axis] = {
-                "segments": encode_entry_payloads(segs),
+                "segments": [e for chunk in part["chunks"]
+                             for e in chunk],
                 "seq": hdr["sequenceNumber"],
                 "minSeq": hdr["minimumSequenceNumber"],
             }
@@ -2828,6 +2841,9 @@ class TpuSequencerLambda(IPartitionLambda):
             return
         # Run payloads are modelable ONLY on matrix axis sub-lanes (their
         # extract path emits runs back); elsewhere they stay Unmodelable.
+        # Items payloads ARE modelable on ordinary sequence lanes now
+        # that extraction re-encodes them (round 5) — SharedNumber/
+        # ObjectSequence channels materialize instead of dropping opaque.
         allow_runs = matrix_base_key(key) is not None
         if seeded_before is not None and seq <= seeded_before.get(key, 0):
             return  # already reflected in the seeded snapshot base
@@ -2843,7 +2859,8 @@ class TpuSequencerLambda(IPartitionLambda):
         try:
             ops = wire_to_host_ops(self.merge.builder, op, seq,
                                    p.ref_seq, p.ordinal, msn,
-                                   allow_runs=allow_runs)
+                                   allow_runs=allow_runs,
+                                   allow_items=not allow_runs)
         except Unmodelable:
             self.merge.drop(key)
             return
@@ -3101,6 +3118,29 @@ class TpuSequencerLambda(IPartitionLambda):
         col_ids = axis_ids(MATRIX_COLS_SUFFIX)
         return [[cells.get(id_key(r) + "|" + id_key(c))
                  for c in col_ids] for r in row_ids]
+
+    def channel_items(self, doc_id: str, store: str,
+                      channel: str) -> Optional[list]:
+        """Server-materialized item-sequence values (visible Items
+        payloads in order) — comparable 1:1 with get_items() on a
+        caught-up client, including its behavior on non-items lanes
+        (Items segments only; a pure text lane reads as []). None when
+        no lane exists."""
+        from ..mergetree.oracle import Items
+
+        self.drain()
+        entries = self.merge.entries((doc_id, store, channel))
+        if entries is None:
+            return None
+        out: list = []
+        for e in entries:
+            if e.get("removedSeq") is not None or \
+                    e.get("removedLocalSeq") is not None:
+                continue
+            text = e.get("text")
+            if isinstance(text, Items):
+                out.extend(text.values)
+        return out
 
     def channel_directory(self, doc_id: str, store: str,
                           channel: str) -> Optional[dict]:
