@@ -700,22 +700,17 @@ class SharedItemsSequence(SharedSegmentSequence):
             return len(text["items"])
         return super()._segment_visible_len(seg)
 
-    # Items payloads are not JSON until wrapped (snapshot wire shape
-    # mirrors matrix.py's Run encoding: {"items": [...]}).
+    # Items payloads are not JSON until wrapped; ONE codec owns the
+    # {"items": [...]} wire shape (mergetree/runs.py — shared with the
+    # server lane extraction, so client and server snapshots can never
+    # drift apart).
     def _encode_snapshot_segments(self, segments: List[dict]) -> List[dict]:
-        from ..mergetree.oracle import Items
-        for entry in segments:
-            if isinstance(entry.get("text"), Items):
-                entry["text"] = {"items": entry["text"].encode()}
-        return segments
+        from ..mergetree.runs import encode_entry_payloads
+        return encode_entry_payloads(segments)
 
     def _decode_snapshot_segments(self, segments: List[dict]) -> List[dict]:
-        from ..mergetree.oracle import Items
-        for entry in segments:
-            text = entry.get("text")
-            if isinstance(text, dict) and "items" in text:
-                entry["text"] = Items(text["items"])
-        return segments
+        from ..mergetree.runs import decode_entry_payloads
+        return decode_entry_payloads(segments)
 
 
 class SharedNumberSequence(SharedItemsSequence):

@@ -238,11 +238,18 @@ def _visible_host(cols: dict, ref_seq: int, client: int) -> np.ndarray:
     return inserted & ~removed
 
 
+class NonTextPayload(TypeError):
+    """extract_text hit a non-str payload slice (items/run lane): the
+    lane is not a text channel. A dedicated type so callers can treat
+    it as "not text" WITHOUT masking unrelated TypeErrors as such."""
+
+
 def extract_text(state: DocState, payloads: PayloadTable,
                  ref_seq: Optional[int] = None, client: int = GOD_CLIENT,
                  doc: Optional[int] = None,
                  marker_char: str = "￼") -> str:
-    """Document text at a perspective (defaults: latest acked, god view)."""
+    """Document text at a perspective (defaults: latest acked, god view).
+    Raises NonTextPayload when the lane holds non-str payloads."""
     cols = _to_host(state, doc)
     if ref_seq is None:
         ref_seq = cols["seq"]
@@ -257,7 +264,10 @@ def extract_text(state: DocState, payloads: PayloadTable,
             parts.append(marker_char)
         else:
             off = int(cols["origin_off"][i])
-            parts.append(payload.text[off:off + int(cols["length"][i])])
+            part = payload.text[off:off + int(cols["length"][i])]
+            if not isinstance(part, str):
+                raise NonTextPayload(type(part).__name__)
+            parts.append(part)
     return "".join(parts)
 
 

@@ -97,7 +97,8 @@ def decode_entry_payloads(entries: List[dict]) -> List[dict]:
         if isinstance(text, dict) and "run" in text:
             e = dict(e)
             e["text"] = Run.decode(text["run"])
-        elif isinstance(text, dict) and "items" in text:
+        elif isinstance(text, dict) and isinstance(text.get("items"),
+                                                   list):
             e = dict(e)
             e["text"] = Items(text["items"])
         out.append(e)

@@ -539,10 +539,12 @@ class MergeLaneStore:
         exactly what a text view of it would say)."""
         if key not in self.where:
             return None
+        from ..mergetree.host import NonTextPayload
+
         b, lane = self.where[key]
         try:
             return extract_text(self.buckets[b].row(lane), self.payloads)
-        except TypeError:  # items/run payloads: not a text channel
+        except NonTextPayload:  # items/run lane: not a text channel
             return None
 
     def entries(self, key: tuple) -> Optional[list]:
@@ -618,9 +620,8 @@ def _compose_matrix_channels(out: Dict[tuple, dict]) -> None:
     """Recombine suffixed matrix sub-lane snapshots into ONE channel
     snapshot per matrix, keyed by the real channel name: the two axis
     snapshots in dds/matrix.py load_core's blob format (segments with
-    wire-encoded runs) + the sparse cell map. Mutates `out` in place."""
-    from ..mergetree.runs import encode_entry_payloads
-
+    wire-encoded runs, pre-encoded by extract_assemble) + the sparse
+    cell map. Mutates `out` in place."""
     groups: Dict[tuple, Dict[str, dict]] = {}
     for key in [k for k in out
                 if isinstance(k[2], str) and "\x00mx:" in k[2]]:

@@ -51,6 +51,9 @@ def make_channel(channel_type: str):
     if channel_type == "sequence":
         from ..dds.sequence import SharedString
         return SharedString("replay")
+    if channel_type == "items":
+        from ..dds.sequence import SharedNumberSequence
+        return SharedNumberSequence("replay")
     if channel_type == "matrix":
         from ..dds.matrix import SharedMatrix
         return SharedMatrix("replay")
@@ -70,6 +73,8 @@ def channel_state(channel_type: str, channel) -> Any:
                 | {"text": e.get("text", "")}
                 for e in channel.client.tree.snapshot_segments()],
         }
+    if channel_type == "items":
+        return channel.get_items()
     if channel_type == "matrix":
         return channel.extract()
     if channel_type == "directory":

@@ -45,6 +45,8 @@ def _session(server, doc_id: str, channel: str, channel_type: str,
     ds = c1.runtime.create_datastore("default")
     types = {
         "sequence": "https://graph.microsoft.com/types/mergeTree/string",
+        "items": "https://graph.microsoft.com/types/mergeTree"
+                 "/numberSequence",
         "matrix": "https://graph.microsoft.com/types/sharedmatrix",
         "directory": "https://graph.microsoft.com/types/directory",
     }
@@ -178,7 +180,32 @@ def record_directory(server, outdir: str, n_ops: int = 1200,
             "live_state": d0.root.to_dict()}
 
 
-def main(outdir: str | None = None) -> None:
+def record_items(server, outdir: str, n_ops: int = 1200,
+                 seed: int = 13) -> dict:
+    """Two-client number-sequence session: value-run inserts and range
+    removes (the items-lane workload, round 5)."""
+    containers, (s1, s2) = _session(
+        server, "corpus-items", "nums", "items", 2)
+    rng = random.Random(seed)
+    seqs = [(containers[0], s1), (containers[1], s2)]
+    for i in range(n_ops):
+        c, s = seqs[rng.randrange(2)]
+        with c.op_lock:
+            n = s.get_item_count()
+            if rng.random() < 0.72 or n < 6:
+                at = rng.randrange(n + 1)
+                s.insert_range(at, [i, i + 0.5])
+            else:
+                a = rng.randrange(n - 2)
+                s.remove_range(a, min(n, a + rng.randrange(1, 4)))
+    _settle(containers, lambda: s1.get_items() == s2.get_items())
+    return {"doc": "corpus-items", "channel": "nums",
+            "channel_type": "items", "workload": "number_sequence",
+            "seed": seed, "clients": 2,
+            "live_state": s1.get_items()}
+
+
+def main(outdir: str | None = None, only: set | None = None) -> None:
     from ..core.platform import force_host_platform
     force_host_platform(8)
 
@@ -188,10 +215,23 @@ def main(outdir: str | None = None) -> None:
 
     outdir = outdir or C.CORPUS_DIR
     os.makedirs(outdir, exist_ok=True)
+    pins_path = os.path.join(outdir, "pins.json")
     pins = {}
+    if only and os.path.exists(pins_path):
+        with open(pins_path) as f:
+            pins = json.load(f)  # partial re-record keeps other pins
+    recorders = (record_text, record_matrix, record_directory,
+                 record_items)
+    names = {r.__name__.removeprefix("record_") for r in recorders}
+    if only and only - names:
+        raise SystemExit(f"unknown --only names {sorted(only - names)}; "
+                         f"choose from {sorted(names)}")
     with Tinylicious() as server:
         rest = RestWrapper(server.url)
-        for rec in (record_text, record_matrix, record_directory):
+        for rec in recorders:
+            name = rec.__name__.removeprefix("record_")
+            if only and name not in only:
+                continue
             header = rec(server, outdir)
             rows = rest.get(
                 f"/deltas/{DEFAULT_TENANT}/{header['doc']}")["deltas"]
@@ -218,10 +258,13 @@ def main(outdir: str | None = None) -> None:
             }
             print(f"recorded {header['workload']}: {len(rows)} rows -> "
                   f"{pins[header['workload']]['digest'][:16]}...")
-    with open(os.path.join(outdir, "pins.json"), "w") as f:
+    with open(pins_path, "w") as f:
         json.dump(pins, f, indent=2, sort_keys=True)
-    print(f"pins written to {outdir}/pins.json")
+    print(f"pins written to {pins_path}")
 
 
 if __name__ == "__main__":
-    main(sys.argv[1] if len(sys.argv) > 1 else None)
+    only = {a.removeprefix("--only=") for a in sys.argv[1:]
+            if a.startswith("--only=")}
+    dirs = [a for a in sys.argv[1:] if not a.startswith("--only=")]
+    main(dirs[0] if dirs else None, only or None)

@@ -31,8 +31,8 @@ def test_corpus_rows_are_wellformed():
         assert len(rows) == pin["ops"]
         seqs = [r["sequence_number"] for r in rows]
         assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
-        assert header["channel_type"] in ("sequence", "matrix",
-                                          "directory")
+        assert header["channel_type"] in ("sequence", "items",
+                                          "matrix", "directory")
 
 
 def test_text_corpus_bulk_replay_matches_scalar():

@@ -371,3 +371,33 @@ class TestIntervalCatchupSoak:
         src = {iv.interval_id: ic.endpoints(iv) for iv in ic}
         got = {iv.interval_id: lc.endpoints(iv) for iv in lc}
         assert got == src
+
+
+class TestItemsServingSoak:
+    """Round-5 surface: item sequences materialized on server merge
+    lanes, under random two-client sessions with restarts."""
+
+    @pytest.mark.parametrize("trial", range(TRIALS))
+    def test_random_items_sessions_match(self, trial):
+        from fluidframework_tpu.dds.sequence import SharedNumberSequence
+
+        rng = random.Random(97_000 + trial)
+        server, _, (s1, s2) = _soak_session(SharedNumberSequence.TYPE)
+        for step in range(rng.randrange(50, 140)):
+            s = rng.choice([s1, s2])
+            n = s.get_item_count()
+            r = rng.random()
+            if r < 0.65 or n < 6:
+                s.insert_range(rng.randrange(n + 1),
+                               [step, step + 0.5])
+            elif r < 0.9:
+                a = rng.randrange(n - 2)
+                s.remove_range(a, min(n, a + rng.randrange(1, 4)))
+            else:
+                a = rng.randrange(n - 2)
+                s.annotate_range(a, a + 2, {"fmt": step % 3})
+            if rng.random() < 0.02:
+                server._deli_mgr.restart()
+        assert s1.get_items() == s2.get_items()
+        items = server.sequencer().channel_items("doc", "default", "ch")
+        assert items == s1.get_items()

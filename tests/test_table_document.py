@@ -129,3 +129,47 @@ class TestTableDocumentFarm:
     def test_demo_runs(self):
         out = demo()
         assert out["rows"] == 4 and out["row0"] == {"header": True}
+
+
+class TestTableDocumentOnServingPath:
+    def test_composite_materializes_on_device_lanes(self):
+        """Round-5 serving lanes carry the WHOLE composite: the table's
+        matrix rides axis merge lanes + a cell store, and both number-
+        sequence axes ride items-encoded merge lanes — the server holds
+        the full table, equal to every client."""
+        from fluidframework_tpu.loader.container import Loader
+        from fluidframework_tpu.loader.drivers.local import (
+            LocalDocumentServiceFactory)
+        from fluidframework_tpu.server.local_server import TpuLocalServer
+
+        server = TpuLocalServer()
+        loader = Loader(LocalDocumentServiceFactory(server))
+        c1 = loader.create_detached("doc")
+        ds1 = c1.runtime.create_datastore("table")
+        t1 = TableDocument(ds1)
+        t1.initialize(existing=False)
+        t1.insert_rows(0, 3)
+        t1.insert_cols(0, 2)
+        t1.set_cell(0, 0, "pre")
+        c1.attach()
+
+        c2 = loader.resolve("doc")
+        t2 = TableDocument(c2.runtime.get_datastore("table"))
+        t2.initialize(existing=True)
+        t2.set_cell(2, 1, 42)
+        t1.insert_rows(1, 1)
+        t1.annotate_rows(0, 2, {"height": 20})
+        t2.set_cell(1, 0, "mid")
+
+        assert t1.matrix.extract() == t2.matrix.extract()
+        assert t1.rows.get_items() == t2.rows.get_items()
+        seq = server.sequencer()
+        assert seq.channel_matrix("doc", "table", "matrix") == \
+            t1.matrix.extract()
+        assert seq.channel_items("doc", "table", "rows") == \
+            t1.rows.get_items()
+        assert seq.channel_items("doc", "table", "cols") == \
+            t1.cols.get_items()
+        # One materialized snapshot write covers the whole composite.
+        shas = server.write_materialized_snapshots()
+        assert "doc" in shas
