@@ -195,6 +195,46 @@ inline int hexval(char ch) {
   return -1;
 }
 
+// Validate UTF-8 over [a, b). Interned strings and arena text cross into
+// Python as str objects; an invalid byte sequence would otherwise raise
+// UnicodeDecodeError OUT of pump_parse, aborting the whole flush (every
+// innocent frame in the batch) instead of falling the one frame back.
+bool utf8_valid(const char* a, const char* b) {
+  while (a < b) {
+    uint8_t c0 = static_cast<uint8_t>(*a);
+    if (c0 < 0x80) {
+      ++a;
+      continue;
+    }
+    int cont;
+    uint32_t min_cp;
+    if ((c0 & 0xE0) == 0xC0) {
+      cont = 1;
+      min_cp = 0x80;
+    } else if ((c0 & 0xF0) == 0xE0) {
+      cont = 2;
+      min_cp = 0x800;
+    } else if ((c0 & 0xF8) == 0xF0) {
+      cont = 3;
+      min_cp = 0x10000;
+    } else {
+      return false;
+    }
+    uint32_t cp = c0 & (0x3F >> cont);
+    for (int i = 1; i <= cont; ++i) {
+      if (a + i >= b) return false;
+      uint8_t cc = static_cast<uint8_t>(a[i]);
+      if ((cc & 0xC0) != 0x80) return false;
+      cp = (cp << 6) | (cc & 0x3F);
+    }
+    if (cp < min_cp || cp > 0x10FFFF ||
+        (cp >= 0xD800 && cp < 0xE000))
+      return false;
+    a += cont + 1;
+  }
+  return true;
+}
+
 // Unescape the inner span of a JSON string into out (UTF-8); counts
 // CODEPOINTS (Python len semantics: one astral char == 1). Returns false on
 // a malformed escape.
@@ -371,12 +411,16 @@ inline bool key_is(const P& c, const Span& k, const char* name) {
 // Materialize a (possibly escaped) inner string span as std::string.
 bool span_str(const P& c, const Span& sp, bool esc, std::string* out) {
   if (!esc) {
+    // Whole buffers are UTF-8-gated up front (parse_boxcar) and UTF-8
+    // is self-synchronizing across the ASCII quote boundaries, so a
+    // raw span cannot be invalid — no per-span rescan on the hot path.
     out->assign(c.s + sp.a, sp.len());
     return true;
   }
   long chars = 0;
   out->clear();
-  return unescape(c.s + sp.a, c.s + sp.b, out, &chars);
+  if (!unescape(c.s + sp.a, c.s + sp.b, out, &chars)) return false;
+  return utf8_valid(out->data(), out->data() + out->size());
 }
 
 // ---------------------------------------------------------------------------
@@ -604,6 +648,24 @@ bool parse_op_object(P& c, OpFields* f, OpFields* inner = nullptr) {
 // interning
 // ---------------------------------------------------------------------------
 
+// Hand an intern delta tuple to the Python mirror. Returns false on ANY
+// failure (allocation or append) with the pending exception cleared —
+// the caller must then UNDO its C-side intern and signal frame fallback,
+// or the two intern tables would silently diverge.
+bool push_delta(PyObject* list, PyObject* t) {
+  if (t == nullptr) {
+    PyErr_Clear();
+    return false;
+  }
+  int rc = PyList_Append(list, t);
+  Py_DECREF(t);
+  if (rc != 0) {
+    PyErr_Clear();
+    return false;
+  }
+  return true;
+}
+
 int32_t intern_doc(Ctx* ctx, const std::string& name) {
   auto it = ctx->docs.find(name);
   if (it != ctx->docs.end()) return it->second;
@@ -611,10 +673,12 @@ int32_t intern_doc(Ctx* ctx, const std::string& name) {
   ctx->docs.emplace(name, ord);
   ctx->doc_clients.emplace_back();
   ctx->doc_next_ord.push_back(0);
-  PyObject* t = Py_BuildValue("(is)", ord, name.c_str());
-  if (t != nullptr) {
-    PyList_Append(ctx->new_docs, t);
-    Py_DECREF(t);
+  if (!push_delta(ctx->new_docs,
+                  Py_BuildValue("(is)", ord, name.c_str()))) {
+    ctx->docs.erase(name);
+    ctx->doc_clients.pop_back();
+    ctx->doc_next_ord.pop_back();
+    return -1;  // caller falls the frame back
   }
   return ord;
 }
@@ -625,10 +689,11 @@ int32_t intern_client(Ctx* ctx, int32_t doc, const std::string& cid) {
   if (it != m.end()) return it->second;
   int32_t ord = ctx->doc_next_ord[doc]++;
   m.emplace(cid, ord);
-  PyObject* t = Py_BuildValue("(iis)", doc, ord, cid.c_str());
-  if (t != nullptr) {
-    PyList_Append(ctx->new_clients, t);
-    Py_DECREF(t);
+  if (!push_delta(ctx->new_clients,
+                  Py_BuildValue("(iis)", doc, ord, cid.c_str()))) {
+    m.erase(cid);
+    --ctx->doc_next_ord[doc];
+    return -1;  // caller falls the frame back
   }
   return ord;
 }
@@ -643,15 +708,15 @@ int32_t intern_channel(Ctx* ctx, int32_t doc, const std::string& store,
   auto it = ctx->channels.find(key);
   if (it != ctx->channels.end()) return it->second;
   int32_t ord = static_cast<int32_t>(ctx->channels.size());
-  ctx->channels.emplace(std::move(key), ord);
+  ctx->channels.emplace(key, ord);  // no move: erase(key) on failure
   // s# (length-explicit): matrix sub-lane names carry an embedded NUL
   // ("chan\0mx:rows"), which plain "s" would silently truncate.
-  PyObject* t = Py_BuildValue("(iiss#)", ord, doc, store.c_str(),
-                              chan.data(),
-                              static_cast<Py_ssize_t>(chan.size()));
-  if (t != nullptr) {
-    PyList_Append(ctx->new_channels, t);
-    Py_DECREF(t);
+  if (!push_delta(ctx->new_channels,
+                  Py_BuildValue("(iiss#)", ord, doc, store.c_str(),
+                                chan.data(),
+                                static_cast<Py_ssize_t>(chan.size())))) {
+    ctx->channels.erase(key);
+    return -1;  // caller falls the frame back
   }
   return ord;
 }
@@ -662,11 +727,11 @@ int32_t intern_lww_key(Ctx* ctx, const std::string& k) {
   int32_t ord = static_cast<int32_t>(ctx->lww_keys.size());
   ctx->lww_keys.emplace(k, ord);
   // s#: the reserved cell key "\0cell" has an embedded NUL.
-  PyObject* t = Py_BuildValue("(is#)", ord, k.data(),
-                              static_cast<Py_ssize_t>(k.size()));
-  if (t != nullptr) {
-    PyList_Append(ctx->new_keys, t);
-    Py_DECREF(t);
+  if (!push_delta(ctx->new_keys,
+                  Py_BuildValue("(is#)", ord, k.data(),
+                                static_cast<Py_ssize_t>(k.size())))) {
+    ctx->lww_keys.erase(k);
+    return -1;  // caller falls the frame back
   }
   return ord;
 }
@@ -1213,6 +1278,20 @@ bool parse_message(Ctx* ctx, P& c, int32_t buf_idx, int32_t doc,
     r.v[C_FAMILY] = FAM_NONE;
     r.v[C_CHAN] = -1;
   }
+  // Centralized intern-failure guard: a classified row whose channel
+  // (or a SET/DELETE key) intern failed must fall back rather than ride
+  // with a divergent ordinal (push_delta cleared the error; the Python
+  // mirror never saw the mapping).
+  if (r.v[C_FAMILY] != FAM_NONE && r.v[C_CHAN] < 0) {
+    r.v[C_FLAGS] |= F_FALLBACK;
+    r.v[C_FAMILY] = FAM_NONE;
+  }
+  if (r.v[C_FAMILY] == FAM_LWW &&
+      (r.v[C_MKIND] == LW_SET || r.v[C_MKIND] == LW_DELETE) &&
+      r.v[C_POS1] < 0) {
+    r.v[C_FLAGS] |= F_FALLBACK;
+    r.v[C_FAMILY] = FAM_NONE;
+  }
   push_row(ctx, r);
   return true;
 }
@@ -1235,6 +1314,12 @@ void parse_boxcar(Ctx* ctx, int32_t buf_idx, const char* s, Py_ssize_t n) {
     r.v[C_FLAGS] = F_FALLBACK;
     push_row(ctx, r);
   };
+
+  // Whole-buffer UTF-8 gate: arena text, interned names, lww value
+  // spans, and emit-time message spans all decode into Python strings
+  // later; one invalid byte anywhere must cost THIS frame (fallback →
+  // slow-path poison drop), never a deferred UnicodeDecodeError.
+  if (!utf8_valid(s, s + n)) return fail();
 
   if (!eat(c, '{')) return fail();
   std::string doc_id, client_id;
@@ -1272,8 +1357,12 @@ void parse_boxcar(Ctx* ctx, int32_t buf_idx, const char* s, Py_ssize_t n) {
       saw_contents = true;
       ChanMemo memo;
       int32_t doc = intern_doc(ctx, doc_id);
+      if (doc < 0) return fail();
       int32_t sender_ord = -1;
-      if (have_client) sender_ord = intern_client(ctx, doc, client_id);
+      if (have_client) {
+        sender_ord = intern_client(ctx, doc, client_id);
+        if (sender_ord < 0) return fail();
+      }
       ws(c);
       if (!eat(c, '[')) return fail();
       if (!eat(c, ']')) {

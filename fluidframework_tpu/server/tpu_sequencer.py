@@ -1571,6 +1571,7 @@ class TpuSequencerLambda(IPartitionLambda):
         # SequencedWindow per fast flush instead of per-message emits.
         self.emit_window: Optional[Callable[[SequencedWindow], None]] = None
         self._raw_backlog: List[Tuple[int, str, bytes]] = []
+        self.poison_frames = 0  # undecodable raw frames dropped (logged)
         self._raw_offsets: Dict[str, int] = {}
         # Pipelined mode (opt-in): a clean single-window fast flush defers
         # its result fetch/emit to the next flush's drain(), overlapping
@@ -1797,10 +1798,22 @@ class TpuSequencerLambda(IPartitionLambda):
         (alfred/index.ts:305)."""
         if self._pump is None:
             from .wire import boxcar_from_wire
+            try:
+                value = boxcar_from_wire(message.value)
+            except Exception as err:  # noqa: BLE001 — untrusted bytes
+                # Same poison containment as the pump path: an
+                # undecodable record can never become valid on
+                # redelivery — drop it, keep the lambda alive.
+                self.poison_frames += 1
+                import logging
+                logging.getLogger(__name__).warning(
+                    "dropping undecodable raw frame for %r at "
+                    "offset %s: %s", message.key, message.offset, err)
+                return
             self.handler(QueuedMessage(
                 topic=message.topic, partition=message.partition,
                 offset=message.offset, key=message.key,
-                value=boxcar_from_wire(message.value)))
+                value=value))
             return
         doc_id = message.key
         last = self._raw_offsets.get(doc_id)
@@ -1971,9 +1984,23 @@ class TpuSequencerLambda(IPartitionLambda):
             doc_active[doc_id] = max(doc_active.get(doc_id, -1), off)
         for off, doc_id, buf in backlog:
             if doc_id in slow_ids:
+                try:
+                    value = boxcar_from_wire(buf)
+                except Exception as err:  # noqa: BLE001 — untrusted bytes
+                    # Deterministic poison: an undecodable log record can
+                    # never become valid on redelivery — drop THIS frame
+                    # (logged), keep every innocent frame flowing
+                    # (reference kafka-service catches extractBoxcar
+                    # failures the same way).
+                    self.poison_frames += 1
+                    import logging
+                    logging.getLogger(__name__).warning(
+                        "dropping undecodable raw frame for %r at "
+                        "offset %s: %s", doc_id, off, err)
+                    continue
                 self.handler(QueuedMessage(
                     topic="rawdeltas", partition=0, offset=off, key=doc_id,
-                    value=boxcar_from_wire(buf)))
+                    value=value))
         for doc_id, off in doc_active.items():
             if doc_id not in slow_ids:
                 self.docs[doc_id].log_offset = max(

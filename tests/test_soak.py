@@ -401,3 +401,93 @@ class TestItemsServingSoak:
         assert s1.get_items() == s2.get_items()
         items = server.sequencer().channel_items("doc", "default", "ch")
         assert items == s1.get_items()
+
+
+class TestWireFuzzSoak:
+    """The round-5 native parse paths (matrix envelope, directory
+    storage, run arrays) under random byte corruption: the pump must
+    never crash the lambda — corrupt frames route slow or surface as
+    contained errors, and uncorrupted traffic still matches the object
+    path afterward."""
+
+    @pytest.mark.parametrize("trial", range(TRIALS))
+    def test_corrupted_frames_never_crash_the_pump(self, trial):
+        from fluidframework_tpu.server import pump as pump_mod
+        if not pump_mod.available():
+            pytest.skip("native wirepump unavailable")
+        from fluidframework_tpu.server.log import QueuedMessage
+        from fluidframework_tpu.server.tpu_sequencer import (
+            TpuSequencerLambda)
+        from fluidframework_tpu.server.wire import boxcar_to_wire
+
+        class _Ctx:
+            def checkpoint(self, *_):
+                pass
+
+            def error(self, err, restart=False):
+                raise err
+
+        rng = random.Random(99_000 + trial)
+        nonce = (1 << 44) + trial
+        lam = TpuSequencerLambda(_Ctx(), emit=lambda *a: None,
+                                 nack=lambda *a: None,
+                                 client_timeout_s=0.0)
+
+        def boxcar(doc, msgs, cid):
+            return boxcar_to_wire(Boxcar("t", doc, cid, msgs))
+
+        ops = [
+            {"target": "rows", "op": {"type": 0, "pos1": 0,
+                                      "seg": {"run": [nonce, 1, 0, 3]}}},
+            {"target": "cell", "key": f"{nonce}.1.0|{nonce}.1.1",
+             "value": {"v": trial}},
+            {"type": "storage", "path": "/", "op": {
+                "type": "set", "key": "k", "value": 1, "pid": 1}},
+            {"type": "createSubDirectory", "path": "/", "name": "s"},
+        ]
+        join = DocumentMessage(0, -1, MessageType.CLIENT_JOIN,
+                               data=json.dumps({"clientId": "c",
+                                                "detail": {}}))
+        for i in range(40):
+            op = rng.choice(ops)
+            chan = "g" if "target" in op else "dir"
+            msg = DocumentMessage(
+                i + 1, i, MessageType.OPERATION,
+                contents={"address": "s", "contents": {
+                    "address": chan, "contents": op}})
+            raw = boxcar("doc", [join, msg] if i == 0 else [msg], "c")
+            if rng.random() < 0.5:  # corrupt: flip/truncate/insert bytes
+                b = bytearray(raw)
+                mode = rng.random()
+                if mode < 0.4 and b:
+                    for _ in range(rng.randrange(1, 4)):
+                        b[rng.randrange(len(b))] = rng.randrange(256)
+                elif mode < 0.7:
+                    b = b[:rng.randrange(len(b))]
+                else:
+                    at = rng.randrange(len(b))
+                    b[at:at] = bytes(rng.randrange(256)
+                                     for _ in range(3))
+                raw = bytes(b)
+            try:
+                lam.handler_raw(QueuedMessage("rawdeltas", 0, i, "doc",
+                                              raw))
+                if rng.random() < 0.3:
+                    lam.flush()
+            except Exception as err:  # noqa: BLE001
+                # Contained per-frame errors are acceptable; native
+                # crashes (segfault) would kill the process before this.
+                assert not isinstance(err, (SystemError, MemoryError)), \
+                    err
+        lam.flush()
+        lam.drain()
+        # The lambda is still alive and serves clean traffic.
+        ok = DocumentMessage(
+            100, 99, MessageType.OPERATION,
+            contents={"address": "s", "contents": {
+                "address": "g", "contents": {
+                    "target": "cell", "key": "a|b", "value": 1}}})
+        lam.handler_raw(QueuedMessage("rawdeltas", 0, 999, "doc",
+                                      boxcar("doc", [ok], "c")))
+        lam.flush()
+        lam.drain()

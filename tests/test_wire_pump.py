@@ -226,14 +226,31 @@ class TestFastSlowDifferential:
         assert len(nacks[0]) == 1 and nacks[0] == nacks[1]
         assert not emits[0] and not emits[1]
 
-    def test_malformed_boxcar_falls_back_whole_buffer(self):
+    def test_malformed_boxcar_drops_without_killing_the_lambda(self):
+        """An undecodable log record is deterministic poison (redelivery
+        can never fix it): the frame drops with a logged counter and the
+        lambda keeps serving — innocent traffic in the same flush and
+        after it is unaffected (round-5 containment; previously the
+        whole flush aborted)."""
         eb, nb = [], []
         B = _lam(lambda d, m: eb.append((d, m)), lambda *a: nb.append(a))
         B.handler_raw(QueuedMessage(
             topic="rawdeltas", partition=0, offset=0, key="d0",
             value=b'{"documentId": "d0", "contents": [{{{'))
-        with pytest.raises(Exception):
-            B.flush()
+        # Invalid UTF-8 takes the same frame-fallback road (the native
+        # pump gates whole buffers up front).
+        B.handler_raw(QueuedMessage(
+            topic="rawdeltas", partition=0, offset=1, key="d0",
+            value=b'{"documentId": "d0\x81", "contents": []}'))
+        good = Boxcar("t", "d0", "c0", [
+            _join("c0"), _merge_op(1, {"type": OP_INSERT, "pos1": 0,
+                                       "seg": {"text": "ok"}})])
+        B.handler_raw(_qm(2, "d0", good, raw=True))
+        B.flush()
+        B.drain()
+        assert B.poison_frames == 2
+        assert len(eb) == 2  # join + the good op sequenced
+        assert B.channel_text("d0", "s", "t") == "ok"
 
     def test_multi_wave_interleaving_matches(self):
         rng = np.random.default_rng(7)
