@@ -53,7 +53,8 @@ class MergeArenaBlock:
                  "bufs", "pbuf", "pstart", "pend", "seqs", "_cache")
 
     # kinds codes (block-local)
-    K_TEXT, K_MARKER, K_ANNOTATE, K_NONE, K_RUN = 0, 1, 2, 3, 4
+    K_TEXT, K_MARKER, K_ANNOTATE, K_NONE, K_RUN, K_ITEMS = \
+        0, 1, 2, 3, 4, 5
 
     def __init__(self, kinds, textoff, textlen, arena, bufs, pbuf, pstart,
                  pend):
@@ -97,6 +98,15 @@ class MergeArenaBlock:
             text = self.arena[off:off + int(self.textlen[i])].decode(
                 "utf-8")
             out = InsertPayload(SEG_TEXT, text, self._props(i))
+        elif kind == self.K_ITEMS:
+            # Item-sequence insert: the raw wire span holds the value
+            # array (sharedSequence SubSequence).
+            import json as _json
+
+            from .oracle import Items
+            s = int(self.pstart[i])
+            raw = self.bufs[int(self.pbuf[i])][s:int(self.pend[i])]
+            out = InsertPayload(SEG_TEXT, Items(_json.loads(raw)), None)
         elif kind == self.K_RUN:
             # Matrix-axis stable-id run: the raw wire span holds the
             # encoded [nonce, counter, start, length] array.

@@ -61,6 +61,8 @@ enum Flag {
   F_VALUE = 8,     // lww op carried a "value" key
   F_RUN = 16,      // merge insert payload is a stable-id run (matrix axis);
                    // PSTART/PEND span the raw run array
+  F_ITEMS = 32,    // merge insert payload is an item-value array
+                   // (sharedSequence SubSequence); PSTART/PEND span it
 };
 
 // MsgKind (server/ticket_kernel.py)
@@ -436,7 +438,9 @@ struct OpFields {
   bool has_pos1 = false, has_pos2 = false, has_delta = false;
   long pos1 = 0, pos2 = 0, delta = 0;
   bool has_seg = false, seg_text_present = false, seg_marker = false;
-  bool seg_other = false;  // items or unknown payload keys -> unmodelable
+  bool seg_other = false;  // unknown payload keys / non-literal marker
+  //                            values -> unmodelable (items/runs are
+  //                            modelable via their own flags)
   Span seg_text;
   bool seg_text_esc = false;
   Span seg_props;  // raw JSON span of seg.props
@@ -457,6 +461,9 @@ struct OpFields {
   bool has_inner = false;      // "op": {...} parsed into *inner
   bool seg_run = false;        // seg carried a "run" id-span array
   Span seg_run_span;           // raw span (validated by parse_run_array)
+  bool seg_items = false;      // seg carried an "items" value array
+  Span seg_items_span;         // raw span of the array
+  long seg_items_count = -1;   // element count (device new_len)
 };
 
 bool raw_span(P& c, Span* out) {
@@ -509,6 +516,37 @@ bool parse_seg(P& c, OpFields* f) {
     if (key_is(c, k, "run")) {
       if (!raw_span(c, &f->seg_run_span)) return false;
       f->seg_run = true;
+    } else if (key_is(c, k, "items")) {
+      ws(c);
+      if (!peek(c, '[')) {
+        f->seg_other = true;  // non-array items: unmodelable
+        if (!skip_value(c)) return false;
+      } else {
+        if (!raw_span(c, &f->seg_items_span)) return false;
+        // Count top-level elements (device new_len) on a sub-cursor.
+        P ic{c.s, c.s + f->seg_items_span.a, c.s + f->seg_items_span.b};
+        long count = 0;
+        if (!eat(ic, '[')) return false;
+        if (!eat(ic, ']')) {
+          while (true) {
+            if (!skip_value(ic)) {
+              f->seg_other = true;  // malformed array: slow path
+              break;
+            }
+            ++count;
+            if (eat(ic, ',')) continue;
+            if (eat(ic, ']')) break;
+            f->seg_other = true;
+            break;
+          }
+        }
+        if (!f->seg_other && count > 0) {
+          f->seg_items = true;
+          f->seg_items_count = count;
+        } else if (count == 0) {
+          f->seg_other = true;  // empty items insert: slow path decides
+        }
+      }
     } else if (key_is(c, k, "text")) {
       if (!peek(c, '"')) {
         f->seg_other = true;  // non-string text (items ride "items" anyway)
@@ -518,13 +556,22 @@ bool parse_seg(P& c, OpFields* f) {
         f->seg_text_present = true;
       }
     } else if (key_is(c, k, "marker")) {
+      // The slow path tests truthiness (seg.get("marker")); the pump
+      // can only evaluate the JSON literals. true/false/null map
+      // exactly; any other value (1, "x", [...]) falls back so the two
+      // paths can never diverge on what counts as a marker.
       ws(c);
-      f->seg_marker = (c.p < c.e && *c.p == 't');
+      char m0 = (c.p < c.e) ? *c.p : '\0';
+      if (m0 == 't') {
+        f->seg_marker = true;
+      } else if (m0 != 'f' && m0 != 'n') {
+        f->seg_other = true;  // non-literal marker value: unmodelable
+      }
       if (!skip_value(c)) return false;
     } else if (key_is(c, k, "props")) {
       if (!raw_span(c, &f->seg_props)) return false;
     } else {
-      f->seg_other = true;  // items / unknown payload: unmodelable
+      f->seg_other = true;  // unknown payload key: unmodelable
       if (!skip_value(c)) return false;
     }
     if (eat(c, ',')) continue;
@@ -991,7 +1038,22 @@ bool parse_envelope(Ctx* ctx, P& c, int32_t doc, Row* r, ChanMemo* memo) {
         }
         return true;
       }
-      // merge-looking insert the kernel cannot model (items, no payload)
+      if (f.has_seg && f.seg_items && !f.seg_other &&
+          !f.seg_text_present && !f.seg_marker &&
+          !f.seg_props.present() && fits32(f.seg_items_count)) {
+        // Item-sequence insert (round 5: items materialize on server
+        // lanes). PSTART/PEND carry the value-array span; props-bearing
+        // items inserts keep the slow path (the one span is taken).
+        r->v[C_FAMILY] = FAM_MERGE;
+        r->v[C_MKIND] = M_INSERT;
+        r->v[C_FLAGS] |= F_ITEMS;
+        r->v[C_POS1] = static_cast<int32_t>(f.pos1);
+        r->v[C_CHARLEN] = static_cast<int32_t>(f.seg_items_count);
+        r->v[C_PSTART] = f.seg_items_span.a;
+        r->v[C_PEND] = f.seg_items_span.b;
+        return true;
+      }
+      // merge-looking insert the kernel cannot model (no payload)
       r->v[C_FLAGS] |= F_FALLBACK;
       return true;
     }

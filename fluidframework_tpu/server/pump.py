@@ -21,7 +21,8 @@ DOC, KIND, CLIENT, CSEQ, REFSEQ, FAMILY, CHAN, MKIND, POS1, POS2, \
     range(19)
 NF = 19
 
-F_FALLBACK, F_MARKER, F_PROPS, F_VALUE, F_RUN = 1, 2, 4, 8, 16
+F_FALLBACK, F_MARKER, F_PROPS, F_VALUE, F_RUN, F_ITEMS = \
+    1, 2, 4, 8, 16, 32
 FAM_NONE, FAM_MERGE, FAM_LWW = 0, 1, 2
 
 

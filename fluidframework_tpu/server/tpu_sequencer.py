@@ -2189,6 +2189,7 @@ class TpuSequencerLambda(IPartitionLambda):
         kinds[(mk == 1) & ((fl & P.F_MARKER) != 0)] = MergeArenaBlock.K_MARKER
         kinds[(mk == 1) & ((fl & P.F_MARKER) == 0)] = MergeArenaBlock.K_TEXT
         kinds[(mk == 1) & ((fl & P.F_RUN) != 0)] = MergeArenaBlock.K_RUN
+        kinds[(mk == 1) & ((fl & P.F_ITEMS) != 0)] = MergeArenaBlock.K_ITEMS
         kinds[mk == 3] = MergeArenaBlock.K_ANNOTATE
         block = MergeArenaBlock(
             kinds=kinds,

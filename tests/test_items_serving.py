@@ -93,3 +93,117 @@ class TestItemsSequenceServing:
         flat = [e for chunk in snap["chunks"] for e in chunk]
         assert any(isinstance(e.get("text"), dict)
                    and e["text"].get("items") for e in flat)
+
+
+# ---------------------------------------------------------------------------
+# fast path (native pump) vs object path
+# ---------------------------------------------------------------------------
+
+import json
+
+import pytest
+
+from fluidframework_tpu.protocol.messages import (Boxcar, DocumentMessage,
+                                                  MessageType)
+from fluidframework_tpu.server import pump as pump_mod
+from fluidframework_tpu.server.log import QueuedMessage
+from fluidframework_tpu.server.tpu_sequencer import TpuSequencerLambda
+from fluidframework_tpu.server.wire import boxcar_to_wire
+
+
+class _Ctx:
+    def checkpoint(self, *_):
+        pass
+
+    def error(self, err, restart=False):
+        raise err
+
+
+def _items_op(csn, op, chan="nums"):
+    return DocumentMessage(
+        client_sequence_number=csn, reference_sequence_number=csn - 1,
+        type=MessageType.OPERATION,
+        contents={"address": "s", "contents": {"address": chan,
+                                               "contents": op}})
+
+
+def _run_both(ops):
+    ea, eb = [], []
+    A = TpuSequencerLambda(_Ctx(), emit=lambda d, m: ea.append(
+        (m.sequence_number, m.client_sequence_number)),
+        nack=lambda *a: None, client_timeout_s=0.0)
+    B = TpuSequencerLambda(_Ctx(), emit=lambda d, m: eb.append(
+        (m.sequence_number, m.client_sequence_number)),
+        nack=lambda *a: None, client_timeout_s=0.0)
+    fallbacks = []
+    orig = B.handler
+    B.handler = lambda qm: (fallbacks.append(qm), orig(qm))[1]
+    msgs = [DocumentMessage(0, -1, MessageType.CLIENT_JOIN,
+                            data=json.dumps({"clientId": "c",
+                                             "detail": {}}))]
+    msgs += [_items_op(i + 1, op) for i, op in enumerate(ops)]
+    for i, m in enumerate(msgs):
+        box = Boxcar("t", "doc",
+                     None if m.type != MessageType.OPERATION else "c", [m])
+        A.handler(QueuedMessage("rawdeltas", 0, i, "doc", box))
+        B.handler_raw(QueuedMessage("rawdeltas", 0, i, "doc",
+                                    boxcar_to_wire(box)))
+    A.flush()
+    B.flush()
+    B.drain()
+    assert ea == eb and len(ea) == len(msgs)
+    return A, B, fallbacks
+
+
+@pytest.mark.skipif(not pump_mod.available(),
+                    reason="native wirepump unavailable")
+class TestItemsFastPath:
+    def test_items_inserts_ride_fast_without_fallback(self):
+        ops = [
+            {"type": 0, "pos1": 0, "seg": {"items": [1, 2.5, "x"]}},
+            {"type": 0, "pos1": 1, "seg": {"items": [{"deep": [None]}]}},
+            {"type": 1, "pos1": 0, "pos2": 1},
+            {"type": 0, "pos1": 2, "seg": {"items": [True]}},
+        ]
+        A, B, fallbacks = _run_both(ops)
+        assert not fallbacks  # admitted natively
+        ia = A.channel_items("doc", "s", "nums")
+        ib = B.channel_items("doc", "s", "nums")
+        assert ia == ib == [{"deep": [None]}, 2.5, True, "x"]
+
+    def test_props_and_empty_items_fall_back_identically(self):
+        ops = [
+            {"type": 0, "pos1": 0,
+             "seg": {"items": [7], "props": {"p": 1}}},
+            {"type": 0, "pos1": 0, "seg": {"items": []}},
+            {"type": 0, "pos1": 0, "seg": {"items": [8]}},
+        ]
+        A, B, fallbacks = _run_both(ops)
+        assert fallbacks  # props/empty shapes keep the slow path
+        assert A.channel_items("doc", "s", "nums") == \
+            B.channel_items("doc", "s", "nums")
+        entries_a = A.merge.entries(("doc", "s", "nums"))
+        entries_b = B.merge.entries(("doc", "s", "nums"))
+        assert [e.get("props") for e in entries_a] == \
+            [e.get("props") for e in entries_b]
+
+    def test_nonliteral_marker_values_fall_back_identically(self):
+        """seg.get("marker") truthiness on the slow path vs JSON
+        literals on the pump: non-literal marker values (1, "x") must
+        fall back so the two paths can never disagree on what counts as
+        a marker (found by review; previously {"marker": 1, "items":
+        [...]} diverged: native items insert vs object marker)."""
+        ops = [
+            {"type": 0, "pos1": 0, "seg": {"marker": 1, "items": [7]}},
+            {"type": 0, "pos1": 0, "seg": {"marker": "x", "text": "t"}},
+            {"type": 0, "pos1": 0, "seg": {"marker": False,
+                                           "text": "ok"}},
+            {"type": 0, "pos1": 0, "seg": {"marker": None,
+                                           "items": [9]}},
+        ]
+        A, B, fallbacks = _run_both(ops)
+        assert fallbacks  # the non-literal marker shapes routed slow
+        ea = A.merge.entries(("doc", "s", "nums"))
+        eb = B.merge.entries(("doc", "s", "nums"))
+        assert [(e["kind"], str(e.get("text"))) for e in ea] == \
+            [(e["kind"], str(e.get("text"))) for e in eb]

@@ -444,13 +444,15 @@ class TestWireFuzzSoak:
             {"type": "storage", "path": "/", "op": {
                 "type": "set", "key": "k", "value": 1, "pid": 1}},
             {"type": "createSubDirectory", "path": "/", "name": "s"},
+            {"type": 0, "pos1": 0, "seg": {"items": [trial, "v", None]}},
         ]
         join = DocumentMessage(0, -1, MessageType.CLIENT_JOIN,
                                data=json.dumps({"clientId": "c",
                                                 "detail": {}}))
         for i in range(40):
             op = rng.choice(ops)
-            chan = "g" if "target" in op else "dir"
+            chan = ("g" if "target" in op
+                    else "nums" if "seg" in op else "dir")
             msg = DocumentMessage(
                 i + 1, i, MessageType.OPERATION,
                 contents={"address": "s", "contents": {
