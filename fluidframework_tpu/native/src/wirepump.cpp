@@ -143,8 +143,14 @@ struct Span {
   long len() const { return b - a; }
 };
 
+inline int hexval(char ch);
+
 // String token at the cursor; out = INNER span (between the quotes);
-// esc = whether any backslash escape occurred.
+// esc = whether any backslash escape occurred. STRICT JSON: escapes are
+// validated and raw control chars rejected here, because raw string
+// spans (props/items/lww values) are re-parsed host-side with strict
+// json.loads — anything admitted laxly would defer a JSONDecodeError
+// from ingest (contained) to materialization (uncontained).
 bool str_token(P& c, Span* out, bool* esc) {
   ws(c);
   if (c.p >= c.e || *c.p != '"') {
@@ -154,18 +160,31 @@ bool str_token(P& c, Span* out, bool* esc) {
   const char* q = ++c.p;
   *esc = false;
   while (c.p < c.e) {
-    if (*c.p == '\\') {
+    const unsigned char ch = static_cast<unsigned char>(*c.p);
+    if (ch == '\\') {
       *esc = true;
       if (c.p + 1 >= c.e) break;
-      c.p += 2;
-      continue;
+      const char e = c.p[1];
+      if (e == '"' || e == '\\' || e == '/' || e == 'b' || e == 'f' ||
+          e == 'n' || e == 'r' || e == 't') {
+        c.p += 2;
+        continue;
+      }
+      if (e == 'u' && c.p + 6 <= c.e && hexval(c.p[2]) >= 0 &&
+          hexval(c.p[3]) >= 0 && hexval(c.p[4]) >= 0 &&
+          hexval(c.p[5]) >= 0) {
+        c.p += 6;
+        continue;
+      }
+      break;  // invalid escape: strict JSON rejects this string
     }
-    if (*c.p == '"') {
+    if (ch == '"') {
       out->a = static_cast<int32_t>(q - c.s);
       out->b = static_cast<int32_t>(c.p - c.s);
       ++c.p;
       return true;
     }
+    if (ch < 0x20) break;  // unescaped control char: strict JSON rejects
     ++c.p;
   }
   c.bad = true;
@@ -294,8 +313,12 @@ bool unescape(const char* a, const char* b, std::string* out, long* chars) {
   return true;
 }
 
-// Integer token; false (non-fatal) when the value is a float/exponent or
-// not a number at all. Cursor advances past the numeric token either way.
+// Integer token; false (non-fatal) when the value is a float/exponent,
+// an overflowing integer, or not a number at all. STRICT JSON number
+// grammar — leading zeros, bare '.'/'e' tails, and '1.2.3'-style
+// multi-dot tails set c.bad so the frame falls back to the slow path's
+// strict parse + poison containment instead of being admitted with a
+// span json.loads would later reject.
 bool int_token(P& c, long* out, bool* is_number) {
   ws(c);
   *is_number = false;
@@ -311,16 +334,52 @@ bool int_token(P& c, long* out, bool* is_number) {
   }
   long v = 0;
   bool overflow = false;
-  while (q < c.e && *q >= '0' && *q <= '9') {
-    if (v > (LONG_MAX - 9) / 10) overflow = true;
-    else v = v * 10 + (*q - '0');
+  if (*q == '0') {
     ++q;
-  }
-  bool fractional = q < c.e && (*q == '.' || *q == 'e' || *q == 'E');
-  if (fractional) {  // consume the float tail so the cursor stays aligned
-    while (q < c.e && (*q == '.' || *q == 'e' || *q == 'E' || *q == '+' ||
-                       *q == '-' || (*q >= '0' && *q <= '9')))
+    if (q < c.e && *q >= '0' && *q <= '9') {
+      c.bad = true;  // leading zero: strict JSON rejects
+      return false;
+    }
+  } else {
+    while (q < c.e && *q >= '0' && *q <= '9') {
+      if (v > (LONG_MAX - 9) / 10) overflow = true;
+      else v = v * 10 + (*q - '0');
       ++q;
+    }
+  }
+  bool fractional = false;
+  if (q < c.e && *q == '.') {
+    fractional = true;
+    ++q;
+    if (q >= c.e || *q < '0' || *q > '9') {
+      c.bad = true;  // '.' must be followed by a digit
+      return false;
+    }
+    while (q < c.e && *q >= '0' && *q <= '9') ++q;
+  }
+  if (q < c.e && (*q == 'e' || *q == 'E')) {
+    fractional = true;
+    ++q;
+    if (q < c.e && (*q == '+' || *q == '-')) ++q;
+    if (q >= c.e || *q < '0' || *q > '9') {
+      c.bad = true;  // exponent must have digits
+      return false;
+    }
+    while (q < c.e && *q >= '0' && *q <= '9') ++q;
+  }
+  // Trailing-garbage guard: after a complete JSON number the next char
+  // can only be structural (ws , ] } or end). '1.2.3', '1e5e3', '123abc'
+  // must set c.bad HERE — callers that trust a true skip without
+  // re-checking the following punctuation would otherwise admit a
+  // silently truncated span.
+  if (q < c.e) {
+    const char nx = *q;
+    if (nx == '.' || nx == '+' || nx == '-' ||
+        (nx >= '0' && nx <= '9') || (nx >= 'a' && nx <= 'z') ||
+        (nx >= 'A' && nx <= 'Z')) {
+      c.bad = true;
+      return false;
+    }
   }
   c.p = q;
   *is_number = true;
@@ -395,10 +454,8 @@ bool skip_value(P& c, int depth) {
     long v;
     bool isnum;
     int_token(c, &v, &isnum);
-    if (isnum) {
-      c.bad = false;  // float tails are fine to skip over
-      return true;
-    }
+    // Valid floats/overflows skip fine (is_number, not c.bad); grammar
+    // violations keep c.bad so the frame falls back whole.
     return !c.bad;
   }
   c.bad = true;

@@ -252,6 +252,58 @@ class TestFastSlowDifferential:
         assert len(eb) == 2  # join + the good op sequenced
         assert B.channel_text("d0", "s", "t") == "ok"
 
+    def test_lax_json_payload_poisons_at_ingest_not_materialization(self):
+        """Payload spans the pump admits are re-parsed host-side with
+        STRICT json.loads (host.py MergeArenaBlock.resolve, _props), so
+        the native tokenizers must be exactly as strict: a frame that is
+        lax-parseable but strict-invalid ('1.2.3', leading zeros, bad
+        escapes) must fall back whole and hit the slow path's poison
+        containment at INGEST — previously it was admitted natively and
+        planted a deferred JSONDecodeError that crashed every later
+        read/summarize of the lane."""
+        eb, nb = [], []
+        B = _lam(lambda d, m: eb.append((d, m)), lambda *a: nb.append(a))
+        # Seed a healthy items channel first.
+        good = Boxcar("t", "d0", "c0", [
+            _join("c0"),
+            _merge_op(1, {"type": OP_INSERT, "pos1": 0,
+                          "seg": {"items": [7, 8]}})])
+        B.handler_raw(_qm(0, "d0", good, raw=True))
+
+        # Craft lax frames by byte-surgery on a valid wire frame: the
+        # placeholder array is replaced with shapes json.loads rejects.
+        def lax_frame(csn, payload: bytes, seg_key="items"):
+            box = Boxcar("t", "d0", "c0", [
+                _merge_op(csn, {"type": OP_INSERT, "pos1": 0,
+                                "seg": {seg_key: [123456789]}})])
+            raw = boxcar_to_wire(box)
+            assert raw.count(b"[123456789]") == 1
+            return raw.replace(b"[123456789]", payload)
+
+        for off, payload in enumerate(
+                (b"[1.2.3]", b"[01]", b'["\\x"]', b"[1e]"), start=1):
+            B.handler_raw(QueuedMessage(
+                topic="rawdeltas", partition=0, offset=off, key="d0",
+                value=lax_frame(off, payload)))
+        # Lax props on a text insert take the same road.
+        box = Boxcar("t", "d0", "c0", [
+            _merge_op(5, {"type": OP_INSERT, "pos1": 0,
+                          "seg": {"text": "x", "props": {"a": [123456789]}}})])
+        raw = boxcar_to_wire(box).replace(b"[123456789]", b"[01]")
+        B.handler_raw(QueuedMessage(topic="rawdeltas", partition=0,
+                                    offset=5, key="d0", value=raw))
+        # Innocent traffic after the poison still lands...
+        B.handler_raw(_qm(6, "d0", Boxcar("t", "d0", "c0", [
+            _merge_op(2, {"type": OP_INSERT, "pos1": 2,
+                          "seg": {"items": [9]}})]), raw=True))
+        B.flush()
+        B.drain()
+        assert B.poison_frames == 5
+        # ...and every later read path materializes without a deferred
+        # JSONDecodeError (the round-5 crash: resolve() on the lax span).
+        assert B.channel_items("d0", "s", "t") == [7, 8, 9]
+        assert ("d0", "s", "t") not in B.merge.opaque
+
     def test_multi_wave_interleaving_matches(self):
         rng = np.random.default_rng(7)
         docs = [f"w{d}" for d in range(4)]
