@@ -129,23 +129,16 @@ def looks_like_merge_op(op: Any) -> bool:
 # snapshot entries <-> device state
 # ---------------------------------------------------------------------------
 
-def seed_device_state(entries: Sequence[dict], payloads: PayloadTable,
-                      capacity: int, min_seq: int, current_seq: int,
-                      anno_slots: int = None,
-                      allow_runs: bool = False,
-                      allow_items: bool = False) -> DocState:
-    """Snapshot-format segments (oracle.snapshot_segments) -> a single-doc
-    DocState whose visibility math reproduces the snapshot perspective.
-
-    allow_runs gates decoding wire-encoded {"run": ...} payloads (matrix
-    axis snapshots only); allow_items gates {"items": [...]} (sequence
-    channel summaries — the server lane path enables it so item
-    sequences materialize). Any other non-sliceable payload raises
-    Unmodelable so a malformed client summary degrades the lane instead
-    of planting a crash in the extraction pipeline."""
+def seed_host_cols(entries: Sequence[dict], payloads: PayloadTable,
+                   anno_slots: int = None,
+                   allow_runs: bool = False,
+                   allow_items: bool = False) -> dict:
+    """The host half of seed_device_state: snapshot-format segments ->
+    n-length numpy columns (state_from_numpy layout). Split out so the
+    serving lane stores can build MANY folded lanes host-side and ship
+    them in ONE batched transfer (per-lane device puts over a tunneled
+    chip pay a ~30-70 ms RPC floor each)."""
     n = len(entries)
-    if n > capacity:
-        raise ValueError(f"{n} segments exceed capacity {capacity}")
     cols = {name: np.zeros(n, np.int32)
             for name in ("length", "ins_seq", "ins_client", "rem_seq",
                          "local_seq", "rem_local_seq",
@@ -167,11 +160,31 @@ def seed_device_state(entries: Sequence[dict], payloads: PayloadTable,
         ls: payloads.add_annotate(pending_props[ls], DEV_UNASSIGNED,
                                   local_seq=ls)
         for ls in sorted(pending_props)}
+    # Ids registered so far: freed on a partial failure below, so a
+    # malformed snapshot that degrades the lane (Unmodelable) does not
+    # strand its half-registered payloads in the long-lived shared table.
+    added: List[int] = list(pending_ids.values())
     # Materialized only when pendings exist: the anno column costs a
     # full [capacity, anno_slots] host round-trip per seed otherwise.
     anno = np.full((n, anno_slots), -1, np.int32) if pending_ids else None
     from .oracle import Items
     from .runs import Run
+    try:
+        _seed_fill(entries, payloads, cols, rem_client, anno, anno_slots,
+                   pending_ids, added, allow_runs, allow_items,
+                   Items, Run)
+    except Exception:
+        for op_id in added:
+            payloads.free(op_id)
+        raise
+    cols["rem_client"] = rem_client
+    if anno is not None:
+        cols["anno"] = anno
+    return cols
+
+
+def _seed_fill(entries, payloads, cols, rem_client, anno, anno_slots,
+               pending_ids, added, allow_runs, allow_items, Items, Run):
     for i, e in enumerate(entries):
         kind = e.get("kind", SEG_TEXT)
         text = e.get("text", "")
@@ -196,6 +209,7 @@ def seed_device_state(entries: Sequence[dict], payloads: PayloadTable,
             # device tracks only lengths/offsets; content stays host-side.
             length = len(text)
             op_id = payloads.add_insert(SEG_TEXT, text, e.get("props"))
+        added.append(op_id)
         cols["length"][i] = length
         if e.get("localSeq") is not None:  # pending local insert
             cols["ins_seq"][i] = DEV_UNASSIGNED
@@ -222,11 +236,32 @@ def seed_device_state(entries: Sequence[dict], payloads: PayloadTable,
             for j, pa in enumerate(sorted(pendings,
                                           key=lambda a: -a["localSeq"])):
                 anno[i, j] = pending_ids[pa["localSeq"]]
-    cols["rem_client"] = rem_client
-    if anno is not None:
-        cols["anno"] = anno
+
+
+def seed_device_state(entries: Sequence[dict], payloads: PayloadTable,
+                      capacity: int, min_seq: int, current_seq: int,
+                      anno_slots: int = None,
+                      allow_runs: bool = False,
+                      allow_items: bool = False) -> DocState:
+    """Snapshot-format segments (oracle.snapshot_segments) -> a single-doc
+    DocState whose visibility math reproduces the snapshot perspective.
+
+    allow_runs gates decoding wire-encoded {"run": ...} payloads (matrix
+    axis snapshots only); allow_items gates {"items": [...]} (sequence
+    channel summaries — the server lane path enables it so item
+    sequences materialize). Any other non-sliceable payload raises
+    Unmodelable so a malformed client summary degrades the lane instead
+    of planting a crash in the extraction pipeline."""
+    if len(entries) > capacity:
+        raise ValueError(f"{len(entries)} segments exceed capacity "
+                         f"{capacity}")
+    cols = seed_host_cols(entries, payloads, anno_slots=anno_slots,
+                          allow_runs=allow_runs, allow_items=allow_items)
     from .state import state_from_numpy
     import jax.numpy as jnp
+    if anno_slots is None:
+        from .state import DEFAULT_ANNO_SLOTS
+        anno_slots = DEFAULT_ANNO_SLOTS
     state = state_from_numpy(cols, capacity, anno_slots=anno_slots)
     return state._replace(min_seq=jnp.asarray(min_seq, jnp.int32),
                           seq=jnp.asarray(current_seq, jnp.int32))
@@ -237,42 +272,58 @@ def extract_entries(state: DocState, payloads: PayloadTable,
     """Device state -> full-fidelity snapshot entries (including contended
     insert/remove metadata above min_seq), adoptable by
     MergeTreeOracle.load_segments. Mirrors oracle.snapshot_segments."""
-    cols = {name: np.asarray(getattr(state, name))
-            for name in ("length", "ins_seq", "ins_client", "local_seq",
-                         "rem_seq", "rem_local_seq",
-                         "rem_clients", "origin_op", "origin_off", "anno")}
     count = int(np.asarray(state.count))
+    # One vectorized python-int conversion per column (.tolist() runs in
+    # C): the per-row int(np_scalar) pattern dominated the serving fold
+    # at ~4.5 ms/lane for 256-row lanes (profiled; the fold amortizes
+    # over every op between overflows, so this is the serving path's
+    # steady-state host cost).
+    length_l = np.asarray(state.length)[:count].tolist()
+    ins_seq_l = np.asarray(state.ins_seq)[:count].tolist()
+    ins_client_l = np.asarray(state.ins_client)[:count].tolist()
+    local_seq_l = np.asarray(state.local_seq)[:count].tolist()
+    rem_seq_l = np.asarray(state.rem_seq)[:count].tolist()
+    rem_local_l = np.asarray(state.rem_local_seq)[:count].tolist()
+    rem_client0_l = np.asarray(state.rem_clients)[:count, 0].tolist()
+    op_l = np.asarray(state.origin_op)[:count].tolist()
+    off_l = np.asarray(state.origin_off)[:count].tolist()
+    anno_np = np.asarray(state.anno)[:count]
+    ring_any = (anno_np >= 0).any(axis=1).tolist() if count else []
     out: List[dict] = []
     for i in range(count):
-        rem_seq = int(cols["rem_seq"][i])
+        rem_seq = rem_seq_l[i]
         if rem_seq != DEV_NO_REMOVE and rem_seq != DEV_UNASSIGNED \
                 and rem_seq <= min_seq:
             continue  # zamboni-equivalent: tombstone past the window
-        payload = payloads.get(int(cols["origin_op"][i]))
+        payload = payloads.get(op_l[i])
         entry: Dict[str, Any] = {"kind": payload.kind}
         if payload.kind == SEG_MARKER:
             entry["text"] = ""
         else:
-            off = int(cols["origin_off"][i])
-            entry["text"] = payload.text[off:off + int(cols["length"][i])]
-        props, pendings = _resolve_props(payload, cols["anno"][i], payloads)
+            off = off_l[i]
+            entry["text"] = payload.text[off:off + length_l[i]]
+        if ring_any[i]:
+            props, pendings = _resolve_props(payload, anno_np[i], payloads)
+        else:  # empty ring: the payload's own props verbatim
+            props = dict(payload.props) if payload.props else None
+            pendings = []
         if props:
             entry["props"] = props
         if pendings:
             entry["pendingAnnotates"] = pendings
-        ins_seq = int(cols["ins_seq"][i])
+        ins_seq = ins_seq_l[i]
         if ins_seq == DEV_UNASSIGNED:  # pending local insert
-            entry["localSeq"] = int(cols["local_seq"][i])
-            entry["client"] = int(cols["ins_client"][i])
+            entry["localSeq"] = local_seq_l[i]
+            entry["client"] = ins_client_l[i]
         elif ins_seq > min_seq:
             entry["seq"] = ins_seq
-            entry["client"] = int(cols["ins_client"][i])
+            entry["client"] = ins_client_l[i]
         if rem_seq == DEV_UNASSIGNED:  # pending local remove
-            entry["removedLocalSeq"] = int(cols["rem_local_seq"][i])
-            entry["removedClient"] = int(cols["rem_clients"][i][0])
+            entry["removedLocalSeq"] = rem_local_l[i]
+            entry["removedClient"] = rem_client0_l[i]
         elif rem_seq != DEV_NO_REMOVE:
             entry["removedSeq"] = rem_seq
-            entry["removedClient"] = int(cols["rem_clients"][i][0])
+            entry["removedClient"] = rem_client0_l[i]
         out.append(entry)
     return out
 

@@ -126,19 +126,40 @@ class MergeArenaBlock:
 
 @dataclass
 class PayloadTable:
-    """Global op_id -> payload registry shared by a batch of documents."""
+    """Global op_id -> payload registry shared by a batch of documents.
+
+    Freed slots recycle through a free-list: the serving fold
+    (tpu_sequencer MergeLaneStore) re-seeds a lane's payloads on every
+    fold, and without reuse a long-lived document would retain
+    O(doc_size x folds) superseded folded-run strings. Block
+    registration (add_block) always appends — block ids must stay
+    contiguous."""
 
     entries: List[Any] = field(default_factory=list)
+    free_ids: List[int] = field(default_factory=list)
+
+    def _add(self, payload) -> int:
+        if self.free_ids:
+            i = self.free_ids.pop()
+            self.entries[i] = payload
+            return i
+        self.entries.append(payload)
+        return len(self.entries) - 1
 
     def add_insert(self, kind: int, text: str = "",
                    props: Optional[dict] = None) -> int:
-        self.entries.append(InsertPayload(kind, text, props))
-        return len(self.entries) - 1
+        return self._add(InsertPayload(kind, text, props))
 
     def add_annotate(self, props: Dict[str, Any], seq: int,
                      local_seq: int = 0) -> int:
-        self.entries.append(AnnotatePayload(dict(props), seq, local_seq))
-        return len(self.entries) - 1
+        return self._add(AnnotatePayload(dict(props), seq, local_seq))
+
+    def free(self, op_id: int) -> None:
+        """Release a payload the caller proved unreferenced (e.g. a
+        superseded fold generation). A stale read after free returns
+        None and crashes loudly rather than resolving wrong content."""
+        self.entries[op_id] = None
+        self.free_ids.append(op_id)
 
     def add_block(self, block: MergeArenaBlock) -> int:
         """Register a whole flush's payloads at once; returns the base

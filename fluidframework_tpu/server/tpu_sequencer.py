@@ -150,6 +150,58 @@ class _MergeBucket:
             lambda col, r: col.at[idx].set(r), self.state, rows)
 
 
+def _stack_seed_rows(items: List[tuple], capacity: int, anno_slots: int,
+                     overlap_slots: int) -> DocState:
+    """[(key, seed_host_cols dict, min_seq, seq)] -> one [k, ...] DocState
+    built entirely in host numpy, shipped as ONE transfer per column
+    (the batched half of catchup.seed_host_cols)."""
+    from ..mergetree.constants import DEV_NO_REMOVE, DEV_UNASSIGNED
+    k = len(items)
+
+    def full(val, *dims):
+        return np.full((k, *dims), val, np.int32)
+
+    out = {
+        "length": full(0, capacity),
+        "ins_seq": full(DEV_UNASSIGNED, capacity),
+        "ins_client": full(-1, capacity),
+        "local_seq": full(0, capacity),
+        "rem_seq": full(DEV_NO_REMOVE, capacity),
+        "rem_local_seq": full(0, capacity),
+        "origin_op": full(-1, capacity),
+        "origin_off": full(0, capacity),
+    }
+    rem_clients = full(-1, capacity, overlap_slots)
+    anno = full(-1, capacity, anno_slots)
+    count = np.zeros(k, np.int32)
+    mins = np.zeros(k, np.int32)
+    seqs = np.zeros(k, np.int32)
+    for j, (_, cols, mseq, cseq) in enumerate(items):
+        n = len(cols["length"])
+        for name, arr in out.items():
+            arr[j, :n] = cols[name]
+        rem_clients[j, :n, 0] = cols["rem_client"]
+        if "anno" in cols:
+            anno[j, :n] = cols["anno"]
+        count[j], mins[j], seqs[j] = n, mseq, cseq
+    return DocState(
+        length=jnp.asarray(out["length"]),
+        ins_seq=jnp.asarray(out["ins_seq"]),
+        ins_client=jnp.asarray(out["ins_client"]),
+        local_seq=jnp.asarray(out["local_seq"]),
+        rem_seq=jnp.asarray(out["rem_seq"]),
+        rem_local_seq=jnp.asarray(out["rem_local_seq"]),
+        rem_clients=jnp.asarray(rem_clients),
+        origin_op=jnp.asarray(out["origin_op"]),
+        origin_off=jnp.asarray(out["origin_off"]),
+        anno=jnp.asarray(anno),
+        count=jnp.asarray(count),
+        min_seq=jnp.asarray(mins),
+        seq=jnp.asarray(seqs),
+        overflow=jnp.zeros(k, jnp.bool_),
+    )
+
+
 def _repad_batch(rows: DocState, capacity: int) -> DocState:
     """Re-pad a [n, ...] sub-batch to a larger capacity (group promotion)."""
     n = rows.length.shape[0]
@@ -189,6 +241,26 @@ class MergeLaneStore:
         self.overflow_drops = 0  # lanes degraded after exhausting buckets
         self.flushes_since_compact = 0
         self.compact_every = 8
+        self.folds = 0            # lanes host-folded (zamboni pack)
+        self.fold_rows_reclaimed = 0
+        # Overflow below this capacity promotes; at/above it folds.
+        self.fold_min_capacity = min(
+            (c for c in self.capacities if c >= 256),
+            default=self.capacities[-1])
+        # op_ids created by the lane's latest fold/rescue generation:
+        # freed (PayloadTable free-list) when the next generation
+        # supersedes them — otherwise a long-lived document retains
+        # O(doc_size x folds) dead folded-run strings.
+        self._fold_payloads: Dict[tuple, List[int]] = {}
+        # Async-summary safety: summarize_documents_async workers resolve
+        # through the SHARED payload table; while any are in flight,
+        # frees defer (a recycled id would materialize the WRONG text
+        # into the in-flight snapshot). Deferred ids drain on the next
+        # main-thread free once the last guard releases.
+        import threading
+        self._extract_guards = 0
+        self._deferred_frees: List[int] = []
+        self._guard_lock = threading.Lock()
         # Monotone change generations per channel — incremental
         # summarization extracts (and transfers) only channels whose
         # generation advanced past a consumer's last-written snapshot
@@ -214,7 +286,49 @@ class MergeLaneStore:
         if key in self.where:
             b, lane = self.where.pop(key)
             self.buckets[b].free(lane)
+        for op_id in self._fold_payloads.pop(key, ()):
+            self._free_payload(op_id)
         self.opaque.add(key)
+
+    def _free_payload(self, op_id: int) -> None:
+        """Free via the guard: deferred while an async summary worker may
+        still resolve the id; drains the backlog when clear. Always
+        called from the sequencing thread, so the drain never races
+        PayloadTable._add."""
+        with self._guard_lock:
+            if self._extract_guards:
+                self._deferred_frees.append(op_id)
+                return
+            backlog, self._deferred_frees = self._deferred_frees, []
+        self.payloads.free(op_id)
+        for i in backlog:
+            self.payloads.free(i)
+
+    def extract_guard_acquire(self) -> None:
+        with self._guard_lock:
+            self._extract_guards += 1
+
+    def extract_guard_release(self) -> None:
+        """Worker-thread safe: only decrements; the deferred backlog
+        drains on the sequencing thread at the next free."""
+        with self._guard_lock:
+            self._extract_guards -= 1
+
+    def _swap_fold_payloads(self, key: tuple, new_ids: set) -> None:
+        """Adopt a fold/rescue generation's payload ids for `key`, freeing
+        the superseded generation (every row got a fresh id, so the old
+        ones are unreferenced once the new rows are adopted)."""
+        for op_id in self._fold_payloads.pop(key, ()):
+            if op_id not in new_ids:
+                self._free_payload(op_id)
+        self._fold_payloads[key] = sorted(new_ids)
+
+    @staticmethod
+    def _seed_ids(cols: dict) -> set:
+        ids = {int(i) for i in cols["origin_op"].tolist()}
+        if "anno" in cols:
+            ids.update(int(i) for i in np.unique(cols["anno"]) if i >= 0)
+        return ids
 
     def seed(self, key: tuple, entries, min_seq: int,
              current_seq: int) -> bool:
@@ -227,29 +341,28 @@ class MergeLaneStore:
         from ..mergetree.catchup import Unmodelable, seed_device_state
         if key in self.where or key in self.opaque:
             return key in self.where
-        n = len(entries)
-        last = len(self.buckets) - 1
         allow_runs = matrix_base_key(key) is not None
-        for b, bucket in enumerate(self.buckets):
-            if n * 2 > bucket.capacity and not (b == last
-                                                and n <= bucket.capacity):
-                continue
-            try:
-                row = seed_device_state(entries, self.payloads,
-                                        bucket.capacity, min_seq,
-                                        current_seq,
-                                        allow_runs=allow_runs,
-                                        allow_items=not allow_runs)
-            except (Unmodelable, ValueError):
-                self.opaque.add(key)
-                return False
-            lane = bucket.alloc(key)
-            bucket.put_row(lane, row)
-            self.where[key] = (b, lane)
-            self.mark_dirty(key)
-            return True
-        self.opaque.add(key)
-        return False
+        # Plain snapshot seed: no window to re-apply, so the widest
+        # bucket may fill completely (last_slack=0) before degrading.
+        b = self._seed_bucket_for(len(entries), last_slack=0)
+        if b is None:
+            self.opaque.add(key)
+            return False
+        bucket = self.buckets[b]
+        try:
+            row = seed_device_state(entries, self.payloads,
+                                    bucket.capacity, min_seq,
+                                    current_seq,
+                                    allow_runs=allow_runs,
+                                    allow_items=not allow_runs)
+        except (Unmodelable, ValueError):
+            self.opaque.add(key)
+            return False
+        lane = bucket.alloc(key)
+        bucket.put_row(lane, row)
+        self.where[key] = (b, lane)
+        self.mark_dirty(key)
+        return True
 
     # -- batched apply with overflow recovery ------------------------------
     def apply(self, streams: Dict[tuple, List[HostOp]]) -> None:
@@ -357,6 +470,21 @@ class MergeLaneStore:
             sel = np.asarray(ok_j)
             bucket.put_rows([lanes[j] for j in ok_j],
                             tm(lambda x: x[sel], redone))
+        # Attempt 2: host-fold acked runs and re-run at the SAME
+        # capacity. Sustained typing overflows with mostly-acked rows
+        # (device compaction cannot merge them — payload bytes live
+        # host-side), and promotion would climb to capacities whose
+        # apply cost scales with C (measured steady-state ingest on the
+        # CPU host: 139k -> 75k -> 17k ops/s at C=64/256/1024). The fold
+        # caps that climb; only lanes whose live in-window rows genuinely
+        # exceed the fold capacity still promote past it. Buckets BELOW
+        # fold_min_capacity promote instead (warm shapes, one batched
+        # pass): folding there would fire every ~(C - window)/window
+        # flushes and the per-lane host fold cost would dominate — the
+        # fold amortizes ~13x wider at 256 for keystroke windows.
+        if bad_j and bucket.capacity >= self.fold_min_capacity:
+            bad_j = self._fold_rerun_batch(bucket, lanes, bad_j,
+                                           compacted, packed, lane_ops)
         carried = [bucket.used[lanes[j]] for j in bad_j]  # keys carrying up
         # Pre-apply row index + this window's ops per carried key: the
         # host-fold rescue (rare: only lanes that exhaust every capacity
@@ -396,8 +524,81 @@ class MergeLaneStore:
             if self._rescue_lane(key, row, ops):
                 continue
             self.where.pop(key, None)
+            for op_id in self._fold_payloads.pop(key, ()):
+                self._free_payload(op_id)
             self.opaque.add(key)
             self.overflow_drops += 1
+
+    def _fold_rerun_batch(self, bucket, lanes: List[int], bad_j: List[int],
+                          compacted: DocState, packed,
+                          lane_ops: Dict[int, List[HostOp]]) -> List[int]:
+        """Overflow attempt 2: fold the flagged lanes' acked runs on the
+        host (coalesce_entries — the zamboni pack step the device cannot
+        do) and re-run this window at the SAME capacity, batched. Returns
+        the lane indices that still overflow (those carry into the
+        promotion cascade). One D2H slice in, one batched apply + one
+        put_rows out."""
+        from ..mergetree.catchup import (Unmodelable, coalesce_entries,
+                                         extract_entries, seed_host_cols)
+        tm = jax.tree_util.tree_map
+        sel = np.asarray(bad_j)
+        host_rows = jax.device_get(tm(
+            lambda x: x[sel] if getattr(x, "ndim", 0) else x, compacted))
+        folded: List[tuple] = []  # (j, key, cols, mseq, cseq)
+        for k, j in enumerate(bad_j):
+            key = bucket.used[lanes[j]]
+            row = tm(lambda x: x[k] if getattr(x, "ndim", 0) else x,
+                     host_rows)
+            mseq = int(row.min_seq)
+            cseq = int(row.seq)
+            allow_runs = matrix_base_key(key) is not None
+            try:
+                entries = coalesce_entries(
+                    extract_entries(row, self.payloads, mseq))
+                # Re-run headroom: each window op costs at most 2 rows
+                # (insert + split). Not enough -> promotion is correct.
+                need = len(entries) + 2 * len(lane_ops[lanes[j]]) + 8
+                if need > bucket.capacity:
+                    continue
+                cols = seed_host_cols(
+                    entries, self.payloads,
+                    anno_slots=int(row.anno.shape[-1]),
+                    allow_runs=allow_runs, allow_items=not allow_runs)
+            except (Unmodelable, ValueError):
+                continue  # ring depth, odd payloads: promotion handles it
+            folded.append((j, key, cols, mseq, cseq))
+        if not folded:
+            return bad_j
+        rows = _stack_seed_rows(
+            [(key, cols, ms, cs) for _, key, cols, ms, cs in folded],
+            bucket.capacity, bucket.state.anno_slots,
+            bucket.state.rem_clients.shape[-1])
+        psel = np.asarray([j for j, *_ in folded])
+        sub_packed = tm(lambda x: x[psel], packed)
+        rows, sub_packed = self._pad_pow2(rows, sub_packed, len(folded),
+                                          bucket.capacity)
+        redone = _apply_keep_batched(rows, sub_packed)
+        over = np.asarray(redone.overflow)
+        adopted = [k for k in range(len(folded)) if not over[k]]
+        if adopted:
+            idx = np.asarray(adopted)
+            bucket.put_rows([lanes[folded[k][0]] for k in adopted],
+                            tm(lambda x: x[idx], redone))
+            self.folds += len(adopted)
+        counts = np.asarray(host_rows.count)
+        bad_pos = {j: k for k, j in enumerate(bad_j)}
+        for k, (j, key, cols, _, _) in enumerate(folded):
+            if over[k]:
+                # Rerun still overflowed: this generation's fresh seed
+                # payloads were never adopted — free them now.
+                for op_id in self._seed_ids(cols):
+                    self._free_payload(op_id)
+            else:
+                self._swap_fold_payloads(key, self._seed_ids(cols))
+                self.fold_rows_reclaimed += (
+                    int(counts[bad_pos[j]]) - len(cols["length"]))
+        done = {folded[k][0] for k in adopted}
+        return [j for j in bad_j if j not in done]
 
     def _rescue_lane(self, key: tuple, row: DocState, ops) -> bool:
         """Last resort before opaque: fold the lane on the HOST — annotate
@@ -408,8 +609,7 @@ class MergeLaneStore:
         fold empties every ring, so only >anno_slots annotates on one
         segment within a single window can still defeat it."""
         from ..mergetree.catchup import (Unmodelable, apply_host_ops,
-                                         coalesce_entries, extract_entries,
-                                         seed_device_state)
+                                         coalesce_entries, extract_entries)
         try:
             mseq = int(np.asarray(row.min_seq))
             cseq = int(np.asarray(row.seq))
@@ -424,32 +624,137 @@ class MergeLaneStore:
         cseq2 = max([cseq] + [op.seq for op in ops
                               if op.seq not in (DEV_UNASSIGNED,
                                                 UNASSIGNED_SEQ)])
-        # seed()'s bucket policy: smallest with 2x headroom (a +8 fit
-        # would re-overflow on the very next busy window and thrash the
-        # whole recovery cascade per flush); the widest bucket accepts a
-        # plain fit as the final fallback.
-        n = len(new_entries)
-        last = len(self.buckets) - 1
-        for nb, bucket in enumerate(self.buckets):
-            if n * 2 > bucket.capacity and not (nb == last
-                                                and n + 8 <= bucket.capacity):
-                continue
-            row2 = seed_device_state(new_entries, self.payloads,
-                                     bucket.capacity, mseq2, cseq2)
-            lane = bucket.alloc(key)
-            bucket.put_row(lane, row2)
-            self.where[key] = (nb, lane)
-            self.mark_dirty(key)
-            return True
-        return False
+        # _seed_bucket_for: smallest with 2x headroom (a +8 fit would
+        # re-overflow on the very next busy window and thrash the whole
+        # recovery cascade per flush); the widest bucket accepts an
+        # n + 8 fit as the final fallback.
+        nb = self._seed_bucket_for(len(new_entries))
+        if nb is None:
+            return False
+        bucket = self.buckets[nb]
+        from ..mergetree.catchup import seed_host_cols
+        from ..mergetree.state import state_from_numpy
+        try:
+            cols = seed_host_cols(new_entries, self.payloads,
+                                  anno_slots=bucket.state.anno_slots)
+        except (Unmodelable, ValueError):
+            return False
+        row2 = state_from_numpy(
+            cols, bucket.capacity,
+            anno_slots=bucket.state.anno_slots)._replace(
+            min_seq=jnp.asarray(mseq2, jnp.int32),
+            seq=jnp.asarray(cseq2, jnp.int32))
+        lane = bucket.alloc(key)
+        bucket.put_row(lane, row2)
+        self.where[key] = (nb, lane)
+        self.mark_dirty(key)
+        self._swap_fold_payloads(key, self._seed_ids(cols))
+        return True
 
     def compact_all(self) -> None:
         """Zamboni every bucket (reference mergeTree.ts:1422, run between
-        batches so the gather cost amortizes, kernel.py design note)."""
+        batches so the gather cost amortizes, kernel.py design note),
+        then pack crowded lanes host-side."""
         for bucket in self.buckets:
             if any(k is not None for k in bucket.used):
                 bucket.state = kernel.compact_batched(bucket.state)
+        self._fold_crowded()
         self.flushes_since_compact = 0
+
+    # Fold when live rows pass 3/4 of capacity; the per-lane cadence is
+    # therefore ~capacity/4 ops, so the host cost amortizes wider as
+    # documents grow.
+    FOLD_NUM, FOLD_DEN = 3, 4
+
+    def _seed_bucket_for(self, n: int, last_slack: int = 8) -> \
+            Optional[int]:
+        """Smallest bucket with 2x headroom (a tight fit would
+        re-overflow next window and thrash); the widest bucket accepts a
+        fit with `last_slack` spare rows as the final fallback —
+        rescue/fold need room to re-apply a window (slack 8), a plain
+        snapshot seed does not (slack 0)."""
+        last = len(self.buckets) - 1
+        for nb, bucket in enumerate(self.buckets):
+            if n * 2 <= bucket.capacity or \
+                    (nb == last and n + last_slack <= bucket.capacity):
+                return nb
+        return None
+
+    def _fold_crowded(self) -> None:
+        """Host-side pack — the serving half of the reference's zamboni
+        scour/pack (mergeTree.ts:1289): device compaction frees removed
+        rows but cannot merge ACKED adjacent rows (payload bytes live
+        host-side as origin slices), so sustained typing grows one row
+        per op and climbs capacity buckets whose apply cost scales with
+        capacity (measured steady-state ingest on the CPU host: 139k ->
+        75k -> 17k ops/s at C=64/256/1024, with multi-second promotion
+        stalls at each boundary). Folding acked runs through
+        coalesce_entries and reseeding into the smallest fitting bucket
+        keeps long-lived documents in the fast small buckets. Candidate
+        rows leave the device in ONE slice per bucket and folded lanes
+        return in ONE batched put per destination bucket (per-lane
+        round-trips over a tunneled chip pay a ~30-70 ms RPC floor
+        each)."""
+        from ..mergetree.catchup import (Unmodelable, coalesce_entries,
+                                         extract_entries, seed_host_cols)
+        tm = jax.tree_util.tree_map
+        dest: Dict[int, List[tuple]] = {}  # nb -> [(key, cols, mseq, cseq)]
+        for b, bucket in enumerate(self.buckets):
+            if not any(k is not None for k in bucket.used):
+                continue
+            counts = np.asarray(bucket.state.count)
+            cands = [i for i, key in enumerate(bucket.used)
+                     if key is not None
+                     and int(counts[i]) * self.FOLD_DEN
+                     >= bucket.capacity * self.FOLD_NUM]
+            if not cands:
+                continue
+            take = jnp.asarray(np.asarray(cands, np.int32))
+            sub = jax.device_get(tm(
+                lambda x: x[take] if getattr(x, "ndim", 0) else x,
+                bucket.state))
+            freed: List[int] = []
+            for j, lane in enumerate(cands):
+                key = bucket.used[lane]
+                row = tm(lambda x: x[j] if getattr(x, "ndim", 0) else x,
+                         sub)
+                mseq = int(row.min_seq)
+                cseq = int(row.seq)
+                allow_runs = matrix_base_key(key) is not None
+                try:
+                    entries = coalesce_entries(
+                        extract_entries(row, self.payloads, mseq))
+                    nb = self._seed_bucket_for(len(entries))
+                    # Demotion-only: the overflow-time fold
+                    # (_fold_rerun_batch) keeps busy lanes in their small
+                    # buckets; this tick exists to move lanes whose
+                    # content SHRANK back down to a cheaper capacity.
+                    # Same-bucket rebuilds would be pure churn.
+                    if nb is None or nb >= b:
+                        continue
+                    cols = seed_host_cols(
+                        entries, self.payloads,
+                        anno_slots=int(row.anno.shape[-1]),
+                        allow_runs=allow_runs,
+                        allow_items=not allow_runs)
+                except (Unmodelable, ValueError):
+                    continue  # leave the lane untouched; fold is optional
+                dest.setdefault(nb, []).append((key, cols, mseq, cseq))
+                freed.append(lane)
+                self.folds += 1
+                self.fold_rows_reclaimed += int(counts[lane]) \
+                    - len(entries)
+            if freed:
+                bucket.free_many(freed)
+        for nb, items in dest.items():
+            target = self.buckets[nb]
+            lanes = target.alloc_many([key for key, *_ in items])
+            target.put_rows(lanes, _stack_seed_rows(
+                items, target.capacity, target.state.anno_slots,
+                target.state.rem_clients.shape[-1]))
+            for (key, cols, *_), lane in zip(items, lanes):
+                self.where[key] = (nb, lane)
+                self._swap_fold_payloads(key, self._seed_ids(cols))
 
     # -- batched summary extraction ----------------------------------------
     def extract_dispatch(self, only: Optional[set] = None) -> List[tuple]:
@@ -3088,11 +3393,19 @@ class TpuSequencerLambda(IPartitionLambda):
         self._compose_directory_channels(lww_part)
 
         def work():
-            out = self.merge.extract_assemble(jobs, chunk_chars)
-            out.update(lww_part)
-            _compose_matrix_channels(out)
+            try:
+                out = self.merge.extract_assemble(jobs, chunk_chars)
+                out.update(lww_part)
+                _compose_matrix_channels(out)
+            finally:
+                self.merge.extract_guard_release()
             on_done(out)
 
+        # Hold fold/rescue payload frees while the worker resolves
+        # through the shared table (a recycled id would materialize the
+        # wrong text into this snapshot). Acquired last so a raise in
+        # the synchronous staging above cannot leak the guard.
+        self.merge.extract_guard_acquire()
         th = threading.Thread(target=work, daemon=True)
         th.start()
         return th
