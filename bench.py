@@ -102,7 +102,7 @@ def run_baseline(cols, sample_docs: int, n_ops: int) -> float:
     return total / elapsed
 
 
-def _serving_ingest_rate(docs: int = 4096, ops_per_doc: int = 32) -> float:
+def _serving_ingest_rate(docs: int = 4096, ops_per_doc: int = 32) -> dict:
     """End-to-end SERVING ingest throughput: RAW WIRE BYTES (serialized
     boxcars, the shape a production raw-deltas log carries) through the
     real TpuSequencerLambda — native pump parse (wirepump.cpp), numpy
@@ -117,7 +117,7 @@ def _serving_ingest_rate(docs: int = 4096, ops_per_doc: int = 32) -> float:
     clients heartbeat via the delta manager). The no-nacks self-check
     still guards against measuring the rejection path."""
     if os.environ.get("BENCH_INGEST", "1") == "0":
-        return 0.0
+        return {"serving_ingest_ops_per_sec": 0.0}
     import jax as _jax
     import json as _json
     import random as _random
@@ -216,7 +216,11 @@ def _serving_ingest_rate(docs: int = 4096, ops_per_doc: int = 32) -> float:
     if emitted != total:
         raise RuntimeError(
             f"steady windows emitted {emitted} of {total} messages")
-    return round(total / elapsed, 1)
+    return {"serving_ingest_ops_per_sec": round(total / elapsed, 1),
+            # Lane-health counters: promotions/folds/rescues DURING the
+            # measured waves would mean the steady state isn't steady.
+            "serving_ingest_folds": lam.merge.folds,
+            "serving_ingest_overflow_drops": lam.merge.overflow_drops}
 
 
 def _matrix_serving_ingest_rate(docs: int = 1024,
@@ -1007,8 +1011,7 @@ def main() -> None:
     # End-to-end SERVING ingest: wire DocumentMessages through the real
     # TpuSequencerLambda (parse -> native pack -> device ticket+apply) —
     # the whole partition-lambda path, not just the device half.
-    ingest_rate = _serving_ingest_rate()
-    checkpoint_partial(serving_ingest_ops_per_sec=ingest_rate)
+    checkpoint_partial(**_serving_ingest_rate())
 
     # Real-workload configs (BASELINE.md #2-4): keystroke-level single-doc
     # trace, matrix op storm, concurrent directory merges.

@@ -50,7 +50,8 @@ class MergeArenaBlock:
     LWW order) is assigned after the window's ticket results arrive."""
 
     __slots__ = ("base", "kinds", "marker", "textoff", "textlen", "arena",
-                 "bufs", "pbuf", "pstart", "pend", "seqs", "_cache")
+                 "bufs", "pbuf", "pstart", "pend", "seqs", "_cache",
+                 "lane_ids")
 
     # kinds codes (block-local)
     K_TEXT, K_MARKER, K_ANNOTATE, K_NONE, K_RUN, K_ITEMS = \

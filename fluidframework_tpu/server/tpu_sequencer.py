@@ -261,6 +261,23 @@ class MergeLaneStore:
         self._extract_guards = 0
         self._deferred_frees: List[int] = []
         self._guard_lock = threading.Lock()
+        # Fast-path arena blocks pin the WHOLE flush's raw wire buffers
+        # (MergeArenaBlock.bufs) until every referencing lane moves off
+        # them — without aging, a long-lived server retains its entire
+        # raw ingest history in host memory. Blocks are tracked with
+        # per-lane id lists; lanes release refs when a fold/rescue
+        # reseeds their rows, and blocks older than block_age_ticks
+        # compact ticks materialize their remaining (tiny) payloads so
+        # the buffers can go.
+        self._lane_blocks: Dict[tuple, set] = {}   # key -> blocks
+        self._blocks: List[list] = []              # [ticks, block]
+        self.block_age_ticks = 8                   # x compact_every flushes
+        self.blocks_aged = 0
+        # Demotion-fold memo: live-row count at the last fold attempt
+        # that could not demote — retry only when the count changes (the
+        # extract+coalesce probe costs ~ms/lane and a contended lane can
+        # stay crowded-but-undemotable across many ticks).
+        self._fold_skip: Dict[tuple, int] = {}
         # Monotone change generations per channel — incremental
         # summarization extracts (and transfers) only channels whose
         # generation advanced past a consumer's last-written snapshot
@@ -286,9 +303,17 @@ class MergeLaneStore:
         if key in self.where:
             b, lane = self.where.pop(key)
             self.buckets[b].free(lane)
+        self._forget_lane_payloads(key)
+        self.opaque.add(key)
+
+    def _forget_lane_payloads(self, key: tuple) -> None:
+        """The lane's rows are gone: free its fold generation and release
+        every block ref."""
         for op_id in self._fold_payloads.pop(key, ()):
             self._free_payload(op_id)
-        self.opaque.add(key)
+        for block in self._lane_blocks.pop(key, ()):
+            self._release_block_ref(block, key)
+        self._fold_skip.pop(key, None)
 
     def _free_payload(self, op_id: int) -> None:
         """Free via the guard: deferred while an async summary worker may
@@ -314,14 +339,75 @@ class MergeLaneStore:
         with self._guard_lock:
             self._extract_guards -= 1
 
-    def _swap_fold_payloads(self, key: tuple, new_ids: set) -> None:
+    def _swap_fold_payloads(self, key: tuple, new_ids: set,
+                            keep_ops=()) -> None:
         """Adopt a fold/rescue generation's payload ids for `key`, freeing
         the superseded generation (every row got a fresh id, so the old
-        ones are unreferenced once the new rows are adopted)."""
+        ones are unreferenced once the new rows are adopted). Block refs
+        release too: after a reseed the lane's rows reference only the
+        new generation — plus, for an overflow fold that re-ran the
+        current window on device, that window's block ids (keep_ops)."""
         for op_id in self._fold_payloads.pop(key, ()):
             if op_id not in new_ids:
                 self._free_payload(op_id)
         self._fold_payloads[key] = sorted(new_ids)
+        refs = self._lane_blocks.get(key)
+        if refs:
+            kept = set()
+            for block in list(refs):
+                base, n = block.base, len(block)
+                if any(base <= op.op_id < base + n for op in keep_ops):
+                    kept.add(block)
+                else:
+                    self._release_block_ref(block, key)
+            if kept:
+                self._lane_blocks[key] = kept
+            else:
+                self._lane_blocks.pop(key, None)
+
+    def note_block(self, block, lane_ids: Dict[tuple, list]) -> None:
+        """Register a fast-flush arena block for aging. lane_ids maps each
+        channel key to the block-global op ids admitted for it."""
+        block.lane_ids = lane_ids
+        self._blocks.append([0, block])
+        for key in lane_ids:
+            self._lane_blocks.setdefault(key, set()).add(block)
+
+    def _release_block_ref(self, block, key: tuple) -> None:
+        """A lane's rows no longer reference this block: free its ids (the
+        slots recycle). Once the last lane departs, the registry entry
+        drops at the next aging pass and the block — with the raw wire
+        buffers it pins — becomes garbage."""
+        for op_id in block.lane_ids.pop(key, ()):
+            self._free_payload(op_id)
+
+    def _age_blocks(self) -> None:
+        keep = []
+        for rec in self._blocks:
+            rec[0] += 1
+            block = rec[1]
+            if not block.lane_ids:
+                continue  # every lane departed; drop the registry ref
+            if rec[0] < self.block_age_ticks:
+                keep.append(rec)
+                continue
+            # Old block still referenced (idle lanes never fold):
+            # materialize the remaining payloads — a window's worth of
+            # tiny strings per lane — so the flush's raw buffers free.
+            # Materialized ids are superseded at the lane's next
+            # fold/drop exactly like seed ids.
+            for key in list(block.lane_ids):
+                ids = block.lane_ids.pop(key)
+                for op_id in ids:
+                    self.payloads.entries[op_id] = block.resolve(op_id)
+                self._fold_payloads.setdefault(key, []).extend(ids)
+                refs = self._lane_blocks.get(key)
+                if refs is not None:
+                    refs.discard(block)
+                    if not refs:
+                        self._lane_blocks.pop(key, None)
+            self.blocks_aged += 1
+        self._blocks = keep
 
     @staticmethod
     def _seed_ids(cols: dict) -> set:
@@ -338,7 +424,8 @@ class MergeLaneStore:
         empty lane and overflows every bucket). Picks the smallest bucket
         with 2x headroom; unmodelable or oversized snapshots degrade the
         channel to opaque."""
-        from ..mergetree.catchup import Unmodelable, seed_device_state
+        from ..mergetree.catchup import Unmodelable, seed_host_cols
+        from ..mergetree.state import state_from_numpy
         if key in self.where or key in self.opaque:
             return key in self.where
         allow_runs = matrix_base_key(key) is not None
@@ -350,18 +437,26 @@ class MergeLaneStore:
             return False
         bucket = self.buckets[b]
         try:
-            row = seed_device_state(entries, self.payloads,
-                                    bucket.capacity, min_seq,
-                                    current_seq,
-                                    allow_runs=allow_runs,
-                                    allow_items=not allow_runs)
+            cols = seed_host_cols(entries, self.payloads,
+                                  anno_slots=bucket.state.anno_slots,
+                                  allow_runs=allow_runs,
+                                  allow_items=not allow_runs)
         except (Unmodelable, ValueError):
             self.opaque.add(key)
             return False
+        row = state_from_numpy(
+            cols, bucket.capacity,
+            anno_slots=bucket.state.anno_slots)._replace(
+            min_seq=jnp.asarray(min_seq, jnp.int32),
+            seq=jnp.asarray(current_seq, jnp.int32))
         lane = bucket.alloc(key)
         bucket.put_row(lane, row)
         self.where[key] = (b, lane)
         self.mark_dirty(key)
+        # Track the seed generation like a fold's: the first fold (or a
+        # drop) frees it instead of stranding the attach-time document
+        # text in the shared table forever.
+        self._swap_fold_payloads(key, self._seed_ids(cols))
         return True
 
     # -- batched apply with overflow recovery ------------------------------
@@ -524,8 +619,7 @@ class MergeLaneStore:
             if self._rescue_lane(key, row, ops):
                 continue
             self.where.pop(key, None)
-            for op_id in self._fold_payloads.pop(key, ()):
-                self._free_payload(op_id)
+            self._forget_lane_payloads(key)
             self.opaque.add(key)
             self.overflow_drops += 1
 
@@ -594,7 +688,8 @@ class MergeLaneStore:
                 for op_id in self._seed_ids(cols):
                     self._free_payload(op_id)
             else:
-                self._swap_fold_payloads(key, self._seed_ids(cols))
+                self._swap_fold_payloads(key, self._seed_ids(cols),
+                                         keep_ops=lane_ops[lanes[j]])
                 self.fold_rows_reclaimed += (
                     int(counts[bad_pos[j]]) - len(cols["length"]))
         done = {folded[k][0] for k in adopted}
@@ -659,6 +754,7 @@ class MergeLaneStore:
             if any(k is not None for k in bucket.used):
                 bucket.state = kernel.compact_batched(bucket.state)
         self._fold_crowded()
+        self._age_blocks()
         self.flushes_since_compact = 0
 
     # Fold when live rows pass 3/4 of capacity; the per-lane cadence is
@@ -706,7 +802,8 @@ class MergeLaneStore:
             cands = [i for i, key in enumerate(bucket.used)
                      if key is not None
                      and int(counts[i]) * self.FOLD_DEN
-                     >= bucket.capacity * self.FOLD_NUM]
+                     >= bucket.capacity * self.FOLD_NUM
+                     and self._fold_skip.get(key) != int(counts[i])]
             if not cands:
                 continue
             take = jnp.asarray(np.asarray(cands, np.int32))
@@ -731,6 +828,7 @@ class MergeLaneStore:
                     # content SHRANK back down to a cheaper capacity.
                     # Same-bucket rebuilds would be pure churn.
                     if nb is None or nb >= b:
+                        self._fold_skip[key] = int(counts[lane])
                         continue
                     cols = seed_host_cols(
                         entries, self.payloads,
@@ -738,9 +836,11 @@ class MergeLaneStore:
                         allow_runs=allow_runs,
                         allow_items=not allow_runs)
                 except (Unmodelable, ValueError):
+                    self._fold_skip[key] = int(counts[lane])
                     continue  # leave the lane untouched; fold is optional
                 dest.setdefault(nb, []).append((key, cols, mseq, cseq))
                 freed.append(lane)
+                self._fold_skip.pop(key, None)
                 self.folds += 1
                 self.fold_rows_reclaimed += int(counts[lane]) \
                     - len(entries)
@@ -2531,7 +2631,22 @@ class TpuSequencerLambda(IPartitionLambda):
             ok_u[j] = True
             b_u[j] = bb
             l_u[j] = ll
-        return mbase, ok_u[inv], b_u[inv], l_u[inv]
+        ok_rows = ok_u[inv]
+        # Block aging bookkeeping: which lanes reference which of this
+        # block's op ids. Non-admitted rows (opaque/degraded channels —
+        # the host object path is authoritative for them) are freed NOW:
+        # nothing will ever resolve them, and leaving the entries in
+        # place would pin this flush's raw buffers forever.
+        lane_ids: Dict[tuple, list] = {}
+        for i in range(merge_rows.size):
+            if ok_rows[i]:
+                lane_ids.setdefault(self._pump_chan[int(chans[i])],
+                                    []).append(mbase + i)
+            else:
+                self.merge._free_payload(mbase + i)
+        if lane_ids:
+            self.merge.note_block(block, lane_ids)
+        return mbase, ok_rows, b_u[inv], l_u[inv]
 
     def _lww_block_and_lanes(self, parsed, lww_rows: np.ndarray):
         from . import pump as P
