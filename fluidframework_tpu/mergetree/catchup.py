@@ -35,7 +35,12 @@ from .constants import (
     SEG_TEXT,
     UNIVERSAL_SEQ,
 )
-from .host import OpBuilder, PayloadTable, PENDING_ORDER_BASE
+from .host import (
+    MergeArenaBlock,
+    OpBuilder,
+    PayloadTable,
+    PENDING_ORDER_BASE,
+)
 from .oppack import HostOp, PackedOps, pack_single
 from .state import DocState, make_state
 
@@ -268,10 +273,15 @@ def seed_device_state(entries: Sequence[dict], payloads: PayloadTable,
 
 
 def extract_entries(state: DocState, payloads: PayloadTable,
-                    min_seq: int) -> List[dict]:
+                    min_seq: int, fold: bool = False) -> List[dict]:
     """Device state -> full-fidelity snapshot entries (including contended
     insert/remove metadata above min_seq), adoptable by
-    MergeTreeOracle.load_segments. Mirrors oracle.snapshot_segments."""
+    MergeTreeOracle.load_segments. Mirrors oracle.snapshot_segments.
+
+    fold=True coalesces maximal runs of plain acked text rows INLINE
+    (equivalent to coalesce_entries over the per-row output, which the
+    fold/rescue callers apply anyway) — one joined entry instead of
+    hundreds of dicts; the serving fold's hot loop."""
     count = int(np.asarray(state.count))
     # One vectorized python-int conversion per column (.tolist() runs in
     # C): the per-row int(np_scalar) pattern dominated the serving fold
@@ -289,28 +299,56 @@ def extract_entries(state: DocState, payloads: PayloadTable,
     off_l = np.asarray(state.origin_off)[:count].tolist()
     anno_np = np.asarray(state.anno)[:count]
     ring_any = (anno_np >= 0).any(axis=1).tolist() if count else []
+    table = payloads.entries
     out: List[dict] = []
+    parts: List[str] = []  # pending foldable plain-text pieces (fold=True)
+
+    def flush_parts():
+        if parts:
+            out.append({"kind": SEG_TEXT, "text": "".join(parts)})
+            parts.clear()
+
     for i in range(count):
         rem_seq = rem_seq_l[i]
         if rem_seq != DEV_NO_REMOVE and rem_seq != DEV_UNASSIGNED \
                 and rem_seq <= min_seq:
             continue  # zamboni-equivalent: tombstone past the window
-        payload = payloads.get(op_l[i])
-        entry: Dict[str, Any] = {"kind": payload.kind}
-        if payload.kind == SEG_MARKER:
-            entry["text"] = ""
-        else:
+        op_id = op_l[i]
+        raw = table[op_id]
+        ft = None
+        if type(raw) is MergeArenaBlock and not ring_any[i]:
+            # Plain props-free text row of an arena block: slice the
+            # block's one-shot decoded arena instead of materializing a
+            # payload object per row (the fold frees these ids right
+            # after, so resolve()'s cache never pays off).
+            ft = raw.fast_text(op_id)
+        if ft is not None:
             off = off_l[i]
-            entry["text"] = payload.text[off:off + length_l[i]]
-        if ring_any[i]:
-            props, pendings = _resolve_props(payload, anno_np[i], payloads)
-        else:  # empty ring: the payload's own props verbatim
-            props = dict(payload.props) if payload.props else None
-            pendings = []
-        if props:
-            entry["props"] = props
-        if pendings:
-            entry["pendingAnnotates"] = pendings
+            piece = ft[off:off + length_l[i]]
+            if fold and rem_seq == DEV_NO_REMOVE \
+                    and ins_seq_l[i] != DEV_UNASSIGNED \
+                    and ins_seq_l[i] <= min_seq:
+                parts.append(piece)  # acked plain text: folds
+                continue
+            entry: Dict[str, Any] = {"kind": SEG_TEXT, "text": piece}
+        else:
+            payload = payloads.get(op_id)
+            entry = {"kind": payload.kind}
+            if payload.kind == SEG_MARKER:
+                entry["text"] = ""
+            else:
+                off = off_l[i]
+                entry["text"] = payload.text[off:off + length_l[i]]
+            if ring_any[i]:
+                props, pendings = _resolve_props(payload, anno_np[i],
+                                                 payloads)
+            else:  # empty ring: the payload's own props verbatim
+                props = dict(payload.props) if payload.props else None
+                pendings = []
+            if props:
+                entry["props"] = props
+            if pendings:
+                entry["pendingAnnotates"] = pendings
         ins_seq = ins_seq_l[i]
         if ins_seq == DEV_UNASSIGNED:  # pending local insert
             entry["localSeq"] = local_seq_l[i]
@@ -324,7 +362,9 @@ def extract_entries(state: DocState, payloads: PayloadTable,
         elif rem_seq != DEV_NO_REMOVE:
             entry["removedSeq"] = rem_seq
             entry["removedClient"] = rem_client0_l[i]
+        flush_parts()
         out.append(entry)
+    flush_parts()
     return out
 
 
@@ -491,7 +531,7 @@ def apply_host_ops(entries: Sequence[dict], host_ops: Sequence[HostOp],
             mseq = int(np.asarray(compacted.min_seq))
             cseq = int(np.asarray(compacted.seq))
             cur = coalesce_entries(extract_entries(compacted, payloads,
-                                                   mseq))
+                                                   mseq, fold=True))
             cap = capacity_for(len(cur), chunk_rows(chunk))
             state = seed_device_state(cur, payloads, cap, mseq, cseq,
                                       anno_slots=anno_slots)
@@ -523,7 +563,7 @@ def apply_host_ops(entries: Sequence[dict], host_ops: Sequence[HostOp],
             mseq = int(np.asarray(compacted.min_seq))
             cseq = int(np.asarray(compacted.seq))
             cur = coalesce_entries(extract_entries(compacted, payloads,
-                                                   mseq))
+                                                   mseq, fold=True))
             cap = capacity_for(len(cur), chunk_rows(chunk))
             state = seed_device_state(cur, payloads, cap, mseq, cseq,
                                       anno_slots=anno_slots)

@@ -39,6 +39,9 @@ class AnnotatePayload:
     # through bulk catch-up so pending groups rebuild after adoption)
 
 
+_UNSET = object()  # lazy-cache sentinel (cached values include None)
+
+
 class MergeArenaBlock:
     """One flush's merge payloads in columnar form (the native wire pump's
     output, server/pump.py): text lives as byte slices of a shared arena,
@@ -51,7 +54,7 @@ class MergeArenaBlock:
 
     __slots__ = ("base", "kinds", "marker", "textoff", "textlen", "arena",
                  "bufs", "pbuf", "pstart", "pend", "seqs", "_cache",
-                 "lane_ids")
+                 "lane_ids", "_ascii_text")
 
     # kinds codes (block-local)
     K_TEXT, K_MARKER, K_ANNOTATE, K_NONE, K_RUN, K_ITEMS = \
@@ -70,6 +73,7 @@ class MergeArenaBlock:
         self.pend = pend
         self.seqs = None  # [n] int32, annotate seq — set post-ticketing
         self._cache: Dict[int, Any] = {}
+        self._ascii_text = _UNSET  # fast_text lazy tri-state
 
     def __len__(self) -> int:
         return len(self.kinds)
@@ -82,6 +86,27 @@ class MergeArenaBlock:
         import json as _json
         decoded = _json.loads(raw)
         return decoded if isinstance(decoded, dict) else None
+
+    def fast_text(self, op_id: int):
+        """Whole-payload text for a plain props-free K_TEXT row via a
+        ONE-SHOT decode of the shared arena — the serving fold touches
+        every row of a lane once (then frees the ids), so resolve()'s
+        per-row decode + object construct + cache never amortizes there.
+        Returns None when the row needs the generic resolve (non-text
+        kind, props present, or a non-ASCII arena where byte offsets
+        stop being char offsets)."""
+        i = op_id - self.base
+        if int(self.kinds[i]) != self.K_TEXT or int(self.pstart[i]) >= 0:
+            return None
+        text_all = self._ascii_text
+        if text_all is _UNSET:
+            decoded = self.arena.decode("utf-8")
+            text_all = decoded if len(decoded) == len(self.arena) else None
+            self._ascii_text = text_all
+        if text_all is None:
+            return None
+        off = int(self.textoff[i])
+        return text_all[off:off + int(self.textlen[i])]
 
     def resolve(self, op_id: int):
         i = op_id - self.base

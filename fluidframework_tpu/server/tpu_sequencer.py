@@ -709,7 +709,7 @@ class MergeLaneStore:
             mseq = int(np.asarray(row.min_seq))
             cseq = int(np.asarray(row.seq))
             entries = coalesce_entries(
-                extract_entries(row, self.payloads, mseq))
+                extract_entries(row, self.payloads, mseq, fold=True))
             new_entries = coalesce_entries(
                 apply_host_ops(entries, ops, self.payloads, mseq, cseq))
         except (Unmodelable, ValueError):
@@ -820,7 +820,8 @@ class MergeLaneStore:
                 allow_runs = matrix_base_key(key) is not None
                 try:
                     entries = coalesce_entries(
-                        extract_entries(row, self.payloads, mseq))
+                        extract_entries(row, self.payloads, mseq,
+                                        fold=True))
                     nb = self._seed_bucket_for(len(entries))
                     # Demotion-only: the overflow-time fold
                     # (_fold_rerun_batch) keeps busy lanes in their small
