@@ -278,6 +278,10 @@ class MergeLaneStore:
         # extract+coalesce probe costs ~ms/lane and a contended lane can
         # stay crowded-but-undemotable across many ticks).
         self._fold_skip: Dict[tuple, int] = {}
+        # Tick-fold work cap: bounds the host fold per compact tick so
+        # proactive folding smooths latency instead of creating its own
+        # stop-the-world wave.
+        self.fold_budget_per_tick = 64
         # Monotone change generations per channel — incremental
         # summarization extracts (and transfers) only channels whose
         # generation advanced past a consumer's last-written snapshot
@@ -382,6 +386,7 @@ class MergeLaneStore:
             self._free_payload(op_id)
 
     def _age_blocks(self) -> None:
+        from ..mergetree.host import _UNSET
         keep = []
         for rec in self._blocks:
             rec[0] += 1
@@ -389,6 +394,11 @@ class MergeLaneStore:
             if not block.lane_ids:
                 continue  # every lane departed; drop the registry ref
             if rec[0] < self.block_age_ticks:
+                # Drop fast_text's decoded-arena cache between ticks: it
+                # serves the fold batches of ONE tick window; keeping it
+                # for the block's whole aging life would double the
+                # pinned arena memory.
+                block._ascii_text = _UNSET
                 keep.append(rec)
                 continue
             # Old block still referenced (idle lanes never fold):
@@ -648,7 +658,7 @@ class MergeLaneStore:
             allow_runs = matrix_base_key(key) is not None
             try:
                 entries = coalesce_entries(
-                    extract_entries(row, self.payloads, mseq))
+                    extract_entries(row, self.payloads, mseq, fold=True))
                 # Re-run headroom: each window op costs at most 2 rows
                 # (insert + split). Not enough -> promotion is correct.
                 need = len(entries) + 2 * len(lane_ops[lanes[j]]) + 8
@@ -795,15 +805,32 @@ class MergeLaneStore:
                                          extract_entries, seed_host_cols)
         tm = jax.tree_util.tree_map
         dest: Dict[int, List[tuple]] = {}  # nb -> [(key, cols, mseq, cseq)]
+        budget = self.fold_budget_per_tick
         for b, bucket in enumerate(self.buckets):
             if not any(k is not None for k in bucket.used):
                 continue
             counts = np.asarray(bucket.state.count)
+            # Near-overflow lanes in fold-eligible buckets fold ahead of
+            # time (same-bucket reseed allowed, budget-capped): spreading
+            # the host fold across ticks instead of letting a cohort of
+            # lockstep lanes all hit the synchronized overflow fold in
+            # one flush (a p99 latency cliff).
+            near_ok = bucket.capacity >= self.fold_min_capacity
+            if b == 0 and not near_ok:
+                # Neither demotion (no smaller bucket) nor refold
+                # (below fold_min_capacity) is possible here: probing
+                # would burn budget + extract time on guaranteed no-ops,
+                # starving the buckets the budget exists to smooth.
+                continue
             cands = [i for i, key in enumerate(bucket.used)
                      if key is not None
                      and int(counts[i]) * self.FOLD_DEN
                      >= bucket.capacity * self.FOLD_NUM
                      and self._fold_skip.get(key) != int(counts[i])]
+            if len(cands) > budget:
+                cands = sorted(cands, key=lambda i: -int(counts[i]))
+                cands = cands[:budget]
+            budget -= len(cands)
             if not cands:
                 continue
             take = jnp.asarray(np.asarray(cands, np.int32))
@@ -823,12 +850,19 @@ class MergeLaneStore:
                         extract_entries(row, self.payloads, mseq,
                                         fold=True))
                     nb = self._seed_bucket_for(len(entries))
-                    # Demotion-only: the overflow-time fold
-                    # (_fold_rerun_batch) keeps busy lanes in their small
-                    # buckets; this tick exists to move lanes whose
-                    # content SHRANK back down to a cheaper capacity.
-                    # Same-bucket rebuilds would be pure churn.
-                    if nb is None or nb >= b:
+                    # Accept a demotion (content shrank: cheaper
+                    # capacity) or, for a fold-eligible bucket, a
+                    # near-overflow fold in place that actually reclaims
+                    # rows (>= half) — same-bucket rebuilds that reclaim
+                    # little would be pure churn; the overflow-time fold
+                    # still owns contended lanes.
+                    near = (near_ok
+                            and int(counts[lane]) * 8
+                            >= bucket.capacity * 7
+                            and len(entries) * 2 <= int(counts[lane]))
+                    demote = nb is not None and nb < b
+                    refold = nb == b and near
+                    if not (demote or refold):
                         self._fold_skip[key] = int(counts[lane])
                         continue
                     cols = seed_host_cols(

@@ -550,6 +550,42 @@ class TestHostFold:
         assert set(gen1) <= set(freed) | gen2, (gen1, freed, gen2)
         assert store.text(key) == "cd" * 72 + "ab" * 72
 
+    def test_inline_fold_equivalence_and_non_ascii_arena(self):
+        """extract_entries(fold=True) must equal
+        coalesce_entries(extract_entries(fold=False)) — including on a
+        NON-ASCII arena, where fast_text's byte-offset slicing must
+        refuse (len(decoded) != len(arena)) and fall back to resolve();
+        a regression there silently corrupts snapshot text."""
+        import jax as _jax
+
+        from fluidframework_tpu.mergetree.catchup import (coalesce_entries,
+                                                          extract_entries)
+
+        for payload_txt in ("ascii", "héllo·wörld"):
+            server = TpuLocalServer()
+            loader, c1, ds1 = make_doc(server)
+            c1.attach()
+            text = ds1.create_channel("text", SharedString.TYPE)
+            rng = random.Random(37)
+            for i in range(120):
+                pos = rng.randrange(text.get_length() + 1)
+                text.insert_text(pos, payload_txt[i % len(payload_txt)])
+                if i % 9 == 0 and text.get_length() > 6:
+                    start = rng.randrange(text.get_length() - 4)
+                    text.annotate_range(start, start + 3, {"k": i % 2})
+            store = server.sequencer().merge
+            key = ("doc", "default", "text")
+            b, lane = store.where[key]
+            row = _jax.device_get(store.buckets[b].row(lane))
+            mseq = int(row.min_seq)
+            folded = extract_entries(row, store.payloads, mseq, fold=True)
+            perrow = coalesce_entries(
+                extract_entries(row, store.payloads, mseq))
+            assert coalesce_entries(folded) == perrow, payload_txt
+            joined = "".join(e["text"] for e in perrow
+                             if e.get("removedSeq") is None)
+            assert joined == text.get_text(), payload_txt
+
     def test_arena_blocks_age_out(self):
         """Fast-path arena blocks pin the flush's raw wire buffers; once
         every referencing lane folds (or the block ages), the registry
