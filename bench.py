@@ -216,11 +216,45 @@ def _serving_ingest_rate(docs: int = 4096, ops_per_doc: int = 32) -> dict:
     if emitted != total:
         raise RuntimeError(
             f"steady windows emitted {emitted} of {total} messages")
+    # Lane-health counters snapshot FIRST: cumulative, and the latency
+    # waves below may legitimately fold — these must describe only the
+    # measured throughput waves (folds there mean the steady state
+    # wasn't steady).
+    steady_folds = lam.merge.folds
+    steady_drops = lam.merge.overflow_drops
+    # Flush-latency distribution (the reference tracks op round-trip
+    # latency, connectionTelemetry.ts): waves 6-8 re-drive the same
+    # steady shape flushed in small batches, so each flush is one
+    # latency sample — p50/p99 of what a client actually waits for a
+    # window to sequence, incl. any fold/recovery stalls. 64 flushes
+    # per wave x 3 waves = 192 samples, enough that nearest-rank p99
+    # is not just the max.
+    chunk = max(8, docs // 64)
+    lat_ms: list = []
+    for w in (6, 7, 8):
+        msgs = build_wave(w)
+        for i in range(0, len(msgs), chunk):
+            t1 = time.perf_counter()
+            for qm in msgs[i:i + chunk]:
+                lam.handler(qm)
+            lam.flush()
+            lam.drain()
+            lat_ms.append((time.perf_counter() - t1) * 1000.0)
+    if nacks:
+        raise RuntimeError(f"latency waves nacked {len(nacks)} ops")
+    lat_ms.sort()
+
+    def pct(p):
+        return round(lat_ms[min(len(lat_ms) - 1,
+                                int(p * len(lat_ms)))], 2)
+
     return {"serving_ingest_ops_per_sec": round(total / elapsed, 1),
-            # Lane-health counters: promotions/folds/rescues DURING the
-            # measured waves would mean the steady state isn't steady.
-            "serving_ingest_folds": lam.merge.folds,
-            "serving_ingest_overflow_drops": lam.merge.overflow_drops}
+            "serving_ingest_flush_p50_ms": pct(0.50),
+            "serving_ingest_flush_p99_ms": pct(0.99),
+            "serving_ingest_flush_max_ms": round(lat_ms[-1], 2),
+            "serving_ingest_flush_samples": len(lat_ms),
+            "serving_ingest_folds": steady_folds,
+            "serving_ingest_overflow_drops": steady_drops}
 
 
 def _matrix_serving_ingest_rate(docs: int = 1024,
