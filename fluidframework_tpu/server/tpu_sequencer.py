@@ -282,6 +282,16 @@ class MergeLaneStore:
         # proactive folding smooths latency instead of creating its own
         # stop-the-world wave.
         self.fold_budget_per_tick = 64
+        # Major payload-id collection cadence: every N compact ticks IF
+        # the table grew past double its post-collection size (the
+        # heap-doubling heuristic — dead slots cannot be counted via
+        # free_ids alone, because slow-path ingest ids orphaned by a
+        # fold are never individually freed).
+        self.payload_compact_every = 64
+        self.payload_compact_min_entries = 4096
+        self._ticks_since_payload_compact = 0
+        self._entries_after_last_compact = 0
+        self.payload_compactions = 0
         # Monotone change generations per channel — incremental
         # summarization extracts (and transfers) only channels whose
         # generation advanced past a consumer's last-written snapshot
@@ -384,6 +394,61 @@ class MergeLaneStore:
         buffers it pins — becomes garbage."""
         for op_id in block.lane_ids.pop(key, ()):
             self._free_payload(op_id)
+
+    def compact_payload_ids(self) -> bool:
+        """Major collection (LWW compact_values' merge analog): renumber
+        the LIVE payload ids and rebuild the table. The entries LIST
+        grows one slot per ingested op (blocks append contiguously;
+        holes recycle but the list never shrinks), so a long-lived
+        server would hold an ever-growing slab of dead slots. Collects
+        referenced ids from the origin_op + anno planes (run right
+        after compact_batched, so rows past count are blanked),
+        materializes block-backed payloads, renumbers the planes with a
+        vectorized searchsorted remap, and drops every block. Skipped
+        (retried next tick) while an async summary worker resolves the
+        old ids. Returns True when it ran."""
+        with self._guard_lock:
+            if self._extract_guards:
+                return False
+            self._deferred_frees = []  # table is rebuilt wholesale
+        per_bucket: List[Optional[tuple]] = []
+        referenced: set = set()
+        for bucket in self.buckets:
+            if not any(k is not None for k in bucket.used):
+                per_bucket.append(None)
+                continue
+            op_np = np.asarray(bucket.state.origin_op)
+            an_np = np.asarray(bucket.state.anno)
+            per_bucket.append((op_np, an_np))
+            referenced.update(int(v) for v in np.unique(op_np) if v >= 0)
+            referenced.update(int(v) for v in np.unique(an_np) if v >= 0)
+        order = sorted(referenced)
+        sorted_old = np.asarray(order, np.int64)
+        new_entries = [self.payloads.get(old) for old in order]
+        for bucket, host in zip(self.buckets, per_bucket):
+            if host is None:
+                continue
+            op_np, an_np = host
+
+            def renumber(plane):
+                live = plane >= 0
+                idx = np.searchsorted(sorted_old, plane)
+                return np.where(live, idx, -1).astype(np.int32)
+
+            bucket.state = bucket.state._replace(
+                origin_op=jnp.asarray(renumber(op_np)),
+                anno=jnp.asarray(renumber(an_np)))
+        remap = {old: new for new, old in enumerate(order)}
+        self._fold_payloads = {
+            key: sorted(remap[i] for i in ids if i in remap)
+            for key, ids in self._fold_payloads.items()}
+        self.payloads.entries = new_entries
+        self.payloads.free_ids = []
+        self._blocks = []
+        self._lane_blocks = {}
+        self._entries_after_last_compact = len(new_entries)
+        self.payload_compactions += 1
+        return True
 
     def _age_blocks(self) -> None:
         from ..mergetree.host import _UNSET
@@ -765,6 +830,17 @@ class MergeLaneStore:
                 bucket.state = kernel.compact_batched(bucket.state)
         self._fold_crowded()
         self._age_blocks()
+        self._ticks_since_payload_compact += 1
+        if self._ticks_since_payload_compact >= self.payload_compact_every:
+            # Only worth the plane round-trip when the table doubled
+            # since the last collection (or its initial floor).
+            threshold = max(self.payload_compact_min_entries,
+                            2 * self._entries_after_last_compact)
+            if len(self.payloads.entries) >= threshold:
+                if self.compact_payload_ids():
+                    self._ticks_since_payload_compact = 0
+            else:
+                self._ticks_since_payload_compact = 0
         self.flushes_since_compact = 0
 
     # Fold when live rows pass 3/4 of capacity; the per-lane cadence is

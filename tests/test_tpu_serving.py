@@ -586,6 +586,50 @@ class TestHostFold:
                              if e.get("removedSeq") is None)
             assert joined == text.get_text(), payload_txt
 
+    def test_payload_id_compaction_renumbers_and_shrinks(self):
+        """Major collection: the payload-table LIST grows one slot per
+        ingested op; compact_payload_ids must renumber the live ids,
+        shrink the table to live size, and leave every read path and
+        subsequent editing exact."""
+        server = TpuLocalServer()
+        loader, c1, ds1 = make_doc(server)
+        c1.attach()
+        text = ds1.create_channel("text", SharedString.TYPE)
+        rng = random.Random(41)
+        for i in range(500):
+            pos = rng.randrange(text.get_length() + 1)
+            text.insert_text(pos, f"p{i % 10}")
+        store = server.sequencer().merge
+        before = len(store.payloads.entries)
+        assert store.compact_payload_ids()
+        after = len(store.payloads.entries)
+        assert after < before // 3, (before, after)
+        assert not store._blocks and not store._lane_blocks
+        key = ("doc", "default", "text")
+        assert server.sequencer().channel_text(*key) == text.get_text()
+        # Renumbered generation tracking still frees on the next fold.
+        gen = store._fold_payloads.get(key)
+        assert gen is None or all(i < after for i in gen)
+        for i in range(200):  # editing continues exactly post-renumber
+            pos = rng.randrange(text.get_length() + 1)
+            if text.get_length() > 10 and rng.random() < 0.3:
+                start = rng.randrange(text.get_length() - 4)
+                text.remove_text(start, start + 2)
+            else:
+                text.insert_text(pos, "Z")
+        assert server.sequencer().channel_text(*key) == text.get_text()
+        # The cadence trigger fires organically once the table doubles
+        # past its post-collection size (heap-doubling heuristic: dead
+        # slow-path slots never enter free_ids, so the gate must not
+        # depend on the free list).
+        before_count = store.payload_compactions
+        store.payload_compact_every = 1
+        store.payload_compact_min_entries = 0
+        while store.payload_compactions == before_count:
+            text.insert_text(0, "q")
+            assert text.get_length() < 6000, "organic trigger never fired"
+        assert server.sequencer().channel_text(*key) == text.get_text()
+
     def test_arena_blocks_age_out(self):
         """Fast-path arena blocks pin the flush's raw wire buffers; once
         every referencing lane folds (or the block ages), the registry
