@@ -292,6 +292,10 @@ class MergeLaneStore:
         self._ticks_since_payload_compact = 0
         self._entries_after_last_compact = 0
         self.payload_compactions = 0
+        # Renumbering while a chunked apply() still holds un-applied
+        # HostOps (numbered against the old table) would corrupt the
+        # stream's tail — the collection only runs between applies.
+        self._in_apply = False
         # Monotone change generations per channel — incremental
         # summarization extracts (and transfers) only channels whose
         # generation advanced past a consumer's last-written snapshot
@@ -538,6 +542,13 @@ class MergeLaneStore:
     def apply(self, streams: Dict[tuple, List[HostOp]]) -> None:
         """Apply per-lane op streams; windows longer than the largest
         T-bucket chunk into successive device passes (bulk catch-up)."""
+        self._in_apply = True
+        try:
+            self._apply(streams)
+        finally:
+            self._in_apply = False
+
+    def _apply(self, streams: Dict[tuple, List[HostOp]]) -> None:
         max_t = self.t_buckets[-1]
         while streams:
             window: Dict[tuple, List[HostOp]] = {}
@@ -831,17 +842,27 @@ class MergeLaneStore:
         self._fold_crowded()
         self._age_blocks()
         self._ticks_since_payload_compact += 1
-        if self._ticks_since_payload_compact >= self.payload_compact_every:
-            # Only worth the plane round-trip when the table doubled
-            # since the last collection (or its initial floor).
-            threshold = max(self.payload_compact_min_entries,
-                            2 * self._entries_after_last_compact)
-            if len(self.payloads.entries) >= threshold:
-                if self.compact_payload_ids():
-                    self._ticks_since_payload_compact = 0
-            else:
-                self._ticks_since_payload_compact = 0
+        self.maybe_compact_payload_ids()
         self.flushes_since_compact = 0
+
+    def maybe_compact_payload_ids(self) -> None:
+        """Cadence + size gate for the major collection. Safe-boundary
+        aware: skipped while a chunked apply() holds un-applied HostOps
+        (their op_ids are numbered against the old table — renumbering
+        mid-stream corrupts the tail), so pure slow-path servers fire it
+        from the flush boundary instead (TpuSequencerLambda.flush)."""
+        if self._ticks_since_payload_compact < self.payload_compact_every \
+                or self._in_apply:
+            return
+        # Only worth the plane round-trip when the table doubled since
+        # the last collection (or its initial floor).
+        threshold = max(self.payload_compact_min_entries,
+                        2 * self._entries_after_last_compact)
+        if len(self.payloads.entries) >= threshold:
+            if self.compact_payload_ids():
+                self._ticks_since_payload_compact = 0
+        else:
+            self._ticks_since_payload_compact = 0
 
     # Fold when live rows pass 3/4 of capacity; the per-lane cadence is
     # therefore ~capacity/4 ops, so the host cost amortizes wider as
@@ -2441,6 +2462,10 @@ class TpuSequencerLambda(IPartitionLambda):
         # so this loop is bounded by the backlog length.
         while any(self.pending.values()):
             self._flush_window()
+        # Slow-path traffic only ever ticks the compaction cadence INSIDE
+        # apply() (where the collection must defer); this is its safe
+        # boundary — every window above has fully applied.
+        self.merge.maybe_compact_payload_ids()
         if self._inflight is None:
             self._checkpoint()
         # else: the deferred window's drain checkpoints its own offset.

@@ -630,6 +630,68 @@ class TestHostFold:
             assert text.get_length() < 6000, "organic trigger never fired"
         assert server.sequencer().channel_text(*key) == text.get_text()
 
+    def test_collection_defers_during_chunked_apply(self):
+        """A single apply() with a stream longer than the largest
+        T-bucket chunks into successive windows whose compact ticks
+        could hit the collection cadence — renumbering then would
+        corrupt the un-applied tail's op_ids (reproduced as IndexError
+        pre-fix). The collection must wait for the apply to finish."""
+        from fluidframework_tpu.server.tpu_sequencer import MergeLaneStore
+        store = MergeLaneStore(capacities=(8, 64, 1024),
+                               lanes_per_bucket=1,
+                               t_buckets=(1, 4, 16, 64))
+        store.fold_min_capacity = 64
+        store.compact_every = 1          # tick at every window
+        store.payload_compact_every = 1  # collection eligible every tick
+        store.payload_compact_min_entries = 0
+        key = ("d", "s", "t")
+        ops = [store.builder.insert_text(0, "xy", s, 0, s + 1, msn=s)
+               for s in range(300)]      # >> max_t=64: many chunks
+        store.apply({key: ops})          # must not crash nor corrupt
+        assert store.text(key) == "xy" * 300
+        # At the next safe boundary the collection still runs.
+        assert store.compact_payload_ids() is True
+        assert store.text(key) == "xy" * 300
+
+    def test_extract_guard_defers_frees_and_collection(self):
+        """While an async summary worker may still resolve the shared
+        payload table, fold frees must defer (a recycled id would
+        materialize the WRONG text into the in-flight snapshot) and the
+        major collection must refuse to renumber; both proceed after
+        release."""
+        from fluidframework_tpu.server.tpu_sequencer import MergeLaneStore
+        store = MergeLaneStore(capacities=(8, 64), lanes_per_bucket=1)
+        store.fold_min_capacity = 64
+        key = ("d", "s", "t")
+        seq = 0
+
+        def drive(batches):
+            nonlocal seq
+            for _ in range(batches):
+                ops = []
+                for _ in range(6):
+                    seq += 1
+                    ops.append(store.builder.insert_text(
+                        0, "ab", seq - 1, 0, seq, msn=seq - 1))
+                store.apply({key: ops})
+
+        drive(12)
+        assert store.folds >= 1
+        store.extract_guard_acquire()
+        # Snapshot content under guard (what the async worker reads).
+        text_before = store.text(key)
+        assert store.compact_payload_ids() is False, \
+            "collection must defer under an extract guard"
+        drive(12)  # folds fire; their frees must defer, not recycle
+        assert store._deferred_frees, "fold frees should have deferred"
+        assert store.text(key) == "ab" * 144
+        store.extract_guard_release()
+        assert store.compact_payload_ids() is True
+        assert not store._deferred_frees  # table rebuilt wholesale
+        drive(2)  # editing continues exactly post-release+renumber
+        assert store.text(key) == "ab" * 156
+        assert text_before == "ab" * 72
+
     def test_arena_blocks_age_out(self):
         """Fast-path arena blocks pin the flush's raw wire buffers; once
         every referencing lane folds (or the block ages), the registry
