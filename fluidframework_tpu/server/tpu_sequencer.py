@@ -2464,9 +2464,12 @@ class TpuSequencerLambda(IPartitionLambda):
             self._flush_window()
         # Slow-path traffic only ever ticks the compaction cadence INSIDE
         # apply() (where the collection must defer); this is its safe
-        # boundary — every window above has fully applied.
-        self.merge.maybe_compact_payload_ids()
+        # boundary — every window above has fully applied. A deferred
+        # fast window is the same hazard class: its recovery replays
+        # op_ids and pre-window rows numbered against the CURRENT table,
+        # so no renumbering while one is in flight.
         if self._inflight is None:
+            self.merge.maybe_compact_payload_ids()
             self._checkpoint()
         # else: the deferred window's drain checkpoints its own offset.
 

@@ -493,3 +493,68 @@ class TestWireFuzzSoak:
                                       boxcar("doc", [ok], "c")))
         lam.flush()
         lam.drain()
+
+
+class TestMaintenanceSoak:
+    """The serving maintenance machinery (host fold, block aging,
+    payload-id collection) at its most hostile cadences — every knob at
+    minimum — interleaved with restarts and async summaries. Any
+    id/lane bookkeeping slip shows up as divergence from the client
+    replicas or a crash."""
+
+    @pytest.mark.parametrize("trial", range(TRIALS))
+    def test_aggressive_maintenance_converges(self, trial):
+        from fluidframework_tpu.dds.sequence import SharedString
+
+        rng = random.Random(77_000 + trial)
+        server, loader, chans = _soak_session(SharedString.TYPE,
+                                              n_clients=2)
+
+        def tune():
+            st = server.sequencer().merge
+            st.compact_every = 1
+            st.block_age_ticks = 1
+            st.payload_compact_every = rng.choice((1, 2))
+            st.payload_compact_min_entries = 0
+            st.fold_budget_per_tick = rng.choice((1, 4))
+            return st
+
+        store = tune()
+        activity = 0  # accumulated across restarts (fresh store each)
+        summaries = []
+
+        def on_done(out):
+            summaries.append(out)
+
+        threads = []
+        for i in range(rng.randrange(250, 400)):
+            ch = rng.choice(chans)
+            n = ch.get_length()
+            if n > 8 and rng.random() < 0.3:
+                start = rng.randrange(n - 4)
+                ch.remove_text(start, start + rng.randrange(1, 4))
+            elif n > 4 and rng.random() < 0.15:
+                start = rng.randrange(n - 2)
+                ch.annotate_range(start, start + 2, {"b": i % 3})
+            else:
+                ch.insert_text(rng.randrange(n + 1), f"m{i % 10}")
+            if rng.random() < 0.05:
+                threads.append(
+                    server.sequencer().summarize_documents_async(on_done))
+            if rng.random() < 0.02:
+                # restart() rebuilds the lambda with a FRESH store:
+                # bank the old one's counters and re-apply the hostile
+                # knobs to the new one.
+                activity += store.folds + store.payload_compactions \
+                    + store.blocks_aged
+                server._deli_mgr.restart()
+                store = tune()
+        for th in threads:
+            th.join(timeout=30)
+        assert chans[0].get_text() == chans[1].get_text()
+        assert server.sequencer().channel_text(
+            "doc", "default", "ch") == chans[0].get_text()
+        # Maintenance actually exercised (not silently gated off).
+        activity += store.folds + store.payload_compactions \
+            + store.blocks_aged
+        assert activity > 0
