@@ -245,8 +245,9 @@ def _serving_ingest_rate(docs: int = 4096, ops_per_doc: int = 32) -> dict:
     lat_ms.sort()
 
     def pct(p):
+        import math
         return round(lat_ms[min(len(lat_ms) - 1,
-                                int(p * len(lat_ms)))], 2)
+                                math.ceil(p * len(lat_ms)) - 1)], 2)
 
     return {"serving_ingest_ops_per_sec": round(total / elapsed, 1),
             "serving_ingest_flush_p50_ms": pct(0.50),

@@ -185,6 +185,15 @@ def seed_host_cols(entries: Sequence[dict], payloads: PayloadTable,
     cols["rem_client"] = rem_client
     if anno is not None:
         cols["anno"] = anno
+    if any("removedOverlapClients" in e for e in entries):
+        from .constants import MAX_OVERLAP_CLIENTS
+        overlap = np.full((n, MAX_OVERLAP_CLIENTS - 1), -1, np.int32)
+        for i, e in enumerate(entries):
+            for j, c in enumerate(
+                    e.get("removedOverlapClients",
+                          [])[:MAX_OVERLAP_CLIENTS - 1]):
+                overlap[i, j] = c
+        cols["rem_overlap"] = overlap
     return cols
 
 
@@ -294,7 +303,13 @@ def extract_entries(state: DocState, payloads: PayloadTable,
     local_seq_l = np.asarray(state.local_seq)[:count].tolist()
     rem_seq_l = np.asarray(state.rem_seq)[:count].tolist()
     rem_local_l = np.asarray(state.rem_local_seq)[:count].tolist()
-    rem_client0_l = np.asarray(state.rem_clients)[:count, 0].tolist()
+    rem_clients_np = np.asarray(state.rem_clients)[:count]
+    rem_client0_l = rem_clients_np[:, 0].tolist()
+    # Overlap removers (slots 1+) matter to in-window consumers: an op
+    # from a second remover at a ref below the first remove's seq must
+    # still see the segment as removed after a fold/reseed cycle.
+    overlap_any = (rem_clients_np[:, 1:] >= 0).any(axis=1).tolist() \
+        if count and rem_clients_np.shape[1] > 1 else [False] * count
     op_l = np.asarray(state.origin_op)[:count].tolist()
     off_l = np.asarray(state.origin_off)[:count].tolist()
     anno_np = np.asarray(state.anno)[:count]
@@ -362,6 +377,9 @@ def extract_entries(state: DocState, payloads: PayloadTable,
         elif rem_seq != DEV_NO_REMOVE:
             entry["removedSeq"] = rem_seq
             entry["removedClient"] = rem_client0_l[i]
+        if overlap_any[i]:
+            entry["removedOverlapClients"] = [
+                int(c) for c in rem_clients_np[i, 1:] if c >= 0]
         flush_parts()
         out.append(entry)
     flush_parts()

@@ -670,6 +670,12 @@ class MergeTreeOracle:
             if seg.rem_seq is not None and seg.rem_seq != UNASSIGNED_SEQ:
                 entry["removedSeq"] = seg.rem_seq
                 entry["removedClient"] = seg.rem_client
+                if seg.rem_overlap:
+                    # Overlap removers matter to in-window consumers (an
+                    # op from a second remover at a ref below the first
+                    # remove's seq must still see the segment as gone);
+                    # without them a reseeded tree diverges.
+                    entry["removedOverlapClients"] = list(seg.rem_overlap)
             out.append(entry)
         return out
 
@@ -710,6 +716,8 @@ class MergeTreeOracle:
                 else:
                     entry["removedSeq"] = seg.rem_seq
                     entry["removedClient"] = seg.rem_client
+                if seg.rem_overlap:
+                    entry["removedOverlapClients"] = list(seg.rem_overlap)
             if id(seg) in pending_anno:
                 entry["pendingAnnotates"] = sorted(
                     pending_anno[id(seg)], key=lambda a: a["localSeq"])
@@ -736,6 +744,7 @@ class MergeTreeOracle:
                 rem_seq=(UNASSIGNED_SEQ if pending_rem
                          else e.get("removedSeq")),
                 rem_client=e.get("removedClient"),
+                rem_overlap=list(e.get("removedOverlapClients", [])),
                 props=dict(e["props"]) if e.get("props") else None,
                 uid=tree._next_uid(),
             )

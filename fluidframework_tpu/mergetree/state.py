@@ -120,6 +120,12 @@ def state_from_numpy(columns: dict, capacity: int,
     if "rem_client" in columns:
         rem_clients = rem_clients.copy()
         rem_clients[:n, 0] = np.asarray(columns["rem_client"], np.int32)
+    if "rem_overlap" in columns:  # overlap removers, slots 1+
+        if "rem_client" not in columns:
+            rem_clients = rem_clients.copy()
+        ov = np.asarray(columns["rem_overlap"], np.int32)
+        w = min(ov.shape[1], overlap_slots - 1)
+        rem_clients[:n, 1:1 + w] = ov[:, :w]
     anno = base.anno
     if "anno" in columns:
         host_anno = np.asarray(base.anno).copy()

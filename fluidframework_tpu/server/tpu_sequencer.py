@@ -181,6 +181,10 @@ def _stack_seed_rows(items: List[tuple], capacity: int, anno_slots: int,
         for name, arr in out.items():
             arr[j, :n] = cols[name]
         rem_clients[j, :n, 0] = cols["rem_client"]
+        if "rem_overlap" in cols:
+            ov = cols["rem_overlap"]
+            w = min(ov.shape[1], overlap_slots - 1)
+            rem_clients[j, :n, 1:1 + w] = ov[:, :w]
         if "anno" in cols:
             anno[j, :n] = cols["anno"]
         count[j], mins[j], seqs[j] = n, mseq, cseq
@@ -907,6 +911,7 @@ class MergeLaneStore:
             if not any(k is not None for k in bucket.used):
                 continue
             counts = np.asarray(bucket.state.count)
+            mseqs = np.asarray(bucket.state.min_seq)
             # Near-overflow lanes in fold-eligible buckets fold ahead of
             # time (same-bucket reseed allowed, budget-capped): spreading
             # the host fold across ticks instead of letting a cohort of
@@ -919,11 +924,15 @@ class MergeLaneStore:
                 # would burn budget + extract time on guaranteed no-ops,
                 # starving the buckets the budget exists to smooth.
                 continue
+            # The memo keys on (count, min_seq): an msn advance can turn
+            # a previously-undemotable lane foldable without its row
+            # count changing.
             cands = [i for i, key in enumerate(bucket.used)
                      if key is not None
                      and int(counts[i]) * self.FOLD_DEN
                      >= bucket.capacity * self.FOLD_NUM
-                     and self._fold_skip.get(key) != int(counts[i])]
+                     and self._fold_skip.get(key)
+                     != (int(counts[i]), int(mseqs[i]))]
             if len(cands) > budget:
                 cands = sorted(cands, key=lambda i: -int(counts[i]))
                 cands = cands[:budget]
@@ -960,7 +969,8 @@ class MergeLaneStore:
                     demote = nb is not None and nb < b
                     refold = nb == b and near
                     if not (demote or refold):
-                        self._fold_skip[key] = int(counts[lane])
+                        self._fold_skip[key] = (int(counts[lane]),
+                                                int(mseqs[lane]))
                         continue
                     cols = seed_host_cols(
                         entries, self.payloads,
@@ -968,7 +978,8 @@ class MergeLaneStore:
                         allow_runs=allow_runs,
                         allow_items=not allow_runs)
                 except (Unmodelable, ValueError):
-                    self._fold_skip[key] = int(counts[lane])
+                    self._fold_skip[key] = (int(counts[lane]),
+                                            int(mseqs[lane]))
                     continue  # leave the lane untouched; fold is optional
                 dest.setdefault(nb, []).append((key, cols, mseq, cseq))
                 freed.append(lane)
@@ -3658,10 +3669,16 @@ class TpuSequencerLambda(IPartitionLambda):
         # Hold fold/rescue payload frees while the worker resolves
         # through the shared table (a recycled id would materialize the
         # wrong text into this snapshot). Acquired last so a raise in
-        # the synchronous staging above cannot leak the guard.
+        # the synchronous staging above cannot leak the guard — and
+        # released on a failed thread start (fd/thread exhaustion), or
+        # every later free would defer forever.
         self.merge.extract_guard_acquire()
-        th = threading.Thread(target=work, daemon=True)
-        th.start()
+        try:
+            th = threading.Thread(target=work, daemon=True)
+            th.start()
+        except Exception:
+            self.merge.extract_guard_release()
+            raise
         return th
 
     # -- introspection (tests / summarization) -----------------------------

@@ -630,7 +630,51 @@ class TestHostFold:
             assert text.get_length() < 6000, "organic trigger never fired"
         assert server.sequencer().channel_text(*key) == text.get_text()
 
-    def test_collection_defers_during_chunked_apply(self):
+    def test_fold_preserves_overlap_removers(self):
+        """Overlap-remove clients (rem_clients slots 1+) must survive the
+        fold's extract->reseed cycle: an op from the SECOND remover at a
+        ref below the first remove's seq must still see the segment as
+        removed — losing the overlap shifts its positions and diverges
+        the lane from the clients."""
+        from fluidframework_tpu.server.tpu_sequencer import MergeLaneStore
+        store = MergeLaneStore(capacities=(64,), lanes_per_bucket=1)
+        store.fold_min_capacity = 64
+        key = ("d", "s", "t")
+        b = store.builder
+        # 50 acked rows below the window: "ABCDEFGH" + 49 tail fillers.
+        ops = [b.insert_text(0, "ABCDEFGH", 0, 0, 1, msn=0)]
+        for s in range(2, 51):
+            ops.append(b.insert_text(6 + s, "z", s - 1, 0, s, msn=s - 1))
+        # Concurrent removes of [2,5)="CDE" by clients 1 and 2 (overlap),
+        # ABOVE the window (min_seq stays 50).
+        ops.append(b.remove(2, 5, 50, 1, 51, msn=50))
+        ops.append(b.remove(2, 5, 50, 2, 52, msn=50))
+        store.apply({key: ops})
+        removed = [e for e in store.entries(key) if "removedSeq" in e]
+        assert removed and removed[0].get("removedOverlapClients") == [2], \
+            removed
+        # Crowd past capacity with in-window fillers appended at the
+        # inserting client's view end (client 0 at ref 50 still sees
+        # CDE): the overflow fold packs the 50 acked rows while the
+        # removed row stays in-window.
+        seq = 52
+        vlen = 8 + 49  # client-0 view length at ref 50
+        while store.folds == 0:
+            chunk = []
+            for _ in range(6):
+                seq += 1
+                chunk.append(b.insert_text(vlen, "z", 50, 0, seq, msn=50))
+                vlen += 1
+            store.apply({key: chunk})
+            assert seq < 600, "fold never fired"
+        # Client 2 edits at ref 50 (below both removes): it must see
+        # [2,5) as removed (its own remove survived the fold), so its
+        # view is AB+FGH... and view-pos 3 lands after F — not inside
+        # the tombstoned CDE.
+        seq += 1
+        store.apply({key: [b.insert_text(3, "!", 50, 2, seq, msn=50)]})
+        text = store.text(key)
+        assert text.startswith("ABF!"), text
         """A single apply() with a stream longer than the largest
         T-bucket chunks into successive windows whose compact ticks
         could hit the collection cadence — renumbering then would
