@@ -338,17 +338,21 @@ class MergeLaneStore:
         self._fold_skip.pop(key, None)
 
     def _free_payload(self, op_id: int) -> None:
-        """Free via the guard: deferred while an async summary worker may
-        still resolve the id; drains the backlog when clear. Always
-        called from the sequencing thread, so the drain never races
-        PayloadTable._add."""
+        self.free_payloads((op_id,))
+
+    def free_payloads(self, ids) -> None:
+        """Free via the guard (one lock round per BATCH): deferred while
+        an async summary worker may still resolve the ids; drains the
+        backlog when clear. Always called from the sequencing thread, so
+        the drain never races PayloadTable._add."""
         with self._guard_lock:
             if self._extract_guards:
-                self._deferred_frees.append(op_id)
+                self._deferred_frees.extend(ids)
                 return
             backlog, self._deferred_frees = self._deferred_frees, []
-        self.payloads.free(op_id)
         for i in backlog:
+            self.payloads.free(i)
+        for i in ids:
             self.payloads.free(i)
 
     def extract_guard_acquire(self) -> None:
@@ -375,10 +379,15 @@ class MergeLaneStore:
         self._fold_payloads[key] = sorted(new_ids)
         refs = self._lane_blocks.get(key)
         if refs:
+            keep_ids = {op.op_id for op in keep_ops}
             kept = set()
             for block in list(refs):
-                base, n = block.base, len(block)
-                if any(base <= op.op_id < base + n for op in keep_ops):
+                # Membership against the block's RECORDED ids for this
+                # lane, not its id range: freed range ids recycle to
+                # unrelated builder ops, and a range test would let such
+                # an op spuriously pin an old block's buffers.
+                if keep_ids and not keep_ids.isdisjoint(
+                        block.lane_ids.get(key, ())):
                     kept.add(block)
                 else:
                     self._release_block_ref(block, key)
@@ -2786,14 +2795,25 @@ class TpuSequencerLambda(IPartitionLambda):
         # block's op ids. Non-admitted rows (opaque/degraded channels —
         # the host object path is authoritative for them) are freed NOW:
         # nothing will ever resolve them, and leaving the entries in
-        # place would pin this flush's raw buffers forever.
+        # place would pin this flush's raw buffers forever. Vectorized
+        # grouping + one batched free — this runs per fast flush on the
+        # ingest hot path.
         lane_ids: Dict[tuple, list] = {}
-        for i in range(merge_rows.size):
-            if ok_rows[i]:
-                lane_ids.setdefault(self._pump_chan[int(chans[i])],
-                                    []).append(mbase + i)
-            else:
-                self.merge._free_payload(mbase + i)
+        ok_idx = np.flatnonzero(ok_rows)
+        if ok_idx.size:
+            ch = chans[ok_idx]
+            order = np.argsort(ch, kind="stable")  # keeps arrival order
+            sorted_ch = ch[order]
+            sorted_ids = (mbase + ok_idx[order]).tolist()
+            bounds = np.flatnonzero(np.diff(sorted_ch)) + 1
+            starts = [0, *bounds.tolist()]
+            ends = [*bounds.tolist(), len(sorted_ids)]
+            for s, e in zip(starts, ends):
+                lane_ids[self._pump_chan[int(sorted_ch[s])]] = \
+                    sorted_ids[s:e]
+        bad_idx = np.flatnonzero(~ok_rows)
+        if bad_idx.size:
+            self.merge.free_payloads((mbase + bad_idx).tolist())
         if lane_ids:
             self.merge.note_block(block, lane_ids)
         return mbase, ok_rows, b_u[inv], l_u[inv]
