@@ -331,8 +331,7 @@ class MergeLaneStore:
     def _forget_lane_payloads(self, key: tuple) -> None:
         """The lane's rows are gone: free its fold generation and release
         every block ref."""
-        for op_id in self._fold_payloads.pop(key, ()):
-            self._free_payload(op_id)
+        self.free_payloads(self._fold_payloads.pop(key, ()))
         for block in self._lane_blocks.pop(key, ()):
             self._release_block_ref(block, key)
         self._fold_skip.pop(key, None)
@@ -373,9 +372,9 @@ class MergeLaneStore:
         release too: after a reseed the lane's rows reference only the
         new generation — plus, for an overflow fold that re-ran the
         current window on device, that window's block ids (keep_ops)."""
-        for op_id in self._fold_payloads.pop(key, ()):
-            if op_id not in new_ids:
-                self._free_payload(op_id)
+        self.free_payloads([op_id
+                            for op_id in self._fold_payloads.pop(key, ())
+                            if op_id not in new_ids])
         self._fold_payloads[key] = sorted(new_ids)
         refs = self._lane_blocks.get(key)
         if refs:
@@ -409,8 +408,7 @@ class MergeLaneStore:
         slots recycle). Once the last lane departs, the registry entry
         drops at the next aging pass and the block — with the raw wire
         buffers it pins — becomes garbage."""
-        for op_id in block.lane_ids.pop(key, ()):
-            self._free_payload(op_id)
+        self.free_payloads(block.lane_ids.pop(key, ()))
 
     def compact_payload_ids(self) -> bool:
         """Major collection (LWW compact_values' merge analog): renumber
@@ -784,8 +782,7 @@ class MergeLaneStore:
             if over[k]:
                 # Rerun still overflowed: this generation's fresh seed
                 # payloads were never adopted — free them now.
-                for op_id in self._seed_ids(cols):
-                    self._free_payload(op_id)
+                self.free_payloads(self._seed_ids(cols))
             else:
                 self._swap_fold_payloads(key, self._seed_ids(cols),
                                          keep_ops=lane_ops[lanes[j]])

@@ -84,3 +84,21 @@ class TestLoadRig:
             documents=1, clients_per_document=2, ops_per_client=40,
             seed=11, reconnect_probability=0.05))
         assert result.converged, result.divergences
+
+
+class TestServingDecayProbe:
+    def test_probe_runs_and_reports_no_decay(self):
+        """server/decay_probe at tiny shapes: the probe must run the
+        real fast path, classify waves, and (with the host zamboni pack
+        in place) report decayed=False."""
+        from fluidframework_tpu.server import pump as pump_mod
+        if not pump_mod.available():
+            import pytest
+            pytest.skip("native wirepump unavailable")
+        from fluidframework_tpu.server.decay_probe import run
+        out = run(docs=32, ops=8, waves=16)
+        if out["decayed"]:  # one retry: a noisy CI neighbor can skew
+            out = run(docs=32, ops=8, waves=16)  # a wall-clock quartile
+        assert out["waves"] == 16
+        assert out["sustained_ops_per_sec"] > 0
+        assert out["decayed"] is False, out
