@@ -210,3 +210,42 @@ class TestMeshServing:
         joined = "".join(e.get("text") or "" for e in body
                          if e.get("removedSeq") is None)
         assert joined == t.get_text()
+
+    def test_host_fold_on_sharded_lanes(self):
+        """The serving zamboni pack must work when lane states are
+        sharded over the dp mesh: the fold's device_get slices, host
+        reseed, and batched put_rows all cross the sharding boundary.
+        Sustained typing overflows the fold bucket and must pack there
+        instead of promoting, with exact text after."""
+        import random
+
+        mesh = make_mesh(sp=1)
+        server = TpuLocalServer(mesh=mesh)
+        loader, c, ds = make_doc(server, "mf")
+        t = ds.create_channel("text", SharedString.TYPE)
+        c.attach()
+        store = server.sequencer().merge
+        rng = random.Random(53)
+        for i in range(400):
+            pos = rng.randrange(t.get_length() + 1)
+            t.insert_text(pos, f"s{i % 10}")
+        assert store.folds > 0, "fold never fired on the mesh"
+        b, lane = store.where[("mf", "default", "text")]
+        fold_b = store.capacities.index(store.fold_min_capacity)
+        assert b <= fold_b
+        # The folded lane's bucket state REALLY spans the mesh (else
+        # this test passes without crossing any sharding boundary).
+        assert len(store.buckets[b].state.length
+                   .sharding.device_set) == 8
+        assert server.sequencer().channel_text(
+            "mf", "default", "text") == t.get_text()
+        # Editing (incl. removes: position resolution against packed
+        # tombstones) continues exactly against the folded sharded lanes.
+        for i in range(40):
+            if t.get_length() > 10 and rng.random() < 0.4:
+                start = rng.randrange(t.get_length() - 4)
+                t.remove_text(start, start + 3)
+            else:
+                t.insert_text(rng.randrange(t.get_length() + 1), "Q")
+        assert server.sequencer().channel_text(
+            "mf", "default", "text") == t.get_text()
