@@ -1,7 +1,7 @@
 # Repeatable entry points (VERDICT r4 #8: the randomized-evidence ritual
 # must be a one-liner anyone can repeat).
 
-.PHONY: test soak bench dryrun record-corpus
+.PHONY: test soak bench dryrun record-corpus historian-smoke
 
 test:
 	python -m pytest tests/ -q
@@ -22,3 +22,9 @@ dryrun:
 
 record-corpus:
 	python -m fluidframework_tpu.testing.record_corpus
+
+# Spawn the local topology with the historian cache tier in front of git
+# storage and assert a reload serves from cache (hit rate > 0), commits
+# invalidate, and a dead historian degrades to direct GitStore reads.
+historian-smoke:
+	JAX_PLATFORMS=cpu python -m fluidframework_tpu.testing.historian_smoke

@@ -227,12 +227,27 @@ class CachingDocumentService(IDocumentService):
 class CachingDocumentServiceFactory(IDocumentServiceFactory):
     """Decorates any factory with the persistent cache. One factory = one
     cache = one shared transport namespace, mirroring the odsp driver's
-    one-socket-many-documents multiplexing shape."""
+    one-socket-many-documents multiplexing shape.
+
+    historian_url composes the client cache with the SERVER-side cache
+    tier (server/historian.py): the inner factory's storage endpoint
+    repoints at the tier, so even this cache's epoch-check misses (head
+    moved, cold boot) serve their blobs from the historian instead of
+    GitStore."""
 
     def __init__(self, inner: IDocumentServiceFactory,
-                 cache: Optional[PersistentCache] = None):
+                 cache: Optional[PersistentCache] = None,
+                 historian_url: Optional[str] = None):
         self.inner = inner
         self.cache = cache or PersistentCache()
+        self.historian_url = historian_url
+        if historian_url is not None:
+            set_endpoint = getattr(inner, "set_historian_endpoint", None)
+            if set_endpoint is None:
+                raise TypeError(
+                    f"{type(inner).__name__} does not support a historian "
+                    "endpoint (no set_historian_endpoint)")
+            set_endpoint(historian_url)
         self._services: Dict[str, CachingDocumentService] = {}
 
     def create_document_service(self, document_id: str) -> IDocumentService:

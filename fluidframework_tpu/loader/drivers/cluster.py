@@ -24,12 +24,28 @@ from .local import _row_to_message
 
 
 class ClusterDocumentStorageService(IDocumentStorageService):
-    def __init__(self, cluster: Cluster, document_id: str):
+    def __init__(self, cluster: Cluster, document_id: str,
+                 historian_tier=None):
         self.cluster = cluster
         self.document_id = document_id
+        self.historian_tier = historian_tier
         self.store = cluster.historian.store(cluster.tenant_id, document_id)
 
     def get_summary(self, version: Optional[str] = None):
+        tier = self.historian_tier
+        if tier is not None:
+            # Reads ride the cache tier; a dead/poisoned tier degrades to
+            # the direct store below (same contract as the network
+            # driver's historian fallback).
+            try:
+                from ...protocol.summary import summary_tree_from_dict
+                data = tier.read_summary_dict(
+                    self.cluster.tenant_id, self.document_id,
+                    commit_sha=version)
+                return (summary_tree_from_dict(data)
+                        if data is not None else None)
+            except Exception:  # noqa: BLE001 — tier failure, not data
+                pass
         return self.cluster.historian.read_summary(
             self.cluster.tenant_id, self.document_id, commit_sha=version)
 
@@ -81,8 +97,9 @@ class ClusterDocumentService(IDocumentService):
         self.document_id = document_id
 
     def connect_to_storage(self):
-        return ClusterDocumentStorageService(self.factory.cluster,
-                                             self.document_id)
+        return ClusterDocumentStorageService(
+            self.factory.cluster, self.document_id,
+            historian_tier=self.factory.historian_tier)
 
     def connect_to_delta_storage(self):
         return ClusterDeltaStorageService(self.factory, self.document_id)
@@ -96,9 +113,15 @@ class ClusterDocumentService(IDocumentService):
 
 
 class ClusterDocumentServiceFactory(IDocumentServiceFactory):
-    def __init__(self, cluster: Cluster, node: OrdererNode):
+    def __init__(self, cluster: Cluster, node: OrdererNode,
+                 historian_tier=None):
+        """historian_tier: an embedded server/historian.py HistorianTier
+        over the cluster's shared store — summary reads then serve from
+        its cache on every node, surviving node failovers (the cache is
+        keyed by content, not by node)."""
         self.cluster = cluster
         self.node = node
+        self.historian_tier = historian_tier
 
     def set_node(self, node: OrdererNode) -> None:
         """Repoint at a different entry node (failover)."""

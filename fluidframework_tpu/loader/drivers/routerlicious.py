@@ -92,17 +92,60 @@ class RestError(Exception):
 RestFactory = Callable[[], RestWrapper]
 
 
+def _never_sent(exc: OSError) -> bool:
+    """True when the transport error proves the request never reached the
+    server (safe to replay a non-idempotent call elsewhere)."""
+    if isinstance(exc, ConnectionRefusedError):
+        return True
+    reason = getattr(exc, "reason", None)  # urllib wraps in URLError
+    return isinstance(reason, ConnectionRefusedError)
+
+
 class NetworkDocumentStorageService(IDocumentStorageService):
     """Summary upload/download over the historian REST routes. Takes a
     RestWrapper *factory* so every request gets a freshly minted token —
-    these services are long-lived and tokens expire."""
+    these services are long-lived and tokens expire.
+
+    historian_factory: a second RestFactory pointed at a standalone
+    summary-cache tier (server/historian.py). When set, storage traffic
+    rides the tier — reads serve from its object cache, uploads
+    write-through it — and a dead tier degrades to the direct endpoint
+    (sticky per service instance, so one mid-load kill costs one timeout,
+    not one per blob)."""
 
     def __init__(self, rest_factory: RestFactory, tenant_id: str,
-                 document_id: str):
+                 document_id: str,
+                 historian_factory: Optional[RestFactory] = None):
         self._rest = rest_factory
+        self._historian = historian_factory
+        self._historian_down = False
         self.tenant_id = tenant_id
         self.document_id = document_id
         self._repo = f"/repos/{_q(tenant_id)}/{_q(document_id)}"
+
+    def _call(self, fn, idempotent: bool = True):
+        """fn(rest) against the cache tier first; transport failure or a
+        503 (tier lost ITS upstream) falls back to the direct endpoint —
+        the historian-killed-mid-load degradation path.
+
+        idempotent=False (summary uploads): an AMBIGUOUS transport error
+        (timeout / reset mid-flight) must NOT replay against the direct
+        endpoint — the tier may already have committed the write (a
+        replayed initial upload would 409 a document that was in fact
+        created; a replayed proposal would orphan a duplicate commit).
+        Only a provably-unsent request (connection refused) falls back."""
+        if self._historian is not None and not self._historian_down:
+            try:
+                return fn(self._historian())
+            except RestError as exc:
+                if exc.status != 503:
+                    raise
+                self._historian_down = True
+            except OSError as exc:
+                self._historian_down = True
+                if not idempotent and not _never_sent(exc):
+                    raise
+        return fn(self._rest())
 
     def get_summary(self, version: Optional[str] = None
                     ) -> Optional[SummaryTree]:
@@ -110,7 +153,7 @@ class NetworkDocumentStorageService(IDocumentStorageService):
         if version:
             path += f"?sha={_q(version)}"
         try:
-            data = self._rest().get(path)
+            data = self._call(lambda rest: rest.get(path))
         except RestError as exc:
             if exc.status == 404:
                 return None
@@ -120,15 +163,18 @@ class NetworkDocumentStorageService(IDocumentStorageService):
     def upload_summary(self, summary: SummaryTree,
                        parent: Optional[str] = None,
                        initial: bool = False) -> str:
-        return self._rest().post(self._repo + "/summaries", {
+        body = {
             "summary": summary_tree_to_dict(summary),
             "parent": parent,
             "initial": initial,
-        })["sha"]
+        }
+        return self._call(
+            lambda rest: rest.post(self._repo + "/summaries", body),
+            idempotent=False)["sha"]
 
     def get_versions(self, count: int = 1) -> List[str]:
-        return self._rest().get(self._repo + f"/versions?count={count}"
-                                )["versions"]
+        return self._call(lambda rest: rest.get(
+            self._repo + f"/versions?count={count}"))["versions"]
 
 
 class NetworkDeltaStorageService(IDocumentDeltaStorageService):
@@ -253,11 +299,14 @@ class NetworkDocumentDeltaConnection(TypedEventEmitter,
 class NetworkDocumentService(IDocumentService):
     def __init__(self, base_url: str, tenant_id: str, document_id: str,
                  token_provider: Optional[TokenProvider],
-                 mux_pool=None, session_cache=None):
+                 mux_pool=None, session_cache=None,
+                 historian_url: Optional[str] = None):
         self.base_url = base_url.rstrip("/")
         self.tenant_id = tenant_id
         self.document_id = document_id
         self.token_provider = token_provider
+        self.historian_url = (historian_url.rstrip("/")
+                              if historian_url else None)
         # Set by a multiplexing factory: shared socket pool + join-session
         # discovery cache (loader/drivers/mux.py).
         self._mux_pool = mux_pool
@@ -274,9 +323,16 @@ class NetworkDocumentService(IDocumentService):
     def _rest(self) -> RestWrapper:
         return RestWrapper(self.base_url, self._token())
 
+    def _historian_rest(self) -> RestWrapper:
+        # Same bearer token: the tier forwards it upstream, so alfred's
+        # riddler validation still gates every cached read.
+        return RestWrapper(self.historian_url, self._token())
+
     def connect_to_storage(self) -> NetworkDocumentStorageService:
-        return NetworkDocumentStorageService(self._rest, self.tenant_id,
-                                             self.document_id)
+        return NetworkDocumentStorageService(
+            self._rest, self.tenant_id, self.document_id,
+            historian_factory=(self._historian_rest
+                               if self.historian_url else None))
 
     def connect_to_delta_storage(self) -> NetworkDeltaStorageService:
         return NetworkDeltaStorageService(self._rest, self.tenant_id,
@@ -316,14 +372,21 @@ class NetworkDocumentServiceFactory(IDocumentServiceFactory):
     multiplex=True turns on the odsp-style connection management: the
     delta stream is discovered per document via the join-session REST
     call and documents on the same endpoint share ONE physical websocket
-    (loader/drivers/mux.py)."""
+    (loader/drivers/mux.py).
+
+    historian_url points storage traffic at a standalone summary-cache
+    tier (server/historian.py); second-and-later container loads then
+    serve summary blobs from its cache instead of GitStore, degrading to
+    base_url if the tier is down."""
 
     def __init__(self, base_url: str, tenant_id: str,
                  token_provider: Optional[TokenProvider] = None,
-                 multiplex: bool = False):
+                 multiplex: bool = False,
+                 historian_url: Optional[str] = None):
         self.base_url = base_url
         self.tenant_id = tenant_id
         self.token_provider = token_provider
+        self.historian_url = historian_url
         if multiplex:
             from .mux import JoinSessionCache, MuxConnectionPool
             self.mux_pool = MuxConnectionPool()
@@ -338,12 +401,18 @@ class NetworkDocumentServiceFactory(IDocumentServiceFactory):
         rest = RestWrapper(self.base_url, token)
         return rest.get(f"/api/v1/session/{_q(tenant_id)}/{_q(document_id)}")
 
+    def set_historian_endpoint(self, historian_url: Optional[str]) -> None:
+        """Repoint storage reads at a cache tier (or None to detach);
+        affects services created afterwards."""
+        self.historian_url = historian_url
+
     def create_document_service(self, document_id: str
                                 ) -> NetworkDocumentService:
         return NetworkDocumentService(self.base_url, self.tenant_id,
                                       document_id, self.token_provider,
                                       mux_pool=self.mux_pool,
-                                      session_cache=self.session_cache)
+                                      session_cache=self.session_cache,
+                                      historian_url=self.historian_url)
 
     def create_document(self, document_id: Optional[str] = None,
                         summary: Optional[SummaryTree] = None) -> str:

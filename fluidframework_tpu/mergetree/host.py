@@ -183,7 +183,13 @@ class PayloadTable:
     def free(self, op_id: int) -> None:
         """Release a payload the caller proved unreferenced (e.g. a
         superseded fold generation). A stale read after free returns
-        None and crashes loudly rather than resolving wrong content."""
+        None and crashes loudly rather than resolving wrong content.
+        Double-free crashes loudly too: a duplicate entry in free_ids
+        would let _add hand ONE slot to TWO payloads — silent cross-lane
+        text corruption, the worst possible failure mode for the
+        fold-generation/block-ref id-ownership dance."""
+        if self.entries[op_id] is None:  # not assert: must survive -O
+            raise ValueError(f"double free of payload op_id {op_id}")
         self.entries[op_id] = None
         self.free_ids.append(op_id)
 

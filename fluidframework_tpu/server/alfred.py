@@ -41,13 +41,16 @@ import hmac
 import json
 import re
 import threading
+import urllib.error
 import urllib.parse
+import urllib.request
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
 from ..protocol.summary import summary_tree_from_dict, summary_tree_to_dict
 from .auth import AuthError, TenantManager
+from .historian import TIER_HEADER, git_object_to_wire, notify_summary_commit
 from .local_server import LocalServer
 from .websocket import WebSocketClosed, upgrade_server_socket
 from .wire import (
@@ -66,11 +69,22 @@ class AlfredService:
                  require_auth: bool = True,
                  partitions: int = 1,
                  admin_key: Optional[str] = None,
-                 config=None):
+                 config=None,
+                 historian_url: Optional[str] = None):
         """config: the nconf-style provider handed to each tenant core
-        (throttling, op-size ceiling, deli checkpoint/eviction knobs)."""
+        (throttling, op-size ceiling, deli checkpoint/eviction knobs).
+
+        historian_url: a standalone summary-cache tier
+        (server/historian.py). When set, latest-summary reads delegate to
+        it (unless the request came FROM the tier — TIER_HEADER marks
+        those) and summary commits notify it for invalidation + warm
+        prefetch. When unset or unreachable, git routes serve straight
+        from the GitStore — the degradation path."""
         self.tenants = tenants or TenantManager()
         self.config = config
+        self.historian_url = historian_url
+        if self.historian_url is None and config is not None:
+            self.historian_url = config.get("historian.url")
         self.require_auth = require_auth
         # Riddler's tenant CRUD/key routes are operator-only (the reference
         # deploys riddler on an internal network); when auth is on they
@@ -119,13 +133,35 @@ class AlfredService:
     def url(self) -> str:
         return f"http://{self.host}:{self.port}"
 
+    def attach_historian(self, historian_url: Optional[str]) -> None:
+        """Point this alfred at a summary-cache tier after construction
+        (the tier usually needs alfred's URL first, so the wiring is
+        two-phase). Existing cores gain the commit notifier too."""
+        self.historian_url = historian_url
+        if historian_url:
+            with self._cores_lock:
+                for tenant_id, core in self._cores.items():
+                    self._register_commit_notifier(core, tenant_id)
+
+    def _register_commit_notifier(self, core: LocalServer,
+                                  tenant_id: str) -> None:
+        # Scribe-acked commits advance refs in-process; the cache tier
+        # must hear about them (invalidate + warm prefetch).
+        core.summary_commit_listeners.append(
+            lambda doc_id, sha, t=tenant_id:
+            self.historian_url and notify_summary_commit(
+                self.historian_url, t, doc_id, sha))
+
     def core(self, tenant_id: str) -> LocalServer:
         """The per-tenant ordering core (lazily created)."""
         with self._cores_lock:
             if tenant_id not in self._cores:
-                self._cores[tenant_id] = LocalServer(
+                core = LocalServer(
                     tenant_id=tenant_id, partitions=self.partitions,
                     config=self.config)
+                if self.historian_url:
+                    self._register_commit_notifier(core, tenant_id)
+                self._cores[tenant_id] = core
             return self._cores[tenant_id]
 
     # -- auth --------------------------------------------------------------
@@ -197,6 +233,23 @@ class AlfredService:
         ("GET", re.compile(
             r"^/repos/(?P<tenant>[^/]+)/(?P<doc>[^/]+)/git/commits$"),
          "_r_commits"),
+        # gitrest object surface (what the historian tier proxies).
+        ("GET", re.compile(
+            r"^/repos/(?P<tenant>[^/]+)/(?P<doc>[^/]+)"
+            r"/git/objects/(?P<sha>[^/]+)$"),
+         "_r_git_object"),
+        ("GET", re.compile(
+            r"^/repos/(?P<tenant>[^/]+)/(?P<doc>[^/]+)"
+            r"/git/blobs/(?P<sha>[^/]+)$"),
+         "_r_git_blob"),
+        ("GET", re.compile(
+            r"^/repos/(?P<tenant>[^/]+)/(?P<doc>[^/]+)"
+            r"/git/trees/(?P<sha>[^/]+)$"),
+         "_r_git_tree"),
+        ("GET", re.compile(
+            r"^/repos/(?P<tenant>[^/]+)/(?P<doc>[^/]+)"
+            r"/git/refs/(?P<ref>.+)$"),
+         "_r_git_ref"),
     ]
 
     def _handle_rest(self, handler, method: str) -> None:
@@ -409,12 +462,20 @@ class AlfredService:
             return
         sha = store.write_summary(tree, base_commit=body.get("parent"),
                                   advance_ref=initial)
+        if self.historian_url and not handler.headers.get(TIER_HEADER):
+            # Direct upload bypassed the cache tier: tell it the commit
+            # landed so a stale latest pointer never outlives this write
+            # (and the new tree warms ahead of the scribe ack).
+            notify_summary_commit(self.historian_url, tenant, doc, sha)
         _send_json(handler, 201, {"sha": sha})
 
     def _r_latest_summary(self, handler, params, tenant: str,
                           doc: str) -> None:
         claims = self._check_auth(handler, tenant, doc, "doc:read")
         if claims is None:
+            return
+        if (self.historian_url and not handler.headers.get(TIER_HEADER)
+                and self._delegate_latest(handler, params, tenant, doc)):
             return
         core = self.core(tenant)
         tree = core.historian.read_summary(tenant, doc,
@@ -423,6 +484,32 @@ class AlfredService:
             _send_json(handler, 404, {"error": "no summary"})
             return
         _send_json(handler, 200, {"summary": summary_tree_to_dict(tree)})
+
+    def _delegate_latest(self, handler, params, tenant: str,
+                         doc: str) -> bool:
+        """Serve the latest-summary read through the historian tier.
+        Returns True when a response was sent; False (historian down)
+        lets the caller fall back to the direct GitStore path."""
+        path = (f"/repos/{urllib.parse.quote(tenant, safe='')}"
+                f"/{urllib.parse.quote(doc, safe='')}/summaries/latest")
+        if "sha" in params:
+            path += "?sha=" + urllib.parse.quote(params["sha"], safe="")
+        req = urllib.request.Request(self.historian_url.rstrip("/") + path)
+        auth = handler.headers.get("Authorization")
+        if auth:
+            req.add_header("Authorization", auth)
+        try:
+            with urllib.request.urlopen(req, timeout=30.0) as resp:
+                _send_json(handler, resp.status,
+                           json.loads(resp.read() or b"{}"))
+            return True
+        except urllib.error.HTTPError as exc:
+            if exc.code == 503:
+                return False  # tier's own upstream is down: serve direct
+            _send_json(handler, exc.code, _error_payload(exc))
+            return True
+        except OSError:
+            return False
 
     def _r_versions(self, handler, params, tenant: str, doc: str) -> None:
         claims = self._check_auth(handler, tenant, doc, "doc:read")
@@ -443,6 +530,48 @@ class AlfredService:
             {"sha": c.sha, "tree": c.tree_sha, "parents": c.parents,
              "message": c.message, "timestamp": c.timestamp}
             for c in commits]})
+
+    # -- gitrest object surface (consumed by server/historian.py) ----------
+    def _r_git_object(self, handler, params, tenant: str, doc: str,
+                      sha: str) -> None:
+        self._send_git_object(handler, tenant, doc, sha, kind=None)
+
+    def _r_git_blob(self, handler, params, tenant: str, doc: str,
+                    sha: str) -> None:
+        self._send_git_object(handler, tenant, doc, sha, kind="blob")
+
+    def _r_git_tree(self, handler, params, tenant: str, doc: str,
+                    sha: str) -> None:
+        self._send_git_object(handler, tenant, doc, sha, kind="tree")
+
+    def _send_git_object(self, handler, tenant: str, doc: str, sha: str,
+                         kind: Optional[str]) -> None:
+        claims = self._check_auth(handler, tenant, doc, "doc:read")
+        if claims is None:
+            return
+        obj = self.core(tenant).storage(doc).get(sha)
+        if obj is None:
+            _send_json(handler, 404,
+                       {"error": f"no object {sha!r}"})
+            return
+        wire = git_object_to_wire(obj)
+        if kind is not None and wire.get("kind") != kind:
+            _send_json(handler, 404,
+                       {"error": f"object {sha!r} is a "
+                                 f"{wire.get('kind')}, not a {kind}"})
+            return
+        _send_json(handler, 200, wire)
+
+    def _r_git_ref(self, handler, params, tenant: str, doc: str,
+                   ref: str) -> None:
+        claims = self._check_auth(handler, tenant, doc, "doc:read")
+        if claims is None:
+            return
+        sha = self.core(tenant).storage(doc).get_ref(ref)
+        if sha is None:
+            _send_json(handler, 404, {"error": f"no ref {ref!r}"})
+            return
+        _send_json(handler, 200, {"ref": ref, "sha": sha})
 
     # -- websocket delta stream -------------------------------------------
     def _handle_websocket(self, handler) -> None:
@@ -654,6 +783,13 @@ def _oversized_of(messages, limit: int):
             return Nack(m, -1, NackContent(
                 NACK_TOO_LARGE, f"op exceeds {limit} bytes"))
     return None
+
+
+def _error_payload(exc: urllib.error.HTTPError) -> dict:
+    try:
+        return json.loads(exc.read() or b"{}")
+    except (ValueError, OSError):
+        return {"error": f"historian HTTP {exc.code}"}
 
 
 def _send_json(handler, status: int, payload: dict) -> None:

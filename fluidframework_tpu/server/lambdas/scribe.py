@@ -26,15 +26,19 @@ class ScribeLambda(IPartitionLambda):
                  tenant_id: str,
                  send_system: Callable[[str, DocumentMessage], None],
                  checkpoints: Optional[Collection] = None,
-                 fresh_log: bool = False):
+                 fresh_log: bool = False,
+                 on_commit: Optional[Callable[[str, str], None]] = None):
         """send_system(document_id, message) routes summaryAck/Nack back
         through deli for sequencing. fresh_log: see DeliLambda — True when
         consuming a new MessageLog with checkpoints handed over from a
-        previous core (takeover), False for same-log crash-restart."""
+        previous core (takeover), False for same-log crash-restart.
+        on_commit(document_id, commit_sha): fired after a validated
+        summary advances the ref — cache-tier invalidation rides this."""
         self.context = context
         self.historian = historian
         self.tenant_id = tenant_id
         self.send_system = send_system
+        self.on_commit = on_commit
         self.checkpoints = checkpoints
         self.handlers: Dict[str, ProtocolOpHandler] = {}
         self.log_offsets: Dict[str, int] = {}
@@ -109,6 +113,11 @@ class ScribeLambda(IPartitionLambda):
             return
         # Valid: advance the main ref and ack with the commit handle.
         store.set_ref("main", commit_sha)
+        if self.on_commit is not None:
+            try:
+                self.on_commit(doc_id, commit_sha)
+            except Exception:  # noqa: BLE001 — observers never break scribe
+                pass
         self.send_system(doc_id, DocumentMessage(
             client_sequence_number=0,
             reference_sequence_number=sequenced.sequence_number,

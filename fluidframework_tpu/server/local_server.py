@@ -213,6 +213,11 @@ class LocalServer:
         self.deli_checkpoints = self.db.collection("deliCheckpoints")
         self.scribe_checkpoints = self.db.collection("scribeCheckpoints")
         self._connections: Dict[str, List[Connection]] = {}
+        # Fired after scribe validates + commits a summary (advancing the
+        # ref): (document_id, commit_sha). The historian cache tier hooks
+        # in here for write-through invalidation + warm prefetch
+        # (server/historian.py; alfred registers the notifier).
+        self.summary_commit_listeners: List[Callable[[str, str], None]] = []
         # Broadcaster room membership lives here (not in the lambda) so it
         # survives lambda crash-restarts; the lambda reads it by reference.
         self._rooms: Dict[str, List] = {}
@@ -250,7 +255,9 @@ class LocalServer:
             lambda ctx: ScribeLambda(ctx, self.historian, tenant_id,
                                      send_system=self._send_system,
                                      checkpoints=self.scribe_checkpoints,
-                                     fresh_log=True), offload=True))
+                                     fresh_log=True,
+                                     on_commit=self._on_summary_commit),
+            offload=True))
         self._broadcaster_mgr = self.runner.add(PartitionManager(
             self.log, "broadcaster", DELTAS_TOPIC,
             lambda ctx: BroadcasterLambda(ctx, rooms=self._rooms)))
@@ -279,6 +286,13 @@ class LocalServer:
         for conn in self._connections.get(doc_id, []):
             if conn.client_id == client_id and conn.connected:
                 conn.emit("nack", nack)
+
+    def _on_summary_commit(self, doc_id: str, commit_sha: str) -> None:
+        for listener in list(self.summary_commit_listeners):
+            try:
+                listener(doc_id, commit_sha)
+            except Exception:  # noqa: BLE001 — observers never break scribe
+                pass
 
     def _send_system(self, doc_id: str, message: DocumentMessage) -> None:
         self.log.send(RAW_TOPIC, doc_id, Boxcar(

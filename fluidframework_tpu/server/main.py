@@ -7,6 +7,7 @@ broker (server/docker-compose.yml:2-55; `kafka-service/index.js <name>
     python -m fluidframework_tpu.server.main broker  --config deploy/config.json
     python -m fluidframework_tpu.server.main worker  --config deploy/config.json
     python -m fluidframework_tpu.server.main worker  --stages scriptorium,scribe ...
+    python -m fluidframework_tpu.server.main historian --config deploy/config.json
 
 - `broker` hosts the ordered log (pure-Python or the native C++ engine)
   over gRPC (server/log_service.py) — the Kafka role.
@@ -15,6 +16,10 @@ broker (server/docker-compose.yml:2-55; `kafka-service/index.js <name>
   store (server/durable.py) — the per-lambda service role. `--stages
   tpu-deli` swaps the scalar sequencer for the device-batched
   TpuSequencerLambda (server/tpu_sequencer.py).
+- `historian` runs the standalone summary-cache tier
+  (server/historian.py) over the shared git directory (or proxying an
+  alfred URL via `historian.upstream`); scribe workers notify it on
+  summary commits when `historian.url` points at it.
 
 Deli nacks publish to the `nacks` topic (the front door consumes it and
 routes to the offending client's socket); sequenced deltas flow through
@@ -44,6 +49,14 @@ DEFAULT_CONFIG = {
     "worker": {"stages": ["deli", "scriptorium", "scribe", "copier"],
                "poll_ms": 10, "tenant": "local"},
     "deli": {"checkpointBatchSize": 8, "checkpointTimeIntervalMsec": 500},
+    # The summary-cache tier (server/historian.py). `historian` service:
+    # host/port to serve on; upstream (alfred URL) switches store mode ->
+    # proxy mode; monitorPort exposes /health+/metrics with the cache
+    # counters. Workers: a non-empty `url` makes scribe notify the tier
+    # on every summary commit (write-through invalidation).
+    "historian": {"host": "127.0.0.1", "port": 7081, "upstream": None,
+                  "url": None, "refTtlS": 2.0,
+                  "maxBytes": 256 * 1024 * 1024, "monitorPort": 0},
 }
 
 
@@ -99,6 +112,37 @@ def run_broker(cfg: dict) -> None:
     print(f"broker: serving ordered log on {server.address}", flush=True)
     _wait_for_signal()
     server.stop()
+
+
+def run_historian(cfg: dict) -> None:
+    from .historian import HistorianService
+
+    hcfg = cfg.get("historian", {})
+    store = None
+    upstream = hcfg.get("upstream")
+    if not upstream:
+        from .durable import FileHistorian
+        store = FileHistorian(cfg["storage"]["git"])
+    service = HistorianService(
+        upstream_url=upstream, store=store,
+        host=hcfg.get("host", "127.0.0.1"), port=hcfg.get("port", 7081),
+        max_bytes=hcfg.get("maxBytes", 256 * 1024 * 1024),
+        ref_ttl_s=hcfg.get("refTtlS", 2.0))
+    service.start()
+    print(f"historian: serving cache tier on {service.url} "
+          f"({'proxy' if upstream else 'store'} mode)", flush=True)
+    monitor = None
+    if hcfg.get("monitorPort"):
+        from .monitor import ServiceMonitor
+        monitor = ServiceMonitor(host=hcfg.get("host", "127.0.0.1"),
+                                 port=hcfg["monitorPort"])
+        monitor.watch_historian("historian", service)
+        monitor.start()
+        print(f"historian: monitor on {monitor.url}", flush=True)
+    _wait_for_signal()
+    if monitor is not None:
+        monitor.stop()
+    service.stop()
 
 
 def build_worker(cfg: dict, stages: List[str]):
@@ -178,12 +222,24 @@ def build_worker(cfg: dict, stages: List[str]):
                 log, "scriptorium", DELTAS_TOPIC,
                 lambda ctx: ScriptoriumLambda(ctx, deltas)))
         elif stage == "scribe":
+            # A configured historian tier hears about every commit the
+            # scribe acks (write-through invalidation + warm prefetch);
+            # without one (or with it down) the notify is a no-op.
+            historian_url = cfg.get("historian", {}).get("url")
+            on_commit = None
+            if historian_url:
+                from .historian import notify_summary_commit
+
+                def on_commit(doc_id, sha, _url=historian_url,
+                              _tenant=tenant):
+                    notify_summary_commit(_url, _tenant, doc_id, sha)
+
             runner.add(PartitionManager(
                 log, "scribe", DELTAS_TOPIC,
-                lambda ctx: ScribeLambda(ctx, historian, tenant,
-                                         send_system=send_system,
-                                         checkpoints=scribe_ckpt,
-                                         fresh_log=False)))
+                lambda ctx, _oc=on_commit: ScribeLambda(
+                    ctx, historian, tenant, send_system=send_system,
+                    checkpoints=scribe_ckpt, fresh_log=False,
+                    on_commit=_oc)))
         elif stage == "copier":
             runner.add(PartitionManager(
                 log, "copier", RAW_TOPIC,
@@ -243,7 +299,7 @@ def main(argv=None) -> None:
     parser = argparse.ArgumentParser(
         prog="fluidframework_tpu.server.main",
         description="Run one service of the ordering pipeline")
-    parser.add_argument("service", choices=["broker", "worker"])
+    parser.add_argument("service", choices=["broker", "worker", "historian"])
     parser.add_argument("--config", default=None,
                         help="path to deploy config JSON")
     parser.add_argument("--stages", default=None,
@@ -252,6 +308,8 @@ def main(argv=None) -> None:
     cfg = load_config(args.config)
     if args.service == "broker":
         run_broker(cfg)
+    elif args.service == "historian":
+        run_historian(cfg)
     else:
         stages = (args.stages.split(",") if args.stages
                   else cfg["worker"]["stages"])

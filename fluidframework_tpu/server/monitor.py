@@ -94,6 +94,12 @@ class ServiceMonitor:
 
         self.add_probe(name, probe)
 
+    def watch_historian(self, name: str, historian) -> None:
+        """Probe over a historian cache tier (server/historian.py
+        HistorianTier or HistorianService): hit/miss/bytes/evictions
+        counters plus hit rate, live at request time."""
+        self.add_probe(name, historian.stats)
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ServiceMonitor":
         self._thread = threading.Thread(target=self._httpd.serve_forever,

@@ -37,6 +37,12 @@ class Tinylicious:
     def admin_key(self) -> str:
         return self.service.admin_key
 
+    def attach_historian(self, historian_url: Optional[str]) -> None:
+        """Wire a summary-cache tier (server/historian.py) in front of
+        this server's git storage: latest-summary reads delegate to it
+        and scribe-acked commits notify it."""
+        self.service.attach_historian(historian_url)
+
     def start(self) -> "Tinylicious":
         self.service.start()
         return self

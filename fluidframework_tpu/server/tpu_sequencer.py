@@ -453,6 +453,11 @@ class MergeLaneStore:
             bucket.state = bucket.state._replace(
                 origin_op=jnp.asarray(renumber(op_np)),
                 anno=jnp.asarray(renumber(an_np)))
+            if bucket.placer is not None:
+                # jnp.asarray built host-resident replicated columns,
+                # dropping the dp-mesh placement: re-place so major
+                # collection preserves sharding (grow() does the same).
+                bucket.state = bucket.placer(bucket.state)
         remap = {old: new for new, old in enumerate(order)}
         self._fold_payloads = {
             key: sorted(remap[i] for i in ids if i in remap)
@@ -1630,6 +1635,10 @@ class LwwLaneStore:
             for old, new in remap.items():
                 out[vals == old] = new
             bucket.state = bucket.state._replace(val=jnp.asarray(out))
+            if bucket.placer is not None:
+                # Same dp-mesh rule as the merge side's major collection:
+                # jnp.asarray dropped the placement; re-place.
+                bucket.state = bucket.placer(bucket.state)
         self.windows_since_value_compact = 0
 
     # -- reads (tests / snapshots) -----------------------------------------

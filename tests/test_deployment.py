@@ -8,6 +8,7 @@ import socket
 import subprocess
 import sys
 import time
+import urllib.request
 
 import pytest
 
@@ -40,18 +41,27 @@ class TestMultiProcessPipeline:
     def test_broker_and_worker_processes_sequence_and_persist(
             self, tmp_path, sequencer):
         port = _free_port()
+        hist_port = _free_port()
         cfg = {
             "broker": {"host": "127.0.0.1", "port": port, "partitions": 1},
             "storage": {"db": str(tmp_path / "fluid.sqlite"),
                         "git": str(tmp_path / "git")},
-            "worker": {"stages": [sequencer, "scriptorium", "copier"],
+            "worker": {"stages": [sequencer, "scriptorium", "scribe",
+                                  "copier"],
                        "poll_ms": 5, "tenant": "local"},
+            # The cache tier rides the same topology: its own process in
+            # store mode over the shared git dir, with scribe notifying
+            # it on summary commits (historian.url).
+            "historian": {"host": "127.0.0.1", "port": hist_port,
+                          "url": f"http://127.0.0.1:{hist_port}"},
         }
         cfg_path = tmp_path / "config.json"
         cfg_path.write_text(json.dumps(cfg))
 
         broker = _spawn(["broker", "--config", str(cfg_path)], tmp_path)
-        procs = [broker]
+        historian = _spawn(["historian", "--config", str(cfg_path)],
+                           tmp_path)
+        procs = [broker, historian]
         try:
             # Wait for the broker socket.
             deadline = time.time() + 60
@@ -122,6 +132,22 @@ class TestMultiProcessPipeline:
                         worker.stdout.read().decode()[-2000:])
                 time.sleep(0.2)
             assert len(raw) >= 6, f"only {len(raw)} raw messages copied"
+            # The historian tier is alive in the topology and serving.
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                try:
+                    ping = json.loads(urllib.request.urlopen(
+                        f"http://127.0.0.1:{hist_port}/api/v1/ping",
+                        timeout=2).read())
+                    break
+                except OSError:
+                    if historian.poll() is not None:
+                        raise AssertionError(
+                            historian.stdout.read().decode()[-2000:])
+                    time.sleep(0.2)
+            else:
+                raise AssertionError("historian never listened")
+            assert ping.get("service") == "historian"
         finally:
             for p in procs:
                 p.terminate()
@@ -130,6 +156,85 @@ class TestMultiProcessPipeline:
                     p.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     p.kill()
+
+
+class TestHistorianProcess:
+    """The standalone cache tier as a real OS process over the shared git
+    directory (store mode), plus the degradation contract when it dies."""
+
+    def test_store_mode_serves_cached_summaries_and_degrades(
+            self, tmp_path):
+        from fluidframework_tpu.loader.drivers.routerlicious import (
+            RestWrapper,
+        )
+        from fluidframework_tpu.protocol.summary import SummaryTree
+        from fluidframework_tpu.server.durable import FileHistorian
+        from fluidframework_tpu.server.historian import (
+            notify_summary_commit,
+        )
+
+        git_dir = str(tmp_path / "git")
+        # The "gitrest" role: a summary already persisted to the shared
+        # directory (as a scribe worker would have written it).
+        writer = FileHistorian(git_dir)
+        tree = SummaryTree()
+        tree.add_tree("default").add_blob(
+            "header", json.dumps({"text": "durable"}))
+        writer.store("local", "doc").write_summary(tree, advance_ref=True)
+
+        hist_port = _free_port()
+        cfg = {
+            "storage": {"db": str(tmp_path / "fluid.sqlite"),
+                        "git": git_dir},
+            "historian": {"host": "127.0.0.1", "port": hist_port},
+        }
+        cfg_path = tmp_path / "config.json"
+        cfg_path.write_text(json.dumps(cfg))
+        historian = _spawn(["historian", "--config", str(cfg_path)],
+                           tmp_path)
+        url = f"http://127.0.0.1:{hist_port}"
+        try:
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                try:
+                    socket.create_connection(("127.0.0.1", hist_port),
+                                             timeout=0.3).close()
+                    break
+                except OSError:
+                    if historian.poll() is not None:
+                        raise AssertionError(
+                            historian.stdout.read().decode()[-2000:])
+                    time.sleep(0.1)
+            else:
+                raise AssertionError("historian never listened")
+
+            rest = RestWrapper(url)
+            first = rest.get("/repos/local/doc/summaries/latest")["summary"]
+            assert first["entries"]["default"]["entries"]["header"][
+                "content"] == json.dumps({"text": "durable"})
+            second = rest.get("/repos/local/doc/summaries/latest")["summary"]
+            assert second == first
+            stats = rest.get("/historian/stats")
+            assert stats["objects"]["hits"] > 0  # second read was warm
+            # Cross-process commit notification (what a scribe worker
+            # with historian.url configured sends) lands cleanly.
+            assert notify_summary_commit(url, "local", "doc") is True
+            assert rest.get("/historian/stats")["refs"][
+                "invalidations"] >= 1
+        finally:
+            historian.terminate()
+            try:
+                historian.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                historian.kill()
+        # Degradation: the tier is dead — notifications are best-effort
+        # no-ops (the pipeline must not care) and direct GitStore reads
+        # keep serving the same bytes.
+        assert notify_summary_commit(url, "local", "doc") is False
+        direct = FileHistorian(git_dir).read_summary("local", "doc")
+        assert json.loads(
+            direct.entries["default"].entries["header"].content
+        ) == {"text": "durable"}
 
 
 class TestBrokerRestart:

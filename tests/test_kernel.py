@@ -95,6 +95,23 @@ def flatten_runs(segs):
     return out
 
 
+class TestPayloadTable:
+    def test_double_free_crashes_loudly(self):
+        """Freeing the same op_id twice must raise, not silently put a
+        duplicate into free_ids (one slot handed to two payloads =
+        cross-lane text corruption)."""
+        table = PayloadTable()
+        op_id = table.add_insert(0, "hello")
+        table.free(op_id)
+        with pytest.raises(ValueError):
+            table.free(op_id)
+        # The slot recycles exactly once: two adds get two DISTINCT ids.
+        a = table.add_insert(0, "a")
+        b = table.add_insert(0, "b")
+        assert a != b
+        assert table.get(a).text == "a" and table.get(b).text == "b"
+
+
 class TestKernelBasics:
     def test_insert_sequence(self):
         ops = [("insert", 0, "hello", 0, 1, 1),

@@ -211,6 +211,58 @@ class TestMeshServing:
                          if e.get("removedSeq") is None)
         assert joined == t.get_text()
 
+    def test_payload_collection_preserves_sharding(self):
+        """Major collection (compact_payload_ids) rebuilds the origin_op/
+        anno planes from host-built arrays; on a dp mesh it must re-apply
+        the bucket's placer so the renumbered state keeps its sharding —
+        and the renumbered ids must still resolve the exact text."""
+        mesh = make_mesh(sp=1)
+        server = TpuLocalServer(mesh=mesh)
+        loader, c, ds = make_doc(server, "mc")
+        t = ds.create_channel("text", SharedString.TYPE)
+        c.attach()
+        for i in range(60):
+            t.insert_text(t.get_length(), f"w{i} ")
+        store = server.sequencer().merge
+        b, lane = store.where[("mc", "default", "text")]
+        bucket = store.buckets[b]
+        assert len(bucket.state.origin_op.sharding.device_set) == 8
+        store.payload_compact_min_entries = 0
+        assert store.compact_payload_ids() is True
+        # The collection REALLY renumbered on sharded state and the
+        # planes still span the mesh.
+        assert store.payload_compactions >= 1
+        assert len(bucket.state.origin_op.sharding.device_set) == 8
+        assert len(bucket.state.anno.sharding.device_set) == 8
+        # Renumbered ids resolve: materialization and further edits work.
+        assert server.sequencer().channel_text(
+            "mc", "default", "text") == t.get_text()
+        t.insert_text(0, ">>")
+        assert server.sequencer().channel_text(
+            "mc", "default", "text") == t.get_text()
+
+    def test_lww_value_compaction_preserves_sharding(self):
+        """compact_values (the LWW major collection) renumbers the val
+        plane from a host-built array — it must re-place on a dp mesh,
+        same rule as the merge side's compact_payload_ids."""
+        mesh = make_mesh(sp=1)
+        server = TpuLocalServer(mesh=mesh)
+        loader, c, ds = make_doc(server, "mv")
+        m = ds.create_channel("meta", SharedMap.TYPE)
+        c.attach()
+        for i in range(30):
+            m.set("k", f"v{i}")  # 29 superseded values to reclaim
+        lww = server.sequencer().lww
+        b, lane = lww.where[("mv", "default", "meta")]
+        assert len(lww.buckets[b].state.val.sharding.device_set) == 8
+        lww.compact_values()
+        assert len(lww.buckets[b].state.val.sharding.device_set) == 8
+        snap = server.sequencer().channel_snapshot("mv", "default", "meta")
+        assert snap["entries"]["k"] == "v29"
+        m.set("k2", "post")  # lanes still editable after re-place
+        assert server.sequencer().channel_snapshot(
+            "mv", "default", "meta")["entries"]["k2"] == "post"
+
     def test_host_fold_on_sharded_lanes(self):
         """The serving zamboni pack must work when lane states are
         sharded over the dp mesh: the fold's device_get slices, host

@@ -675,6 +675,8 @@ class TestHostFold:
         store.apply({key: [b.insert_text(3, "!", 50, 2, seq, msn=50)]})
         text = store.text(key)
         assert text.startswith("ABF!"), text
+
+    def test_collection_defers_during_chunked_apply(self):
         """A single apply() with a stream longer than the largest
         T-bucket chunks into successive windows whose compact ticks
         could hit the collection cadence — renumbering then would
