@@ -1,10 +1,22 @@
 # Repeatable entry points (VERDICT r4 #8: the randomized-evidence ritual
 # must be a one-liner anyone can repeat).
 
-.PHONY: test soak bench dryrun record-corpus historian-smoke
+.PHONY: test soak bench dryrun record-corpus historian-smoke \
+	lint-analysis check
 
 test:
 	python -m pytest tests/ -q
+
+# fluidlint: the AST-based JAX-kernel & server-concurrency analyzer
+# (fluidframework_tpu/analysis/, docs/static_analysis.md). Exits non-zero
+# on any violation that is neither suppressed inline nor baselined; the
+# last output line is the machine-readable trend summary
+# {"violations": N, "baselined": M}.
+lint-analysis:
+	python -m fluidframework_tpu.analysis fluidframework_tpu/
+
+# The pre-merge gate: static analysis + the full test suite.
+check: lint-analysis test
 
 # The round-end randomized-evidence ritual: 50-trial soaks over every
 # differential surface (bulk catch-up, serving fast path, matrix/

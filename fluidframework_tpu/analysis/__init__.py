@@ -1,0 +1,32 @@
+"""fluidlint: AST-based static analysis for the fluidframework_tpu tree.
+
+Two rule families guard the two silent failure modes of the system
+(see docs/static_analysis.md):
+
+* JAX/TPU kernel hygiene (JX*): tracing hazards inside jit-decorated
+  functions — Python branching on traced values, host syncs, unrolled
+  jnp loops, mutable-global capture, dtype drift, missing donation.
+* Server concurrency/robustness (CC*): await-under-lock, blocking calls
+  in async code, swallowed exceptions on op-pipeline paths, listener
+  registration without a removal path, mutable default arguments.
+
+Run it with ``python -m fluidframework_tpu.analysis [paths]``.  Findings
+are suppressed inline with ``# fluidlint: disable=RULE — reason`` or
+accepted in the committed baseline (``analysis/baseline.json``); anything
+else fails the run, which `make lint-analysis` and
+tests/test_static_analysis.py turn into a hard CI gate.
+"""
+
+from .engine import AnalysisResult, ModuleContext, Violation, analyze_paths, analyze_source
+from .registry import RULES, Rule, all_rules, get_rule, rule
+from .baseline import Baseline, DEFAULT_BASELINE_PATH
+
+# Importing the rule modules registers every rule with the registry.
+from . import jax_rules as _jax_rules  # noqa: F401
+from . import concurrency_rules as _concurrency_rules  # noqa: F401
+
+__all__ = [
+    "AnalysisResult", "Baseline", "DEFAULT_BASELINE_PATH", "ModuleContext",
+    "RULES", "Rule", "Violation", "all_rules", "analyze_paths",
+    "analyze_source", "get_rule", "rule",
+]

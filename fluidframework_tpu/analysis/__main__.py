@@ -1,0 +1,96 @@
+"""CLI: ``python -m fluidframework_tpu.analysis [paths]``.
+
+Exit status 0 iff every finding is suppressed inline or baselined.
+The last stdout line is always the one-line JSON summary.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .baseline import Baseline, DEFAULT_BASELINE_PATH
+from .engine import REPO_ROOT, analyze_paths
+from .registry import RULES, all_rules
+from .reporters import render_human, render_json
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m fluidframework_tpu.analysis",
+        description="fluidlint: JAX-kernel & server-concurrency analyzer")
+    parser.add_argument("paths", nargs="*",
+                        default=[str(REPO_ROOT / "fluidframework_tpu")],
+                        help="files/dirs to analyze (default: the package)")
+    parser.add_argument("--format", choices=("human", "json"),
+                        default="human")
+    parser.add_argument("--baseline", type=Path,
+                        default=DEFAULT_BASELINE_PATH,
+                        help="baseline file (default: analysis/baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, ignoring the baseline")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="accept all current findings into the baseline "
+                             "file and exit 0")
+    parser.add_argument("--show-baselined", action="store_true",
+                        help="also list baselined findings (human format)")
+    parser.add_argument("--rule", action="append", default=[],
+                        metavar="RULE_ID", help="run only these rule ids")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.id:22s} [{r.family}] {r.summary}")
+        return 0
+
+    unknown = set(args.rule) - set(RULES)
+    if unknown:
+        parser.error(f"unknown rule id(s): {', '.join(sorted(unknown))} "
+                     f"(see --list-rules)")
+    missing = [p for p in args.paths if not Path(p).exists()]
+    if missing:
+        # A typo'd path must never turn the hard gate into a vacuous
+        # pass that still prints a healthy-looking summary line.
+        parser.error(f"path(s) do not exist: {', '.join(missing)}")
+
+    baseline = None if args.no_baseline else Baseline.load(args.baseline)
+    result = analyze_paths(args.paths, baseline=baseline, only=args.rule)
+
+    if args.write_baseline:
+        prior = baseline if baseline is not None \
+            else Baseline.load(args.baseline)
+        current = result.violations + result.baselined
+        merged = prior.updated_with(current)
+        # Entries outside this run's scope (file not analyzed, or rule
+        # filtered out by --rule) survive untouched — a scoped
+        # --write-baseline must never discard curated acceptances; only
+        # a full default run retires stale entries.
+        from .engine import _rel_path, iter_python_files
+        analyzed = {_rel_path(f) for f in iter_python_files(args.paths)}
+        active = set(args.rule) or set(RULES)
+        merged.entries.extend(
+            e for e in prior.entries
+            if e["path"] not in analyzed or e["rule"] not in active)
+        merged = Baseline(merged.entries)
+        merged.save(args.baseline)
+        print(f"wrote {len(merged)} entries to {args.baseline} "
+              f"({len(current)} from this run)")
+        return 0
+
+    if result.files == 0:
+        print("error: no Python files matched the given paths; "
+              "refusing to report a vacuous pass", file=sys.stderr)
+        return 2
+
+    if args.format == "json":
+        render_json(result, sys.stdout)
+    else:
+        render_human(result, sys.stdout,
+                     show_baselined=args.show_baselined)
+    return 1 if result.violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,193 @@
+"""CC* rules: server concurrency and robustness.
+
+These run over the whole tree but are written against the failure modes
+of the server/ and loader/ pipelines: a swallowed exception in a lambda
+op path loses ops silently, a blocking call in async code stalls every
+document sharing the loop, and a listener registered without a removal
+path pins a document's worth of state for the process lifetime.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set
+
+from .engine import ModuleContext, Violation, _dotted
+from .registry import rule
+
+
+def _lockish(expr: ast.AST) -> bool:
+    """Does this context-manager expression look like a lock? Matches
+    bare names/attributes containing 'lock'/'mutex'/'sem' and calls on
+    them (e.g. ``self._lock``, ``lock.acquire()``)."""
+    target = expr
+    if isinstance(target, ast.Call):
+        target = target.func
+    dotted = _dotted(target).lower()
+    last = dotted.rsplit(".", 1)[-1].lstrip("_")
+    return any(tok in last for tok in ("lock", "mutex", "semaphore"))
+
+
+@rule("AWAIT_IN_LOCK",
+      "await while holding a lock",
+      family="concurrency",
+      rationale="Awaiting under a held lock serializes every coroutine "
+                "behind the slowest holder — and deadlocks outright when "
+                "the awaited task needs the same lock. Narrow the critical "
+                "section to the state mutation; await outside it.")
+def await_in_lock(ctx: ModuleContext) -> Iterator[Violation]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        if not any(_lockish(item.context_expr) for item in node.items):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Await):
+                yield ctx.violation(
+                    "AWAIT_IN_LOCK", sub,
+                    "await while holding a lock: the lock is held across "
+                    "the suspension point")
+
+
+_BLOCKING_CALLS = {
+    "time.sleep": "time.sleep blocks the event loop; use asyncio.sleep",
+    "open": "sync file IO blocks the event loop; read via a thread "
+            "(asyncio.to_thread) or an async file API",
+    "subprocess.run": "subprocess.run blocks the event loop; use "
+                      "asyncio.create_subprocess_exec",
+    "subprocess.check_output": "blocking subprocess call in async code",
+    "subprocess.call": "blocking subprocess call in async code",
+    "socket.create_connection": "blocking connect in async code",
+}
+
+
+@rule("BLOCKING_IN_ASYNC",
+      "Blocking call (time.sleep / sync IO / subprocess) inside async def",
+      family="concurrency",
+      rationale="One blocking call inside a coroutine stalls the whole "
+                "event loop — every other document's pipeline stops "
+                "making progress until it returns.")
+def blocking_in_async(ctx: ModuleContext) -> Iterator[Violation]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.AsyncFunctionDef):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                fn = _dotted(sub.func)
+                if fn in _BLOCKING_CALLS:
+                    yield ctx.violation(
+                        "BLOCKING_IN_ASYNC", sub,
+                        f"`{fn}` inside `async def {node.name}`: "
+                        f"{_BLOCKING_CALLS[fn]}")
+
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _broad_types(type_node: Optional[ast.AST]) -> bool:
+    if type_node is None:  # bare except
+        return True
+    if isinstance(type_node, ast.Tuple):
+        return any(_broad_types(el) for el in type_node.elts)
+    return _dotted(type_node).rsplit(".", 1)[-1] in _BROAD
+
+
+def _handler_is_silent(handler: ast.ExceptHandler) -> bool:
+    """Silent = no raise, no call, and the bound exception (if any) is
+    never read — nothing observes the failure."""
+    for stmt in handler.body:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, (ast.Raise, ast.Call)):
+                return False
+            if (handler.name and isinstance(sub, ast.Name)
+                    and sub.id == handler.name
+                    and isinstance(sub.ctx, ast.Load)):
+                return False
+    return True
+
+
+@rule("SWALLOWED_EXCEPTION",
+      "Broad except (bare / Exception / BaseException) that silently "
+      "drops the error",
+      family="concurrency",
+      rationale="On an op-pipeline path a silent drop loses ops with no "
+                "forensic trail (the class of bug behind the alfred/"
+                "historian route-reply handlers). Narrow the type, or at "
+                "minimum count the swallow via telemetry.counters so "
+                "/healthz exposes the rate.")
+def swallowed_exception(ctx: ModuleContext) -> Iterator[Violation]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _broad_types(node.type):
+            continue
+        if not _handler_is_silent(node):
+            continue
+        shown = "bare except" if node.type is None else \
+            f"except {ast.unparse(node.type)}"
+        yield ctx.violation(
+            "SWALLOWED_EXCEPTION", node,
+            f"`{shown}` swallows the error with no raise, log, or "
+            f"counter; narrow the type or record the swallow "
+            f"(telemetry.counters.record_swallow)")
+
+
+_REGISTER_NAMES = {"on", "subscribe", "add_listener", "add_handler",
+                   "register_listener"}
+_REMOVE_NAMES = {"off", "unsubscribe", "remove_listener", "remove_handler",
+                 "unregister_listener", "remove_all_listeners", "dispose"}
+
+
+@rule("LISTENER_LEAK",
+      "Class registers event listeners but offers no removal path",
+      family="concurrency",
+      rationale="A subscribe/on API without unsubscribe/off pins every "
+                "registered closure (and whatever document state it "
+                "captures) for the lifetime of the emitter — the "
+                "long-lived-server leak class.")
+def listener_leak(ctx: ModuleContext) -> Iterator[Violation]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        methods = {m.name: m for m in node.body
+                   if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        registers = [m for name, m in methods.items()
+                     if name in _REGISTER_NAMES]
+        if not registers:
+            continue
+        if any(name in _REMOVE_NAMES for name in methods):
+            continue
+        for m in registers:
+            yield ctx.violation(
+                "LISTENER_LEAK", m,
+                f"`{node.name}.{m.name}` registers listeners but "
+                f"`{node.name}` has no removal path "
+                f"({'/'.join(sorted(_REMOVE_NAMES))})")
+
+
+def _mutable_default(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    return (isinstance(node, ast.Call)
+            and _dotted(node.func) in ("list", "dict", "set", "bytearray"))
+
+
+@rule("MUTABLE_DEFAULT",
+      "Mutable default argument",
+      family="concurrency",
+      rationale="Default values evaluate once at def time; a mutable one "
+                "is shared across every call and every thread — state "
+                "leaks between requests.")
+def mutable_default(ctx: ModuleContext) -> Iterator[Violation]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        args = node.args
+        defaults: List[ast.AST] = list(args.defaults) + [
+            d for d in args.kw_defaults if d is not None]
+        for d in defaults:
+            if _mutable_default(d):
+                yield ctx.violation(
+                    "MUTABLE_DEFAULT", d,
+                    f"mutable default argument in `{node.name}`; use "
+                    f"None and create inside the body")

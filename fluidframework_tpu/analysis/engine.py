@@ -1,0 +1,344 @@
+"""fluidlint engine: parse, shared AST context, suppressions, dispatch.
+
+The engine owns everything rule implementations share: the parsed tree
+with parent links, the jit-function index (decorator and call forms,
+with ``static_argnums``/``donate_argnums`` parsed out), inline
+suppression comments, and stable violation fingerprints for the
+baseline. Rules stay small predicate functions over this context.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from pathlib import Path
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+# Repo root = the directory holding the fluidframework_tpu package;
+# baseline entries key file paths relative to it so the gate is stable
+# regardless of the CWD the analyzer runs from.
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+SUPPRESS_RE = re.compile(
+    r"#\s*fluidlint:\s*disable(?:=(?P<rules>[A-Z0-9_,\s]+))?"
+    r"(?:\s*[—:-]\s*(?P<reason>.*))?")
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule_id: str
+    path: str          # repo-root-relative (or absolute if outside)
+    line: int
+    col: int
+    message: str
+    symbol: str        # enclosing def/class qualname ("" at module level)
+    line_text: str
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable identity for baseline matching: deliberately excludes
+        the line *number* (which drifts on unrelated edits) in favour of
+        the enclosing symbol plus the normalized source line."""
+        raw = "|".join((self.rule_id, self.path, self.symbol,
+                        " ".join(self.line_text.split())))
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{loc}: {self.rule_id}{sym}: {self.message}"
+
+
+@dataclass
+class JitInfo:
+    """How a function is jitted: which params are static (safe to branch
+    on) and which are donated."""
+    node: ast.FunctionDef
+    static_argnums: Set[int] = field(default_factory=set)
+    static_argnames: Set[str] = field(default_factory=set)
+    donate_argnums: Set[int] = field(default_factory=set)
+    donate_argnames: Set[str] = field(default_factory=set)
+    form: str = "decorator"  # "decorator" | "call"
+
+    def traced_params(self) -> Set[str]:
+        names = set()
+        for i, arg in enumerate(self.node.args.args):
+            if i in self.static_argnums or arg.arg in self.static_argnames:
+                continue
+            names.add(arg.arg)
+        return names
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.jit' for Attribute/Name chains, '' for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    return _dotted(node) in ("jax.jit", "jit")
+
+
+def _int_elems(node: ast.AST) -> Set[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: Set[int] = set()
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, int):
+                out.add(el.value)
+        return out
+    return set()
+
+
+def _str_elems(node: ast.AST) -> Set[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return {node.value}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return {el.value for el in node.elts
+                if isinstance(el, ast.Constant) and isinstance(el.value, str)}
+    return set()
+
+
+def _jit_kwargs(call: ast.Call, info: JitInfo) -> None:
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            info.static_argnums |= _int_elems(kw.value)
+        elif kw.arg == "static_argnames":
+            info.static_argnames |= _str_elems(kw.value)
+        elif kw.arg == "donate_argnums":
+            info.donate_argnums |= _int_elems(kw.value)
+        elif kw.arg == "donate_argnames":
+            info.donate_argnames |= _str_elems(kw.value)
+
+
+class ModuleContext:
+    """Everything rules need about one source file."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self.jit_functions: Dict[ast.FunctionDef, JitInfo] = {}
+        self._index_jit_functions()
+        self.suppressions = self._scan_suppressions()
+
+    # -- jit detection -----------------------------------------------------
+    def _index_jit_functions(self) -> None:
+        by_name: Dict[str, List[ast.FunctionDef]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.FunctionDef):
+                by_name.setdefault(node.name, []).append(node)
+                info = self._decorator_jit_info(node)
+                if info is not None:
+                    self.jit_functions[node] = info
+        # Call form: jax.jit(fn, ...) where fn is a Name that resolves to
+        # exactly one FunctionDef in this module.
+        for node in ast.walk(self.tree):
+            if not (isinstance(node, ast.Call) and _is_jit_ref(node.func)
+                    and node.args):
+                continue
+            target = node.args[0]
+            if not isinstance(target, ast.Name):
+                continue
+            defs = by_name.get(target.id, [])
+            if len(defs) != 1 or defs[0] in self.jit_functions:
+                continue
+            info = JitInfo(node=defs[0], form="call")
+            _jit_kwargs(node, info)
+            self.jit_functions[defs[0]] = info
+
+    def _decorator_jit_info(self,
+                            node: ast.FunctionDef) -> Optional[JitInfo]:
+        for dec in node.decorator_list:
+            if _is_jit_ref(dec):
+                return JitInfo(node=node)
+            if isinstance(dec, ast.Call):
+                # @jax.jit(...) and @functools.partial(jax.jit, ...)
+                if _is_jit_ref(dec.func):
+                    info = JitInfo(node=node)
+                    _jit_kwargs(dec, info)
+                    return info
+                if (_dotted(dec.func) in ("functools.partial", "partial")
+                        and dec.args and _is_jit_ref(dec.args[0])):
+                    info = JitInfo(node=node)
+                    _jit_kwargs(dec, info)
+                    return info
+        return None
+
+    def enclosing_jit(self, node: ast.AST) -> Optional[JitInfo]:
+        """The jit-decorated function lexically containing ``node``, if
+        any — nested helper defs inside a jitted body count (they trace
+        when the jitted caller runs them)."""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, ast.FunctionDef) and cur in self.jit_functions:
+                return self.jit_functions[cur]
+            cur = self.parents.get(cur)
+        return None
+
+    def symbol_for(self, node: ast.AST) -> str:
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts))
+
+    # -- suppressions ------------------------------------------------------
+    def _scan_suppressions(self) -> Dict[int, Set[str]]:
+        """line -> rule ids disabled there ({"all"} disables everything).
+        A suppression comment applies to its own line; a standalone
+        comment line applies to the next line as well (so long
+        statements can carry the comment just above them)."""
+        out: Dict[int, Set[str]] = {}
+        try:
+            tokens = list(tokenize.generate_tokens(
+                StringIO(self.source).readline))
+        except (tokenize.TokenError, IndentationError):
+            return out
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            rules = m.group("rules")
+            ids = ({r.strip() for r in rules.split(",") if r.strip()}
+                   if rules else {"all"})
+            line = tok.start[0]
+            out.setdefault(line, set()).update(ids)
+            stripped = self.lines[line - 1].strip() if \
+                line <= len(self.lines) else ""
+            if stripped.startswith("#"):
+                # Standalone comment: applies to the next code line, even
+                # across the rest of the comment block and blank lines.
+                nxt = line + 1
+                while nxt <= len(self.lines) and (
+                        not self.lines[nxt - 1].strip()
+                        or self.lines[nxt - 1].strip().startswith("#")):
+                    nxt += 1
+                out.setdefault(nxt, set()).update(ids)
+        return out
+
+    def is_suppressed(self, rule_id: str, line: int) -> bool:
+        ids = self.suppressions.get(line, set())
+        return "all" in ids or rule_id in ids
+
+    # -- violation helper --------------------------------------------------
+    def violation(self, rule_id: str, node: ast.AST,
+                  message: str) -> Violation:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        text = self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+        return Violation(rule_id=rule_id, path=self.path, line=line,
+                         col=col, message=message,
+                         symbol=self.symbol_for(node),
+                         line_text=text.strip())
+
+
+@dataclass
+class AnalysisResult:
+    violations: List[Violation]          # new (not suppressed/baselined)
+    baselined: List[Violation]
+    suppressed: int
+    files: int
+
+    @property
+    def summary(self) -> dict:
+        return {"violations": len(self.violations),
+                "baselined": len(self.baselined)}
+
+
+def _rel_path(path: Path) -> str:
+    path = path.resolve()
+    try:
+        return path.relative_to(REPO_ROOT).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def iter_python_files(paths: Sequence[str]) -> Iterator[Path]:
+    for raw in paths:
+        p = Path(raw)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if "__pycache__" not in f.parts:
+                    yield f
+        elif p.suffix == ".py":
+            yield p
+
+
+def analyze_source(source: str, path: str = "<memory>",
+                   only: Iterable[str] = ()) -> List[Violation]:
+    """Run (a subset of) the rules over one source string. Suppressions
+    apply; baseline does not (that is a CLI-level concern). Fixture
+    tests drive this directly."""
+    from .registry import iter_checks
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as exc:
+        return [Violation(rule_id="PARSE_ERROR", path=path,
+                          line=exc.lineno or 1, col=exc.offset or 0,
+                          message=f"could not parse: {exc.msg}",
+                          symbol="", line_text="")]
+    ctx = ModuleContext(path, source, tree)
+    out: List[Violation] = []
+    for r in iter_checks(only):
+        for v in r.check(ctx):
+            if not ctx.is_suppressed(v.rule_id, v.line):
+                out.append(v)
+    out.sort(key=lambda v: (v.line, v.col, v.rule_id))
+    return out
+
+
+def analyze_paths(paths: Sequence[str], baseline=None,
+                  only: Iterable[str] = ()) -> AnalysisResult:
+    from .registry import iter_checks
+    rules = iter_checks(only)
+    new: List[Violation] = []
+    base: List[Violation] = []
+    suppressed = 0
+    files = 0
+    for file in iter_python_files(paths):
+        files += 1
+        rel = _rel_path(file)
+        try:
+            source = file.read_text()
+            tree = ast.parse(source)
+        except (OSError, SyntaxError, UnicodeDecodeError) as exc:
+            new.append(Violation(rule_id="PARSE_ERROR", path=rel, line=1,
+                                 col=0, message=f"could not parse: {exc}",
+                                 symbol="", line_text=""))
+            continue
+        ctx = ModuleContext(rel, source, tree)
+        for r in rules:
+            for v in r.check(ctx):
+                if ctx.is_suppressed(v.rule_id, v.line):
+                    suppressed += 1
+                elif baseline is not None and baseline.contains(v):
+                    base.append(v)
+                else:
+                    new.append(v)
+    key = lambda v: (v.path, v.line, v.col, v.rule_id)  # noqa: E731
+    new.sort(key=key)
+    base.sort(key=key)
+    return AnalysisResult(violations=new, baselined=base,
+                          suppressed=suppressed, files=files)

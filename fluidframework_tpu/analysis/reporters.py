@@ -1,0 +1,56 @@
+"""Reporters: human-readable listing and machine-readable JSON.
+
+Both end with the same one-line JSON summary
+(``{"violations": N, "baselined": M}``) so `make lint-analysis` output
+can be trend-tracked by the bench tooling with a tail -1.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import IO
+
+from .engine import AnalysisResult
+from .registry import RULES
+
+
+def render_human(result: AnalysisResult, stream: IO[str],
+                 show_baselined: bool = False) -> None:
+    for v in result.violations:
+        stream.write(v.render() + "\n")
+        if v.line_text:
+            stream.write(f"    {v.line_text}\n")
+        rule = RULES.get(v.rule_id)
+        if rule is not None:
+            stream.write(f"    hint: {rule.rationale}\n")
+    if show_baselined:
+        for v in result.baselined:
+            stream.write(f"baselined: {v.render()}\n")
+    if result.violations:
+        stream.write(
+            f"\n{len(result.violations)} new violation(s) across "
+            f"{result.files} file(s) "
+            f"({len(result.baselined)} baselined, "
+            f"{result.suppressed} suppressed inline).\n"
+            f"Fix them, add `# fluidlint: disable=RULE — reason`, or "
+            f"accept with --write-baseline (and justify in the entry).\n")
+    stream.write(json.dumps(result.summary) + "\n")
+
+
+def render_json(result: AnalysisResult, stream: IO[str]) -> None:
+    payload = {
+        "files": result.files,
+        "suppressed": result.suppressed,
+        "summary": result.summary,
+        "violations": [
+            {"rule": v.rule_id, "path": v.path, "line": v.line,
+             "col": v.col, "symbol": v.symbol, "message": v.message,
+             "fingerprint": v.fingerprint}
+            for v in result.violations],
+        "baselined": [
+            {"rule": v.rule_id, "path": v.path, "line": v.line,
+             "fingerprint": v.fingerprint}
+            for v in result.baselined],
+    }
+    stream.write(json.dumps(payload, indent=2) + "\n")
+    stream.write(json.dumps(result.summary) + "\n")

@@ -69,6 +69,9 @@ class Document:
     def on(self, event: str, fn) -> None:
         self.container.on(event, fn)
 
+    def off(self, event: str, fn) -> None:
+        self.container.off(event, fn)
+
     def close(self) -> None:
         self.container.close()
 

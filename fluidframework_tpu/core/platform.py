@@ -74,5 +74,8 @@ def enable_compile_cache(path: str = None) -> None:
             path or os.environ.get("JAX_COMPILATION_CACHE_DIR",
                                    "/tmp/fluid_tpu_xla_cache"))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:  # pragma: no cover - cache is best-effort
+    # fluidlint: disable=SWALLOWED_EXCEPTION — core/ is the bottom layer
+    # and must not import telemetry; a missing XLA cache dir only costs
+    # recompiles (cache is best-effort).
+    except Exception:  # pragma: no cover
         pass

@@ -53,6 +53,9 @@ class IDocumentDeltaConnection:
     def on(self, event: str, fn: Callable) -> None:
         raise NotImplementedError
 
+    def off(self, event: str, fn: Callable) -> None:
+        raise NotImplementedError
+
     def close(self) -> None:
         raise NotImplementedError
 

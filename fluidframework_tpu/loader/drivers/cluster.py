@@ -13,6 +13,7 @@ from typing import List, Optional
 
 from ...protocol.summary import SummaryTree
 from ...server.nodes import Cluster, OrdererNode
+from ...telemetry.counters import record_swallow
 from .base import (
     IDocumentDeltaConnection,
     IDocumentDeltaStorageService,
@@ -45,7 +46,9 @@ class ClusterDocumentStorageService(IDocumentStorageService):
                 return (summary_tree_from_dict(data)
                         if data is not None else None)
             except Exception:  # noqa: BLE001 — tier failure, not data
-                pass
+                # Degrade to the direct store read below; counted so a
+                # dead historian tier is visible as a rate, not silence.
+                record_swallow("driver.historian_tier")
         return self.cluster.historian.read_summary(
             self.cluster.tenant_id, self.document_id, commit_sha=version)
 
@@ -85,6 +88,9 @@ class ClusterDocumentDeltaConnection(IDocumentDeltaConnection):
 
     def on(self, event, fn) -> None:
         self._conn.on(event, fn)
+
+    def off(self, event, fn) -> None:
+        self._conn.off(event, fn)
 
     def close(self) -> None:
         self._conn.disconnect()

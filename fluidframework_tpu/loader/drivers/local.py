@@ -89,6 +89,9 @@ class LocalDocumentDeltaConnection(IDocumentDeltaConnection):
     def on(self, event, fn) -> None:
         self._conn.on(event, fn)
 
+    def off(self, event, fn) -> None:
+        self._conn.off(event, fn)
+
     def close(self) -> None:
         self._conn.disconnect()
 

@@ -18,6 +18,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ...telemetry.counters import record_swallow
 from .base import (IDocumentDeltaStorageService, IDocumentService,
                    IDocumentServiceFactory, IDocumentStorageService)
 
@@ -185,4 +186,5 @@ class RetryingDocumentServiceFactory(IDocumentServiceFactory):
             service.connect_to_storage().get_summary()
             return True
         except Exception:  # noqa: BLE001 — prefetch is best-effort
+            record_swallow("driver.prefetch_snapshot")
             return False

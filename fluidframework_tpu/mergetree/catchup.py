@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..telemetry.counters import increment
 from . import kernel
 from .constants import (
     DEV_NO_REMOVE,
@@ -178,7 +179,10 @@ def seed_host_cols(entries: Sequence[dict], payloads: PayloadTable,
         _seed_fill(entries, payloads, cols, rem_client, anno, anno_slots,
                    pending_ids, added, allow_runs, allow_items,
                    Items, Run)
-    except Exception:
+    except BaseException:  # incl. KeyboardInterrupt: never strand payloads
+        # Not a swallow (re-raised below), so not a swallowed.* counter:
+        # those mean "error hidden"; this one means "unwind ran".
+        increment("catchup.seed_fill_unwinds")
         for op_id in added:
             payloads.free(op_id)
         raise

@@ -20,6 +20,12 @@ DEV_UNASSIGNED = 2**31 - 1   # pending ins_seq / rem_seq on device
 DEV_NO_REMOVE = 2**31 - 2    # rem_seq sentinel: never removed
 DEV_NO_CLIENT = -1
 
+# Canonical device dtypes: every jitted column is int32, every mask
+# bool_. fluidlint's DTYPE_DRIFT rule enforces this set inside jitted
+# functions; deliberate exceptions (the int16 wire-result packing in
+# server/serve_step.py) carry inline suppressions.
+CANONICAL_DEVICE_DTYPES = ("int32", "bool_")
+
 # Default tuning knobs (reference mergeTree.ts:1050-1068, snapshotV1.ts:40)
 TEXT_SEGMENT_GRANULARITY = 256
 SNAPSHOT_CHUNK_SIZE = 10000

@@ -95,6 +95,8 @@ def _fused_sp_body(state: DocState, ops: PackedOps, sp_shards: int,
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
+# fluidlint: disable=MISSING_DONATE — non-donating by contract (docstring):
+# overflow recovery re-applies from the retained sharded input.
 def apply_ops_fused_sp(state: DocState, ops: PackedOps, sp_shards: int,
                        runs=None) -> DocState:
     """The fused formulation with sp-aware prefix sums: jit this with the

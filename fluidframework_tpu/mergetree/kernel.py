@@ -429,11 +429,15 @@ def apply_ops_batched(state: DocState, ops: PackedOps) -> DocState:
 # (overflow recovery / bulk catch-up retry at a larger capacity): jax arrays
 # are immutable, so keeping the input alive costs nothing extra.
 @jax.jit
+# fluidlint: disable=MISSING_DONATE — non-donating by design (see comment
+# above): overflow recovery re-applies from the retained input.
 def apply_ops_keep(state: DocState, ops: PackedOps, runs=None) -> DocState:
     return _scan_ops(state, ops, batched=False, runs=runs)
 
 
 @jax.jit
+# fluidlint: disable=MISSING_DONATE — non-donating by design (see comment
+# above): overflow recovery re-applies from the retained input.
 def apply_ops_batched_keep(state: DocState, ops: PackedOps) -> DocState:
     return _scan_ops(state, ops, batched=True)
 

@@ -98,7 +98,11 @@ def _native_pack():
                            ctypes.c_long, ctypes.c_long, ctypes.c_long]
             fn.restype = ctypes.c_long
             _NATIVE_PACK = fn
-        except Exception:  # noqa: BLE001 — no toolchain: Python fallback
+        except (ImportError, OSError, RuntimeError, AttributeError):
+            # No toolchain (NativeBuildError is a RuntimeError) or a
+            # missing symbol in a stale .so: Python packer fallback.
+            from ..telemetry.counters import record_swallow
+            record_swallow("oppack.native_fallback")
             _NATIVE_PACK = False
     return _NATIVE_PACK or None
 

@@ -441,6 +441,8 @@ def _stream_loop(st, t_steps, get_op, k, a, ln: Lanes, with_runs=False):
 
 
 @jax.jit
+# fluidlint: disable=MISSING_DONATE — non-donating is the documented
+# apply_ops_fused contract (callers retain the input for overflow retry).
 def apply_ops_fused_ref(state: DocState, ops: PackedOps) -> DocState:
     """jnp reference of the fused formulation (also the non-TPU fallback).
     Non-donating, matching the documented apply_ops_fused contract."""
@@ -578,6 +580,8 @@ def fused_available() -> bool:
             jax.block_until_ready(out.length)
             _FUSED_OK = int(jax.device_get(out.count)[0]) == 1
         except Exception:  # noqa: BLE001 — any Mosaic failure => fallback
+            from ..telemetry.counters import record_swallow
+            record_swallow("pallas.fused_unavailable")
             _FUSED_OK = False
     return _FUSED_OK
 
@@ -612,6 +616,8 @@ def fused_runs_available() -> bool:
             jax.block_until_ready(out.length)
             _FUSED_RUNS_OK = int(jax.device_get(out.count)[0]) == RUN_K
         except Exception:  # noqa: BLE001 — any Mosaic failure => fallback
+            from ..telemetry.counters import record_swallow
+            record_swallow("pallas.fused_runs_unavailable")
             _FUSED_RUNS_OK = False
     return _FUSED_RUNS_OK
 

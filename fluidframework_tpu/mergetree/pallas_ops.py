@@ -49,6 +49,8 @@ def _pallas_available() -> bool:
             jax.block_until_ready(out)
             _PALLAS_OK = bool((out == 2).all())
         except Exception:  # noqa: BLE001 — any backend failure => fallback
+            from ..telemetry.counters import record_swallow
+            record_swallow("pallas.unavailable")
             _PALLAS_OK = False
     return _PALLAS_OK
 

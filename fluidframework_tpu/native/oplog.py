@@ -205,3 +205,10 @@ class NativeMessageLog:
                   fn: Callable[[QueuedMessage], None]) -> None:
         self.topic(topic)
         self._listeners.setdefault((topic, partition), []).append(fn)
+
+    def unsubscribe(self, topic: str, partition: int,
+                    fn: Callable[[QueuedMessage], None]) -> None:
+        """Removal path for subscribe (same contract as MessageLog)."""
+        listeners = self._listeners.get((topic, partition), [])
+        if fn in listeners:
+            listeners.remove(fn)

@@ -55,8 +55,17 @@ class Quorum:
     def on(self, event: str, fn: Callable) -> None:
         self._listeners.setdefault(event, []).append(fn)
 
+    def off(self, event: str, fn: Callable) -> None:
+        """Removal path for on(): quorum outlives individual observers
+        (summarizer clients come and go), so observers must detach."""
+        listeners = self._listeners.get(event)
+        if listeners and fn in listeners:
+            listeners.remove(fn)
+
     def _emit(self, event: str, *args) -> None:
-        for fn in self._listeners.get(event, []):
+        # Iterate a copy: a listener may off() itself mid-emit (the
+        # one-shot pattern); mutating the live list would skip siblings.
+        for fn in list(self._listeners.get(event, [])):
             fn(*args)
 
     # -- membership --------------------------------------------------------

@@ -49,6 +49,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
 from ..protocol.summary import summary_tree_from_dict, summary_tree_to_dict
+from ..telemetry.counters import increment, record_swallow
 from .auth import AuthError, TenantManager
 from .historian import TIER_HEADER, git_object_to_wire, notify_summary_commit
 from .local_server import LocalServer
@@ -267,12 +268,13 @@ class AlfredService:
                 try:
                     getattr(self, name)(handler, params, **groups)
                 except BrokenPipeError:
-                    pass
+                    record_swallow("alfred.client_gone")
                 except Exception as exc:  # route bug -> 500, keep serving
+                    increment("alfred.route_errors")
                     try:
                         _send_json(handler, 500, {"error": repr(exc)})
-                    except Exception:
-                        pass
+                    except OSError:  # reply socket died mid-error
+                        record_swallow("alfred.route_reply")
                 return
         _send_json(handler, 404, {"error": f"no route {method} {path}"})
 
@@ -438,7 +440,7 @@ class AlfredService:
         import base64
         try:
             raw = base64.b64decode(content, validate=True)
-        except Exception:  # noqa: BLE001 — malformed payload
+        except ValueError:  # binascii.Error: malformed payload
             _send_json(handler, 400, {"error": "content is not base64"})
             return
         sha = self.core(tenant).storage(doc).put_blob(raw)
@@ -695,6 +697,7 @@ class AlfredService:
                 except Exception as exc:  # noqa: BLE001 — isolate per doc
                     # One document's bad frame must never kill the shared
                     # socket for its siblings: answer on the cid.
+                    increment("alfred.mux_frame_errors")
                     send({"type": "error", "cid": msg.get("cid"),
                           "error": repr(exc)})
         except (WebSocketClosed, OSError, json.JSONDecodeError):
@@ -724,6 +727,7 @@ class AlfredService:
                 core = self.core(tenant_id)
                 conn = core.connect(document_id, msg.get("client"))
             except Exception as exc:  # noqa: BLE001 — fail the handshake
+                increment("alfred.connect_errors")
                 # Answer with connect_error, not the generic error frame:
                 # the client routes only connect_error/connected to the
                 # pending handshake, so anything else leaves

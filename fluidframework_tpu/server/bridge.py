@@ -98,7 +98,11 @@ class OpBridgeServer:
                  max_workers: int = 4):
         import grpc
         from .pipeline import full_step
-        self._step = jax.jit(full_step)
+        # donate both threaded states: _submit_batch overwrites
+        # session.tstate/mstate with the step result, so the previous
+        # buffers are dead the moment the call returns — donation halves
+        # the bridge's peak device footprint per session.
+        self._step = jax.jit(full_step, donate_argnums=(0, 1))
         self.capacity = capacity
         self.sessions: Dict[Tuple[str, int], _Session] = {}
         self._lock = threading.Lock()
@@ -149,8 +153,18 @@ class OpBridgeServer:
         raw = tk.RawOps(client=ops.client, client_seq=ops.seq,
                         ref_seq=ops.ref_seq)
         with session.lock:
-            session.tstate, session.mstate, ticketed, total_len = \
-                self._step(session.tstate, session.mstate, raw, ops)
+            try:
+                session.tstate, session.mstate, ticketed, total_len = \
+                    self._step(session.tstate, session.mstate, raw, ops)
+            except Exception:
+                # The step donates tstate/mstate: a runtime execution
+                # failure has already consumed those buffers, so the
+                # session can never step again — evict it (the next
+                # SubmitBatch for this key rebuilds fresh state) instead
+                # of poisoning every future RPC with deleted-array errors.
+                with self._lock:
+                    self.sessions.pop(key, None)
+                raise
             seq = np.asarray(ticketed.seq)
             min_seq = np.asarray(ticketed.min_seq)
             nack = np.asarray(ticketed.nacked).astype(np.int32)

@@ -20,6 +20,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
 
 from ..loader.container import Container, Loader
+from ..telemetry.counters import record_swallow
 
 
 def _dump_channel(channel) -> dict:
@@ -38,8 +39,11 @@ def _dump_channel(channel) -> dict:
         try:
             out["entries"] = {k: channel.get(k) for k in channel.keys()}
             return out
-        except Exception:  # noqa: BLE001 — fall through to value probe
-            pass
+        except Exception:  # noqa: BLE001 — duck-typed channel probe
+            # Not actually map-shaped (keys() lied): fall through to the
+            # value probe. Counted — a climbing rate means a DDS type is
+            # rendering wrong in every gateway dump, not an odd one-off.
+            record_swallow("gateway.channel_probe")
     if hasattr(channel, "value"):
         out["value"] = channel.value
     return out

@@ -46,6 +46,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 from ..protocol.summary import summary_tree_from_dict
+from ..telemetry.counters import increment, record_swallow
 from ..telemetry.logger import PerformanceEvent, TelemetryLogger
 from .cache import LruTtlCache
 from .storage import GitBlob, GitCommit, GitTree, Historian
@@ -376,6 +377,9 @@ class HistorianTier:
                 self._prefetch_tree(tenant_id, document_id, commit["tree"],
                                     token)
         except Exception as exc:  # noqa: BLE001 — warmup must never fail a write
+            if self.metrics is not None:
+                self.metrics.increment("historian.prefetchFailures")
+            record_swallow("historian.prefetch")
             if event is not None:
                 event.cancel(error=exc)
             return
@@ -529,10 +533,11 @@ class HistorianService:
                     # direct-GitStore fallback path.
                     _send_json(handler, 503, {"error": repr(exc)})
                 except Exception as exc:  # noqa: BLE001 — route bug
+                    increment("historian.route_errors")
                     try:
                         _send_json(handler, 500, {"error": repr(exc)})
-                    except Exception:
-                        pass
+                    except OSError:  # reply socket died mid-error
+                        record_swallow("historian.route_reply")
                 return
         _send_json(handler, 404, {"error": f"no route {method} {path}"})
 
@@ -567,7 +572,20 @@ class HistorianService:
             return
         try:
             self.tier.ensure_authorized(tenant, doc, token)
-        except Exception:  # noqa: BLE001 — unauthorized: invalidate only
+        except (UpstreamError, OSError):
+            # Unauthorized (or upstream unreachable): the invalidate above
+            # already happened — correctness holds — we only skip the warm
+            # prefetch. Counted: a climbing rate means notifiers are
+            # sending dead tokens and every reload is a cold miss.
+            record_swallow("historian.unauthorized_prefetch")
+            return
+        except Exception:  # noqa: BLE001 — response already committed
+            # The 200 is already on the wire (keep-alive socket): anything
+            # escaping here would reach the route dispatcher and write a
+            # SECOND response, desyncing the notifier's connection. E.g. a
+            # malformed upstream body raises JSONDecodeError out of the
+            # proxy-mode auth probe.
+            record_swallow("historian.invalidate_prefetch_guard")
             return
         self.tier._prefetch(tenant, doc, sha, token)
 

@@ -15,6 +15,7 @@ from typing import Callable, Dict, Optional
 from ...protocol.messages import DocumentMessage, MessageType, \
     SequencedDocumentMessage
 from ...protocol.protocol_handler import ProtocolOpHandler, ProtocolState
+from ...telemetry.counters import record_swallow
 from ..database import Collection
 from ..log import QueuedMessage
 from ..storage import GitStore, Historian
@@ -117,7 +118,7 @@ class ScribeLambda(IPartitionLambda):
             try:
                 self.on_commit(doc_id, commit_sha)
             except Exception:  # noqa: BLE001 — observers never break scribe
-                pass
+                record_swallow("scribe.commit_observer")
         self.send_system(doc_id, DocumentMessage(
             client_sequence_number=0,
             reference_sequence_number=sequenced.sequence_number,

@@ -23,6 +23,7 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 from ..core.events import TypedEventEmitter
+from ..telemetry.counters import record_swallow
 from ..protocol.messages import (
     Boxcar,
     DocumentMessage,
@@ -292,7 +293,10 @@ class LocalServer:
             try:
                 listener(doc_id, commit_sha)
             except Exception:  # noqa: BLE001 — observers never break scribe
-                pass
+                # Swallowed by design (a historian invalidation hook must
+                # not fail the commit) but counted: a climbing rate means
+                # the cache tier is no longer invalidating.
+                record_swallow("server.summary_commit_listener")
 
     def _send_system(self, doc_id: str, message: DocumentMessage) -> None:
         self.log.send(RAW_TOPIC, doc_id, Boxcar(

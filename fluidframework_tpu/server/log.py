@@ -18,6 +18,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
+from ..telemetry.counters import record_swallow
+
 
 @dataclass
 class QueuedMessage:
@@ -119,6 +121,15 @@ class MessageLog:
                   fn: Callable[[QueuedMessage], None]) -> None:
         self.topic(topic).partitions[partition].listeners.append(fn)
 
+    def unsubscribe(self, topic: str, partition: int,
+                    fn: Callable[[QueuedMessage], None]) -> None:
+        """Removal path for subscribe: a consumer that rebalances away
+        must drop its listener or the broker pins it (and everything the
+        closure captured) for the process lifetime."""
+        listeners = self.topic(topic).partitions[partition].listeners
+        if fn in listeners:
+            listeners.remove(fn)
+
 
 def make_message_log(default_partitions: int = 1,
                      native: Optional[bool] = None):
@@ -131,7 +142,14 @@ def make_message_log(default_partitions: int = 1,
         from ..native.oplog import NativeMessageLog, is_available
         if native or is_available():
             return NativeMessageLog(default_partitions)
-    except Exception:
+    except (ImportError, OSError, RuntimeError, AttributeError):
+        # NativeBuildError is a RuntimeError; OSError covers a missing/
+        # unloadable .so; AttributeError a stale .so missing a symbol
+        # (ctypes binding happens outside oplog._load's own guard). With
+        # native=None this is the documented auto-fallback — counted so a
+        # fleet that should be native shows the silent downgrade on
+        # /healthz.
         if native:
             raise
+        record_swallow("log.native_fallback")
     return MessageLog(default_partitions)

@@ -117,6 +117,8 @@ def _scan(state: LwwState, ops: LwwOps, batched: bool) -> LwwState:
 
 
 @jax.jit
+# fluidlint: disable=MISSING_DONATE — non-donating by design (docstring):
+# overflow lanes restore and re-apply from the retained pre-state.
 def apply_lww_batched(state: LwwState, ops: LwwOps) -> LwwState:
     """Apply [B, T] LWW op streams to B channels (non-donating: callers
     retry overflowing lanes at a larger capacity from the retained input)."""

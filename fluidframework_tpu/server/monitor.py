@@ -16,6 +16,8 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ..telemetry import counters as process_counters
+
 
 class MetricClient:
     """Programmatic metric sink (reference IMetricClient.writeLatencyMetric
@@ -128,11 +130,16 @@ class ServiceMonitor:
                 checks[name] = (False, repr(exc))
         return {"ok": all(ok for ok, _ in checks.values()),
                 "uptimeS": time.time() - self.started_at,
+                # Process-wide counters ride on every health report: the
+                # swallowed.* rates (fluidlint CC rules' runtime side) and
+                # kernel.retrace_count (the RETRACE_HAZARD cross-check).
+                "counters": process_counters.snapshot(),
                 "checks": {n: {"ok": ok, "detail": d}
                            for n, (ok, d) in checks.items()}}
 
     def report(self) -> dict:
-        out = {"metrics": self.metrics.snapshot(), "probes": {}}
+        out = {"metrics": self.metrics.snapshot(),
+               "counters": process_counters.snapshot(), "probes": {}}
         for name, probe in self.probes.items():
             try:
                 out["probes"][name] = probe()
@@ -142,6 +149,8 @@ class ServiceMonitor:
 
     def _route(self, handler) -> None:
         path = handler.path.partition("?")[0]
+        if path == "/healthz":  # k8s-style alias
+            path = "/health"
         if path == "/health":
             payload, status = self.health(), 200
             if not payload["ok"]:

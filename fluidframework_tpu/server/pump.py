@@ -79,7 +79,11 @@ def _load():
             if lib.pump_nf() != NF:
                 raise RuntimeError("wirepump NF mismatch — rebuild needed")
             _LIB = lib
-        except Exception:  # noqa: BLE001 — no toolchain: pump unavailable
+        except (ImportError, OSError, RuntimeError, AttributeError):
+            # No toolchain (NativeBuildError is a RuntimeError), missing
+            # symbol, or NF mismatch: pump unavailable, object path only.
+            from ..telemetry.counters import record_swallow
+            record_swallow("pump.unavailable")
             _LIB = False
     return _LIB or None
 

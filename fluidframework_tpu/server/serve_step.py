@@ -57,6 +57,9 @@ def serve_window(tstate, ticket_cols, merge_states, merge_cols,
     if merge_runs is None:
         merge_runs = [None] * len(merge_cols)
     new_merge = []
+    # fluidlint: disable=RETRACE_HAZARD — deliberate bounded unroll: one
+    # iteration per capacity bucket (≤3 in production; docstring), fused
+    # so the whole window stays a single device program.
     for mstate, mc, mr in zip(merge_states, merge_cols, merge_runs):
         packed = PackedOps(kind=mc[0], seq=mc[1], ref_seq=mc[2],
                            client=mc[3], pos1=mc[4], pos2=mc[5],
@@ -116,6 +119,8 @@ def serve_window(tstate, ticket_cols, merge_states, merge_cols,
             new_merge.append(kernel._scan_ops(mstate, ops2, batched=True))
 
     new_lww = []
+    # fluidlint: disable=RETRACE_HAZARD — deliberate bounded unroll, one
+    # iteration per LWW capacity bucket (same contract as the merge loop).
     for lstate, lc in zip(lww_states, lww_cols):
         seq_g = seq_bt[lc[4], lc[5]]
         ok = (lc[0] != lk.LwwKind.NOOP) & (seq_g > 0)

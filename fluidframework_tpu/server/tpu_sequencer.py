@@ -52,6 +52,8 @@ from ..protocol.messages import (
     NACK_BAD_REF_SEQ,
     SequencedDocumentMessage,
 )
+from ..telemetry.counters import (JitRetraceProbe, increment,
+                                  record_swallow)
 from . import ticket_kernel as tk
 from .lambdas.base import IPartitionLambda, LambdaContext
 from .log import QueuedMessage
@@ -224,8 +226,13 @@ def _repad_batch(rows: DocState, capacity: int) -> DocState:
 # Non-donating applies (kernel.apply_ops*_keep): the serving path keeps the
 # pre-flush state alive until overflow recovery has cleared, so nothing is
 # rebuilt on the recovery path (jax arrays are immutable; retaining the
-# input is free).
-_apply_keep_batched = kernel.apply_ops_batched_keep
+# input is free). Wrapped in the retrace probe: serving windows bucket to
+# a fixed (capacity, T) grid, so compile-cache growth after warmup means
+# an unplanned signature leaked in — counted as kernel.retrace_count and
+# exported via the monitor's /healthz (the runtime cross-check for
+# fluidlint's static RETRACE_HAZARD rule).
+_apply_keep_batched = JitRetraceProbe(kernel.apply_ops_batched_keep,
+                                      name="kernel.merge_apply_batched")
 
 
 class MergeLaneStore:
@@ -2167,7 +2174,11 @@ class TpuSequencerLambda(IPartitionLambda):
             from . import pump as _pump_mod
             if _pump_mod.available():
                 self._pump = _pump_mod.WirePump()
-        except Exception:  # noqa: BLE001 — no toolchain: object path only
+        except (ImportError, OSError, RuntimeError):
+            # No toolchain: object path only. Counted so a fleet that
+            # SHOULD be on the native pump shows the regression on
+            # /healthz instead of just running slow.
+            record_swallow("sequencer.pump_unavailable")
             self._pump = None
         self._restore()
 
@@ -2217,7 +2228,11 @@ class TpuSequencerLambda(IPartitionLambda):
         if self.storage is not None:
             try:
                 tree = self.storage(doc_id)
-            except Exception:  # noqa: BLE001 — storage miss = no seed
+            except Exception:  # noqa: BLE001 — storage backends vary
+                # Miss = no seed (correct for fresh documents), but a
+                # climbing rate means summaries exist and cannot be read
+                # — catch-up is silently replaying whole logs.
+                record_swallow("sequencer.summary_probe_miss")
                 tree = None
             if tree is not None:
                 probe = _parse_summary_probe(tree)
@@ -2933,6 +2948,7 @@ class TpuSequencerLambda(IPartitionLambda):
             # way, log loudly — a silent degrade would hide both a
             # Mosaic regression and the perf cliff.
             import logging
+            increment("sequencer.fused_degrades")
             had_runs = any(j["runs"] is not None for j in merge_jobs)
             if had_runs and self.pack_runs:
                 self.pack_runs = False
@@ -2946,6 +2962,7 @@ class TpuSequencerLambda(IPartitionLambda):
                     (self.tstate, new_merge, new_lww, flat_dev,
                      msn32_dev) = dispatch(self._fused_serve)
                 except Exception as err2:  # noqa: BLE001
+                    increment("sequencer.fused_degrades")
                     self._fused_serve = False
                     logging.getLogger(__name__).warning(
                         "fused serving failed without runs too; scan "
@@ -3702,7 +3719,7 @@ class TpuSequencerLambda(IPartitionLambda):
         try:
             th = threading.Thread(target=work, daemon=True)
             th.start()
-        except Exception:
+        except BaseException:  # incl. KeyboardInterrupt: never leak the guard
             self.merge.extract_guard_release()
             raise
         return th

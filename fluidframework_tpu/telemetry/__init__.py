@@ -15,10 +15,12 @@ from .logger import (
     TelemetryLogger,
 )
 from .mock import MockLogger
+from . import counters
+from .counters import JitRetraceProbe, record_swallow
 
 __all__ = [
     "ERROR", "GENERIC", "PERFORMANCE",
     "ChildLogger", "DebugLogger", "MultiSinkLogger",
     "OpRoundTripTelemetry", "PerformanceEvent", "TelemetryLogger",
-    "MockLogger",
+    "MockLogger", "JitRetraceProbe", "counters", "record_swallow",
 ]

@@ -1,0 +1,109 @@
+"""Process-wide named counters + the jit retrace probe.
+
+This is the runtime side of fluidlint: wherever a broad exception
+handler deliberately swallows an error on an op-pipeline path, it calls
+``record_swallow(site)`` so the drop is visible as a rate instead of
+silence; and the ``JitRetraceProbe`` wrapper counts compile-cache misses
+on the hot jitted kernels so the static RETRACE_HAZARD rule has a
+runtime cross-check. ``server/monitor.py`` exports ``snapshot()``
+through ``/healthz``.
+
+Kept dependency-free (stdlib only) so every layer — mergetree, loader,
+server — can import it without cycles.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+_lock = threading.Lock()
+_counters: Dict[str, float] = {}
+
+
+def increment(name: str, by: float = 1.0) -> float:
+    with _lock:
+        _counters[name] = value = _counters.get(name, 0.0) + by
+        return value
+
+
+def get(name: str) -> float:
+    with _lock:
+        return _counters.get(name, 0.0)
+
+
+def snapshot() -> Dict[str, float]:
+    with _lock:
+        return dict(_counters)
+
+
+def reset() -> None:
+    """Test isolation only."""
+    with _lock:
+        _counters.clear()
+
+
+def record_swallow(site: str) -> None:
+    """Count a deliberately swallowed exception at ``site``. The point is
+    the rate: a handful of swallows is a degraded dependency, a climbing
+    counter is an outage hiding behind a broad except."""
+    increment(f"swallowed.{site}")
+
+
+class JitRetraceProbe:
+    """Transparent wrapper over a jitted callable that counts compile-
+    cache growth observed across THIS probe's calls. The first growth a
+    probe observes is an expected compile (``<name>.compiles``); growth
+    on a later call is a retrace — a new (shape, dtype, structure)
+    signature on a path the static analyzer believes is shape-stable —
+    counted as ``<name>.retraces`` and aggregated into
+    ``kernel.retrace_count``.
+
+    The cache baseline snapshots lazily on the probe's first call (not
+    at construction), so compiles other callers made earlier against the
+    same shared jit cache are neither charged to this probe nor
+    misread as retraces. Growth caused by a concurrent other-caller
+    compile during one of our calls is still attributed here — the
+    counter is an operational rate signal, not an exact ledger.
+    """
+
+    def __init__(self, fn: Callable, name: str):
+        self._fn = fn
+        self.name = name
+        # Module-global probes are shared across partition/worker threads:
+        # guard the cache-size accounting so two concurrent first compiles
+        # don't read as a phantom retrace (or lose a real one).
+        self._probe_lock = threading.Lock()
+        self._last: Optional[int] = None
+        self._seen_compile = False
+
+    def _cache_size(self) -> int:
+        size = getattr(self._fn, "_cache_size", None)
+        if size is None:
+            return -1  # not a jitted callable (or an old jax): probe off
+        try:
+            return int(size())
+        except (TypeError, ValueError):
+            return -1
+
+    def __call__(self, *args, **kwargs):
+        with self._probe_lock:
+            if self._last is None:  # lazy baseline: first probed call
+                self._last = self._cache_size()
+        out = self._fn(*args, **kwargs)
+        size = self._cache_size()
+        with self._probe_lock:
+            if size >= 0 and self._last >= 0 and size > self._last:
+                grew = size - self._last
+                increment(f"{self.name}.compiles", grew)
+                if self._seen_compile:
+                    increment(f"{self.name}.retraces", grew)
+                    increment("kernel.retrace_count", grew)
+                self._seen_compile = True
+            if size >= 0:
+                self._last = size
+        return out
+
+    def __getattr__(self, item):
+        # Passthrough (lower/trace/cache introspection on the wrapped jit).
+        return getattr(self._fn, item)

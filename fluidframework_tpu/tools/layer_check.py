@@ -43,6 +43,9 @@ ALLOWED: Dict[str, Set[str]] = {
     "client_api": {"core", "dds", "loader"},
     "agents": {"core", "dds", "loader", "framework"},
     "tools": {"core", "protocol", "mergetree", "loader"},
+    # fluidlint (the AST analyzer) reads the canonical device dtypes from
+    # mergetree/constants.py; it must not depend on anything above that.
+    "analysis": {"mergetree"},
 }
 
 # Per-module exceptions (module path relative to the package root).

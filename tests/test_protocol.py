@@ -41,6 +41,22 @@ class TestQuorum:
         assert q.get_member("A") is None
         assert len(q.members) == 1
 
+    def test_listener_off_and_self_detach_during_emit(self):
+        q = Quorum()
+        hits = []
+
+        def once(client_id, client):
+            hits.append(("once", client_id))
+            q.off("addMember", once)
+
+        q.on("addMember", once)
+        q.on("addMember", lambda cid, c: hits.append(("always", cid)))
+        q.add_member("A", 1)
+        # The self-detaching listener must not make emit skip its sibling.
+        assert hits == [("once", "A"), ("always", "A")]
+        q.add_member("B", 2)
+        assert hits == [("once", "A"), ("always", "A"), ("always", "B")]
+
     def test_proposal_approved_when_msn_passes(self):
         q = Quorum()
         approved = []

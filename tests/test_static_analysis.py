@@ -1,0 +1,785 @@
+"""fluidlint: rule fixtures, suppressions, baseline, and the repo gate.
+
+Every rule gets one true-positive fixture (the rule must fire) and one
+false-positive guard (an adjacent legitimate idiom the rule must stay
+quiet on). The final class is the CI gate itself: the analyzer over the
+whole package must report zero non-baselined violations, so any future
+kernel or lambda change that introduces a hazard fails tier-1 here.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from fluidframework_tpu.analysis import (
+    Baseline,
+    analyze_paths,
+    analyze_source,
+    all_rules,
+)
+from fluidframework_tpu.telemetry import counters
+
+PACKAGE_DIR = Path(__file__).resolve().parents[1] / "fluidframework_tpu"
+
+
+def lint(src, rule=None):
+    only = [rule] if rule else ()
+    return analyze_source(textwrap.dedent(src), only=only)
+
+
+def rule_ids(src, rule=None):
+    return [v.rule_id for v in lint(src, rule)]
+
+
+# ---------------------------------------------------------------------------
+# JX family
+# ---------------------------------------------------------------------------
+
+class TestTracedBranch:
+    def test_true_positive_if_on_traced_arg(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """
+        assert rule_ids(src, "TRACED_BRANCH") == ["TRACED_BRANCH"]
+
+    def test_true_positive_while(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                while x > 0:
+                    x = x - 1
+                return x
+        """
+        assert rule_ids(src, "TRACED_BRANCH") == ["TRACED_BRANCH"]
+
+    def test_guard_static_argnums(self):
+        src = """
+            import functools, jax
+
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def f(x, fused):
+                if fused:
+                    return x * 2
+                return x
+        """
+        assert rule_ids(src, "TRACED_BRANCH") == []
+
+    def test_guard_is_none_and_isinstance_and_shape(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x, runs=None):
+                if runs is None:
+                    return x
+                if isinstance(runs, tuple):
+                    return x
+                if x.ndim > 1:
+                    return x.sum()
+                return x
+        """
+        assert rule_ids(src, "TRACED_BRANCH") == []
+
+    def test_guard_not_jitted(self):
+        src = """
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """
+        assert rule_ids(src, "TRACED_BRANCH") == []
+
+
+class TestHostSync:
+    def test_true_positive_item(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x.sum().item()
+        """
+        assert rule_ids(src, "HOST_SYNC") == ["HOST_SYNC"]
+
+    def test_true_positive_int_on_traced(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return int(x)
+        """
+        assert rule_ids(src, "HOST_SYNC") == ["HOST_SYNC"]
+
+    def test_guard_int_on_shape(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x):
+                n = int(x.shape[0])
+                return x * n
+        """
+        assert rule_ids(src, "HOST_SYNC") == []
+
+    def test_guard_item_outside_jit(self):
+        src = """
+            def host_read(arr):
+                return arr.sum().item()
+        """
+        assert rule_ids(src, "HOST_SYNC") == []
+
+
+class TestRetraceHazard:
+    def test_true_positive_jnp_in_loop(self):
+        src = """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x, cols):
+                for c in cols:
+                    x = x + jnp.sum(c)
+                return x
+        """
+        assert rule_ids(src, "RETRACE_HAZARD") == ["RETRACE_HAZARD"]
+
+    def test_guard_loop_without_jnp(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def f(x, names):
+                total = 0
+                for n in names:
+                    total += len(n)
+                return x * total
+        """
+        assert rule_ids(src, "RETRACE_HAZARD") == []
+
+    def test_guard_lax_scan(self):
+        src = """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                out, _ = jax.lax.scan(lambda c, t: (c + t, None), x,
+                                      jnp.arange(4))
+                return out
+        """
+        assert rule_ids(src, "RETRACE_HAZARD") == []
+
+
+class TestMutableCapture:
+    def test_true_positive_module_dict(self):
+        src = """
+            import jax
+
+            CACHE = {}
+
+            @jax.jit
+            def f(x):
+                return x * len(CACHE)
+        """
+        assert rule_ids(src, "MUTABLE_CAPTURE") == ["MUTABLE_CAPTURE"]
+
+    def test_guard_tuple_constant(self):
+        src = """
+            import jax
+
+            SHAPES = (64, 256, 1024)
+
+            @jax.jit
+            def f(x):
+                return x * SHAPES[0]
+        """
+        assert rule_ids(src, "MUTABLE_CAPTURE") == []
+
+    def test_guard_shadowed_by_param(self):
+        src = """
+            import jax
+
+            table = {}
+
+            @jax.jit
+            def f(x, table):
+                return x * len(table)
+        """
+        assert rule_ids(src, "MUTABLE_CAPTURE") == []
+
+
+class TestDtypeDrift:
+    def test_true_positive_int64_in_jit(self):
+        src = """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                return x.astype(jnp.int64)
+        """
+        assert rule_ids(src, "DTYPE_DRIFT") == ["DTYPE_DRIFT"]
+
+    def test_guard_canonical_int32(self):
+        src = """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                return x.astype(jnp.int32) & jnp.bool_(True)
+        """
+        assert rule_ids(src, "DTYPE_DRIFT") == []
+
+    def test_guard_host_side_float64(self):
+        src = """
+            import numpy as np
+
+            def host_stats(xs):
+                return np.asarray(xs, np.float64).mean()
+        """
+        assert rule_ids(src, "DTYPE_DRIFT") == []
+
+
+class TestMissingDonate:
+    def test_true_positive_step_without_donate(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def serve_step(state, ops):
+                return state._replace(seq=state.seq + 1)
+        """
+        assert rule_ids(src, "MISSING_DONATE") == ["MISSING_DONATE"]
+
+    def test_true_positive_call_form_unresolved(self):
+        src = """
+            import jax
+            from .pipeline import full_step
+
+            stepper = jax.jit(full_step)
+        """
+        assert rule_ids(src, "MISSING_DONATE") == ["MISSING_DONATE"]
+
+    def test_guard_with_donate(self):
+        src = """
+            import functools, jax
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def apply_ops(state, ops):
+                return state._replace(seq=state.seq + 1)
+        """
+        assert rule_ids(src, "MISSING_DONATE") == []
+
+    def test_guard_non_state_function(self):
+        src = """
+            import jax
+
+            @jax.jit
+            def decode(buf, table):
+                return buf + table
+        """
+        assert rule_ids(src, "MISSING_DONATE") == []
+
+
+# ---------------------------------------------------------------------------
+# CC family
+# ---------------------------------------------------------------------------
+
+class TestAwaitInLock:
+    def test_true_positive(self):
+        src = """
+            async def handler(self, op):
+                async with self._lock:
+                    await self.store.write(op)
+        """
+        assert rule_ids(src, "AWAIT_IN_LOCK") == ["AWAIT_IN_LOCK"]
+
+    def test_guard_await_outside_lock(self):
+        src = """
+            async def handler(self, op):
+                async with self._lock:
+                    self.pending.append(op)
+                await self.store.flush()
+        """
+        assert rule_ids(src, "AWAIT_IN_LOCK") == []
+
+    def test_guard_non_lock_context(self):
+        src = """
+            async def handler(self, op):
+                async with self.session() as s:
+                    await s.write(op)
+        """
+        assert rule_ids(src, "AWAIT_IN_LOCK") == []
+
+
+class TestBlockingInAsync:
+    def test_true_positive_time_sleep(self):
+        src = """
+            import time
+
+            async def poll(self):
+                time.sleep(1)
+        """
+        assert rule_ids(src, "BLOCKING_IN_ASYNC") == ["BLOCKING_IN_ASYNC"]
+
+    def test_true_positive_open(self):
+        src = """
+            async def load(self, path):
+                with open(path) as f:
+                    return f.read()
+        """
+        assert rule_ids(src, "BLOCKING_IN_ASYNC") == ["BLOCKING_IN_ASYNC"]
+
+    def test_guard_asyncio_sleep_and_sync_def(self):
+        src = """
+            import asyncio, time
+
+            async def poll(self):
+                await asyncio.sleep(1)
+
+            def sync_poll(self):
+                time.sleep(1)
+        """
+        assert rule_ids(src, "BLOCKING_IN_ASYNC") == []
+
+
+class TestSwallowedException:
+    def test_true_positive_pass(self):
+        src = """
+            def f(sock):
+                try:
+                    sock.send(b"x")
+                except Exception:
+                    pass
+        """
+        assert rule_ids(src, "SWALLOWED_EXCEPTION") == [
+            "SWALLOWED_EXCEPTION"]
+
+    def test_true_positive_bare_except_return(self):
+        src = """
+            def f(raw):
+                try:
+                    return decode(raw)
+                except:
+                    return None
+        """
+        assert rule_ids(src, "SWALLOWED_EXCEPTION") == [
+            "SWALLOWED_EXCEPTION"]
+
+    def test_guard_typed_except(self):
+        src = """
+            def f(sock):
+                try:
+                    sock.send(b"x")
+                except OSError:
+                    pass
+        """
+        assert rule_ids(src, "SWALLOWED_EXCEPTION") == []
+
+    def test_guard_counter_call(self):
+        src = """
+            from fluidframework_tpu.telemetry.counters import record_swallow
+
+            def f(sock):
+                try:
+                    sock.send(b"x")
+                except Exception:
+                    record_swallow("test.site")
+        """
+        assert rule_ids(src, "SWALLOWED_EXCEPTION") == []
+
+    def test_guard_reraise(self):
+        src = """
+            def f(guard, work):
+                try:
+                    work()
+                except BaseException:
+                    guard.release()
+                    raise
+        """
+        assert rule_ids(src, "SWALLOWED_EXCEPTION") == []
+
+    def test_guard_error_stored(self):
+        src = """
+            def f(ctx, work):
+                try:
+                    work()
+                except Exception as err:
+                    ctx["error"] = err
+        """
+        assert rule_ids(src, "SWALLOWED_EXCEPTION") == []
+
+
+class TestListenerLeak:
+    def test_true_positive_on_without_off(self):
+        src = """
+            class Emitter:
+                def __init__(self):
+                    self.listeners = []
+
+                def on(self, event, fn):
+                    self.listeners.append(fn)
+        """
+        assert rule_ids(src, "LISTENER_LEAK") == ["LISTENER_LEAK"]
+
+    def test_guard_on_with_off(self):
+        src = """
+            class Emitter:
+                def __init__(self):
+                    self.listeners = []
+
+                def on(self, event, fn):
+                    self.listeners.append(fn)
+
+                def off(self, event, fn):
+                    self.listeners.remove(fn)
+        """
+        assert rule_ids(src, "LISTENER_LEAK") == []
+
+    def test_guard_subscribe_with_unsubscribe(self):
+        src = """
+            class Broker:
+                def subscribe(self, topic, fn):
+                    self.topics[topic].append(fn)
+
+                def unsubscribe(self, topic, fn):
+                    self.topics[topic].remove(fn)
+        """
+        assert rule_ids(src, "LISTENER_LEAK") == []
+
+
+class TestMutableDefault:
+    def test_true_positive(self):
+        src = """
+            def enqueue(op, queue=[]):
+                queue.append(op)
+                return queue
+        """
+        assert rule_ids(src, "MUTABLE_DEFAULT") == ["MUTABLE_DEFAULT"]
+
+    def test_true_positive_kwonly_dict(self):
+        src = """
+            def connect(url, *, headers={}):
+                return (url, headers)
+        """
+        assert rule_ids(src, "MUTABLE_DEFAULT") == ["MUTABLE_DEFAULT"]
+
+    def test_guard_none_default(self):
+        src = """
+            def enqueue(op, queue=None):
+                queue = queue or []
+                queue.append(op)
+                return queue
+        """
+        assert rule_ids(src, "MUTABLE_DEFAULT") == []
+
+    def test_guard_tuple_default(self):
+        src = """
+            def make(capacities=(64, 256, 1024)):
+                return list(capacities)
+        """
+        assert rule_ids(src, "MUTABLE_DEFAULT") == []
+
+
+# ---------------------------------------------------------------------------
+# suppressions + baseline + CLI
+# ---------------------------------------------------------------------------
+
+SWALLOW_SRC = """
+    def f(sock):
+        try:
+            sock.send(b"x")
+        except Exception:
+            pass
+"""
+
+
+class TestSuppressions:
+    def test_inline_same_line(self):
+        src = """
+            def f(sock):
+                try:
+                    sock.send(b"x")
+                except Exception:  # fluidlint: disable=SWALLOWED_EXCEPTION
+                    pass
+        """
+        assert rule_ids(src) == []
+
+    def test_standalone_comment_above(self):
+        src = """
+            def f(sock):
+                try:
+                    sock.send(b"x")
+                # fluidlint: disable=SWALLOWED_EXCEPTION — reply socket is
+                # already dead; nothing to tell anyone.
+                except Exception:
+                    pass
+        """
+        assert rule_ids(src) == []
+
+    def test_suppression_is_rule_specific(self):
+        src = """
+            def f(sock):
+                try:
+                    sock.send(b"x")
+                except Exception:  # fluidlint: disable=MUTABLE_DEFAULT
+                    pass
+        """
+        assert rule_ids(src) == ["SWALLOWED_EXCEPTION"]
+
+    def test_disable_all(self):
+        src = """
+            def f(sock):
+                try:
+                    sock.send(b"x")
+                except Exception:  # fluidlint: disable
+                    pass
+        """
+        assert rule_ids(src) == []
+
+    def test_unsuppressed_fires(self):
+        assert rule_ids(SWALLOW_SRC) == ["SWALLOWED_EXCEPTION"]
+
+
+class TestBaselineRoundTrip:
+    def test_round_trip(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent(SWALLOW_SRC))
+        # First pass: the violation is new.
+        result = analyze_paths([str(bad)], baseline=Baseline())
+        assert [v.rule_id for v in result.violations] == [
+            "SWALLOWED_EXCEPTION"]
+        assert result.baselined == []
+        # Accept it, save, reload: now it is baselined, not new.
+        bl_path = tmp_path / "baseline.json"
+        Baseline().updated_with(result.violations).save(bl_path)
+        reloaded = Baseline.load(bl_path)
+        result2 = analyze_paths([str(bad)], baseline=reloaded)
+        assert result2.violations == []
+        assert [v.rule_id for v in result2.baselined] == [
+            "SWALLOWED_EXCEPTION"]
+        assert result2.summary == {"violations": 0, "baselined": 1}
+
+    def test_fingerprint_survives_line_shift(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent(SWALLOW_SRC))
+        result = analyze_paths([str(bad)], baseline=Baseline())
+        bl = Baseline().updated_with(result.violations)
+        # Shift the violation down: same symbol + line text => same
+        # fingerprint, so the baseline still matches.
+        bad.write_text("GREETING = 'hello'\n\n"
+                       + textwrap.dedent(SWALLOW_SRC))
+        result2 = analyze_paths([str(bad)], baseline=bl)
+        assert result2.violations == []
+        assert len(result2.baselined) == 1
+
+    def test_edited_line_escapes_baseline(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent(SWALLOW_SRC))
+        bl = Baseline().updated_with(
+            analyze_paths([str(bad)], baseline=Baseline()).violations)
+        # A semantic edit to the flagged line changes the fingerprint:
+        # the finding counts as NEW again (accepted debt cannot mutate).
+        bad.write_text(textwrap.dedent(SWALLOW_SRC).replace(
+            "except Exception:", "except BaseException:"))
+        result = analyze_paths([str(bad)], baseline=bl)
+        assert [v.rule_id for v in result.violations] == [
+            "SWALLOWED_EXCEPTION"]
+
+    def test_reason_preserved_on_regenerate(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent(SWALLOW_SRC))
+        vs = analyze_paths([str(bad)], baseline=Baseline()).violations
+        bl = Baseline().updated_with(vs)
+        bl.entries[0]["reason"] = "socket already dead"
+        bl2 = Baseline(bl.entries).updated_with(vs)
+        assert bl2.entries[0]["reason"] == "socket already dead"
+
+
+class TestCli:
+    def run_cli(self, *args):
+        return subprocess.run(
+            [sys.executable, "-m", "fluidframework_tpu.analysis", *args],
+            capture_output=True, text=True,
+            cwd=str(PACKAGE_DIR.parent))
+
+    def test_clean_file_exits_zero_with_summary(self, tmp_path):
+        ok = tmp_path / "ok.py"
+        ok.write_text("def f():\n    return 1\n")
+        proc = self.run_cli(str(ok))
+        assert proc.returncode == 0
+        assert json.loads(proc.stdout.strip().splitlines()[-1]) == {
+            "violations": 0, "baselined": 0}
+
+    def test_violation_exits_nonzero_with_summary(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text(textwrap.dedent(SWALLOW_SRC))
+        proc = self.run_cli(str(bad))
+        assert proc.returncode == 1
+        last = json.loads(proc.stdout.strip().splitlines()[-1])
+        assert last == {"violations": 1, "baselined": 0}
+        assert "SWALLOWED_EXCEPTION" in proc.stdout
+
+    def test_list_rules(self):
+        proc = self.run_cli("--list-rules")
+        assert proc.returncode == 0
+        for r in all_rules():
+            assert r.id in proc.stdout
+
+    def test_unknown_rule_id_is_a_clean_error(self):
+        proc = self.run_cli("--rule", "BOGUS")
+        assert proc.returncode == 2
+        assert "unknown rule id" in proc.stderr
+
+    def test_nonexistent_path_is_an_error_not_a_vacuous_pass(self):
+        proc = self.run_cli("no_such_dir/")
+        assert proc.returncode != 0
+        assert "do not exist" in proc.stderr
+
+    def test_empty_match_is_an_error_not_a_vacuous_pass(self, tmp_path):
+        proc = self.run_cli(str(tmp_path))  # exists, holds no .py files
+        assert proc.returncode == 2
+        assert "no Python files" in proc.stderr
+
+    def test_scoped_write_baseline_preserves_out_of_scope_entries(
+            self, tmp_path):
+        """--write-baseline over a subset of paths must merge, never
+        discard curated acceptances for files outside the scope."""
+        a, b = tmp_path / "a.py", tmp_path / "b.py"
+        a.write_text(textwrap.dedent(SWALLOW_SRC))
+        b.write_text(textwrap.dedent(SWALLOW_SRC))
+        bl_path = tmp_path / "bl.json"
+        proc = self.run_cli(str(a), str(b), "--baseline", str(bl_path),
+                            "--write-baseline")
+        assert proc.returncode == 0
+        entries = json.loads(bl_path.read_text())["entries"]
+        assert len(entries) == 2
+        # Scoped re-write over only a.py: b.py's entry must survive.
+        proc = self.run_cli(str(a), "--baseline", str(bl_path),
+                            "--write-baseline")
+        assert proc.returncode == 0
+        entries = json.loads(bl_path.read_text())["entries"]
+        assert len(entries) == 2
+        # Full-scope re-write after fixing a.py retires its stale entry.
+        a.write_text("def f():\n    return 1\n")
+        proc = self.run_cli(str(a), str(b), "--baseline", str(bl_path),
+                            "--write-baseline")
+        assert proc.returncode == 0
+        entries = json.loads(bl_path.read_text())["entries"]
+        assert len(entries) == 1
+        assert entries[0]["path"].endswith("b.py")
+
+
+# ---------------------------------------------------------------------------
+# runtime cross-checks: swallow counters + the retrace probe
+# ---------------------------------------------------------------------------
+
+class TestRuntimeCounters:
+    def setup_method(self):
+        counters.reset()
+
+    def test_record_swallow_counts(self):
+        counters.record_swallow("test.site")
+        counters.record_swallow("test.site")
+        assert counters.get("swallowed.test.site") == 2
+
+    def test_monitor_healthz_exports_counters(self):
+        from fluidframework_tpu.server.monitor import ServiceMonitor
+        import urllib.request
+        counters.record_swallow("test.healthz")
+        mon = ServiceMonitor().start()
+        try:
+            body = json.loads(urllib.request.urlopen(
+                mon.url + "/healthz", timeout=5).read())
+            assert body["counters"]["swallowed.test.healthz"] == 1.0
+            report = mon.report()
+            assert report["counters"]["swallowed.test.healthz"] == 1.0
+        finally:
+            mon.stop()
+
+    def test_retrace_probe_counts_cache_growth(self):
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+        probed = counters.JitRetraceProbe(jax.jit(lambda x: x + 1),
+                                          name="test.kernel")
+        probed(jnp.zeros((4,), jnp.int32))
+        # First signature: a compile, not a retrace.
+        assert counters.get("test.kernel.compiles") == 1
+        assert counters.get("test.kernel.retraces") == 0
+        probed(jnp.zeros((4,), jnp.int32))  # cache hit: no growth
+        assert counters.get("test.kernel.compiles") == 1
+        # New shape after warmup: that is the retrace signal.
+        probed(jnp.zeros((8,), jnp.int32))
+        assert counters.get("test.kernel.retraces") == 1
+        assert counters.get("kernel.retrace_count") == 1
+
+    def test_probe_over_warm_cache_counts_compile_not_retrace(self):
+        """A probe attached to an already-warm jitted fn must treat the
+        first growth IT observes as a compile, never a phantom retrace;
+        pre-probe compiles by other callers are not charged to it."""
+        jax = pytest.importorskip("jax")
+        import jax.numpy as jnp
+        fn = jax.jit(lambda x: x * 2)
+        fn(jnp.zeros((4,), jnp.int32))  # warmed by another caller
+        probed = counters.JitRetraceProbe(fn, name="test.warm")
+        probed(jnp.zeros((4,), jnp.int32))  # cache hit: nothing to count
+        assert counters.get("test.warm.compiles") == 0
+        probed(jnp.zeros((8,), jnp.int32))  # first growth WE observe
+        assert counters.get("test.warm.compiles") == 1
+        assert counters.get("test.warm.retraces") == 0
+        probed(jnp.zeros((16,), jnp.int32))  # growth after growth: retrace
+        assert counters.get("test.warm.retraces") == 1
+
+    def test_sequencer_batched_apply_is_probed(self):
+        from fluidframework_tpu.server import tpu_sequencer
+        assert isinstance(tpu_sequencer._apply_keep_batched,
+                          counters.JitRetraceProbe)
+        assert tpu_sequencer._apply_keep_batched.name == \
+            "kernel.merge_apply_batched"
+
+
+# ---------------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------------
+
+class TestRepoGate:
+    def test_package_is_clean_against_baseline(self):
+        """The hard gate: the analyzer over the whole package must come
+        back clean (every finding fixed, suppressed with a reason, or
+        baselined with a reason). A new kernel or lambda hazard fails
+        tier-1 right here."""
+        result = analyze_paths([str(PACKAGE_DIR)], baseline=Baseline.load())
+        rendered = "\n".join(v.render() for v in result.violations)
+        assert result.violations == [], (
+            f"new fluidlint violations:\n{rendered}\n"
+            f"Fix them, suppress inline with a reason, or baseline via "
+            f"python -m fluidframework_tpu.analysis --write-baseline")
+        assert result.files > 100  # the walk actually covered the package
+
+    def test_baseline_entries_all_still_match(self):
+        """Stale baseline entries (fixed code, lingering acceptance) rot
+        the gate; regenerating keeps violations+baselined == reality."""
+        result = analyze_paths([str(PACKAGE_DIR)], baseline=Baseline.load())
+        assert len(result.baselined) == len(Baseline.load()), (
+            "baseline has entries no longer observed; regenerate with "
+            "--write-baseline to drop them")
+
+    def test_baseline_reasons_filled_in(self):
+        for entry in Baseline.load().entries:
+            assert entry["reason"] and "TODO" not in entry["reason"], (
+                f"baseline entry {entry['fingerprint']} "
+                f"({entry['path']}) has no justification")
