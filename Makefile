@@ -2,7 +2,7 @@
 # must be a one-liner anyone can repeat).
 
 .PHONY: test soak bench dryrun record-corpus historian-smoke \
-	lint-analysis check
+	summarize-smoke lint-analysis check
 
 test:
 	python -m pytest tests/ -q
@@ -15,8 +15,16 @@ test:
 lint-analysis:
 	python -m fluidframework_tpu.analysis fluidframework_tpu/
 
-# The pre-merge gate: static analysis + the full test suite.
-check: lint-analysis test
+# CPU smoke of the incremental summarize path: tiny batch, 100%- vs
+# 1%-dirty fused extraction, narrow-wire byte drop + bit-identity, and
+# the MergeLaneStore blob cache. Exits non-zero if any acceptance
+# property regresses; prints one JSON line with the backend stamped.
+summarize-smoke:
+	JAX_PLATFORMS=cpu python bench.py summarize-smoke
+
+# The pre-merge gate: static analysis + the summarize smoke + the full
+# test suite.
+check: lint-analysis summarize-smoke test
 
 # The round-end randomized-evidence ritual: 50-trial soaks over every
 # differential surface (bulk catch-up, serving fast path, matrix/

@@ -384,6 +384,36 @@ def assemble_entries(packed, payloads: PayloadTable, doc: int,
     return out
 
 
+def assemble_snapshot(packed, payloads: PayloadTable, doc: int,
+                      min_seq: int, seq: int,
+                      chunk_chars: int = 10000) -> dict:
+    """One document's chunked snapshot dict {"header", "chunks"} from a
+    batched device extraction — the host half of a summarize pass
+    (assemble_entries + chunk_entries + the SnapshotV1-shaped header,
+    snapshotV1.ts:33-40). Chunks arrive wire-encoded (JSON-safe): Items
+    and Run payloads encode via runs.encode_entry_payloads so the
+    materialized-snapshot writer can json.dumps them directly. The
+    summarize blob cache (server MergeLaneStore) stores exactly this
+    dict per (lane, summarize epoch)."""
+    from .constants import SEG_MARKER
+    from .runs import encode_entry_payloads
+
+    entries = assemble_entries(packed, payloads, doc, min_seq=min_seq)
+    total = sum((1 if e["kind"] == SEG_MARKER else len(e["text"]))
+                for e in entries if e.get("removedSeq") is None)
+    chunks = [encode_entry_payloads(c)
+              for c in chunk_entries(entries, chunk_chars)]
+    return {
+        "header": {
+            "sequenceNumber": seq,
+            "minimumSequenceNumber": min_seq,
+            "totalLength": total,
+            "chunkCount": len(chunks),
+        },
+        "chunks": chunks,
+    }
+
+
 def chunk_entries(entries: List[dict], chunk_chars: int = 10000
                   ) -> List[List[dict]]:
     """Split snapshot entries into body chunks of ~chunk_chars characters

@@ -465,19 +465,29 @@ def _gather_segments(s: DocState, src: jnp.ndarray) -> DocState:
     )
 
 
-def _compact_one(s: DocState) -> DocState:
-    """Free segments removed at-or-before min_seq (reference zamboni,
-    mergeTree.ts:1422): stable-partition live segments to the front."""
+def _pack_src(s: DocState):
+    """The keep-mask + prefix-sum + scatter-to-gather addressing SHARED by
+    zamboni compaction and snapshot extraction: both left-pack exactly the
+    not-yet-zambonied rows (everything not removed at-or-before min_seq).
+    Returns (src, n): gather sources per output slot and the live count."""
     c = s.capacity
     idx = jnp.arange(c, dtype=jnp.int32)
     valid = idx < s.count
     keep = valid & ~(s.rem_seq <= s.min_seq)
-    new_count = jnp.sum(keep.astype(jnp.int32))
+    n = jnp.sum(keep.astype(jnp.int32))
     # Destination of each kept row; gather formulation: for each output slot
     # j, source = index of the (j+1)-th kept row.
     order = jnp.cumsum(keep.astype(jnp.int32)) - 1  # dest slot per kept row
     src = jnp.full((c,), c - 1, jnp.int32)
     src = src.at[jnp.where(keep, order, c)].set(idx, mode="drop")
+    return src, n
+
+
+def _compact_one(s: DocState) -> DocState:
+    """Free segments removed at-or-before min_seq (reference zamboni,
+    mergeTree.ts:1422): stable-partition live segments to the front."""
+    c = s.capacity
+    src, new_count = _pack_src(s)
     g = _gather_segments(s, src)
     pad = jnp.arange(c) >= new_count
     g = g._replace(
@@ -517,17 +527,22 @@ def _extract_one(s: DocState):
     into a dense output, so the host reads exactly the live rows instead
     of scanning the whole capacity (reference snapshotV1.ts:33 segment
     gather via mapRange, batched; the snapshot stays loadable mid-window)."""
-    c = s.capacity
-    idx = jnp.arange(c, dtype=jnp.int32)
-    valid = idx < s.count
-    keep = valid & ~(s.rem_seq <= s.min_seq)
-    n = jnp.sum(keep.astype(jnp.int32))
-    order = jnp.cumsum(keep.astype(jnp.int32)) - 1
-    src = jnp.full((c,), c - 1, jnp.int32)
-    src = src.at[jnp.where(keep, order, c)].set(idx, mode="drop")
+    src, n = _pack_src(s)
     return (s.origin_op[src], s.origin_off[src], s.length[src],
             s.anno[src], s.ins_seq[src], s.ins_client[src],
             s.rem_seq[src], s.rem_clients[src, 0], n)
+
+
+def _compact_extract_one(s: DocState):
+    """Fused zamboni + extraction: ONE keep-mask/prefix-sum/gather serves
+    both the compacted next state and the packed snapshot rows (they are
+    the same left-pack — extraction keeps exactly what compaction keeps),
+    so a summarize pass pays one device program instead of two and the
+    packed rows are post-GC minimal. Extraction columns read from the
+    compacted rows, so padding slots carry clean blanks, not stale data."""
+    g = _compact_one(s)
+    return g, (g.origin_op, g.origin_off, g.length, g.anno, g.ins_seq,
+               g.ins_client, g.rem_seq, g.rem_clients[:, 0], g.count)
 
 
 @jax.jit
@@ -540,6 +555,64 @@ def extract_visible_batched(state: DocState):
     return jax.vmap(_extract_one)(state)
 
 
+@jax.jit
+# fluidlint: disable=MISSING_DONATE — non-donating by design: the serving
+# extract path retains the pre-compaction bucket state until the caller
+# adopts the compacted result (mirrors the *_keep apply family).
+def compact_extract_batched(state: DocState):
+    """Fused zamboni + snapshot extraction over a [B, ...] batch: returns
+    (compacted_state, packed) from ONE jitted dispatch. `packed` has the
+    extract_visible_batched layout; `compacted_state` is the post-GC state
+    the caller may adopt in place of the input (bit-identical to
+    compact_batched(state), locked by tests/test_narrow_wire.py)."""
+    return jax.vmap(_compact_extract_one)(state)
+
+
+def _gather_rows(state, idx):
+    return jax.tree_util.tree_map(
+        lambda x: x[idx] if getattr(x, "ndim", 0) else x, state)
+
+
+# Probed: the dirty-lane sub-batch gather must NOT recompile per distinct
+# dirty count — gather_rows_pow2 pads the index vector to a power of two
+# precisely so the compiled variants stay bounded at log2(B). The probe
+# (telemetry.counters.JitRetraceProbe) counts cache growth as
+# kernel.extract_gather.* and feeds kernel.retrace_count; the regression
+# lock is tests/test_narrow_wire.py::TestGatherRowsPow2.
+_gather_rows_jit = None
+
+
+def pad_pow2_indices(rows):
+    """Host ints -> (int32 index vector zero-padded to the next power of
+    two, real count). The pow2 pad is THE retrace bound for every
+    dynamic-count gather on the summarize path: the jit cache holds
+    log2(B) variants instead of one per distinct dirty count."""
+    import numpy as np
+
+    idx = np.asarray(rows, np.int32).reshape(-1)
+    n = idx.size
+    n_pad = 1 << max(n - 1, 0).bit_length()
+    idx_p = np.zeros(n_pad, np.int32)
+    idx_p[:n] = idx
+    return idx_p, n
+
+
+def gather_rows_pow2(state, rows):
+    """Gather batch rows `rows` (host ints) of a [B, ...] state tree into
+    a power-of-two-padded sub-batch (padding repeats row 0 — callers index
+    only the first len(rows) rows). Returns (sub_state, n). The pow2 pad
+    bounds the jit cache at log2(B) variants instead of one per distinct
+    dirty count (the retrace hazard bench.py's extract_dirty used to
+    carry)."""
+    global _gather_rows_jit
+    if _gather_rows_jit is None:
+        from ..telemetry.counters import JitRetraceProbe
+        _gather_rows_jit = JitRetraceProbe(jax.jit(_gather_rows),
+                                           name="kernel.extract_gather")
+    idx_p, n = pad_pow2_indices(rows)
+    return _gather_rows_jit(state, jnp.asarray(idx_p)), n
+
+
 @functools.partial(jax.jit, static_argnums=1)
 def _slice_stack(cols, mx):
     return jnp.stack([c[:, :mx] for c in cols])
@@ -550,7 +623,99 @@ def _slice_rows(x, mx):
     return x[:, :mx]
 
 
-def fetch_extracted(packed) -> tuple:
+# Narrow-wire bound: deltas/values above this fall back to the exact
+# int32 plane refetch for the overflowing docs (headroom under int16 max
+# mirrors serve_step's 32000 msn-delta cutoff).
+_NARROW_MAX = 32000
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def _narrow_pack(packed, mx):
+    """Device-side narrow delta packing of an extraction result: the
+    bounded columns (length, origin_off, ins_client, rem_client, anno id
+    deltas) ride int16, the seq columns delta-encode against a per-doc
+    base (min live value — within one collab window deltas are small)
+    with sentinel codes for pending/no-remove, and a per-doc ok bit
+    flags any doc whose values escape the narrow range (the host then
+    refetches that doc's exact int32 planes — the same trick as
+    serve_step's int16 window results). origin_op stays int32: payload
+    ids are unbounded and not seq-shaped. Cuts extraction D2H bytes
+    roughly in half (asserted by tests/test_narrow_wire.py)."""
+    (origin_op, origin_off, length, anno, ins_seq, ins_client,
+     rem_seq, rem_client, counts) = packed
+
+    def sl(x):
+        return x[:, :mx]
+
+    length, origin_off = sl(length), sl(origin_off)
+    ins_seq, ins_client = sl(ins_seq), sl(ins_client)
+    rem_seq, rem_client = sl(rem_seq), sl(rem_client)
+    op32 = sl(origin_op)
+    anno_m = anno[:, :mx, :]
+    j = jnp.arange(mx, dtype=jnp.int32)
+    live = j[None, :] < counts[:, None]
+    big = jnp.int32(1 << 30)
+
+    ins_acked = live & (ins_seq != DEV_UNASSIGNED)
+    base_ins = jnp.min(jnp.where(ins_acked, ins_seq, big), axis=1)
+    base_ins = jnp.where(base_ins == big, 0, base_ins)
+    d_ins = jnp.where(ins_acked, ins_seq - base_ins[:, None], -1)
+
+    rem_real = live & (rem_seq != DEV_NO_REMOVE) & \
+        (rem_seq != DEV_UNASSIGNED)
+    base_rem = jnp.min(jnp.where(rem_real, rem_seq, big), axis=1)
+    base_rem = jnp.where(base_rem == big, 0, base_rem)
+    d_rem = jnp.where(rem_real, rem_seq - base_rem[:, None],
+                      jnp.where(live & (rem_seq == DEV_UNASSIGNED), -2, -1))
+
+    anno_live = live[:, :, None] & (anno_m >= 0)
+    base_anno = jnp.min(jnp.where(anno_live, anno_m, big), axis=(1, 2))
+    base_anno = jnp.where(base_anno == big, 0, base_anno)
+    d_anno = jnp.where(anno_live, anno_m - base_anno[:, None, None], -1)
+
+    def in_range(x, m):
+        masked = jnp.where(m, x, 0)
+        axes = tuple(range(1, x.ndim))
+        return jnp.all((masked >= -2) & (masked <= _NARROW_MAX), axis=axes)
+
+    ok = (in_range(length, live) & in_range(origin_off, live)
+          & in_range(ins_client, live) & in_range(rem_client, live)
+          & in_range(d_ins, live) & in_range(d_rem, live)
+          & in_range(d_anno, anno_live))
+
+    def n16(x):
+        # fluidlint: disable=DTYPE_DRIFT — deliberate narrow wire packing
+        # (host decodes back to int32; overflow guarded by the ok bit).
+        return jnp.clip(x, -(1 << 15), (1 << 15) - 1).astype(jnp.int16)
+
+    stacked16 = jnp.stack([
+        n16(jnp.where(live, length, 0)),
+        n16(jnp.where(live, origin_off, 0)),
+        n16(jnp.where(live, ins_client, -1)),
+        n16(jnp.where(live, rem_client, -1)),
+        n16(d_ins), n16(d_rem)])
+    meta = jnp.stack([base_ins, base_rem, base_anno,
+                      ok.astype(jnp.int32)])
+    return stacked16, n16(d_anno), op32, meta
+
+
+@functools.partial(jax.jit, static_argnums=2)
+def _exact_rows(packed, idx, mx):
+    """Exact int32 planes for the (rare) docs whose values escape the
+    narrow range: one stacked gather per refetch, idx pow2-padded by the
+    caller so the compiled variants stay bounded."""
+    (origin_op, origin_off, length, anno, ins_seq, ins_client,
+     rem_seq, rem_client, _counts) = packed
+
+    def take(x):
+        return x[idx, :mx]
+
+    return (jnp.stack([take(origin_op), take(origin_off), take(length),
+                       take(ins_seq), take(ins_client), take(rem_seq),
+                       take(rem_client)]), anno[idx, :mx, :])
+
+
+def fetch_extracted(packed, narrow: bool = True) -> tuple:
     """Host fetch of an extraction result, sliced to the batch's max live
     row count BEFORE the transfer: with left-packed rows everything past
     max(counts) is padding, so this cuts D2H bytes by C/max_count — and
@@ -559,8 +724,18 @@ def fetch_extracted(packed) -> tuple:
     5.3s -> 2.5s for 10k docs). The slice width buckets to a multiple of
     32 so the jitted slice/stack programs cache across calls (up to
     capacity/32 variants — counts drift slowly, so in practice a handful;
-    tighter than power-of-two slicing by up to 37% of the bytes)."""
+    tighter than power-of-two slicing by up to 37% of the bytes).
+
+    narrow=True (default) additionally rides the bounded columns as int16
+    and delta-encodes the seq columns per doc (_narrow_pack), decoding
+    back to the EXACT int32 arrays host-side — callers see bit-identical
+    results either way; only the D2H bytes change (~2x fewer). Docs whose
+    values escape int16 refetch their exact planes (counted as
+    summarize.wire_refetch). Total transferred bytes accumulate in the
+    summarize.bytes_d2h counter."""
     import numpy as np
+
+    from ..telemetry import counters as _counters
 
     counts = np.asarray(packed[-1])
     mx = max(int(counts.max()) if counts.size else 0, 1)
@@ -568,25 +743,74 @@ def fetch_extracted(packed) -> tuple:
     # Bucket the slice width to a multiple of 32: bounded jit-cache
     # variants without inflating the transfer much beyond max(counts).
     mx = min(((mx + 31) // 32) * 32, capacity)
+    nbytes = counts.nbytes
 
-    cols = packed[:-1]
-    # Group stackable columns: same (ndim, dtype) 2-D planes stack into
-    # one [n, B, mx] transfer; anything else (e.g. 3-D anno) goes alone.
-    by_kind = {}
-    for i, x in enumerate(cols):
-        key = (x.ndim, str(x.dtype)) if x.ndim == 2 else ("solo", i)
-        by_kind.setdefault(key, []).append(i)
-    fetched: dict = {}
-    for key, idxs in by_kind.items():
-        if key[0] == 2 and len(idxs) > 1:
-            arr = np.asarray(_slice_stack(
-                tuple(cols[i] for i in idxs), mx))
-            for j, i in enumerate(idxs):
-                fetched[i] = arr[j]
-        else:
-            for i in idxs:
-                fetched[i] = np.asarray(_slice_rows(cols[i], mx))
-    return tuple(fetched[i] for i in range(len(cols))) + (counts,)
+    if not narrow:
+        cols = packed[:-1]
+        # Group stackable columns: same (ndim, dtype) 2-D planes stack
+        # into one [n, B, mx] transfer; anything else (3-D anno) alone.
+        by_kind = {}
+        for i, x in enumerate(cols):
+            key = (x.ndim, str(x.dtype)) if x.ndim == 2 else ("solo", i)
+            by_kind.setdefault(key, []).append(i)
+        fetched: dict = {}
+        for key, idxs in by_kind.items():
+            if key[0] == 2 and len(idxs) > 1:
+                arr = np.asarray(_slice_stack(
+                    tuple(cols[i] for i in idxs), mx))
+                nbytes += arr.nbytes
+                for j, i in enumerate(idxs):
+                    fetched[i] = arr[j]
+            else:
+                for i in idxs:
+                    fetched[i] = np.asarray(_slice_rows(cols[i], mx))
+                    nbytes += fetched[i].nbytes
+        _counters.increment("summarize.bytes_d2h", nbytes)
+        return tuple(fetched[i] for i in range(len(cols))) + (counts,)
+
+    stacked16, anno16, op32, meta = _narrow_pack(packed, mx)
+    s16 = np.asarray(stacked16)
+    a16 = np.asarray(anno16)
+    op32 = np.asarray(op32)
+    meta = np.asarray(meta)
+    nbytes += s16.nbytes + a16.nbytes + op32.nbytes + meta.nbytes
+    base_ins, base_rem, base_anno, ok = meta
+
+    length = s16[0].astype(np.int32)
+    origin_off = s16[1].astype(np.int32)
+    ins_client = s16[2].astype(np.int32)
+    rem_client = s16[3].astype(np.int32)
+    d_ins = s16[4].astype(np.int32)
+    ins_seq = np.where(d_ins < 0, np.int32(DEV_UNASSIGNED),
+                       base_ins[:, None] + d_ins).astype(np.int32)
+    d_rem = s16[5].astype(np.int32)
+    rem_seq = np.where(
+        d_rem == -1, np.int32(DEV_NO_REMOVE),
+        np.where(d_rem == -2, np.int32(DEV_UNASSIGNED),
+                 base_rem[:, None] + d_rem)).astype(np.int32)
+    d_anno = a16.astype(np.int32)
+    anno = np.where(d_anno < 0, np.int32(-1),
+                    base_anno[:, None, None] + d_anno).astype(np.int32)
+
+    bad = np.nonzero(ok == 0)[0]
+    if bad.size:
+        # Exact-plane refetch for the overflowing docs only.
+        _counters.increment("summarize.wire_refetch", int(bad.size))
+        idx_p, _ = pad_pow2_indices(bad)
+        planes, anno_x = _exact_rows(packed, jnp.asarray(idx_p), mx)
+        planes = np.asarray(planes)
+        anno_x = np.asarray(anno_x)
+        nbytes += planes.nbytes + anno_x.nbytes
+        op32 = np.array(op32)  # the zero-copy device view is read-only
+        for k, d in enumerate(bad):
+            op32[d], origin_off[d], length[d] = (
+                planes[0, k], planes[1, k], planes[2, k])
+            ins_seq[d], ins_client[d] = planes[3, k], planes[4, k]
+            rem_seq[d], rem_client[d] = planes[5, k], planes[6, k]
+            anno[d] = anno_x[k]
+    _counters.increment("summarize.bytes_d2h", nbytes)
+    return (op32, origin_off, length, anno, ins_seq, ins_client,
+            rem_seq, rem_client, counts)
 
 
 # ---------------------------------------------------------------------------

@@ -72,6 +72,19 @@ class LruTtlCache:
             self.bytes_served += nbytes
             return value
 
+    def contains(self, key: Hashable) -> bool:
+        """Non-counting presence probe (no hit/miss accounting, no LRU
+        touch): callers deciding whether work CAN be skipped — e.g. the
+        historian's shared-subtree prefetch cutoff — must not skew the
+        hit-rate stats operators alert on."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return False
+            expires_at = entry[2]
+            return expires_at is None or now < expires_at
+
     def put(self, key: Hashable, value: Any, nbytes: int = 0,
             ttl_s: Optional[float] = -1.0) -> None:
         """ttl_s: -1.0 (default) inherits the cache-level TTL; None pins

@@ -226,6 +226,7 @@ class HistorianTier:
         self.metrics = metrics
         self.upstream_fetches = 0
         self.prefetched_objects = 0
+        self.prefetch_shared_trees = 0
         self.summary_reads = 0
         self.summary_writes = 0
         self.invalidations = 0
@@ -392,9 +393,24 @@ class HistorianTier:
 
     def _prefetch_tree(self, tenant_id: str, document_id: str,
                        tree_sha: str, token: Optional[str]) -> None:
+        # Incremental summaries share unchanged subtrees with the parent
+        # commit (clean channels ride as handles / identical shas), and
+        # the object cache keys by BARE sha (content-addressed — see
+        # get_object), so a shared subtree's walk is all cache hits:
+        # upstream prefetch traffic scales with the CHANGED set. The
+        # descent itself is NOT skipped on a cached tree sha — a blob
+        # evicted under a still-cached tree must re-warm, or eviction
+        # would silently break warm-on-summary forever. Shared subtrees
+        # are counted (prefetchSharedTrees) so operators can see the
+        # incremental sharing rate.
+        shared = self.objects.contains(tree_sha)
         tree = self.get_object(tenant_id, document_id, tree_sha, token)
         if tree is None or tree.get("kind") != "tree":
             return
+        if shared:
+            self.prefetch_shared_trees += 1
+            if self.metrics is not None:
+                self.metrics.increment("historian.prefetchSharedTrees")
         for _, (kind, sha) in tree["entries"].items():
             if kind == "tree":
                 self._prefetch_tree(tenant_id, document_id, sha, token)
@@ -409,6 +425,7 @@ class HistorianTier:
             "auth": self.auth.stats(),
             "upstreamFetches": self.upstream_fetches,
             "prefetchedObjects": self.prefetched_objects,
+            "prefetchSharedTrees": self.prefetch_shared_trees,
             "summaryReads": self.summary_reads,
             "summaryWrites": self.summary_writes,
             "invalidations": self.invalidations,

@@ -15,7 +15,7 @@ from typing import Callable, Dict, Optional
 from ...protocol.messages import DocumentMessage, MessageType, \
     SequencedDocumentMessage
 from ...protocol.protocol_handler import ProtocolOpHandler, ProtocolState
-from ...telemetry.counters import record_swallow
+from ...telemetry.counters import increment, record_swallow
 from ..database import Collection
 from ..log import QueuedMessage
 from ..storage import GitStore, Historian
@@ -114,6 +114,10 @@ class ScribeLambda(IPartitionLambda):
             return
         # Valid: advance the main ref and ack with the commit handle.
         store.set_ref("main", commit_sha)
+        # Commit rate beside the summarize.* extraction counters: an
+        # incremental-summary regression shows up as bytes/commit (or
+        # blob-cache hit rate) drifting, not as a single number.
+        increment("summarize.commits")
         if self.on_commit is not None:
             try:
                 self.on_commit(doc_id, commit_sha)

@@ -102,6 +102,29 @@ class ServiceMonitor:
         counters plus hit rate, live at request time."""
         self.add_probe(name, historian.stats)
 
+    def watch_summaries(self, name: str, merge_store) -> None:
+        """Probe over a MergeLaneStore's incremental-summarization state:
+        dirty lane count (channels past their summarize epoch), cached
+        blob count, and the summarize.* process counters rolled into a
+        blob-cache hit rate — the health-report view of the dirty-epoch
+        extraction path."""
+
+        def probe() -> dict:
+            snap = process_counters.snapshot()
+            hits = snap.get("summarize.blob_cache.hits", 0.0)
+            misses = snap.get("summarize.blob_cache.misses", 0.0)
+            return {
+                "dirtyLanes": len(merge_store.dirty_keys()),
+                "cachedBlobs": merge_store.cached_blob_count(),
+                "blobCacheHitRate": hits / max(hits + misses, 1.0),
+                "extractMs": snap.get("summarize.extract_ms", 0.0),
+                "dirtyDocs": snap.get("summarize.dirty_docs", 0.0),
+                "bytesD2H": snap.get("summarize.bytes_d2h", 0.0),
+                "wireRefetches": snap.get("summarize.wire_refetch", 0.0),
+            }
+
+        self.add_probe(name, probe)
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ServiceMonitor":
         self._thread = threading.Thread(target=self._httpd.serve_forever,

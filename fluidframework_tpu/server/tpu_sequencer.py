@@ -313,6 +313,20 @@ class MergeLaneStore:
         # (per-ref, reference SummaryTracker/trackState server-side).
         self.change_gen: Dict[tuple, int] = {}
         self._gen_counter = 0
+        # Summarize epoch (dirty-epoch extraction): the generation each
+        # lane was last assembled at, plus the assembled chunk blobs
+        # keyed by that generation. A clean lane (change_gen unchanged
+        # since its cached assembly) skips device extraction, the D2H
+        # transfer, AND host text/props assembly — the whole summarize
+        # pass scales with the dirty count. Entries: key ->
+        # (gen_at_dispatch, chunk_chars, snapshot dict). Callers must
+        # treat returned snapshots as immutable (they are shared).
+        # Memory: one assembled snapshot per live lane (~doc text size,
+        # same order as the payload table's live text); dropped lanes
+        # evict, dirty lanes overwrite — bounded by live state like the
+        # arena-block aging bound, not by ingest history.
+        self._snap_cache: Dict[tuple, tuple] = {}
+        self.last_summarized_gen: Dict[tuple, int] = {}
 
     # -- lane admission ----------------------------------------------------
     def lane_for(self, key: tuple) -> Tuple[int, int]:
@@ -325,6 +339,22 @@ class MergeLaneStore:
     def mark_dirty(self, key: tuple) -> None:
         self._gen_counter += 1
         self.change_gen[key] = self._gen_counter
+
+    def dirty_keys(self) -> set:
+        """Channels whose change generation advanced past the summarize
+        epoch (their last cached assembly) — what the next summarize
+        pass will actually extract. Snapshots `where` first: monitor
+        probes call this from the HTTP thread while the sequencing
+        thread admits/drops lanes, and iterating the live dict would
+        raise mid-mutation."""
+        return {k for k in list(self.where)
+                if self.change_gen.get(k, 0)
+                > self.last_summarized_gen.get(k, 0)}
+
+    def cached_blob_count(self) -> int:
+        """Assembled snapshots currently held by the summarize blob
+        cache (the public, monitor-safe view of _snap_cache)."""
+        return len(self._snap_cache)
 
     def drop(self, key: tuple) -> None:
         """Mark a channel opaque: an op arrived the server cannot model
@@ -342,6 +372,8 @@ class MergeLaneStore:
         for block in self._lane_blocks.pop(key, ()):
             self._release_block_ref(block, key)
         self._fold_skip.pop(key, None)
+        self._snap_cache.pop(key, None)
+        self.last_summarized_gen.pop(key, None)
 
     def _free_payload(self, op_id: int) -> None:
         self.free_payloads((op_id,))
@@ -788,6 +820,12 @@ class MergeLaneStore:
             bucket.put_rows([lanes[folded[k][0]] for k in adopted],
                             tm(lambda x: x[idx], redone))
             self.folds += len(adopted)
+            for k in adopted:
+                # The fold reseeded the rows (coalesced segmentation, new
+                # payload ids): any cached summary blob is stale even
+                # though the window's mark_dirty already fired — keep the
+                # epoch honest for callers that summarize mid-recovery.
+                self.mark_dirty(folded[k][1])
         counts = np.asarray(host_rows.count)
         bad_pos = {j: k for k, j in enumerate(bad_j)}
         for k, (j, key, cols, _, _) in enumerate(folded):
@@ -1002,6 +1040,11 @@ class MergeLaneStore:
                 dest.setdefault(nb, []).append((key, cols, mseq, cseq))
                 freed.append(lane)
                 self._fold_skip.pop(key, None)
+                # Reseeded rows = new segmentation + payload ids: a
+                # cached summary blob assembled before the fold no longer
+                # describes the lane — advance the change generation so
+                # dirty-epoch extraction re-assembles it.
+                self.mark_dirty(key)
                 self.folds += 1
                 self.fold_rows_reclaimed += int(counts[lane]) \
                     - len(entries)
@@ -1018,84 +1061,117 @@ class MergeLaneStore:
                 self._swap_fold_payloads(key, self._seed_ids(cols))
 
     # -- batched summary extraction ----------------------------------------
-    def extract_dispatch(self, only: Optional[set] = None) -> List[tuple]:
-        """Phase 1 (device, async): launch ONE extraction pass per bucket
-        (mask + prefix-sum packing, kernel.extract_visible_batched). The
-        returned jobs hold in-flight device arrays — jax dispatch is
-        asynchronous, so the caller can keep sequencing the next window
-        while these execute (the reference's pipeline-stage overlap,
+    def extract_dispatch(self, only: Optional[set] = None,
+                         chunk_chars: int = 10000) -> tuple:
+        """Phase 1 (device, async): launch ONE fused zamboni+extraction
+        pass per bucket (kernel.compact_extract_batched — compaction and
+        snapshot packing share a single keep-mask/prefix-sum/gather, and
+        the bucket adopts the compacted state). The returned jobs hold
+        in-flight device arrays — jax dispatch is asynchronous, so the
+        caller can keep sequencing the next window while these execute
+        (the reference's pipeline-stage overlap,
         kafka-service/README.md:58-60).
 
-        only: restrict to these channel keys (incremental summarization):
-        the dirty lanes gather into a pow2-padded sub-batch on device, so
+        Dirty-epoch extraction: lanes whose change generation still
+        matches their cached assembly (the summarize epoch) skip device
+        extraction entirely and return their previous blobs via the
+        second element. Remaining dirty lanes gather into a pow2-padded
+        sub-batch (kernel.gather_rows_pow2, bounded compile shapes), so
         extraction compute AND the D2H transfer scale with the dirty
-        count, not the fleet size."""
+        count, not the fleet size. `only` further restricts the keys
+        considered. Returns (jobs, cached_snapshots)."""
         jobs = []
+        cached: Dict[tuple, dict] = {}
         for bucket in self.buckets:
-            lanes = [(i, key) for i, key in enumerate(bucket.used)
-                     if key is not None and (only is None or key in only)]
+            lanes = []
+            live = 0
+            for i, key in enumerate(bucket.used):
+                if key is None:
+                    continue
+                live += 1
+                if only is not None and key not in only:
+                    continue
+                hit = self._snap_cache.get(key)
+                if hit is not None and hit[0] == self.change_gen.get(key, 0) \
+                        and hit[1] == chunk_chars:
+                    cached[key] = hit[2]
+                    continue
+                lanes.append((i, key))
             if not lanes:
                 continue
-            if only is None or len(lanes) == bucket.lanes:
-                packed = kernel.extract_visible_batched(bucket.state)
-                jobs.append((packed, lanes, bucket.state.seq,
-                             bucket.state.min_seq))
+            # Generations captured AT DISPATCH: ops applied while an async
+            # assembly is in flight advance change_gen past these, so the
+            # cache entry written later correctly reads as stale.
+            gens = {key: self.change_gen.get(key, 0) for _, key in lanes}
+            if len(lanes) == live:
+                # Every live lane extracts: fuse over the whole bucket
+                # state and adopt the compacted result (the summarize
+                # pass IS this tick's zamboni for these lanes).
+                new_state, packed = kernel.compact_extract_batched(
+                    bucket.state)
+                bucket.state = new_state
+                jobs.append((packed, lanes, new_state.seq,
+                             new_state.min_seq, gens))
             else:
-                take = np.asarray([i for i, _ in lanes], np.int32)
-                n_pad = 1 << max(len(take) - 1, 0).bit_length()
-                take_p = np.concatenate(
-                    [take, np.zeros(n_pad - len(take), np.int32)])
-                idx = jnp.asarray(take_p)
-                sub = jax.tree_util.tree_map(lambda x: x[idx],
-                                             bucket.state)
-                packed = kernel.extract_visible_batched(sub)
+                sub, _n = kernel.gather_rows_pow2(
+                    bucket.state, [i for i, _ in lanes])
+                _, packed = kernel.compact_extract_batched(sub)
                 # Lane indices become sub-batch rows.
                 jobs.append((packed,
                              [(j, key) for j, (_, key)
                               in enumerate(lanes)],
-                             sub.seq, sub.min_seq))
-        return jobs
+                             sub.seq, sub.min_seq, gens))
+        if cached:
+            increment("summarize.blob_cache.hits", len(cached))
+        return jobs, cached
 
     def extract_assemble(self, jobs: List[tuple],
-                         chunk_chars: int = 10000) -> Dict[tuple, dict]:
+                         chunk_chars: int = 10000,
+                         cached: Optional[Dict[tuple, dict]] = None
+                         ) -> Dict[tuple, dict]:
         """Phase 2 (host): D2H transfer + text/props assembly touching only
-        the visible rows. Returns {lane_key: {"header", "chunks"}} — chunked
-        snapshot shape per reference SnapshotV1 (snapshotV1.ts:33-40)."""
-        from ..mergetree.host import assemble_entries, chunk_entries
+        the visible rows of the DIRTY lanes; clean lanes ride through from
+        the blob cache. Returns {lane_key: {"header", "chunks"}} — chunked
+        snapshot shape per reference SnapshotV1 (snapshotV1.ts:33-40).
+        Newly assembled snapshots enter the blob cache at their dispatch
+        generation, advancing the summarize epoch."""
+        from ..mergetree.host import assemble_snapshot
 
-        from ..mergetree.constants import SEG_MARKER
-
-        out: Dict[tuple, dict] = {}
-        for packed, lanes, seq_dev, min_seq_dev in jobs:
+        out: Dict[tuple, dict] = dict(cached or {})
+        for packed, lanes, seq_dev, min_seq_dev, gens in jobs:
+            t0 = time.perf_counter()
             packed = kernel.fetch_extracted(packed)
+            increment("summarize.extract_ms",
+                           (time.perf_counter() - t0) * 1000.0)
             seqs = np.asarray(seq_dev)
             min_seqs = np.asarray(min_seq_dev)
-            from ..mergetree.runs import encode_entry_payloads
             for lane, key in lanes:
-                entries = assemble_entries(packed, self.payloads, lane,
-                                           min_seq=int(min_seqs[lane]))
-                total = sum(
-                    (1 if e["kind"] == SEG_MARKER else len(e["text"]))
-                    for e in entries if e.get("removedSeq") is None)
-                # JSON-safe chunks: Items/Run payloads wire-encode (the
-                # materialized-snapshot writer json.dumps these).
-                chunks = [encode_entry_payloads(c)
-                          for c in chunk_entries(entries, chunk_chars)]
-                out[key] = {
-                    "header": {
-                        "sequenceNumber": int(seqs[lane]),
-                        "minimumSequenceNumber": int(min_seqs[lane]),
-                        "totalLength": total,
-                        "chunkCount": len(chunks),
-                    },
-                    "chunks": chunks,
-                }
+                snap = assemble_snapshot(
+                    packed, self.payloads, lane,
+                    min_seq=int(min_seqs[lane]), seq=int(seqs[lane]),
+                    chunk_chars=chunk_chars)
+                out[key] = snap
+                # Monotone adoption: an async worker finishing LATE must
+                # not clobber a newer-generation entry an interleaved
+                # synchronous summarize already cached, nor resurrect a
+                # cache entry for a lane drop() evicted mid-assembly
+                # (the snapshot would be retained forever for a channel
+                # that no longer exists).
+                if key not in self.where:
+                    continue
+                prev = self._snap_cache.get(key)
+                if prev is None or prev[0] <= gens[key]:
+                    self._snap_cache[key] = (gens[key], chunk_chars, snap)
+                self.last_summarized_gen[key] = max(
+                    self.last_summarized_gen.get(key, 0), gens[key])
+            increment("summarize.dirty_docs", len(lanes))
+            increment("summarize.blob_cache.misses", len(lanes))
         return out
 
     def extract_all(self, chunk_chars: int = 10000,
                     only: Optional[set] = None) -> Dict[tuple, dict]:
-        return self.extract_assemble(self.extract_dispatch(only),
-                                     chunk_chars)
+        jobs, cached = self.extract_dispatch(only, chunk_chars)
+        return self.extract_assemble(jobs, chunk_chars, cached)
 
     # -- queries -----------------------------------------------------------
     def text(self, key: tuple) -> Optional[str]:
@@ -3683,7 +3759,7 @@ class TpuSequencerLambda(IPartitionLambda):
         import threading
 
         self.drain()  # settle any deferred window before reading lanes
-        jobs = self.merge.extract_dispatch()
+        jobs, cached = self.merge.extract_dispatch(chunk_chars=chunk_chars)
         # LWW snapshots are host-cheap: capture them now so the composed
         # output matches the synchronous path (matrix cell stores).
         lww_part: Dict[tuple, dict] = {}
@@ -3702,7 +3778,7 @@ class TpuSequencerLambda(IPartitionLambda):
 
         def work():
             try:
-                out = self.merge.extract_assemble(jobs, chunk_chars)
+                out = self.merge.extract_assemble(jobs, chunk_chars, cached)
                 out.update(lww_part)
                 _compose_matrix_channels(out)
             finally:

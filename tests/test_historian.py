@@ -157,6 +157,39 @@ class TestHistorianTierStoreMode:
         # Only the ref lookup touched upstream; every object was warm.
         assert tier.upstream_fetches == fetches + 1
 
+    def test_prefetch_skips_shared_subtrees(self):
+        """Incremental summaries share unchanged subtrees by sha; the
+        warm-on-summary walk serves them straight from the cache — zero
+        upstream fetches beyond the changed set — and counts them
+        (prefetchSharedTrees must actually move, proving the shared
+        detection isn't dead code against the bare-sha cache keying)."""
+        store, tier = self._tier()
+        gstore = store.store("t", "d")
+
+        def two_channel(text_a: str, text_b: str) -> SummaryTree:
+            root = SummaryTree()
+            for name, text in (("a", text_a), ("b", text_b)):
+                ds = root.add_tree(name)
+                ds.add_blob("header", json.dumps({"text": text}))
+            return root
+
+        sha1 = gstore.write_summary(two_channel("one", "same"),
+                                    advance_ref=True)
+        tier.handle_summary_commit("t", "d", sha=sha1)
+        assert tier.prefetch_shared_trees == 0
+        # Second commit changes only channel "a": channel "b"'s subtree
+        # sha is unchanged and already warm from the first prefetch.
+        sha2 = gstore.write_summary(two_channel("two", "same"),
+                                    advance_ref=True,
+                                    base_commit=sha1)
+        fetched_before = tier.upstream_fetches
+        tier.handle_summary_commit("t", "d", sha=sha2)
+        assert tier.prefetch_shared_trees >= 1
+        assert tier.stats()["prefetchSharedTrees"] >= 1
+        # The shared subtree's blob was NOT re-fetched upstream.
+        walked = tier.upstream_fetches - fetched_before
+        assert walked <= 4, walked  # commit + root + changed subtree+blob
+
     def test_ttl_bounds_staleness_for_bypass_writers(self):
         store, tier = self._tier(ref_ttl_s=0.05)
         gstore = store.store("t", "d")

@@ -318,6 +318,66 @@ class TestServerIncrementalMaterialization:
         t2 = c2.runtime.get_datastore("default").get_channel("text")
         assert t2.get_text() == "alpha beta"
 
+    def test_blob_cache_reuses_clean_assemblies(self):
+        """A second summarize with nothing dirty re-serves every channel
+        from the blob cache (no device extraction, no host assembly);
+        editing one doc re-assembles only that channel."""
+        from fluidframework_tpu.telemetry import counters
+
+        server = TpuLocalServer()
+        loader = Loader(LocalDocumentServiceFactory(server))
+        texts = {}
+        for d in range(6):
+            c = loader.create_detached(f"bc{d}")
+            ds = c.runtime.create_datastore("default")
+            t = ds.create_channel("text", SharedString.TYPE)
+            c.attach()
+            t.insert_text(0, f"blob-{d} " * 10)
+            texts[f"bc{d}"] = t
+        seq = server.sequencer()
+        first = seq.summarize_documents()
+        h0 = counters.get("summarize.blob_cache.hits")
+        m0 = counters.get("summarize.blob_cache.misses")
+        second = seq.summarize_documents()
+        assert second == first
+        assert counters.get("summarize.blob_cache.hits") - h0 >= 6
+        assert counters.get("summarize.blob_cache.misses") == m0
+        texts["bc2"].insert_text(0, "EDIT ")
+        third = seq.summarize_documents()
+        key = ("bc2", "default", "text")
+        assert third[key] != first[key]
+        joined = "".join(e.get("text") or ""
+                         for chunk in third[key]["chunks"] for e in chunk
+                         if e.get("removedSeq") is None)
+        assert joined == texts["bc2"].get_text()
+        for d in range(6):
+            if d != 2:
+                assert third[("bc%d" % d, "default", "text")] == \
+                    first[("bc%d" % d, "default", "text")]
+
+    def test_async_summarize_matches_sync_with_cache(self):
+        """The async pipeline (dispatch now, assemble on a worker) sees
+        the same cached/dirty split as the synchronous path."""
+        import threading
+
+        server = TpuLocalServer()
+        loader, c, ds = make_doc(server, "async")
+        t = ds.create_channel("text", SharedString.TYPE)
+        c.attach()
+        t.insert_text(0, "async content " * 5)
+        seq = server.sequencer()
+        sync_out = seq.summarize_documents()
+        done = threading.Event()
+        result = {}
+
+        def on_done(out):
+            result["out"] = out
+            done.set()
+
+        seq.summarize_documents_async(on_done)
+        assert done.wait(timeout=30)
+        assert result["out"] == sync_out
+
     def test_dirty_subset_extraction_matches_full(self):
         """extract_dispatch(only=...) returns byte-identical snapshots to
         the full extraction for the selected channels."""
