@@ -2,7 +2,7 @@
 # must be a one-liner anyone can repeat).
 
 .PHONY: test soak bench dryrun record-corpus historian-smoke \
-	summarize-smoke lint-analysis check
+	summarize-smoke trace-smoke lint-analysis check
 
 test:
 	python -m pytest tests/ -q
@@ -22,9 +22,19 @@ lint-analysis:
 summarize-smoke:
 	JAX_PLATFORMS=cpu python bench.py summarize-smoke
 
-# The pre-merge gate: static analysis + the summarize smoke + the full
-# test suite.
-check: lint-analysis summarize-smoke test
+# CPU smoke of the tracing subsystem (docs/observability.md): a short
+# ingest burst at sample=1 must yield a complete submit->broadcast
+# trace carrying every named serving sub-span, the Prometheus
+# exposition must parse with monotone histogram buckets, the serving-
+# flush SLO verdict must appear in /health, and tracing overhead vs
+# tracing-off on the same burst must stay under 2% (stamped into the
+# record as trace_overhead_pct).
+trace-smoke:
+	JAX_PLATFORMS=cpu python bench.py trace-smoke
+
+# The pre-merge gate: static analysis + the summarize/trace smokes +
+# the full test suite.
+check: lint-analysis summarize-smoke trace-smoke test
 
 # The round-end randomized-evidence ritual: 50-trial soaks over every
 # differential surface (bulk catch-up, serving fast path, matrix/

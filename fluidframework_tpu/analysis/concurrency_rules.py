@@ -165,6 +165,119 @@ def listener_leak(ctx: ModuleContext) -> Iterator[Violation]:
                 f"({'/'.join(sorted(_REMOVE_NAMES))})")
 
 
+_SPAN_PRODUCERS = {"span", "start_span"}
+
+# Span discipline is enforced where spans matter operationally: the op
+# pipeline (client engine, drivers, server stages, telemetry itself).
+# "<memory>" keeps the fixture tests in scope.
+_SPAN_SCOPE_PREFIXES = (
+    "fluidframework_tpu/mergetree", "fluidframework_tpu/loader",
+    "fluidframework_tpu/server", "fluidframework_tpu/telemetry",
+    "<memory>")
+
+
+def _span_scope(ctx: ModuleContext) -> bool:
+    path = ctx.path.replace("\\", "/")
+    return any(path.startswith(p) or f"/{p}" in path
+               for p in _SPAN_SCOPE_PREFIXES)
+
+
+def _enclosing_scope(ctx: ModuleContext, node: ast.AST) -> ast.AST:
+    cur = ctx.parents.get(node)
+    while cur is not None and not isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+        cur = ctx.parents.get(cur)
+    return cur if cur is not None else ctx.tree
+
+
+def _span_end_calls(scope: ast.AST, name: str):
+    for sub in ast.walk(scope):
+        if (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in ("end", "cancel")
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id == name):
+            yield sub
+
+
+def _assign_block(ctx: ModuleContext, assign: ast.AST):
+    """The statement list the assignment sits in, plus its index."""
+    owner = ctx.parents.get(assign)
+    if owner is None:
+        return None, -1
+    for field in ("body", "orelse", "finalbody"):
+        block = getattr(owner, field, None)
+        if isinstance(block, list) and assign in block:
+            return block, block.index(assign)
+    return None, -1
+
+
+def _covered_by_finally(ctx: ModuleContext, scope: ast.AST,
+                        assign: ast.AST, call: ast.Call) -> bool:
+    """True when `call` (an end/cancel) sits in a Try's finalbody AND
+    that Try actually protects the code after the span start: the start
+    is inside the try body, or the Try is the statement IMMEDIATELY
+    after the start in the same block. A finally elsewhere in the
+    function proves nothing — an exception raised between the start and
+    that try still leaks the span."""
+    block, idx = _assign_block(ctx, assign)
+    for t in ast.walk(scope):
+        if not isinstance(t, ast.Try) or not t.finalbody:
+            continue
+        if not any(sub is call for stmt in t.finalbody
+                   for sub in ast.walk(stmt)):
+            continue
+        if any(sub is assign for stmt in t.body
+               for sub in ast.walk(stmt)):
+            return True
+        if block is not None and idx + 1 < len(block) \
+                and block[idx + 1] is t:
+            return True
+    return False
+
+
+@rule("SPAN_LEAK",
+      "Span started without context-manager or try/finally end() "
+      "protection",
+      family="concurrency",
+      rationale="A span whose end() sits in straight-line code never "
+                "closes when anything between start and end raises — the "
+                "trace shows a hole exactly where the failure happened, "
+                "and an unsampled-slow span (the always-sample-on-slow "
+                "policy's quarry) is lost entirely. Use `with "
+                "tracing.span(...)` or end in a finally block.")
+def span_leak(ctx: ModuleContext) -> Iterator[Violation]:
+    if not _span_scope(ctx):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        call = node.value
+        if not isinstance(call, ast.Call):
+            continue
+        if _dotted(call.func).rsplit(".", 1)[-1] not in _SPAN_PRODUCERS:
+            continue
+        names = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if not names:
+            continue
+        name = names[0]
+        scope = _enclosing_scope(ctx, node)
+        ends = list(_span_end_calls(scope, name))
+        if not ends:
+            yield ctx.violation(
+                "SPAN_LEAK", node,
+                f"span `{name}` is started but never end()ed in this "
+                f"scope; use `with` or end it in a finally block")
+        elif not any(_covered_by_finally(ctx, scope, node, e)
+                     for e in ends):
+            yield ctx.violation(
+                "SPAN_LEAK", node,
+                f"span `{name}` can exit without end(): no finally "
+                f"block that COVERS the span start ends it — an "
+                f"exception between start and end leaks the span; use "
+                f"`with` or a try/finally around the started region")
+
+
 def _mutable_default(node: ast.AST) -> bool:
     if isinstance(node, (ast.List, ast.Dict, ast.Set)):
         return True

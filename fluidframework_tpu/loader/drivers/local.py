@@ -8,6 +8,7 @@ from typing import List, Optional
 from ...protocol.messages import ITrace, SequencedDocumentMessage
 from ...protocol.summary import SummaryTree
 from ...server.local_server import LocalServer
+from ...telemetry import tracing
 from .base import (
     IDocumentDeltaConnection,
     IDocumentDeltaStorageService,
@@ -81,7 +82,16 @@ class LocalDocumentDeltaConnection(IDocumentDeltaConnection):
         self.client_id = self._conn.client_id
 
     def submit(self, messages) -> None:
-        self._conn.submit(messages)
+        # Adopt the context the client edit minted (same thread) — or
+        # mint one here — and put it on the wire: metadata rides the
+        # envelope end to end.
+        ctx = tracing.ensure_op_context()
+        if ctx is not None:
+            for msg in messages:
+                tracing.stamp_message(msg, ctx)
+        with tracing.span("driver.submit", parent=ctx,
+                          count=len(messages)):
+            self._conn.submit(messages)
 
     def submit_signal(self, content) -> None:
         self._conn.submit_signal(content)

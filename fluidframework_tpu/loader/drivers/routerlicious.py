@@ -27,6 +27,7 @@ from ...protocol.summary import (
     summary_tree_to_dict,
 )
 from ...server import websocket
+from ...telemetry import tracing
 from ...server.wire import (
     delta_rows_to_messages,
     document_message_to_dict,
@@ -264,10 +265,20 @@ class NetworkDocumentDeltaConnection(TypedEventEmitter,
     def submit(self, messages: List[DocumentMessage]) -> None:
         if self._closed:
             raise ConnectionError("connection closed")
-        self._ws.send_text(json.dumps({
-            "type": "submitOp",
-            "messages": [document_message_to_dict(m) for m in messages],
-        }))
+        # Trace context onto the wire: metadata serializes inside
+        # document_message_to_dict, so the context survives the socket
+        # hop into alfred's ingest verbatim.
+        ctx = tracing.ensure_op_context()
+        if ctx is not None:
+            for msg in messages:
+                tracing.stamp_message(msg, ctx)
+        with tracing.span("driver.submit", parent=ctx, transport="ws",
+                          count=len(messages)):
+            self._ws.send_text(json.dumps({
+                "type": "submitOp",
+                "messages": [document_message_to_dict(m)
+                             for m in messages],
+            }))
 
     def submit_signal(self, content) -> None:
         if self._closed:

@@ -20,6 +20,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional
 
 from ..core.events import TypedEventEmitter
+from ..telemetry import tracing
 from .constants import SEG_MARKER, SEG_TEXT, UNASSIGNED_SEQ
 from .oracle import Items, MergeTreeOracle, Segment
 
@@ -84,51 +85,73 @@ class MergeTreeClient(TypedEventEmitter):
         return self.tree.get_text()
 
     # -- local edits (return the wire op to submit) ------------------------
+    # Each local edit is where an op's TRACE is born: new_op_trace() head-
+    # samples a root context, the edit itself records as its first span,
+    # and the context parks thread-locally until the driver submit that
+    # ships the op adopts it onto the wire (telemetry/tracing.py).
     def insert_text_local(self, pos: int, text: str,
                           props: Optional[dict] = None) -> dict:
-        self.tree.insert_text(pos, text, self.tree.current_seq, self.client_id,
-                              UNASSIGNED_SEQ, props=props)
-        self.emit("delta", {"op": "insert", "pos": pos, "text": text}, True)
-        return make_insert_op(pos, text_seg(text, props))
+        with tracing.span("client.local_edit",
+                          parent=tracing.new_op_trace(), op="insertText"):
+            self.tree.insert_text(pos, text, self.tree.current_seq,
+                                  self.client_id, UNASSIGNED_SEQ,
+                                  props=props)
+            self.emit("delta", {"op": "insert", "pos": pos, "text": text},
+                      True)
+            return make_insert_op(pos, text_seg(text, props))
 
     def insert_marker_local(self, pos: int,
                             props: Optional[dict] = None) -> dict:
-        self.tree.insert_marker(pos, self.tree.current_seq, self.client_id,
-                                UNASSIGNED_SEQ, props=props)
-        self.emit("delta", {"op": "insertMarker", "pos": pos}, True)
-        return make_insert_op(pos, marker_seg(props))
+        with tracing.span("client.local_edit",
+                          parent=tracing.new_op_trace(), op="insertMarker"):
+            self.tree.insert_marker(pos, self.tree.current_seq,
+                                    self.client_id, UNASSIGNED_SEQ,
+                                    props=props)
+            self.emit("delta", {"op": "insertMarker", "pos": pos}, True)
+            return make_insert_op(pos, marker_seg(props))
 
     def insert_items_local(self, pos: int, values,
                            props: Optional[dict] = None) -> dict:
-        self.tree.insert_items(pos, values, self.tree.current_seq,
-                               self.client_id, UNASSIGNED_SEQ, props=props)
-        self.emit("delta", {"op": "insert", "pos": pos,
-                            "items": list(values)}, True)
-        return make_insert_op(pos, items_seg(values, props))
+        with tracing.span("client.local_edit",
+                          parent=tracing.new_op_trace(), op="insertItems"):
+            self.tree.insert_items(pos, values, self.tree.current_seq,
+                                   self.client_id, UNASSIGNED_SEQ,
+                                   props=props)
+            self.emit("delta", {"op": "insert", "pos": pos,
+                                "items": list(values)}, True)
+            return make_insert_op(pos, items_seg(values, props))
 
     def remove_range_local(self, start: int, end: int) -> dict:
-        # Capture removed content before applying so undo can reinsert it
-        # (text payloads only; permutation vectors carry non-str runs).
-        try:
-            removed = self.get_text()[start:end]
-        except TypeError:
-            removed = None
-        self.tree.remove_range(start, end, self.tree.current_seq,
-                               self.client_id, UNASSIGNED_SEQ)
-        args = {"op": "remove", "start": start, "end": end}
-        if isinstance(removed, str):
-            args["text"] = removed
-        self.emit("delta", args, True)
-        return make_remove_op(start, end)
+        with tracing.span("client.local_edit",
+                          parent=tracing.new_op_trace(), op="remove"):
+            # Capture removed content before applying so undo can reinsert
+            # it (text payloads only; permutation vectors carry non-str
+            # runs).
+            try:
+                removed = self.get_text()[start:end]
+            except TypeError:
+                removed = None
+            self.tree.remove_range(start, end, self.tree.current_seq,
+                                   self.client_id, UNASSIGNED_SEQ)
+            args = {"op": "remove", "start": start, "end": end}
+            if isinstance(removed, str):
+                args["text"] = removed
+            self.emit("delta", args, True)
+            return make_remove_op(start, end)
 
     def annotate_range_local(self, start: int, end: int, props: dict) -> dict:
-        # Per-span previous values (undo restores them; null deletes).
-        deltas = self.tree.get_range_property_deltas(start, end, props.keys())
-        self.tree.annotate_range(start, end, props, self.tree.current_seq,
-                                 self.client_id, UNASSIGNED_SEQ)
-        self.emit("delta", {"op": "annotate", "start": start, "end": end,
-                            "props": props, "propertyDeltas": deltas}, True)
-        return make_annotate_op(start, end, props)
+        with tracing.span("client.local_edit",
+                          parent=tracing.new_op_trace(), op="annotate"):
+            # Per-span previous values (undo restores them; null deletes).
+            deltas = self.tree.get_range_property_deltas(start, end,
+                                                         props.keys())
+            self.tree.annotate_range(start, end, props,
+                                     self.tree.current_seq, self.client_id,
+                                     UNASSIGNED_SEQ)
+            self.emit("delta", {"op": "annotate", "start": start,
+                                "end": end, "props": props,
+                                "propertyDeltas": deltas}, True)
+            return make_annotate_op(start, end, props)
 
     # -- sequenced message application ------------------------------------
     def apply_msg(self, op: dict, seq: int, ref_seq: int, client: int,

@@ -49,6 +49,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Tuple
 
 from ..protocol.summary import summary_tree_from_dict, summary_tree_to_dict
+from ..telemetry import tracing
 from ..telemetry.counters import increment, record_swallow
 from .auth import AuthError, TenantManager
 from .historian import TIER_HEADER, git_object_to_wire, notify_summary_commit
@@ -645,7 +646,15 @@ class AlfredService:
                     if oversized is not None:
                         on_nack(oversized)
                     else:
-                        conn.submit(messages)
+                        # Network ingest hop: the wire context (stamped
+                        # by the driver into metadata) parents alfred's
+                        # span, and the in-process pipeline nests below.
+                        with tracing.span(
+                                "alfred.ingest",
+                                parent=tracing.first_message_context(
+                                    messages),
+                                document=document_id):
+                            conn.submit(messages)
                 elif mtype == "submitSignal":
                     conn.submit_signal(msg.get("content"))
                 elif mtype == "disconnect":
@@ -764,7 +773,11 @@ class AlfredService:
                 send({"type": "nack", "cid": cid,
                       "nack": nack_to_dict(oversized)})
             else:
-                conn.submit(messages)
+                with tracing.span(
+                        "alfred.ingest",
+                        parent=tracing.first_message_context(messages),
+                        document=conn.document_id):
+                    conn.submit(messages)
         elif mtype == "submitSignal":
             conn.submit_signal(msg.get("content"))
         elif mtype == "disconnect_document":

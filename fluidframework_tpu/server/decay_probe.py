@@ -85,6 +85,8 @@ def run(docs: int, ops: int, waves: int) -> dict:
     if lam._pump is None:
         raise RuntimeError("native wirepump unavailable")
 
+    from fluidframework_tpu.telemetry import counters
+
     rates = []
     prebuilt = [build_wave(w) for w in range(waves)]
     for w, msgs in enumerate(prebuilt):
@@ -94,6 +96,11 @@ def run(docs: int, ops: int, waves: int) -> dict:
         lam.flush()
         lam.drain()
         rates.append(docs * ops / (time.perf_counter() - t0))
+        # Live gauge per wave: the monitor/health surface sees sustained-
+        # typing throughput (and its decay) while the probe runs, instead
+        # of the reading living only in this process's stdout.
+        counters.gauge("decay_probe.wave_ops_s", rates[-1])
+        counters.increment("decay_probe.waves")
     # Warmup (compiles, first promotions) = first quarter; classify the
     # rest into fast waves vs maintenance (fold) waves by median gap.
     tail = rates[waves // 4:]
@@ -106,6 +113,12 @@ def run(docs: int, ops: int, waves: int) -> dict:
     first_q = sorted(fast[:q])[q // 2]
     last_q = sorted(fast[-q:])[q // 2]
     import jax
+    decayed = bool(last_q * 2 < first_q)
+    # Final verdict + sustained rate into the process counters: a monitor
+    # watching this process (or a bench run embedding the probe) exports
+    # them via /health and /metrics.prom.
+    counters.gauge("decay_probe.sustained_ops_s", sustained)
+    counters.gauge("decay_probe.decayed", 1.0 if decayed else 0.0)
     return {
         "backend": jax.default_backend(),
         "docs": docs, "ops_per_wave": ops, "waves": waves,
@@ -118,7 +131,7 @@ def run(docs: int, ops: int, waves: int) -> dict:
         "payload_compactions": lam.merge.payload_compactions,
         "blocks_aged": lam.merge.blocks_aged,
         "overflow_drops": lam.merge.overflow_drops,
-        "decayed": bool(last_q * 2 < first_q),
+        "decayed": decayed,
     }
 
 

@@ -46,6 +46,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 from ..protocol.summary import summary_tree_from_dict
+from ..telemetry import tracing
 from ..telemetry.counters import increment, record_swallow
 from ..telemetry.logger import PerformanceEvent, TelemetryLogger
 from .cache import LruTtlCache
@@ -281,16 +282,25 @@ class HistorianTier:
                           token: Optional[str] = None) -> Optional[dict]:
         """The drivers' summary download: the full tree in
         summary_tree_to_dict wire form, every object through the cache."""
-        if commit_sha is not None:
-            self.ensure_authorized(tenant_id, document_id, token)
-        sha = commit_sha or self.get_ref(tenant_id, document_id, ref, token)
-        if sha is None:
-            return None
-        commit = self.get_object(tenant_id, document_id, sha, token)
-        if commit is None or commit.get("kind") != "commit":
-            return None
-        self.summary_reads += 1
-        return self._tree_dict(tenant_id, document_id, commit["tree"], token)
+        # Tail attribution for loads: the read joins the requesting op's
+        # trace when the ambient context carries one; the histogram feeds
+        # /metrics.prom either way.
+        with tracing.span("historian.read_summary",
+                          hist="historian.read_summary",
+                          document=document_id) as sp:
+            if commit_sha is not None:
+                self.ensure_authorized(tenant_id, document_id, token)
+            sha = commit_sha or self.get_ref(tenant_id, document_id, ref,
+                                             token)
+            if sha is None:
+                return None
+            commit = self.get_object(tenant_id, document_id, sha, token)
+            if commit is None or commit.get("kind") != "commit":
+                return None
+            self.summary_reads += 1
+            sp.set(sha=sha)
+            return self._tree_dict(tenant_id, document_id, commit["tree"],
+                                   token)
 
     def _tree_dict(self, tenant_id: str, document_id: str, tree_sha: str,
                    token: Optional[str]) -> dict:
@@ -372,24 +382,29 @@ class HistorianTier:
             self.logger, {"eventName": "HistorianPrefetch",
                           "documentId": document_id})
             if self.logger is not None else None)
-        try:
-            commit = self.get_object(tenant_id, document_id, sha, token)
-            if commit is not None and commit.get("kind") == "commit":
-                self._prefetch_tree(tenant_id, document_id, commit["tree"],
-                                    token)
-        except Exception as exc:  # noqa: BLE001 — warmup must never fail a write
+        with tracing.span("historian.prefetch", hist="historian.prefetch",
+                          document=document_id) as sp:
+            try:
+                commit = self.get_object(tenant_id, document_id, sha, token)
+                if commit is not None and commit.get("kind") == "commit":
+                    self._prefetch_tree(tenant_id, document_id,
+                                        commit["tree"], token)
+            except Exception as exc:  # noqa: BLE001 — warmup must never fail a write
+                if self.metrics is not None:
+                    self.metrics.increment("historian.prefetchFailures")
+                record_swallow("historian.prefetch")
+                sp.set(error=True)
+                if event is not None:
+                    event.cancel(error=exc)
+                return
+            loaded = self.objects.puts - before
+            self.prefetched_objects += loaded
+            sp.set(objects=loaded)
             if self.metrics is not None:
-                self.metrics.increment("historian.prefetchFailures")
-            record_swallow("historian.prefetch")
+                self.metrics.increment("historian.prefetchedObjects",
+                                       loaded)
             if event is not None:
-                event.cancel(error=exc)
-            return
-        loaded = self.objects.puts - before
-        self.prefetched_objects += loaded
-        if self.metrics is not None:
-            self.metrics.increment("historian.prefetchedObjects", loaded)
-        if event is not None:
-            event.end({"objects": loaded})
+                event.end({"objects": loaded})
 
     def _prefetch_tree(self, tenant_id: str, document_id: str,
                        tree_sha: str, token: Optional[str]) -> None:

@@ -4,9 +4,11 @@ tenantId/documentId)."""
 
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, List
 
 from ...protocol.messages import SequencedDocumentMessage
+from ...telemetry import tracing
 from ..log import QueuedMessage
 from .base import IPartitionLambda, LambdaContext
 
@@ -35,11 +37,28 @@ class BroadcasterLambda(IPartitionLambda):
         if hasattr(value, "messages"):
             # SequencedWindow: one record per flush; fan out per room.
             for doc_id, sequenced in value.messages():
-                for listener in list(self.rooms.get(doc_id, [])):
-                    listener(sequenced)
+                self._fan_out(doc_id, sequenced)
             self.context.checkpoint(message.offset)
             return
         doc_id, sequenced = value
+        self._fan_out(doc_id, sequenced)
+        self.context.checkpoint(message.offset)
+
+    def _fan_out(self, doc_id: str,
+                 sequenced: SequencedDocumentMessage) -> None:
+        # Traced ops record the fan-out hop (metadata survived ticketing
+        # via from_document_message, so the span joins the op's trace);
+        # untraced ops take the bare loop. Pre-measured record_span (not
+        # a context-manager Span) keeps the per-op cost off the fan-out
+        # hot path's <2% tracing-overhead budget.
+        ctx = tracing.message_context(sequenced)
+        if ctx is None:
+            for listener in list(self.rooms.get(doc_id, [])):
+                listener(sequenced)
+            return
+        t0 = time.perf_counter()
         for listener in list(self.rooms.get(doc_id, [])):
             listener(sequenced)
-        self.context.checkpoint(message.offset)
+        tracing.record_span("broadcaster.fanout", ctx, t0,
+                            time.perf_counter(), document=doc_id,
+                            seq=sequenced.sequence_number)

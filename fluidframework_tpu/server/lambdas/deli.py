@@ -27,6 +27,7 @@ from ...protocol.messages import (
     NACK_BAD_REF_SEQ,
     SequencedDocumentMessage,
 )
+from ...telemetry import tracing
 from ..log import QueuedMessage
 from .base import IPartitionLambda, LambdaContext
 
@@ -138,7 +139,13 @@ class DeliLambda(IPartitionLambda):
         if message.offset <= state.log_offset:
             return  # replayed message already processed (deli/lambda.ts:143)
         for raw in boxcar.contents:
-            self._ticket(doc_id, state, boxcar.client_id, raw)
+            ctx = tracing.message_context(raw)
+            if ctx is None:
+                self._ticket(doc_id, state, boxcar.client_id, raw)
+            else:
+                with tracing.span("deli.ticket", parent=ctx,
+                                  document=doc_id):
+                    self._ticket(doc_id, state, boxcar.client_id, raw)
         self._evict_ghosts(doc_id, state)
         state.log_offset = message.offset
         self._pending_offset = message.offset

@@ -15,6 +15,7 @@ from typing import Callable, Dict, Optional
 from ...protocol.messages import DocumentMessage, MessageType, \
     SequencedDocumentMessage
 from ...protocol.protocol_handler import ProtocolOpHandler, ProtocolState
+from ...telemetry import tracing
 from ...telemetry.counters import increment, record_swallow
 from ..database import Collection
 from ..log import QueuedMessage
@@ -97,6 +98,17 @@ class ScribeLambda(IPartitionLambda):
 
     def _handle_summarize(self, doc_id: str,
                           sequenced: SequencedDocumentMessage) -> None:
+        # Summaries are rare and load-bearing: root a trace even when the
+        # proposing client didn't carry one (root=True head-samples).
+        with tracing.span("scribe.summarize",
+                          parent=tracing.message_context(sequenced),
+                          root=True, hist="scribe.summarize",
+                          document=doc_id):
+            self._handle_summarize_inner(doc_id, sequenced)
+
+    def _handle_summarize_inner(self, doc_id: str,
+                                sequenced: SequencedDocumentMessage
+                                ) -> None:
         contents = sequenced.contents
         if isinstance(contents, str):
             contents = json.loads(contents)

@@ -23,6 +23,7 @@ import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 from ..core.events import TypedEventEmitter
+from ..telemetry import tracing
 from ..telemetry.counters import record_swallow
 from ..protocol.messages import (
     Boxcar,
@@ -139,9 +140,15 @@ class Connection(TypedEventEmitter):
                     NackContent(NACK_THROTTLED, "op rate limit",
                                 retry_after_s=wait)))
                 return
-        self.server._submit_boxcar(Boxcar(
-            tenant_id=self.tenant_id, document_id=self.document_id,
-            client_id=self.client_id, contents=list(messages)))
+        # The ingest span parents on the first stamped op in the batch;
+        # with auto_pump the whole pipeline pump (deli ticket, serving
+        # flush, fan-out) nests under it on this thread.
+        with tracing.span("server.ingest",
+                          parent=tracing.first_message_context(messages),
+                          document=self.document_id):
+            self.server._submit_boxcar(Boxcar(
+                tenant_id=self.tenant_id, document_id=self.document_id,
+                client_id=self.client_id, contents=list(messages)))
 
     def submit_signal(self, content: Any) -> None:
         """Transient broadcast: the signal fans out to every connection in

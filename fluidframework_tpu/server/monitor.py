@@ -1,4 +1,4 @@
-"""Service monitor: health + metrics over the ordering service.
+"""Service monitor: health + metrics + the observability surface.
 
 Capability parity with reference server/service-monitor (the ops stub) and
 the IMetricClient surface (services-core/src/metricClient.ts): collects
@@ -6,6 +6,18 @@ counters from registered probes (documents resident, sequence numbers,
 partition checkpoint lag, op throughput), serves them as JSON over
 `/health` and `/metrics`, and keeps a rolling sample window for rate
 computation.
+
+Observability additions (docs/observability.md):
+
+  /trace         drain the tracing flight recorder as Chrome trace-event
+                 JSON (open in perfetto / chrome://tracing)
+  /metrics.prom  Prometheus text exposition: process counters + the
+                 per-stage latency histograms (bucket lines carry
+                 trace-id exemplars)
+  SLO            a declared serving-flush latency budget (default
+                 p99 <= 2x p50 over the rolling window) evaluated on
+                 every /health; a breach flips /health to 503 with the
+                 measured numbers in the `slo` detail
 """
 
 from __future__ import annotations
@@ -17,6 +29,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..telemetry import counters as process_counters
+from ..telemetry import tracing
+from ..telemetry.counters import nearest_rank
 
 
 class MetricClient:
@@ -47,14 +61,67 @@ class MetricClient:
                 if not samples:
                     continue
                 ordered = sorted(samples)
+                # Shared nearest-rank percentiles (telemetry/counters.py):
+                # the previous inline math used the upper-median index for
+                # p50 and a truncation-based index for p99, both of which
+                # misquote small windows (p99 of 100 samples returned the
+                # max; p50 of 2 returned the larger).
                 out["latencies"][name] = {
                     "count": len(samples),
-                    "p50": ordered[len(ordered) // 2],
-                    "p99": ordered[min(len(ordered) - 1,
-                                       int(len(ordered) * 0.99))],
+                    "p50": nearest_rank(ordered, 0.50),
+                    "p99": nearest_rank(ordered, 0.99),
                     "max": ordered[-1],
                 }
             return out
+
+
+class SloPolicy:
+    """A declared latency budget over one stage histogram's rolling
+    window (VERDICT #8: a budget the surface can ENFORCE, not just
+    report). Default: the serving flush must hold p99 <= ratio * p50 —
+    the tail-spread budget the p99/p50=3.5x open item is graded
+    against."""
+
+    def __init__(self, stage: str = "serving.flush",
+                 p99_over_p50: float = 2.0, min_samples: int = 64):
+        self.stage = stage
+        self.p99_over_p50 = float(p99_over_p50)
+        # Below min_samples the window's p99 is dominated by compile /
+        # warmup transients; the verdict reports "not evaluated" (ok).
+        self.min_samples = int(min_samples)
+
+    @property
+    def budget(self) -> str:
+        """Human-readable budget — the single source for every surface
+        that quotes it (health, /metrics.prom, bench records)."""
+        return f"p99 <= {self.p99_over_p50:g} * p50"
+
+    def check(self, p50: float, p99: float) -> bool:
+        """Grade an externally measured (p50, p99) pair against this
+        budget (bench records use this so they can never diverge from
+        the /health verdict)."""
+        return p50 <= 0 or p99 <= self.p99_over_p50 * p50
+
+    def evaluate(self) -> dict:
+        window = process_counters.latency_window(self.stage)
+        ordered = sorted(window)
+        out = {
+            "stage": self.stage,
+            "budget": self.budget,
+            "samples": len(ordered),
+            "evaluated": len(ordered) >= self.min_samples,
+            "ok": True,
+        }
+        if not ordered:
+            return out
+        p50 = nearest_rank(ordered, 0.50)
+        p99 = nearest_rank(ordered, 0.99)
+        out["p50Ms"] = round(p50, 3)
+        out["p99Ms"] = round(p99, 3)
+        out["ratio"] = round(p99 / p50, 3) if p50 > 0 else 0.0
+        if out["evaluated"] and p50 > 0:
+            out["ok"] = p99 <= self.p99_over_p50 * p50
+        return out
 
 
 class ServiceMonitor:
@@ -62,8 +129,14 @@ class ServiceMonitor:
     them. Probes run at request time, so readings are live."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 metrics: Optional[MetricClient] = None):
+                 metrics: Optional[MetricClient] = None,
+                 slo: Optional[SloPolicy] = None,
+                 enforce_slo: bool = True):
         self.metrics = metrics or MetricClient()
+        self.slo = slo or SloPolicy()
+        # enforce_slo=False keeps the verdict in /health without letting
+        # a breach flip the status code (report-only rollout mode).
+        self.enforce_slo = enforce_slo
         self.probes: Dict[str, Callable[[], dict]] = {}
         self.started_at = time.time()
         service = self
@@ -151,18 +224,25 @@ class ServiceMonitor:
                 checks[name] = (True, "ok")
             except Exception as exc:  # noqa: BLE001 — probe crash = unhealthy
                 checks[name] = (False, repr(exc))
-        return {"ok": all(ok for ok, _ in checks.values()),
+        slo = self.slo.evaluate()
+        slo_ok = slo["ok"] or not self.enforce_slo
+        return {"ok": all(ok for ok, _ in checks.values()) and slo_ok,
                 "uptimeS": time.time() - self.started_at,
                 # Process-wide counters ride on every health report: the
                 # swallowed.* rates (fluidlint CC rules' runtime side) and
                 # kernel.retrace_count (the RETRACE_HAZARD cross-check).
                 "counters": process_counters.snapshot(),
+                # The declared-budget verdict (503-with-detail on breach).
+                "slo": slo,
+                "stageLatencies": process_counters.latency_snapshot(),
                 "checks": {n: {"ok": ok, "detail": d}
                            for n, (ok, d) in checks.items()}}
 
     def report(self) -> dict:
         out = {"metrics": self.metrics.snapshot(),
-               "counters": process_counters.snapshot(), "probes": {}}
+               "counters": process_counters.snapshot(),
+               "stageLatencies": process_counters.latency_snapshot(),
+               "probes": {}}
         for name, probe in self.probes.items():
             try:
                 out["probes"][name] = probe()
@@ -170,21 +250,92 @@ class ServiceMonitor:
                 out["probes"][name] = {"error": repr(exc)}
         return out
 
+    # -- Prometheus exposition ----------------------------------------------
+    @staticmethod
+    def _prom_name(name: str) -> str:
+        out = []
+        for ch in name:
+            out.append(ch if ch.isalnum() or ch == "_" else "_")
+        sanitized = "".join(out)
+        if sanitized and sanitized[0].isdigit():
+            sanitized = "_" + sanitized
+        return "fluid_" + sanitized
+
+    def prometheus(self) -> str:
+        """Prometheus/OpenMetrics-style text exposition: every process
+        counter as an untyped sample, every stage latency histogram with
+        cumulative bucket lines (le in milliseconds) — bucket lines carry
+        the last trace id observed in that bucket as an exemplar, so a
+        latency spike on a dashboard links straight to its flight-recorder
+        trace."""
+        lines: List[str] = []
+        for name, value in sorted(process_counters.snapshot().items()):
+            metric = self._prom_name(name)
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {value:g}")
+        for name, value in sorted(self.metrics.snapshot()
+                                  ["counters"].items()):
+            metric = self._prom_name("metric." + name)
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {value:g}")
+        hists = process_counters.histogram_export()
+        if hists:
+            lines.append("# TYPE fluid_stage_latency_ms histogram")
+        for name in sorted(hists):
+            h = hists[name]
+            for le, cum, exemplar in h["buckets"]:
+                le_s = "+Inf" if le == float("inf") else f"{le:g}"
+                line = (f'fluid_stage_latency_ms_bucket'
+                        f'{{stage="{name}",le="{le_s}"}} {cum}')
+                if exemplar is not None:
+                    trace_id, value = exemplar
+                    line += (f' # {{trace_id="{trace_id}"}} '
+                             f'{value:g}')
+                lines.append(line)
+            lines.append(f'fluid_stage_latency_ms_sum{{stage="{name}"}} '
+                         f'{h["sum"]:g}')
+            lines.append(f'fluid_stage_latency_ms_count{{stage="{name}"}} '
+                         f'{h["count"]}')
+        slo = self.slo.evaluate()
+        lines.append("# TYPE fluid_slo_ok gauge")
+        lines.append(f'fluid_slo_ok{{stage="{slo["stage"]}"}} '
+                     f'{1 if slo["ok"] else 0}')
+        # OpenMetrics terminator — exemplars are OpenMetrics syntax, so
+        # the exposition declares (and terminates as) OpenMetrics rather
+        # than the 0.0.4 text format, whose parsers reject the '# {...}'
+        # tail after a sample value.
+        lines.append("# EOF")
+        return "\n".join(lines) + "\n"
+
     def _route(self, handler) -> None:
         path = handler.path.partition("?")[0]
         if path == "/healthz":  # k8s-style alias
             path = "/health"
+        content_type = "application/json"
         if path == "/health":
             payload, status = self.health(), 200
             if not payload["ok"]:
                 status = 503
+            body = json.dumps(payload).encode()
         elif path == "/metrics":
-            payload, status = self.report(), 200
+            body = json.dumps(self.report()).encode()
+            status = 200
+        elif path == "/metrics.prom":
+            body = self.prometheus().encode()
+            status = 200
+            content_type = ("application/openmetrics-text; "
+                            "version=1.0.0; charset=utf-8")
+        elif path == "/trace":
+            # Drain: each capture window starts fresh (the flight
+            # recorder bounds memory, not retention policy).
+            body = json.dumps(tracing.chrome_trace(
+                tracing.recorder.drain())).encode()
+            status = 200
         else:
-            payload, status = {"error": f"no route {path}"}, 404
-        body = json.dumps(payload).encode()
+            body = json.dumps({"error": f"no route {path}"}).encode()
+            status = 404
         handler.send_response(status)
-        handler.send_header("Content-Type", "application/json")
+        handler.send_header("Content-Type", content_type)
         handler.send_header("Content-Length", str(len(body)))
         handler.end_headers()
         handler.wfile.write(body)

@@ -13,6 +13,14 @@ window is exactly one dispatch and one D2H.
 Reference analog: deli/lambda.ts:142 ticket() feeding downstream lambdas;
 the merge/LWW applies play Scribe's materialization role fused into the
 same device window.
+
+Observability: the WHOLE program is one dispatch by design, so host-side
+tracing (telemetry/tracing.py) cannot subdivide it — the serving flush's
+named sub-spans bracket it instead: ``serving.pack`` (staging the cols
+this function consumes), ``serving.dispatch`` (this jit call),
+``serving.readback`` (the flat16 D2H), with fold/rescue and payload GC
+as their own host stages. Each feeds a ``serving.*`` histogram on
+``/metrics.prom`` (docs/observability.md).
 """
 
 from __future__ import annotations

@@ -52,6 +52,7 @@ from ..protocol.messages import (
     NACK_BAD_REF_SEQ,
     SequencedDocumentMessage,
 )
+from ..telemetry import tracing
 from ..telemetry.counters import (JitRetraceProbe, increment,
                                   record_swallow)
 from . import ticket_kernel as tk
@@ -632,33 +633,48 @@ class MergeLaneStore:
 
         for b, lane_ops in sorted(per_bucket.items()):
             bucket = self.buckets[b]
-            t = _bucket(max(len(v) for v in lane_ops.values()),
-                        self.t_buckets)
-            streams_list = [lane_ops.get(i, []) for i in range(bucket.lanes)]
-            packed = pack_ops(streams_list, steps=t)
+            with tracing.span("serving.pack", hist="serving.pack",
+                              stage="merge-oppack", bucket=b):
+                t = _bucket(max(len(v) for v in lane_ops.values()),
+                            self.t_buckets)
+                streams_list = [lane_ops.get(i, [])
+                                for i in range(bucket.lanes)]
+                packed = pack_ops(streams_list, steps=t)
             pre = bucket.state
-            new_state = _apply_keep_batched(pre, packed)
-            over = np.asarray(new_state.overflow)
+            with tracing.span("serving.dispatch", hist="serving.dispatch",
+                              stage="merge-apply", bucket=b):
+                new_state = _apply_keep_batched(pre, packed)
+            with tracing.span("serving.readback", hist="serving.readback",
+                              stage="merge-overflow", bucket=b):
+                over = np.asarray(new_state.overflow)
             flagged = [i for i in range(bucket.lanes)
                        if over[i] and i in lane_ops]
-            if flagged:
-                # Adopt the clean lanes; roll flagged lanes back to their
-                # pre-flush rows (one batched scatter), then recover them.
-                idx = jnp.asarray(np.asarray(flagged, np.int32))
-                new_state = jax.tree_util.tree_map(
-                    lambda bcol, p: bcol.at[idx].set(p[idx]),
-                    new_state, pre)
-            bucket.state = new_state
-            if flagged:
-                # One BATCHED compact->rerun->promote per level — per-lane
-                # device round-trips over a thin host link turn a 1k-lane
-                # overflow burst into minutes. Lane counts pad to powers of
-                # two so the compiled shapes stay bounded.
-                self._recover_batch(b, {i: lane_ops[i] for i in flagged})
+            # Unconditional fold/rescue span: a clean window records the
+            # stage at ~0 so flush captures always attribute it.
+            with tracing.span("serving.fold_rescue",
+                              hist="serving.fold_rescue", bucket=b):
+                if flagged:
+                    # Adopt the clean lanes; roll flagged lanes back to
+                    # their pre-flush rows (one batched scatter), then
+                    # recover them.
+                    idx = jnp.asarray(np.asarray(flagged, np.int32))
+                    new_state = jax.tree_util.tree_map(
+                        lambda bcol, p: bcol.at[idx].set(p[idx]),
+                        new_state, pre)
+                bucket.state = new_state
+                if flagged:
+                    # One BATCHED compact->rerun->promote per level —
+                    # per-lane device round-trips over a thin host link
+                    # turn a 1k-lane overflow burst into minutes. Lane
+                    # counts pad to powers of two so the compiled shapes
+                    # stay bounded.
+                    self._recover_batch(b, {i: lane_ops[i]
+                                            for i in flagged})
 
-        self.flushes_since_compact += 1
-        if self.flushes_since_compact >= self.compact_every:
-            self.compact_all()
+        with tracing.span("serving.gc", hist="serving.gc"):
+            self.flushes_since_compact += 1
+            if self.flushes_since_compact >= self.compact_every:
+                self.compact_all()
 
     @staticmethod
     def _pad_pow2(sub: DocState, packed: PackedOps, n: int,
@@ -2560,6 +2576,30 @@ class TpuSequencerLambda(IPartitionLambda):
 
     # -- the device flush --------------------------------------------------
     def flush(self) -> None:
+        """One serving flush. Traced as the ``serving.flush`` parent span
+        (continuing the first traced op's context when one is pending)
+        with the named sub-spans — pack, dispatch, readback, fold/rescue,
+        payload GC — recorded by the stages below; each stage also feeds
+        its ``serving.*`` latency histogram unconditionally, so the
+        flush-p99/p50 spread attributes to a stage even with tracing
+        off (server/monitor.py `/metrics.prom` + SLO)."""
+        with tracing.span("serving.flush", parent=self._flush_parent(),
+                          root=True, hist="serving.flush"):
+            self._flush_traced()
+
+    def _flush_parent(self):
+        """The first pending traced op's context, if any (slow/object
+        path only: fast-path backlogs are raw bytes, parsed later)."""
+        if not tracing.enabled():
+            return None
+        for q in self.pending.values():
+            for p in q:
+                ctx = tracing.message_context(p.msg)
+                if ctx is not None:
+                    return ctx
+        return None
+
+    def _flush_traced(self) -> None:
         fast_active: List[str] = []
         if self._raw_backlog:
             fast_active = self._flush_raw()
@@ -2586,7 +2626,8 @@ class TpuSequencerLambda(IPartitionLambda):
         # op_ids and pre-window rows numbered against the CURRENT table,
         # so no renumbering while one is in flight.
         if self._inflight is None:
-            self.merge.maybe_compact_payload_ids()
+            with tracing.span("serving.gc", hist="serving.gc"):
+                self.merge.maybe_compact_payload_ids()
             self._checkpoint()
         # else: the deferred window's drain checkpoints its own offset.
 
@@ -2622,9 +2663,11 @@ class TpuSequencerLambda(IPartitionLambda):
         # The native parse overlaps the PREVIOUS deferred window's result
         # transfer (pipelined mode); everything lane-state-dependent waits
         # for drain() just below.
-        parsed = self._pump.parse(bufs)
-        cols = parsed.cols
-        self._mirror_pump_interns(parsed)
+        with tracing.span("serving.pack", hist="serving.pack",
+                          stage="parse"):
+            parsed = self._pump.parse(bufs)
+            cols = parsed.cols
+            self._mirror_pump_interns(parsed)
         self.drain()
 
         # --- fallback routing (doc granularity) ---------------------------
@@ -2707,13 +2750,15 @@ class TpuSequencerLambda(IPartitionLambda):
         n_windows = int(win.max()) + 1
 
         # Payload blocks for the whole flush (op ids + value ids).
-        merge_all = np.flatnonzero(
-            fast & (cols[P.FAMILY] == P.FAM_MERGE))
-        mbase, chan_ok, chan_b, chan_l = self._merge_block_and_lanes(
-            parsed, merge_all)
-        lww_all = np.flatnonzero(fast & (cols[P.FAMILY] == P.FAM_LWW))
-        vbase, lchan_ok, lchan_b, lchan_l = self._lww_block_and_lanes(
-            parsed, lww_all)
+        with tracing.span("serving.pack", hist="serving.pack",
+                          stage="payload-blocks"):
+            merge_all = np.flatnonzero(
+                fast & (cols[P.FAMILY] == P.FAM_MERGE))
+            mbase, chan_ok, chan_b, chan_l = self._merge_block_and_lanes(
+                parsed, merge_all)
+            lww_all = np.flatnonzero(fast & (cols[P.FAMILY] == P.FAM_LWW))
+            vbase, lchan_ok, lchan_b, lchan_l = self._lww_block_and_lanes(
+                parsed, lww_all)
 
         row_seq = np.zeros(rows.size, np.int32)
         row_msn = np.zeros(rows.size, np.int32)
@@ -2753,13 +2798,14 @@ class TpuSequencerLambda(IPartitionLambda):
                 self.emit(doc_id, msg)
         # Compaction cadence bookkeeping (the fast path bypasses
         # MergeLaneStore.apply / LwwLaneStore.apply which normally tick).
-        self.merge.flushes_since_compact += 1
-        if self.merge.flushes_since_compact >= self.merge.compact_every:
-            self.merge.compact_all()
-        self.lww.windows_since_value_compact += 1
-        if self.lww.windows_since_value_compact >= \
-                self.lww.value_compact_every:
-            self.lww.compact_values()
+        with tracing.span("serving.gc", hist="serving.gc"):
+            self.merge.flushes_since_compact += 1
+            if self.merge.flushes_since_compact >= self.merge.compact_every:
+                self.merge.compact_all()
+            self.lww.windows_since_value_compact += 1
+            if self.lww.windows_since_value_compact >= \
+                    self.lww.value_compact_every:
+                self.lww.compact_values()
 
     def drain(self) -> None:
         """Finish the deferred fast window, if any: join the result
@@ -2770,7 +2816,14 @@ class TpuSequencerLambda(IPartitionLambda):
         if ctx is None:
             return
         self._inflight = None
+        _t0 = time.perf_counter()
         ctx["thread"].join()
+        # The deferred window's D2H: attributed to the flush that
+        # DISPATCHED it (ctx["trace_ctx"]), measured as the join stall
+        # the draining flush actually pays.
+        tracing.record_span("serving.readback", ctx.get("trace_ctx"),
+                            _t0, time.perf_counter(),
+                            hist="serving.readback", deferred=True)
         if "error" in ctx:
             raise ctx["error"]
         self._finish_window(ctx)
@@ -2980,17 +3033,19 @@ class TpuSequencerLambda(IPartitionLambda):
                 self.pack_runs = False
             self._fused_serve = base
 
-        ticket_cols = np.zeros((4, B, T), np.int32)
-        ticket_cols[1] = -1
-        ticket_cols[0, lanes, slot] = cols[P.KIND, rows]
-        ticket_cols[1, lanes, slot] = cols[P.CLIENT, rows]
-        ticket_cols[2, lanes, slot] = cols[P.CSEQ, rows]
-        ticket_cols[3, lanes, slot] = cols[P.REFSEQ, rows]
+        with tracing.span("serving.pack", hist="serving.pack",
+                          stage="window-staging"):
+            ticket_cols = np.zeros((4, B, T), np.int32)
+            ticket_cols[1] = -1
+            ticket_cols[0, lanes, slot] = cols[P.KIND, rows]
+            ticket_cols[1, lanes, slot] = cols[P.CLIENT, rows]
+            ticket_cols[2, lanes, slot] = cols[P.CSEQ, rows]
+            ticket_cols[3, lanes, slot] = cols[P.REFSEQ, rows]
 
-        merge_jobs = self._build_merge(parsed, rows, lanes, slot,
-                                       mbase, chan_ok, chan_b, chan_l)
-        lww_jobs = self._build_lww(parsed, rows, lanes, slot,
-                                   vbase, lchan_ok, lchan_b, lchan_l)
+            merge_jobs = self._build_merge(parsed, rows, lanes, slot,
+                                           mbase, chan_ok, chan_b, chan_l)
+            lww_jobs = self._build_lww(parsed, rows, lanes, slot,
+                                       vbase, lchan_ok, lchan_b, lchan_l)
 
         # ONE fused device program for the whole window (every extra
         # dispatch is a serialized tunnel RPC), then ONE host sync of the
@@ -3008,50 +3063,52 @@ class TpuSequencerLambda(IPartitionLambda):
                 [None if j["runs"] is None else self._place_cols(j["runs"])
                  for j in merge_jobs])
 
-        try:
-            (self.tstate, new_merge, new_lww, flat_dev,
-             msn32_dev) = dispatch(self._fused_serve)
-        except Exception as err:  # noqa: BLE001 — degrade, never crash
-            if not self._fused_serve:
-                raise
-            # The fused path failed at THIS production shape (the small
-            # probe passed — e.g. the runs variant's 24 extra op columns
-            # blew the VMEM budget at a large (capacity, T)). Failures
-            # happen at lowering, before execution, so the donated
-            # buffers are intact. Degrade in probe-policy order: if this
-            # window carries runs, drop PACKING (keep the fused kernel
-            # for plain buckets) and re-stage; else forfeit fused. Either
-            # way, log loudly — a silent degrade would hide both a
-            # Mosaic regression and the perf cliff.
-            import logging
-            increment("sequencer.fused_degrades")
-            had_runs = any(j["runs"] is not None for j in merge_jobs)
-            if had_runs and self.pack_runs:
-                self.pack_runs = False
-                logging.getLogger(__name__).warning(
-                    "fused INSERT_RUN variant failed at a production "
-                    "shape; disabling run packing (%r)", err)
-                merge_jobs = self._build_merge(parsed, rows, lanes, slot,
-                                               mbase, chan_ok, chan_b,
-                                               chan_l)
-                try:
-                    (self.tstate, new_merge, new_lww, flat_dev,
-                     msn32_dev) = dispatch(self._fused_serve)
-                except Exception as err2:  # noqa: BLE001
-                    increment("sequencer.fused_degrades")
+        with tracing.span("serving.dispatch", hist="serving.dispatch"):
+            try:
+                (self.tstate, new_merge, new_lww, flat_dev,
+                 msn32_dev) = dispatch(self._fused_serve)
+            except Exception as err:  # noqa: BLE001 — degrade, never crash
+                if not self._fused_serve:
+                    raise
+                # The fused path failed at THIS production shape (the
+                # small probe passed — e.g. the runs variant's 24 extra
+                # op columns blew the VMEM budget at a large (capacity,
+                # T)). Failures happen at lowering, before execution, so
+                # the donated buffers are intact. Degrade in probe-policy
+                # order: if this window carries runs, drop PACKING (keep
+                # the fused kernel for plain buckets) and re-stage; else
+                # forfeit fused. Either way, log loudly — a silent
+                # degrade would hide both a Mosaic regression and the
+                # perf cliff.
+                import logging
+                increment("sequencer.fused_degrades")
+                had_runs = any(j["runs"] is not None for j in merge_jobs)
+                if had_runs and self.pack_runs:
+                    self.pack_runs = False
+                    logging.getLogger(__name__).warning(
+                        "fused INSERT_RUN variant failed at a production "
+                        "shape; disabling run packing (%r)", err)
+                    merge_jobs = self._build_merge(parsed, rows, lanes,
+                                                   slot, mbase, chan_ok,
+                                                   chan_b, chan_l)
+                    try:
+                        (self.tstate, new_merge, new_lww, flat_dev,
+                         msn32_dev) = dispatch(self._fused_serve)
+                    except Exception as err2:  # noqa: BLE001
+                        increment("sequencer.fused_degrades")
+                        self._fused_serve = False
+                        logging.getLogger(__name__).warning(
+                            "fused serving failed without runs too; scan "
+                            "path from now on (%r)", err2)
+                        (self.tstate, new_merge, new_lww, flat_dev,
+                         msn32_dev) = dispatch(False)
+                else:
                     self._fused_serve = False
                     logging.getLogger(__name__).warning(
-                        "fused serving failed without runs too; scan "
-                        "path from now on (%r)", err2)
+                        "fused serving apply failed; scan path from now "
+                        "on (%r)", err)
                     (self.tstate, new_merge, new_lww, flat_dev,
                      msn32_dev) = dispatch(False)
-            else:
-                self._fused_serve = False
-                logging.getLogger(__name__).warning(
-                    "fused serving apply failed; scan path from now on "
-                    "(%r)", err)
-                (self.tstate, new_merge, new_lww, flat_dev,
-                 msn32_dev) = dispatch(False)
         for j, post in zip(merge_jobs, new_merge):
             j["post"] = post
             self.merge.buckets[j["bucket"]].state = post
@@ -3069,7 +3126,11 @@ class TpuSequencerLambda(IPartitionLambda):
                # The offsets THIS window covers: drain() must commit
                # exactly these — the live _pending_offset may already
                # include a newer, not-yet-dispatched backlog.
-               "offset": self._pending_offset}
+               "offset": self._pending_offset,
+               # The flush's trace position, so the deferred readback
+               # (joined by a LATER flush's drain) attributes to the
+               # window that dispatched it, not the one that drained it.
+               "trace_ctx": tracing.current()}
         if defer:
             import threading
 
@@ -3083,7 +3144,9 @@ class TpuSequencerLambda(IPartitionLambda):
             ctx["thread"].start()
             self._inflight = ctx
         else:
-            ctx["flat"] = np.asarray(flat_dev)  # the window's ONE sync
+            with tracing.span("serving.readback",
+                              hist="serving.readback"):
+                ctx["flat"] = np.asarray(flat_dev)  # the window's ONE sync
             self._finish_window(ctx)
 
     def _finish_window(self, ctx) -> None:
@@ -3153,16 +3216,25 @@ class TpuSequencerLambda(IPartitionLambda):
                            NackContent(NACK_BAD_REF_SEQ, reason)))
 
         # Overflow recovery (rare): roll flagged lanes back to their
-        # pre-window rows and reuse the batched slow-path recovery.
-        bit_i = 1
-        for job in merge_jobs:
-            if bits[bit_i]:
-                self._recover_fast_merge(parsed, job, seq_bt, msn_bt)
-            bit_i += 1
-        for job in lww_jobs:
-            if bits[bit_i]:
-                self._recover_fast_lww(parsed, job, seq_bt)
-            bit_i += 1
+        # pre-window rows and reuse the batched slow-path recovery. The
+        # span is unconditional — a flush with nothing to rescue records
+        # a ~0 µs stage, so captures always show the stage's cost.
+        with tracing.span("serving.fold_rescue", parent=ctx.get("trace_ctx"),
+                          hist="serving.fold_rescue") as _frsp:
+            bit_i = 1
+            recovered = 0
+            for job in merge_jobs:
+                if bits[bit_i]:
+                    self._recover_fast_merge(parsed, job, seq_bt, msn_bt)
+                    recovered += 1
+                bit_i += 1
+            for job in lww_jobs:
+                if bits[bit_i]:
+                    self._recover_fast_lww(parsed, job, seq_bt)
+                    recovered += 1
+                bit_i += 1
+            if recovered:
+                _frsp.set(recovered_jobs=recovered)
 
     def _build_merge(self, parsed, rows, lanes, slot,
                      mbase, chan_ok, chan_b, chan_l):
@@ -3435,31 +3507,40 @@ class TpuSequencerLambda(IPartitionLambda):
         while self.k < need_k:
             self._grow_clients()
 
-        t = _bucket(max(len(q) for q in live.values()), self.t_buckets)
-        b = self.lanes
-        kind = np.zeros((b, t), np.int32)
-        client = np.full((b, t), -1, np.int32)
-        cseq = np.zeros((b, t), np.int32)
-        ref = np.zeros((b, t), np.int32)
-        for doc_id, queue in live.items():
-            lane = self.docs[doc_id].lane
-            for i, p in enumerate(queue):
-                kind[lane, i] = p.kind
-                client[lane, i] = p.ordinal
-                cseq[lane, i] = p.client_seq
-                ref[lane, i] = p.ref_seq
-        raw = tk.RawOps(client=jnp.asarray(client),
-                        client_seq=jnp.asarray(cseq),
-                        ref_seq=jnp.asarray(ref),
-                        kind=jnp.asarray(kind))
-        self.tstate, ticketed = tk.sequence_batched_strict(self.tstate, raw)
+        _tkt0 = time.perf_counter()
+        with tracing.span("serving.pack", hist="serving.pack",
+                          stage="ticket-staging"):
+            t = _bucket(max(len(q) for q in live.values()), self.t_buckets)
+            b = self.lanes
+            kind = np.zeros((b, t), np.int32)
+            client = np.full((b, t), -1, np.int32)
+            cseq = np.zeros((b, t), np.int32)
+            ref = np.zeros((b, t), np.int32)
+            for doc_id, queue in live.items():
+                lane = self.docs[doc_id].lane
+                for i, p in enumerate(queue):
+                    kind[lane, i] = p.kind
+                    client[lane, i] = p.ordinal
+                    cseq[lane, i] = p.client_seq
+                    ref[lane, i] = p.ref_seq
+            raw = tk.RawOps(client=jnp.asarray(client),
+                            client_seq=jnp.asarray(cseq),
+                            ref_seq=jnp.asarray(ref),
+                            kind=jnp.asarray(kind))
+        with tracing.span("serving.dispatch", hist="serving.dispatch",
+                          stage="ticket"):
+            self.tstate, ticketed = tk.sequence_batched_strict(self.tstate,
+                                                               raw)
 
-        seqs = np.asarray(ticketed.seq)
-        msns = np.asarray(ticketed.min_seq)
-        nacked = np.asarray(ticketed.nacked)
-        not_joined = np.asarray(ticketed.not_joined)
-        empty_after = np.asarray(ticketed.empty_after)
-        next_seq = np.asarray(self.tstate.next_seq)
+        with tracing.span("serving.readback", hist="serving.readback",
+                          stage="ticket"):
+            seqs = np.asarray(ticketed.seq)
+            msns = np.asarray(ticketed.min_seq)
+            nacked = np.asarray(ticketed.nacked)
+            not_joined = np.asarray(ticketed.not_joined)
+            empty_after = np.asarray(ticketed.empty_after)
+            next_seq = np.asarray(self.tstate.next_seq)
+        _tkt1 = time.perf_counter()
         if bool(np.asarray(self.tstate.overflow).any()):
             raise RuntimeError("ticket client table overflow despite "
                                "pre-flush growth — invariant violation")
@@ -3474,6 +3555,14 @@ class TpuSequencerLambda(IPartitionLambda):
                     sequenced = SequencedDocumentMessage.from_document_message(
                         p.msg, p.client_id, seq, int(msns[lane, i]))
                     sequenced.traces.append(ITrace.now("deli", "sequence"))
+                    _tctx = tracing.message_context(p.msg)
+                    if _tctx is not None:
+                        # The op's ticket hop = this window's device
+                        # ticketing (batched: one interval, one span per
+                        # traced op riding it).
+                        tracing.record_span("deli.ticket", _tctx,
+                                            _tkt0, _tkt1, document=doc_id,
+                                            seq=seq)
                     self.emit(doc_id, sequenced)
                     if p.kind == tk.MsgKind.OP and self.materialize:
                         self._collect_channel_op(

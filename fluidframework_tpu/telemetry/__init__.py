@@ -16,6 +16,7 @@ from .logger import (
 )
 from .mock import MockLogger
 from . import counters
+from . import tracing
 from .counters import JitRetraceProbe, record_swallow
 
 __all__ = [
@@ -23,4 +24,5 @@ __all__ = [
     "ChildLogger", "DebugLogger", "MultiSinkLogger",
     "OpRoundTripTelemetry", "PerformanceEvent", "TelemetryLogger",
     "MockLogger", "JitRetraceProbe", "counters", "record_swallow",
+    "tracing",
 ]

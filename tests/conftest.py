@@ -31,3 +31,21 @@ try:
     enable_compile_cache()
 except ImportError:  # pragma: no cover
     pass
+
+try:
+    import pytest
+
+    @pytest.fixture(autouse=True)
+    def _isolate_stage_latency_histograms():
+        """The stage latency histograms (telemetry/counters.observe) are
+        process-global and feed the SLO verdict on /health: without
+        per-test isolation, one test's serving-flush tail would flip a
+        LATER test's health check to 503 under randomized ordering.
+        Named counters are deliberately left alone (pre-existing
+        cross-test semantics)."""
+        yield
+        from fluidframework_tpu.telemetry import counters
+
+        counters.reset_histograms()
+except ImportError:  # pragma: no cover - conftest imported outside pytest
+    pass

@@ -1,0 +1,237 @@
+"""ServiceMonitor routing + MetricClient percentile math + the
+observability surface (/trace, /metrics.prom, SLO enforcement).
+
+The HTTP-level tests drive raw sockets where keep-alive framing matters:
+a wrong Content-Length under HTTP/1.1 makes the SECOND request on a
+reused connection read garbage — invisible through urllib (fresh
+connection per call) but fatal for real scrapers."""
+
+import http.client
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from fluidframework_tpu.server.monitor import (MetricClient, ServiceMonitor,
+                                               SloPolicy)
+from fluidframework_tpu.telemetry import counters, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    counters.reset()
+    tracing.reset()
+    yield
+    counters.reset()
+    tracing.reset()
+
+
+@pytest.fixture()
+def monitor():
+    mon = ServiceMonitor().start()
+    yield mon
+    mon.stop()
+
+
+def _get(url):
+    with urllib.request.urlopen(url) as resp:
+        return resp.status, json.load(resp)
+
+
+class TestMetricClientPercentiles:
+    """Exact nearest-rank values at the window sizes the old math broke:
+    p50 used the upper-median index and p99 a truncation-based index
+    that returned the max for any window under ~100 samples."""
+
+    def _client(self, values):
+        m = MetricClient()
+        for v in values:
+            m.write_latency("op", float(v))
+        return m.snapshot()["latencies"]["op"]
+
+    def test_window_1(self):
+        snap = self._client([7.0])
+        assert snap == {"count": 1, "p50": 7.0, "p99": 7.0, "max": 7.0}
+
+    def test_window_2_p50_is_lower_median(self):
+        snap = self._client([1.0, 2.0])
+        assert snap["p50"] == 1.0
+        assert snap["p99"] == 2.0
+
+    def test_window_4(self):
+        snap = self._client([4.0, 1.0, 3.0, 2.0])
+        assert snap["p50"] == 2.0   # ceil(0.5*4) = 2nd smallest
+        assert snap["p99"] == 4.0   # ceil(0.99*4) = 4th smallest
+        assert snap["max"] == 4.0
+
+    def test_window_100_p99_is_not_max(self):
+        snap = self._client(range(1, 101))
+        assert snap["p50"] == 50.0
+        assert snap["p99"] == 99.0  # NOT 100 — the old truncation bug
+        assert snap["max"] == 100.0
+
+
+class TestRouting:
+    def test_healthz_alias(self, monitor):
+        status, body = _get(monitor.url + "/healthz")
+        assert status == 200
+        assert body["ok"] is True
+        assert "slo" in body
+
+    def test_404_payload(self, monitor):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(monitor.url + "/nope")
+        assert err.value.code == 404
+        assert json.load(err.value) == {"error": "no route /nope"}
+
+    def test_503_on_raising_probe(self, monitor):
+        monitor.add_probe("boom", lambda: 1 / 0)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(monitor.url + "/health")
+        assert err.value.code == 503
+        body = json.load(err.value)
+        assert body["ok"] is False
+        assert not body["checks"]["boom"]["ok"]
+        assert "ZeroDivisionError" in body["checks"]["boom"]["detail"]
+
+    def test_keep_alive_content_length_two_requests(self, monitor):
+        """Two sequential requests on ONE HTTP/1.1 connection: correct
+        Content-Length framing is what lets the second parse at all."""
+        conn = http.client.HTTPConnection(monitor.host, monitor.port)
+        try:
+            for path in ("/health", "/metrics"):
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                body = resp.read()
+                assert resp.status == 200
+                assert int(resp.headers["Content-Length"]) == len(body)
+                json.loads(body)  # parses cleanly = framing was exact
+        finally:
+            conn.close()
+
+    def test_keep_alive_across_prom_and_trace(self, monitor):
+        counters.observe("serving.flush", 1.0)
+        conn = http.client.HTTPConnection(monitor.host, monitor.port)
+        try:
+            conn.request("GET", "/metrics.prom")
+            resp = conn.getresponse()
+            prom = resp.read()
+            assert resp.status == 200
+            assert int(resp.headers["Content-Length"]) == len(prom)
+            assert resp.headers["Content-Type"].startswith(
+                "application/openmetrics-text")
+            assert prom.decode().rstrip().endswith("# EOF")
+            conn.request("GET", "/trace")
+            resp = conn.getresponse()
+            body = resp.read()
+            assert resp.status == 200
+            assert int(resp.headers["Content-Length"]) == len(body)
+            assert "traceEvents" in json.loads(body)
+        finally:
+            conn.close()
+
+
+class TestPrometheusExposition:
+    def test_counters_and_histogram_buckets(self, monitor):
+        counters.increment("ops.sequenced", 5)
+        for ms in (0.4, 3.0, 30.0, 400.0):
+            counters.observe("serving.flush", ms, trace_id="abc123")
+        status, _ = _get(monitor.url + "/health")
+        assert status == 200
+        with urllib.request.urlopen(monitor.url + "/metrics.prom") as resp:
+            text = resp.read().decode()
+        assert "fluid_ops_sequenced 5" in text
+        # Bucket lines parse and cumulative counts are monotone, ending
+        # at the +Inf bucket == count.
+        buckets = []
+        for line in text.splitlines():
+            if line.startswith('fluid_stage_latency_ms_bucket'
+                               '{stage="serving.flush"'):
+                le = line.split('le="')[1].split('"')[0]
+                count = int(line.split("} ")[1].split(" #")[0])
+                buckets.append((le, count))
+        assert buckets, text
+        counts = [c for _, c in buckets]
+        assert counts == sorted(counts)
+        assert buckets[-1][0] == "+Inf" and buckets[-1][1] == 4
+        assert 'fluid_stage_latency_ms_count{stage="serving.flush"} 4' \
+            in text
+        # Exemplar carries the trace id of the last sample in the bucket.
+        assert 'trace_id="abc123"' in text
+
+    def test_slo_gauge_present(self, monitor):
+        with urllib.request.urlopen(monitor.url + "/metrics.prom") as resp:
+            text = resp.read().decode()
+        assert 'fluid_slo_ok{stage="serving.flush"} 1' in text
+
+
+class TestSlo:
+    def _fill(self, spread):
+        # 90 fast + 10 at `spread`x: p99 lands in the tail.
+        for i in range(100):
+            counters.observe("serving.flush",
+                             1.0 if i < 90 else float(spread))
+
+    def test_in_budget_health_ok(self, monitor):
+        self._fill(1.5)
+        status, body = _get(monitor.url + "/health")
+        assert status == 200
+        assert body["slo"]["evaluated"] and body["slo"]["ok"]
+
+    def test_breach_flips_503_with_detail(self, monitor):
+        self._fill(50.0)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(monitor.url + "/health")
+        assert err.value.code == 503
+        body = json.load(err.value)
+        assert body["slo"]["ok"] is False
+        assert body["slo"]["ratio"] > 2.0
+        assert body["slo"]["budget"] == "p99 <= 2 * p50"
+
+    def test_too_few_samples_not_evaluated(self, monitor):
+        for _ in range(8):
+            counters.observe("serving.flush", 100.0)
+        counters.observe("serving.flush", 1.0)
+        status, body = _get(monitor.url + "/health")
+        assert status == 200
+        assert body["slo"]["evaluated"] is False
+        assert body["slo"]["ok"] is True
+
+    def test_report_only_mode(self):
+        self._fill(50.0)
+        mon = ServiceMonitor(enforce_slo=False).start()
+        try:
+            status, body = _get(mon.url + "/health")
+            assert status == 200
+            assert body["slo"]["ok"] is False  # verdict still visible
+        finally:
+            mon.stop()
+
+    def test_custom_policy(self):
+        self._fill(3.0)
+        mon = ServiceMonitor(
+            slo=SloPolicy(p99_over_p50=4.0, min_samples=10)).start()
+        try:
+            status, body = _get(mon.url + "/health")
+            assert status == 200 and body["slo"]["ok"]
+        finally:
+            mon.stop()
+
+
+class TestTraceEndpoint:
+    def test_trace_drains_chrome_json(self, monitor):
+        tracing.configure(sample=1)
+        with tracing.span("stage.a", root=True):
+            with tracing.span("stage.b"):
+                pass
+        status, body = _get(monitor.url + "/trace")
+        assert status == 200
+        names = [e["name"] for e in body["traceEvents"]]
+        assert "stage.a" in names and "stage.b" in names
+        for e in body["traceEvents"]:
+            assert e["ph"] == "X"
+            assert "trace_id" in e["args"]
+        # Drained: a second read starts empty.
+        _, body2 = _get(monitor.url + "/trace")
+        assert body2["traceEvents"] == []

@@ -495,6 +495,117 @@ class TestMutableDefault:
         assert rule_ids(src, "MUTABLE_DEFAULT") == []
 
 
+class TestSpanLeak:
+    def test_true_positive_started_never_ended(self):
+        src = """
+            from fluidframework_tpu.telemetry import tracing
+
+            def flush(backlog):
+                sp = tracing.span("serving.flush")
+                for item in backlog:
+                    process(item)
+        """
+        assert rule_ids(src, "SPAN_LEAK") == ["SPAN_LEAK"]
+
+    def test_true_positive_end_in_straight_line_code(self):
+        src = """
+            from fluidframework_tpu.telemetry import tracing
+
+            def flush(backlog):
+                sp = tracing.span("serving.flush")
+                dispatch(backlog)   # raises -> sp leaks
+                sp.end()
+        """
+        assert rule_ids(src, "SPAN_LEAK") == ["SPAN_LEAK"]
+
+    def test_true_positive_unrelated_finally_does_not_cover_start(self):
+        # The finally holds an end(), but its try starts AFTER dispatch:
+        # dispatch() raising leaks the span — exactly the hole-in-the-
+        # trace failure the rule exists for.
+        src = """
+            from fluidframework_tpu.telemetry import tracing
+
+            def flush(backlog):
+                sp = tracing.span("serving.flush")
+                dispatch(backlog)   # raises -> sp leaks; try below moot
+                try:
+                    other()
+                finally:
+                    sp.end()
+        """
+        assert rule_ids(src, "SPAN_LEAK") == ["SPAN_LEAK"]
+
+    def test_guard_start_inside_try_body(self):
+        src = """
+            from fluidframework_tpu.telemetry import tracing
+
+            def flush(backlog):
+                try:
+                    sp = tracing.span("serving.flush")
+                    dispatch(backlog)
+                finally:
+                    sp.end()
+        """
+        assert rule_ids(src, "SPAN_LEAK") == []
+
+    def test_guard_with_statement(self):
+        src = """
+            from fluidframework_tpu.telemetry import tracing
+
+            def flush(backlog):
+                with tracing.span("serving.flush"):
+                    dispatch(backlog)
+        """
+        assert rule_ids(src, "SPAN_LEAK") == []
+
+    def test_guard_end_in_finally(self):
+        src = """
+            from fluidframework_tpu.telemetry import tracing
+
+            def flush(backlog):
+                sp = tracing.span("serving.flush")
+                try:
+                    dispatch(backlog)
+                finally:
+                    sp.end()
+        """
+        assert rule_ids(src, "SPAN_LEAK") == []
+
+    def test_guard_cancel_in_finally(self):
+        src = """
+            from fluidframework_tpu.telemetry import tracing
+
+            def flush(backlog):
+                sp = tracing.span("serving.flush")
+                try:
+                    dispatch(backlog)
+                    sp.end()
+                finally:
+                    sp.cancel()
+        """
+        assert rule_ids(src, "SPAN_LEAK") == []
+
+    def test_guard_non_span_call(self):
+        src = """
+            def flush(backlog):
+                spacing = compute_spacing("x")
+                return spacing
+        """
+        assert rule_ids(src, "SPAN_LEAK") == []
+
+    def test_out_of_scope_module_is_quiet(self):
+        from fluidframework_tpu.analysis import analyze_source
+        src = textwrap.dedent("""
+            from fluidframework_tpu.telemetry import tracing
+
+            def f():
+                sp = tracing.span("x")
+        """)
+        hits = analyze_source(src, path="examples/clicker.py",
+                              only=["SPAN_LEAK"])
+        assert hits == []
+
+
 # ---------------------------------------------------------------------------
 # suppressions + baseline + CLI
 # ---------------------------------------------------------------------------

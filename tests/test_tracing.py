@@ -1,0 +1,321 @@
+"""telemetry/tracing.py: span/context semantics, sampling policy, the
+flight recorder, wire propagation, and the end-to-end op trace through
+the real pipeline (submit -> ticket -> flush -> broadcast)."""
+
+import json
+import threading
+
+import pytest
+
+from fluidframework_tpu.telemetry import counters, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    counters.reset()
+    tracing.reset()
+    yield
+    counters.reset()
+    tracing.reset()
+
+
+class TestSpanBasics:
+    def test_disabled_is_noop(self):
+        assert not tracing.enabled()
+        with tracing.span("x", root=True):
+            pass
+        assert len(tracing.recorder) == 0
+
+    def test_root_span_records_when_sampled(self):
+        tracing.configure(sample=1)
+        with tracing.span("stage", root=True, detail=7):
+            pass
+        spans = tracing.recorder.snapshot()
+        assert [s["name"] for s in spans] == ["stage"]
+        assert spans[0]["attrs"]["detail"] == 7
+        assert spans[0]["parent_id"] is None
+
+    def test_nesting_inherits_trace_and_parent(self):
+        tracing.configure(sample=1)
+        with tracing.span("outer", root=True) as outer:
+            with tracing.span("inner"):
+                pass
+        inner, outer_rec = tracing.recorder.snapshot()
+        assert inner["name"] == "inner"
+        assert inner["trace_id"] == outer_rec["trace_id"]
+        assert inner["parent_id"] == outer.ctx.span_id
+
+    def test_non_root_without_parent_is_silent(self):
+        tracing.configure(sample=1)
+        with tracing.span("orphan"):
+            pass
+        assert len(tracing.recorder) == 0
+
+    def test_exception_records_error_span(self):
+        tracing.configure(sample=1)
+        with pytest.raises(RuntimeError):
+            with tracing.span("bad", root=True):
+                raise RuntimeError("boom")
+        (span,) = tracing.recorder.snapshot()
+        assert span["attrs"].get("error") is True
+
+    def test_explicit_end_is_idempotent(self):
+        tracing.configure(sample=1)
+        sp = tracing.span("once", root=True)
+        sp.end()
+        sp.end()
+        assert len(tracing.recorder) == 1
+
+    def test_hist_feeds_histogram_even_when_disabled(self):
+        with tracing.span("s", hist="stage.x"):
+            pass
+        snap = counters.latency_snapshot()
+        assert snap["stage.x"]["count"] == 1
+        assert len(tracing.recorder) == 0
+
+
+class TestSampling:
+    def test_one_in_n(self):
+        tracing.configure(sample=4)
+        roots = [tracing.new_op_trace() for _ in range(16)]
+        minted = [r for r in roots if r is not None]
+        assert len(minted) == 4
+
+    def test_always_sample_on_slow(self):
+        tracing.configure(sample=1000, slow_ms=0.0)
+        # Deterministically unsampled context; slow_ms=0 means every
+        # span crosses the slow threshold at end().
+        ctx = tracing.TraceContext("f" * 16, "1", sampled=False)
+        with tracing.span("slowpoke", parent=ctx):
+            pass
+        spans = tracing.recorder.snapshot()
+        assert [s["name"] for s in spans] == ["slowpoke"]
+        assert spans[0]["sampled"] is False  # recorded BECAUSE slow
+
+    def test_fast_unsampled_not_recorded(self):
+        tracing.configure(sample=1000, slow_ms=10_000.0)
+        ctx = tracing.TraceContext("f" * 16, "1", sampled=False)
+        with tracing.span("fast", parent=ctx):
+            pass
+        assert len(tracing.recorder) == 0
+
+
+class TestFlightRecorder:
+    def test_bounded_overwrites_oldest(self):
+        tracing.configure(sample=1, capacity=4)
+        for i in range(7):
+            with tracing.span(f"s{i}", root=True):
+                pass
+        names = [s["name"] for s in tracing.recorder.snapshot()]
+        assert len(names) == 4
+        assert names == ["s3", "s4", "s5", "s6"]  # oldest first
+        assert tracing.recorder.dropped == 3
+
+    def test_drain_clears(self):
+        tracing.configure(sample=1)
+        with tracing.span("a", root=True):
+            pass
+        assert len(tracing.recorder.drain()) == 1
+        assert tracing.recorder.drain() == []
+
+    def test_concurrent_records(self):
+        tracing.configure(sample=1, capacity=4096)
+
+        def work():
+            for _ in range(100):
+                with tracing.span("t", root=True):
+                    pass
+
+        threads = [threading.Thread(target=work) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(tracing.recorder) == 400
+
+
+class TestWirePropagation:
+    def test_stamp_and_extract(self):
+        from fluidframework_tpu.protocol.messages import DocumentMessage
+        tracing.configure(sample=1)
+        ctx = tracing.TraceContext("t" * 16, "1", sampled=True)
+        msg = DocumentMessage(client_sequence_number=1,
+                              reference_sequence_number=0, type="op")
+        tracing.stamp_message(msg, ctx)
+        # Compact string form: asdict-atomic on the persistence path.
+        assert msg.metadata == {"trace": f"{'t' * 16}:1:1"}
+        back = tracing.message_context(msg)
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == "1"
+        assert back.sampled is True
+
+    def test_unsampled_wire_round_trip(self):
+        tracing.configure(sample=1)
+        ctx = tracing.TraceContext("abc", "9", sampled=False)
+        back = tracing.TraceContext.from_wire(ctx.to_wire())
+        assert back.sampled is False and back.trace_id == "abc"
+
+    def test_legacy_dict_form_still_parses(self):
+        tracing.configure(sample=1)
+        back = tracing.TraceContext.from_wire(
+            {"traceId": "x", "spanId": "2", "sampled": False})
+        assert back.trace_id == "x" and back.sampled is False
+
+    def test_stamp_preserves_existing_metadata(self):
+        from fluidframework_tpu.protocol.messages import DocumentMessage
+        tracing.configure(sample=1)
+        msg = DocumentMessage(client_sequence_number=1,
+                              reference_sequence_number=0, type="op",
+                              metadata={"batch": True})
+        tracing.stamp_message(msg, tracing.TraceContext("a", "b"))
+        assert msg.metadata["batch"] is True
+        assert tracing.message_context(msg).trace_id == "a"
+
+    def test_context_survives_json_round_trip(self):
+        from fluidframework_tpu.protocol.messages import DocumentMessage
+        from fluidframework_tpu.server.wire import (
+            document_message_from_dict, document_message_to_dict)
+        tracing.configure(sample=1)
+        msg = DocumentMessage(client_sequence_number=1,
+                              reference_sequence_number=0, type="op")
+        tracing.stamp_message(msg, tracing.TraceContext("deadbeef", "7"))
+        wire = json.loads(json.dumps(document_message_to_dict(msg)))
+        back = tracing.message_context(document_message_from_dict(wire))
+        assert back is not None and back.trace_id == "deadbeef"
+
+    def test_op_trace_handoff(self):
+        tracing.configure(sample=1)
+        ctx = tracing.new_op_trace()
+        assert ctx is not None
+        assert tracing.take_op_trace() is ctx
+        assert tracing.take_op_trace() is None
+
+    def test_unsampled_edit_decision_respected_at_submit(self):
+        # One sampler draw per op: an edit whose draw said "no" must not
+        # get a second roll at the driver boundary (that would double
+        # the effective rate and mint traces missing client.local_edit).
+        tracing.configure(sample=2)
+        minted = 0
+        for _ in range(20):
+            edit_ctx = tracing.new_op_trace()
+            submit_ctx = tracing.ensure_op_context()
+            assert (edit_ctx is None) == (submit_ctx is None)
+            if submit_ctx is not None:
+                assert submit_ctx is edit_ctx
+                minted += 1
+        assert minted == 10  # exactly 1-in-2, not 1-in-2 twice-rolled
+
+
+class TestChromeExport:
+    def test_events_shape(self):
+        tracing.configure(sample=1)
+        with tracing.span("parent", root=True):
+            with tracing.span("child"):
+                pass
+        out = tracing.chrome_trace()
+        assert json.dumps(out)  # serializable
+        assert out["displayTimeUnit"] == "ms"
+        by_name = {e["name"]: e for e in out["traceEvents"]}
+        assert set(by_name) == {"parent", "child"}
+        for e in out["traceEvents"]:
+            assert e["ph"] == "X" and e["pid"] == 1
+            assert e["dur"] >= 0
+        assert (by_name["child"]["args"]["parent_id"]
+                == by_name["parent"]["args"]["span_id"])
+
+
+class TestClientEditRoots:
+    def test_local_edit_mints_trace_and_parks_context(self):
+        from fluidframework_tpu.mergetree.client import MergeTreeClient
+        tracing.configure(sample=1)
+        client = MergeTreeClient(client_id=0)
+        client.insert_text_local(0, "hello")
+        spans = tracing.recorder.snapshot()
+        assert [s["name"] for s in spans] == ["client.local_edit"]
+        parked = tracing.take_op_trace()
+        assert parked is not None
+        assert parked.trace_id == spans[0]["trace_id"]
+
+    def test_edits_untraced_when_disabled(self):
+        from fluidframework_tpu.mergetree.client import MergeTreeClient
+        client = MergeTreeClient(client_id=0)
+        client.insert_text_local(0, "hello")
+        assert len(tracing.recorder) == 0
+        assert tracing.take_op_trace() is None
+
+
+SERVING_SUBSPANS = {"serving.pack", "serving.dispatch", "serving.readback",
+                    "serving.fold_rescue", "serving.gc"}
+
+
+class TestEndToEndPipeline:
+    """A single traced op yields one parent trace spanning
+    submit -> ticket -> flush -> broadcast, with the named serving
+    sub-spans riding the same trace on the device-batched path."""
+
+    def _drive(self, server):
+        from fluidframework_tpu.loader.drivers.local import (
+            LocalDocumentServiceFactory)
+        from fluidframework_tpu.mergetree.client import OP_INSERT
+        from fluidframework_tpu.protocol.messages import (DocumentMessage,
+                                                          MessageType)
+        svc = LocalDocumentServiceFactory(server) \
+            .create_document_service("doc-e2e")
+        conn = svc.connect_to_delta_stream({"user": "u"})
+        seen = []
+        conn.on("op", seen.append)
+        conn.submit([DocumentMessage(
+            client_sequence_number=1, reference_sequence_number=0,
+            type=MessageType.OPERATION,
+            contents={"address": "s", "contents": {
+                "address": "t", "contents": {
+                    "type": OP_INSERT, "pos1": 0,
+                    "seg": {"text": "traced"}}}})])
+        assert seen, "op was not sequenced/broadcast"
+        by_trace = {}
+        for s in tracing.recorder.snapshot():
+            by_trace.setdefault(s["trace_id"], set()).add(s["name"])
+        return by_trace
+
+    def test_scalar_pipeline_full_trace(self):
+        from fluidframework_tpu.server.local_server import LocalServer
+        tracing.configure(sample=1)
+        by_trace = self._drive(LocalServer())
+        assert any({"driver.submit", "server.ingest", "deli.ticket",
+                    "broadcaster.fanout"} <= names
+                   for names in by_trace.values())
+
+    def test_tpu_pipeline_full_trace_with_serving_subspans(self):
+        from fluidframework_tpu.server.local_server import TpuLocalServer
+        tracing.configure(sample=1)
+        by_trace = self._drive(TpuLocalServer())
+        want = ({"driver.submit", "server.ingest", "deli.ticket",
+                 "serving.flush", "broadcaster.fanout"}
+                | SERVING_SUBSPANS)
+        full = [names for names in by_trace.values() if want <= names]
+        assert full, {t: sorted(n) for t, n in by_trace.items()}
+
+    def test_stage_histograms_fill_without_tracing(self):
+        from fluidframework_tpu.server.local_server import TpuLocalServer
+        assert not tracing.enabled()
+        self_spans_before = len(tracing.recorder)
+        from fluidframework_tpu.loader.drivers.local import (
+            LocalDocumentServiceFactory)
+        from fluidframework_tpu.mergetree.client import OP_INSERT
+        from fluidframework_tpu.protocol.messages import (DocumentMessage,
+                                                          MessageType)
+        server = TpuLocalServer()
+        svc = LocalDocumentServiceFactory(server) \
+            .create_document_service("doc-h")
+        conn = svc.connect_to_delta_stream({"user": "u"})
+        conn.submit([DocumentMessage(
+            client_sequence_number=1, reference_sequence_number=0,
+            type=MessageType.OPERATION,
+            contents={"address": "s", "contents": {
+                "address": "t", "contents": {
+                    "type": OP_INSERT, "pos1": 0,
+                    "seg": {"text": "x"}}}})])
+        snap = counters.latency_snapshot()
+        assert "serving.flush" in snap
+        assert SERVING_SUBSPANS <= set(snap)
+        assert len(tracing.recorder) == self_spans_before  # no spans
