@@ -2,7 +2,7 @@
 # must be a one-liner anyone can repeat).
 
 .PHONY: test soak bench dryrun record-corpus historian-smoke \
-	summarize-smoke trace-smoke lint-analysis check
+	summarize-smoke trace-smoke pipeline-smoke lint-analysis check
 
 test:
 	python -m pytest tests/ -q
@@ -32,9 +32,17 @@ summarize-smoke:
 trace-smoke:
 	JAX_PLATFORMS=cpu python bench.py trace-smoke
 
-# The pre-merge gate: static analysis + the summarize/trace smokes +
-# the full test suite.
-check: lint-analysis summarize-smoke trace-smoke test
+# CPU smoke of the deep-pipelined serving path (docs/serving_pipeline.md):
+# identical raw-wire waves through a synchronous and a ring-pipelined
+# sequencer must emit a BIT-IDENTICAL stream with identical lane state,
+# the in-flight ring must actually run deeper than one window, and warm
+# steady-state ingest must clear 1.3x the pinned BENCH_r05 CPU figure.
+pipeline-smoke:
+	JAX_PLATFORMS=cpu python bench.py pipeline-smoke
+
+# The pre-merge gate: static analysis + the summarize/trace/pipeline
+# smokes + the full test suite.
+check: lint-analysis summarize-smoke trace-smoke pipeline-smoke test
 
 # The round-end randomized-evidence ritual: 50-trial soaks over every
 # differential surface (bulk catch-up, serving fast path, matrix/

@@ -248,7 +248,14 @@ def dtype_drift(ctx: ModuleContext) -> Iterator[Violation]:
             f"({', '.join(sorted(canonical))})")
 
 
-_STEP_NAME_RE = re.compile(r"(step|apply)", re.IGNORECASE)
+# serve/window joined step/apply when serve_window gained lane-state
+# donation (donate_argnums=(0, 2, 4)): the serving window threads the
+# ticket state plus every merge/LWW lane plane per flush, so a dropped
+# donation there doubles peak HBM on the hottest path in the system.
+# Word-ish anchoring so names that merely CONTAIN a keyword (observe,
+# reserved, stepper-adjacent helpers like `misapply`) don't fire.
+_STEP_NAME_RE = re.compile(r"(^|_)(step|apply|serve|window)",
+                           re.IGNORECASE)
 
 
 def _threads_state(fn: ast.FunctionDef) -> bool:

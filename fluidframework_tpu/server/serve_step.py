@@ -36,7 +36,7 @@ from . import lww_kernel as lk
 from . import ticket_kernel as tk
 
 
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnums=(6,))
+@functools.partial(jax.jit, donate_argnums=(0, 2, 4), static_argnums=(6,))
 def serve_window(tstate, ticket_cols, merge_states, merge_cols,
                  lww_states, lww_cols, fused=False, merge_runs=None):
     """The WHOLE fast window in one device program — over a tunneled
@@ -52,7 +52,9 @@ def serve_window(tstate, ticket_cols, merge_states, merge_cols,
     Returns (tstate', merge_states', lww_states', flat16, msn32) where
     flat16 is the NARROW int16 result the host fetches every window:
     [seq_delta B*T | msn_delta B*T | flags B*T | next_seq as (lo B, hi B)
-    | msn_base as (lo B, hi B) | msn_ok bit | overflow bits], decoded by
+    | msn_base as (lo B, hi B) | msn_ok bit | overflow-any bits |
+    per-lane overflow planes (merge then LWW, lanes each) | per-lane
+    occupancy planes (same order)], decoded by
     tpu_sequencer._finish_window; msn32 is the exact int32 msn plane,
     fetched ONLY when the window's msn span overflows the delta (msn_ok
     == 0; one global bit for the whole window)."""
@@ -142,6 +144,29 @@ def serve_window(tstate, ticket_cols, merge_states, merge_cols,
     bits = [tstate.overflow.any()[None].astype(jnp.int32)]
     bits += [s.overflow.any()[None].astype(jnp.int32) for s in new_merge]
     bits += [s.overflow.any()[None].astype(jnp.int32) for s in new_lww]
+    # Per-lane overflow planes ride the SAME narrow result (one int16 per
+    # staged bucket lane): overflow recovery learns WHICH lanes flagged
+    # without touching the post states at all — required once the lane
+    # states are donated (the in-ring rollback cannot read a buffer the
+    # next window's dispatch reused), and it also deletes the separate
+    # per-bucket `overflow` D2H the rare recovery path used to pay.
+    # fluidlint: disable=DTYPE_DRIFT — deliberate 16-bit wire packing:
+    # the planes ride flat16, the narrow result plane (docstring).
+    planes = [s.overflow.astype(jnp.int16) for s in new_merge]
+    # fluidlint: disable=DTYPE_DRIFT — deliberate 16-bit wire packing
+    # (same flat16 plane as the merge overflow planes above).
+    planes += [s.overflow.astype(jnp.int16) for s in new_lww]
+    # Post-window occupancy planes (row count per merge lane, occupied
+    # key slots per LWW lane; capacities are <= 16k so int16 is exact):
+    # the host's donation/deferral gate keeps its occupancy hints EXACT
+    # from every window's own result instead of decaying pessimistic
+    # until a compact-tick refresh — no extra device round-trip.
+    # fluidlint: disable=DTYPE_DRIFT — deliberate 16-bit wire packing
+    # (rides the same flat16 narrow result plane).
+    planes += [s.count.astype(jnp.int16) for s in new_merge]
+    # fluidlint: disable=DTYPE_DRIFT — deliberate 16-bit wire packing
+    # (rides the same flat16 narrow result plane).
+    planes += [(s.key >= 0).sum(-1).astype(jnp.int16) for s in new_lww]
 
     # NARROW result packing: the window result is the serving path's one
     # D2H, and over a tunneled device transfer bytes are throughput
@@ -172,6 +197,23 @@ def serve_window(tstate, ticket_cols, merge_states, merge_cols,
          msn_d.ravel().astype(jnp.int16),
          flags.ravel().astype(jnp.int16)]
         + halves(next32) + halves(msn_base)
-        + [jnp.concatenate([msn_ok[None]] + bits).astype(jnp.int16)])
+        # fluidlint: disable=DTYPE_DRIFT — deliberate 16-bit wire packing:
+        # flat16 is the NARROW result plane (docstring); decoded by
+        # tpu_sequencer._finish_window.
+        + [jnp.concatenate([msn_ok[None]] + bits).astype(jnp.int16)]
+        + planes)
     # Fetched ONLY when msn_ok == 0 (second RPC on the rare path).
     return tstate, new_merge, new_lww, flat16, msn_bt
+
+
+# The non-donating recovery-replay variant: identical traced body, but the
+# merge/LWW lane states survive the call. The sequencer dispatches through
+# THIS variant whenever its host-side occupancy hints cannot prove the
+# window overflow-free — the retained pre-window states are what the
+# fold/rescue rollback scatters back before the batched re-run
+# (tpu_sequencer._recover_fast_merge). The common provably-clean window
+# takes the donating `serve_window` above and never allocates a second
+# copy of the lane planes.
+serve_window_keep = functools.partial(
+    jax.jit, donate_argnums=(0,), static_argnums=(6,))(
+        serve_window.__wrapped__)

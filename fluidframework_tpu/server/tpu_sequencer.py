@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
@@ -53,7 +54,8 @@ from ..protocol.messages import (
     SequencedDocumentMessage,
 )
 from ..telemetry import tracing
-from ..telemetry.counters import (JitRetraceProbe, increment,
+from ..telemetry.counters import (JitRetraceProbe, gauge, get as counter_get,
+                                  increment, latency_window, nearest_rank,
                                   record_swallow)
 from . import ticket_kernel as tk
 from .lambdas.base import IPartitionLambda, LambdaContext
@@ -85,6 +87,16 @@ class _MergeBucket:
         self._free: List[int] = []  # explicitly freed lanes (zeroed)
         self._next = 0              # frontier: lanes >= _next never used
         self.placer = None          # optional dp-mesh placement callable
+        # Host-side UPPER BOUND of each lane's live row count: the donation
+        # gate (tpu_sequencer._assess_windows) proves a window cannot
+        # overflow from these hints alone — no device sync on the hot path.
+        # count_hint is the CONFIRMED base (refreshed exactly from every
+        # drained window's occupancy plane, from recovery put_rows, and at
+        # compact ticks); hint_pending is the in-flight windows' staged-op
+        # bound (added at dispatch, removed at that window's drain). The
+        # live bound is their sum.
+        self.count_hint = np.zeros(lanes, np.int64)
+        self.hint_pending = np.zeros(lanes, np.int64)
 
     def grow(self) -> None:
         old = self.lanes
@@ -94,6 +106,10 @@ class _MergeBucket:
             grown, self.state)
         self.used.extend([None] * old)
         self.lanes = old * 2
+        self.count_hint = np.concatenate(
+            [self.count_hint, np.zeros(old, np.int64)])
+        self.hint_pending = np.concatenate(
+            [self.hint_pending, np.zeros(old, np.int64)])
         if self.placer is not None:
             self.state = self.placer(self.state)
 
@@ -108,6 +124,7 @@ class _MergeBucket:
             i = self._next
             self._next += 1
         self.used[i] = key
+        self.count_hint[i] = 0  # freed/frontier lanes are blank rows
         return i
 
     def free(self, lane: int) -> None:
@@ -124,6 +141,8 @@ class _MergeBucket:
         for i in lanes:
             self.used[i] = None
         self._free.extend(lanes)
+        self.count_hint[np.asarray(lanes, np.int64)] = 0
+        self.hint_pending[np.asarray(lanes, np.int64)] = 0
         if self._blank_row is None:
             self._blank_row = make_state(
                 self.capacity, anno_slots=self.state.anno_slots,
@@ -142,15 +161,25 @@ class _MergeBucket:
         """Extract one lane as a single-doc DocState (host-side gather)."""
         return jax.tree_util.tree_map(lambda x: x[lane], self.state)
 
-    def put_row(self, lane: int, row: DocState) -> None:
+    def put_row(self, lane: int, row: DocState,
+                count_hint: Optional[int] = None) -> None:
         self.state = jax.tree_util.tree_map(
             lambda b, r: b.at[lane].set(r), self.state, row)
+        self.count_hint[lane] = self.capacity if count_hint is None \
+            else count_hint
 
-    def put_rows(self, lanes: List[int], rows: DocState) -> None:
-        """Scatter a [k, ...] sub-batch into k lanes in ONE pass."""
+    def put_rows(self, lanes: List[int], rows: DocState,
+                 count_hints=None) -> None:
+        """Scatter a [k, ...] sub-batch into k lanes in ONE pass.
+        `count_hints` (aligned to `lanes`) keeps the donation gate's
+        occupancy bound tight; omitted = pessimistic until the next
+        compact-tick refresh."""
         idx = jnp.asarray(np.asarray(lanes, np.int32))
         self.state = jax.tree_util.tree_map(
             lambda col, r: col.at[idx].set(r), self.state, rows)
+        self.count_hint[np.asarray(lanes, np.int64)] = \
+            self.capacity if count_hints is None \
+            else np.asarray(count_hints, np.int64)
 
 
 def _stack_seed_rows(items: List[tuple], capacity: int, anno_slots: int,
@@ -585,7 +614,7 @@ class MergeLaneStore:
             min_seq=jnp.asarray(min_seq, jnp.int32),
             seq=jnp.asarray(current_seq, jnp.int32))
         lane = bucket.alloc(key)
-        bucket.put_row(lane, row)
+        bucket.put_row(lane, row, count_hint=len(cols["length"]))
         self.where[key] = (b, lane)
         self.mark_dirty(key)
         # Track the seed generation like a fold's: the first fold (or a
@@ -621,8 +650,19 @@ class MergeLaneStore:
             streams = rest
 
     def _apply_window(self, streams: Dict[tuple, List[HostOp]]) -> None:
+        self._apply_streams(streams)
+        with tracing.span("serving.gc", hist="serving.gc"):
+            self.flushes_since_compact += 1
+            if self.flushes_since_compact >= self.compact_every:
+                self.compact_all()
+
+    def _apply_streams(self, streams: Dict[tuple, List[HostOp]]) -> None:
         """One batched device pass per bucket; recover overflowing lanes by
-        compact -> re-run -> promote."""
+        compact -> re-run -> promote. No GC tick: the in-ring fixup path
+        (TpuSequencerLambda._finish_window) re-applies quarantined lanes
+        through here while later windows are still in flight, and a
+        compaction there would move lanes those windows already staged
+        against."""
         per_bucket: Dict[int, Dict[int, List[HostOp]]] = {}
         for key, ops in streams.items():
             if key in self.opaque or not ops:
@@ -662,6 +702,11 @@ class MergeLaneStore:
                         lambda bcol, p: bcol.at[idx].set(p[idx]),
                         new_state, pre)
                 bucket.state = new_state
+                # Occupancy hints: each applied op adds at most 2 rows
+                # (insert + split); recovery's put_rows re-hints flagged
+                # lanes below.
+                for i, ops in lane_ops.items():
+                    bucket.count_hint[i] += 2 * len(ops)
                 if flagged:
                     # One BATCHED compact->rerun->promote per level —
                     # per-lane device round-trips over a thin host link
@@ -670,11 +715,6 @@ class MergeLaneStore:
                     # stay bounded.
                     self._recover_batch(b, {i: lane_ops[i]
                                             for i in flagged})
-
-        with tracing.span("serving.gc", hist="serving.gc"):
-            self.flushes_since_compact += 1
-            if self.flushes_since_compact >= self.compact_every:
-                self.compact_all()
 
     @staticmethod
     def _pad_pow2(sub: DocState, packed: PackedOps, n: int,
@@ -720,8 +760,14 @@ class MergeLaneStore:
         bad_j = [j for j in range(len(lanes)) if over[j]]
         if ok_j:
             sel = np.asarray(ok_j)
+            # Exact counts ride the same sync the overflow read already
+            # paid: recovered lanes re-qualify for deferral/donation
+            # immediately instead of staying pessimistic until the next
+            # compact tick.
+            cnts = np.asarray(redone.count)
             bucket.put_rows([lanes[j] for j in ok_j],
-                            tm(lambda x: x[sel], redone))
+                            tm(lambda x: x[sel], redone),
+                            count_hints=cnts[sel])
         # Attempt 2: host-fold acked runs and re-run at the SAME
         # capacity. Sustained typing overflows with mostly-acked rows
         # (device compaction cannot merge them — payload bytes live
@@ -763,7 +809,9 @@ class MergeLaneStore:
             if ok_k:
                 new_lanes = target.alloc_many([carried[k] for k in ok_k])
                 sel_ok = np.asarray(ok_k)
-                target.put_rows(new_lanes, tm(lambda x: x[sel_ok], redone))
+                target.put_rows(new_lanes, tm(lambda x: x[sel_ok], redone),
+                                count_hints=np.asarray(
+                                    redone.count)[sel_ok])
                 for k, nl in zip(ok_k, new_lanes):
                     self.where[carried[k]] = (nb, nl)
             keep = [k for k in range(len(carried)) if over[k]]
@@ -834,7 +882,11 @@ class MergeLaneStore:
         if adopted:
             idx = np.asarray(adopted)
             bucket.put_rows([lanes[folded[k][0]] for k in adopted],
-                            tm(lambda x: x[idx], redone))
+                            tm(lambda x: x[idx], redone),
+                            count_hints=[
+                                len(folded[k][2]["length"])
+                                + 2 * len(lane_ops[lanes[folded[k][0]]])
+                                for k in adopted])
             self.folds += len(adopted)
             for k in adopted:
                 # The fold reseeded the rows (coalesced segmentation, new
@@ -902,7 +954,7 @@ class MergeLaneStore:
             min_seq=jnp.asarray(mseq2, jnp.int32),
             seq=jnp.asarray(cseq2, jnp.int32))
         lane = bucket.alloc(key)
-        bucket.put_row(lane, row2)
+        bucket.put_row(lane, row2, count_hint=len(new_entries))
         self.where[key] = (nb, lane)
         self.mark_dirty(key)
         self._swap_fold_payloads(key, self._seed_ids(cols))
@@ -915,6 +967,11 @@ class MergeLaneStore:
         for bucket in self.buckets:
             if any(k is not None for k in bucket.used):
                 bucket.state = kernel.compact_batched(bucket.state)
+                # Exact occupancy refresh at the safe boundary: lanes that
+                # went pessimistic (recovery put_rows) re-qualify for the
+                # donating dispatch. One small D2H per bucket per tick.
+                bucket.count_hint = np.asarray(
+                    bucket.state.count).astype(np.int64).copy()
         self._fold_crowded()
         self._age_blocks()
         self._ticks_since_payload_compact += 1
@@ -1071,7 +1128,9 @@ class MergeLaneStore:
             lanes = target.alloc_many([key for key, *_ in items])
             target.put_rows(lanes, _stack_seed_rows(
                 items, target.capacity, target.state.anno_slots,
-                target.state.rem_clients.shape[-1]))
+                target.state.rem_clients.shape[-1]),
+                count_hints=[len(cols["length"])
+                             for _, cols, *_ in items])
             for (key, cols, *_), lane in zip(items, lanes):
                 self.where[key] = (nb, lane)
                 self._swap_fold_payloads(key, self._seed_ids(cols))
@@ -1449,6 +1508,11 @@ class _LwwBucket:
         self._free: List[int] = []
         self._next = 0
         self.placer = None  # optional dp-mesh placement callable
+        # Upper bound of each lane's occupied key slots (the donation
+        # gate's host-side fit proof; see _MergeBucket.count_hint for
+        # the confirmed-base / in-flight-pending split).
+        self.count_hint = np.zeros(lanes, np.int64)
+        self.hint_pending = np.zeros(lanes, np.int64)
 
     def grow(self) -> None:
         old = self.lanes
@@ -1457,6 +1521,10 @@ class _LwwBucket:
             lambda g, s: g.at[:old].set(s), grown, self.state)
         self.used.extend([None] * old)
         self.lanes = old * 2
+        self.count_hint = np.concatenate(
+            [self.count_hint, np.zeros(old, np.int64)])
+        self.hint_pending = np.concatenate(
+            [self.hint_pending, np.zeros(old, np.int64)])
         if self.placer is not None:
             self.state = self.placer(self.state)
 
@@ -1470,6 +1538,7 @@ class _LwwBucket:
             i = self._next
             self._next += 1
         self.used[i] = key
+        self.count_hint[i] = 0  # freed/frontier lanes are blank rows
         return i
 
     def free(self, lane: int) -> None:
@@ -1477,16 +1546,20 @@ class _LwwBucket:
         # channel's keys/values (see _MergeBucket.free).
         self.used[lane] = None
         self._free.append(lane)
+        self.hint_pending[lane] = 0
         if self._blank_row is None:
             self._blank_row = self.lk.make_lww_state(self.capacity)
-        self.put_row(lane, self._blank_row)
+        self.put_row(lane, self._blank_row, count_hint=0)
 
     def row(self, lane: int):
         return jax.tree_util.tree_map(lambda x: x[lane], self.state)
 
-    def put_row(self, lane: int, row) -> None:
+    def put_row(self, lane: int, row, count_hint: Optional[int] = None) \
+            -> None:
         self.state = jax.tree_util.tree_map(
             lambda b, r: b.at[lane].set(r), self.state, row)
+        self.count_hint[lane] = self.capacity if count_hint is None \
+            else count_hint
 
 
 class LwwLaneStore:
@@ -1682,6 +1755,9 @@ class LwwLaneStore:
                 new = jax.tree_util.tree_map(
                     lambda bcol, p: bcol.at[idx].set(p[idx]), new, pre)
             bucket.state = new
+            # Each applied op can occupy at most one new key slot.
+            for i, ops in lane_ops.items():
+                bucket.count_hint[i] += len(ops)
             for i in flagged:
                 self._promote(b, i, lane_ops[i], t)
 
@@ -1730,6 +1806,10 @@ class LwwLaneStore:
             if not any(k is not None for k in bucket.used):
                 continue
             vals = np.asarray(bucket.state.val)
+            # Exact key-slot occupancy refresh while the plane is on the
+            # host anyway (donation-gate hints; see _MergeBucket).
+            bucket.count_hint = np.count_nonzero(
+                np.asarray(bucket.state.key) >= 0, axis=-1).astype(np.int64)
             out = np.full_like(vals, -1)
             for old, new in remap.items():
                 out[vals == old] = new
@@ -2235,11 +2315,48 @@ class TpuSequencerLambda(IPartitionLambda):
         self._raw_backlog: List[Tuple[int, str, bytes]] = []
         self.poison_frames = 0  # undecodable raw frames dropped (logged)
         self._raw_offsets: Dict[str, int] = {}
-        # Pipelined mode (opt-in): a clean single-window fast flush defers
-        # its result fetch/emit to the next flush's drain(), overlapping
-        # the tunnel transfer with the next backlog's native parse.
+        # Pipelined mode (opt-in): clean fast windows defer their result
+        # fetch/emit into a bounded FIFO ring of dispatched-but-unread
+        # windows, so window k+1's host pack/staging overlaps window k's
+        # device execution and window k-1's narrow readback. The ring
+        # drains in dispatch order; anything lane-state-dependent (slow
+        # windows, fold/rescue, payload GC, summarize extract) forces a
+        # full drain first (docs/serving_pipeline.md).
         self.pipelined = False
-        self._inflight: Optional[dict] = None
+        self.ring_depth = 4            # max dispatched-but-unread windows
+        self.adaptive_window = True    # per-flush T/depth from latencies
+        self._ring: "deque" = deque()
+        # Overflow quarantine (mid-ring fold/rescue): channel ordinals
+        # whose lanes were rolled back + host-recovered while later
+        # windows were already in flight. Those windows' rows for these
+        # channels re-apply host-side at their own drain; the sets clear
+        # when the ring fully drains.
+        self._ring_fixup: set = set()
+        self._ring_fixup_lww: set = set()
+        # Deferred GC cadence: compactions that came due while windows
+        # were in flight (they move lanes, so they only run ring-empty).
+        self._gc_due = False
+        # Donation: provably-overflow-free windows dispatch through the
+        # donating serve_window (lane states updated in place); windows
+        # the occupancy hints cannot clear keep their pre states via
+        # serve_window_keep for the fold/rescue rollback. Mesh placements
+        # keep every window on serve_window_keep (ticket-state-only
+        # donation): on jax 0.4.37 a donated dp-sharded lane-state list
+        # reloaded from the persistent compilation cache returns corrupt
+        # lane planes — cold compiles and non-donating reloads are both
+        # correct, only the cache-hit donating executable miscompiles
+        # (repro: tests/test_mesh_serving.py warm vs cold after
+        # `rm -rf /tmp/fluid_tpu_xla_cache`). Revisit on a jax upgrade.
+        self.donate_lane_states = mesh is None
+        # Bumped by every fast-path fold/rescue/fixup: a flush's staged
+        # lane placement is stale once this moves (re-resolve).
+        self._recovery_gen = 0
+        # Test/chaos hook: defer even hint-risky windows into the ring,
+        # forcing the mid-ring quarantine fixup path that production
+        # traffic only hits on unpredicted (overlap/anno-ring) overflow.
+        # Donation still follows the gate — risky windows keep their pre
+        # states, which the forced recovery then needs.
+        self.defer_risky_windows = False
         # Insert-run packing on the fast path (PERF.md lever 3): typing
         # bursts in a window collapse to INSERT_RUN slots; a mispredicted
         # member admission (rare: dup/stale nack inside a run) flags the
@@ -2255,9 +2372,15 @@ class TpuSequencerLambda(IPartitionLambda):
         self._pump_ord: Dict[str, int] = {}     # doc id -> pump ordinal
         self._pump_synced: Dict[str, int] = {}  # doc id -> synced ordinals
         self._pump_known: set = set()
+        # Docs the SLOW path interned clients into since the last fast
+        # flush: only these re-sync into the pump per flush (the full
+        # _pump_known sweep was O(docs) host work per flush even when
+        # nothing changed).
+        self._pump_sync_dirty: set = set()
         self._pump_docs: List[Optional[str]] = []   # pump ord -> doc id
         self._pump_lane = np.full(64, -1, np.int32)  # pump ord -> lane
         self._pump_chan: List[tuple] = []           # chan ord -> key tuple
+        self._chan_ord: Dict[tuple, int] = {}       # key tuple -> chan ord
         self._lww_key_map = np.full(64, -1, np.int32)  # key ord -> kid
         # Directory lanes: lane key -> set of existing subdirectory paths
         # (host structure; rebuilt by replay, seeded from summaries).
@@ -2437,6 +2560,11 @@ class TpuSequencerLambda(IPartitionLambda):
         queue = self.pending.setdefault(doc_id, [])
         for msg in boxcar.contents:
             queue.append(self._parse(dl, boxcar.client_id, msg))
+        # Slow-path parse may have interned new clients: flag the doc so
+        # the next fast flush re-syncs ONLY it into the pump.
+        if doc_id in self._pump_known and \
+                dl.next_ordinal > self._pump_synced.get(doc_id, 0):
+            self._pump_sync_dirty.add(doc_id)
         dl.log_offset = message.offset
         self._pending_offset = message.offset
 
@@ -2621,15 +2749,47 @@ class TpuSequencerLambda(IPartitionLambda):
             self._flush_window()
         # Slow-path traffic only ever ticks the compaction cadence INSIDE
         # apply() (where the collection must defer); this is its safe
-        # boundary — every window above has fully applied. A deferred
-        # fast window is the same hazard class: its recovery replays
+        # boundary — every window above has fully applied. In-flight ring
+        # windows are the same hazard class: their recovery replays
         # op_ids and pre-window rows numbered against the CURRENT table,
-        # so no renumbering while one is in flight.
-        if self._inflight is None:
+        # so no renumbering while any are in flight.
+        if not self._ring:
+            if self._gc_due:
+                self._run_fast_gc()
             with tracing.span("serving.gc", hist="serving.gc"):
                 self.merge.maybe_compact_payload_ids()
             self._checkpoint()
-        # else: the deferred window's drain checkpoints its own offset.
+        else:
+            # Emit-bearing window drains checkpoint their own offsets.
+            # Lane compactions that came due mid-ring must not starve
+            # under sustained traffic: once 2x overdue, pay one full
+            # drain and run them at the now-safe boundary.
+            if self._gc_due and (
+                    self.merge.flushes_since_compact
+                    >= 2 * self.merge.compact_every
+                    or self.lww.windows_since_value_compact
+                    >= 2 * self.lww.value_compact_every):
+                self.drain()
+                self._run_fast_gc()
+            gauge("serving.ring_occupancy", float(len(self._ring)))
+
+    def _run_fast_gc(self) -> None:
+        """The fast path's due lane compactions, at a ring-empty boundary
+        (compact_all/_fold_crowded move lanes; in-flight windows staged
+        against the old placement would corrupt their successors)."""
+        assert not self._ring
+        self._gc_due = False
+        # compact_all's _fold_crowded reseeds channels at new (bucket,
+        # lane) placements: any flush staging resolved before this point
+        # is stale — bump the gen so the window loop re-resolves, exactly
+        # as it does after a fold/rescue.
+        self._recovery_gen += 1
+        with tracing.span("serving.gc", hist="serving.gc"):
+            if self.merge.flushes_since_compact >= self.merge.compact_every:
+                self.merge.compact_all()
+            if self.lww.windows_since_value_compact >= \
+                    self.lww.value_compact_every:
+                self.lww.compact_values()
 
     # -- the fast (native-pump) flush --------------------------------------
     def _flush_raw(self) -> List[str]:
@@ -2648,27 +2808,30 @@ class TpuSequencerLambda(IPartitionLambda):
         # Re-sync pump client interners for docs the SLOW path interned
         # into since the last flush (fallback joins, eviction, restore
         # replay): the pump must never hand out an ordinal the host side
-        # already assigned to a different client.
-        for doc_id in self._pump_known:
-            dl = self.docs.get(doc_id)
-            if dl is None:
-                continue
-            synced = self._pump_synced.get(doc_id, 0)
-            if dl.next_ordinal > synced:
-                ord_ = self._pump_ord[doc_id]
-                for cid, o in dl.interner.items():
-                    if o >= synced:
-                        self._pump.preload_client(ord_, cid, o)
-                self._pump_synced[doc_id] = dl.next_ordinal
-        # The native parse overlaps the PREVIOUS deferred window's result
-        # transfer (pipelined mode); everything lane-state-dependent waits
-        # for drain() just below.
+        # already assigned to a different client. Only the dirty set —
+        # the full _pump_known sweep was O(docs) per flush even when no
+        # slow-path intern had happened.
+        if self._pump_sync_dirty:
+            for doc_id in self._pump_sync_dirty & self._pump_known:
+                dl = self.docs.get(doc_id)
+                if dl is None:
+                    continue
+                synced = self._pump_synced.get(doc_id, 0)
+                if dl.next_ordinal > synced:
+                    ord_ = self._pump_ord[doc_id]
+                    for cid, o in dl.interner.items():
+                        if o >= synced:
+                            self._pump.preload_client(ord_, cid, o)
+                    self._pump_synced[doc_id] = dl.next_ordinal
+            self._pump_sync_dirty.clear()
+        # The native parse overlaps the in-flight ring windows' device
+        # execution and result transfers (pipelined mode); everything
+        # lane-state-dependent drains first below.
         with tracing.span("serving.pack", hist="serving.pack",
                           stage="parse"):
             parsed = self._pump.parse(bufs)
             cols = parsed.cols
             self._mirror_pump_interns(parsed)
-        self.drain()
 
         # --- fallback routing (doc granularity) ---------------------------
         flags = cols[P.FLAGS]
@@ -2743,8 +2906,20 @@ class TpuSequencerLambda(IPartitionLambda):
         lanes_r = self._pump_lane[doc_col[rows]]
         pos = _cumcount(lanes_r)
         max_per_doc = int(pos.max()) + 1
-        max_t = self.t_buckets[-1]
-        T = _bucket(min(max_per_doc, max_t), self.t_buckets)
+        # Pipelining: clean fast windows defer their result fetch + emit
+        # into the in-flight ring (the NEXT flush's native parse then
+        # overlaps their transfers). Flushes with slow-routed docs or
+        # pending object-path messages stay synchronous — their later
+        # work touches the same lane state a deferred recovery might
+        # roll back — and drain the ring BEFORE resolving lanes, so a
+        # drain-time fold/promotion cannot stale this flush's staging.
+        defer_ok = (self.pipelined and not slow_ids
+                    and not any(self.pending.values()))
+        if not defer_ok:
+            self.drain()
+        per_doc = np.bincount(lanes_r)
+        T, depth = self._adaptive_shape(max_per_doc,
+                                        per_doc[per_doc > 0])
         win = (pos // T).astype(np.int64)
         slot = (pos % T).astype(np.int64)
         n_windows = int(win.max()) + 1
@@ -2762,28 +2937,72 @@ class TpuSequencerLambda(IPartitionLambda):
 
         row_seq = np.zeros(rows.size, np.int32)
         row_msn = np.zeros(rows.size, np.int32)
-        # Pipelining: a single clean fast window may defer its result
-        # fetch + emit to the NEXT flush (whose native parse then overlaps
-        # this window's transfer). Multi-window flushes and flushes with
-        # slow-routed docs stay synchronous — their later work touches the
-        # same lane state the deferred recovery might roll back.
-        defer_ok = (self.pipelined and n_windows == 1 and not slow_ids
-                    and not any(self.pending.values()))
+        # Per-window risk (host occupancy hints): a window whose staged
+        # lanes might overflow dispatches SYNCHRONOUSLY behind a full
+        # drain — its fold/rescue then runs with nothing in flight (the
+        # cheap sync recovery), and the expensive mid-ring quarantine
+        # fixup stays reserved for genuinely unpredicted overflow
+        # (overlap-slot / anno-ring exhaustion).
+        win_m = win[np.searchsorted(rows, merge_all)] \
+            if merge_all.size else np.zeros(0, np.int64)
+        win_l = win[np.searchsorted(rows, lww_all)] \
+            if lww_all.size else np.zeros(0, np.int64)
+        risky, donate_ok = self._assess_windows(
+            parsed, n_windows, merge_all, win_m, chan_ok, chan_b, chan_l,
+            win_l, lchan_ok, lchan_b, lchan_l)
+        gen_seen = self._recovery_gen
         for w in range(n_windows):
+            defer_w = defer_ok and (not risky[w]
+                                    or self.defer_risky_windows)
+            if defer_w:
+                # Bounded ring admission: retire the oldest window once
+                # the ring is full.
+                while len(self._ring) >= depth:
+                    self._drain_one()
+                if self._ring_fixup or self._ring_fixup_lww:
+                    self.drain()
+            elif self._ring:
+                # Sync dispatch (risky or unpipelined): settle every
+                # in-flight window first — _finish_window's quarantine
+                # direction assumes ring entries are LATER windows.
+                self.drain()
+            if self._recovery_gen != gen_seen:
+                # A fold/rescue (drained window's, or the previous sync
+                # window's own) may have moved channels: re-resolve this
+                # flush's lane placement and re-assess the remaining
+                # windows (docs/serving_pipeline.md invariant R3).
+                gen_seen = self._recovery_gen
+                chan_ok, chan_b, chan_l = self._resolve_merge_lanes(
+                    cols[P.CHAN, merge_all])
+                lchan_ok, lchan_b, lchan_l = self._resolve_lww_lanes(
+                    cols[P.CHAN, lww_all])
+                risky, donate_ok = self._assess_windows(
+                    parsed, n_windows, merge_all, win_m, chan_ok, chan_b,
+                    chan_l, win_l, lchan_ok, lchan_b, lchan_l)
+                defer_w = defer_ok and (not risky[w]
+                                        or self.defer_risky_windows)
             sel = win == w
             self._dispatch_fast_window(
                 parsed, backlog, rows[sel], lanes_r[sel], slot[sel], T,
                 mbase, chan_ok, chan_b, chan_l,
                 vbase, lchan_ok, lchan_b, lchan_l,
-                row_seq, sel, row_msn, defer=defer_ok)
+                row_seq, sel, row_msn, defer=defer_w,
+                donate=self.donate_lane_states and bool(donate_ok[w]))
 
         emit_args = (bufs,
                      [self._pump_docs[int(o)] for o in doc_col[rows]],
                      rows, cols, row_seq, row_msn)
-        if self._inflight is not None:
-            self._inflight["emit_args"] = emit_args
+        if defer_ok and self._ring:
+            # Attached to the flush's LAST window: its drain (after every
+            # earlier window filled its row_seq/row_msn slice) emits and
+            # checkpoints for the whole flush.
+            self._ring[-1]["emit_args"] = emit_args
         else:
             self._emit_fast_window(emit_args)
+        gauge("serving.ring_occupancy", float(len(self._ring)))
+        peak = max(len(self._ring),
+                   int(counter_get("serving.ring_peak_occupancy")))
+        gauge("serving.ring_peak_occupancy", float(peak))
         return sorted(doc_active.keys() - slow_ids)
 
     def _emit_fast_window(self, emit_args) -> None:
@@ -2798,24 +3017,41 @@ class TpuSequencerLambda(IPartitionLambda):
                 self.emit(doc_id, msg)
         # Compaction cadence bookkeeping (the fast path bypasses
         # MergeLaneStore.apply / LwwLaneStore.apply which normally tick).
-        with tracing.span("serving.gc", hist="serving.gc"):
-            self.merge.flushes_since_compact += 1
-            if self.merge.flushes_since_compact >= self.merge.compact_every:
-                self.merge.compact_all()
-            self.lww.windows_since_value_compact += 1
-            if self.lww.windows_since_value_compact >= \
-                    self.lww.value_compact_every:
-                self.lww.compact_values()
+        # The compactions themselves ALWAYS defer to the flush-boundary
+        # handler (end of flush(), or the starvation drain): this method
+        # also runs from a mid-flush _drain_one, where an inline
+        # compact_all fold would move lanes the CURRENT flush already
+        # resolved staging against — and GC, unlike recovery, does not
+        # ride the _recovery_gen staleness re-resolve alone (it bumps
+        # the gen too, belt and braces; see _run_fast_gc).
+        self.merge.flushes_since_compact += 1
+        self.lww.windows_since_value_compact += 1
+        due = (self.merge.flushes_since_compact >= self.merge.compact_every
+               or self.lww.windows_since_value_compact
+               >= self.lww.value_compact_every)
+        if due and not self._gc_due:
+            self._gc_due = True
+            if self._ring:
+                increment("serving.ring_gc_deferred")
 
     def drain(self) -> None:
-        """Finish the deferred fast window, if any: join the result
-        transfer, then nacks, overflow recovery, batched emit, and the
-        window's checkpoint — always on the caller's thread, so lane
-        stores are never touched concurrently."""
-        ctx = self._inflight
-        if ctx is None:
-            return
-        self._inflight = None
+        """Finish EVERY deferred fast window, oldest first: join each
+        result transfer, then nacks, overflow recovery, the flush's
+        batched emit, and its checkpoint — always on the caller's thread,
+        so lane stores are never touched concurrently. A completed full
+        drain clears the overflow quarantine: every window that could
+        carry a quarantined channel's ops has re-applied them."""
+        while self._ring:
+            self._drain_one()
+        if self._ring_fixup or self._ring_fixup_lww:
+            self._ring_fixup.clear()
+            self._ring_fixup_lww.clear()
+
+    def _drain_one(self) -> None:
+        """Retire the OLDEST in-flight window (FIFO: emits and lane
+        mutations must land in dispatch order)."""
+        ctx = self._ring.popleft()
+        increment("serving.ring_drains")
         _t0 = time.perf_counter()
         ctx["thread"].join()
         # The deferred window's D2H: attributed to the flush that
@@ -2827,10 +3063,12 @@ class TpuSequencerLambda(IPartitionLambda):
         if "error" in ctx:
             raise ctx["error"]
         self._finish_window(ctx)
+        if "emit_args" not in ctx:
+            return  # a non-final window of a multi-window flush
         self._emit_fast_window(ctx["emit_args"])
-        # Commit only the offsets this window covered; offsets staged
-        # after the deferral belong to a window that has not sequenced
-        # yet and must survive a crash for replay.
+        # Commit only the offsets this window's FLUSH covered; offsets
+        # staged after the deferral belong to a flush that has not
+        # sequenced yet and must survive a crash for replay.
         newer = self._pending_offset
         self._pending_offset = ctx["offset"]
         self._checkpoint()
@@ -2867,8 +3105,9 @@ class TpuSequencerLambda(IPartitionLambda):
                 self._pump_synced.get(name, 0), ord_ + 1)
         for chan_ord, doc_ord, store, chan in parsed.new_channels:
             assert chan_ord == len(self._pump_chan)
-            self._pump_chan.append(
-                (self._pump_docs[doc_ord], store, chan))
+            key = (self._pump_docs[doc_ord], store, chan)
+            self._pump_chan.append(key)
+            self._chan_ord[key] = chan_ord
         for ord_, key in parsed.new_keys:
             kid = self.lww.intern_key(key)
             if ord_ >= len(self._lww_key_map):
@@ -2919,28 +3158,7 @@ class TpuSequencerLambda(IPartitionLambda):
         self._flush_merge_rows = merge_rows
 
         chans = cols[P.CHAN, merge_rows]
-        uniq, inv = np.unique(chans, return_inverse=True)
-        ok_u = np.zeros(uniq.size, bool)
-        b_u = np.zeros(uniq.size, np.int32)
-        l_u = np.zeros(uniq.size, np.int32)
-        for j, ch in enumerate(uniq.tolist()):
-            key = self._pump_chan[ch]
-            if key in self.merge.opaque:
-                continue
-            if key not in self.merge.where and self.storage is not None:
-                probe = self._probe_summary(key[0])
-                if probe is not None:
-                    payload = probe.channels.get((key[1], key[2]))
-                    if payload is not None:
-                        self.merge.seed(key, *payload)
-                        if key in self.merge.opaque:
-                            continue
-            bb, ll = self.merge.lane_for(key)
-            self.merge.mark_dirty(key)
-            ok_u[j] = True
-            b_u[j] = bb
-            l_u[j] = ll
-        ok_rows = ok_u[inv]
+        ok_rows, b_rows, l_rows = self._resolve_merge_lanes(chans)
         # Block aging bookkeeping: which lanes reference which of this
         # block's op ids. Non-admitted rows (opaque/degraded channels —
         # the host object path is authoritative for them) are freed NOW:
@@ -2966,7 +3184,35 @@ class TpuSequencerLambda(IPartitionLambda):
             self.merge.free_payloads((mbase + bad_idx).tolist())
         if lane_ids:
             self.merge.note_block(block, lane_ids)
-        return mbase, ok_rows, b_u[inv], l_u[inv]
+        return mbase, ok_rows, b_rows, l_rows
+
+    def _resolve_merge_lanes(self, chans: np.ndarray):
+        """Resolve each merge row's channel to its CURRENT (bucket, lane),
+        seeding new channels from stored summaries exactly as the slow
+        path does. Idempotent — re-run after a mid-ring recovery moved
+        channels (promotion/fold) to refresh a flush's staging."""
+        uniq, inv = np.unique(chans, return_inverse=True)
+        ok_u = np.zeros(uniq.size, bool)
+        b_u = np.zeros(uniq.size, np.int32)
+        l_u = np.zeros(uniq.size, np.int32)
+        for j, ch in enumerate(uniq.tolist()):
+            key = self._pump_chan[ch]
+            if key in self.merge.opaque:
+                continue
+            if key not in self.merge.where and self.storage is not None:
+                probe = self._probe_summary(key[0])
+                if probe is not None:
+                    payload = probe.channels.get((key[1], key[2]))
+                    if payload is not None:
+                        self.merge.seed(key, *payload)
+                        if key in self.merge.opaque:
+                            continue
+            bb, ll = self.merge.lane_for(key)
+            self.merge.mark_dirty(key)
+            ok_u[j] = True
+            b_u[j] = bb
+            l_u[j] = ll
+        return ok_u[inv], b_u[inv], l_u[inv]
 
     def _lww_block_and_lanes(self, parsed, lww_rows: np.ndarray):
         from . import pump as P
@@ -2980,8 +3226,11 @@ class TpuSequencerLambda(IPartitionLambda):
         block = _LwwValueBlock(parsed.bufs, cols[P.BUF, lww_rows].copy(),
                                vstart, cols[P.PEND, lww_rows].copy())
         vbase = self.lww.add_value_block(block)
+        ok, b, lane = self._resolve_lww_lanes(cols[P.CHAN, lww_rows])
+        return vbase, ok, b, lane
 
-        chans = cols[P.CHAN, lww_rows]
+    def _resolve_lww_lanes(self, chans: np.ndarray):
+        """LWW side of _resolve_merge_lanes (same idempotence contract)."""
         uniq, inv = np.unique(chans, return_inverse=True)
         ok_u = np.zeros(uniq.size, bool)
         b_u = np.zeros(uniq.size, np.int32)
@@ -3003,13 +3252,75 @@ class TpuSequencerLambda(IPartitionLambda):
             ok_u[j] = True
             b_u[j] = bb
             l_u[j] = ll
-        return vbase, ok_u[inv], b_u[inv], l_u[inv]
+        return ok_u[inv], b_u[inv], l_u[inv]
+
+    def _adaptive_shape(self, max_per_doc: int,
+                        doc_depths: Optional[np.ndarray] = None
+                        ) -> Tuple[int, int]:
+        """Pick the window op-depth T and the per-flush ring depth from
+        the backlog's per-doc depth distribution plus the rolling
+        serving.pack/dispatch/readback histograms.
+
+        The op-depth always comes from the FIXED t_buckets grid — the
+        adaptive policy changes which bucket is chosen, never the shape
+        vocabulary, so serve_window's compile cache stays bounded
+        (JitRetraceProbe-checked in tests/test_pipelined_serving.py).
+
+        Policy: T follows the p95 per-doc depth, not the max. A RAGGED
+        backlog (one storm doc atop a fleet of keystroke docs) would
+        otherwise pad EVERY lane to the deepest doc — [B, T] staging and
+        the scan kernel's step count both scale with T — so the bulk of
+        the fleet rides one narrow window and only the storm doc spans
+        the extra ring slots. A uniform backlog keeps its exact-depth
+        single window: splitting below the backlog depth only multiplies
+        the per-dispatch cost (a tunneled chip pays an RPC floor per
+        dispatch) since the ring already overlaps pack/execute/readback
+        ACROSS windows. The rolling histograms steer the ring depth:
+        host-bound traffic (keystroke bursts) shortens the ring so
+        results emit sooner; device/transfer-bound traffic keeps it deep
+        for overlap."""
+        max_t = self.t_buckets[-1]
+        need = min(max_per_doc, max_t)
+        T = _bucket(need, self.t_buckets)
+        depth = 1
+        if self.pipelined:
+            depth = self.ring_depth
+            if self.adaptive_window:
+                if doc_depths is not None and doc_depths.size \
+                        and need > self.t_buckets[0]:
+                    p95 = int(np.percentile(doc_depths, 95))
+                    p95b = _bucket(max(1, min(p95, max_t)),
+                                   self.t_buckets)
+                    # Smallest bucket >= the p95 depth whose window
+                    # count stays bounded (the storm doc alone spans
+                    # the extra windows; everyone else rides one).
+                    for cand in self.t_buckets:
+                        if cand < p95b or cand >= T:
+                            continue
+                        if -(-need // cand) <= max(depth, 8):
+                            T = cand
+                            break
+
+                def p50(name: str) -> float:
+                    w = latency_window(name)
+                    return nearest_rank(sorted(w), 0.50) if w else 0.0
+
+                host_ms = p50("serving.pack")
+                dev_ms = p50("serving.dispatch") + p50("serving.readback")
+                if host_ms > 0.0 and dev_ms <= 0.25 * host_ms:
+                    # Host-bound keystroke traffic: shallow ring (emit
+                    # latency over overlap).
+                    depth = min(depth, 2)
+        gauge("serving.ring_depth", float(depth))
+        gauge("serving.window_t", float(T))
+        return T, depth
 
     def _dispatch_fast_window(self, parsed, backlog, rows, lanes, slot, T,
                               mbase, chan_ok, chan_b, chan_l,
                               vbase, lchan_ok, lchan_b, lchan_l,
                               row_seq, flush_sel, row_msn,
-                              defer: bool = False) -> None:
+                              defer: bool = False,
+                              donate: bool = False) -> None:
         """One fast window: staging + ONE fused device dispatch, then
         either an immediate result fetch (_finish_window) or — pipelined —
         a background transfer joined by the next flush's drain().
@@ -3047,12 +3358,21 @@ class TpuSequencerLambda(IPartitionLambda):
             lww_jobs = self._build_lww(parsed, rows, lanes, slot,
                                        vbase, lchan_ok, lchan_b, lchan_l)
 
+        # Buffer donation (decided by _assess_windows' occupancy-hint fit
+        # proof): donated windows update lane states in place — no fresh
+        # HBM allocation per window; kept windows retain the pre states
+        # the fold/rescue rollback scatters back.
+        increment("serving.ring_donated_windows" if donate
+                  else "serving.ring_kept_windows")
+
         # ONE fused device program for the whole window (every extra
         # dispatch is a serialized tunnel RPC), then ONE host sync of the
         # narrow int16 result (msn32_dev is fetched only on the rare
         # msn-span overflow).
         def dispatch(fused):
-            return serve_step.serve_window(
+            step = serve_step.serve_window if donate \
+                else serve_step.serve_window_keep
+            return step(
                 self.tstate, self._place_cols(ticket_cols),
                 [self.merge.buckets[j["bucket"]].state
                  for j in merge_jobs],
@@ -3110,11 +3430,24 @@ class TpuSequencerLambda(IPartitionLambda):
                     (self.tstate, new_merge, new_lww, flat_dev,
                      msn32_dev) = dispatch(False)
         for j, post in zip(merge_jobs, new_merge):
-            j["post"] = post
             self.merge.buckets[j["bucket"]].state = post
+            if donate:
+                # The donated pre-state buffers were consumed in place;
+                # drop the stale reference so a recovery bug trips the
+                # explicit pre-is-None degrade, not a deleted-buffer read.
+                j["pre"] = None
+            # In-flight occupancy bound: each staged op adds at most 2
+            # rows; confirmed exactly (and removed from pending) when
+            # this window's occupancy plane comes back at drain.
+            np.add.at(self.merge.buckets[j["bucket"]].hint_pending,
+                      j["lanes"], 2)
         for j, post in zip(lww_jobs, new_lww):
-            j["post"] = post
             self.lww.buckets[j["bucket"]].state = post
+            if donate:
+                j["pre"] = None
+            # Each staged op can occupy at most one new key slot.
+            np.add.at(self.lww.buckets[j["bucket"]].hint_pending,
+                      j["lanes"], 1)
 
         ctx = {"parsed": parsed, "B": B, "T": T, "rows": rows,
                "lanes": lanes, "slot": slot,
@@ -3122,7 +3455,8 @@ class TpuSequencerLambda(IPartitionLambda):
                "merge_jobs": merge_jobs, "lww_jobs": lww_jobs,
                "mbase": mbase, "block": self._flush_merge_block,
                "row_seq": row_seq, "row_msn": row_msn,
-               "msn32_dev": msn32_dev,
+               "msn32_dev": msn32_dev, "donated": donate,
+               "gen": self._recovery_gen,
                # The offsets THIS window covers: drain() must commit
                # exactly these — the live _pending_offset may already
                # include a newer, not-yet-dispatched backlog.
@@ -3142,12 +3476,74 @@ class TpuSequencerLambda(IPartitionLambda):
 
             ctx["thread"] = threading.Thread(target=fetch, daemon=True)
             ctx["thread"].start()
-            self._inflight = ctx
+            self._ring.append(ctx)
+            increment("serving.ring_windows_deferred")
         else:
             with tracing.span("serving.readback",
                               hist="serving.readback"):
                 ctx["flat"] = np.asarray(flat_dev)  # the window's ONE sync
             self._finish_window(ctx)
+
+    def _assess_windows(self, parsed, n_windows: int,
+                        merge_all, win_m, chan_ok, chan_b, chan_l,
+                        win_l, lchan_ok, lchan_b, lchan_l):
+        """Per-window (risky, donate_ok) from the host occupancy hints.
+
+        risky[w]: some staged lane's ROW fit cannot be proven —
+        `hint + 2*inserts + 8 > capacity` (merge, each op adds at most 2
+        rows) or `hint + ops + 4 > capacity` key slots (LWW). Risky
+        windows dispatch synchronously so their likely fold/rescue runs
+        the cheap empty-ring recovery.
+
+        donate_ok[w]: not risky AND insert-only merge traffic — removes
+        touch the overlap ring and annotates the anno ring, neither
+        bounded by the count hint, so those windows keep their pre
+        states (their rare exhaustion overflow needs the rollback). The
+        margins mirror the recovery paths' +8 re-run slack convention."""
+        from . import pump as P
+        cols = parsed.cols
+        risky = np.zeros(n_windows, bool)
+        donate_ok = np.ones(n_windows, bool)
+        if merge_all.size:
+            mk = cols[P.MKIND, merge_all]
+            for w in range(n_windows):
+                ws = chan_ok & (win_m == w)
+                if not ws.any():
+                    continue
+                if np.any(mk[ws] != 1):
+                    donate_ok[w] = False
+                for b in np.unique(chan_b[ws]).tolist():
+                    bucket = self.merge.buckets[b]
+                    bsel = ws & (chan_b == b)
+                    ins = np.bincount(chan_l[bsel & (mk == 1)],
+                                      minlength=bucket.lanes)
+                    touched = np.unique(chan_l[bsel])
+                    bound = bucket.count_hint[touched] \
+                        + bucket.hint_pending[touched]
+                    if np.any(bound + 2 * ins[touched] + 8
+                              > bucket.capacity):
+                        risky[w] = True
+                        break
+        if lchan_ok.size:
+            lchans_l = lchan_l
+            for w in range(n_windows):
+                ws = lchan_ok & (win_l == w)
+                if not ws.any():
+                    continue
+                for b in np.unique(lchan_b[ws]).tolist():
+                    bucket = self.lww.buckets[b]
+                    bsel = ws & (lchan_b == b)
+                    per = np.bincount(lchans_l[bsel],
+                                      minlength=bucket.lanes)
+                    touched = np.unique(lchans_l[bsel])
+                    bound = bucket.count_hint[touched] \
+                        + bucket.hint_pending[touched]
+                    if np.any(bound + per[touched] + 4
+                              > bucket.capacity):
+                        risky[w] = True
+                        break
+        donate_ok &= ~risky
+        return risky, donate_ok
 
     def _finish_window(self, ctx) -> None:
         """The post-fetch half of a fast window: seq/msn distribution,
@@ -3176,7 +3572,88 @@ class TpuSequencerLambda(IPartitionLambda):
         msn_base = u32(flat[p + 2 * B:p + 3 * B],
                        flat[p + 3 * B:p + 4 * B])
         tailbits = flat[p + 4 * B:]
-        msn_ok, bits = tailbits[0], tailbits[1:]
+        nm, nl = len(merge_jobs), len(lww_jobs)
+        msn_ok = tailbits[0]
+        bits = tailbits[1:2 + nm + nl]
+        # Per-lane overflow planes (one int16 per staged bucket lane,
+        # serve_step.serve_window layout): merge jobs then LWW jobs, each
+        # lanes_n wide — recovery never touches the (possibly donated)
+        # post states. The occupancy (count) planes follow in the same
+        # order.
+        plane_total = sum(j["lanes_n"] for j in merge_jobs) \
+            + sum(j["lanes_n"] for j in lww_jobs)
+        planes = tailbits[2 + nm + nl:2 + nm + nl + plane_total]
+        cnt_planes = tailbits[2 + nm + nl + plane_total:]
+
+        q_m = np.fromiter(self._ring_fixup, np.int64,
+                          len(self._ring_fixup)) \
+            if self._ring_fixup else None
+        q_l = np.fromiter(self._ring_fixup_lww, np.int64,
+                          len(self._ring_fixup_lww)) \
+            if self._ring_fixup_lww else None
+
+        # Exact occupancy refresh from this window's own result: the
+        # confirmed base adopts the post-window counts for the lanes THIS
+        # window staged (never the whole plane — lanes seeded/alloc'd by
+        # later flushes while this window was in flight have newer hints)
+        # and this window's staged-op bound leaves the pending set — the
+        # donation/deferral gate never decays pessimistic. Runs BEFORE
+        # recovery, whose put_rows re-hint any rolled-back lanes;
+        # quarantined lanes keep their recovered base (this window's
+        # device counts for them describe discarded state). When a
+        # recovery ran since this window dispatched (gen moved), a staged
+        # lane may have been freed and REALLOCATED to another channel —
+        # refresh/deduct only lanes still owned by the staged key.
+        gen_same = ctx.get("gen") == self._recovery_gen
+
+        def _owned_rows(bucket, job):
+            """Per-row mask: the staged channel still owns the lane (a
+            recovery may have freed + reallocated it while this window
+            was in flight)."""
+            return np.fromiter(
+                (bucket.used[int(l)] == self._pump_chan[int(c)]
+                 for l, c in zip(job["lanes"], job["chan"])), bool,
+                job["lanes"].size)
+
+        cnt_off = 0
+        for job in merge_jobs:
+            n = job["lanes_n"]
+            bucket = self.merge.buckets[job["bucket"]]
+            fresh = cnt_planes[cnt_off:cnt_off + n].astype(np.int64)
+            cnt_off += n
+            pend_lanes = job["lanes"]
+            lanes_j = np.unique(pend_lanes)
+            if not gen_same:
+                own = _owned_rows(bucket, job)
+                job["owned"] = own
+                pend_lanes = pend_lanes[own]
+                lanes_j = np.unique(pend_lanes)
+            if q_m is not None:
+                qlanes = np.unique(job["lanes"][np.isin(job["chan"], q_m)])
+                lanes_j = np.setdiff1d(lanes_j, qlanes)
+            bucket.count_hint[lanes_j] = fresh[lanes_j]
+            np.subtract.at(bucket.hint_pending, pend_lanes, 2)
+            np.maximum(bucket.hint_pending, 0,
+                       out=bucket.hint_pending)
+        for job in lww_jobs:
+            n = job["lanes_n"]
+            bucket = self.lww.buckets[job["bucket"]]
+            fresh = cnt_planes[cnt_off:cnt_off + n].astype(np.int64)
+            cnt_off += n
+            pend_lanes = job["lanes"]
+            lanes_j = np.unique(pend_lanes)
+            if not gen_same:
+                own = _owned_rows(bucket, job)
+                job["owned"] = own
+                pend_lanes = pend_lanes[own]
+                lanes_j = np.unique(pend_lanes)
+            if q_l is not None:
+                qlanes = np.unique(job["lanes"][np.isin(job["chan"], q_l)])
+                lanes_j = np.setdiff1d(lanes_j, qlanes)
+            bucket.count_hint[lanes_j] = fresh[lanes_j]
+            np.subtract.at(bucket.hint_pending, pend_lanes, 1)
+            np.maximum(bucket.hint_pending, 0,
+                       out=bucket.hint_pending)
         admitted = seq_d >= 0
         seq_bt = np.where(admitted, next_seq[:, None] - seq_d, 0)
         if msn_ok:
@@ -3219,20 +3696,68 @@ class TpuSequencerLambda(IPartitionLambda):
         # pre-window rows and reuse the batched slow-path recovery. The
         # span is unconditional — a flush with nothing to rescue records
         # a ~0 µs stage, so captures always show the stage's cost.
+        # Ring-aware: lanes recovered while LATER windows are in flight
+        # quarantine their channels — those windows' rows for them
+        # re-apply host-side here (fixup), in dispatch order, instead of
+        # trusting device results computed from pre-recovery rows.
         with tracing.span("serving.fold_rescue", parent=ctx.get("trace_ctx"),
                           hist="serving.fold_rescue") as _frsp:
-            bit_i = 1
+            bit_i = 1  # bits[0] is the ticket-table invariant bit
             recovered = 0
+            plane_off = 0
+            ring_behind = bool(self._ring)
+            fixup_merge: Dict[tuple, List[HostOp]] = {}
+            fixup_lww: Dict[tuple, List[tuple]] = {}
             for job in merge_jobs:
+                n = job["lanes_n"]
+                over = planes[plane_off:plane_off + n] != 0
+                plane_off += n
+                qsel = np.isin(job["chan"], q_m) if q_m is not None \
+                    else None
                 if bits[bit_i]:
-                    self._recover_fast_merge(parsed, job, seq_bt, msn_bt)
-                    recovered += 1
+                    qlanes = set(job["lanes"][qsel].tolist()) \
+                        if qsel is not None else set()
+                    flagged = sorted(
+                        {int(i) for i in job["lanes"].tolist()
+                         if over[i] and i not in qlanes})
+                    if flagged:
+                        self._recover_fast_merge(
+                            parsed, job, seq_bt, msn_bt, flagged,
+                            quarantine=ring_behind)
+                        recovered += 1
                 bit_i += 1
+                if qsel is not None and qsel.any():
+                    self._collect_merge_fixup(fixup_merge, parsed, job,
+                                              seq_bt, msn_bt, qsel)
             for job in lww_jobs:
+                n = job["lanes_n"]
+                over = planes[plane_off:plane_off + n] != 0
+                plane_off += n
+                qsel = np.isin(job["chan"], q_l) if q_l is not None \
+                    else None
                 if bits[bit_i]:
-                    self._recover_fast_lww(parsed, job, seq_bt)
-                    recovered += 1
+                    qlanes = set(job["lanes"][qsel].tolist()) \
+                        if qsel is not None else set()
+                    flagged = sorted(
+                        {int(i) for i in job["lanes"].tolist()
+                         if over[i] and i not in qlanes})
+                    if flagged:
+                        self._recover_fast_lww(parsed, job, seq_bt,
+                                               flagged,
+                                               quarantine=ring_behind)
+                        recovered += 1
                 bit_i += 1
+                if qsel is not None and qsel.any():
+                    self._collect_lww_fixup(fixup_lww, parsed, job,
+                                            seq_bt, qsel)
+            if fixup_merge:
+                increment("serving.ring_fixups", len(fixup_merge))
+                self._recovery_gen += 1  # the re-apply itself may promote
+                self.merge._apply_streams(fixup_merge)
+            if fixup_lww:
+                increment("serving.ring_fixups", len(fixup_lww))
+                self._recovery_gen += 1
+                self.lww._apply_window(fixup_lww)
             if recovered:
                 _frsp.set(recovered_jobs=recovered)
 
@@ -3321,7 +3846,8 @@ class TpuSequencerLambda(IPartitionLambda):
                 rc[3, rl[msel], rp[msel], sub[msel]] = tslot[msel]
                 runs_rc = rc
             jobs.append({"bucket": b, "pre": bucket.state, "cols": mc,
-                         "runs": runs_rc,
+                         "runs": runs_rc, "lanes_n": bucket.lanes,
+                         "chan": cols[P.CHAN, rr],
                          "rows": rr, "lanes": rl, "op_ids": op_ids[bsel],
                          "doc_lane": doc_lane, "slot": tslot})
         return jobs
@@ -3367,24 +3893,37 @@ class TpuSequencerLambda(IPartitionLambda):
             lc[4, rl, rp] = doc_lane
             lc[5, rl, rp] = tslot
             jobs.append({"bucket": b, "pre": bucket.state, "cols": lc,
+                         "lanes_n": bucket.lanes, "chan": cols[P.CHAN, rr],
                          "rows": rr, "lanes": rl, "val_ids": val_ids[bsel],
                          "doc_lane": doc_lane, "slot": tslot})
         return jobs
 
-    def _recover_fast_merge(self, parsed, job, seq_bt, msn_bt) -> None:
+    def _recover_fast_merge(self, parsed, job, seq_bt, msn_bt,
+                            flagged: List[int],
+                            quarantine: bool = False) -> None:
         """A merge bucket overflowed in a fast window: rebuild HostOp
         streams for the flagged lanes from the pump columns, roll those
         lanes back to their pre-window rows, and run the slow path's
-        batched recovery."""
+        batched recovery. `quarantine=True` (windows behind this one are
+        still in flight) additionally quarantines the recovered channels:
+        the later windows' device results for these lanes are void, and
+        their rows re-apply host-side at each window's own drain."""
         from . import pump as P
         cols = parsed.cols
         b = job["bucket"]
         bucket = self.merge.buckets[b]
-        over = np.asarray(job["post"].overflow)
+        flag_set = set(flagged)
+        own = job.get("owned")  # set by _finish_window when gen moved
         tm = jax.tree_util.tree_map
         lane_ops: Dict[int, List[HostOp]] = {}
         for k, i in enumerate(job["lanes"].tolist()):
-            if not over[i]:
+            if i not in flag_set:
+                continue
+            if own is not None and not own[k]:
+                # A recovery freed + reallocated this lane while the
+                # window was in flight: the plane bit describes the OLD
+                # channel's discarded state — never roll back or re-run
+                # over the lane's new owner.
                 continue
             r = int(job["rows"][k])
             # seq/msn were assigned by the ticket pass regardless of the
@@ -3403,22 +3942,87 @@ class TpuSequencerLambda(IPartitionLambda):
                 local_seq=0, msn=msn))
         if not lane_ops:
             return
+        self._recovery_gen += 1
+        if job["pre"] is None:
+            # Donated window flagged overflow: the gate's fit proof was
+            # wrong (hint bug) and the pre rows are gone. Degrade the
+            # affected channels to opaque instead of materializing
+            # corrupt state — loudly, this is an invariant break.
+            self._degrade_donated_merge(b, sorted(lane_ops))
+            return
+        if quarantine:
+            for i in sorted(lane_ops):
+                key = bucket.used[i]
+                ch = self._chan_ord.get(key)
+                if ch is not None:
+                    self._ring_fixup.add(int(ch))
         idx = jnp.asarray(np.asarray(sorted(lane_ops), np.int32))
         bucket.state = tm(lambda col, p: col.at[idx].set(p[idx]),
                           bucket.state, job["pre"])
         self.merge._recover_batch(b, lane_ops)
 
-    def _recover_fast_lww(self, parsed, job, seq_bt) -> None:
+    def _degrade_donated_merge(self, b: int, lanes: List[int]) -> None:
+        import logging
+        increment("sequencer.donated_overflow")
+        bucket = self.merge.buckets[b]
+        keys = [bucket.used[i] for i in lanes if bucket.used[i] is not None]
+        logging.getLogger(__name__).error(
+            "merge overflow on a DONATED window (occupancy-hint invariant "
+            "break); degrading %d channel(s) to opaque: %r", len(keys),
+            keys)
+        for key in keys:
+            # Quarantine BEFORE dropping: in-flight windows that staged
+            # this channel must void their device results for it at
+            # their drain (the opaque check then skips the re-apply),
+            # not recover against a freed/reallocated lane.
+            ch = self._chan_ord.get(key)
+            if ch is not None:
+                self._ring_fixup.add(int(ch))
+            self.merge.drop(key)
+            self.merge.overflow_drops += 1
+
+    def _collect_merge_fixup(self, streams: Dict[tuple, List[HostOp]],
+                             parsed, job, seq_bt, msn_bt,
+                             qsel: np.ndarray) -> None:
+        """Rows riding a quarantined channel: their lanes were rolled back
+        and host-recovered by an EARLIER window's drain, so this window's
+        device result for them is void — rebuild the ops as HostOp
+        streams (arrival order) for the sync-faithful re-apply."""
+        from . import pump as P
+        cols = parsed.cols
+        for k in np.flatnonzero(qsel).tolist():
+            r = int(job["rows"][k])
+            seq = int(seq_bt[job["doc_lane"][k], job["slot"][k]])
+            msn = int(msn_bt[job["doc_lane"][k], job["slot"][k]])
+            if seq <= 0:
+                continue
+            key = self._pump_chan[int(job["chan"][k])]
+            streams.setdefault(key, []).append(HostOp(
+                kind=int(cols[P.MKIND, r]), seq=seq,
+                ref_seq=int(cols[P.REFSEQ, r]),
+                client=int(cols[P.CLIENT, r]),
+                pos1=int(cols[P.POS1, r]), pos2=int(cols[P.POS2, r]),
+                op_id=int(job["op_ids"][k]),
+                new_len=int(cols[P.CHARLEN, r]),
+                local_seq=0, msn=msn))
+
+    def _recover_fast_lww(self, parsed, job, seq_bt, flagged: List[int],
+                          quarantine: bool = False) -> None:
         from . import pump as P
         cols = parsed.cols
         lk = self.lww.lk
         b = job["bucket"]
         bucket = self.lww.buckets[b]
-        over = np.asarray(job["post"].overflow)
+        flag_set = set(flagged)
+        own = job.get("owned")  # set by _finish_window when gen moved
         tm = jax.tree_util.tree_map
         lane_ops: Dict[int, List[tuple]] = {}
         for k, i in enumerate(job["lanes"].tolist()):
-            if not over[i]:
+            if i not in flag_set:
+                continue
+            if own is not None and not own[k]:
+                # Lane freed + reallocated while in flight (see
+                # _recover_fast_merge): never touch the new owner.
                 continue
             r = int(job["rows"][k])
             seq = int(seq_bt[job["doc_lane"][k], job["slot"][k]])
@@ -3433,12 +4037,55 @@ class TpuSequencerLambda(IPartitionLambda):
                  int(cols[P.POS2, r]), seq))
         if not lane_ops:
             return
+        self._recovery_gen += 1
+        if job["pre"] is None:
+            import logging
+            increment("sequencer.donated_overflow")
+            keys = [bucket.used[i] for i in sorted(lane_ops)
+                    if bucket.used[i] is not None]
+            logging.getLogger(__name__).error(
+                "LWW overflow on a DONATED window (occupancy-hint "
+                "invariant break); degrading %d channel(s): %r",
+                len(keys), keys)
+            for key in keys:
+                # Quarantine before dropping (see _degrade_donated_merge).
+                ch = self._chan_ord.get(key)
+                if ch is not None:
+                    self._ring_fixup_lww.add(int(ch))
+                self.lww.drop(key)
+                self.lww.overflow_drops += 1
+            return
+        if quarantine:
+            for i in sorted(lane_ops):
+                key = bucket.used[i]
+                ch = self._chan_ord.get(key)
+                if ch is not None:
+                    self._ring_fixup_lww.add(int(ch))
         idx = jnp.asarray(np.asarray(sorted(lane_ops), np.int32))
         bucket.state = tm(lambda col, p: col.at[idx].set(p[idx]),
                           bucket.state, job["pre"])
         for i, ops in lane_ops.items():
             t = _bucket(len(ops), self.t_buckets)
             self.lww._promote(b, i, ops, t)
+
+    def _collect_lww_fixup(self, streams: Dict[tuple, List[tuple]],
+                           parsed, job, seq_bt, qsel: np.ndarray) -> None:
+        from . import pump as P
+        cols = parsed.cols
+        lk = self.lww.lk
+        for k in np.flatnonzero(qsel).tolist():
+            r = int(job["rows"][k])
+            seq = int(seq_bt[job["doc_lane"][k], job["slot"][k]])
+            if seq <= 0:
+                continue
+            kord = int(cols[P.POS1, r])
+            kid = int(self._lww_key_map[kord]) if kord >= 0 else -1
+            mk = int(cols[P.MKIND, r])
+            key = self._pump_chan[int(job["chan"][k])]
+            streams.setdefault(key, []).append(
+                (mk, kid,
+                 int(job["val_ids"][k]) if mk == lk.LwwKind.SET else -1,
+                 int(cols[P.POS2, r]), seq))
 
     def _evict_ghosts(self, active_docs: List[str]) -> None:
         """Synthesize leaves for writers silent past client_timeout_s
@@ -3470,6 +4117,8 @@ class TpuSequencerLambda(IPartitionLambda):
                     self.pending.setdefault(doc_id, []).append(_Pending(
                         tk.MsgKind.LEAVE, dl.intern(client_id), 0, 0,
                         leave, None))
+                    if doc_id in self._pump_known:
+                        self._pump_sync_dirty.add(doc_id)
 
     def _take_window(self) -> Dict[str, List[_Pending]]:
         """Carve the next per-doc message chunks off the backlog: at most

@@ -1,0 +1,309 @@
+"""Deep-pipelined serving: the N-deep in-flight window ring.
+
+The ring (tpu_sequencer._ring, docs/serving_pipeline.md) lets window k+1's
+host pack/staging overlap window k's device execution and window k-1's
+narrow readback. These tests pin its safety contract:
+
+- multi-window backlogs with an overflow-triggered fold MID-RING must
+  produce sequence numbers and lane state bit-identical to
+  ``pipelined=False`` (the quarantine fixup path);
+- adaptive window sizing only ever draws T from the fixed t_buckets grid,
+  and a warm pipeline does not retrace serve_window per flush
+  (JitRetraceProbe regression);
+- donation bookkeeping (occupancy hints) stays consistent with the device
+  counts the narrow result reports.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from fluidframework_tpu.mergetree.client import OP_INSERT
+from fluidframework_tpu.protocol.messages import (
+    Boxcar,
+    DocumentMessage,
+    MessageType,
+)
+from fluidframework_tpu.server import pump as pump_mod
+from fluidframework_tpu.server.tpu_sequencer import (
+    MergeLaneStore,
+    TpuSequencerLambda,
+)
+from fluidframework_tpu.server.log import QueuedMessage
+from fluidframework_tpu.server.wire import boxcar_to_wire
+from fluidframework_tpu.telemetry import counters
+
+pytestmark = pytest.mark.skipif(not pump_mod.available(),
+                                reason="native wirepump unavailable")
+
+
+class _Ctx:
+    def checkpoint(self, *_):
+        pass
+
+    def error(self, err, restart=False):
+        raise err
+
+
+def _lam(emit=None, **kw):
+    kw.setdefault("client_timeout_s", 0.0)
+    return TpuSequencerLambda(_Ctx(), emit=emit or (lambda *a: None),
+                              nack=lambda *a: None, **kw)
+
+
+def _qm(offset, doc, box):
+    return QueuedMessage(topic="rawdeltas", partition=0, offset=offset,
+                         key=doc, value=boxcar_to_wire(box))
+
+
+def _join(cid):
+    return DocumentMessage(0, -1, MessageType.CLIENT_JOIN,
+                           data=json.dumps({"clientId": cid,
+                                            "detail": {}}))
+
+
+def _insert(csn, pos, text):
+    return DocumentMessage(
+        client_sequence_number=csn, reference_sequence_number=csn - 1,
+        type=MessageType.OPERATION,
+        contents={"address": "s", "contents": {
+            "address": "t", "contents": {
+                "type": OP_INSERT, "pos1": pos, "seg": {"text": text}}}})
+
+
+def _emit_key(doc_id, m):
+    return (doc_id, m.sequence_number, m.minimum_sequence_number,
+            m.client_id, m.client_sequence_number)
+
+
+def _drive(lam, waves, emits):
+    off = 0
+    for wave in waves:
+        for doc, box in wave:
+            lam.handler_raw(_qm(off, doc, box))
+            off += 1
+        lam.flush()
+    lam.drain()
+
+
+def _deep_ragged_waves(n_waves=4, docs=3, deep_ops=8, shallow_ops=2):
+    """Doc r0 types deep bursts (spans multiple T=4 windows with
+    t_buckets=(1, 4)); the rest send keystrokes. Inserts land at pos 0
+    so content is order-sensitive: any ring reordering corrupts it."""
+    waves = []
+    csn = {d: 0 for d in range(docs)}
+    for w in range(n_waves):
+        wave = []
+        for d in range(docs):
+            doc = f"r{d}"
+            n = deep_ops if d == 0 else shallow_ops
+            msgs = [] if w else [_join(f"c{d}")]
+            for _ in range(n):
+                csn[d] += 1
+                msgs.append(_insert(csn[d], 0, f"{csn[d] % 10}"))
+            wave.append((doc, Boxcar("t", doc, f"c{d}", msgs)))
+        waves.append(wave)
+    return waves
+
+
+def _merge_rows(lam, key):
+    """The key's device lane planes as host arrays (bit-identity probe)."""
+    b, lane = lam.merge.where[key]
+    row = lam.merge.buckets[b].row(lane)
+    import jax
+    return jax.device_get(row)
+
+
+class TestFoldMidRingBitIdentity:
+    def test_multiwindow_overflow_fold_mid_ring_matches_sync(self):
+        """Tiny capacities force overflow folds while later windows of
+        the same multi-window backlog are still in flight; the
+        quarantine fixup must reconverge to EXACTLY the sync result:
+        same sequence numbers, same text, same device lane planes."""
+        waves = _deep_ragged_waves(n_waves=5, deep_ops=8)
+
+        def run(pipelined):
+            emits = []
+            lam = _lam(lambda d, m: emits.append(_emit_key(d, m)),
+                       merge_store=MergeLaneStore(capacities=(4, 16, 64)),
+                       t_buckets=(1, 4))
+            lam.pipelined = pipelined
+            if pipelined:
+                # Force hint-risky windows through the ring: production
+                # routes predictable overflow to the sync path, but the
+                # quarantine fixup must stay correct for the overflow
+                # the hints cannot see (overlap/anno exhaustion).
+                lam.defer_risky_windows = True
+            _drive(lam, waves, emits)
+            return lam, emits
+
+        fix0 = counters.get("serving.ring_fixups")
+        sync_lam, sync_emits = run(False)
+        ring_lam, ring_emits = run(True)
+        # The scenario actually exercised a mid-ring fold fixup.
+        assert counters.get("serving.ring_fixups") > fix0
+        # The recovery's lane compaction agrees between modes (this
+        # scenario recovers by compact->promote, so folds may be zero —
+        # but a ring path that folded differently would diverge here;
+        # promotion placement equality is locked by `where` below).
+        assert ring_lam.merge.folds == sync_lam.merge.folds
+        # The STREAM is bit-identical, order included: an out-of-order
+        # drain or a misattached emit_args would reorder across windows
+        # while keeping the same multiset.
+        assert sync_emits == ring_emits
+        for d in range(3):
+            key = (f"r{d}", "s", "t")
+            assert sync_lam.channel_text(*key) == \
+                ring_lam.channel_text(*key)
+            assert sync_lam.merge.where[key] == ring_lam.merge.where[key]
+            a = _merge_rows(sync_lam, key)
+            b = _merge_rows(ring_lam, key)
+            for name in ("length", "ins_seq", "ins_client", "rem_seq",
+                         "count", "min_seq", "seq"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(a, name)),
+                    np.asarray(getattr(b, name)),
+                    err_msg=f"{key} plane {name} diverged")
+
+    def test_natural_gate_routes_risky_windows_sync(self):
+        """With the hook OFF, hint-risky windows drain the ring and run
+        the cheap sync recovery — the stream still matches sync mode."""
+        waves = _deep_ragged_waves(n_waves=4, deep_ops=8)
+
+        def run(pipelined):
+            emits = []
+            lam = _lam(lambda d, m: emits.append(_emit_key(d, m)),
+                       merge_store=MergeLaneStore(capacities=(4, 16, 64)),
+                       t_buckets=(1, 4))
+            lam.pipelined = pipelined
+            _drive(lam, waves, emits)
+            return lam, emits
+
+        sync_lam, sync_emits = run(False)
+        ring_lam, ring_emits = run(True)
+        assert sync_emits == ring_emits  # order included
+        for d in range(3):
+            key = (f"r{d}", "s", "t")
+            assert sync_lam.channel_text(*key) == \
+                ring_lam.channel_text(*key)
+
+
+class TestRingDepth:
+    def test_ring_runs_deeper_than_one(self):
+        """Clean keystroke waves must actually pipeline: occupancy climbs
+        past one in-flight window and every deferred window drains."""
+        counters.gauge("serving.ring_peak_occupancy", 0.0)
+        waves = _deep_ragged_waves(n_waves=6, deep_ops=2, shallow_ops=2)
+        emits = []
+        lam = _lam(lambda d, m: emits.append(_emit_key(d, m)))
+        lam.pipelined = True
+        _drive(lam, waves, emits)
+        assert counters.get("serving.ring_peak_occupancy") > 1
+        assert not lam._ring
+        # Every wave's messages were emitted exactly once.
+        assert len(emits) == len({e for e in emits})
+        assert len(emits) == sum(
+            len(box.contents) for wave in waves for _, box in wave)
+
+    def test_drain_is_idempotent_and_settles(self):
+        lam = _lam()
+        lam.pipelined = True
+        lam.handler_raw(_qm(0, "d0", Boxcar("t", "d0", "c0", [
+            _join("c0"), _insert(1, 0, "a")])))
+        lam.flush()
+        lam.drain()
+        lam.drain()
+        assert lam.channel_text("d0", "s", "t") == "a"
+
+
+class TestAdaptiveWindowSizing:
+    def test_adaptive_t_draws_from_bounded_shape_set(self):
+        """Whatever the backlog distribution or histogram state, T comes
+        from the fixed t_buckets grid and depth never exceeds the
+        configured ring depth."""
+        lam = _lam()
+        lam.pipelined = True
+        rng = np.random.default_rng(7)
+        seen = set()
+        for _ in range(200):
+            n_docs = int(rng.integers(1, 64))
+            depths = rng.integers(1, 400, size=n_docs)
+            t, depth = lam._adaptive_shape(int(depths.max()),
+                                           depths.astype(np.int64))
+            seen.add(t)
+            assert t in lam.t_buckets
+            assert 1 <= depth <= lam.ring_depth
+        # The policy actually adapts: more than one bucket chosen.
+        assert len(seen) > 1
+
+    def test_ragged_backlog_narrows_t_uniform_keeps_depth(self):
+        lam = _lam()
+        lam.pipelined = True
+        # Uniform: every doc 16 deep -> exact-depth single window.
+        t_uniform, _ = lam._adaptive_shape(
+            16, np.full(64, 16, np.int64))
+        assert t_uniform == 16
+        # Ragged: one storm doc atop a keystroke fleet -> T follows the
+        # p95 depth, the storm doc spans extra windows.
+        depths = np.full(64, 2, np.int64)
+        depths[0] = 256
+        t_ragged, _ = lam._adaptive_shape(256, depths)
+        assert t_ragged < 256
+        assert t_ragged in lam.t_buckets
+
+    def test_warm_pipeline_does_not_retrace_serve_window(self):
+        """JitRetraceProbe-style regression: after warm-up, further
+        flushes with the same traffic shape must not grow serve_window's
+        compile cache (adaptive sizing stays on the warmed grid)."""
+        from fluidframework_tpu.server import serve_step
+        waves = _deep_ragged_waves(n_waves=8, deep_ops=2, shallow_ops=2)
+        lam = _lam()
+        lam.pipelined = True
+        _drive(lam, waves[:5], [])
+        def cache_size():
+            try:
+                return serve_step.serve_window._cache_size()
+            except TypeError:
+                return serve_step.serve_window._cache_size
+        warm = cache_size()
+        _drive(lam, waves[5:], [])
+        assert cache_size() == warm, \
+            "serve_window retraced on a warm traffic shape"
+
+
+class TestOccupancyHints:
+    def test_hints_track_device_counts_after_drain(self):
+        """The narrow result's occupancy planes keep the confirmed base
+        exact: after a full drain, count_hint matches the device count
+        plane and nothing is left pending."""
+        lam = _lam()
+        lam.pipelined = True
+        waves = _deep_ragged_waves(n_waves=3, deep_ops=3, shallow_ops=3)
+        _drive(lam, waves, [])
+        for bucket in lam.merge.buckets:
+            if not any(k is not None for k in bucket.used):
+                continue
+            counts = np.asarray(bucket.state.count).astype(np.int64)
+            live = [i for i, k in enumerate(bucket.used) if k is not None]
+            np.testing.assert_array_equal(bucket.count_hint[live],
+                                          counts[live])
+            assert not bucket.hint_pending[live].any()
+
+    def test_donated_windows_counted(self):
+        counters.reset()
+        lam = _lam()
+        lam.pipelined = True
+        waves = _deep_ragged_waves(n_waves=3, deep_ops=2, shallow_ops=2)
+        _drive(lam, waves, [])
+        assert counters.get("serving.ring_donated_windows") > 0
+
+    def test_mesh_placement_disables_lane_state_donation(self):
+        """jax 0.4.37: the donated dp-sharded serve_window executable
+        returns corrupt lane planes when reloaded warm from the
+        persistent compilation cache (cold compiles are correct) —
+        mesh placements must stay on serve_window_keep until a jax
+        upgrade clears the repro (docs/serving_pipeline.md R6)."""
+        from fluidframework_tpu.parallel.mesh import make_mesh
+        assert _lam().donate_lane_states is True
+        assert _lam(mesh=make_mesh(sp=1)).donate_lane_states is False

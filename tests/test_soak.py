@@ -212,6 +212,14 @@ class TestServingSoak:
             _Ctx(), emit=lambda d, m: eb.append(key(d, m)),
             nack=lambda d, c, n: nb.append((d, c, n.content.code)),
             client_timeout_s=0.0)
+        # Half the trials run the fast path through the in-flight window
+        # ring (docs/serving_pipeline.md), a quarter of those forcing
+        # hint-risky windows through it (the quarantine fixup path) —
+        # random burst schedules are exactly where ring reordering or a
+        # stale-lane staging bug would surface as a diff.
+        B.pipelined = trial % 2 == 1
+        if B.pipelined and rng.random() < 0.25:
+            B.defer_risky_windows = True
         tr = _serving_traffic(rng)
         for i, (doc, box) in enumerate(tr):
             A.handler(QueuedMessage("rawdeltas", 0, i, doc, box))

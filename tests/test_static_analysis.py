@@ -294,6 +294,37 @@ class TestMissingDonate:
         """
         assert rule_ids(src, "MISSING_DONATE") == []
 
+    def test_true_positive_serve_window_signature_without_donate(self):
+        """The donated serve_window shape (tstate + merge/LWW lane-state
+        lists threaded through one fused window): dropping its
+        donate_argnums must keep firing — a regression here doubles peak
+        HBM for every lane plane on every serving window."""
+        src = """
+            import functools, jax
+
+            @functools.partial(jax.jit, static_argnums=(6,))
+            def serve_window(tstate, ticket_cols, merge_states,
+                             merge_cols, lww_states, lww_cols,
+                             fused=False, merge_runs=None):
+                return tstate, merge_states, lww_states
+        """
+        assert rule_ids(src, "MISSING_DONATE") == ["MISSING_DONATE"]
+
+    def test_guard_serve_window_with_lane_state_donation(self):
+        """The shipped signature: donate_argnums=(0, 2, 4) covers the
+        ticket state AND both lane-state lists."""
+        src = """
+            import functools, jax
+
+            @functools.partial(jax.jit, donate_argnums=(0, 2, 4),
+                               static_argnums=(6,))
+            def serve_window(tstate, ticket_cols, merge_states,
+                             merge_cols, lww_states, lww_cols,
+                             fused=False, merge_runs=None):
+                return tstate, merge_states, lww_states
+        """
+        assert rule_ids(src, "MISSING_DONATE") == []
+
 
 # ---------------------------------------------------------------------------
 # CC family
