@@ -40,9 +40,19 @@ trace-smoke:
 pipeline-smoke:
 	JAX_PLATFORMS=cpu python bench.py pipeline-smoke
 
-# The pre-merge gate: static analysis + the summarize/trace/pipeline
-# smokes + the full test suite.
-check: lint-analysis summarize-smoke trace-smoke pipeline-smoke test
+# Virtual-clocked open-loop overload harness (docs/overload.md): at 2x
+# sustained overload the admission controller must shed instead of
+# queueing unboundedly (peak queue bounded), hold the admitted-op flush
+# SLO, keep goodput >= 80% of capacity, ride a stall crunch through
+# SHED into DEGRADE and back to ACCEPT within 5s, and reproduce every
+# fault-injection scenario bit-identically from its seed.
+overload-smoke:
+	JAX_PLATFORMS=cpu python bench.py overload-smoke
+
+# The pre-merge gate: static analysis + the summarize/trace/pipeline/
+# overload smokes + the full test suite.
+check: lint-analysis summarize-smoke trace-smoke pipeline-smoke \
+		overload-smoke test
 
 # The round-end randomized-evidence ritual: 50-trial soaks over every
 # differential surface (bulk catch-up, serving fast path, matrix/

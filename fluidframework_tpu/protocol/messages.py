@@ -133,6 +133,10 @@ NACK_DUPLICATE = 409
 NACK_TOO_LARGE = 413
 NACK_THROTTLED = 429
 NACK_NOT_WRITER = 403
+# Admission-control DEGRADE: the server is refusing ingest entirely
+# until pressure drains (server/admission.py). Drivers honor the
+# retry_after exactly like a 429 — resubmitting sooner cannot succeed.
+NACK_SERVICE_UNAVAILABLE = 503
 
 
 @dataclass

@@ -51,6 +51,7 @@ from typing import Dict, Optional, Tuple
 from ..protocol.summary import summary_tree_from_dict, summary_tree_to_dict
 from ..telemetry import tracing
 from ..telemetry.counters import increment, record_swallow
+from .admission import admission_from_config
 from .auth import AuthError, TenantManager
 from .historian import TIER_HEADER, git_object_to_wire, notify_summary_commit
 from .local_server import LocalServer
@@ -95,6 +96,12 @@ class AlfredService:
         self.partitions = partitions
         self._cores: Dict[str, LocalServer] = {}
         self._cores_lock = threading.Lock()
+        # ONE admission controller across every tenant core: overload is
+        # a process-level condition (the cores share this process's CPU
+        # and memory), and sharing the controller is what makes the
+        # per-tenant credit split an actual fairness guarantee instead of
+        # per-core honor system (server/admission.py).
+        self.admission = admission_from_config(config)
         service = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -160,7 +167,7 @@ class AlfredService:
             if tenant_id not in self._cores:
                 core = LocalServer(
                     tenant_id=tenant_id, partitions=self.partitions,
-                    config=self.config)
+                    config=self.config, admission=self.admission)
                 if self.historian_url:
                     self._register_commit_notifier(core, tenant_id)
                 self._cores[tenant_id] = core

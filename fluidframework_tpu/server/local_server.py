@@ -32,12 +32,15 @@ from ..protocol.messages import (
     Nack,
     NackContent,
     NACK_NOT_WRITER,
+    NACK_SERVICE_UNAVAILABLE,
     NACK_THROTTLED,
     NACK_TOO_LARGE,
     SequencedDocumentMessage,
     SignalMessage,
     op_size,
 )
+from . import admission as admission_mod
+from .admission import AdmissionController, admission_from_config
 from .database import DatabaseManager
 from .lambdas import (
     BroadcasterLambda,
@@ -130,11 +133,42 @@ class Connection(TypedEventEmitter):
                             NACK_TOO_LARGE,
                             f"op exceeds {limit} bytes")))
                     return
+        # Overload admission (server/admission.py): the GLOBAL gate —
+        # occupancy-driven throttle/shed/degrade with a server-computed
+        # retry_after — sits before the per-document token bucket (a
+        # local rate nit is pointless to evaluate on traffic the process
+        # cannot absorb at all).
+        adm = self.server.admission
+        if adm is not None and messages:
+            ctx = tracing.first_message_context(messages)
+            # The whole batch rides ONE boxcar record — the unit
+            # raw_backlog() polls — so it enters the controller's queue
+            # accounting as records=1 while credits/counters see the op
+            # count.
+            decision = adm.admit(
+                self.tenant_id, kind=admission_mod.CLASS_OP,
+                count=len(messages), records=1,
+                trace_id=getattr(ctx, "trace_id", None))
+            if not decision.admitted:
+                code = NACK_SERVICE_UNAVAILABLE \
+                    if decision.state == admission_mod.DEGRADE \
+                    else NACK_THROTTLED
+                self.emit("nack", Nack(
+                    messages[0] if messages else None, -1,
+                    NackContent(code,
+                                f"admission {decision.state}: "
+                                f"{decision.reason}",
+                                retry_after_s=decision.retry_after_s)))
+                return
         if self.bucket is not None:
             wait = self.bucket.take(len(messages))
             if wait > 0:
                 # Reference alfred throttler: nack 429 with retryAfter;
-                # the client backs off and resubmits.
+                # the client backs off and resubmits. The admitted batch
+                # never reaches the queue — retract it so the phantom
+                # record doesn't read as drained at the next observe.
+                if adm is not None and messages:
+                    adm.retract(len(messages), records=1)
                 self.emit("nack", Nack(
                     messages[0] if messages else None, -1,
                     NackContent(NACK_THROTTLED, "op rate limit",
@@ -157,6 +191,15 @@ class Connection(TypedEventEmitter):
         alfred submitSignal, lambdas/src/alfred/index.ts:305-328)."""
         if not self.connected:
             raise ConnectionError("connection closed")
+        adm = self.server.admission
+        if adm is not None:
+            # Signals are the FIRST class shed under pressure (transient
+            # presence traffic, cheap to regenerate): dropped silently —
+            # a fire-and-forget channel has no retry contract.
+            decision = adm.admit(self.tenant_id,
+                                 kind=admission_mod.CLASS_SIGNAL)
+            if not decision.admitted:
+                return
         self.server._broadcast_signal(self.document_id, SignalMessage(
             client_id=self.client_id, content=content))
 
@@ -177,9 +220,15 @@ class LocalServer:
                  native_log: Optional[bool] = False,
                  db: Optional[DatabaseManager] = None,
                  historian: Optional[Historian] = None,
-                 config=None, overlapped: bool = False):
+                 config=None, overlapped: bool = False,
+                 admission: Optional[AdmissionController] = None):
         """native_log: False = pure-Python broker (default, the LocalKafka
         role); True = the C++ engine (requires the toolchain); None = auto.
+
+        admission: an overload controller to share (alfred passes ONE
+        across every tenant core so credits fair-share between tenants);
+        None constructs a per-core controller unless config disables it
+        (admission.enabled=false).
 
         db/historian: pass shared instances to make this core one node of a
         cluster over common durable services (the reference's Mongo + git);
@@ -270,7 +319,51 @@ class LocalServer:
             self.log, "broadcaster", DELTAS_TOPIC,
             lambda ctx: BroadcasterLambda(ctx, rooms=self._rooms)))
 
+        # Overload admission (server/admission.py): the occupancy-driven
+        # front door every Connection.submit/submit_signal passes. A
+        # shared controller (alfred) or a per-core one from config;
+        # admission.enabled=false opts a core out entirely.
+        self.admission = admission if admission is not None \
+            else admission_from_config(config)
+        if self.admission is not None:
+            self._wire_admission()
+
     # -- internal wiring ---------------------------------------------------
+    def raw_backlog(self) -> int:
+        """Raw-topic ingest backlog: messages appended but not yet
+        consumed by the sequencing stage (per partition: end offset minus
+        the deli group's committed offset). Counts broker records
+        (boxcars), the unit the partition pumps drain in — the admission
+        controller's primary occupancy feed."""
+        topic = self.log.topic(RAW_TOPIC)
+        total = 0
+        for p, part in enumerate(topic.partitions):
+            total += max(0, part.end_offset
+                         - self.log.committed("deli", RAW_TOPIC, p))
+        return total
+
+    def _wire_admission(self) -> None:
+        adm = self.admission
+        adm.add_source(f"core:{self.tenant_id}",
+                       queue_depth=self.raw_backlog)
+        # DEGRADE survival mode: pause the archival pumps (copier raw
+        # persistence, scribe summaries) so every cycle goes to draining
+        # the sequencer. Their consumer offsets hold their place in the
+        # log; on de-escalation they resume and replay the gap — work is
+        # deferred, never lost. Deli/broadcaster stay live (they ARE the
+        # drain) and scriptorium keeps catch-up queries truthful.
+        def pause() -> None:
+            for mgr in (self._copier_mgr, self._scribe_mgr):
+                for pump in mgr.pumps.values():
+                    pump.pause()
+
+        def resume() -> None:
+            for mgr in (self._copier_mgr, self._scribe_mgr):
+                for pump in mgr.pumps.values():
+                    pump.resume()
+
+        adm.add_degrade_hooks(pause, resume)
+
     def _build_sequencer(self) -> PartitionManager:
         """The sequencing stage (scalar DeliLambda here; TpuLocalServer
         overrides with the device-batched TpuSequencerLambda)."""
@@ -463,6 +556,16 @@ class TpuLocalServer(LocalServer):
     def sequencer(self):
         """The live TpuSequencerLambda (single-partition default)."""
         return self.tpu_sequencers[-1]
+
+    def _wire_admission(self) -> None:
+        super()._wire_admission()
+        # The device pipeline's occupancy hints: staged ops count toward
+        # queue depth; the in-flight ring's fill feeds the (damped)
+        # utilization term. Resolved through sequencer() so a crash-
+        # restarted lambda keeps feeding the controller.
+        self.admission.add_source(
+            f"ring:{self.tenant_id}",
+            hints=lambda: self.sequencer().occupancy_hints())
 
     def sequence_number(self, document_id: str) -> int:
         return self.sequencer().document_seq(document_id)

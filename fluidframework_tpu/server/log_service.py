@@ -21,6 +21,7 @@ import threading
 from concurrent import futures
 from typing import List, Optional
 
+from ..telemetry.counters import record_swallow
 from .log import MessageLog, QueuedMessage
 
 SERVICE = "fluidframework.LogService"
@@ -112,26 +113,69 @@ class _RemoteTopic:
 
 
 class RemoteMessageLog:
-    """MessageLog-compatible client over a LogServiceServer."""
+    """MessageLog-compatible client over a LogServiceServer.
 
-    def __init__(self, address: str, default_partitions: int = 1):
+    Broker outages (restart, network blip) are handled HERE with a
+    bounded-backoff deterministic reconnect: on UNAVAILABLE the client
+    closes and rebuilds its channel (discarding gRPC's internal
+    reconnect backoff state, which can sit in a multi-second wait after
+    repeated failures) and retries through a RetryPolicy. Workers no
+    longer depend on the container's gRPC channel-backoff timing to
+    notice a restarted broker — the class of flake behind the
+    tests/test_deployment.py broker-restart test. Retried sends are
+    at-least-once (the pipeline dedups by offset/clientSequenceNumber
+    downstream, exactly as for a crash-replayed partition)."""
+
+    def __init__(self, address: str, default_partitions: int = 1,
+                 reconnect_policy=None):
         import grpc
+        self._grpc = grpc
+        self._address = address
         self._channel = grpc.insecure_channel(address)
         self.default_partitions = default_partitions
         self._methods = {}
         self._topics = {}
         self._lock = threading.Lock()
+        if reconnect_policy is None:
+            from ..core.retry import RetryPolicy
+            reconnect_policy = RetryPolicy(max_attempts=8,
+                                           base_delay_s=0.05,
+                                           max_delay_s=2.0)
+        self._reconnect = reconnect_policy
+
+    def _rebuild_channel(self) -> None:
+        with self._lock:
+            try:
+                self._channel.close()
+            except Exception:  # noqa: BLE001 — dead channel teardown
+                record_swallow("log_service.channel_close")
+            self._channel = self._grpc.insecure_channel(self._address)
+            self._methods.clear()
 
     def _call(self, name: str, payload):
-        with self._lock:
-            stub = self._methods.get(name)
-            if stub is None:
-                stub = self._channel.unary_unary(
-                    f"/{SERVICE}/{name}",
-                    request_serializer=lambda b: b,
-                    response_deserializer=lambda b: b)
-                self._methods[name] = stub
-        return pickle.loads(stub(pickle.dumps(payload)))
+        from ..core.retry import NonRetryableError
+
+        def once():
+            with self._lock:
+                stub = self._methods.get(name)
+                if stub is None:
+                    stub = self._channel.unary_unary(
+                        f"/{SERVICE}/{name}",
+                        request_serializer=lambda b: b,
+                        response_deserializer=lambda b: b)
+                    self._methods[name] = stub
+            try:
+                return pickle.loads(stub(pickle.dumps(payload)))
+            except self._grpc.RpcError as err:
+                code = err.code() if hasattr(err, "code") else None
+                if code == self._grpc.StatusCode.UNAVAILABLE:
+                    # Transport outage: fresh channel, then the policy's
+                    # jittered bounded backoff decides the retry cadence.
+                    self._rebuild_channel()
+                    raise
+                raise NonRetryableError(str(err)) from err
+
+        return self._reconnect.run(once)
 
     # -- MessageLog surface --------------------------------------------------
     def topic(self, name: str, partitions: Optional[int] = None

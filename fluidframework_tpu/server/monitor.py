@@ -138,6 +138,7 @@ class ServiceMonitor:
         # a breach flip the status code (report-only rollout mode).
         self.enforce_slo = enforce_slo
         self.probes: Dict[str, Callable[[], dict]] = {}
+        self._admission = None
         self.started_at = time.time()
         service = self
 
@@ -174,6 +175,21 @@ class ServiceMonitor:
         HistorianTier or HistorianService): hit/miss/bytes/evictions
         counters plus hit rate, live at request time."""
         self.add_probe(name, historian.stats)
+
+    def watch_admission(self, name: str, controller) -> None:
+        """Surface an AdmissionController (server/admission.py): its
+        full status block — ladder state, pressure, queue depth vs
+        limit, per-tenant credits — rides every /health payload, and
+        /metrics.prom gains a live fluid_admission_level gauge. The
+        admission.* process counters and the admission.retry_wait_ms
+        histogram (bucket lines carry trace-id exemplars) already flow
+        through the standard exposition. NOT registered as a probe:
+        health() renders the block from `_admission` directly, and a
+        probe would compute the same status (controller lock + tenant
+        serialization) a second time only to discard it — status() is
+        pure introspection with no failure mode worth a checks entry."""
+        del name  # kept for call-site symmetry with the other watchers
+        self._admission = controller
 
     def watch_summaries(self, name: str, merge_store) -> None:
         """Probe over a MergeLaneStore's incremental-summarization state:
@@ -226,7 +242,14 @@ class ServiceMonitor:
                 checks[name] = (False, repr(exc))
         slo = self.slo.evaluate()
         slo_ok = slo["ok"] or not self.enforce_slo
+        admission = (self._admission.status()
+                     if self._admission is not None else None)
         return {"ok": all(ok for ok, _ in checks.values()) and slo_ok,
+                # Overload-control state (server/admission.py): a DEGRADE
+                # reading here with /health still 200 is deliberate — the
+                # process is protecting itself, not failing; orchestrators
+                # must not restart it for shedding load.
+                "admission": admission,
                 "uptimeS": time.time() - self.started_at,
                 # Process-wide counters ride on every health report: the
                 # swallowed.* rates (fluidlint CC rules' runtime side) and
@@ -300,6 +323,11 @@ class ServiceMonitor:
         lines.append("# TYPE fluid_slo_ok gauge")
         lines.append(f'fluid_slo_ok{{stage="{slo["stage"]}"}} '
                      f'{1 if slo["ok"] else 0}')
+        if self._admission is not None:
+            st = self._admission.status()
+            lines.append("# TYPE fluid_admission_level gauge")
+            lines.append(f'fluid_admission_level{{state="{st["state"]}"}} '
+                         f'{st["level"]}')
         # OpenMetrics terminator — exemplars are OpenMetrics syntax, so
         # the exposition declares (and terminates as) OpenMetrics rather
         # than the 0.0.4 text format, whose parsers reject the '# {...}'

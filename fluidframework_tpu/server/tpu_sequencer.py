@@ -2357,6 +2357,10 @@ class TpuSequencerLambda(IPartitionLambda):
         # Donation still follows the gate — risky windows keep their pre
         # states, which the forced recovery then needs.
         self.defer_risky_windows = False
+        # Fault-injection hook (testing/faultinject.py stall): called at
+        # the top of every flush to model a slow device; None in
+        # production.
+        self.stall_hook: Optional[Callable[[], float]] = None
         # Insert-run packing on the fast path (PERF.md lever 3): typing
         # bursts in a window collapse to INSERT_RUN slots; a mispredicted
         # member admission (rare: dup/stale nack inside a run) flags the
@@ -2622,6 +2626,12 @@ class TpuSequencerLambda(IPartitionLambda):
             return  # checkpointed replay (deli/lambda.ts:143)
         if doc_id not in self._pump_known:
             self._register_pump_doc(doc_id)
+        # fluidlint: disable=UNBOUNDED_QUEUE — bounded at the front
+        # door: this backlog rides occupancy_hints staged_ops into the
+        # admission controller's queue depth, which sheds ingest before
+        # it can outgrow admission.queueLimit (docs/overload.md); a
+        # broker consumer cannot reject mid-partition without wedging
+        # the offset cursor.
         self._raw_backlog.append((message.offset, doc_id, message.value))
         self._raw_offsets[doc_id] = message.offset
         self._pending_offset = message.offset
@@ -2711,9 +2721,23 @@ class TpuSequencerLambda(IPartitionLambda):
         its ``serving.*`` latency histogram unconditionally, so the
         flush-p99/p50 spread attributes to a stage even with tracing
         off (server/monitor.py `/metrics.prom` + SLO)."""
+        if self.stall_hook is not None:
+            self.stall_hook()
         with tracing.span("serving.flush", parent=self._flush_parent(),
                           root=True, hist="serving.flush"):
             self._flush_traced()
+
+    def occupancy_hints(self) -> dict:
+        """Live occupancy for the admission controller (server/
+        admission.py): staged-but-unflushed ops (raw fast-path backlog +
+        slow-path pending queues) and the in-flight window ring's fill.
+        Host-state reads only — never blocks on the device."""
+        return {
+            "staged_ops": len(self._raw_backlog)
+            + sum(len(q) for q in self.pending.values()),
+            "ring_occupancy": len(self._ring),
+            "ring_depth": self.ring_depth,
+        }
 
     def _flush_parent(self):
         """The first pending traced op's context, if any (slow/object

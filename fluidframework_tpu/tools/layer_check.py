@@ -37,8 +37,11 @@ ALLOWED: Dict[str, Set[str]] = {
     "loader": {"core", "protocol", "runtime", "telemetry", "server", "dds"},
     "framework": {"core", "protocol", "dds", "runtime"},
     # testing hosts the load rig + snapshot corpus, which drive the full
-    # stack like the reference's test-utils/localLoader does.
-    "testing": {"core", "protocol", "dds", "runtime", "loader", "server"},
+    # stack like the reference's test-utils/localLoader does; the fault
+    # injector counts its injected faults (telemetry sits below server,
+    # which testing already imports).
+    "testing": {"core", "protocol", "dds", "runtime", "loader", "server",
+                "telemetry"},
     "hosts": {"core", "loader", "runtime", "framework"},
     "client_api": {"core", "dds", "loader"},
     "agents": {"core", "dds", "loader", "framework"},

@@ -1,0 +1,619 @@
+"""Overload-control unit suite: the admission ladder, fair-share
+credits, shed ordering, degrade hooks (server/admission.py), the
+seeded fault-injection layer (testing/faultinject.py), and the
+LocalServer/monitor wiring.
+
+Everything runs on an injected virtual clock and scripted occupancy
+sources — no sleeps, no wall time in any assertion. The open-loop
+grading (goodput/SLO/recovery under a real pipeline) lives in
+`python bench.py overload-smoke`; this suite pins the controller's
+decision logic exactly.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from fluidframework_tpu.protocol.messages import (
+    Boxcar,
+    DocumentMessage,
+    MessageType,
+    NACK_SERVICE_UNAVAILABLE,
+    NACK_THROTTLED,
+)
+from fluidframework_tpu.server.admission import (
+    ACCEPT,
+    DEGRADE,
+    SHED,
+    THROTTLE,
+    AdmissionController,
+    CLASS_NOOP,
+    CLASS_OP,
+    CLASS_SIGNAL,
+    admission_from_config,
+)
+from fluidframework_tpu.server.local_server import LocalServer
+from fluidframework_tpu.server.monitor import ServiceMonitor
+from fluidframework_tpu.telemetry import counters
+from fluidframework_tpu.testing import faultinject
+
+
+@pytest.fixture(autouse=True)
+def _clean_counters():
+    counters.reset()
+    yield
+    counters.reset()
+
+
+class VClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=0.01):
+        self.t += dt
+
+
+def make_ctl(queue_limit=1000, **kw):
+    clock = VClock()
+    depth = {"n": 0}
+    ctl = AdmissionController(queue_limit=queue_limit,
+                              recover_after_s=0.5, interval_s=0.01,
+                              clock=clock, **kw)
+    ctl.add_source("scripted", queue_depth=lambda: depth["n"])
+    return ctl, clock, depth
+
+
+def observe_at(ctl, clock, depth, n, dt=0.01):
+    depth["n"] = n
+    clock.tick(dt)
+    ctl.observe(force=True)
+
+
+def seed_drain(ctl, clock, depth, start=600, step=100):
+    """Feed the capacity estimator queue-limited drain windows (backlog
+    at both ends, monotone decrease) until it holds an estimate."""
+    observe_at(ctl, clock, depth, start)
+    n = start
+    while ctl.status()["drainRateOpsS"] is None:
+        n -= step
+        assert n > 0, "estimator never seeded"
+        observe_at(ctl, clock, depth, n)
+    return ctl.status()["drainRateOpsS"]
+
+
+class TestLadder:
+    def test_starts_accepting(self):
+        ctl, _, _ = make_ctl()
+        d = ctl.admit("t")
+        assert d.admitted and d.state == ACCEPT
+
+    def test_escalates_through_every_state(self):
+        ctl, clock, depth = make_ctl()
+        observe_at(ctl, clock, depth, 600)
+        assert ctl.state == THROTTLE
+        observe_at(ctl, clock, depth, 850)
+        assert ctl.state == SHED
+        observe_at(ctl, clock, depth, 960)
+        assert ctl.state == DEGRADE
+
+    def test_escalation_can_jump_levels(self):
+        ctl, clock, depth = make_ctl()
+        observe_at(ctl, clock, depth, 990)
+        assert ctl.state == DEGRADE
+
+    def test_deescalates_one_level_per_calm_window(self):
+        ctl, clock, depth = make_ctl()
+        observe_at(ctl, clock, depth, 990)
+        assert ctl.state == DEGRADE
+        # Calm: pressure ~0. One recover_after_s per step down.
+        for expected in (SHED, THROTTLE, ACCEPT):
+            for _ in range(55):
+                observe_at(ctl, clock, depth, 0)
+            assert ctl.state == expected
+
+    def test_hysteresis_blocks_flapping_at_the_edge(self):
+        ctl, clock, depth = make_ctl()
+        observe_at(ctl, clock, depth, 600)
+        assert ctl.state == THROTTLE
+        # 0.45 is under the 0.5 entry edge but NOT clearly calm
+        # (edge * 0.7 = 0.35): the ladder must hold, not flap.
+        for _ in range(200):
+            observe_at(ctl, clock, depth, 450)
+        assert ctl.state == THROTTLE
+
+    def test_throttle_holds_while_credit_rejects_continue(self):
+        # A miniature saturated server: capacity 100 ops/tick, offered
+        # 200/tick. The credits keep the simulated queue near empty, so
+        # pressure alone looks calm — but opening to ACCEPT would admit
+        # the full 2x burst and sawtooth the queue. The reject-gated
+        # calm window must hold THROTTLE for the whole overload.
+        ctl, clock, depth = make_ctl()
+        seed_drain(ctl, clock, depth)
+        observe_at(ctl, clock, depth, 600)
+        assert ctl.state == THROTTLE
+        sim_q = 600
+
+        def step(offer, cap=100):
+            nonlocal sim_q
+            if ctl.admit("t", count=offer).admitted:
+                sim_q += offer
+            sim_q = max(0, sim_q - cap)
+            observe_at(ctl, clock, depth, sim_q)
+
+        for _ in range(200):
+            step(200)
+        assert ctl.state == THROTTLE
+        # Offered load drops under capacity: rejects stop, the calm
+        # window runs clean, and the door opens.
+        for _ in range(80):
+            step(50)
+        assert ctl.state == ACCEPT
+
+    def test_forced_state_pins_the_ladder(self):
+        ctl, clock, depth = make_ctl()
+        ctl.force_state(SHED)
+        for _ in range(100):
+            observe_at(ctl, clock, depth, 0)
+        assert ctl.state == SHED
+        ctl.force_state(None)
+        for _ in range(60):
+            observe_at(ctl, clock, depth, 0)
+        assert ctl.state in (THROTTLE, ACCEPT)
+
+    def test_transition_counters(self):
+        ctl, clock, depth = make_ctl()
+        observe_at(ctl, clock, depth, 600)
+        snap = counters.snapshot()
+        assert snap["admission.transitions.accept_to_throttle"] == 1.0
+
+
+class TestCredits:
+    def test_fair_share_between_tenants(self):
+        ctl, clock, depth = make_ctl()
+        seed_drain(ctl, clock, depth)
+        observe_at(ctl, clock, depth, 600)
+        assert ctl.state == THROTTLE
+        # Register both tenants, let one refill interval pass.
+        ctl.admit("a", count=0)
+        ctl.admit("b", count=0)
+        observe_at(ctl, clock, depth, 600)
+        observe_at(ctl, clock, depth, 600)
+        # A greedy burst from one tenant over-credit rejects (count
+        # kept under the hard queue bound so the credit path decides)...
+        greedy = ctl.admit("a", count=300)
+        assert not greedy.admitted
+        assert greedy.reason == "over credit share"
+        # ...while the other tenant's trickle still admits.
+        assert ctl.admit("b", count=1).admitted
+
+    def test_idle_tenant_buckets_evicted(self):
+        """A churning tenant population must not grow the credit dict
+        (and the /health status payload serialized from it) without
+        bound — idle buckets past the eviction TTL are deleted, not
+        merely dropped from the fair-share split."""
+        from fluidframework_tpu.server.admission import _TENANT_EVICT_S
+        ctl, clock, depth = make_ctl()
+        for i in range(50):
+            ctl.admit(f"churn-{i}", count=0)
+        assert len(ctl.status()["tenants"]) == 50
+        clock.tick(_TENANT_EVICT_S + 1.0)
+        ctl.admit("fresh", count=0)  # any admit runs the observe cycle
+        tenants = ctl.status()["tenants"]
+        assert set(tenants) == {"fresh"}
+
+    def test_retry_after_is_bounded_and_positive(self):
+        ctl, clock, depth = make_ctl()
+        seed_drain(ctl, clock, depth)
+        observe_at(ctl, clock, depth, 600)
+        observe_at(ctl, clock, depth, 600)
+        d = ctl.admit("t", count=350)
+        assert not d.admitted and d.reason == "over credit share"
+        assert 0.05 <= d.retry_after_s <= 2.0
+
+    def test_headroom_fallback_without_estimate(self):
+        # Before any drain sample exists, THROTTLE falls back to a
+        # queue-headroom allowance instead of refusing everything.
+        ctl, clock, depth = make_ctl()
+        observe_at(ctl, clock, depth, 600)
+        assert ctl.state == THROTTLE
+        assert ctl.status()["drainRateOpsS"] is None
+        assert ctl.admit("t", count=1).admitted
+        d = ctl.admit("t", count=200)  # 601 + 200 > 75% of 1000
+        assert not d.admitted and d.reason == "no headroom"
+
+    def test_queue_hard_bound_in_accept(self):
+        ctl, clock, depth = make_ctl()
+        observe_at(ctl, clock, depth, 0)
+        assert ctl.state == ACCEPT
+        d = ctl.admit("t", count=2000)
+        assert not d.admitted
+        assert d.reason == "queue full"
+        assert d.retry_after_s > 0
+
+    def test_peak_queue_depth_tracks_admissions(self):
+        ctl, clock, depth = make_ctl()
+        ctl.admit("t", count=400)
+        assert ctl.peak_queue_depth >= 400
+
+    def test_batched_submit_accounts_records_not_ops(self):
+        """A multi-op batch rides ONE boxcar record — the unit
+        raw_backlog polls — so queue accounting must bump by records,
+        or every poll would read N-1 phantom drains per batch and
+        inflate the capacity estimate by the batch size."""
+        ctl, clock, depth = make_ctl(queue_limit=10)
+        d = ctl.admit("t", count=64, records=1)
+        assert d.admitted  # 64 ops but ONE record vs the 10-record limit
+        assert ctl.queue_depth() == 1
+        # The op count still reaches the observability counters.
+        assert counters.snapshot()["admission.admitted"] == 64
+
+    def test_retract_reverses_queue_accounting(self):
+        """An admit whose batch a LATER gate nacks (per-doc token
+        bucket) must not leave a phantom record behind: it would read
+        as drained at the next observe and corrupt the estimator."""
+        ctl, clock, depth = make_ctl()
+        ctl.admit("t", count=3, records=1)
+        assert ctl.queue_depth() == 1
+        ctl.retract(3, records=1)
+        assert ctl.queue_depth() == 0
+        assert counters.snapshot()["admission.retracted"] == 3
+
+
+class TestShedOrdering:
+    def test_shed_rejects_non_essential_first(self):
+        ctl, _, _ = make_ctl()
+        ctl.force_state(SHED)
+        sig = ctl.admit("t", kind=CLASS_SIGNAL)
+        noop = ctl.admit("t", kind=CLASS_NOOP)
+        assert not sig.admitted and sig.retry_after_s == 0.0
+        assert not noop.admitted
+        # Essential ops still ride the (fallback) credit path.
+        assert ctl.admit("t", kind=CLASS_OP, count=1).admitted
+
+    def test_throttle_keeps_signals_flowing(self):
+        ctl, _, _ = make_ctl()
+        ctl.force_state(THROTTLE)
+        assert ctl.admit("t", kind=CLASS_SIGNAL).admitted
+
+    def test_degrade_refuses_everything(self):
+        ctl, _, _ = make_ctl()
+        ctl.force_state(DEGRADE)
+        op = ctl.admit("t", kind=CLASS_OP)
+        assert not op.admitted and op.retry_after_s > 0
+        sig = ctl.admit("t", kind=CLASS_SIGNAL)
+        assert not sig.admitted and sig.retry_after_s == 0.0
+
+    def test_signals_never_count_toward_queue_depth(self):
+        ctl, _, _ = make_ctl()
+        before = ctl.queue_depth()
+        ctl.admit("t", kind=CLASS_SIGNAL, count=50)
+        assert ctl.queue_depth() == before
+
+
+class TestDegradeHooks:
+    def test_hooks_fire_on_boundary_only(self):
+        ctl, clock, depth = make_ctl()
+        fired = []
+        ctl.add_degrade_hooks(lambda: fired.append("enter"),
+                              lambda: fired.append("exit"))
+        observe_at(ctl, clock, depth, 990)
+        observe_at(ctl, clock, depth, 990)  # stays degraded: no refire
+        assert fired == ["enter"]
+        for _ in range(60):
+            observe_at(ctl, clock, depth, 0)
+        assert fired == ["enter", "exit"]
+
+    def test_forced_degrade_fires_hooks(self):
+        ctl, _, _ = make_ctl()
+        fired = []
+        ctl.add_degrade_hooks(lambda: fired.append("enter"),
+                              lambda: fired.append("exit"))
+        ctl.force_state(DEGRADE)
+        ctl.force_state(ACCEPT)
+        assert fired == ["enter", "exit"]
+
+    def test_broken_hook_never_kills_admission(self):
+        ctl, _, _ = make_ctl()
+
+        def boom():
+            raise RuntimeError("pump exploded")
+
+        ctl.add_degrade_hooks(boom, boom)
+        ctl.force_state(DEGRADE)
+        assert not ctl.admit("t").admitted  # still deciding, not raising
+        assert counters.snapshot()["swallowed.admission.degrade_hook"] >= 1
+
+
+class TestConfig:
+    def test_enabled_gate(self):
+        assert admission_from_config({"admission.enabled": "false"}) is None
+        assert admission_from_config({"admission.enabled": False}) is None
+        assert admission_from_config({}) is not None
+        assert admission_from_config(None) is not None
+
+    def test_knob_overrides(self):
+        ctl = admission_from_config({
+            "admission.queueLimit": 42,
+            "admission.throttleAt": 0.3,
+            "admission.shedAt": 0.6,
+            "admission.degradeAt": 0.9,
+            "admission.recoverAfterS": 1.5,
+        })
+        assert ctl.queue_limit == 42
+        assert ctl.throttle_at == 0.3
+        assert ctl.shed_at == 0.6
+        assert ctl.degrade_at == 0.9
+        assert ctl.recover_after_s == 1.5
+
+    def test_status_block_shape(self):
+        ctl, clock, depth = make_ctl()
+        observe_at(ctl, clock, depth, 600)
+        st = ctl.status()
+        assert st["state"] == THROTTLE and st["level"] == 1
+        assert st["queueLimit"] == 1000
+        assert st["thresholds"] == {"throttle": 0.5, "shed": 0.8,
+                                    "degrade": 0.95}
+        json.dumps(st)  # must be wire-serializable for /health
+
+
+class TestFaultPlan:
+    def test_same_seed_same_fingerprint(self):
+        def run(seed):
+            plan = faultinject.FaultPlan(seed, drop=0.2, dup=0.2,
+                                         delay=0.2, reset=0.3, stall=0.4)
+            for _ in range(200):
+                plan.delivery()
+                plan.should_reset()
+                plan.stall_s()
+                plan.pick(7)
+            return plan.fingerprint()
+
+        assert run(42) == run(42)
+        assert run(42) != run(43)
+
+    def test_trace_records_every_decision(self):
+        plan = faultinject.FaultPlan(1, drop=1.0)
+        plan.delivery()
+        plan.should_reset()
+        assert [a for _, a in plan.trace] == ["drop", "ok"]
+
+    def test_delay_sends_within_bound(self):
+        plan = faultinject.FaultPlan(7, delay=1.0, max_delay_sends=3)
+        for _ in range(50):
+            action, k = plan.delivery()
+            assert action == faultinject.DELAY
+            assert 1 <= k <= 3
+
+    def test_stall_range(self):
+        plan = faultinject.FaultPlan(3, stall=1.0, stall_range_ms=(1, 2))
+        for _ in range(20):
+            assert 0.001 <= plan.stall_s() <= 0.002
+        none = faultinject.FaultPlan(3, stall=0.0)
+        assert none.stall_s() == 0.0
+
+
+class _RecLog:
+    """Recording MessageLog stand-in: captures every delivered send."""
+
+    def __init__(self):
+        self.sent = []
+
+    def send(self, topic, key, value):
+        self.sent.append((topic, key, value))
+        return len(self.sent)
+
+    def committed(self, group, topic, partition):
+        return 0
+
+
+class TestFaultyMessageLog:
+    def test_drop_never_reaches_inner(self):
+        log = faultinject.FaultyMessageLog(
+            _RecLog(), faultinject.FaultPlan(1, drop=1.0))
+        log.send("rawdeltas", "d", "v")
+        assert log.inner.sent == []
+
+    def test_dup_delivers_twice(self):
+        log = faultinject.FaultyMessageLog(
+            _RecLog(), faultinject.FaultPlan(1, dup=1.0))
+        log.send("rawdeltas", "d", "v")
+        assert log.inner.sent == [("rawdeltas", "d", "v")] * 2
+
+    def test_delay_release_order_and_flush(self):
+        log = faultinject.FaultyMessageLog(
+            _RecLog(), faultinject.FaultPlan(5, delay=1.0,
+                                             max_delay_sends=2))
+        for i in range(4):
+            log.send("rawdeltas", "d", i)
+        # Everything is delayed; some released by later sends, the rest
+        # recovered at teardown.
+        held = log.held_count
+        released = log.flush_delayed()
+        assert released == held
+        assert log.held_count == 0
+        assert sorted(v for _, _, v in log.inner.sent) == [0, 1, 2, 3]
+
+    def test_non_fault_topics_bypass_the_plan(self):
+        plan = faultinject.FaultPlan(1, drop=1.0)
+        log = faultinject.FaultyMessageLog(_RecLog(), plan)
+        log.send("deltas", "d", "v")
+        assert log.inner.sent == [("deltas", "d", "v")]
+        assert plan.trace == []  # no decision drawn
+
+    def test_delegates_everything_else(self):
+        log = faultinject.FaultyMessageLog(
+            _RecLog(), faultinject.FaultPlan(1))
+        assert log.committed("deli", "rawdeltas", 0) == 0
+
+
+class TestSkewedClock:
+    def test_offset_and_drift_are_exact(self):
+        t = {"n": 100.0}
+        clock = faultinject.SkewedClock(skew_s=5.0, drift=0.01,
+                                        base=lambda: t["n"])
+        assert clock() == pytest.approx(105.0)
+        t["n"] = 110.0
+        assert clock() == pytest.approx(115.1)
+
+    def test_admission_controller_survives_skew(self):
+        t = {"n": 0.0}
+        clock = faultinject.SkewedClock(skew_s=3600.0, drift=0.05,
+                                        base=lambda: t["n"])
+        depth = {"n": 0}
+        ctl = AdmissionController(queue_limit=1000, recover_after_s=0.5,
+                                  interval_s=0.01, clock=clock)
+        ctl.add_source("s", queue_depth=lambda: depth["n"])
+        depth["n"] = 990
+        t["n"] += 0.02
+        ctl.observe(force=True)
+        assert ctl.state == DEGRADE
+        depth["n"] = 0
+        for _ in range(200):
+            t["n"] += 0.02
+            ctl.observe(force=True)
+        assert ctl.state == ACCEPT
+
+    def test_stall_helper_draws_and_sleeps(self):
+        plan = faultinject.FaultPlan(9, stall=1.0, stall_range_ms=(1, 1))
+        slept = []
+        s = faultinject.stall(plan, sleep=slept.append)
+        assert s == pytest.approx(0.001)
+        assert slept == [s]
+
+
+class TestLocalServerIntegration:
+    def _server(self, **adm_kw):
+        ctl = AdmissionController(queue_limit=adm_kw.pop("queue_limit", 10),
+                                  **adm_kw)
+        server = LocalServer(auto_pump=False, admission=ctl)
+        return server, ctl
+
+    def test_degrade_nacks_503_with_retry_after(self):
+        server, ctl = self._server()
+        conn = server.connect("doc")
+        server.pump()
+        ctl.force_state(DEGRADE)
+        nacks = []
+        conn.on("nack", nacks.append)
+        conn.submit([DocumentMessage(
+            client_sequence_number=1, reference_sequence_number=0,
+            type=MessageType.OPERATION, contents={})])
+        assert len(nacks) == 1
+        assert nacks[0].content.code == NACK_SERVICE_UNAVAILABLE
+        assert nacks[0].content.retry_after_s > 0
+
+    def test_throttle_reject_nacks_429(self):
+        server, ctl = self._server()
+        conn = server.connect("doc")
+        server.pump()
+        ctl.force_state(THROTTLE)
+        nacks = []
+        conn.on("nack", nacks.append)
+        # No drain estimate: headroom fallback is 75% of the 10-op
+        # limit; the un-pumped backlog crosses it and must 429.
+        for i in range(1, 10):
+            conn.submit([DocumentMessage(
+                client_sequence_number=i, reference_sequence_number=0,
+                type=MessageType.OPERATION, contents={})])
+        assert nacks
+        assert nacks[0].content.code == NACK_THROTTLED
+        assert nacks[0].content.retry_after_s > 0
+
+    def test_signals_shed_silently_under_shed(self):
+        server, ctl = self._server()
+        a = server.connect("doc")
+        b = server.connect("doc")
+        server.pump()
+        got = []
+        b.on("signal", got.append)
+        ctl.force_state(SHED)
+        a.submit_signal({"k": 1})
+        assert got == []
+        ctl.force_state(None)
+        ctl.force_state(ACCEPT)
+        a.submit_signal({"k": 2})
+        assert [s.content for s in got] == [{"k": 2}]
+
+    def test_raw_backlog_counts_unpumped_records(self):
+        server, _ = self._server(queue_limit=100)
+        conn = server.connect("doc")
+        server.pump()
+        assert server.raw_backlog() == 0
+        for i in range(1, 4):
+            conn.submit([DocumentMessage(
+                client_sequence_number=i, reference_sequence_number=0,
+                type=MessageType.OPERATION, contents={})])
+        assert server.raw_backlog() == 3
+        server.pump()
+        assert server.raw_backlog() == 0
+
+    def test_degrade_pauses_archival_pumps(self):
+        server, ctl = self._server()
+        server.connect("doc")
+        server.pump()
+        ctl.force_state(DEGRADE)
+        assert all(p.paused for p in server._copier_mgr.pumps.values())
+        assert all(p.paused for p in server._scribe_mgr.pumps.values())
+        ctl.force_state(ACCEPT)
+        assert not any(p.paused for p in server._copier_mgr.pumps.values())
+
+    def test_per_core_controller_from_config(self):
+        server = LocalServer(auto_pump=False,
+                             config={"admission.queueLimit": 7})
+        assert server.admission is not None
+        assert server.admission.queue_limit == 7
+        off = LocalServer(auto_pump=False,
+                          config={"admission.enabled": "false"})
+        assert off.admission is None
+
+    def test_monitor_health_and_prom_surface(self):
+        ctl = AdmissionController(queue_limit=10)
+        ctl.force_state(SHED)
+        mon = ServiceMonitor().start()
+        try:
+            mon.watch_admission("admission", ctl)
+            with urllib.request.urlopen(mon.url + "/health") as resp:
+                health = json.load(resp)
+            assert health["admission"]["state"] == SHED
+            assert health["admission"]["level"] == 2
+            with urllib.request.urlopen(mon.url + "/metrics.prom") as resp:
+                prom = resp.read().decode()
+            assert 'fluid_admission_level{state="shed"} 2' in prom
+        finally:
+            mon.stop()
+
+
+class TestFaultDeterminismThroughServer:
+    def test_same_seed_same_sequenced_stream(self):
+        def run(seed):
+            srv = LocalServer(auto_pump=False)
+            plan = faultinject.FaultPlan(seed, drop=0.15, dup=0.15,
+                                         delay=0.2)
+            srv.log = faultinject.FaultyMessageLog(srv.log, plan)
+            conn = srv.connect("d")
+            seen = []
+            conn.on("op", lambda m: seen.append(
+                (m.sequence_number, m.client_sequence_number)))
+            srv.pump()
+            for i in range(1, 41):
+                srv.log.send("rawdeltas", "d", Boxcar(
+                    tenant_id="local", document_id="d",
+                    client_id=conn.client_id,
+                    contents=[DocumentMessage(
+                        client_sequence_number=i,
+                        reference_sequence_number=0,
+                        type=MessageType.OPERATION, contents={"i": i})]))
+                srv.pump()
+            srv.log.flush_delayed()
+            srv.pump()
+            return plan.fingerprint(), seen
+
+        fp_a, seen_a = run(99)
+        fp_b, seen_b = run(99)
+        assert fp_a == fp_b
+        assert seen_a == seen_b
+        assert seen_a  # faults thinned, not silenced, the stream

@@ -43,7 +43,10 @@ class TestScalarDeliEviction:
         assert seen[-1].minimum_sequence_number > ghost_pin
 
     def test_active_writer_not_evicted(self):
-        server = self._server(200)
+        # Generous timeout vs the 50ms op cadence: with 200ms a loaded
+        # suite's scheduler/GC pause between two submits could exceed
+        # the window and evict the "active" writer (observed flake).
+        server = self._server(1000)
         writer = server.connect("doc")
         seen = []
         writer.on("op", lambda m: seen.append(m))

@@ -197,3 +197,174 @@ class TestFullStackResilience:
         assert c2.runtime.get_datastore("default") \
             .get_channel("root").get("k") == "v"
         assert cache.hits > hits_before  # load served from the warm cache
+
+
+class TestServerIssuedThrottling:
+    """End-to-end: the ADMISSION CONTROLLER (server/admission.py) issues
+    the throttle — 429/503 nacks with a server-computed retry_after —
+    and the driver/container stack already knows how to honor it."""
+
+    def test_container_honors_degrade_retry_after_end_to_end(self):
+        import time
+
+        from fluidframework_tpu.protocol.messages import MessageType
+        from fluidframework_tpu.server.admission import ACCEPT, DEGRADE
+        from fluidframework_tpu.telemetry import counters
+
+        server = LocalServer()
+        assert server.admission is not None
+        server.admission.recover_after_s = 0.02  # fast retry_after
+        loader = Loader(LocalDocumentServiceFactory(server))
+        c1 = loader.create_detached("doc")
+        ds = c1.runtime.create_datastore("default")
+        c1.attach()
+        root = ds.create_channel("root", SharedMap.TYPE)
+        root.set("k", 1)
+
+        # Server-side observer: every sequenced op from here on.
+        obs = server.connect("doc", {"mode": "read"})
+        sequenced = []
+        obs.on("op", lambda m: m.type == MessageType.OPERATION
+               and sequenced.append(m))
+
+        rejected0 = counters.snapshot().get("admission.rejected.degrade", 0)
+        server.admission.force_state(DEGRADE)
+        try:
+            root.set("k", 2)  # nacked 503 -> recovery thread takes over
+            # The edit must NOT have landed while degraded.
+            assert counters.snapshot()["admission.rejected.degrade"] \
+                > rejected0
+            assert sequenced == []
+            time.sleep(0.1)  # a couple of nack->retry_after rounds
+        finally:
+            server.admission.force_state(ACCEPT)
+        # The driver's retry_after recovery resubmits; the op lands
+        # exactly once without any client-side intervention.
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not sequenced:
+            time.sleep(0.01)
+        landed = [m for m in sequenced if "k" in str(m.contents)]
+        assert len(landed) == 1
+        c2 = Loader(LocalDocumentServiceFactory(server)).resolve("doc")
+        assert c2.runtime.get_datastore("default") \
+            .get_channel("root").get("k") == 2
+        c1.close()
+        c2.close()
+
+    def test_retry_policy_honors_admission_retry_after_exactly(self):
+        # The server-computed retry_after (a Decision from the
+        # controller) overrides the policy's jittered backoff: waits
+        # must match the server's ask, not exceed it.
+        from fluidframework_tpu.server.admission import (
+            AdmissionController, DEGRADE)
+
+        ctl = AdmissionController(queue_limit=10, recover_after_s=0.3)
+        ctl.force_state(DEGRADE)
+        decision = ctl.admit("t")
+        assert not decision.admitted and decision.retry_after_s > 0
+
+        sleeps = []
+        attempts = {"n": 0}
+
+        def op():
+            attempts["n"] += 1
+            if attempts["n"] <= 2:
+                raise ThrottlingError(decision.retry_after_s)
+            return "ok"
+
+        policy = RetryPolicy(max_attempts=5, sleep=sleeps.append)
+        assert policy.run(op) == "ok"
+        assert sleeps == [decision.retry_after_s] * 2
+
+    def test_single_flight_dedups_fetches_under_throttle(self):
+        # Two concurrent readers during a throttled storage window must
+        # collapse into ONE upstream retry loop — a throttled backend
+        # is exactly when a fetch storm would hurt the most.
+        from fluidframework_tpu.loader.drivers.resilience import (
+            RetryingStorageService)
+
+        release = threading.Event()
+        state = {"calls": 0, "throttles": 2}
+
+        class _ThrottledStorage:
+            def get_summary(self, version=None):
+                state["calls"] += 1
+                release.wait(timeout=5)
+                if state["throttles"]:
+                    state["throttles"] -= 1
+                    raise ThrottlingError(0.0)
+                return "SUMMARY"
+
+        svc = RetryingStorageService(
+            _ThrottledStorage(), RetryPolicy(sleep=lambda _: None),
+            SingleFlight(), "doc")
+        results = []
+        threads = [threading.Thread(
+            target=lambda: results.append(svc.get_summary()))
+            for _ in range(2)]
+        threads[0].start()
+        while state["calls"] == 0:
+            pass  # leader is in flight
+        threads[1].start()
+        release.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert results == ["SUMMARY", "SUMMARY"]
+        # 2 throttled attempts + 1 success from the ONE leader; the
+        # follower rode the same flight instead of its own retry loop.
+        assert state["calls"] == 3
+
+
+class TestStickyDegradationWithHistorian:
+    """The historian-tier fallback (routerlicious
+    NetworkDocumentStorageService._call) under admission-style
+    pressure: a 503 (DEGRADE refusal / dead tier) degrades to the
+    direct endpoint and STAYS degraded; a 429 throttle does NOT — the
+    tier is alive and asking for patience, so the retry rides the
+    normal policy and the cache tier keeps its traffic."""
+
+    class _Rest:
+        def __init__(self, script, calls):
+            self.script = script
+            self.calls = calls
+
+        def get(self, path):
+            self.calls.append(path)
+            action = self.script.pop(0) if self.script else "ok"
+            if action == "ok":
+                from fluidframework_tpu.protocol.summary import SummaryType
+                return {"summary": {"type": SummaryType.TREE,
+                                    "entries": {}}}
+            raise action
+
+    def _storage(self, historian_script):
+        from fluidframework_tpu.loader.drivers.routerlicious import (
+            NetworkDocumentStorageService)
+        direct_calls, tier_calls = [], []
+        script = list(historian_script)  # shared across factory mints
+        svc = NetworkDocumentStorageService(
+            lambda: self._Rest([], direct_calls), "t", "d",
+            historian_factory=lambda: self._Rest(script, tier_calls))
+        return svc, direct_calls, tier_calls
+
+    def test_503_degrades_sticky_to_direct(self):
+        from fluidframework_tpu.loader.drivers.routerlicious import RestError
+        svc, direct, tier = self._storage(
+            [RestError(503, "tier lost upstream")] * 10)
+        assert svc.get_summary() is not None   # fell back to direct
+        assert len(tier) == 1 and len(direct) == 1
+        svc.get_summary()                      # sticky: tier untouched
+        assert len(tier) == 1 and len(direct) == 2
+
+    def test_429_throttle_does_not_mark_tier_down(self):
+        from fluidframework_tpu.loader.drivers.routerlicious import RestError
+        svc, direct, tier = self._storage(
+            [RestError(429, "throttled"), "ok"])
+        with pytest.raises(RestError) as exc:
+            svc.get_summary()
+        assert exc.value.status == 429
+        assert direct == []                    # no silent failover
+        # The retry (driver RetryPolicy's job) lands on the TIER again:
+        # throttling is back-pressure, not death.
+        assert svc.get_summary() is not None
+        assert len(tier) == 2 and direct == []

@@ -925,3 +925,155 @@ class TestRepoGate:
             assert entry["reason"] and "TODO" not in entry["reason"], (
                 f"baseline entry {entry['fingerprint']} "
                 f"({entry['path']}) has no justification")
+
+
+class TestUnboundedQueue:
+    def test_true_positive_list_append(self):
+        src = """
+            class Ingest:
+                def __init__(self):
+                    self._queue = []
+
+                def on_message(self, msg):
+                    self._queue.append(msg)
+        """
+        assert rule_ids(src, "UNBOUNDED_QUEUE") == ["UNBOUNDED_QUEUE"]
+
+    def test_true_positive_deque_without_maxlen(self):
+        src = """
+            import collections
+
+            class Pump:
+                def __init__(self):
+                    self.backlog = collections.deque()
+
+                def feed(self, batch):
+                    self.backlog.extend(batch)
+        """
+        assert rule_ids(src, "UNBOUNDED_QUEUE") == ["UNBOUNDED_QUEUE"]
+
+    def test_guard_deque_maxlen(self):
+        src = """
+            import collections
+
+            class Pump:
+                def __init__(self):
+                    self.backlog = collections.deque(maxlen=1024)
+
+                def feed(self, batch):
+                    self.backlog.extend(batch)
+        """
+        assert rule_ids(src, "UNBOUNDED_QUEUE") == []
+
+    def test_guard_len_limit_check(self):
+        src = """
+            class Ingest:
+                def __init__(self, limit):
+                    self._queue = []
+                    self.limit = limit
+
+                def on_message(self, msg):
+                    if len(self._queue) >= self.limit:
+                        return False
+                    self._queue.append(msg)
+                    return True
+        """
+        assert rule_ids(src, "UNBOUNDED_QUEUE") == []
+
+    def test_guard_slicing_trim(self):
+        src = """
+            class Recorder:
+                def __init__(self):
+                    self.pending = []
+
+                def push(self, item):
+                    self.pending.append(item)
+                    self.pending = self.pending[-512:]
+        """
+        assert rule_ids(src, "UNBOUNDED_QUEUE") == []
+
+    def test_guard_del_trim(self):
+        src = """
+            class Recorder:
+                def __init__(self):
+                    self.pending = []
+
+                def push(self, item):
+                    self.pending.append(item)
+                    if True:
+                        del self.pending[:256]
+        """
+        assert rule_ids(src, "UNBOUNDED_QUEUE") == []
+
+    def test_guard_swap_and_drain_clear(self):
+        src = """
+            class Batcher:
+                def __init__(self):
+                    self.inbox = []
+
+                def push(self, item):
+                    self.inbox.append(item)
+
+                def drain(self):
+                    out = list(self.inbox)
+                    self.inbox.clear()
+                    return out
+        """
+        assert rule_ids(src, "UNBOUNDED_QUEUE") == []
+
+    def test_non_queueish_names_are_ignored(self):
+        src = """
+            class Registry:
+                def __init__(self):
+                    self.rules = []
+
+                def add(self, r):
+                    self.rules.append(r)
+        """
+        assert rule_ids(src, "UNBOUNDED_QUEUE") == []
+
+    def test_pop_alone_is_not_a_bound(self):
+        # Consumption is not a bound: producers can outpace the pump.
+        src = """
+            class Pump:
+                def __init__(self):
+                    self._queue = []
+
+                def feed(self, msg):
+                    self._queue.append(msg)
+
+                def pump_one(self):
+                    if self._queue:
+                        return self._queue.pop(0)
+        """
+        assert rule_ids(src, "UNBOUNDED_QUEUE") == ["UNBOUNDED_QUEUE"]
+
+    def test_out_of_scope_module_ignored(self):
+        from fluidframework_tpu.analysis import analyze_source
+        src = textwrap.dedent("""
+            class ClientPending:
+                def __init__(self):
+                    self.pending = []
+
+                def queue_op(self, op):
+                    self.pending.append(op)
+        """)
+        assert [v.rule_id for v in analyze_source(
+            src, path="fluidframework_tpu/loader/pending.py",
+            only=["UNBOUNDED_QUEUE"])] == []
+        assert [v.rule_id for v in analyze_source(
+            src, path="fluidframework_tpu/server/newpump.py",
+            only=["UNBOUNDED_QUEUE"])] == ["UNBOUNDED_QUEUE"]
+
+    def test_suppression_with_reason(self):
+        src = """
+            class Ingest:
+                def __init__(self):
+                    self._queue = []
+
+                def on_message(self, msg):
+                    # fluidlint: disable=UNBOUNDED_QUEUE — bounded by
+                    # the admission front door (docs/overload.md)
+                    self._queue.append(msg)
+        """
+        assert rule_ids(src, "UNBOUNDED_QUEUE") == []
