@@ -2,7 +2,8 @@
 # must be a one-liner anyone can repeat).
 
 .PHONY: test soak bench dryrun record-corpus historian-smoke \
-	summarize-smoke trace-smoke pipeline-smoke lint-analysis check
+	summarize-smoke trace-smoke pipeline-smoke fused-smoke \
+	lint-analysis check
 
 test:
 	python -m pytest tests/ -q
@@ -40,6 +41,15 @@ trace-smoke:
 pipeline-smoke:
 	JAX_PLATFORMS=cpu python bench.py pipeline-smoke
 
+# CPU smoke of the fused serving-burst path (docs/serving_pipeline.md
+# R8): identical raw-wire waves through a synchronous and a burst-
+# pipelined sequencer must emit an ORDER-identical stream, bursts must
+# actually form with <= 2 dispatches per burst (one scan + at most one
+# recovery) and < 1.0 dispatches per served window, and warm ingest at
+# the 512-doc shape must clear 1.15x the pinned BENCH_r06 ring figure.
+fused-smoke:
+	JAX_PLATFORMS=cpu python bench.py fused-smoke
+
 # Virtual-clocked open-loop overload harness (docs/overload.md): at 2x
 # sustained overload the admission controller must shed instead of
 # queueing unboundedly (peak queue bounded), hold the admitted-op flush
@@ -50,9 +60,9 @@ overload-smoke:
 	JAX_PLATFORMS=cpu python bench.py overload-smoke
 
 # The pre-merge gate: static analysis + the summarize/trace/pipeline/
-# overload smokes + the full test suite.
+# fused/overload smokes + the full test suite.
 check: lint-analysis summarize-smoke trace-smoke pipeline-smoke \
-		overload-smoke test
+		fused-smoke overload-smoke test
 
 # The round-end randomized-evidence ritual: 50-trial soaks over every
 # differential surface (bulk catch-up, serving fast path, matrix/

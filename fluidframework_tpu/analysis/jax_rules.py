@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import ast
 import re
-from typing import Iterator, Optional, Set
+from typing import Iterator, Optional, Set, Tuple
 
 from .engine import ModuleContext, Violation, _dotted
 from .registry import rule
@@ -246,6 +246,131 @@ def dtype_drift(ctx: ModuleContext) -> Iterator[Violation]:
             f"dtype `{_dotted(node.value)}.{node.attr}` in jitted "
             f"`{info.node.name}` drifts from the canonical device dtypes "
             f"({', '.join(sorted(canonical))})")
+
+
+# Host-callback hazards inside scan/while_loop bodies: the fused burst
+# program (serve_step.serve_burst) runs pack→apply→extract for K windows
+# inside ONE lax.scan precisely to remove per-window host round-trips —
+# an io_callback/debug.callback re-entering the host per scan step (or a
+# block_until_ready forcing a device sync at trace/staging time) would
+# silently reintroduce the serialized RPC the fusion exists to delete,
+# K times per burst.
+_SCAN_DRIVER_BODY_ARGS = {
+    "scan": (0,),          # lax.scan(body, init, xs)
+    "while_loop": (0, 1),  # lax.while_loop(cond_fun, body_fun, init)
+    "fori_loop": (2,),     # lax.fori_loop(lo, hi, body_fun, init)
+}
+
+_HOST_CALLBACK_NAMES = {
+    "io_callback", "jax.experimental.io_callback",
+    "debug.callback", "jax.debug.callback",
+    "host_callback.call", "jax.experimental.host_callback.call",
+    "hcb.call", "pure_callback", "jax.pure_callback",
+}
+
+# Same operational scope as SPAN_LEAK: the op pipeline's device code.
+_SCAN_SCOPE_PREFIXES = (
+    "fluidframework_tpu/mergetree", "fluidframework_tpu/server",
+    "<memory>")
+
+
+def _scan_scope(ctx: ModuleContext) -> bool:
+    path = ctx.path.replace("\\", "/")
+    return any(path.startswith(p) or f"/{p}" in path
+               for p in _SCAN_SCOPE_PREFIXES)
+
+
+def _scan_driver(call: ast.Call) -> Optional[Tuple[str, tuple]]:
+    """(driver name, body-arg positions) when `call` is a lax.scan /
+    while_loop / fori_loop invocation (plain or jax.lax-qualified)."""
+    fn = _dotted(call.func)
+    if not fn:
+        return None
+    head, _, tail = fn.rpartition(".")
+    if tail in _SCAN_DRIVER_BODY_ARGS and head in ("lax", "jax.lax", ""):
+        # Bare names ("scan") only count when qualified — too generic
+        # otherwise.
+        if head or tail in ("while_loop", "fori_loop"):
+            return tail, _SCAN_DRIVER_BODY_ARGS[tail]
+    return None
+
+
+def _body_functions(ctx: ModuleContext, call: ast.Call,
+                    positions: tuple):
+    """Resolve a scan driver call's body argument(s) to AST function
+    nodes: inline lambdas directly, Name references to module-level (or
+    nested) defs by name, functools.partial(f, ...) through its first
+    argument. Unresolvable bodies (imports, attributes) are skipped —
+    the rule is single-module by design, like every fluidlint check."""
+    by_name: dict = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.FunctionDef):
+            by_name.setdefault(node.name, []).append(node)
+    exprs = []
+    for pos in positions:
+        if pos < len(call.args):
+            exprs.append(call.args[pos])
+    for kw in call.keywords:
+        if kw.arg in ("f", "body_fun", "cond_fun") \
+                and kw.value not in exprs:
+            exprs.append(kw.value)
+    for expr in exprs:
+        if isinstance(expr, ast.Call) and \
+                _dotted(expr.func) in ("functools.partial", "partial") \
+                and expr.args:
+            expr = expr.args[0]
+        if isinstance(expr, ast.Lambda):
+            yield "<lambda>", expr
+        elif isinstance(expr, ast.Name):
+            for fn in by_name.get(expr.id, []):
+                yield expr.id, fn
+
+
+def _host_callback_hazards(body: ast.AST):
+    for node in ast.walk(body):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = _dotted(node.func)
+        if fn in _HOST_CALLBACK_NAMES or \
+                fn.rpartition(".")[2] == "io_callback":
+            yield node, fn
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "block_until_ready"
+              and not node.args):
+            yield node, ".block_until_ready()"
+
+
+@rule("SCAN_HOST_CALLBACK",
+      "Host callback / device sync inside a lax.scan or while_loop body",
+      family="jax",
+      rationale="A scanned body re-entering the host (io_callback, "
+                "debug.callback, pure_callback) or forcing a sync "
+                "(.block_until_ready()) serializes every scan step on a "
+                "host round-trip — exactly the per-window RPC the fused "
+                "serving burst exists to remove. Move the host work to "
+                "the carry/ys boundary, or keep the value device-side.")
+def scan_host_callback(ctx: ModuleContext) -> Iterator[Violation]:
+    if not _scan_scope(ctx):
+        return
+    seen: Set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        driver = _scan_driver(node)
+        if driver is None:
+            continue
+        name, positions = driver
+        for body_name, body in _body_functions(ctx, node, positions):
+            for hazard, what in _host_callback_hazards(body):
+                key = id(hazard)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield ctx.violation(
+                    "SCAN_HOST_CALLBACK", hazard,
+                    f"`{what}` inside `{body_name}`, the body of a "
+                    f"`lax.{name}`: every step pays a host round-trip, "
+                    f"serializing the scanned program")
 
 
 # serve/window joined step/apply when serve_window gained lane-state

@@ -442,6 +442,23 @@ def apply_ops_batched_keep(state: DocState, ops: PackedOps) -> DocState:
     return _scan_ops(state, ops, batched=True)
 
 
+def apply_if_any(apply_fn, state: DocState, active) -> DocState:
+    """lax.cond-guard an apply inside a larger traced program: run
+    ``apply_fn(state)`` when ``active`` (any real op in the block), else
+    return ``state`` unchanged.
+
+    This is the burst scan's padding shortcut (serve_step.serve_burst):
+    stacking K serving windows into one scanned program pads every
+    window to the union of staged buckets, so a window that staged
+    nothing for a bucket carries an all-NOOP op plane there — and a
+    NOOP stream is an exact identity on DocState (every phase masks on
+    the op kind; locked by the burst bit-identity tests), so skipping
+    the T-step apply is free correctness-wise and saves the full
+    scan-kernel cost of the padded window. NOT jitted here: it traces
+    inside the caller's program (the scan body)."""
+    return jax.lax.cond(active, apply_fn, lambda s: s, state)
+
+
 # ---------------------------------------------------------------------------
 # zamboni: compaction
 # ---------------------------------------------------------------------------

@@ -228,9 +228,19 @@ class AdmissionController:
                     h = src["hints"]() or {}
                     staged += int(h.get("staged_ops", 0))
                     ring_depth = max(1, int(h.get("ring_depth", 1)))
+                    # Occupancy is WINDOW-counted (a K-window fused
+                    # burst reports K, not 1 — see TpuSequencerLambda.
+                    # occupancy_hints), so the raw ratio can exceed 1
+                    # whenever a burst is in flight. Clamp at "full":
+                    # that keeps the latency term live during long scan
+                    # steps (an uncapped ratio is not more full than
+                    # full) while the 0.45 damping below still
+                    # guarantees bursting-by-design never reaches the
+                    # 0.5 THROTTLE threshold on its own.
                     ring_frac = max(
                         ring_frac,
-                        float(h.get("ring_occupancy", 0)) / ring_depth)
+                        min(1.0, float(h.get("ring_occupancy", 0))
+                            / ring_depth))
             except Exception:  # noqa: BLE001 — a probe must not block ingest
                 record_swallow("admission.source")
         self._staged_ops = staged

@@ -33,7 +33,14 @@ def make_full_step(sp_shards: int = 1, fused_apply: bool = False):
     whole op stream). fused_apply with sp_shards > 1 composes the SAME
     fused formulation with sequence-axis sharding (mergetree/fused_sp.py):
     per-shard lane tiles with two-level collective prefix sums, so long
-    documents and the flagship kernel are no longer mutually exclusive."""
+    documents and the flagship kernel are no longer mutually exclusive.
+
+    Naming note: this flag is the capacity-gated KERNEL experiment bench
+    drives (stamped `fused_apply_kernel_exp` in BENCH records since
+    round 8). The PRODUCTION serving path's `fused_apply` stamp means
+    something stronger — the sequencer actually ran scanned multi-window
+    serving bursts (serve_step.serve_burst,
+    docs/serving_pipeline.md R8)."""
 
     def full_step(tstate, mstate, raw, ops):
         """(ticket_state, merge_state, RawOps, PackedOps) ->

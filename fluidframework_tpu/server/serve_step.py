@@ -36,13 +36,22 @@ from . import lww_kernel as lk
 from . import ticket_kernel as tk
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 2, 4), static_argnums=(6,))
-def serve_window(tstate, ticket_cols, merge_states, merge_cols,
-                 lww_states, lww_cols, fused=False, merge_runs=None):
-    """The WHOLE fast window in one device program — over a tunneled
-    device every extra dispatch pays a serialized RPC, so ticketing, every
-    bucket's merge/LWW apply, and the result packing fuse into a single
-    jit (retraced per bucket-set structure, which is bounded).
+def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
+                       lww_states, lww_cols, fused=False, merge_runs=None,
+                       noop_skip=False):
+    """The traced body shared by ``serve_window`` (one jitted window),
+    ``serve_window_keep`` (the non-donating recovery variant), and
+    ``serve_burst``'s scan step (K windows in one program).
+
+    ``noop_skip`` is the burst-padding escape hatch: stacked burst
+    windows pad to the union of staged buckets, so a bucket a window
+    never staged carries an all-NOOP op plane — with the flag set, each
+    bucket's apply is lax.cond-guarded on "any real op", skipping the
+    whole T-step apply for padding (kernel.apply_if_any; a NOOP stream
+    is an exact identity on the lane state either way, so results are
+    bit-identical — the guard only saves the padded window's compute).
+    Single-window callers keep it off: the cond costs a predicate per
+    bucket and a real window always has work.
 
     ticket_cols: [4, B, T] int32 (kind, client, cseq, refseq) — ONE H2D.
     merge_cols:  per bucket [12, lanes, Tm] (10 PackedOps columns +
@@ -112,21 +121,30 @@ def serve_window(tstate, ticket_cols, merge_states, merge_cols,
             # tpu_sequencer), else the scan kernel — whose per-step cost
             # the packing itself collapses.
             if use_fused:
-                out = apply_ops_fused_pallas(mstate, ops2, runs=runs)
+                def apply_m(s, o=ops2, r=runs):
+                    return apply_ops_fused_pallas(s, o, runs=r)
             else:
-                out = kernel._scan_ops(mstate, ops2, batched=True,
-                                       runs=runs)
-            out = out._replace(overflow=out.overflow | over_extra)
-            new_merge.append(out)
+                def apply_m(s, o=ops2, r=runs):
+                    return kernel._scan_ops(s, o, batched=True, runs=r)
         elif use_fused:
             # VMEM-resident fused apply: the bucket's lane block stays
             # on-core across the whole op stream — the T-step HBM
             # re-read/re-write of the scan kernel (the serving apply's
             # dominant cost) collapses to one read + one write.
             # Bit-identical to the scan kernel (tests/test_pallas_apply).
-            new_merge.append(apply_ops_fused_pallas(mstate, ops2))
+            def apply_m(s, o=ops2):
+                return apply_ops_fused_pallas(s, o)
         else:
-            new_merge.append(kernel._scan_ops(mstate, ops2, batched=True))
+            def apply_m(s, o=ops2):
+                return kernel._scan_ops(s, o, batched=True)
+        if noop_skip:
+            out = kernel.apply_if_any(apply_m, mstate,
+                                      jnp.any(ops2.kind != OpKind.NOOP))
+        else:
+            out = apply_m(mstate)
+        if over_extra is not None:
+            out = out._replace(overflow=out.overflow | over_extra)
+        new_merge.append(out)
 
     new_lww = []
     # fluidlint: disable=RETRACE_HAZARD — deliberate bounded unroll, one
@@ -137,7 +155,14 @@ def serve_window(tstate, ticket_cols, merge_states, merge_cols,
         ops = lk.LwwOps(kind=jnp.where(ok, lc[0], lk.LwwKind.NOOP),
                         key=lc[1], val=lc[2], delta=lc[3],
                         seq=jnp.where(ok, seq_g, 0))
-        new_lww.append(lk._scan(lstate, ops, batched=True))
+
+        def apply_l(s, o=ops):
+            return lk._scan(s, o, batched=True)
+        if noop_skip:
+            new_lww.append(kernel.apply_if_any(
+                apply_l, lstate, jnp.any(ops.kind != lk.LwwKind.NOOP)))
+        else:
+            new_lww.append(apply_l(lstate))
 
     flags = ticketed.nacked.astype(jnp.int32) | \
         (ticketed.not_joined.astype(jnp.int32) << 1)
@@ -206,6 +231,17 @@ def serve_window(tstate, ticket_cols, merge_states, merge_cols,
     return tstate, new_merge, new_lww, flat16, msn_bt
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 2, 4), static_argnums=(6,))
+def serve_window(tstate, ticket_cols, merge_states, merge_cols,
+                 lww_states, lww_cols, fused=False, merge_runs=None):
+    """One fast window, donating: the jitted single-window entry point
+    over ``_serve_window_impl`` (docstring there carries the full
+    contract and the flat16 layout)."""
+    return _serve_window_impl(tstate, ticket_cols, merge_states,
+                              merge_cols, lww_states, lww_cols, fused,
+                              merge_runs)
+
+
 # The non-donating recovery-replay variant: identical traced body, but the
 # merge/LWW lane states survive the call. The sequencer dispatches through
 # THIS variant whenever its host-side occupancy hints cannot prove the
@@ -217,3 +253,60 @@ def serve_window(tstate, ticket_cols, merge_states, merge_cols,
 serve_window_keep = functools.partial(
     jax.jit, donate_argnums=(0,), static_argnums=(6,))(
         serve_window.__wrapped__)
+
+
+def _serve_burst(tstate, merge_states, lww_states, ticket_xs, merge_xs,
+                 lww_xs, runs_xs, fused=False):
+    """K serving windows in ONE scanned device program (the fused
+    serving burst, docs/serving_pipeline.md R8).
+
+    The ring overlaps the per-window host→device dispatch and narrow
+    readback but every window still PAYS them — over a tunneled device
+    each is a serialized RPC (~70 ms floor, PERF.md). The burst
+    collapses K ready windows into one ``lax.scan`` whose carry is the
+    donated lane-bucket state (ticket state + every staged merge/LWW
+    bucket, updated in place across all K windows) and whose xs are the
+    per-window packed op planes, pre-staged host-side into single
+    stacked buffers:
+
+      ticket_xs: [K, 4, B, T]  — per-window ticket staging
+      merge_xs:  per union bucket [K, 12, lanes, Tm] (NOOP-padded where
+                 a window staged nothing for the bucket; the scan body
+                 cond-skips those applies — kernel.apply_if_any)
+      lww_xs:    per union bucket [K, 6, lanes, Tm]
+      runs_xs:   per union bucket [K, 4, lanes, Tm, RUN_K] or None
+
+    ys are each window's narrow int16 result [K, flat] plus the exact
+    msn planes [K, B, T] (fetched per window only on the rare msn-delta
+    overflow) — the whole burst is ONE dispatch and ONE readback. The
+    body is ``_serve_window_impl`` itself, so results are bit-identical
+    to dispatching the K windows through ``serve_window`` back to back;
+    the host-side finish path (seq distribution, nacks, overflow
+    quarantine) runs per window off the stacked result exactly as it
+    does off ring entries.
+
+    Burst admission is the sequencer's job: only windows whose
+    occupancy-hint fit proofs pass (non-risky AND donate-eligible)
+    enter a burst, so overflow here is the same rare unpredicted class
+    the donated per-window path handles (degrade + quarantine fixup).
+    Mesh note: the body shards exactly as serve_window does under
+    GSPMD, but bursts require donation, which dp meshes gate off (the
+    jax 0.4.37 warm-cache corruption, docs/serving_pipeline.md R6) —
+    so meshes stay on the per-window ring until that clears."""
+    def body(carry, xs):
+        ts, ms, ls = carry
+        tc, mc, lc, rc = xs
+        ts2, nm, nl, flat16, msn32 = _serve_window_impl(
+            ts, tc, list(ms), list(mc), list(ls), list(lc), fused,
+            list(rc), noop_skip=True)
+        return (ts2, tuple(nm), tuple(nl)), (flat16, msn32)
+
+    carry, ys = jax.lax.scan(
+        body, (tstate, tuple(merge_states), tuple(lww_states)),
+        (ticket_xs, tuple(merge_xs), tuple(lww_xs), tuple(runs_xs)))
+    ts, ms, ls = carry
+    return ts, list(ms), list(ls), ys[0], ys[1]
+
+
+serve_burst = functools.partial(
+    jax.jit, donate_argnums=(0, 1, 2), static_argnums=(7,))(_serve_burst)

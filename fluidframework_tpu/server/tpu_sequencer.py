@@ -2323,9 +2323,29 @@ class TpuSequencerLambda(IPartitionLambda):
         # windows, fold/rescue, payload GC, summarize extract) forces a
         # full drain first (docs/serving_pipeline.md).
         self.pipelined = False
-        self.ring_depth = 4            # max dispatched-but-unread windows
+        self.ring_depth = 4            # max dispatched-but-unread entries
         self.adaptive_window = True    # per-flush T/depth from latencies
         self._ring: "deque" = deque()
+        # Fused serving bursts (docs/serving_pipeline.md R8): windows
+        # whose occupancy-hint fit proofs pass stay STAGED (packed but
+        # undispatched) and flush as ONE lax.scan program per
+        # burst_depth windows (serve_step.serve_burst) — the last
+        # per-window host round-trip (dispatch RPC + narrow readback)
+        # amortizes over the whole burst. Requires lane-state donation
+        # (the scan carry is donated), so dp meshes stay on the
+        # per-window ring. Scan lengths draw from the fixed grid so the
+        # burst program's compile cache stays bounded; a remainder of
+        # one window dispatches through plain serve_window.
+        self.fused_bursts = True
+        self.burst_depth = 8           # staged windows per scan cap
+        self._burst_k_grid = (2, 4, 8, 16, 32)
+        self._staged: List[dict] = []  # packed-not-yet-dispatched windows
+        # Whether this backend's jit call BLOCKS on execution (CPU) or
+        # dispatches asynchronously (tpu/axon) — picks the _device_busy
+        # signal that decides when staged windows stop accumulating.
+        import jax as _jax
+        self._dispatch_blocking = _jax.default_backend() not in (
+            "tpu", "axon")
         # Overflow quarantine (mid-ring fold/rescue): channel ordinals
         # whose lanes were rolled back + host-recovered while later
         # windows were already in flight. Those windows' rows for these
@@ -2730,14 +2750,28 @@ class TpuSequencerLambda(IPartitionLambda):
     def occupancy_hints(self) -> dict:
         """Live occupancy for the admission controller (server/
         admission.py): staged-but-unflushed ops (raw fast-path backlog +
-        slow-path pending queues) and the in-flight window ring's fill.
-        Host-state reads only — never blocks on the device."""
+        slow-path pending queues) and the in-flight WINDOW fill. Host-
+        state reads only — never blocks on the device.
+
+        Window-counted, not entry-counted: a K-window fused burst is one
+        ring entry but K windows of committed in-flight work — reporting
+        it as fill 1 would let the controller's latency term read a long
+        scan step as calm (ring "mostly empty" zeroes the term). Staged
+        (packed, not yet dispatched) burst windows count too; the
+        controller clamps the resulting fill fraction at 1.0 so bursting
+        by design never throttles on its own."""
         return {
             "staged_ops": len(self._raw_backlog)
             + sum(len(q) for q in self.pending.values()),
-            "ring_occupancy": len(self._ring),
+            "ring_occupancy": self._in_flight_windows(),
             "ring_depth": self.ring_depth,
         }
+
+    def _in_flight_windows(self) -> int:
+        """Dispatched-but-unread windows (burst entries count their K)
+        plus staged-but-undispatched windows."""
+        return sum(e.get("n_windows", 1) for e in self._ring) \
+            + len(self._staged)
 
     def _flush_parent(self):
         """The first pending traced op's context, if any (slow/object
@@ -2776,8 +2810,11 @@ class TpuSequencerLambda(IPartitionLambda):
         # boundary — every window above has fully applied. In-flight ring
         # windows are the same hazard class: their recovery replays
         # op_ids and pre-window rows numbered against the CURRENT table,
-        # so no renumbering while any are in flight.
-        if not self._ring:
+        # so no renumbering while any are in flight — and STAGED burst
+        # windows more so (their packed cols embed op ids and lane
+        # placements that a renumber/compaction would invalidate before
+        # they even dispatch).
+        if not self._ring and not self._staged:
             if self._gc_due:
                 self._run_fast_gc()
             with tracing.span("serving.gc", hist="serving.gc"):
@@ -2795,13 +2832,14 @@ class TpuSequencerLambda(IPartitionLambda):
                     >= 2 * self.lww.value_compact_every):
                 self.drain()
                 self._run_fast_gc()
-            gauge("serving.ring_occupancy", float(len(self._ring)))
+            gauge("serving.ring_occupancy",
+                  float(self._in_flight_windows()))
 
     def _run_fast_gc(self) -> None:
         """The fast path's due lane compactions, at a ring-empty boundary
         (compact_all/_fold_crowded move lanes; in-flight windows staged
         against the old placement would corrupt their successors)."""
-        assert not self._ring
+        assert not self._ring and not self._staged
         self._gc_due = False
         # compact_all's _fold_crowded reseeds channels at new (bucket,
         # lane) placements: any flush staging resolved before this point
@@ -2975,20 +3013,35 @@ class TpuSequencerLambda(IPartitionLambda):
             parsed, n_windows, merge_all, win_m, chan_ok, chan_b, chan_l,
             win_l, lchan_ok, lchan_b, lchan_l)
         gen_seen = self._recovery_gen
-        for w in range(n_windows):
+        burst_on = (defer_ok and self.fused_bursts
+                    and self.donate_lane_states)
+        w = 0
+        while w < n_windows:
+            burst_w = (burst_on and not risky[w]
+                       and bool(donate_ok[w]))
             defer_w = defer_ok and (not risky[w]
                                     or self.defer_risky_windows)
-            if defer_w:
-                # Bounded ring admission: retire the oldest window once
-                # the ring is full.
+            if burst_w or defer_w:
+                if not burst_w and self._staged:
+                    # A non-burstable window interrupts accumulation:
+                    # dispatch the staged run first (FIFO — emits and
+                    # lane mutations must land in stage order).
+                    increment("serving.burst_breaks")
+                    self._dispatch_staged_burst()
+                # Bounded ring admission: retire the oldest entry once
+                # the ring is full (for burst windows _drain_one first
+                # flushes the staged run as a scan, keeping FIFO).
                 while len(self._ring) >= depth:
                     self._drain_one()
                 if self._ring_fixup or self._ring_fixup_lww:
                     self.drain()
-            elif self._ring:
+            elif self._staged or self._ring:
                 # Sync dispatch (risky or unpipelined): settle every
                 # in-flight window first — _finish_window's quarantine
                 # direction assumes ring entries are LATER windows.
+                # drain() dispatches any staged burst before joining.
+                if self._staged:
+                    increment("serving.burst_breaks")
                 self.drain()
             if self._recovery_gen != gen_seen:
                 # A fold/rescue (drained window's, or the previous sync
@@ -3002,32 +3055,80 @@ class TpuSequencerLambda(IPartitionLambda):
                     cols[P.CHAN, lww_all])
                 risky, donate_ok = self._assess_windows(
                     parsed, n_windows, merge_all, win_m, chan_ok, chan_b,
-                    chan_l, win_l, lchan_ok, lchan_b, lchan_l)
-                defer_w = defer_ok and (not risky[w]
-                                        or self.defer_risky_windows)
+                    chan_l, win_l, lchan_ok, lchan_b, lchan_l, start_w=w)
+                # Re-derive this window's routing against the fresh
+                # placement before staging anything.
+                continue
             sel = win == w
-            self._dispatch_fast_window(
-                parsed, backlog, rows[sel], lanes_r[sel], slot[sel], T,
+            wd = self._stage_fast_window(
+                parsed, rows[sel], lanes_r[sel], slot[sel], T,
                 mbase, chan_ok, chan_b, chan_l,
                 vbase, lchan_ok, lchan_b, lchan_l,
-                row_seq, sel, row_msn, defer=defer_w,
+                row_seq, sel, row_msn,
                 donate=self.donate_lane_states and bool(donate_ok[w]))
+            if burst_w:
+                self._staged.append(wd)
+                increment("serving.ring_windows_deferred")
+                # Deferral counted at stage; the dispatch paths below
+                # (burst chunk or solo remainder) must not re-count it.
+                wd["counted_deferred"] = True
+                if len(self._staged) >= self.burst_depth:
+                    self._dispatch_staged_burst()
+            else:
+                self._dispatch_staged_window(wd, defer=defer_w)
+            w += 1
 
         emit_args = (bufs,
                      [self._pump_docs[int(o)] for o in doc_col[rows]],
                      rows, cols, row_seq, row_msn)
-        if defer_ok and self._ring:
+        if defer_ok and (self._staged or self._ring):
             # Attached to the flush's LAST window: its drain (after every
             # earlier window filled its row_seq/row_msn slice) emits and
-            # checkpoints for the whole flush.
-            self._ring[-1]["emit_args"] = emit_args
+            # checkpoints for the whole flush. Staged windows are always
+            # newer than every ring entry (dispatch preserves FIFO).
+            if self._staged:
+                self._staged[-1]["emit_args"] = emit_args
+            else:
+                tail = self._ring[-1]
+                if "burst" in tail:
+                    # The flush's last window already dispatched inside
+                    # a burst entry: emits ride that WINDOW's retire so
+                    # ordering stays per-window uniform.
+                    tail["burst"][-1]["emit_args"] = emit_args
+                else:
+                    tail["emit_args"] = emit_args
         else:
             self._emit_fast_window(emit_args)
-        gauge("serving.ring_occupancy", float(len(self._ring)))
-        peak = max(len(self._ring),
-                   int(counter_get("serving.ring_peak_occupancy")))
+        # Load-adaptive burst sizing: dispatch whatever accumulated the
+        # moment the DEVICE goes idle — a single staged window rides
+        # plain serve_window (the burst degrades to exactly the
+        # per-window ring under light load, keeping the ring's
+        # pack/execute overlap), while a device running behind lets
+        # staged windows pile up and leave as ONE scan (dispatch count
+        # per window shrinks precisely when dispatch pressure is the
+        # bottleneck). The burst_depth cap above bounds staging memory
+        # and emit latency either way.
+        if self._staged and not self._device_busy():
+            self._dispatch_staged_burst()
+        occ = self._in_flight_windows()
+        gauge("serving.ring_occupancy", float(occ))
+        peak = max(occ, int(counter_get("serving.ring_peak_occupancy")))
         gauge("serving.ring_peak_occupancy", float(peak))
         return sorted(doc_active.keys() - slow_ids)
+
+    def _device_busy(self) -> bool:
+        """Is dispatched work still in flight? On async backends (a
+        tunneled TPU) fetch threads exit the moment their D2H lands, so
+        a live thread means the device or transfer is still working
+        through the ring — staged windows should accumulate into a
+        bigger scan rather than queue behind it. On blocking-dispatch
+        backends (CPU: the jit call runs the program inline, so threads
+        die instantly) undrained ring entries are the only in-flight
+        signal: results nobody has joined yet mean nobody is waiting,
+        so batching costs nothing — dispatches serialize either way."""
+        if any(e["thread"].is_alive() for e in self._ring):
+            return True
+        return self._dispatch_blocking and bool(self._ring)
 
     def _emit_fast_window(self, emit_args) -> None:
         bufs, doc_ids_r, rows, cols, row_seq, row_msn = emit_args
@@ -3059,12 +3160,15 @@ class TpuSequencerLambda(IPartitionLambda):
                 increment("serving.ring_gc_deferred")
 
     def drain(self) -> None:
-        """Finish EVERY deferred fast window, oldest first: join each
-        result transfer, then nacks, overflow recovery, the flush's
-        batched emit, and its checkpoint — always on the caller's thread,
-        so lane stores are never touched concurrently. A completed full
-        drain clears the overflow quarantine: every window that could
-        carry a quarantined channel's ops has re-applied them."""
+        """Finish EVERY deferred fast window, oldest first: dispatch any
+        staged burst, then join each result transfer, then nacks,
+        overflow recovery, the flush's batched emit, and its checkpoint
+        — always on the caller's thread, so lane stores are never
+        touched concurrently. A completed full drain clears the overflow
+        quarantine: every window that could carry a quarantined
+        channel's ops has re-applied them."""
+        if self._staged:
+            self._dispatch_staged_burst()
         while self._ring:
             self._drain_one()
         if self._ring_fixup or self._ring_fixup_lww:
@@ -3072,8 +3176,14 @@ class TpuSequencerLambda(IPartitionLambda):
             self._ring_fixup_lww.clear()
 
     def _drain_one(self) -> None:
-        """Retire the OLDEST in-flight window (FIFO: emits and lane
-        mutations must land in dispatch order)."""
+        """Retire the OLDEST in-flight ring entry (FIFO: emits and lane
+        mutations must land in dispatch order). Staged windows dispatch
+        FIRST: retiring an entry can run a recovery that moves lanes,
+        and staged windows' packed placements must reach the device
+        before any move (their results then ride the same quarantine
+        fixup every later in-flight window does)."""
+        if self._staged:
+            self._dispatch_staged_burst()
         ctx = self._ring.popleft()
         increment("serving.ring_drains")
         _t0 = time.perf_counter()
@@ -3086,7 +3196,32 @@ class TpuSequencerLambda(IPartitionLambda):
                             hist="serving.readback", deferred=True)
         if "error" in ctx:
             raise ctx["error"]
-        self._finish_window(ctx)
+        wins = ctx.get("burst")
+        if wins is None:
+            self._finish_window(ctx)
+            self._retire_window(ctx)
+            return
+        # A burst entry: ONE stacked readback finishes its K windows in
+        # stage order; windows with burst siblings still behind them
+        # quarantine any recovery exactly as if the siblings were ring
+        # entries. Dispatches-per-burst (1 scan + any recovery re-runs
+        # its windows' finish triggers) feeds the burst histogram — the
+        # figure the fused-smoke grades at <= 2.
+        rec0 = counter_get("serving.recovery_dispatches")
+        for k, wd in enumerate(wins):
+            wd["flat"] = ctx["flat"][k]
+            wd["burst_more"] = k + 1 < len(wins)
+            self._finish_window(wd)
+            self._retire_window(wd)
+        dispatches = 1.0 + (counter_get("serving.recovery_dispatches")
+                            - rec0)
+        increment("serving.burst_dispatch_total", dispatches)
+        from ..telemetry.counters import observe as _observe
+        _observe("serving.dispatches_per_burst", dispatches)
+
+    def _retire_window(self, ctx) -> None:
+        """The emit + checkpoint tail of a finished window (only the
+        flush-final window of a multi-window flush carries emit_args)."""
         if "emit_args" not in ctx:
             return  # a non-final window of a multi-window flush
         self._emit_fast_window(ctx["emit_args"])
@@ -3339,34 +3474,37 @@ class TpuSequencerLambda(IPartitionLambda):
         gauge("serving.window_t", float(T))
         return T, depth
 
-    def _dispatch_fast_window(self, parsed, backlog, rows, lanes, slot, T,
-                              mbase, chan_ok, chan_b, chan_l,
-                              vbase, lchan_ok, lchan_b, lchan_l,
-                              row_seq, flush_sel, row_msn,
-                              defer: bool = False,
-                              donate: bool = False) -> None:
-        """One fast window: staging + ONE fused device dispatch, then
-        either an immediate result fetch (_finish_window) or — pipelined —
-        a background transfer joined by the next flush's drain().
-        `rows`/`lanes`/`slot` are aligned arrays for this window's
-        messages, in arrival order."""
+    def _probe_fused(self) -> None:
+        """Lazy first-dispatch probe: can this backend lower the fused
+        VMEM apply (and its INSERT_RUN variant)?"""
+        if self._fused_serve is not None:
+            return
+        from ..mergetree.pallas_apply import (fused_available,
+                                             fused_runs_available)
+        import jax as _jax
+        base = (_jax.default_backend() in ("tpu", "axon")
+                and fused_available())
+        if base and self.pack_runs and not fused_runs_available():
+            # The INSERT_RUN Mosaic variant failed to lower on this
+            # backend: keep the fused kernel (the round-3 lever) and
+            # drop packing rather than forfeit fused for scan+runs.
+            self.pack_runs = False
+        self._fused_serve = base
+
+    def _stage_fast_window(self, parsed, rows, lanes, slot, T,
+                           mbase, chan_ok, chan_b, chan_l,
+                           vbase, lchan_ok, lchan_b, lchan_l,
+                           row_seq, flush_sel, row_msn,
+                           donate: bool = False) -> dict:
+        """Pack one fast window into host staging arrays + job records —
+        everything the dispatch needs EXCEPT the device call, so a
+        window can sit in the staged-burst queue across flushes. The
+        in-flight occupancy bound (hint_pending) is charged HERE: later
+        flushes' fit proofs must see staged windows' worst-case rows
+        whether or not they have dispatched yet."""
         from . import pump as P
-        from . import serve_step
         cols = parsed.cols
         B = self.lanes
-
-        if self._fused_serve is None:
-            from ..mergetree.pallas_apply import (fused_available,
-                                                 fused_runs_available)
-            import jax as _jax
-            base = (_jax.default_backend() in ("tpu", "axon")
-                    and fused_available())
-            if base and self.pack_runs and not fused_runs_available():
-                # The INSERT_RUN Mosaic variant failed to lower on this
-                # backend: keep the fused kernel (the round-3 lever) and
-                # drop packing rather than forfeit fused for scan+runs.
-                self.pack_runs = False
-            self._fused_serve = base
 
         with tracing.span("serving.pack", hist="serving.pack",
                           stage="window-staging"):
@@ -3382,12 +3520,104 @@ class TpuSequencerLambda(IPartitionLambda):
             lww_jobs = self._build_lww(parsed, rows, lanes, slot,
                                        vbase, lchan_ok, lchan_b, lchan_l)
 
+        # In-flight occupancy bound: each staged merge op adds at most 2
+        # rows, each LWW op at most one key slot; confirmed exactly (and
+        # removed from pending) when this window's occupancy plane comes
+        # back at its drain.
+        for j in merge_jobs:
+            np.add.at(self.merge.buckets[j["bucket"]].hint_pending,
+                      j["lanes"], 2)
+        for j in lww_jobs:
+            np.add.at(self.lww.buckets[j["bucket"]].hint_pending,
+                      j["lanes"], 1)
+
+        return {"parsed": parsed, "B": B, "T": T, "rows": rows,
+                "lanes": lanes, "slot": slot,
+                "idx": np.flatnonzero(flush_sel),
+                "ticket_cols": ticket_cols,
+                "merge_jobs": merge_jobs, "lww_jobs": lww_jobs,
+                "mbase": mbase, "block": self._flush_merge_block,
+                "row_seq": row_seq, "row_msn": row_msn,
+                "donated": donate,
+                # Staged placements go stale the moment a recovery moves
+                # lanes; the GC/drain discipline guarantees gen cannot
+                # move while a window sits staged (staged bursts always
+                # dispatch before any join/recovery), so stage-time gen
+                # IS dispatch-time gen.
+                "gen": self._recovery_gen,
+                # The offsets THIS window covers: drain() must commit
+                # exactly these — the live _pending_offset may already
+                # include a newer, not-yet-dispatched backlog.
+                "offset": self._pending_offset,
+                # The flush's trace position, so the deferred readback
+                # (joined by a LATER flush's drain) attributes to the
+                # window that dispatched it, not the one that drained it.
+                "trace_ctx": tracing.current(),
+                # Degrade-path restage context (the fused INSERT_RUN
+                # variant failing at a production shape re-builds the
+                # merge jobs without packing): the originating flush's
+                # row universe, valid cross-flush because the arrays are
+                # immutable snapshots.
+                "rebuild": (rows, lanes, slot, mbase, chan_ok, chan_b,
+                            chan_l, self._flush_merge_rows)}
+
+    def _pad_staged_window(self, wd: dict) -> None:
+        """Re-shape a staged window's cols to CURRENT table widths: doc
+        lanes and bucket lanes may have grown (new docs/channels in a
+        later flush) between staging and dispatch. Growth only appends
+        lanes, so zero-padding the lane axis (NOOP rows) is exact; T/Tm
+        never change after staging."""
+        B = self.lanes
+        tc = wd["ticket_cols"]
+        if tc.shape[1] < B:
+            grown = np.zeros((4, B, tc.shape[2]), np.int32)
+            grown[1] = -1
+            grown[:, :tc.shape[1], :] = tc
+            wd["ticket_cols"] = grown
+        wd["B"] = B
+        for j in wd["merge_jobs"]:
+            bucket = self.merge.buckets[j["bucket"]]
+            c = j["cols"]
+            if c is not None and c.shape[1] < bucket.lanes:
+                grown = np.zeros((12, bucket.lanes, c.shape[2]), np.int32)
+                grown[:, :c.shape[1], :] = c
+                j["cols"] = grown
+                if j["runs"] is not None:
+                    r = j["runs"]
+                    rg = np.zeros((4, bucket.lanes) + r.shape[2:],
+                                  np.int32)
+                    rg[:, :r.shape[1]] = r
+                    j["runs"] = rg
+            j["lanes_n"] = bucket.lanes
+        for j in wd["lww_jobs"]:
+            bucket = self.lww.buckets[j["bucket"]]
+            c = j["cols"]
+            if c is not None and c.shape[1] < bucket.lanes:
+                grown = np.zeros((6, bucket.lanes, c.shape[2]), np.int32)
+                grown[1] = -1
+                grown[2] = -1
+                grown[:, :c.shape[1], :] = c
+                j["cols"] = grown
+            j["lanes_n"] = bucket.lanes
+
+    def _dispatch_staged_window(self, wd: dict, defer: bool) -> None:
+        """Dispatch ONE staged window: the fused device program, then
+        either an immediate result fetch (_finish_window) or — pipelined
+        — a background transfer joined by the next drain()."""
+        from . import serve_step
+        self._probe_fused()
+        self._pad_staged_window(wd)
+        donate = wd["donated"]
+        merge_jobs, lww_jobs = wd["merge_jobs"], wd["lww_jobs"]
+        ticket_cols = wd["ticket_cols"]
+
         # Buffer donation (decided by _assess_windows' occupancy-hint fit
         # proof): donated windows update lane states in place — no fresh
         # HBM allocation per window; kept windows retain the pre states
         # the fold/rescue rollback scatters back.
         increment("serving.ring_donated_windows" if donate
                   else "serving.ring_kept_windows")
+        increment("serving.window_dispatches")
 
         # ONE fused device program for the whole window (every extra
         # dispatch is a serialized tunnel RPC), then ONE host sync of the
@@ -3432,9 +3662,8 @@ class TpuSequencerLambda(IPartitionLambda):
                     logging.getLogger(__name__).warning(
                         "fused INSERT_RUN variant failed at a production "
                         "shape; disabling run packing (%r)", err)
-                    merge_jobs = self._build_merge(parsed, rows, lanes,
-                                                   slot, mbase, chan_ok,
-                                                   chan_b, chan_l)
+                    merge_jobs = self._restage_merge_jobs(wd)
+                    wd["merge_jobs"] = merge_jobs
                     try:
                         (self.tstate, new_merge, new_lww, flat_dev,
                          msn32_dev) = dispatch(self._fused_serve)
@@ -3460,57 +3689,231 @@ class TpuSequencerLambda(IPartitionLambda):
                 # drop the stale reference so a recovery bug trips the
                 # explicit pre-is-None degrade, not a deleted-buffer read.
                 j["pre"] = None
-            # In-flight occupancy bound: each staged op adds at most 2
-            # rows; confirmed exactly (and removed from pending) when
-            # this window's occupancy plane comes back at drain.
-            np.add.at(self.merge.buckets[j["bucket"]].hint_pending,
-                      j["lanes"], 2)
         for j, post in zip(lww_jobs, new_lww):
             self.lww.buckets[j["bucket"]].state = post
             if donate:
                 j["pre"] = None
-            # Each staged op can occupy at most one new key slot.
-            np.add.at(self.lww.buckets[j["bucket"]].hint_pending,
-                      j["lanes"], 1)
 
-        ctx = {"parsed": parsed, "B": B, "T": T, "rows": rows,
-               "lanes": lanes, "slot": slot,
-               "idx": np.flatnonzero(flush_sel),
-               "merge_jobs": merge_jobs, "lww_jobs": lww_jobs,
-               "mbase": mbase, "block": self._flush_merge_block,
-               "row_seq": row_seq, "row_msn": row_msn,
-               "msn32_dev": msn32_dev, "donated": donate,
-               "gen": self._recovery_gen,
-               # The offsets THIS window covers: drain() must commit
-               # exactly these — the live _pending_offset may already
-               # include a newer, not-yet-dispatched backlog.
-               "offset": self._pending_offset,
-               # The flush's trace position, so the deferred readback
-               # (joined by a LATER flush's drain) attributes to the
-               # window that dispatched it, not the one that drained it.
-               "trace_ctx": tracing.current()}
+        wd["msn32_dev"] = msn32_dev
         if defer:
             import threading
 
             def fetch():
                 try:
-                    ctx["flat"] = np.asarray(flat_dev)
+                    wd["flat"] = np.asarray(flat_dev)
                 except Exception as err:  # noqa: BLE001 — surface at join
-                    ctx["error"] = err
+                    wd["error"] = err
 
-            ctx["thread"] = threading.Thread(target=fetch, daemon=True)
-            ctx["thread"].start()
-            self._ring.append(ctx)
-            increment("serving.ring_windows_deferred")
+            wd["thread"] = threading.Thread(target=fetch, daemon=True)
+            wd["thread"].start()
+            self._ring.append(wd)
+            if not wd.pop("counted_deferred", False):
+                increment("serving.ring_windows_deferred")
         else:
             with tracing.span("serving.readback",
                               hist="serving.readback"):
-                ctx["flat"] = np.asarray(flat_dev)  # the window's ONE sync
-            self._finish_window(ctx)
+                wd["flat"] = np.asarray(flat_dev)  # the window's ONE sync
+            self._finish_window(wd)
+
+    def _restage_merge_jobs(self, wd: dict) -> List[dict]:
+        """Rebuild a staged window's merge jobs (degrade path: packing
+        just turned off) against its ORIGINATING flush's row universe,
+        preserving the hint_pending charge (same rows, same lanes, same
+        +2-per-op bound — no re-add)."""
+        (rows, lanes, slot, mbase, chan_ok, chan_b, chan_l,
+         flush_rows) = wd["rebuild"]
+        return self._build_merge(wd["parsed"], rows, lanes, slot, mbase,
+                                 chan_ok, chan_b, chan_l,
+                                 flush_rows=flush_rows)
+
+    def _dispatch_staged_burst(self) -> None:
+        """Dispatch EVERY staged window, oldest first, as fused scan
+        bursts: consecutive staged windows sharing a ticket depth T
+        chunk into scan lengths from the fixed grid (compile-cache
+        bound); a remainder of one dispatches through plain
+        serve_window. Always empties the staged queue — callers rely on
+        'staged dispatched before any join' to keep recovery
+        quarantine's window-ordering invariant."""
+        staged, self._staged = self._staged, []
+        i = 0
+        while i < len(staged):
+            # Longest same-T run from i (T is baked into the stacked
+            # ticket planes and the flat16 layout).
+            run = i + 1
+            while (run < len(staged)
+                   and staged[run]["T"] == staged[i]["T"]):
+                run += 1
+            while i < run:
+                left = run - i
+                k = 1
+                for cand in self._burst_k_grid:
+                    if cand <= left:
+                        k = cand
+                if k >= 2:
+                    if not self._dispatch_burst_chunk(staged[i:i + k]):
+                        # Lowering failed (counted/logged there): fall
+                        # back to per-window dispatch for this chunk.
+                        for wd in staged[i:i + k]:
+                            self._dispatch_staged_window(wd, defer=True)
+                else:
+                    self._dispatch_staged_window(staged[i], defer=True)
+                i += k
+
+    def _dispatch_burst_chunk(self, wins: List[dict]) -> bool:
+        """ONE scanned device program for K staged windows: stack every
+        window's packed op planes (NOOP-padded to the union of staged
+        buckets), dispatch serve_burst with the donated lane-bucket
+        carry, and enter the ring as a single entry whose drain finishes
+        all K windows off the stacked narrow result. Returns False if
+        the burst program failed to lower (donated buffers intact — the
+        caller falls back to per-window dispatch)."""
+        from . import serve_step
+        self._probe_fused()
+        K = len(wins)
+        for wd in wins:
+            self._pad_staged_window(wd)
+        B, T = self.lanes, wins[0]["T"]
+
+        with tracing.span("serving.pack", hist="serving.pack",
+                          stage="burst-stack"):
+            # Every member passed _pad_staged_window, so each
+            # ticket_cols is exactly [4, B, T] and fills its full slice.
+            tx = np.empty((K, 4, B, T), np.int32)
+            for k, wd in enumerate(wins):
+                tx[k] = wd["ticket_cols"]
+
+            def stack_jobs(job_lists, buckets, ncols, fills):
+                """Union-bucket stacking: per bucket one [K, ncols,
+                lanes, Tm] plane (+ runs for merge); windows without the
+                bucket ride all-NOOP padding, and every window's job
+                list is rewritten union-aligned so _finish_window parses
+                the shared flat16 layout."""
+                ids = sorted({j["bucket"] for jl in job_lists for j in jl})
+                xs, rxs, states = [], [], []
+                aligned: List[List[dict]] = [[] for _ in wins]
+                for b in ids:
+                    bucket = buckets[b]
+                    jobs = [next((j for j in jl if j["bucket"] == b),
+                                 None) for jl in job_lists]
+                    tm = max(j["cols"].shape[2] for j in jobs
+                             if j is not None)
+                    arr = np.zeros((K, ncols, bucket.lanes, tm), np.int32)
+                    for plane, fill in fills:
+                        arr[:, plane] = fill
+                    has_runs = any(j is not None and j.get("runs")
+                                   is not None for j in jobs)
+                    rarr = None
+                    if has_runs:
+                        from ..mergetree.oppack import RUN_K
+                        rarr = np.zeros((K, 4, bucket.lanes, tm, RUN_K),
+                                        np.int32)
+                    for k, j in enumerate(jobs):
+                        if j is None:
+                            aligned[k].append(self._empty_job(
+                                b, bucket.lanes))
+                            continue
+                        c = j["cols"]
+                        arr[k, :, :c.shape[1], :c.shape[2]] = c
+                        if rarr is not None and j.get("runs") is not None:
+                            r = j["runs"]
+                            rarr[k, :, :r.shape[1], :r.shape[2], :] = r
+                        aligned[k].append(j)
+                    xs.append(self._place_cols(arr, lane_axis=2))
+                    rxs.append(None if rarr is None else
+                               self._place_cols(rarr, lane_axis=2))
+                    states.append(bucket.state)
+                return ids, xs, rxs, states, aligned
+
+            m_ids, merge_xs, runs_xs, merge_states, m_aligned = stack_jobs(
+                [wd["merge_jobs"] for wd in wins], self.merge.buckets,
+                12, ())
+            l_ids, lww_xs, _, lww_states, l_aligned = stack_jobs(
+                [wd["lww_jobs"] for wd in wins], self.lww.buckets,
+                6, ((1, -1), (2, -1)))
+
+        with tracing.span("serving.dispatch", hist="serving.dispatch"):
+            try:
+                (self.tstate, new_merge, new_lww, flats_dev,
+                 msns_dev) = serve_step.serve_burst(
+                    self.tstate, tuple(merge_states), tuple(lww_states),
+                    self._place_cols(tx, lane_axis=2), tuple(merge_xs),
+                    tuple(lww_xs), tuple(runs_xs), self._fused_serve)
+            except Exception as err:  # noqa: BLE001 — degrade, never crash
+                # Lowering failures leave the donated buffers intact
+                # (same contract as the per-window degrade ladder); the
+                # per-window fallback then runs its own fused degrade.
+                # Job lists are still the windows' OWN (union alignment
+                # is adopted only below, on success), so serve_window
+                # re-dispatches them unchanged. A POST-lowering failure
+                # (device OOM mid-scan) may have consumed the donated
+                # carry, though — falling back onto deleted/corrupt lane
+                # buffers would materialize garbage, so probe for it and
+                # re-raise: that failure mode has no safe recovery.
+                def _gone(tree):
+                    leaf = jax.tree_util.tree_leaves(tree)
+                    return bool(leaf) and bool(
+                        getattr(leaf[0], "is_deleted", bool)())
+                if (_gone(self.tstate) or any(map(_gone, merge_states))
+                        or any(map(_gone, lww_states))):
+                    raise
+                import logging
+                increment("serving.burst_fallbacks")
+                logging.getLogger(__name__).warning(
+                    "fused burst scan failed at K=%d; dispatching the "
+                    "chunk per-window (%r)", K, err)
+                return False
+        for k, wd in enumerate(wins):
+            # Union-aligned job lists: each window's _finish_window
+            # parses the SHARED flat16 layout (one plane set per union
+            # bucket), so its jobs must cover every union bucket in
+            # order — placeholders for buckets it never staged.
+            wd["merge_jobs"] = m_aligned[k]
+            wd["lww_jobs"] = l_aligned[k]
+        for b, post in zip(m_ids, new_merge):
+            self.merge.buckets[b].state = post
+        for b, post in zip(l_ids, new_lww):
+            self.lww.buckets[b].state = post
+        for k, wd in enumerate(wins):
+            for j in wd["merge_jobs"] + wd["lww_jobs"]:
+                # The scan carry was donated: no per-window pre states
+                # exist (burst admission proved the windows overflow-
+                # free; unpredicted overflow takes the donated degrade
+                # + quarantine path, exactly as per-window donation).
+                j["pre"] = None
+            wd["msn32_dev"] = msns_dev[k]
+        increment("serving.ring_donated_windows", K)
+        increment("serving.bursts")
+        increment("serving.burst_windows", K)
+
+        entry = {"burst": wins, "n_windows": K,
+                 "trace_ctx": wins[-1]["trace_ctx"]}
+        import threading
+
+        def fetch():
+            try:
+                entry["flat"] = np.asarray(flats_dev)  # [K, flat] D2H
+            except Exception as err:  # noqa: BLE001 — surface at join
+                entry["error"] = err
+
+        entry["thread"] = threading.Thread(target=fetch, daemon=True)
+        entry["thread"].start()
+        self._ring.append(entry)
+        return True
+
+    @staticmethod
+    def _empty_job(bucket: int, lanes_n: int) -> dict:
+        """A window's placeholder for a union bucket it never staged:
+        zero rows, so _finish_window's hint/recovery walks are no-ops,
+        but lanes_n keeps the shared flat16 plane layout parseable."""
+        z = np.zeros(0, np.int64)
+        return {"bucket": bucket, "pre": None, "cols": None, "runs": None,
+                "lanes_n": lanes_n, "chan": z, "rows": z, "lanes": z,
+                "op_ids": z, "val_ids": z, "doc_lane": z, "slot": z}
 
     def _assess_windows(self, parsed, n_windows: int,
                         merge_all, win_m, chan_ok, chan_b, chan_l,
-                        win_l, lchan_ok, lchan_b, lchan_l):
+                        win_l, lchan_ok, lchan_b, lchan_l,
+                        start_w: int = 0):
         """Per-window (risky, donate_ok) from the host occupancy hints.
 
         risky[w]: some staged lane's ROW fit cannot be proven —
@@ -3523,49 +3926,62 @@ class TpuSequencerLambda(IPartitionLambda):
         touch the overlap ring and annotates the anno ring, neither
         bounded by the count hint, so those windows keep their pre
         states (their rare exhaustion overflow needs the rollback). The
-        margins mirror the recovery paths' +8 re-run slack convention."""
+        margins mirror the recovery paths' +8 re-run slack convention.
+
+        The bound ACCUMULATES across this flush's windows (from
+        `start_w`, where earlier windows' charges already live in
+        hint_pending): window w's fit proof counts windows start_w..w-1
+        worst-case rows on the shared lanes, because none of them will
+        have confirmed occupancy before w dispatches — with fused bursts
+        a whole run of windows dispatches in one scan before ANY plane
+        comes back, so a per-window-only bound would under-count deep
+        docs and break the donated-dispatch soundness invariant."""
         from . import pump as P
         cols = parsed.cols
         risky = np.zeros(n_windows, bool)
         donate_ok = np.ones(n_windows, bool)
-        if merge_all.size:
-            mk = cols[P.MKIND, merge_all]
-            for w in range(n_windows):
+        acc_m: Dict[int, np.ndarray] = {}
+        acc_l: Dict[int, np.ndarray] = {}
+        mk = cols[P.MKIND, merge_all] if merge_all.size else None
+        for w in range(start_w, n_windows):
+            if mk is not None:
                 ws = chan_ok & (win_m == w)
-                if not ws.any():
-                    continue
-                if np.any(mk[ws] != 1):
-                    donate_ok[w] = False
-                for b in np.unique(chan_b[ws]).tolist():
-                    bucket = self.merge.buckets[b]
-                    bsel = ws & (chan_b == b)
-                    ins = np.bincount(chan_l[bsel & (mk == 1)],
-                                      minlength=bucket.lanes)
-                    touched = np.unique(chan_l[bsel])
-                    bound = bucket.count_hint[touched] \
-                        + bucket.hint_pending[touched]
-                    if np.any(bound + 2 * ins[touched] + 8
-                              > bucket.capacity):
-                        risky[w] = True
-                        break
-        if lchan_ok.size:
-            lchans_l = lchan_l
-            for w in range(n_windows):
+                if ws.any():
+                    if np.any(mk[ws] != 1):
+                        donate_ok[w] = False
+                    for b in np.unique(chan_b[ws]).tolist():
+                        bucket = self.merge.buckets[b]
+                        bsel = ws & (chan_b == b)
+                        ins = np.bincount(chan_l[bsel & (mk == 1)],
+                                          minlength=bucket.lanes)
+                        touched = np.unique(chan_l[bsel])
+                        acc = acc_m.setdefault(
+                            b, np.zeros(bucket.lanes, np.int64))
+                        bound = bucket.count_hint[touched] \
+                            + bucket.hint_pending[touched] \
+                            + acc[touched]
+                        if np.any(bound + 2 * ins[touched] + 8
+                                  > bucket.capacity):
+                            risky[w] = True
+                        acc += 2 * ins
+            if lchan_ok.size:
                 ws = lchan_ok & (win_l == w)
-                if not ws.any():
-                    continue
-                for b in np.unique(lchan_b[ws]).tolist():
-                    bucket = self.lww.buckets[b]
-                    bsel = ws & (lchan_b == b)
-                    per = np.bincount(lchans_l[bsel],
-                                      minlength=bucket.lanes)
-                    touched = np.unique(lchans_l[bsel])
-                    bound = bucket.count_hint[touched] \
-                        + bucket.hint_pending[touched]
-                    if np.any(bound + per[touched] + 4
-                              > bucket.capacity):
-                        risky[w] = True
-                        break
+                if ws.any():
+                    for b in np.unique(lchan_b[ws]).tolist():
+                        bucket = self.lww.buckets[b]
+                        bsel = ws & (lchan_b == b)
+                        per = np.bincount(lchan_l[bsel],
+                                          minlength=bucket.lanes)
+                        touched = np.unique(lchan_l[bsel])
+                        acc = acc_l.setdefault(
+                            b, np.zeros(bucket.lanes, np.int64))
+                        bound = bucket.count_hint[touched] \
+                            + bucket.hint_pending[touched] \
+                            + acc[touched]
+                        if np.any(bound + per[touched] + 4
+                                  > bucket.capacity):
+                            risky[w] = True
+                        acc += per
         donate_ok &= ~risky
         return risky, donate_ok
 
@@ -3729,7 +4145,12 @@ class TpuSequencerLambda(IPartitionLambda):
             bit_i = 1  # bits[0] is the ticket-table invariant bit
             recovered = 0
             plane_off = 0
-            ring_behind = bool(self._ring)
+            # Quarantine direction: anything dispatched AFTER this
+            # window — later ring entries, staged windows, or the rest
+            # of this window's own burst (burst_more) — holds device
+            # results computed from pre-recovery rows.
+            ring_behind = bool(self._ring) or bool(self._staged) \
+                or bool(ctx.get("burst_more"))
             fixup_merge: Dict[tuple, List[HostOp]] = {}
             fixup_lww: Dict[tuple, List[tuple]] = {}
             for job in merge_jobs:
@@ -3786,13 +4207,17 @@ class TpuSequencerLambda(IPartitionLambda):
                 _frsp.set(recovered_jobs=recovered)
 
     def _build_merge(self, parsed, rows, lanes, slot,
-                     mbase, chan_ok, chan_b, chan_l):
+                     mbase, chan_ok, chan_b, chan_l, flush_rows=None):
         """Per-bucket merge window staging ([12, lanes, Tm]: 10 PackedOps
         columns + doc_idx + t_idx, one array => one H2D); returns job
-        records carrying what the (rare) recovery path needs."""
+        records carrying what the (rare) recovery path needs.
+        `flush_rows` overrides the live flush's merge-row universe (the
+        staged-window degrade restage, which may run after a LATER flush
+        overwrote self._flush_merge_rows)."""
         from . import pump as P
         cols = parsed.cols
-        flush_rows = self._flush_merge_rows
+        if flush_rows is None:
+            flush_rows = self._flush_merge_rows
         in_window = np.isin(flush_rows, rows)
         sel = in_window & chan_ok
         jobs = []
@@ -3967,11 +4392,14 @@ class TpuSequencerLambda(IPartitionLambda):
         if not lane_ops:
             return
         self._recovery_gen += 1
+        increment("serving.recovery_dispatches")
         if job["pre"] is None:
             # Donated window flagged overflow: the gate's fit proof was
-            # wrong (hint bug) and the pre rows are gone. Degrade the
-            # affected channels to opaque instead of materializing
-            # corrupt state — loudly, this is an invariant break.
+            # wrong (hint bug) or the overflow was structurally
+            # unpredictable (bad insert position, a nacked INSERT_RUN
+            # member) and the pre rows are gone. Degrade the affected
+            # channels to opaque instead of materializing corrupt state
+            # — loudly.
             self._degrade_donated_merge(b, sorted(lane_ops))
             return
         if quarantine:
@@ -4062,6 +4490,7 @@ class TpuSequencerLambda(IPartitionLambda):
         if not lane_ops:
             return
         self._recovery_gen += 1
+        increment("serving.recovery_dispatches")
         if job["pre"] is None:
             import logging
             increment("sequencer.donated_overflow")

@@ -91,6 +91,25 @@ class TestLadder:
         d = ctl.admit("t")
         assert d.admitted and d.state == ACCEPT
 
+    def test_burst_window_fill_clamps_and_never_throttles_alone(self):
+        """occupancy_hints is WINDOW-counted since fused bursts: a
+        K-window scan in flight reports fill K over depth 4, a ratio
+        far above 1. The controller must clamp the fill fraction at
+        "full" — damped ring pressure then tops out at 0.45, below the
+        0.5 THROTTLE threshold, so bursting by design cannot throttle
+        on its own — while a saturated fill keeps the latency term
+        live (the empty-queue + sub-full-ring zeroing must NOT kick
+        in)."""
+        ctl, clock, _ = make_ctl()
+        hints = {"staged_ops": 0, "ring_occupancy": 32, "ring_depth": 4}
+        ctl.add_source("seq", hints=lambda: hints)
+        clock.tick()
+        ctl.observe(force=True)
+        s = ctl.status()
+        assert s["ringOccupancyFrac"] == 1.0   # clamped, not 8.0
+        assert s["state"] == ACCEPT            # 0.45 damped < THROTTLE
+        assert s["pressure"] <= 0.45 + 1e-9
+
     def test_escalates_through_every_state(self):
         ctl, clock, depth = make_ctl()
         observe_at(ctl, clock, depth, 600)

@@ -307,3 +307,202 @@ class TestOccupancyHints:
         from fluidframework_tpu.parallel.mesh import make_mesh
         assert _lam().donate_lane_states is True
         assert _lam(mesh=make_mesh(sp=1)).donate_lane_states is False
+
+
+def _keystroke_waves(n_waves=10, docs=4, ops=3, bad_flush=None,
+                     bad_pos=None):
+    """Shallow per-doc keystroke waves (one window per flush) so staged
+    windows accumulate into scan bursts. `bad_flush` injects one insert
+    at an impossible position (`bad_pos` beyond the doc's length) on an
+    extra channel-owning doc — structurally unpredictable overflow the
+    occupancy-hint fit proof cannot see."""
+    waves = []
+    csn = {d: 0 for d in range(docs)}
+    bad_csn = 0
+    for w in range(n_waves):
+        wave = []
+        for d in range(docs):
+            doc = f"k{d}"
+            msgs = [] if w else [_join(f"c{d}")]
+            for _ in range(ops):
+                csn[d] += 1
+                msgs.append(_insert(csn[d], 0, f"{csn[d] % 10}"))
+            wave.append((doc, Boxcar("t", doc, f"c{d}", msgs)))
+        if bad_flush is not None:
+            msgs = [] if w else [_join("cbad")]
+            bad_csn += 1
+            pos = bad_pos if w == bad_flush else 0
+            msgs.append(_insert(bad_csn, pos, "X"))
+            wave.append(("kbad", Boxcar("t", "kbad", "cbad", msgs)))
+        waves.append(wave)
+    return waves
+
+
+class TestFusedBursts:
+    """The fused serving burst (docs/serving_pipeline.md R8): staged
+    windows leave as ONE lax.scan per burst, bit-identical to the sync
+    and per-window ring paths — emit order, lane planes, and recovery
+    semantics included."""
+
+    def _run(self, waves, pipelined, bursts=True, risky_hook=False,
+             stall=None, **lam_kw):
+        emits = []
+        lam = _lam(lambda d, m: emits.append(_emit_key(d, m)), **lam_kw)
+        lam.pipelined = pipelined
+        lam.fused_bursts = bursts
+        if risky_hook:
+            lam.defer_risky_windows = True
+        if stall is not None:
+            lam.stall_hook = stall
+        _drive(lam, waves, emits)
+        return lam, emits
+
+    def test_burst_bit_identical_to_sync_and_ring(self):
+        """Clean multi-flush keystroke traffic: scanned bursts must
+        reproduce the sync path EXACTLY — stream order, text, and the
+        device lane planes — and actually fuse more than one window per
+        dispatch."""
+        waves = _keystroke_waves(n_waves=10)
+        counters.reset()
+        sync_lam, sync_emits = self._run(waves, pipelined=False)
+        ring_lam, ring_emits = self._run(waves, pipelined=True,
+                                         bursts=False)
+        counters.reset()
+        burst_lam, burst_emits = self._run(waves, pipelined=True)
+        assert counters.get("serving.bursts") > 0
+        assert counters.get("serving.burst_windows") >= \
+            2 * counters.get("serving.bursts")
+        assert sync_emits == burst_emits  # order included
+        assert ring_emits == burst_emits
+        for d in range(4):
+            key = (f"k{d}", "s", "t")
+            assert sync_lam.channel_text(*key) == \
+                burst_lam.channel_text(*key)
+            assert sync_lam.merge.where[key] == \
+                burst_lam.merge.where[key]
+            a = _merge_rows(sync_lam, key)
+            b = _merge_rows(burst_lam, key)
+            for name in ("length", "ins_seq", "ins_client", "rem_seq",
+                         "count", "min_seq", "seq"):
+                np.testing.assert_array_equal(
+                    np.asarray(getattr(a, name)),
+                    np.asarray(getattr(b, name)),
+                    err_msg=f"{key} plane {name} diverged")
+
+    def test_mid_burst_overflow_quarantine(self):
+        """An insert at an impossible position (beyond the doc's
+        visible length) flags overflow on a window every fit proof
+        cleared — mid-burst, with sibling windows behind it in the SAME
+        scan. The donated degrade must quarantine the channel, void the
+        later windows' device results for it, and keep the emitted
+        stream identical to the sync path (which degrades the same
+        window the same way)."""
+        waves = _keystroke_waves(n_waves=8, bad_flush=3, bad_pos=500)
+        counters.reset()
+        _, sync_emits = self._run(waves, pipelined=False)
+        sync_degrades = counters.get("sequencer.donated_overflow")
+        counters.reset()
+        burst_lam, burst_emits = self._run(waves, pipelined=True)
+        assert counters.get("serving.bursts") > 0
+        assert counters.get("sequencer.donated_overflow") > 0
+        assert counters.get("sequencer.donated_overflow") == \
+            sync_degrades
+        # The degraded channel's later rows re-applied host-side (the
+        # quarantine fixup) instead of trusting the scan's results.
+        assert counters.get("serving.ring_fixups") > 0
+        assert sync_emits == burst_emits  # order included
+        # The healthy fleet is untouched by the neighbor's degrade.
+        sync_lam, _ = self._run(waves, pipelined=False)
+        for d in range(4):
+            key = (f"k{d}", "s", "t")
+            assert sync_lam.channel_text(*key) == \
+                burst_lam.channel_text(*key)
+        assert (("kbad", "s", "t") in burst_lam.merge.opaque) == \
+            (("kbad", "s", "t") in sync_lam.merge.opaque)
+
+    def test_defer_risky_windows_forces_burst_breakup(self):
+        """The chaos hook defers hint-risky windows per-window (they
+        keep pre states for the forced rollback) — a risky window
+        landing mid-accumulation must BREAK the burst: staged windows
+        flush as their own scan, the risky window rides the ring, and
+        the stream still matches sync."""
+        waves = _deep_ragged_waves(n_waves=8, deep_ops=8)
+        counters.reset()
+        _, sync_emits = self._run(
+            waves, pipelined=False,
+            merge_store=MergeLaneStore(capacities=(4, 16, 64)),
+            t_buckets=(1, 4))
+        counters.reset()
+        _, burst_emits = self._run(
+            waves, pipelined=True, risky_hook=True,
+            merge_store=MergeLaneStore(capacities=(4, 16, 64)),
+            t_buckets=(1, 4))
+        assert counters.get("serving.burst_breaks") > 0
+        assert sync_emits == burst_emits  # order included
+
+    def test_faultplan_stall_during_burst_is_deterministic(self):
+        """A FaultPlan device stall firing while bursts accumulate must
+        reproduce bit-identically from its seed: same fault trace
+        fingerprint, same emitted stream, run twice."""
+        from fluidframework_tpu.testing import faultinject
+
+        def once():
+            plan = faultinject.FaultPlan(seed=1234, stall=1.0,
+                                         stall_range_ms=(0.1, 0.4))
+            waves = _keystroke_waves(n_waves=8)
+            counters.reset()
+            _, emits = self._run(
+                waves, pipelined=True,
+                stall=lambda: faultinject.stall(plan))
+            return emits, plan.fingerprint(), \
+                counters.get("serving.bursts")
+
+        emits_a, fp_a, bursts_a = once()
+        emits_b, fp_b, bursts_b = once()
+        assert bursts_a > 0 and bursts_b > 0
+        assert fp_a == fp_b
+        assert emits_a == emits_b
+
+    def test_burst_lowering_failure_falls_back_per_window(
+            self, monkeypatch):
+        """A burst scan that fails to lower (counted + logged) must
+        fall back to dispatching its windows individually — job lists
+        untouched, donated buffers intact, stream identical to sync."""
+        from fluidframework_tpu.server import serve_step
+        waves = _keystroke_waves(n_waves=8)
+        counters.reset()
+        _, sync_emits = self._run(waves, pipelined=False)
+        counters.reset()
+
+        def boom(*a, **k):
+            raise RuntimeError("burst lowering refused")
+
+        monkeypatch.setattr(serve_step, "serve_burst", boom)
+        _, emits = self._run(waves, pipelined=True)
+        assert counters.get("serving.burst_fallbacks") > 0
+        assert counters.get("serving.bursts") == 0
+        assert sync_emits == emits  # order included
+
+    def test_occupancy_hints_count_staged_burst_windows(self):
+        """K staged/scanned windows must read as ring-fill K, not 1 —
+        the PR 6 admission controller's fill term would otherwise see a
+        long scan step as a calm, mostly-empty ring."""
+        lam = _lam()
+        lam.pipelined = True
+        waves = _keystroke_waves(n_waves=6)
+        off = 0
+        fills = []
+        for wave in waves:
+            for doc, box in wave:
+                lam.handler_raw(_qm(off, doc, box))
+                off += 1
+            lam.flush()
+            fills.append(lam.occupancy_hints()["ring_occupancy"])
+        # Windows accumulate across flushes: fill must exceed the
+        # one-entry illusion while a multi-window burst is in flight.
+        assert max(fills) >= 3
+        assert fills == sorted(fills[:fills.index(max(fills)) + 1]) \
+            + fills[fills.index(max(fills)) + 1:]
+        lam.drain()
+        assert lam.occupancy_hints()["ring_occupancy"] == 0
+        assert not lam._staged and not lam._ring

@@ -326,6 +326,104 @@ class TestMissingDonate:
         assert rule_ids(src, "MISSING_DONATE") == []
 
 
+class TestScanHostCallback:
+    def test_true_positive_io_callback_in_scan_body(self):
+        src = """
+            import jax
+            from jax import lax
+            from jax.experimental import io_callback
+
+            def serve_burst(carry, xs):
+                def body(c, x):
+                    io_callback(print, None, x)
+                    return c, x
+                return lax.scan(body, carry, xs)
+        """
+        assert rule_ids(src, "SCAN_HOST_CALLBACK") == \
+            ["SCAN_HOST_CALLBACK"]
+
+    def test_true_positive_block_until_ready_in_while_body(self):
+        src = """
+            import jax
+
+            def drain(state):
+                def cond(s):
+                    return s.pending > 0
+                def step(s):
+                    s.planes.block_until_ready()
+                    return s.advance()
+                return jax.lax.while_loop(cond, step, state)
+        """
+        assert rule_ids(src, "SCAN_HOST_CALLBACK") == \
+            ["SCAN_HOST_CALLBACK"]
+
+    def test_true_positive_debug_callback_in_lambda_body(self):
+        src = """
+            from jax import lax, debug
+
+            def trace_scan(init, xs):
+                return lax.scan(
+                    lambda c, x: (debug.callback(print, c), x)[1:],
+                    init, xs)
+        """
+        assert rule_ids(src, "SCAN_HOST_CALLBACK") == \
+            ["SCAN_HOST_CALLBACK"]
+
+    def test_guard_callback_outside_scan_body(self):
+        """Host callbacks in straight-line staging code are fine — the
+        hazard is per-STEP re-entry, not host work around the program."""
+        src = """
+            import jax
+            from jax import lax
+            from jax.experimental import io_callback
+
+            def serve(carry, xs):
+                def body(c, x):
+                    return c, x + 1
+                out = lax.scan(body, carry, xs)
+                io_callback(print, None, out)
+                return out
+        """
+        assert rule_ids(src, "SCAN_HOST_CALLBACK") == []
+
+    def test_guard_pure_device_scan_body(self):
+        src = """
+            import jax.numpy as jnp
+            from jax import lax
+
+            def apply_ops(state, ops):
+                def body(s, t):
+                    return s + jnp.sum(ops[t]), None
+                return lax.scan(body, state, jnp.arange(4))
+        """
+        assert rule_ids(src, "SCAN_HOST_CALLBACK") == []
+
+    def test_guard_block_until_ready_on_host_path(self):
+        src = """
+            import numpy as np
+
+            def fetch(result):
+                result.block_until_ready()
+                return np.asarray(result)
+        """
+        assert rule_ids(src, "SCAN_HOST_CALLBACK") == []
+
+    def test_out_of_scope_module_is_quiet(self):
+        src = textwrap.dedent("""
+            from jax import lax
+            from jax.experimental import io_callback
+
+            def f(c, xs):
+                def body(c, x):
+                    io_callback(print, None, x)
+                    return c, x
+                return lax.scan(body, c, xs)
+        """)
+        hits = analyze_source(src, path="examples/clicker.py",
+                              only=["SCAN_HOST_CALLBACK"])
+        assert hits == []
+
+
 # ---------------------------------------------------------------------------
 # CC family
 # ---------------------------------------------------------------------------
