@@ -3,7 +3,7 @@
 
 .PHONY: test soak bench dryrun record-corpus historian-smoke \
 	summarize-smoke trace-smoke pipeline-smoke fused-smoke \
-	lint-analysis check
+	paged-smoke lint-analysis check
 
 test:
 	python -m pytest tests/ -q
@@ -50,6 +50,15 @@ pipeline-smoke:
 fused-smoke:
 	JAX_PLATFORMS=cpu python bench.py fused-smoke
 
+# CPU smoke of paged lane memory (docs/paged_memory.md): the storm-doc
+# ragged fleet must produce assembled snapshots BIT-IDENTICAL through
+# the paged and the bucketed (oracle-conformant) stores, fold/rescue
+# dispatches on that scenario must drop >= 5x (capacity ceremony gone),
+# and the warm gather-by-page-id ragged fleet must clear 1.5x the
+# pinned BENCH_r07 bucketed figure (9,687 ops/s) at the same shapes.
+paged-smoke:
+	JAX_PLATFORMS=cpu python bench.py paged-smoke
+
 # Virtual-clocked open-loop overload harness (docs/overload.md): at 2x
 # sustained overload the admission controller must shed instead of
 # queueing unboundedly (peak queue bounded), hold the admitted-op flush
@@ -62,7 +71,7 @@ overload-smoke:
 # The pre-merge gate: static analysis + the summarize/trace/pipeline/
 # fused/overload smokes + the full test suite.
 check: lint-analysis summarize-smoke trace-smoke pipeline-smoke \
-		fused-smoke overload-smoke test
+		fused-smoke paged-smoke overload-smoke test
 
 # The round-end randomized-evidence ritual: 50-trial soaks over every
 # differential surface (bulk catch-up, serving fast path, matrix/

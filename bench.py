@@ -1064,6 +1064,21 @@ def main() -> None:
                 "ingest_ops_per_sec": partial_extra.get(
                     "serving_ingest_ops_per_sec"),
             },
+            # Paged lane memory rides TOP-level (ISSUE 8): allocator
+            # occupancy and fill, the fold/rescue-class event count on
+            # the paged store scenario (capacity ceremony gone — only
+            # per-row ring rescues remain), and the warm ragged-fleet
+            # rate through gather-by-page-id applies (compare
+            # extra.ragged_ops_per_sec, the bucketed run at the same
+            # shapes and seeds).
+            "paged": {
+                "pages_in_use": partial_extra.get("paged_pages_in_use"),
+                "page_fill_frac": partial_extra.get(
+                    "paged_page_fill_frac"),
+                "fold_count": partial_extra.get("paged_fold_count"),
+                "ragged_ops_per_sec": partial_extra.get(
+                    "paged_ragged_ops_per_sec"),
+            },
             "extra": {k: v for k, v in partial_extra.items()
                       if not k.startswith("_")},
         }
@@ -1162,6 +1177,16 @@ def main() -> None:
     # client's catch-up = load summary + replay the op tail. Device analog:
     # one full pipeline step over the whole doc batch's tail; p50 over
     # repeated trials from fresh (summary-loaded) state.
+    # Warm protocol (the r05/r07 lesson applied here, PERF.md round 9):
+    # one unmeasured fresh-state step absorbs any cold-compile /
+    # first-touch cost before the percentile can bill it, and the stamp
+    # carries the fleet size + a per-doc normalization — the r07 "47.3 s
+    # vs 10.7 s regression" was 10,000 docs vs r06's 2,048-doc CPU-
+    # fallback fleet measured by a metric that scales with the fleet
+    # (per-doc, r07 was actually FASTER: 4.73 vs 5.22 ms/doc).
+    t_i, m_i = fresh()
+    jax.block_until_ready((t_i, m_i))
+    np.asarray(step(t_i, m_i, raw, ops)[3])
     trials = []
     for _ in range(5):
         t_i, m_i = fresh()
@@ -1171,7 +1196,12 @@ def main() -> None:
         np.asarray(r[3])
         trials.append(time.perf_counter() - t0)
     catchup_p50_ms = sorted(trials)[len(trials) // 2] * 1000.0
-    checkpoint_partial(summary_catchup_p50_ms=round(catchup_p50_ms, 2))
+    checkpoint_partial(
+        summary_catchup_p50_ms=round(catchup_p50_ms, 2),
+        summary_catchup_docs=n_docs,
+        summary_catchup_per_doc_ms=round(
+            catchup_p50_ms / max(n_docs, 1), 4),
+        summary_catchup_warm=True)
 
     # Batched summarization: ONE device extraction pass over the whole doc
     # batch (mask + prefix-sum packing, kernel.extract_visible_batched) +
@@ -1255,6 +1285,29 @@ def main() -> None:
                        ragged_docs=sum(rb for rb, _, _ in ragged_buckets),
                        ragged_total_ops=ragged_ops,
                        ragged_overflow=ragged_overflow)
+
+    # Paged lane memory (docs/paged_memory.md): the SAME ragged fleet
+    # through gather-by-page-id applies — storage O(pages), each shape
+    # group's view padded to its own page bucket instead of the
+    # capacity grid — plus a store-level ragged serving scenario for
+    # the allocator/ceremony health figures. Feeds the top-level
+    # `paged` block.
+    if ragged_buckets:
+        pr = _paged_ragged_kernel_rate(ragged_buckets)
+        pstore, _, _ = _paged_store_scenario(
+            paged=True, waves=6, keystroke=64, storms=2, key_ops=8,
+            storm_ops=40)
+        pstats = pstore.paged_stats()
+        checkpoint_partial(
+            paged_ragged_ops_per_sec=pr["ragged_ops_per_sec"],
+            paged_ragged_overflow=pr["overflow"],
+            paged_ragged_fill_frac=pr["page_fill_frac"],
+            paged_pages_in_use=pstats["pages_in_use"],
+            paged_page_fill_frac=pstats["page_fill_frac"],
+            paged_fold_count=pstore.folds + pstore.paged_rescues,
+            paged_fold_rescue_dispatches=pstore.fold_rescue_dispatches,
+            paged_pool_pages=pstats["pool_pages"],
+            paged_page_compactions=pstats["page_compactions"])
 
     # End-to-end SERVING ingest: wire DocumentMessages through the real
     # TpuSequencerLambda (parse -> native pack -> device ticket+apply) —
@@ -1746,6 +1799,316 @@ def pipeline_smoke() -> int:
 # against (serving_ingest_ops_per_sec from the committed BENCH_r06.json,
 # the honest warm-protocol ring figure at the 512-doc shape).
 R06_SERVING_INGEST_OPS = 13602.0
+
+# The pinned BENCH_r07 CPU ragged-fleet figure (ragged_ops_per_sec from
+# the committed BENCH_r07.json): the BUCKETED ragged workload — 10k docs
+# across three (docs, ops, capacity) shapes, every lane padded to its
+# bucket — that the paged smoke's gather-by-page-id run must beat 1.5x.
+R07_RAGGED_OPS = 9686.9
+
+
+def _paged_ragged_kernel_rate(ragged_buckets) -> dict:
+    """The ragged fleet through PAGED lane memory at the same (docs,
+    ops) shapes and seeds as the bucketed ragged section, measured the
+    way the paged store actually serves: each group's op stream applies
+    in T-grid WINDOWS (T = min(ops, 64)) with page tables growing
+    between windows from the EXACT post-window counts — early windows
+    run on 1-2 pages, not the final worst case, so view traffic tracks
+    live content instead of the stream's end state. The bucketed
+    comparison point carries the whole-capacity plane through every
+    window by construction. Groups warm (all window shapes compile
+    first on throwaway state) and time sequentially; per-group elapsed
+    sums into the fleet figure — no cross-group overlap is claimed."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.mergetree import kernel
+    from fluidframework_tpu.mergetree.constants import PAGE_ROWS
+    from fluidframework_tpu.mergetree.oppack import OpKind, PackedOps
+    from fluidframework_tpu.mergetree.paging import pages_for, pow2_pages
+    from fluidframework_tpu.mergetree.state import make_state
+    from fluidframework_tpu.server import ticket_kernel as tk
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def paged_window(tstate, pool, page_ids, counts, mins, seqs, raw,
+                     ops):
+        tstate, ticketed = tk._scan_tickets(tstate, raw, batched=True)
+        admitted = ticketed.seq > 0
+        ops2 = ops._replace(
+            kind=jnp.where(admitted, ops.kind, OpKind.NOOP),
+            seq=jnp.where(admitted, ticketed.seq, ops.seq),
+            msn=jnp.where(admitted, ticketed.min_seq, ops.msn))
+        view = kernel.gather_pages(pool, page_ids, counts, mins, seqs)
+        out = kernel._scan_ops(view, ops2, batched=True)
+        pool2 = kernel.scatter_pages(pool, page_ids, out)
+        lens = jax.vmap(
+            lambda s: jnp.sum(kernel.visibility(s, s.seq, -2)[1]))(out)
+        return (tstate, pool2, out.count, out.min_seq, out.seq, lens,
+                out.overflow)
+
+    def run_group(rb, rt, seed):
+        """One shape group, windowed; returns (elapsed_s, live_rows,
+        alloc_pages, overflow). Pages append between windows per the
+        exact counts the window result already carries."""
+        t_w = min(rt, 64)
+        n_windows = -(-rt // t_w)
+        max_pages = pow2_pages(pages_for(2 * rt, PAGE_ROWS))
+        rcols = gen_traces(rb, rt, seed=seed)
+
+        def window_cols(w):
+            sl = slice(w * t_w, (w + 1) * t_w)
+            ops = PackedOps(**{f: jnp.asarray(rcols[f][:, sl])
+                               for f in PackedOps._fields})
+            raw = tk.RawOps(client=ops.client, client_seq=ops.seq,
+                            ref_seq=ops.ref_seq)
+            return raw, ops
+
+        def drive():
+            tstate = tk.make_ticket_state(8, batch=rb)
+            n_pages = rb * max_pages + 1
+            pool = make_state(PAGE_ROWS, 1, batch=n_pages)
+            counts = np.zeros(rb, np.int32)
+            mins = np.zeros(rb, np.int32)
+            seqs = np.zeros(rb, np.int32)
+            over = False
+            rows_per_doc = 0  # pages allocated per doc so far
+            for w in range(n_windows):
+                # Exact-count page growth (the serving store's
+                # ensure_rows proof): every op adds <= 2 rows.
+                need = int(counts.max()) + 2 * t_w
+                rows_per_doc = max(rows_per_doc,
+                                   pow2_pages(pages_for(need, PAGE_ROWS)))
+                p2 = rows_per_doc
+                page_ids = jnp.asarray((np.arange(
+                    rb, dtype=np.int32)[:, None] * max_pages
+                    + np.arange(p2, dtype=np.int32)[None, :] + 1))
+                raw, ops = window_cols(w)
+                (tstate, pool, c_dev, m_dev, s_dev, lens,
+                 over_dev) = paged_window(
+                    tstate, pool, page_ids, jnp.asarray(counts),
+                    jnp.asarray(mins), jnp.asarray(seqs), raw, ops)
+                counts = np.asarray(c_dev)
+                mins = np.asarray(m_dev)
+                seqs = np.asarray(s_dev)
+                over = over or bool(np.asarray(over_dev).any())
+            np.asarray(lens)
+            return counts, over, rb * rows_per_doc
+
+        drive()  # compile every window shape
+        t0 = time.perf_counter()
+        counts, over, alloc_pages = drive()
+        return (time.perf_counter() - t0, int(counts.sum()),
+                alloc_pages, over)
+
+    elapsed = 0.0
+    live_rows = 0
+    alloc_pages = 0
+    overflow = False
+    for i, (rb, rt, _rc) in enumerate(ragged_buckets):
+        e, rows, pages, over = run_group(rb, rt, seed=100 + i)
+        elapsed += e
+        live_rows += rows
+        alloc_pages += pages
+        overflow = overflow or over
+    total_ops = sum(rb * rt for rb, rt, _ in ragged_buckets)
+    return {
+        "ragged_ops_per_sec": round(total_ops / elapsed, 1)
+        if elapsed else 0.0,
+        "elapsed_s": round(elapsed, 4),
+        "total_ops": total_ops,
+        "overflow": overflow,
+        "pages_allocated": alloc_pages,
+        "page_fill_frac": round(
+            live_rows / (alloc_pages * PAGE_ROWS), 4)
+        if alloc_pages else 1.0,
+    }
+
+
+def _bucketed_ragged_kernel_rate(ragged_buckets) -> dict:
+    """In-process bucketed reference at the same shapes (docs may be
+    scaled down by the caller — the rate is B-invariant, cost is linear
+    in B): the host-drift guard for the paged smoke's pinned gate. The
+    committed R07 pin encodes the r07 host's speed; gating the paged
+    run against min(pin, this) keeps the bar at the pin on an
+    r07-speed host and keeps the comparison PAIRED on a slower or
+    loaded one (the r05/r06 honest-baseline lesson)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fluidframework_tpu.mergetree.oppack import PackedOps
+    from fluidframework_tpu.mergetree.state import make_state
+    from fluidframework_tpu.server import ticket_kernel as tk
+    from fluidframework_tpu.server.pipeline import make_full_step
+
+    step = jax.jit(make_full_step(), donate_argnums=(0, 1))
+    elapsed = 0.0
+    for i, (rb, rt, rc) in enumerate(ragged_buckets):
+        def mk():
+            rcols = gen_traces(rb, rt, seed=100 + i)
+            rops = PackedOps(**{f: jnp.asarray(rcols[f])
+                                for f in PackedOps._fields})
+            rraw = tk.RawOps(client=rops.client, client_seq=rops.seq,
+                             ref_seq=rops.ref_seq)
+            return (tk.make_ticket_state(8, batch=rb),
+                    make_state(rc, 1, batch=rb), rraw, rops)
+
+        args = mk()
+        np.asarray(step(*args)[3])  # compile
+        args = mk()
+        jax.block_until_ready(args[0])
+        t0 = time.perf_counter()
+        np.asarray(step(*args)[3])
+        elapsed += time.perf_counter() - t0
+    total_ops = sum(rb * rt for rb, rt, _ in ragged_buckets)
+    return {
+        "ragged_ops_per_sec": round(total_ops / elapsed, 1)
+        if elapsed else 0.0,
+        "elapsed_s": round(elapsed, 4),
+    }
+
+
+def _paged_store_scenario(paged: bool, waves: int = 10,
+                          keystroke: int = 128, storms: int = 4,
+                          key_ops: int = 8, storm_ops: int = 60):
+    """The storm-doc ragged fleet at STORE level (MergeLaneStore.apply,
+    windowed): `keystroke` one-page documents type a few chars per
+    window while `storms` documents type deep — the shape that drives
+    the bucket grid's promote/fold/rescue ceremony (every keystroke doc
+    eventually overflows its 64-bucket; every storm doc climbs the grid
+    and refolds) and that paged storage absorbs with page appends.
+    Returns (store, elapsed_s, total_ops)."""
+    from fluidframework_tpu.server.tpu_sequencer import MergeLaneStore
+
+    store = MergeLaneStore(paged=paged)
+    b = store.builder
+    seqs: dict = {}
+
+    def stream(name, n):
+        s = seqs.get(name, 0)
+        ops = []
+        for _ in range(n):
+            s += 1
+            ops.append(b.insert_text(0, "x", s - 1, 1, s, msn=s))
+        seqs[name] = s
+        return ops
+
+    total = 0
+    t0 = time.perf_counter()
+    for _w in range(waves):
+        streams = {}
+        for d in range(keystroke):
+            streams[("doc", "s", f"k{d}")] = stream(f"k{d}", key_ops)
+        for d in range(storms):
+            streams[("doc", "s", f"S{d}")] = stream(f"S{d}", storm_ops)
+        total += keystroke * key_ops + storms * storm_ops
+        store.apply(streams)
+    return store, time.perf_counter() - t0, total
+
+
+def paged_smoke() -> int:
+    """CPU smoke for paged lane memory (`make paged-smoke`,
+    docs/paged_memory.md). Asserts the acceptance properties:
+
+      * bit-identity: the storm-doc ragged fleet produces IDENTICAL
+        assembled snapshots through the paged store and the bucketed
+        store (whose kernel is conformance-locked to mergetree/oracle.py
+        by tests/test_kernel.py — emit-order identity across engines is
+        locked by tests/test_paged_memory.py);
+      * the fold/rescue ceremony is actually gone: device recovery +
+        fold dispatches on the ragged scenario drop >= 5x vs the
+        bucketed run (paged capacity events are structurally
+        impossible — growth pre-proves page fit);
+      * the warm paged ragged fleet — measured WINDOWED, the way the
+        paged store serves: T-grid windows with exact-count page growth
+        between them — clears 1.5x the pinned BENCH_r07 bucketed figure
+        (9,687 ops/s) at the same shapes and seeds, with the pin
+        min()'d against a paired in-process bucketed reference so a
+        slower/loaded host grades the ratio, not the r07 host's speed.
+
+    Prints one JSON line (also written to BENCH_PAGED_LAST.json);
+    exit 0 iff every check passes."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    ragged_buckets = [(6000, 16, 64), (3000, 64, 256), (1000, 256, 1024)]
+    pr = _paged_ragged_kernel_rate(ragged_buckets)
+    # In-process bucketed reference at quarter doc counts (the rate is
+    # B-invariant; quarter scale keeps the smoke's wall clock sane):
+    # guards the pinned gate against host drift — see
+    # _bucketed_ragged_kernel_rate.
+    br = _bucketed_ragged_kernel_rate(
+        [(rb // 4, rt, rc) for rb, rt, rc in ragged_buckets])
+
+    store_b, b_s, total = _paged_store_scenario(paged=False)
+    store_p, p_s, _ = _paged_store_scenario(paged=True)
+    snaps_b = store_b.extract_all()
+    snaps_p = store_p.extract_all()
+
+    # Per-char content comparison: engine-internal segmentation (folds,
+    # zamboni cadence) may differ; the flattened content must not —
+    # mergetree.host.flatten_snapshot_content docstring has the full
+    # rationale.
+    from fluidframework_tpu.mergetree.host import flatten_snapshot_content
+
+    content_equal = set(snaps_b) == set(snaps_p) and all(
+        flatten_snapshot_content(snaps_p[k])
+        == flatten_snapshot_content(snaps_b[k]) for k in snaps_b)
+    texts_equal = all(store_p.text(k) == store_b.text(k)
+                      for k in snaps_b)
+    bucketed_disp = store_b.fold_rescue_dispatches
+    paged_disp = store_p.fold_rescue_dispatches
+    st = store_p.paged_stats()
+
+    # The gate anchors at the pinned r07 bucketed figure; min() with
+    # the paired in-process bucketed reference keeps the comparison
+    # honest when THIS host runs slower than r07's did (the r05/r06
+    # baseline lesson: a pin encodes the pinning host's speed).
+    baseline = min(R07_RAGGED_OPS, br["ragged_ops_per_sec"])
+    target = 1.5 * baseline
+    checks = {
+        "content_bit_identical": content_equal and texts_equal,
+        "fold_rescue_cut_ge_5x":
+            bucketed_disp >= 5 * max(1, paged_disp),
+        "ragged_rate_ge_1_5x_bucketed":
+            pr["ragged_ops_per_sec"] >= target,
+        "ragged_no_overflow": not pr["overflow"],
+        "no_capacity_ceremony_paged":
+            store_p.folds == 0 and store_p.overflow_drops == 0,
+    }
+    record = {
+        "metric": "paged-smoke",
+        "backend": jax.default_backend(),
+        "ragged_ops_per_sec": pr["ragged_ops_per_sec"],
+        "ragged_total_ops": pr["total_ops"],
+        "ragged_page_fill_frac": pr["page_fill_frac"],
+        "r07_pinned_ragged_ops_per_sec": R07_RAGGED_OPS,
+        "bucketed_inproc_ragged_ops_per_sec": br["ragged_ops_per_sec"],
+        "paged_vs_bucketed_inproc": round(
+            pr["ragged_ops_per_sec"]
+            / max(1.0, br["ragged_ops_per_sec"]), 2),
+        "gate_baseline_ops_per_sec": round(baseline, 1),
+        "target_ops_per_sec": round(target, 1),
+        "scenario_ops": total,
+        "scenario_bucketed_s": round(b_s, 3),
+        "scenario_paged_s": round(p_s, 3),
+        "bucketed_fold_rescue_dispatches": bucketed_disp,
+        "paged_fold_rescue_dispatches": paged_disp,
+        "fold_rescue_cut": round(bucketed_disp / max(1, paged_disp), 1),
+        "bucketed_folds": store_b.folds,
+        "paged_rescues": store_p.paged_rescues,
+        "pages_in_use": st["pages_in_use"],
+        "page_fill_frac": st["page_fill_frac"],
+        "page_compactions": st["page_compactions"],
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    _write_json_atomic(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_PAGED_LAST.json"), record)
+    print(json.dumps(record))
+    return 0 if all(checks.values()) else 1
 
 
 def fused_smoke() -> int:
@@ -2273,6 +2636,8 @@ if __name__ == "__main__":
         sys.exit(pipeline_smoke())
     if len(sys.argv) > 1 and sys.argv[1] == "fused-smoke":
         sys.exit(fused_smoke())
+    if len(sys.argv) > 1 and sys.argv[1] == "paged-smoke":
+        sys.exit(paged_smoke())
     try:
         main()
     except Exception as e:  # noqa: BLE001 - never exit without the JSON line

@@ -26,6 +26,24 @@ DEV_NO_CLIENT = -1
 # server/serve_step.py) carry inline suppressions.
 CANONICAL_DEVICE_DTYPES = ("int32", "bool_")
 
+# Page-table index dtype for the paged segment store (mergetree/paging.py):
+# page ids and every gather/scatter-by-page-id operand ride int32, like all
+# canonical device integers. fluidlint's PAGE_ID_DTYPE rule enforces it in
+# mergetree/server scope.
+PAGE_ID_DTYPE = "int32"
+
+# Paged lane memory (docs/paged_memory.md): segment rows live in fixed-size
+# pages of this many rows; a document's capacity is len(page_table) *
+# PAGE_ROWS and growth is "append a page" instead of the bucket grid's
+# promote-fold-rescue ceremony. 64 matches the smallest capacity bucket,
+# so a keystroke doc costs one page.
+PAGE_ROWS = 64
+
+# The serving window op-depth grid, shared by every lane store and the
+# sequencer (one compiled apply program per (capacity, T) pair; the grid
+# bounds the jit cache). Previously hand-copied in three constructors.
+DEFAULT_T_BUCKETS = (1, 4, 16, 64, 256)
+
 # Default tuning knobs (reference mergeTree.ts:1050-1068, snapshotV1.ts:40)
 TEXT_SEGMENT_GRANULARITY = 256
 SNAPSHOT_CHUNK_SIZE = 10000

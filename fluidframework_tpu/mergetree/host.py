@@ -433,6 +433,29 @@ def chunk_entries(entries: List[dict], chunk_chars: int = 10000
     return chunks
 
 
+def flatten_snapshot_content(snap: dict) -> List[Tuple[str, tuple]]:
+    """Flatten an assembled snapshot (assemble_snapshot's {"header",
+    "chunks"} dict) to its per-char (char, resolved props) stream of
+    VISIBLE content. Segmentation is an engine-internal artifact — the
+    bucketed store's folds coalesce acked rows, the paged store's
+    zamboni runs on its own page-granular cadence — so two conformant
+    engines may chunk the same document differently while the flattened
+    content must match to the character (the cross-engine bar
+    `bench.py paged-smoke` and the paged conformance tests apply, the
+    same normalization tests/test_kernel.py's flatten_runs uses against
+    the oracle)."""
+    out: List[Tuple[str, tuple]] = []
+    for chunk in snap["chunks"]:
+        for e in chunk:
+            if e.get("removedSeq") is not None:
+                continue
+            text = e.get("text") or ("￼" if e.get("kind") != SEG_TEXT
+                                     else "")
+            props = tuple(sorted((e.get("props") or {}).items()))
+            out.extend((ch, props) for ch in text)
+    return out
+
+
 def extract_segments(state: DocState, payloads: PayloadTable,
                      ref_seq: Optional[int] = None, client: int = GOD_CLIENT,
                      doc: Optional[int] = None) -> List[Tuple[str, Optional[dict]]]:

@@ -534,6 +534,125 @@ def compact_batched(state: DocState) -> DocState:
 
 
 # ---------------------------------------------------------------------------
+# paged lane memory: gather/scatter-by-page-id (mergetree/paging.py)
+# ---------------------------------------------------------------------------
+
+def gather_pages(pool: DocState, page_ids: jnp.ndarray, counts, min_seqs,
+                 seqs) -> DocState:
+    """Materialize a batch of documents from their pages: ``page_ids``
+    is the [B, P] int32 page-table plane (-1 pads short tables and
+    gathers the reserved blank page 0, so padded rows are canonical
+    blank padding), ``pool`` the [n_pages, PAGE_ROWS, ...] page pool.
+    Returns a [B, P*PAGE_ROWS, ...] DocState view — the SAME shape the
+    bucketed apply consumes, so every op phase runs unchanged on it —
+    with per-doc scalars injected from the host mirrors and a fresh
+    overflow plane. The gather is by page id only: a document's rows
+    never move on growth, they just gain pages."""
+    gidx = jnp.maximum(page_ids, 0)
+    b, p = page_ids.shape
+    r = pool.capacity
+
+    def g(col):
+        x = col[gidx]  # [B, P, R, ...]
+        return x.reshape((b, p * r) + x.shape[3:])
+
+    return DocState(
+        length=g(pool.length), ins_seq=g(pool.ins_seq),
+        ins_client=g(pool.ins_client), local_seq=g(pool.local_seq),
+        rem_seq=g(pool.rem_seq), rem_local_seq=g(pool.rem_local_seq),
+        rem_clients=g(pool.rem_clients), origin_op=g(pool.origin_op),
+        origin_off=g(pool.origin_off), anno=g(pool.anno),
+        count=counts, min_seq=min_seqs, seq=seqs,
+        overflow=jnp.zeros((b,), jnp.bool_),
+    )
+
+
+def scatter_pages(pool: DocState, page_ids: jnp.ndarray,
+                  view: DocState) -> DocState:
+    """Write a [B, P*PAGE_ROWS, ...] view back into its pages. Padding
+    slots (page id -1) redirect out of bounds and DROP — callers
+    guarantee live rows never spill into padding pages (counts <=
+    allocated rows, asserted host-side by PagedMergeStore), so dropped
+    rows are always blank. Each real page has exactly one owner, so the
+    scatter is collision-free."""
+    b, p = page_ids.shape
+    r = pool.capacity
+    n = pool.length.shape[0]
+    dst = jnp.where(page_ids >= 0, page_ids, n)  # OOB -> mode="drop"
+
+    def s(col, v):
+        vp = v.reshape((b, p, r) + v.shape[2:])
+        return col.at[dst].set(vp, mode="drop")
+
+    return pool._replace(
+        length=s(pool.length, view.length),
+        ins_seq=s(pool.ins_seq, view.ins_seq),
+        ins_client=s(pool.ins_client, view.ins_client),
+        local_seq=s(pool.local_seq, view.local_seq),
+        rem_seq=s(pool.rem_seq, view.rem_seq),
+        rem_local_seq=s(pool.rem_local_seq, view.rem_local_seq),
+        rem_clients=s(pool.rem_clients, view.rem_clients),
+        origin_op=s(pool.origin_op, view.origin_op),
+        origin_off=s(pool.origin_off, view.origin_off),
+        anno=s(pool.anno, view.anno),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def apply_ops_paged(pool: DocState, page_ids: jnp.ndarray, counts,
+                    min_seqs, seqs, ops: PackedOps):
+    """One [B, T] op window over paged documents: gather-by-page-id ->
+    the unchanged batched apply -> scatter-by-page-id, in ONE jitted
+    dispatch with the page pool and page-table plane DONATED (the pool
+    updates in place; page_ids alias straight through to the returned
+    plane). Returns (pool', page_ids, count, min_seq, seq, overflow,
+    pre_view): pre_view is the gathered PRE-window group — the rollback
+    the rare unpredicted-overflow recovery (annotate-ring/overlap-slot
+    exhaustion) scatters back for flagged docs only, so donation costs
+    one group-view allocation instead of a whole retained pool."""
+    pre = gather_pages(pool, page_ids, counts, min_seqs, seqs)
+    out = _scan_ops(pre, ops, batched=True)
+    pool2 = scatter_pages(pool, page_ids, out)
+    return (pool2, page_ids, out.count, out.min_seq, out.seq,
+            out.overflow, pre)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def rollback_pages(pool: DocState, page_ids: jnp.ndarray,
+                   pre: DocState) -> DocState:
+    """Scatter a retained pre-window view back over flagged docs' pages
+    (page_ids here is the FLAGGED sub-plane): the paged overflow
+    recovery's rollback half."""
+    return scatter_pages(pool, page_ids, pre)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def compact_pages(pool: DocState, page_ids: jnp.ndarray, counts,
+                  min_seqs, seqs):
+    """Page-granular zamboni for a (budgeted) group of fragmented docs:
+    gather -> left-pack compact -> scatter. The caller releases pages
+    wholly past the returned counts (PagedMergeStore.release_trailing)
+    — compaction is how a shrinking document actually gives pages
+    back."""
+    view = gather_pages(pool, page_ids, counts, min_seqs, seqs)
+    g = jax.vmap(_compact_one)(view)
+    return scatter_pages(pool, page_ids, g), page_ids, g.count
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def compact_extract_paged(pool: DocState, page_ids: jnp.ndarray, counts,
+                          min_seqs, seqs):
+    """Fused zamboni + snapshot extraction over gathered page views (the
+    paged analog of compact_extract_batched): ONE dispatch returns the
+    compacted pool (adopted in place — pool donated) plus packed
+    per-doc rows in the extract_visible_batched layout, so host
+    assembly (host.assemble_snapshot) runs unchanged."""
+    view = gather_pages(pool, page_ids, counts, min_seqs, seqs)
+    g, packed = jax.vmap(_compact_extract_one)(view)
+    return scatter_pages(pool, page_ids, g), page_ids, g.count, packed
+
+
+# ---------------------------------------------------------------------------
 # batched summary extraction
 # ---------------------------------------------------------------------------
 

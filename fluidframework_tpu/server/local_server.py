@@ -523,10 +523,16 @@ class TpuLocalServer(LocalServer):
     mesh: an optional jax.sharding.Mesh — the sequencer's ticket lanes
     and merge/LWW channel lanes shard over its 'dp' axis (multi-chip
     serving; parallel/mesh.py).
+
+    paged_lanes: store merge segment rows in the refcounted page pool
+    (per-doc page tables, gather-by-page-id applies) instead of the
+    capacity-bucket grid — document growth appends pages, no
+    promote/fold/rescue (docs/paged_memory.md). Single-chip only.
     """
 
-    def __init__(self, *args, mesh=None, **kwargs):
+    def __init__(self, *args, mesh=None, paged_lanes=False, **kwargs):
         self.mesh = mesh
+        self.paged_lanes = paged_lanes
         super().__init__(*args, **kwargs)
 
     def _build_sequencer(self) -> PartitionManager:
@@ -543,7 +549,8 @@ class TpuLocalServer(LocalServer):
                 storage=lambda doc_id: self.historian.read_summary(
                     self.tenant_id, doc_id),
                 config=self.config,
-                send_system=self._send_system)
+                send_system=self._send_system,
+                paged_lanes=getattr(self, "paged_lanes", False))
             self.tpu_sequencers.append(lam)
             return lam
 

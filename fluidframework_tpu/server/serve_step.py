@@ -310,3 +310,43 @@ def _serve_burst(tstate, merge_states, lww_states, ticket_xs, merge_xs,
 
 serve_burst = functools.partial(
     jax.jit, donate_argnums=(0, 1, 2), static_argnums=(7,))(_serve_burst)
+
+
+def _serve_paged_burst(pool, page_ids, counts, min_seqs, seqs, ops_xs):
+    """K op windows over PAGED documents in ONE scanned device program
+    (the paged serving burst, docs/paged_memory.md): gather each doc's
+    pages once, scan the K stacked [B, T] op planes with the gathered
+    view as the carry, scatter back once through the page-table plane
+    (immutable for the whole burst, so it carries no per-step scan
+    leg). The page pool and page tables are the DONATED operands — the
+    pool updates in place across the whole burst and page_ids alias
+    straight through to the returned plane, so a bulk catch-up stream
+    costs one dispatch regardless of its chunk count, with no
+    bucket-padded planes anywhere: view capacity is the GROUP's page
+    bucket, not the fleet-wide storm doc's.
+
+    Returns (pool', page_ids, count, min_seq, seq, overflow, over_k,
+    pre_view): over_k is the per-chunk overflow plane [K, B] (any bit
+    -> the host rolls the flagged docs back from pre_view and runs the
+    host rescue with the FULL stream, mirroring the bucketed recovery
+    contract); pre_view is the gathered pre-burst group view that makes
+    that rollback possible under donation."""
+    from ..mergetree import kernel
+
+    pre = kernel.gather_pages(pool, page_ids, counts, min_seqs, seqs)
+
+    def body(view, ops):
+        out = kernel._scan_ops(view, ops, batched=True)
+        return out, out.overflow
+
+    out, over_k = jax.lax.scan(body, pre, ops_xs)
+    pool2 = kernel.scatter_pages(pool, page_ids, out)
+    # page_ids pass straight through as an output (identity), which is
+    # what lets XLA alias the donated plane; tables are immutable for
+    # the whole burst, so they carry no per-step scan leg.
+    return (pool2, page_ids, out.count, out.min_seq, out.seq,
+            out.overflow, over_k, pre)
+
+
+serve_paged_burst = functools.partial(
+    jax.jit, donate_argnums=(0, 1))(_serve_paged_burst)

@@ -40,8 +40,10 @@ from ..mergetree.catchup import (
     looks_like_merge_op,
     wire_to_host_ops,
 )
+from ..mergetree.constants import DEFAULT_T_BUCKETS, PAGE_ROWS
 from ..mergetree.host import OpBuilder, PayloadTable, extract_text
 from ..mergetree.oppack import HostOp, OpKind, PackedOps, pack_ops
+from ..mergetree.paging import PagedMergeStore, pages_for, pow2_pages
 from ..mergetree.state import DocState, make_state
 from ..protocol.messages import (
     Boxcar,
@@ -264,17 +266,44 @@ def _repad_batch(rows: DocState, capacity: int) -> DocState:
 _apply_keep_batched = JitRetraceProbe(kernel.apply_ops_batched_keep,
                                       name="kernel.merge_apply_batched")
 
+# The paged apply (kernel.apply_ops_paged): gather-by-page-id -> the same
+# batched op phases -> scatter-by-page-id, pool + page tables donated.
+# Shapes bucket to (pow2 docs, pow2 pages, T-grid) so cache growth after
+# warmup is a leaked signature, same contract as the bucketed probe.
+_apply_paged_probe = JitRetraceProbe(kernel.apply_ops_paged,
+                                     name="kernel.paged_apply")
+
 
 class MergeLaneStore:
     """All merge lanes across capacity buckets + the shared payload table."""
 
     def __init__(self, capacities: Tuple[int, ...] = (64, 256, 1024),
                  lanes_per_bucket: int = 8,
-                 t_buckets: Tuple[int, ...] = (1, 4, 16, 64, 256)):
+                 t_buckets: Tuple[int, ...] = DEFAULT_T_BUCKETS,
+                 paged: bool = False,
+                 page_rows: Optional[int] = None):
         self.capacities = tuple(capacities)
         self.t_buckets = tuple(t_buckets)
-        self.buckets = [
+        # Paged lane memory (docs/paged_memory.md): segment rows live in
+        # a refcounted page pool with per-doc page tables instead of the
+        # capacity-bucket grid — growth is "append a page", so the whole
+        # promote/fold/rescue ceremony (and its padding of every lane to
+        # the storm doc's bucket) disappears from the apply path. The
+        # bucket list stays empty in paged mode; every storage touchpoint
+        # below branches on self.paged.
+        self.paged = bool(paged)
+        self.pages: Optional[PagedMergeStore] = None
+        if self.paged:
+            self.pages = PagedMergeStore(page_rows=page_rows or PAGE_ROWS)
+        self.buckets = [] if self.paged else [
             _MergeBucket(c, lanes_per_bucket) for c in self.capacities]
+        # Paged-mode telemetry: host rescues (the only fold/rescue-class
+        # event left — annotate-ring/overlap-slot exhaustion) and
+        # budgeted defrag passes. The paged smoke compares these against
+        # the bucketed path's fold/rescue dispatch count.
+        self.paged_rescues = 0
+        self.page_compactions = 0
+        self.fold_rescue_dispatches = 0  # device recovery dispatches
         self.payloads = PayloadTable()
         self.builder = OpBuilder(self.payloads)
         self.where: Dict[tuple, Tuple[int, int]] = {}  # key -> (bucket, lane)
@@ -361,9 +390,19 @@ class MergeLaneStore:
     # -- lane admission ----------------------------------------------------
     def lane_for(self, key: tuple) -> Tuple[int, int]:
         if key not in self.where:
-            bucket = 0
-            lane = self.buckets[bucket].alloc(key)
-            self.where[key] = (bucket, lane)
+            if self.paged:
+                # Admission = one blank page + a page-table entry; the
+                # placement tuple keeps the (bucket, lane) arity with a
+                # fixed (-1, -1) sentinel — paged placement lives in
+                # the page table, so there is no unique lane index here
+                # and pretending otherwise (e.g. an insertion ordinal)
+                # would collide after drops.
+                self.pages.ensure(key)
+                self.where[key] = (-1, -1)
+            else:
+                bucket = 0
+                lane = self.buckets[bucket].alloc(key)
+                self.where[key] = (bucket, lane)
         return self.where[key]
 
     def mark_dirty(self, key: tuple) -> None:
@@ -391,7 +430,10 @@ class MergeLaneStore:
         (chunked/unknown payload); its device lane is abandoned."""
         if key in self.where:
             b, lane = self.where.pop(key)
-            self.buckets[b].free(lane)
+            if self.paged:
+                self.pages.free_all(key)
+            else:
+                self.buckets[b].free(lane)
         self._forget_lane_payloads(key)
         self.opaque.add(key)
 
@@ -497,6 +539,16 @@ class MergeLaneStore:
             self._deferred_frees = []  # table is rebuilt wholesale
         per_bucket: List[Optional[tuple]] = []
         referenced: set = set()
+        pool_planes: Optional[tuple] = None
+        if self.paged:
+            # One whole-pool pass: free pages are zeroed (-1 planes) and
+            # padding rows stay blank, so the pool's unique ids ARE the
+            # live reference set — no per-bucket walk.
+            op_np = np.asarray(self.pages.pool.origin_op)
+            an_np = np.asarray(self.pages.pool.anno)
+            pool_planes = (op_np, an_np)
+            referenced.update(int(v) for v in np.unique(op_np) if v >= 0)
+            referenced.update(int(v) for v in np.unique(an_np) if v >= 0)
         for bucket in self.buckets:
             if not any(k is not None for k in bucket.used):
                 per_bucket.append(None)
@@ -509,16 +561,21 @@ class MergeLaneStore:
         order = sorted(referenced)
         sorted_old = np.asarray(order, np.int64)
         new_entries = [self.payloads.get(old) for old in order]
+
+        def renumber(plane):
+            live = plane >= 0
+            idx = np.searchsorted(sorted_old, plane)
+            return np.where(live, idx, -1).astype(np.int32)
+
+        if pool_planes is not None:
+            op_np, an_np = pool_planes
+            self.pages.pool = self.pages.pool._replace(
+                origin_op=jnp.asarray(renumber(op_np)),
+                anno=jnp.asarray(renumber(an_np)))
         for bucket, host in zip(self.buckets, per_bucket):
             if host is None:
                 continue
             op_np, an_np = host
-
-            def renumber(plane):
-                live = plane >= 0
-                idx = np.searchsorted(sorted_old, plane)
-                return np.where(live, idx, -1).astype(np.int32)
-
             bucket.state = bucket.state._replace(
                 origin_op=jnp.asarray(renumber(op_np)),
                 anno=jnp.asarray(renumber(an_np)))
@@ -592,6 +649,8 @@ class MergeLaneStore:
         from ..mergetree.state import state_from_numpy
         if key in self.where or key in self.opaque:
             return key in self.where
+        if self.paged:
+            return self._seed_paged(key, entries, min_seq, current_seq)
         allow_runs = matrix_base_key(key) is not None
         # Plain snapshot seed: no window to re-apply, so the widest
         # bucket may fill completely (last_slack=0) before degrading.
@@ -623,6 +682,36 @@ class MergeLaneStore:
         self._swap_fold_payloads(key, self._seed_ids(cols))
         return True
 
+    def _seed_paged(self, key: tuple, entries, min_seq: int,
+                    current_seq: int) -> bool:
+        """Paged snapshot seed: no bucket-fit degradation — any snapshot
+        size fits, it just allocates more pages (the page pool grows by
+        doubling like every other table). Only unmodelable payload
+        shapes still degrade the channel to opaque."""
+        from ..mergetree.catchup import Unmodelable, seed_host_cols
+        from ..mergetree.state import state_from_numpy
+        pg = self.pages
+        allow_runs = matrix_base_key(key) is not None
+        try:
+            cols = seed_host_cols(entries, self.payloads,
+                                  anno_slots=pg.anno_slots,
+                                  allow_runs=allow_runs,
+                                  allow_items=not allow_runs)
+        except (Unmodelable, ValueError):
+            self.opaque.add(key)
+            return False
+        n = len(cols["length"])
+        capacity = pages_for(n, pg.page_rows) * pg.page_rows
+        row = state_from_numpy(
+            cols, capacity, anno_slots=pg.anno_slots)._replace(
+            min_seq=jnp.asarray(min_seq, jnp.int32),
+            seq=jnp.asarray(current_seq, jnp.int32))
+        self.lane_for(key)
+        pg.put_row(key, row, count=n)
+        self.mark_dirty(key)
+        self._swap_fold_payloads(key, self._seed_ids(cols))
+        return True
+
     # -- batched apply with overflow recovery ------------------------------
     def apply(self, streams: Dict[tuple, List[HostOp]]) -> None:
         """Apply per-lane op streams; windows longer than the largest
@@ -634,6 +723,13 @@ class MergeLaneStore:
             self._in_apply = False
 
     def _apply(self, streams: Dict[tuple, List[HostOp]]) -> None:
+        if self.paged:
+            self._apply_paged(streams)
+            with tracing.span("serving.gc", hist="serving.gc"):
+                self.flushes_since_compact += 1
+                if self.flushes_since_compact >= self.compact_every:
+                    self.compact_all()
+            return
         max_t = self.t_buckets[-1]
         while streams:
             window: Dict[tuple, List[HostOp]] = {}
@@ -716,6 +812,238 @@ class MergeLaneStore:
                     self._recover_batch(b, {i: lane_ops[i]
                                             for i in flagged})
 
+    # -- the paged apply path (docs/paged_memory.md) -----------------------
+    def _apply_paged(self, streams: Dict[tuple, List[HostOp]]) -> None:
+        """Apply per-lane op streams against the page pool. Growth is
+        pre-proven: each op adds at most 2 rows, so `ensure_rows(count +
+        2*ops)` appends exactly the pages the worst case needs BEFORE
+        the dispatch — a page-table write, no data movement — and row
+        overflow is structurally impossible. Documents group by their
+        pow2 page-count bucket, so the gathered view pads to the
+        GROUP's depth, not the fleet-wide storm doc's, and a stream
+        longer than the T grid rides ONE scanned program
+        (serve_step.serve_paged_burst) instead of per-window passes.
+        The only fold/rescue-class event left is annotate-ring/overlap-
+        slot exhaustion (per-row, unfixable by capacity), handled by
+        rollback-from-pre-view + the host rescue."""
+        pg = self.pages
+        groups: Dict[int, List[Tuple[tuple, List[HostOp]]]] = {}
+        for key, ops in streams.items():
+            if key in self.opaque or not ops:
+                continue
+            self.lane_for(key)
+            self.mark_dirty(key)
+            pg.ensure_rows(key, pg.counts.get(key, 0) + 2 * len(ops))
+            p2 = pow2_pages(len(pg.tables[key]))
+            groups.setdefault(p2, []).append((key, ops))
+        for p2, items in sorted(groups.items()):
+            self._apply_group_paged(p2, items)
+
+    def _stage_paged_group(self, keys: List[tuple]):
+        """Pow2-padded staging planes for one page-bucket group:
+        (n_pad, pids [n_pad, p2], counts, mins, seqs — each [n_pad]).
+        Padding rows carry page id -1 (gathers the reserved blank page,
+        scatters out of bounds → dropped) and zeroed scalars: the ONE
+        padding convention every paged dispatch site shares (apply,
+        defrag tick, extract)."""
+        pg = self.pages
+        p2 = pow2_pages(max(len(pg.tables[k]) for k in keys))
+        n = len(keys)
+        n_pad = pow2_pages(n)  # next pow2: same bound as the page axis
+        pids = np.full((n_pad, p2), -1, np.int32)
+        pids[:n] = pg.page_ids_array(keys, p2)
+        counts = np.zeros(n_pad, np.int32)
+        mins = np.zeros(n_pad, np.int32)
+        seqs = np.zeros(n_pad, np.int32)
+        counts[:n], mins[:n], seqs[:n] = pg.scalars_arrays(keys)
+        return n_pad, pids, counts, mins, seqs
+
+    def _apply_group_paged(self, p2: int,
+                           items: List[Tuple[tuple, List[HostOp]]]) -> None:
+        pg = self.pages
+        max_t = self.t_buckets[-1]
+        keys = [k for k, _ in items]
+        longest = max(len(ops) for _, ops in items)
+        t = _bucket(min(longest, max_t), self.t_buckets)
+        k_chunks = -(-longest // t)
+        n = len(keys)
+        n_pad, pids, counts, mins, seqs = self._stage_paged_group(keys)
+        with tracing.span("serving.pack", hist="serving.pack",
+                          stage="paged-oppack", pages=p2):
+            pad_streams: List[List[HostOp]] = [ops for _, ops in items]
+            pad_streams += [[] for _ in range(n_pad - n)]
+            if k_chunks == 1:
+                staged = pack_ops(pad_streams, steps=t)
+            else:
+                # Chunk the streams on the T grid and stack the chunks
+                # into [K, B, T] planes: the scanned burst's xs. K pads
+                # to a power of two with all-NOOP chunks (an exact
+                # identity), bounding the compiled scan lengths.
+                k_pad = pow2_pages(k_chunks)
+                chunks = [pack_ops([s[c * t:(c + 1) * t]
+                                    for s in pad_streams], steps=t)
+                          for c in range(k_chunks)]
+                chunks += [pack_ops([[] for _ in pad_streams], steps=t)
+                           for _ in range(k_pad - k_chunks)]
+                staged = PackedOps(*[
+                    jnp.stack([getattr(c, f) for c in chunks])
+                    for f in PackedOps._fields])
+        with tracing.span("serving.dispatch", hist="serving.dispatch",
+                          stage="paged-apply", pages=p2):
+            args = (pg.pool, jnp.asarray(pids), jnp.asarray(counts),
+                    jnp.asarray(mins), jnp.asarray(seqs), staged)
+            if k_chunks == 1:
+                (pool2, _pids2, c2, m2, s2, over, pre) = \
+                    _apply_paged_probe(*args)
+            else:
+                from . import serve_step
+                (pool2, _pids2, c2, m2, s2, over, _over_k, pre) = \
+                    serve_step.serve_paged_burst(*args)
+            pg.pool = pool2
+        with tracing.span("serving.readback", hist="serving.readback",
+                          stage="paged-overflow", pages=p2):
+            over_np = np.asarray(over)[:n]
+            c2n = np.asarray(c2)[:n]
+            m2n = np.asarray(m2)[:n]
+            s2n = np.asarray(s2)[:n]
+        with tracing.span("serving.fold_rescue",
+                          hist="serving.fold_rescue", pages=p2):
+            good = np.flatnonzero(~over_np)
+            if good.size:
+                pg.adopt_scalars([keys[j] for j in good],
+                                 c2n[good], m2n[good], s2n[good])
+                for j in good.tolist():
+                    key = keys[j]
+                    pg.ops_since_compact[key] = \
+                        pg.ops_since_compact.get(key, 0) \
+                        + len(items[j][1])
+                # One batched zeroing scatter for the whole group: the
+                # 2-rows-per-op pre-grow means most multi-page docs free
+                # something every window.
+                pg.release_trailing_many(keys[j] for j in good.tolist())
+            flagged = np.flatnonzero(over_np).tolist()
+            if flagged:
+                self._recover_paged(keys, items, pids, pre, flagged)
+
+    def _recover_paged(self, keys, items, pids: np.ndarray, pre: DocState,
+                       flagged: List[int]) -> None:
+        """Rare unpredicted overflow (annotate ring / overlap slots):
+        roll the flagged docs' pages back from the retained pre-view
+        (one pow2-padded scatter), then host-rescue each with THIS
+        stream's ops — more pages cannot fix per-row ring exhaustion,
+        the host fold resolving rings into props can."""
+        pg = self.pages
+        tm = jax.tree_util.tree_map
+        self.fold_rescue_dispatches += 1
+        k = len(flagged)
+        k_pad = pow2_pages(k)
+        sel = np.asarray(flagged + [flagged[0]] * (k_pad - k), np.int64)
+        sub_pids = pids[sel].copy()
+        sub_pids[k:] = -1  # padding rows scatter out of bounds -> drop
+        sub_pre = tm(lambda x: x[jnp.asarray(sel)]
+                     if getattr(x, "ndim", 0) else x, pre)
+        pg.pool = kernel.rollback_pages(pg.pool, jnp.asarray(sub_pids),
+                                        sub_pre)
+        for j in flagged:
+            key = keys[j]
+            row = tm(lambda x: x[j] if getattr(x, "ndim", 0) else x, pre)
+            self.paged_rescues += 1
+            if self._rescue_paged(key, row, items[j][1]):
+                continue
+            self.where.pop(key, None)
+            pg.free_all(key)
+            self._forget_lane_payloads(key)
+            self.opaque.add(key)
+            self.overflow_drops += 1
+
+    def _rescue_paged(self, key: tuple, row: DocState, ops) -> bool:
+        """_rescue_lane's contract, page-backed: fold the pre-window row
+        on the host (annotate rings resolve into props, acked runs
+        coalesce), re-apply this stream's ops host-side, reseed into
+        exactly the pages the folded content needs."""
+        from ..mergetree.catchup import (Unmodelable, apply_host_ops,
+                                         coalesce_entries, extract_entries)
+        pg = self.pages
+        try:
+            mseq = int(np.asarray(row.min_seq))
+            cseq = int(np.asarray(row.seq))
+            entries = coalesce_entries(
+                extract_entries(row, self.payloads, mseq, fold=True))
+            new_entries = coalesce_entries(
+                apply_host_ops(entries, ops, self.payloads, mseq, cseq))
+        except (Unmodelable, ValueError):
+            return False
+        from ..mergetree.catchup import seed_host_cols
+        from ..mergetree.constants import DEV_UNASSIGNED, UNASSIGNED_SEQ
+        from ..mergetree.state import state_from_numpy
+        mseq2 = max([mseq] + [op.msn for op in ops])
+        cseq2 = max([cseq] + [op.seq for op in ops
+                              if op.seq not in (DEV_UNASSIGNED,
+                                                UNASSIGNED_SEQ)])
+        try:
+            cols = seed_host_cols(new_entries, self.payloads,
+                                  anno_slots=pg.anno_slots)
+        except (Unmodelable, ValueError):
+            return False
+        n = len(cols["length"])
+        capacity = pages_for(n, pg.page_rows) * pg.page_rows
+        row2 = state_from_numpy(
+            cols, capacity, anno_slots=pg.anno_slots)._replace(
+            min_seq=jnp.asarray(mseq2, jnp.int32),
+            seq=jnp.asarray(cseq2, jnp.int32))
+        pg.put_row(key, row2, count=n)
+        self.mark_dirty(key)
+        self.fold_rescue_dispatches += 1  # the per-lane put_row dispatch
+        self._swap_fold_payloads(key, self._seed_ids(cols))
+        pg.ops_since_compact.pop(key, None)
+        return True
+
+    def _compact_tick_paged(self) -> None:
+        """Page-granular zamboni tick: fully-dead trailing pages already
+        released at every apply, so this pass only defrags FRAGMENTED
+        documents — ranked by applied-op volume since their last pass
+        (the host-visible upper bound on new tombstones) — under the
+        same per-tick budget the bucketed fold uses, releasing whatever
+        pages the left-pack empties."""
+        pg = self.pages
+        cands = [key for key, v in pg.ops_since_compact.items()
+                 if v > 0 and key in pg.tables]
+        if not cands:
+            return
+        cands.sort(key=lambda k: -pg.ops_since_compact[k])
+        cands = cands[:self.fold_budget_per_tick]
+        groups: Dict[int, List[tuple]] = {}
+        for key in cands:
+            groups.setdefault(
+                pow2_pages(len(pg.tables[key])), []).append(key)
+        for _p2, keys in sorted(groups.items()):
+            n = len(keys)
+            _n_pad, pids, counts, mins, seqs = \
+                self._stage_paged_group(keys)
+            pool2, _, c2 = kernel.compact_pages(
+                pg.pool, jnp.asarray(pids), jnp.asarray(counts),
+                jnp.asarray(mins), jnp.asarray(seqs))
+            pg.pool = pool2
+            c2n = np.asarray(c2)[:n]
+            pg.adopt_scalars(keys, c2n, mins[:n], seqs[:n])
+            pg.release_trailing_many(keys)
+            for key in keys:
+                pg.ops_since_compact.pop(key, None)
+            self.page_compactions += n
+
+    def paged_stats(self) -> dict:
+        """The paged block's bench/monitor surface."""
+        pg = self.pages
+        return {
+            "pages_in_use": pg.pages_in_use,
+            "pool_pages": pg.allocator.capacity,
+            "page_fill_frac": round(pg.page_fill_frac(), 4),
+            "page_rows": pg.page_rows,
+            "paged_rescues": self.paged_rescues,
+            "page_compactions": self.page_compactions,
+            "pool_grows": pg.pool_grows,
+        }
+
     @staticmethod
     def _pad_pow2(sub: DocState, packed: PackedOps, n: int,
                   capacity: int):
@@ -753,6 +1081,7 @@ class MergeLaneStore:
         packed = pack_ops([lane_ops[i] for i in lanes], steps=t)
         sub, packed = self._pad_pow2(sub, packed, n, bucket.capacity)
         # Attempt 1: compact in place and re-run at this capacity.
+        self.fold_rescue_dispatches += 1
         compacted = kernel.compact_batched(sub)
         redone = _apply_keep_batched(compacted, packed)
         over = np.asarray(redone.overflow)
@@ -803,6 +1132,7 @@ class MergeLaneStore:
             target = self.buckets[nb]
             wide = _repad_batch(src, target.capacity)
             wide, packed = self._pad_pow2(wide, packed, n, target.capacity)
+            self.fold_rescue_dispatches += 1
             redone = _apply_keep_batched(wide, packed)
             over = np.asarray(redone.overflow)
             ok_k = [k for k in range(len(carried)) if not over[k]]
@@ -876,6 +1206,7 @@ class MergeLaneStore:
         sub_packed = tm(lambda x: x[psel], packed)
         rows, sub_packed = self._pad_pow2(rows, sub_packed, len(folded),
                                           bucket.capacity)
+        self.fold_rescue_dispatches += 1
         redone = _apply_keep_batched(rows, sub_packed)
         over = np.asarray(redone.overflow)
         adopted = [k for k in range(len(folded)) if not over[k]]
@@ -957,13 +1288,23 @@ class MergeLaneStore:
         bucket.put_row(lane, row2, count_hint=len(new_entries))
         self.where[key] = (nb, lane)
         self.mark_dirty(key)
+        self.fold_rescue_dispatches += 1
         self._swap_fold_payloads(key, self._seed_ids(cols))
         return True
 
     def compact_all(self) -> None:
         """Zamboni every bucket (reference mergeTree.ts:1422, run between
         batches so the gather cost amortizes, kernel.py design note),
-        then pack crowded lanes host-side."""
+        then pack crowded lanes host-side. Paged mode replaces both
+        halves with the page-granular tick: no whole-fleet compaction
+        pass, no host folds."""
+        if self.paged:
+            self._compact_tick_paged()
+            self._age_blocks()
+            self._ticks_since_payload_compact += 1
+            self.maybe_compact_payload_ids()
+            self.flushes_since_compact = 0
+            return
         for bucket in self.buckets:
             if any(k is not None for k in bucket.used):
                 bucket.state = kernel.compact_batched(bucket.state)
@@ -1069,6 +1410,11 @@ class MergeLaneStore:
             if not cands:
                 continue
             take = jnp.asarray(np.asarray(cands, np.int32))
+            # One DEVICE dispatch per candidate slice (the unit
+            # fold_rescue_dispatches counts everywhere — the paged
+            # smoke's ceremony-cut gate compares it across engines, so
+            # per-key counting here would inflate the bucketed side).
+            self.fold_rescue_dispatches += 1
             sub = jax.device_get(tm(
                 lambda x: x[take] if getattr(x, "ndim", 0) else x,
                 bucket.state))
@@ -1125,6 +1471,7 @@ class MergeLaneStore:
                 bucket.free_many(freed)
         for nb, items in dest.items():
             target = self.buckets[nb]
+            self.fold_rescue_dispatches += 1  # one batched put per dest
             lanes = target.alloc_many([key for key, *_ in items])
             target.put_rows(lanes, _stack_seed_rows(
                 items, target.capacity, target.state.anno_slots,
@@ -1155,6 +1502,8 @@ class MergeLaneStore:
         extraction compute AND the D2H transfer scale with the dirty
         count, not the fleet size. `only` further restricts the keys
         considered. Returns (jobs, cached_snapshots)."""
+        if self.paged:
+            return self._extract_dispatch_paged(only, chunk_chars)
         jobs = []
         cached: Dict[tuple, dict] = {}
         for bucket in self.buckets:
@@ -1196,6 +1545,55 @@ class MergeLaneStore:
                              [(j, key) for j, (_, key)
                               in enumerate(lanes)],
                              sub.seq, sub.min_seq, gens))
+        if cached:
+            increment("summarize.blob_cache.hits", len(cached))
+        return jobs, cached
+
+    def _extract_dispatch_paged(self, only: Optional[set],
+                                chunk_chars: int) -> tuple:
+        """Paged phase-1 extraction: dirty lanes group by their pow2
+        page bucket and each group runs ONE fused zamboni+extract over
+        gathered page views (kernel.compact_extract_paged, pool adopted
+        in place). The packed rows keep the extract_visible_batched
+        layout, so phase 2 (extract_assemble / assemble_snapshot) runs
+        unchanged. Counts adopt synchronously — the host scalar mirrors
+        are authoritative and the next apply's page pre-growth proof
+        reads them — then trailing pages release: a summarize pass IS
+        these lanes' zamboni, exactly like the bucketed fuse."""
+        pg = self.pages
+        jobs = []
+        cached: Dict[tuple, dict] = {}
+        lanes: List[tuple] = []
+        for key in list(self.where):
+            if key not in pg.tables:
+                continue
+            if only is not None and key not in only:
+                continue
+            hit = self._snap_cache.get(key)
+            if hit is not None and hit[0] == self.change_gen.get(key, 0) \
+                    and hit[1] == chunk_chars:
+                cached[key] = hit[2]
+                continue
+            lanes.append(key)
+        groups: Dict[int, List[tuple]] = {}
+        for key in lanes:
+            groups.setdefault(
+                pow2_pages(len(pg.tables[key])), []).append(key)
+        for _p2, keys in sorted(groups.items()):
+            gens = {key: self.change_gen.get(key, 0) for key in keys}
+            n = len(keys)
+            _n_pad, pids, counts, mins, seqs = \
+                self._stage_paged_group(keys)
+            pool2, _, c2, packed = kernel.compact_extract_paged(
+                pg.pool, jnp.asarray(pids), jnp.asarray(counts),
+                jnp.asarray(mins), jnp.asarray(seqs))
+            pg.pool = pool2
+            c2n = np.asarray(c2)[:n]
+            pg.adopt_scalars(keys, c2n, mins[:n], seqs[:n])
+            pg.release_trailing_many(keys)
+            for key in keys:
+                pg.ops_since_compact.pop(key, None)
+            jobs.append((packed, list(enumerate(keys)), seqs, mins, gens))
         if cached:
             increment("summarize.blob_cache.hits", len(cached))
         return jobs, cached
@@ -1259,8 +1657,10 @@ class MergeLaneStore:
         from ..mergetree.host import NonTextPayload
 
         b, lane = self.where[key]
+        row = self.pages.row(key) if self.paged \
+            else self.buckets[b].row(lane)
         try:
-            return extract_text(self.buckets[b].row(lane), self.payloads)
+            return extract_text(row, self.payloads)
         except NonTextPayload:  # items/run lane: not a text channel
             return None
 
@@ -1273,7 +1673,8 @@ class MergeLaneStore:
         if key not in self.where:
             return None
         b, lane = self.where[key]
-        row = self.buckets[b].row(lane)
+        row = self.pages.row(key) if self.paged \
+            else self.buckets[b].row(lane)
         return extract_entries(row, self.payloads,
                                int(np.asarray(row.min_seq)))
 
@@ -1571,7 +1972,7 @@ class LwwLaneStore:
 
     def __init__(self, capacities: Tuple[int, ...] = (64, 1024, 16384),
                  lanes_per_bucket: int = 8,
-                 t_buckets: Tuple[int, ...] = (1, 4, 16, 64, 256)):
+                 t_buckets: Tuple[int, ...] = DEFAULT_T_BUCKETS):
         from . import lww_kernel as lk
 
         self.lk = lk
@@ -2237,9 +2638,10 @@ class TpuSequencerLambda(IPartitionLambda):
                  checkpoints=None, deltas=None, fresh_log: bool = False,
                  materialize: bool = True,
                  merge_store: Optional[MergeLaneStore] = None,
-                 t_buckets: Tuple[int, ...] = (1, 4, 16, 64, 256),
+                 t_buckets: Tuple[int, ...] = DEFAULT_T_BUCKETS,
                  storage=None, client_timeout_s: float = 300.0,
-                 send_system=None, config=None, mesh=None):
+                 send_system=None, config=None, mesh=None,
+                 paged_lanes: bool = False):
         """storage: optional callable doc_id -> SummaryTree | None (the
         historian's latest summary). Enables snapshot seeding: merge lanes
         for channels whose base content shipped in a summary bootstrap
@@ -2296,8 +2698,12 @@ class TpuSequencerLambda(IPartitionLambda):
         self.pending: Dict[str, List[_Pending]] = {}
         self.materialize = materialize
         self.merge = merge_store if merge_store is not None else \
-            MergeLaneStore(t_buckets=t_buckets)
+            MergeLaneStore(t_buckets=t_buckets, paged=paged_lanes)
         self.lww = LwwLaneStore(t_buckets=t_buckets)
+        if getattr(self.merge, "paged", False) and mesh is not None:
+            raise ValueError(
+                "paged merge lanes are single-chip for now: the page "
+                "pool has no dp placement rule yet (docs/paged_memory.md)")
         if mesh is not None:
             dp = int(mesh.shape.get("dp", 1))
             for bucket in self.merge.buckets + self.lww.buckets:
@@ -2409,16 +2815,29 @@ class TpuSequencerLambda(IPartitionLambda):
         # Directory lanes: lane key -> set of existing subdirectory paths
         # (host structure; rebuilt by replay, seeded from summaries).
         self._dir_paths: Dict[tuple, set] = {}
-        try:
-            from . import pump as _pump_mod
-            if _pump_mod.available():
-                self._pump = _pump_mod.WirePump()
-        except (ImportError, OSError, RuntimeError):
-            # No toolchain: object path only. Counted so a fleet that
-            # SHOULD be on the native pump shows the regression on
-            # /healthz instead of just running slow.
-            record_swallow("sequencer.pump_unavailable")
-            self._pump = None
+        if getattr(self.merge, "paged", False):
+            # Paged lane memory serves through the OBJECT path: raw wire
+            # frames decode per message (handler_raw's pump-less branch)
+            # and every merge apply runs gather-by-page-id windows /
+            # scanned paged bursts via MergeLaneStore.apply. The
+            # bucket-grid fast-flush machinery (_flush_raw staging,
+            # per-bucket donated windows) never engages — it indexes
+            # merge.buckets, which a paged store doesn't have. Don't
+            # even construct the pump (loading the native toolchain to
+            # throw it away), and don't record the pump_unavailable
+            # health swallow for a config that never wanted one.
+            pass
+        else:
+            try:
+                from . import pump as _pump_mod
+                if _pump_mod.available():
+                    self._pump = _pump_mod.WirePump()
+            except (ImportError, OSError, RuntimeError):
+                # No toolchain: object path only. Counted so a fleet
+                # that SHOULD be on the native pump shows the
+                # regression on /healthz instead of just running slow.
+                record_swallow("sequencer.pump_unavailable")
+                self._pump = None
         self._restore()
 
     # -- checkpoint/restore ------------------------------------------------

@@ -1,0 +1,458 @@
+"""Paged lane memory (docs/paged_memory.md): the page allocator's
+free-list/refcount invariants, gather/scatter-by-page-id conformance
+against both the scalar oracle and the bucketed lane store, page-granular
+compaction, the annotate-ring rescue, and run-twice determinism of paged
+serving under a seeded FaultPlan.
+
+The conformance bar mirrors tests/test_kernel.py: on a storm-doc ragged
+fleet (one deep document atop many keystroke documents — exactly the
+workload the bucket grid pads worst), every channel's text at every
+perspective and every assembled snapshot must be identical whether the
+rows live in capacity buckets or pages."""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from test_kernel import GOD, apply_to_oracle, random_schedule
+
+from fluidframework_tpu.mergetree import MergeTreeOracle
+from fluidframework_tpu.mergetree.constants import PAGE_ROWS
+from fluidframework_tpu.mergetree.host import GOD_CLIENT
+from fluidframework_tpu.mergetree.paging import (
+    BLANK_PAGE,
+    PageAllocator,
+    PagedMergeStore,
+    pages_for,
+    pow2_pages,
+)
+from fluidframework_tpu.protocol.messages import (
+    Boxcar,
+    DocumentMessage,
+    MessageType,
+)
+from fluidframework_tpu.server.log import QueuedMessage
+from fluidframework_tpu.server.tpu_sequencer import (
+    MergeLaneStore,
+    TpuSequencerLambda,
+)
+from fluidframework_tpu.server.wire import boxcar_to_wire
+from fluidframework_tpu.telemetry import counters
+
+
+# ---------------------------------------------------------------------------
+# allocator invariants
+# ---------------------------------------------------------------------------
+
+class TestPageAllocator:
+    def test_alloc_free_and_reuse(self):
+        a = PageAllocator(8)
+        pids = [a.alloc() for _ in range(4)]
+        assert len(set(pids)) == 4 and BLANK_PAGE not in pids
+        assert a.pages_in_use == 4
+        freed = pids[1]
+        assert a.release(freed) is True
+        assert a.pages_in_use == 3
+        # The freed page is reusable (free list hands it back first).
+        assert a.alloc() == freed
+
+    def test_double_free_raises(self):
+        a = PageAllocator(4)
+        pid = a.alloc()
+        assert a.release(pid)
+        with pytest.raises(ValueError, match="double free"):
+            a.release(pid)
+
+    def test_blank_and_out_of_range_ids_refuse(self):
+        a = PageAllocator(4)
+        with pytest.raises(ValueError):
+            a.release(BLANK_PAGE)
+        with pytest.raises(ValueError):
+            a.release(99)
+        with pytest.raises(ValueError):
+            a.retain(0)
+
+    def test_refcounted_share_frees_on_last_release(self):
+        a = PageAllocator(4)
+        pid = a.alloc()
+        a.retain(pid)
+        assert a.release(pid) is False  # still one owner
+        assert a.pages_in_use == 1
+        assert a.release(pid) is True
+        with pytest.raises(ValueError, match="double free"):
+            a.release(pid)
+
+    def test_grow_extends_free_list(self):
+        a = PageAllocator(4)
+        got = {a.alloc() for _ in range(3)}  # pool (minus blank) full
+        with pytest.raises(IndexError):
+            a.alloc()
+        a.grow(8)
+        more = {a.alloc() for _ in range(4)}
+        assert not (got & more)
+        assert a.pages_in_use == 7
+
+
+# ---------------------------------------------------------------------------
+# page-table storage
+# ---------------------------------------------------------------------------
+
+class TestPagedStore:
+    def test_growth_appends_pages_without_moving(self):
+        pg = PagedMergeStore(page_rows=8, pages=8)
+        key = ("d", "s", "t")
+        pg.ensure_rows(key, 5)
+        first = list(pg.tables[key])
+        pg.ensure_rows(key, 30)  # 4 pages
+        assert pg.tables[key][:len(first)] == first  # prefix stable
+        assert len(pg.tables[key]) == pages_for(30, 8) == 4
+
+    def test_pool_doubles_when_exhausted(self):
+        pg = PagedMergeStore(page_rows=8, pages=4)
+        pg.ensure_rows(("k",), 8 * 10)
+        assert pg.allocator.capacity >= 16
+        assert pg.pool_grows >= 1
+
+    def test_release_trailing_frees_and_zeroes(self):
+        pg = PagedMergeStore(page_rows=8, pages=8)
+        key = ("d", "s", "t")
+        pg.ensure_rows(key, 32)
+        dead = pg.tables[key][1:]
+        pg.counts[key] = 3  # one page's worth live
+        pg.release_trailing(key)
+        assert len(pg.tables[key]) == 1
+        assert pg.allocator.pages_in_use == 1
+        # Freed pages are blank: a fresh alloc hands out canonical rows.
+        pool_len = np.asarray(pg.pool.length)
+        for pid in dead:
+            assert (pool_len[pid] == 0).all()
+
+    def test_pow2_pages_bounds_view_shapes(self):
+        assert [pow2_pages(n) for n in (1, 2, 3, 5, 9)] == [1, 2, 4, 8, 16]
+
+
+def _stream(builder, schedule):
+    """test_kernel op tuples -> HostOps via the store's shared builder."""
+    out = []
+    for op in schedule:
+        kind = op[0]
+        if kind == "insert":
+            _, pos, text, ref_seq, client, seq = op
+            out.append(builder.insert_text(pos, text, ref_seq, client, seq))
+        elif kind == "remove":
+            _, start, end, ref_seq, client, seq = op
+            out.append(builder.remove(start, end, ref_seq, client, seq))
+        else:
+            _, start, end, props, ref_seq, client, seq = op
+            out.append(builder.annotate(start, end, props, ref_seq,
+                                        client, seq))
+    return out
+
+
+def _ragged_fleet(seed, storm_ops=120, fleet=24, fleet_ops=4):
+    """One storm doc + a fleet of keystroke docs, per-doc sequenced
+    schedules (the bucket grid's worst case: every bucketed lane pads
+    toward the storm doc's depth)."""
+    rng = random.Random(seed)
+    docs = {("doc", "s", "storm"): random_schedule(rng, 3, storm_ops)}
+    for i in range(fleet):
+        docs[("doc", "s", f"k{i}")] = random_schedule(rng, 2, fleet_ops)
+    return docs
+
+
+class TestPagedConformance:
+    @pytest.mark.parametrize("seed", [1, 7, 23])
+    def test_storm_fleet_matches_oracle_and_bucketed(self, seed):
+        schedules = _ragged_fleet(seed)
+        paged = MergeLaneStore(paged=True, page_rows=16)
+        bucketed = MergeLaneStore()
+        streams_p = {k: _stream(paged.builder, s)
+                     for k, s in schedules.items()}
+        streams_b = {k: _stream(bucketed.builder, s)
+                     for k, s in schedules.items()}
+        paged.apply(streams_p)
+        bucketed.apply(streams_b)
+
+        for key, schedule in schedules.items():
+            oracle = MergeTreeOracle(local_client=GOD)
+            apply_to_oracle(oracle, schedule)
+            top_seq = max(op[-1] for op in schedule)
+            perspectives = [(top_seq, GOD)] + [
+                (max(0, top_seq - d), GOD) for d in (1, 3, 7)]
+            text_p = paged.text(key)
+            assert text_p == bucketed.text(key)
+            assert text_p == oracle.get_text(ref_seq=top_seq, client=GOD)
+            # Entry-level (props included) equality paged vs bucketed.
+            ep = paged.entries(key)
+            eb = bucketed.entries(key)
+            assert ep == eb
+            del perspectives  # latest-view text is the cross-engine bar
+
+        # Assembled snapshots — the wire-visible artifact — identical.
+        snaps_p = paged.extract_all()
+        snaps_b = bucketed.extract_all()
+        assert snaps_p == snaps_b
+        # The paged fleet never pays CAPACITY ceremony (folds,
+        # promotions, overflow drops). Annotate-ring exhaustion — the
+        # per-row overflow class pages cannot fix — may still rescue,
+        # but never more often than the bucketed run, which adds its
+        # capacity recoveries on top.
+        assert paged.folds == 0
+        assert paged.overflow_drops == 0
+        assert paged.fold_rescue_dispatches <= \
+            bucketed.fold_rescue_dispatches
+
+    def test_chunked_stream_rides_one_scanned_burst(self):
+        """A stream longer than the widest T bucket applies through
+        serve_step.serve_paged_burst (stacked [K, B, T] chunks, one
+        scan) and must match the bucketed chunked applier exactly."""
+        paged = MergeLaneStore(paged=True)
+        bucketed = MergeLaneStore()
+        key = ("doc", "s", "bulk")
+        n = 700  # > max_t=256 -> K=4 chunks, padded to 4
+
+        def ops(b):
+            out = [b.insert_text(0, "seed ", 0, GOD_CLIENT, 1)]
+            for i in range(n):
+                out.append(b.insert_text(min(i, 3), "ab", i + 1,
+                                         GOD_CLIENT, i + 2))
+            return out
+
+        paged.apply({key: ops(paged.builder)})
+        bucketed.apply({key: ops(bucketed.builder)})
+        assert paged.text(key) == bucketed.text(key)
+        assert len(paged.text(key)) == 5 + 2 * n
+
+    def test_annotate_ring_exhaustion_takes_host_rescue(self):
+        """>anno_slots annotates on one segment in a single window
+        exhaust the per-row ring — the one overflow class pages cannot
+        fix. The paged path must rollback + host-fold (rings resolve
+        into props) and end bit-identical to the bucketed rescue."""
+        paged = MergeLaneStore(paged=True)
+        bucketed = MergeLaneStore()
+        key = ("doc", "s", "anno")
+
+        def ops(b):
+            out = [b.insert_text(0, "abcdef", 0, GOD_CLIENT, 1)]
+            for i in range(6):  # DEFAULT_ANNO_SLOTS=4 -> ring exhausts
+                out.append(b.annotate(0, 6, {f"k{i}": i}, 1, GOD_CLIENT,
+                                      2 + i))
+            return out
+
+        paged.apply({key: ops(paged.builder)})
+        bucketed.apply({key: ops(bucketed.builder)})
+        assert paged.paged_rescues >= 1
+        assert paged.text(key) == bucketed.text(key) == "abcdef"
+        assert paged.extract_all() == bucketed.extract_all()
+
+    def test_mid_burst_ring_overflow_rolls_back_and_rescues(self):
+        """Ring exhaustion in a LATER chunk of a scanned burst: the
+        overflow flag is sticky across the scan carry, the flagged doc
+        rolls back to the retained PRE-BURST view, and the host rescue
+        re-applies the full stream — content identical to bucketed."""
+        from fluidframework_tpu.mergetree.host import (
+            flatten_snapshot_content)
+
+        def ops(b):
+            out = []
+            seq = 0
+            for _ in range(300):  # > max_t -> K=2 scanned chunks
+                seq += 1
+                out.append(b.insert_text(0, "y", seq - 1, GOD_CLIENT,
+                                         seq))
+            for i in range(6):  # ring exhausts in chunk 2
+                seq += 1
+                out.append(b.annotate(0, 4, {f"k{i}": i}, seq - 1,
+                                      GOD_CLIENT, seq))
+            return out
+
+        paged = MergeLaneStore(paged=True)
+        bucketed = MergeLaneStore()
+        key = ("d", "s", "burst-anno")
+        paged.apply({key: ops(paged.builder)})
+        bucketed.apply({key: ops(bucketed.builder)})
+        assert paged.paged_rescues >= 1
+        assert paged.text(key) == bucketed.text(key)
+        assert flatten_snapshot_content(paged.extract_all()[key]) \
+            == flatten_snapshot_content(bucketed.extract_all()[key])
+
+    def test_seed_then_apply_matches_bucketed(self):
+        """Snapshot-seeded lanes (attach-time content) bootstrap into
+        pages and serve follow-on ops identically to bucket seeding."""
+        entries = [{"text": "hello paged world", "props": {"x": 1}}]
+        paged = MergeLaneStore(paged=True)
+        bucketed = MergeLaneStore()
+        key = ("doc", "s", "seeded")
+        assert paged.seed(key, entries, 0, 4)
+        assert bucketed.seed(key, entries, 0, 4)
+        paged.apply({key: [paged.builder.remove(0, 6, 4, GOD_CLIENT, 5)]})
+        bucketed.apply({key: [bucketed.builder.remove(0, 6, 4, GOD_CLIENT,
+                                                      5)]})
+        assert paged.text(key) == bucketed.text(key) == "paged world"
+
+    def test_fragmentation_then_compact_releases_pages(self):
+        """Insert deep, remove most, advance the MSN past the removes:
+        the budgeted page-granular zamboni left-packs the survivors and
+        the trailing release returns the emptied pages to the pool —
+        text untouched."""
+        store = MergeLaneStore(paged=True, page_rows=16)
+        key = ("doc", "s", "frag")
+        b = store.builder
+        ops = []
+        seq = 0
+        for i in range(80):
+            seq += 1
+            ops.append(b.insert_text(i, "x", seq - 1, GOD_CLIENT, seq))
+        store.apply({key: ops})
+        pages_before = len(store.pages.tables[key])
+        assert pages_before >= 5
+        seq += 1
+        # One remove of almost everything, msn stamped PAST it so the
+        # tombstones are zamboni-eligible immediately.
+        store.apply({key: [b.remove(0, 76, seq - 1, GOD_CLIENT, seq,
+                                    msn=seq)]})
+        store._compact_tick_paged()
+        assert store.text(key) == "xxxx"
+        assert len(store.pages.tables[key]) < pages_before
+        assert store.pages.counts[key] <= 16
+        assert store.page_compactions >= 1
+
+    def test_warm_paged_apply_does_not_retrace(self):
+        """Same (docs, pages, T) shape applied repeatedly must hit the
+        jit cache: pow2 padding is the retrace bound, probed as
+        kernel.paged_apply.* (the static rule's runtime cross-check)."""
+        store = MergeLaneStore(paged=True)
+        b = store.builder
+        seq = 0
+
+        def wave():
+            nonlocal seq
+            out = {}
+            for d in range(3):
+                key = ("doc", "s", f"w{d}")
+                ops = []
+                for _ in range(2):
+                    seq += 1
+                    ops.append(b.insert_text(0, "a", seq - 1, GOD_CLIENT,
+                                             seq))
+                out[key] = ops
+            return out
+
+        store.apply(wave())  # compile
+        counters.reset()
+        for _ in range(4):
+            store.apply(wave())
+        assert counters.get("kernel.paged_apply.retraces") == 0
+
+
+# ---------------------------------------------------------------------------
+# paged serving end to end (object path through TpuSequencerLambda)
+# ---------------------------------------------------------------------------
+
+class _Ctx:
+    def checkpoint(self, *_):
+        pass
+
+    def error(self, err, restart=False):
+        raise err
+
+
+def _lam(emit, paged):
+    return TpuSequencerLambda(
+        _Ctx(), emit=emit, nack=lambda *a: None, client_timeout_s=0.0,
+        paged_lanes=paged)
+
+
+def _qm(offset, doc, box):
+    return QueuedMessage(topic="rawdeltas", partition=0, offset=offset,
+                         key=doc, value=boxcar_to_wire(box))
+
+
+def _join(cid):
+    return DocumentMessage(0, -1, MessageType.CLIENT_JOIN,
+                           data=json.dumps({"clientId": cid,
+                                            "detail": {}}))
+
+
+def _insert(csn, pos, text):
+    from fluidframework_tpu.mergetree.client import OP_INSERT
+    return DocumentMessage(
+        client_sequence_number=csn, reference_sequence_number=csn - 1,
+        type=MessageType.OPERATION,
+        contents={"address": "s", "contents": {
+            "address": "t", "contents": {
+                "type": OP_INSERT, "pos1": pos, "seg": {"text": text}}}})
+
+
+def _emit_key(doc_id, m):
+    return (doc_id, m.sequence_number, m.minimum_sequence_number,
+            m.client_id, m.client_sequence_number)
+
+
+def _waves(n_waves=5, docs=3, storm_ops=6, fleet_ops=1):
+    waves = []
+    csn = {d: 0 for d in range(docs)}
+    for w in range(n_waves):
+        wave = []
+        for d in range(docs):
+            doc = f"p{d}"
+            n = storm_ops if d == 0 else fleet_ops
+            msgs = [] if w else [_join(f"c{d}")]
+            for _ in range(n):
+                csn[d] += 1
+                msgs.append(_insert(csn[d], 0, f"{csn[d] % 10}"))
+            wave.append((doc, Boxcar("t", doc, f"c{d}", msgs)))
+        waves.append(wave)
+    return waves
+
+
+def _drive(paged, stall=None):
+    emits = []
+    lam = _lam(lambda doc, m: emits.append(_emit_key(doc, m)), paged)
+    if stall is not None:
+        lam.stall_hook = stall
+    off = 0
+    for wave in _waves():
+        for doc, box in wave:
+            lam.handler_raw(_qm(off, doc, box))
+            off += 1
+        lam.flush()
+    lam.drain()
+    texts = {d: lam.channel_text(d, "s", "t") for d in ("p0", "p1", "p2")}
+    return lam, emits, texts
+
+
+class TestPagedServing:
+    def test_paged_sequencer_emits_identical_to_bucketed(self):
+        """The serving contract: emit stream (ORDER included) and
+        materialized channel text identical across storage engines."""
+        _, emits_b, texts_b = _drive(paged=False)
+        lam, emits_p, texts_p = _drive(paged=True)
+        assert emits_p == emits_b
+        assert texts_p == texts_b
+        assert lam.merge.paged
+        assert lam.merge.paged_stats()["pages_in_use"] >= 1
+
+    def test_faultplan_paged_serving_run_twice_deterministic(self):
+        """A seeded FaultPlan stalling the flush must reproduce the
+        paged serving run bit-identically: same fault trace
+        fingerprint, same emitted stream, same channel text, same page
+        bookkeeping."""
+        from fluidframework_tpu.testing import faultinject
+
+        def once():
+            plan = faultinject.FaultPlan(seed=4242, stall=1.0,
+                                         stall_range_ms=(0.05, 0.2))
+            lam, emits, texts = _drive(
+                paged=True, stall=lambda: faultinject.stall(plan))
+            return (emits, texts, plan.fingerprint(),
+                    lam.merge.paged_stats())
+
+        emits_a, texts_a, fp_a, stats_a = once()
+        emits_b, texts_b, fp_b, stats_b = once()
+        assert fp_a == fp_b
+        assert emits_a == emits_b
+        assert texts_a == texts_b
+        assert stats_a == stats_b

@@ -424,6 +424,102 @@ class TestScanHostCallback:
         assert hits == []
 
 
+class TestPageIdDtype:
+    def test_true_positive_int64_page_table(self):
+        src = """
+            import numpy as np
+
+            def stage(table):
+                page_ids = np.asarray(table, np.int64)
+                return page_ids
+        """
+        assert rule_ids(src, "PAGE_ID_DTYPE") == ["PAGE_ID_DTYPE"]
+
+    def test_true_positive_astype_and_kernel_operand(self):
+        src = """
+            import numpy as np
+            import jax.numpy as jnp
+            from fluidframework_tpu.mergetree import kernel
+
+            def dispatch(pool, pids, counts, mins, seqs, ops):
+                wide = pids.astype(np.int64)
+                return kernel.apply_ops_paged(
+                    pool, jnp.asarray(wide, jnp.int16), counts, mins,
+                    seqs, ops)
+        """
+        assert rule_ids(src, "PAGE_ID_DTYPE") == \
+            ["PAGE_ID_DTYPE", "PAGE_ID_DTYPE"]
+
+    def test_true_positive_string_dtype_keyword(self):
+        src = """
+            import numpy as np
+
+            def build(n):
+                page_table = np.zeros(n, dtype="int16")
+                return page_table
+        """
+        assert rule_ids(src, "PAGE_ID_DTYPE") == ["PAGE_ID_DTYPE"]
+
+    def test_true_positive_tuple_unpack_target(self):
+        src = """
+            import numpy as np
+
+            def stage(table):
+                pids, n = np.asarray(table, np.int64), len(table)
+                return pids, n
+        """
+        assert rule_ids(src, "PAGE_ID_DTYPE") == ["PAGE_ID_DTYPE"]
+
+    def test_true_positive_uint32_kills_padding_sentinel(self):
+        """uint32 is 32 bits wide but turns the -1 padding sentinel into
+        4294967295 — the scatter drop-guard (page_ids >= 0) goes
+        vacuously true and padding rows overwrite a real page."""
+        src = """
+            import numpy as np
+
+            def stage(table):
+                pids = np.asarray(table, np.uint32)
+                return pids
+        """
+        assert rule_ids(src, "PAGE_ID_DTYPE") == ["PAGE_ID_DTYPE"]
+
+    def test_guard_int32_page_ids_quiet(self):
+        src = """
+            import numpy as np
+            import jax.numpy as jnp
+
+            def stage(table, flagged):
+                page_ids = np.full((4, 8), -1, np.int32)
+                pids = jnp.asarray(page_ids)
+                sel = np.asarray(flagged, np.int64)  # not page-named
+                return pids, sel
+        """
+        assert rule_ids(src, "PAGE_ID_DTYPE") == []
+
+    def test_guard_unrelated_int64_names_quiet(self):
+        src = """
+            import numpy as np
+
+            def hints(lanes):
+                count_hint = np.zeros(lanes, np.int64)
+                page_fill = float(count_hint.sum())
+                return page_fill
+        """
+        assert rule_ids(src, "PAGE_ID_DTYPE") == []
+
+    def test_out_of_scope_module_is_quiet(self):
+        src = textwrap.dedent("""
+            import numpy as np
+
+            def stage(table):
+                page_ids = np.asarray(table, np.int64)
+                return page_ids
+        """)
+        hits = analyze_source(src, path="examples/clicker.py",
+                              only=["PAGE_ID_DTYPE"])
+        assert hits == []
+
+
 # ---------------------------------------------------------------------------
 # CC family
 # ---------------------------------------------------------------------------
