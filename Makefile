@@ -3,18 +3,38 @@
 
 .PHONY: test soak bench dryrun record-corpus historian-smoke \
 	summarize-smoke trace-smoke pipeline-smoke fused-smoke \
-	paged-smoke lint-analysis check
+	paged-smoke lint-analysis lint-changed layer-check check
 
 test:
 	python -m pytest tests/ -q
 
-# fluidlint: the AST-based JAX-kernel & server-concurrency analyzer
+# fluidlint: the AST + whole-program dataflow analyzer
 # (fluidframework_tpu/analysis/, docs/static_analysis.md). Exits non-zero
 # on any violation that is neither suppressed inline nor baselined; the
 # last output line is the machine-readable trend summary
-# {"violations": N, "baselined": M}.
+# {"violations": N, "baselined": M}. Incremental runs ride the
+# fingerprint cache (.fluidlint_cache.json); the analyzer perf record
+# (wall time, cache hits, counts) lands in BENCH_LINT_LAST.json so the
+# bench tooling can stamp the trend.
 lint-analysis:
-	python -m fluidframework_tpu.analysis fluidframework_tpu/
+	python -m fluidframework_tpu.analysis fluidframework_tpu/ \
+		--bench-json BENCH_LINT_LAST.json
+
+# Fast pre-commit scope: report only on files git sees as changed
+# (worktree vs HEAD + untracked) while the whole-program layer still
+# spans the package, so a donation-signature edit still re-checks its
+# callers' files when they are in the diff.
+lint-changed:
+	python -m fluidframework_tpu.analysis fluidframework_tpu/ \
+		--changed-only
+
+# Machine-enforced layering + import-time cycle detection
+# (tools/layer_check.py): the dependency-DAG gate the reference repo
+# runs as a build step, promoted from test-only to a first-class
+# `make check` stage. Cycles are hard failures with the offending edge
+# printed.
+layer-check:
+	python -m fluidframework_tpu.tools.layer_check
 
 # CPU smoke of the incremental summarize path: tiny batch, 100%- vs
 # 1%-dirty fused extraction, narrow-wire byte drop + bit-identity, and
@@ -68,10 +88,10 @@ paged-smoke:
 overload-smoke:
 	JAX_PLATFORMS=cpu python bench.py overload-smoke
 
-# The pre-merge gate: static analysis + the summarize/trace/pipeline/
-# fused/overload smokes + the full test suite.
-check: lint-analysis summarize-smoke trace-smoke pipeline-smoke \
-		fused-smoke paged-smoke overload-smoke test
+# The pre-merge gate: layering/cycles + static analysis + the
+# summarize/trace/pipeline/fused/overload smokes + the full test suite.
+check: layer-check lint-analysis summarize-smoke trace-smoke \
+		pipeline-smoke fused-smoke paged-smoke overload-smoke test
 
 # The round-end randomized-evidence ritual: 50-trial soaks over every
 # differential surface (bulk catch-up, serving fast path, matrix/

@@ -531,6 +531,27 @@ def _matrix_serving_ingest_rate(docs: int = 1024,
     }
 
 
+def _lint_analysis_record() -> dict:
+    """The analyzer perf record `make lint-analysis` drops
+    (BENCH_LINT_LAST.json via --bench-json): wall time, cache
+    hits/misses, and violation/baseline counts ride every bench record
+    so the static-analysis gate's cost is a tracked trend, not an
+    invisible tax. Null fields when the record has never been
+    written."""
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_LINT_LAST.json")
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+    except (OSError, ValueError):
+        return {"wall_ms": None, "cache_hits": None,
+                "cache_misses": None, "violations": None,
+                "baselined": None}
+    return {k: rec.get(k) for k in ("wall_ms", "cache_hits",
+                                    "cache_misses", "violations",
+                                    "baselined")}
+
+
 def _recorded_replay_rate() -> dict:
     """Replay the RECORDED session corpora (tests/corpus/ — real
     multi-client sessions captured through the alfred websocket stack,
@@ -1079,6 +1100,10 @@ def main() -> None:
                 "ragged_ops_per_sec": partial_extra.get(
                     "paged_ragged_ops_per_sec"),
             },
+            # Analyzer trend (ISSUE 9): the last `make lint-analysis`
+            # run's wall time, cache effectiveness, and counts, read
+            # from the record the CLI drops (BENCH_LINT_LAST.json).
+            "lint_analysis": _lint_analysis_record(),
             "extra": {k: v for k, v in partial_extra.items()
                       if not k.startswith("_")},
         }

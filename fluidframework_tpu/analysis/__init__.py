@@ -1,6 +1,6 @@
-"""fluidlint: AST-based static analysis for the fluidframework_tpu tree.
+"""fluidlint: AST + whole-program static analysis for this tree.
 
-Two rule families guard the two silent failure modes of the system
+Three rule families guard the silent failure modes of the system
 (see docs/static_analysis.md):
 
 * JAX/TPU kernel hygiene (JX*): tracing hazards inside jit-decorated
@@ -9,24 +9,35 @@ Two rule families guard the two silent failure modes of the system
 * Server concurrency/robustness (CC*): await-under-lock, blocking calls
   in async code, swallowed exceptions on op-pipeline paths, listener
   registration without a removal path, mutable default arguments.
+* Donated-buffer lifecycle (v2, whole-program): a cross-module call
+  graph (callgraph.py) + alias/donation dataflow (dataflow.py) prove
+  the serving path never reads freed device memory — USE_AFTER_DONATE,
+  DONATED_ESCAPE, and the PAGE_ID_DTYPE dtype lattice
+  (lifecycle_rules.py).
 
-Run it with ``python -m fluidframework_tpu.analysis [paths]``.  Findings
-are suppressed inline with ``# fluidlint: disable=RULE — reason`` or
+Run it with ``python -m fluidframework_tpu.analysis [paths]``
+(``--changed-only`` for the git-diff-scoped pre-commit pass; warm runs
+ride the fingerprint cache in ``.fluidlint_cache.json``). Findings are
+suppressed inline with ``# fluidlint: disable=RULE — reason`` or
 accepted in the committed baseline (``analysis/baseline.json``); anything
 else fails the run, which `make lint-analysis` and
 tests/test_static_analysis.py turn into a hard CI gate.
 """
 
-from .engine import AnalysisResult, ModuleContext, Violation, analyze_paths, analyze_source
+from .engine import (
+    AnalysisResult, ModuleContext, ProgramContext, Violation,
+    analyze_paths, analyze_source,
+)
 from .registry import RULES, Rule, all_rules, get_rule, rule
 from .baseline import Baseline, DEFAULT_BASELINE_PATH
 
 # Importing the rule modules registers every rule with the registry.
 from . import jax_rules as _jax_rules  # noqa: F401
 from . import concurrency_rules as _concurrency_rules  # noqa: F401
+from . import lifecycle_rules as _lifecycle_rules  # noqa: F401
 
 __all__ = [
     "AnalysisResult", "Baseline", "DEFAULT_BASELINE_PATH", "ModuleContext",
-    "RULES", "Rule", "Violation", "all_rules", "analyze_paths",
-    "analyze_source", "get_rule", "rule",
+    "ProgramContext", "RULES", "Rule", "Violation", "all_rules",
+    "analyze_paths", "analyze_source", "get_rule", "rule",
 ]

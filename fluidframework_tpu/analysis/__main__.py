@@ -2,11 +2,19 @@
 
 Exit status 0 iff every finding is suppressed inline or baselined.
 The last stdout line is always the one-line JSON summary.
+
+``--changed-only`` scopes REPORTING to the files git says changed
+(worktree vs HEAD, plus untracked) while the whole-program layer still
+spans the package — the fast pre-commit mode (``make lint-changed``).
+The fingerprint cache (``.fluidlint_cache.json``, disable with
+``--no-cache``) makes warm full runs skip unchanged modules.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import subprocess
 import sys
 from pathlib import Path
 
@@ -14,6 +22,23 @@ from .baseline import Baseline, DEFAULT_BASELINE_PATH
 from .engine import REPO_ROOT, analyze_paths
 from .registry import RULES, all_rules
 from .reporters import render_human, render_json
+
+
+def _git_changed_paths() -> set:
+    """Repo-root-relative .py paths changed vs HEAD (staged, unstaged,
+    and untracked). Raises on git failure — a broken diff must not
+    silently become an empty (vacuously clean) scope."""
+    out = set()
+    for cmd in (["git", "diff", "--name-only", "HEAD", "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(cmd, cwd=str(REPO_ROOT),
+                              capture_output=True, text=True, timeout=30)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(cmd)} failed: {proc.stderr.strip()}")
+        out.update(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip().endswith(".py"))
+    return out
 
 
 def main(argv=None) -> int:
@@ -38,6 +63,21 @@ def main(argv=None) -> int:
     parser.add_argument("--rule", action="append", default=[],
                         metavar="RULE_ID", help="run only these rule ids")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="report only on files git sees as changed "
+                             "(worktree vs HEAD + untracked); the "
+                             "whole-program context still spans the "
+                             "given paths")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="disable the per-module result cache")
+    parser.add_argument("--cache-file", type=Path, default=None,
+                        help="cache file path (default: "
+                             ".fluidlint_cache.json at the repo root)")
+    parser.add_argument("--bench-json", type=Path, default=None,
+                        metavar="PATH",
+                        help="also write the analyzer perf record "
+                             "(wall time, cache hits, counts) to PATH "
+                             "for the BENCH trend")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -55,8 +95,29 @@ def main(argv=None) -> int:
         # pass that still prints a healthy-looking summary line.
         parser.error(f"path(s) do not exist: {', '.join(missing)}")
 
+    restrict = None
+    if args.changed_only:
+        try:
+            changed = _git_changed_paths()
+        except (OSError, RuntimeError, subprocess.TimeoutExpired) as exc:
+            print(f"error: --changed-only could not read the git diff: "
+                  f"{exc}", file=sys.stderr)
+            return 2
+        restrict = changed
+        if not changed:
+            print("--changed-only: no changed .py files; nothing to "
+                  "analyze")
+            print(json.dumps({"violations": 0, "baselined": 0}))
+            return 0
+
+    cache = None
+    if not args.no_cache and not args.write_baseline:
+        from .cache import DEFAULT_CACHE_PATH, ResultCache
+        cache = ResultCache(args.cache_file or DEFAULT_CACHE_PATH)
+
     baseline = None if args.no_baseline else Baseline.load(args.baseline)
-    result = analyze_paths(args.paths, baseline=baseline, only=args.rule)
+    result = analyze_paths(args.paths, baseline=baseline, only=args.rule,
+                           cache=cache, restrict=restrict)
 
     if args.write_baseline:
         prior = baseline if baseline is not None \
@@ -69,6 +130,11 @@ def main(argv=None) -> int:
         # a full default run retires stale entries.
         from .engine import _rel_path, iter_python_files
         analyzed = {_rel_path(f) for f in iter_python_files(args.paths)}
+        if restrict is not None:
+            # --changed-only: only the restricted files actually
+            # REPORTED, so only their entries may be retired — the
+            # unchanged files' curated acceptances are out of scope.
+            analyzed &= restrict
         active = set(args.rule) or set(RULES)
         merged.entries.extend(
             e for e in prior.entries
@@ -80,9 +146,26 @@ def main(argv=None) -> int:
         return 0
 
     if result.files == 0:
+        if restrict is not None:
+            # Changed files exist, just none inside the analyzed paths:
+            # a legitimately clean scoped run, not a vacuous pass.
+            print("--changed-only: no changed files within the analyzed "
+                  "paths")
+            print(json.dumps({"violations": 0, "baselined": 0}))
+            return 0
         print("error: no Python files matched the given paths; "
               "refusing to report a vacuous pass", file=sys.stderr)
         return 2
+
+    if args.bench_json is not None:
+        record = {
+            "metric": "fluidlint analyzer wall time",
+            "value": round(result.wall_ms, 3),
+            "unit": "ms",
+            "changed_only": bool(args.changed_only),
+            **result.stats,
+        }
+        args.bench_json.write_text(json.dumps(record, indent=2) + "\n")
 
     if args.format == "json":
         render_json(result, sys.stdout)

@@ -122,21 +122,65 @@ def _jit_kwargs(call: ast.Call, info: JitInfo) -> None:
             info.donate_argnames |= _str_elems(kw.value)
 
 
+def decorator_jit_info(node) -> Optional[JitInfo]:
+    """JitInfo for a decorator-jitted def (`@jax.jit`, `@jax.jit(…)`,
+    `@functools.partial(jax.jit, …)`), else None. The one recognizer
+    shared by the per-module jit index and the call graph — a new jit
+    spelling lands in both or neither."""
+    for dec in node.decorator_list:
+        if _is_jit_ref(dec):
+            return JitInfo(node=node)
+        if isinstance(dec, ast.Call):
+            if _is_jit_ref(dec.func):
+                info = JitInfo(node=node)
+                _jit_kwargs(dec, info)
+                return info
+            if (_dotted(dec.func) in ("functools.partial", "partial")
+                    and dec.args and _is_jit_ref(dec.args[0])):
+                info = JitInfo(node=node)
+                _jit_kwargs(dec, info)
+                return info
+    return None
+
+
 class ModuleContext:
-    """Everything rules need about one source file."""
+    """Everything rules need about one source file.
+
+    The derived indexes (parent links, jit functions, suppressions) are
+    LAZY: a fully-cached analyze_paths run parses every module for the
+    whole-program layer but never runs a rule against most of them, and
+    building the parent map for 160 modules dominates warm wall time."""
 
     def __init__(self, path: str, source: str, tree: ast.Module):
         self.path = path
         self.source = source
         self.lines = source.splitlines()
         self.tree = tree
-        self.parents: Dict[ast.AST, ast.AST] = {}
-        for parent in ast.walk(tree):
-            for child in ast.iter_child_nodes(parent):
-                self.parents[child] = parent
-        self.jit_functions: Dict[ast.FunctionDef, JitInfo] = {}
-        self._index_jit_functions()
-        self.suppressions = self._scan_suppressions()
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+        self._jit_functions: Optional[Dict[ast.FunctionDef, JitInfo]] = None
+        self._suppressions: Optional[Dict[int, Set[str]]] = None
+
+    @property
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            for parent in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(parent):
+                    self._parents[child] = parent
+        return self._parents
+
+    @property
+    def jit_functions(self) -> Dict[ast.FunctionDef, JitInfo]:
+        if self._jit_functions is None:
+            self._jit_functions = {}
+            self._index_jit_functions()
+        return self._jit_functions
+
+    @property
+    def suppressions(self) -> Dict[int, Set[str]]:
+        if self._suppressions is None:
+            self._suppressions = self._scan_suppressions()
+        return self._suppressions
 
     # -- jit detection -----------------------------------------------------
     def _index_jit_functions(self) -> None:
@@ -165,21 +209,7 @@ class ModuleContext:
 
     def _decorator_jit_info(self,
                             node: ast.FunctionDef) -> Optional[JitInfo]:
-        for dec in node.decorator_list:
-            if _is_jit_ref(dec):
-                return JitInfo(node=node)
-            if isinstance(dec, ast.Call):
-                # @jax.jit(...) and @functools.partial(jax.jit, ...)
-                if _is_jit_ref(dec.func):
-                    info = JitInfo(node=node)
-                    _jit_kwargs(dec, info)
-                    return info
-                if (_dotted(dec.func) in ("functools.partial", "partial")
-                        and dec.args and _is_jit_ref(dec.args[0])):
-                    info = JitInfo(node=node)
-                    _jit_kwargs(dec, info)
-                    return info
-        return None
+        return decorator_jit_info(node)
 
     def enclosing_jit(self, node: ast.AST) -> Optional[JitInfo]:
         """The jit-decorated function lexically containing ``node``, if
@@ -260,11 +290,53 @@ class AnalysisResult:
     baselined: List[Violation]
     suppressed: int
     files: int
+    wall_ms: float = 0.0                 # analyzer wall time, this run
+    cache_hits: int = 0                  # modules served from the cache
+    cache_misses: int = 0                # modules actually re-analyzed
 
     @property
     def summary(self) -> dict:
         return {"violations": len(self.violations),
                 "baselined": len(self.baselined)}
+
+    @property
+    def stats(self) -> dict:
+        """The perf/trend block stamped into JSON reports and the
+        BENCH_LINT record (wall time + cache effectiveness + counts)."""
+        return {"wall_ms": round(self.wall_ms, 3), "files": self.files,
+                "cache_hits": self.cache_hits,
+                "cache_misses": self.cache_misses,
+                "suppressed": self.suppressed, **self.summary}
+
+
+class ProgramContext:
+    """Whole-program layer shared by the cross-module rules: the
+    symbol/call graph (callgraph.ProgramIndex) plus the per-function
+    dataflow summaries (dataflow.compute_summaries). Built once per
+    analyze_paths run and attached to every ModuleContext as
+    ``ctx.program``; analyze_source builds a single-module one on
+    demand (lifecycle_rules._program_for)."""
+
+    def __init__(self, contexts: Sequence["ModuleContext"]):
+        from .callgraph import ProgramIndex, module_name_for_path
+        self.index = ProgramIndex(
+            [(module_name_for_path(c.path), c.tree, c.path)
+             for c in contexts])
+        from .dataflow import compute_summaries
+        self.summaries = compute_summaries(self.index)
+
+    def digest(self) -> str:
+        """Interface digest for the result cache: any change to a
+        donation signature or transitive summary anywhere invalidates
+        every module's cached result (a caller two modules away may
+        now be donating where it wasn't)."""
+        items = list(self.index.signature_digest_items())
+        for q in sorted(self.summaries):
+            s = self.summaries[q]
+            if s.donated_params or s.metadata_only_params:
+                items.append(f"{q}|{sorted(s.donated_params)}|"
+                             f"{sorted(s.metadata_only_params)}")
+        return hashlib.sha1("\n".join(items).encode()).hexdigest()[:20]
 
 
 def _rel_path(path: Path) -> str:
@@ -310,35 +382,90 @@ def analyze_source(source: str, path: str = "<memory>",
 
 
 def analyze_paths(paths: Sequence[str], baseline=None,
-                  only: Iterable[str] = ()) -> AnalysisResult:
+                  only: Iterable[str] = (), cache=None,
+                  restrict: Optional[Set[str]] = None) -> AnalysisResult:
+    """Analyze ``paths``. ``cache`` (analysis.cache.ResultCache) skips
+    modules whose (source, rules, program-interface) fingerprints are
+    unchanged. ``restrict`` limits REPORTING to the given repo-relative
+    paths while the whole-program context still spans everything parsed
+    — the ``--changed-only`` pre-commit mode."""
+    import time
+    t0 = time.perf_counter()
     from .registry import iter_checks
     rules = iter_checks(only)
     new: List[Violation] = []
     base: List[Violation] = []
     suppressed = 0
     files = 0
+    contexts: List[ModuleContext] = []
+    sources: Dict[str, str] = {}
     for file in iter_python_files(paths):
-        files += 1
         rel = _rel_path(file)
         try:
             source = file.read_text()
             tree = ast.parse(source)
         except (OSError, SyntaxError, UnicodeDecodeError) as exc:
-            new.append(Violation(rule_id="PARSE_ERROR", path=rel, line=1,
-                                 col=0, message=f"could not parse: {exc}",
-                                 symbol="", line_text=""))
+            if restrict is None or rel in restrict:
+                files += 1
+                new.append(Violation(
+                    rule_id="PARSE_ERROR", path=rel, line=1, col=0,
+                    message=f"could not parse: {exc}", symbol="",
+                    line_text=""))
             continue
-        ctx = ModuleContext(rel, source, tree)
+        contexts.append(ModuleContext(rel, source, tree))
+        sources[rel] = source
+    # The whole-program layer spans every parsed module, restricted or
+    # not: a donation signature lives wherever it lives.
+    program = ProgramContext(contexts)
+    program_dig = program.digest()
+    rules_dig = ""
+    if cache is not None:
+        from .cache import rules_digest
+        rules_dig = rules_digest()
+    only_key = tuple(sorted(only))
+    for ctx in contexts:
+        ctx.program = program
+        if restrict is not None and ctx.path not in restrict:
+            continue
+        files += 1
+        cache_key = None
+        if cache is not None:
+            cache_key = cache.key(sources[ctx.path], rules_dig,
+                                  program_dig, only_key)
+            hit = cache.get(ctx.path, cache_key)
+            if hit is not None:
+                module_violations, module_suppressed = hit
+                suppressed += module_suppressed
+                for v in module_violations:
+                    if baseline is not None and baseline.contains(v):
+                        base.append(v)
+                    else:
+                        new.append(v)
+                continue
+        module_violations = []
+        module_suppressed = 0
         for r in rules:
             for v in r.check(ctx):
                 if ctx.is_suppressed(v.rule_id, v.line):
-                    suppressed += 1
-                elif baseline is not None and baseline.contains(v):
-                    base.append(v)
+                    module_suppressed += 1
                 else:
-                    new.append(v)
+                    module_violations.append(v)
+        if cache is not None:
+            cache.put(ctx.path, cache_key, module_violations,
+                      module_suppressed)
+        suppressed += module_suppressed
+        for v in module_violations:
+            if baseline is not None and baseline.contains(v):
+                base.append(v)
+            else:
+                new.append(v)
+    if cache is not None:
+        cache.save()
     key = lambda v: (v.path, v.line, v.col, v.rule_id)  # noqa: E731
     new.sort(key=key)
     base.sort(key=key)
-    return AnalysisResult(violations=new, baselined=base,
-                          suppressed=suppressed, files=files)
+    return AnalysisResult(
+        violations=new, baselined=base, suppressed=suppressed,
+        files=files, wall_ms=(time.perf_counter() - t0) * 1000.0,
+        cache_hits=cache.hits if cache is not None else 0,
+        cache_misses=cache.misses if cache is not None else 0)

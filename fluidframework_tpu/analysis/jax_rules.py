@@ -431,108 +431,8 @@ def missing_donate(ctx: ModuleContext) -> Iterator[Violation]:
                 f"live across every step")
 
 
-# Page-table indices (mergetree/paging.py) and every gather/scatter-by-
-# page-id operand must ride the canonical page-id dtype (int32,
-# constants.PAGE_ID_DTYPE): a page id silently widened to int64 on the
-# host doubles every page-table H2D transfer, and a narrowed int16 id
-# wraps past 32k pages — a scatter to the WRONG document's page. Unlike
-# DTYPE_DRIFT this rule is not jit-scoped: page tables are BUILT on the
-# host and cross the boundary at dispatch.
-_PAGE_NAME_RE = re.compile(
-    r"(^|_)(page_?(ids?|tables?)|pids)($|_)", re.IGNORECASE)
-
-# The gather/scatter-by-page-id kernel surface: calls whose page-id
-# operands the rule audits for stray dtype casts.
-_PAGED_KERNEL_NAMES = {
-    "gather_pages", "scatter_pages", "rollback_pages", "apply_ops_paged",
-    "compact_pages", "compact_extract_paged", "serve_paged_burst",
-}
-
-# Any integer dtype that is not exactly int32: narrower wraps past 32k
-# pages, wider doubles transfers, and UNSIGNED 32-bit silently destroys
-# the -1 padding sentinel (page_ids >= 0 becomes vacuously true, so
-# padding rows stop dropping and scatter into a clamped in-range page).
-_INT_DTYPE_RE = re.compile(r"^(u?int(8|16|64)|uint32)$")
-
-
-def _page_dtype_hazards(node: ast.AST):
-    """Non-int32 integer dtype attributes / dtype= keywords anywhere in
-    ``node``'s expression tree (float dtypes are a different bug class —
-    DTYPE_DRIFT's — and stay out of scope here)."""
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Attribute) and \
-                _INT_DTYPE_RE.match(sub.attr) and \
-                _dotted(sub.value) in _NUMPY_MODULES:
-            yield sub, f"{_dotted(sub.value)}.{sub.attr}"
-        elif isinstance(sub, ast.Constant) and isinstance(sub.value, str) \
-                and _INT_DTYPE_RE.match(sub.value):
-            yield sub, repr(sub.value)
-
-
-@rule("PAGE_ID_DTYPE",
-      "Page-table index built or cast with a non-int32 integer dtype",
-      family="jax",
-      rationale="Page ids are the canonical int32 device index "
-                "(mergetree.constants.PAGE_ID_DTYPE): int64 doubles "
-                "every page-table transfer, int16 wraps past 32k pages "
-                "into another document's page. Applies to page-named "
-                "bindings and to gather/scatter-by-page-id call "
-                "operands in mergetree/server scope.")
-def page_id_dtype(ctx: ModuleContext) -> Iterator[Violation]:
-    if not _scan_scope(ctx):
-        return
-    seen: Set[int] = set()
-
-    def emit(hazard, what, where):
-        key = id(hazard)
-        if key in seen:
-            return None
-        seen.add(key)
-        return ctx.violation(
-            "PAGE_ID_DTYPE", hazard,
-            f"page-id dtype `{what}` {where} drifts from the canonical "
-            f"int32 page-table index")
-
-    for node in ast.walk(ctx.tree):
-        # page_ids = np.asarray(x, np.int64) / pids.astype("int16") ...
-        # Tuple-unpack targets count too (`pids, n = build(...)`), and
-        # the violation names the PAGE-NAMED target, not just the first
-        # one of a multi-target assign.
-        if isinstance(node, ast.Assign):
-            names = []
-            for t in node.targets:
-                if isinstance(t, ast.Name):
-                    names.append(t.id)
-                elif isinstance(t, (ast.Tuple, ast.List)):
-                    names.extend(e.id for e in t.elts
-                                 if isinstance(e, ast.Name))
-            paged_names = [n for n in names if _PAGE_NAME_RE.search(n)]
-            if not paged_names:
-                continue
-            for hazard, what in _page_dtype_hazards(node.value):
-                v = emit(hazard, what,
-                         f"assigned to `{paged_names[0]}`")
-                if v is not None:
-                    yield v
-        # .astype(np.int64) directly on a page-named value.
-        elif isinstance(node, ast.Call) and \
-                isinstance(node.func, ast.Attribute) and \
-                node.func.attr == "astype" and \
-                _PAGE_NAME_RE.search(_dotted(node.func.value) or ""):
-            for arg in list(node.args) + [kw.value for kw in node.keywords]:
-                for hazard, what in _page_dtype_hazards(arg):
-                    v = emit(hazard, what,
-                             f"cast onto `{_dotted(node.func.value)}`")
-                    if v is not None:
-                        yield v
-        # Operands of the gather/scatter-by-page-id kernel surface.
-        elif isinstance(node, ast.Call):
-            fn = _dotted(node.func) or ""
-            tail = fn.rpartition(".")[2]
-            if tail not in _PAGED_KERNEL_NAMES:
-                continue
-            for arg in list(node.args) + [kw.value for kw in node.keywords]:
-                for hazard, what in _page_dtype_hazards(arg):
-                    v = emit(hazard, what, f"in a `{tail}` operand")
-                    if v is not None:
-                        yield v
+# PAGE_ID_DTYPE moved to lifecycle_rules.py in v2: the regex that only
+# saw page-NAMED assignments became a dtype lattice propagated through
+# astype/asarray/arithmetic by the dataflow pass (analysis/dataflow.py),
+# so drift through intermediate bindings is caught too. Rule id,
+# scope, and message shape are unchanged.

@@ -41,6 +41,12 @@ def render_json(result: AnalysisResult, stream: IO[str]) -> None:
     payload = {
         "files": result.files,
         "suppressed": result.suppressed,
+        # Analyzer perf trend: wall time + cache effectiveness ride
+        # every JSON report so an incremental (cached) run's speedup is
+        # verifiable from the report alone.
+        "wall_ms": round(result.wall_ms, 3),
+        "cache": {"hits": result.cache_hits,
+                  "misses": result.cache_misses},
         "summary": result.summary,
         "violations": [
             {"rule": v.rule_id, "path": v.path, "line": v.line,

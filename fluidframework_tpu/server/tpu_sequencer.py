@@ -2701,9 +2701,14 @@ class TpuSequencerLambda(IPartitionLambda):
             MergeLaneStore(t_buckets=t_buckets, paged=paged_lanes)
         self.lww = LwwLaneStore(t_buckets=t_buckets)
         if getattr(self.merge, "paged", False) and mesh is not None:
-            raise ValueError(
-                "paged merge lanes are single-chip for now: the page "
-                "pool has no dp placement rule yet (docs/paged_memory.md)")
+            raise NotImplementedError(
+                "MergeLaneStore(paged=True) cannot be placed on a dp "
+                "mesh: the page pool has no PartitionSpec rule yet — "
+                "pages would need a lane-axis sharding over the 'dp' "
+                "mesh axis plus a replicated page-table plane "
+                "(ROADMAP 'Paged lane memory: finish the takeover'; "
+                "docs/paged_memory.md). Use paged_lanes=False on "
+                "meshes, or a single-chip placement for paged lanes.")
         if mesh is not None:
             dp = int(mesh.shape.get("dp", 1))
             for bucket in self.merge.buckets + self.lww.buckets:

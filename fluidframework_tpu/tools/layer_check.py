@@ -7,8 +7,17 @@ subpackages it may import; an import outside the matrix fails the build.
 Imports guarded by `if TYPE_CHECKING:` are type-only and exempt (they
 erase at runtime), mirroring layer-check's type-only allowance.
 
+Beyond the layering matrix, the checker also builds the module-level
+IMPORT-TIME graph (top-level imports only — deferred function-body
+imports are the sanctioned cycle-breaking idiom here) and fails hard on
+any cycle, printing the offending edges: an import cycle is a layering
+violation the matrix cannot express (two modules in the same layer may
+still not need each other at import time), and Python resolves one
+"successfully" just often enough to ship a partially-initialized module.
+
 Run: `python -m fluidframework_tpu.tools.layer_check` (exit 1 on
-violation); `tests/test_quality_gates.py` runs it in CI.
+violation or cycle); `make layer-check` wires it into `make check`, and
+`tests/test_quality_gates.py` runs both gates in CI.
 """
 
 from __future__ import annotations
@@ -146,15 +155,176 @@ def check(package_root: str, allowed: Optional[Dict[str, Set[str]]] = None,
     return violations
 
 
-def main() -> int:
-    import sys
-    root = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
+# ---------------------------------------------------------------------------
+# import-time cycle detection
+# ---------------------------------------------------------------------------
+
+def _toplevel_imports(tree: ast.Module) -> List[ast.stmt]:
+    """Imports that execute at module import time: module-body
+    statements, descending into top-level If/Try (version guards,
+    optional-dependency fallbacks) and class bodies (they execute at
+    import), but NOT into function bodies — a deferred function-scope
+    import is the sanctioned way to break a would-be cycle."""
+    out: List[ast.stmt] = []
+
+    def visit(stmts) -> None:
+        for node in stmts:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                out.append(node)
+            elif isinstance(node, ast.If):
+                test = node.test
+                name = (test.id if isinstance(test, ast.Name) else
+                        test.attr if isinstance(test, ast.Attribute)
+                        else None)
+                if name != "TYPE_CHECKING":
+                    visit(node.body)
+                # type-only body erases at runtime; the else branch
+                # (if any) still executes at import time either way
+                visit(node.orelse)
+            elif isinstance(node, (ast.Try, ast.ClassDef)):
+                for field in ("body", "orelse", "finalbody"):
+                    visit(getattr(node, field, []) or [])
+                for handler in getattr(node, "handlers", []):
+                    visit(handler.body)
+    visit(tree.body)
+    return out
+
+
+def _import_target_module(node, module_rel: str, package_name: str,
+                          modules: Set[str]) -> List[str]:
+    """In-package module(s) (as "server/serve_step"-style keys) that an
+    import statement binds at import time."""
+    def to_key(dotted: str) -> Optional[str]:
+        key = dotted.replace(".", "/")
+        if key in modules:
+            return key
+        if f"{key}/__init__" in modules:
+            return f"{key}/__init__"
+        return None
+
+    targets: List[str] = []
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            if alias.name == package_name or \
+                    alias.name.startswith(package_name + "."):
+                dotted = alias.name[len(package_name) + 1:]
+                key = to_key(dotted) if dotted else "__init__"
+                if key:
+                    targets.append(key)
+        return targets
+    # ImportFrom: resolve the base package, then each name — a name may
+    # be a submodule (edge to it) or a symbol (edge to the base).
+    if node.level == 0:
+        if not (node.module or "").startswith(package_name):
+            return targets
+        base = (node.module or "")[len(package_name):].lstrip(".")
+    else:
+        parts = module_rel.split("/")[:-1]
+        up = node.level - 1
+        if up > len(parts):
+            return targets
+        parts = parts[:len(parts) - up] if up else parts
+        base = "/".join(parts).replace("/", ".")
+        if node.module:
+            base = f"{base}.{node.module}" if base else node.module
+        base = base.replace("/", ".")
+    base_key = base.replace(".", "/") if base else ""
+    for alias in node.names:
+        if alias.name == "*":
+            continue
+        sub = to_key(f"{base_key}/{alias.name}" if base_key
+                     else alias.name)
+        if sub:
+            targets.append(sub)
+        else:
+            key = to_key(base_key) if base_key else "__init__"
+            if key:
+                targets.append(key)
+    return list(dict.fromkeys(targets))
+
+
+def import_graph(package_root: str) -> Dict[str, Set[str]]:
+    """module key ("server/serve_step") -> in-package modules its
+    import-time imports bind."""
+    package_name = os.path.basename(os.path.abspath(package_root))
+    modules: Set[str] = set()
+    trees: Dict[str, ast.Module] = {}
+    for root, _dirs, files in os.walk(package_root):
+        for fname in files:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            rel = os.path.relpath(path, package_root)
+            if "__pycache__" in rel.split(os.sep):
+                continue
+            key = rel[:-3].replace(os.sep, "/")
+            modules.add(key)
+            try:
+                trees[key] = ast.parse(open(path).read())
+            except SyntaxError:
+                continue
+    graph: Dict[str, Set[str]] = {m: set() for m in modules}
+    for key, tree in trees.items():
+        for node in _toplevel_imports(tree):
+            for target in _import_target_module(node, key, package_name,
+                                                modules):
+                if target != key:
+                    graph[key].add(target)
+    return graph
+
+
+def find_cycles(graph: Dict[str, Set[str]]) -> List[List[str]]:
+    """Elementary cycles via DFS back-edges; each reported once, as the
+    path of module keys with the closing edge repeated last."""
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    stack: List[str] = []
+    cycles: List[List[str]] = []
+    seen: Set[frozenset] = set()
+
+    def dfs(n: str) -> None:
+        color[n] = GREY
+        stack.append(n)
+        for m in sorted(graph.get(n, ())):
+            if color.get(m, BLACK) == GREY:
+                cyc = stack[stack.index(m):] + [m]
+                key = frozenset(cyc)
+                if key not in seen:
+                    seen.add(key)
+                    cycles.append(cyc)
+            elif color.get(m) == WHITE:
+                dfs(m)
+        stack.pop()
+        color[n] = BLACK
+
+    for n in sorted(graph):
+        if color[n] == WHITE:
+            dfs(n)
+    return cycles
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="python -m fluidframework_tpu.tools.layer_check")
+    parser.add_argument("--root", default=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))),
+        help="package root to check (default: this package; the cycle "
+             "gate's exit-1 contract is tested against seeded trees)")
+    args = parser.parse_args(argv)
+    root = args.root
     found = check(root)
     for violation in found:
         print(violation)
-    print(f"layer-check: {len(found)} violation(s)")
-    return 1 if found else 0
+    cycles = find_cycles(import_graph(root))
+    for cyc in cycles:
+        edges = " -> ".join(cyc)
+        print(f"import cycle: {edges} (break the "
+              f"`{cyc[-2]} -> {cyc[-1]}` edge, e.g. defer it into the "
+              f"function that needs it)")
+    print(f"layer-check: {len(found)} violation(s), "
+          f"{len(cycles)} import cycle(s)")
+    return 1 if (found or cycles) else 0
 
 
 if __name__ == "__main__":

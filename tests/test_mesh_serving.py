@@ -146,6 +146,16 @@ class TestMeshServing:
         assert len(server.sequencer().tstate.next_seq
                    .sharding.device_set) == 8
 
+    def test_paged_lanes_on_mesh_refuse_with_missing_partition_spec(self):
+        """MergeLaneStore(paged=True) has no PartitionSpec rule for the
+        page pool yet (ROADMAP 'finish the takeover'): constructing a
+        paged sequencer on a dp mesh must refuse LOUDLY with a
+        NotImplementedError that names the missing placement rule, not
+        die on a bare assert deep in placement code."""
+        with pytest.raises(NotImplementedError,
+                           match="PartitionSpec"):
+            TpuLocalServer(mesh=make_mesh(sp=1), paged_lanes=True)
+
     def test_materialized_not_stale_after_sequencer_restart(self):
         """A crash-restart replaces the lambda (generation counters reset
         to 0); the materialized writer must not compare new counters to

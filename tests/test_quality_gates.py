@@ -11,7 +11,12 @@ from fluidframework_tpu.loader.drivers.local import LocalDocumentServiceFactory
 from fluidframework_tpu.server.local_server import LocalServer
 from fluidframework_tpu.testing.load_test import LoadProfile, LoadRunner
 from fluidframework_tpu.testing.snapshot_corpus import corpus_digests
-from fluidframework_tpu.tools.layer_check import ALLOWED, check
+from fluidframework_tpu.tools.layer_check import (
+    ALLOWED,
+    check,
+    find_cycles,
+    import_graph,
+)
 
 PACKAGE_ROOT = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "fluidframework_tpu")
@@ -53,6 +58,102 @@ class TestLayerCheck:
                        and not name.startswith("__")}
         missing = subpackages - set(ALLOWED)
         assert not missing, f"layer matrix missing {sorted(missing)}"
+
+    def test_analysis_and_tools_layers_constrained(self):
+        """The analyzer and tools layers are themselves in the matrix:
+        fluidlint may reach only mergetree (for the canonical dtypes) —
+        an analyzer that imports the server stack would drag jax into
+        every lint run."""
+        assert ALLOWED["analysis"] == {"mergetree"}
+        assert "tools" in ALLOWED
+
+
+class TestImportCycles:
+    def test_package_has_no_import_time_cycles(self):
+        cycles = find_cycles(import_graph(PACKAGE_ROOT))
+        rendered = "\n".join(" -> ".join(c) for c in cycles)
+        assert cycles == [], f"import-time cycles:\n{rendered}"
+
+    def test_detects_top_level_cycle_with_edge(self, tmp_path):
+        pkg = tmp_path / "fakepkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "a.py").write_text("from .b import x\ny = 1\n")
+        (pkg / "b.py").write_text("from .a import y\nx = 1\n")
+        cycles = find_cycles(import_graph(str(pkg)))
+        assert len(cycles) == 1
+        assert set(cycles[0]) == {"a", "b"}
+
+    def test_type_checking_guard_breaks_cycle(self, tmp_path):
+        pkg = tmp_path / "fakepkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "a.py").write_text(textwrap.dedent("""
+            from typing import TYPE_CHECKING
+            if TYPE_CHECKING:
+                from .b import x
+            y = 1
+        """))
+        (pkg / "b.py").write_text("from .a import y\nx = 1\n")
+        assert find_cycles(import_graph(str(pkg))) == []
+
+    def test_function_deferred_import_breaks_cycle(self, tmp_path):
+        """A function-scope import is the sanctioned cycle-breaking
+        idiom (it defers past module init) — the graph must not count
+        it."""
+        pkg = tmp_path / "fakepkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "a.py").write_text(textwrap.dedent("""
+            def late():
+                from .b import x
+                return x
+            y = 1
+        """))
+        (pkg / "b.py").write_text("from .a import y\nx = 1\n")
+        assert find_cycles(import_graph(str(pkg))) == []
+
+    def test_else_of_type_checking_guard_still_counts(self, tmp_path):
+        """Only the TYPE_CHECKING body erases; an `else:` branch import
+        executes at import time and must stay in the cycle graph."""
+        pkg = tmp_path / "fakepkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "a.py").write_text(textwrap.dedent("""
+            from typing import TYPE_CHECKING
+            if TYPE_CHECKING:
+                pass
+            else:
+                from .b import x
+            y = 1
+        """))
+        (pkg / "b.py").write_text("from .a import y\nx = 1\n")
+        assert len(find_cycles(import_graph(str(pkg)))) == 1
+
+    def test_cli_exit_code_covers_cycles(self, tmp_path):
+        """python -m …tools.layer_check must exit 1 and print the
+        offending edge when a cycle exists (the `make layer-check`
+        gate's contract), and exit 0 on the real tree."""
+        import subprocess
+        import sys
+        pkg = tmp_path / "fakepkg"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("")
+        (pkg / "a.py").write_text("from .b import x\ny = 1\n")
+        (pkg / "b.py").write_text("from .a import y\nx = 1\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "fluidframework_tpu.tools.layer_check",
+             "--root", str(pkg)],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(PACKAGE_ROOT))
+        assert proc.returncode == 1, proc.stdout
+        assert "import cycle:" in proc.stdout
+        proc = subprocess.run(
+            [sys.executable, "-m", "fluidframework_tpu.tools.layer_check"],
+            capture_output=True, text=True,
+            cwd=os.path.dirname(PACKAGE_ROOT))
+        assert proc.returncode == 0, proc.stdout
+        assert "0 import cycle(s)" in proc.stdout
 
 
 class TestSnapshotPins:
