@@ -3,7 +3,7 @@
 
 .PHONY: test soak bench dryrun record-corpus historian-smoke \
 	summarize-smoke trace-smoke pipeline-smoke fused-smoke \
-	paged-smoke lint-analysis lint-changed layer-check check
+	paged-smoke lint-analysis lint-changed lint-races layer-check check
 
 test:
 	python -m pytest tests/ -q
@@ -23,10 +23,26 @@ lint-analysis:
 # Fast pre-commit scope: report only on files git sees as changed
 # (worktree vs HEAD + untracked) while the whole-program layer still
 # spans the package, so a donation-signature edit still re-checks its
-# callers' files when they are in the diff.
+# callers' files when they are in the diff. Race findings additionally
+# re-report on every file sharing a thread root's reach with a changed
+# file (locksets are whole-program).
 lint-changed:
 	python -m fluidframework_tpu.analysis fluidframework_tpu/ \
 		--changed-only
+
+# fluidlint v3's lockset race detector, focused on the server
+# concurrency tier (docs/static_analysis.md "fluidlint v3"): thread-root
+# discovery + whole-program held-lockset propagation behind
+# SHARED_STATE_NO_LOCK / ATOMICITY_CHECK_THEN_ACT /
+# LOCK_ORDER_INVERSION / SIGNAL_WITHOUT_LOCK. Exits non-zero on any
+# unbaselined finding; the full rule set (and the same race rules) also
+# runs under lint-analysis — this is the focused gate and its trend
+# line (race_rules_wall_ms rides the lint bench record).
+lint-races:
+	python -m fluidframework_tpu.analysis fluidframework_tpu/server \
+		fluidframework_tpu/telemetry \
+		--rule SHARED_STATE_NO_LOCK --rule ATOMICITY_CHECK_THEN_ACT \
+		--rule LOCK_ORDER_INVERSION --rule SIGNAL_WITHOUT_LOCK
 
 # Machine-enforced layering + import-time cycle detection
 # (tools/layer_check.py): the dependency-DAG gate the reference repo
@@ -88,9 +104,10 @@ paged-smoke:
 overload-smoke:
 	JAX_PLATFORMS=cpu python bench.py overload-smoke
 
-# The pre-merge gate: layering/cycles + static analysis + the
-# summarize/trace/pipeline/fused/overload smokes + the full test suite.
-check: layer-check lint-analysis summarize-smoke trace-smoke \
+# The pre-merge gate: layering/cycles + static analysis (incl. the
+# focused race gate) + the summarize/trace/pipeline/fused/overload
+# smokes + the full test suite.
+check: layer-check lint-analysis lint-races summarize-smoke trace-smoke \
 		pipeline-smoke fused-smoke paged-smoke overload-smoke test
 
 # The round-end randomized-evidence ritual: 50-trial soaks over every

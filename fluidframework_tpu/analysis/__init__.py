@@ -14,6 +14,12 @@ Three rule families guard the silent failure modes of the system
   the serving path never reads freed device memory — USE_AFTER_DONATE,
   DONATED_ESCAPE, and the PAGE_ID_DTYPE dtype lattice
   (lifecycle_rules.py).
+* Lockset race detection (v3, whole-program): thread-root discovery +
+  per-function held-lockset summaries over the server/telemetry tier
+  (concurrency_model.py) back SHARED_STATE_NO_LOCK,
+  ATOMICITY_CHECK_THEN_ACT, LOCK_ORDER_INVERSION, and
+  SIGNAL_WITHOUT_LOCK (race_rules.py), with a runtime verifier in
+  testing/lockcheck.py.
 
 Run it with ``python -m fluidframework_tpu.analysis [paths]``
 (``--changed-only`` for the git-diff-scoped pre-commit pass; warm runs
@@ -35,6 +41,7 @@ from .baseline import Baseline, DEFAULT_BASELINE_PATH
 from . import jax_rules as _jax_rules  # noqa: F401
 from . import concurrency_rules as _concurrency_rules  # noqa: F401
 from . import lifecycle_rules as _lifecycle_rules  # noqa: F401
+from . import race_rules as _race_rules  # noqa: F401
 
 __all__ = [
     "AnalysisResult", "Baseline", "DEFAULT_BASELINE_PATH", "ModuleContext",

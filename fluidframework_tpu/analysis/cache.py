@@ -13,7 +13,10 @@ cached under a key that folds in everything that could change it:
   even though that file's bytes never changed,
 * the active rule filter.
 
-The cache lives in ``.fluidlint_cache.json`` at the repo root
+Storage slots key by module path plus the active rule filter, so a
+focused run (``make lint-races``) and the full run share the file
+without evicting each other. The cache lives in
+``.fluidlint_cache.json`` at the repo root
 (gitignored); a corrupt or version-skewed file is silently discarded —
 the cache can only ever cost a re-analysis, never a wrong answer.
 """

@@ -293,6 +293,7 @@ class AnalysisResult:
     wall_ms: float = 0.0                 # analyzer wall time, this run
     cache_hits: int = 0                  # modules served from the cache
     cache_misses: int = 0                # modules actually re-analyzed
+    race_rules_wall_ms: float = 0.0      # lockset model build + findings
 
     @property
     def summary(self) -> dict:
@@ -306,6 +307,7 @@ class AnalysisResult:
         return {"wall_ms": round(self.wall_ms, 3), "files": self.files,
                 "cache_hits": self.cache_hits,
                 "cache_misses": self.cache_misses,
+                "race_rules_wall_ms": round(self.race_rules_wall_ms, 3),
                 "suppressed": self.suppressed, **self.summary}
 
 
@@ -319,23 +321,50 @@ class ProgramContext:
 
     def __init__(self, contexts: Sequence["ModuleContext"]):
         from .callgraph import ProgramIndex, module_name_for_path
+        self.contexts = list(contexts)
         self.index = ProgramIndex(
             [(module_name_for_path(c.path), c.tree, c.path)
              for c in contexts])
         from .dataflow import compute_summaries
         self.summaries = compute_summaries(self.index)
+        self._concurrency = None
+        self.race_wall_ms = 0.0
 
-    def digest(self) -> str:
+    def concurrency(self):
+        """The whole-program lockset model (concurrency_model.py),
+        built lazily once per run and shared by the race rules, the
+        --changed-only reach expansion, and the cache digest. Build
+        time accumulates into ``race_wall_ms`` (stamped into the
+        BENCH_LINT record as ``race_rules_wall_ms``)."""
+        if self._concurrency is None:
+            import time
+            t0 = time.perf_counter()
+            from .concurrency_model import ConcurrencyModel
+            self._concurrency = ConcurrencyModel(self.index,
+                                                 self.contexts)
+            self.race_wall_ms += (time.perf_counter() - t0) * 1000.0
+        return self._concurrency
+
+    def digest(self, include_concurrency: bool = True) -> str:
         """Interface digest for the result cache: any change to a
-        donation signature or transitive summary anywhere invalidates
+        donation signature, transitive summary, or concurrency fact
+        (lock decl, thread root, race finding) anywhere invalidates
         every module's cached result (a caller two modules away may
-        now be donating where it wasn't)."""
+        now be donating — or racing — where it wasn't).
+        ``include_concurrency=False`` skips the lockset-model facts for
+        runs whose rule filter excludes the race family — their cached
+        results contain no race findings, so concurrency drift is
+        irrelevant to them (the rule filter is part of the cache key),
+        and skipping avoids both the model-build cost and spurious
+        invalidation."""
         items = list(self.index.signature_digest_items())
         for q in sorted(self.summaries):
             s = self.summaries[q]
             if s.donated_params or s.metadata_only_params:
                 items.append(f"{q}|{sorted(s.donated_params)}|"
                              f"{sorted(s.metadata_only_params)}")
+        if include_concurrency:
+            items.extend(self.concurrency().digest_items())
         return hashlib.sha1("\n".join(items).encode()).hexdigest()[:20]
 
 
@@ -417,48 +446,75 @@ def analyze_paths(paths: Sequence[str], baseline=None,
     # The whole-program layer spans every parsed module, restricted or
     # not: a donation signature lives wherever it lives.
     program = ProgramContext(contexts)
-    program_dig = program.digest()
+    race_active = any(r.family == "race" for r in rules)
+    # The digest (and the lockset-model build inside it) is a cache
+    # concern: a cacheless run pays the model only if a race rule
+    # actually checks a module in scope.
+    program_dig = "" if cache is None else \
+        program.digest(include_concurrency=race_active)
     rules_dig = ""
     if cache is not None:
         from .cache import rules_digest
         rules_dig = rules_digest()
     only_key = tuple(sorted(only))
+    # Storage slot per (module, rule filter): a focused run (make
+    # lint-races) and the full run (make lint-analysis) share the cache
+    # file without evicting each other's entries.
+    slot_suffix = ("#" + ",".join(only_key)) if only_key else ""
+    # Race findings are whole-program: a change to any file in a thread
+    # root's reach can alter that root's findings in OTHER files, so
+    # --changed-only additionally re-reports the RACE rules on every
+    # file sharing a root's reach with a changed file.
+    race_extra: Set[str] = set()
+    race_rules = [r for r in rules if r.family == "race"]
+    if restrict is not None and race_rules:
+        race_extra = program.concurrency().reach_expansion(
+            set(restrict)) - set(restrict)
+    def split_baseline(module_violations):
+        for v in module_violations:
+            if baseline is not None and baseline.contains(v):
+                base.append(v)
+            else:
+                new.append(v)
+
+    def run_rules(ctx, active_rules):
+        module_violations = []
+        module_suppressed = 0
+        for r in active_rules:
+            for v in r.check(ctx):
+                if ctx.is_suppressed(v.rule_id, v.line):
+                    module_suppressed += 1
+                else:
+                    module_violations.append(v)
+        return module_violations, module_suppressed
+
     for ctx in contexts:
         ctx.program = program
         if restrict is not None and ctx.path not in restrict:
+            if ctx.path in race_extra:
+                files += 1
+                module_violations, module_suppressed = \
+                    run_rules(ctx, race_rules)
+                suppressed += module_suppressed
+                split_baseline(module_violations)
             continue
         files += 1
         cache_key = None
         if cache is not None:
             cache_key = cache.key(sources[ctx.path], rules_dig,
                                   program_dig, only_key)
-            hit = cache.get(ctx.path, cache_key)
+            hit = cache.get(ctx.path + slot_suffix, cache_key)
             if hit is not None:
                 module_violations, module_suppressed = hit
                 suppressed += module_suppressed
-                for v in module_violations:
-                    if baseline is not None and baseline.contains(v):
-                        base.append(v)
-                    else:
-                        new.append(v)
+                split_baseline(module_violations)
                 continue
-        module_violations = []
-        module_suppressed = 0
-        for r in rules:
-            for v in r.check(ctx):
-                if ctx.is_suppressed(v.rule_id, v.line):
-                    module_suppressed += 1
-                else:
-                    module_violations.append(v)
+        module_violations, module_suppressed = run_rules(ctx, rules)
         if cache is not None:
-            cache.put(ctx.path, cache_key, module_violations,
-                      module_suppressed)
+            cache.put(ctx.path + slot_suffix, cache_key,
+                      module_violations, module_suppressed)
         suppressed += module_suppressed
-        for v in module_violations:
-            if baseline is not None and baseline.contains(v):
-                base.append(v)
-            else:
-                new.append(v)
+        split_baseline(module_violations)
     if cache is not None:
         cache.save()
     key = lambda v: (v.path, v.line, v.col, v.rule_id)  # noqa: E731
@@ -468,4 +524,5 @@ def analyze_paths(paths: Sequence[str], baseline=None,
         violations=new, baselined=base, suppressed=suppressed,
         files=files, wall_ms=(time.perf_counter() - t0) * 1000.0,
         cache_hits=cache.hits if cache is not None else 0,
-        cache_misses=cache.misses if cache is not None else 0)
+        cache_misses=cache.misses if cache is not None else 0,
+        race_rules_wall_ms=program.race_wall_ms)

@@ -20,7 +20,7 @@ if TYPE_CHECKING:  # pragma: no cover - typing only
 class Rule:
     id: str
     summary: str
-    family: str  # "jax" | "concurrency"
+    family: str  # "jax" | "concurrency" | "race"
     rationale: str
     check: Callable[["ModuleContext"], Iterator["Violation"]] = field(
         repr=False, compare=False, default=None)  # type: ignore[assignment]

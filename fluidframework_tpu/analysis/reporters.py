@@ -45,6 +45,7 @@ def render_json(result: AnalysisResult, stream: IO[str]) -> None:
         # every JSON report so an incremental (cached) run's speedup is
         # verifiable from the report alone.
         "wall_ms": round(result.wall_ms, 3),
+        "race_rules_wall_ms": round(result.race_rules_wall_ms, 3),
         "cache": {"hits": result.cache_hits,
                   "misses": result.cache_misses},
         "summary": result.summary,

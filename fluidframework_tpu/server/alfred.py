@@ -146,6 +146,11 @@ class AlfredService:
         """Point this alfred at a summary-cache tier after construction
         (the tier usually needs alfred's URL first, so the wiring is
         two-phase). Existing cores gain the commit notifier too."""
+        # Atomic reference publish of an immutable endpoint string: the
+        # HTTP request threads read it lock-free and tolerate either
+        # epoch (a request raced with attachment simply serves direct).
+        # fluidlint: disable=SHARED_STATE_NO_LOCK — single-writer
+        # publish of an immutable str; readers tolerate either epoch
         self.historian_url = historian_url
         if historian_url:
             with self._cores_lock:

@@ -108,8 +108,10 @@ class GatewayService:
         path = urllib.parse.unquote(handler.path.partition("?")[0])
         try:
             if path == "/health":
+                with self._lock:
+                    resident = len(self.containers)
                 return _send(handler, 200, {"ok": True,
-                                            "resident": len(self.containers)})
+                                            "resident": resident})
             m = self._DOC.match(path)
             if m:
                 return self._serve_document(handler, m.group("doc"))

@@ -138,6 +138,12 @@ class ServiceMonitor:
         # a breach flip the status code (report-only rollout mode).
         self.enforce_slo = enforce_slo
         self.probes: Dict[str, Callable[[], dict]] = {}
+        # Guards the probe registry + the admission handle: watch_*()
+        # registration happens on the operator thread while the HTTP
+        # request threads iterate probes for /health — an unguarded dict
+        # grows mid-iteration and the request thread dies with
+        # RuntimeError (fluidlint SHARED_STATE_NO_LOCK).
+        self._probes_lock = threading.Lock()
         self._admission = None
         self.started_at = time.time()
         service = self
@@ -157,7 +163,8 @@ class ServiceMonitor:
         self._thread: Optional[threading.Thread] = None
 
     def add_probe(self, name: str, probe: Callable[[], dict]) -> None:
-        self.probes[name] = probe
+        with self._probes_lock:
+            self.probes[name] = probe
 
     def watch_local_server(self, name: str, server) -> None:
         """Convenience probe over a LocalServer pipeline core."""
@@ -189,7 +196,8 @@ class ServiceMonitor:
         serialization) a second time only to discard it — status() is
         pure introspection with no failure mode worth a checks entry."""
         del name  # kept for call-site symmetry with the other watchers
-        self._admission = controller
+        with self._probes_lock:
+            self._admission = controller
 
     def watch_summaries(self, name: str, merge_store) -> None:
         """Probe over a MergeLaneStore's incremental-summarization state:
@@ -234,7 +242,13 @@ class ServiceMonitor:
     # -- views --------------------------------------------------------------
     def health(self) -> dict:
         checks: Dict[str, Tuple[bool, str]] = {}
-        for name, probe in self.probes.items():
+        # Snapshot under the lock, run probes outside it: a probe may
+        # be arbitrarily slow (it reads live server state) and must not
+        # serialize concurrent /health requests or registration.
+        with self._probes_lock:
+            probes = list(self.probes.items())
+            admission_ctl = self._admission
+        for name, probe in probes:
             try:
                 probe()
                 checks[name] = (True, "ok")
@@ -242,8 +256,8 @@ class ServiceMonitor:
                 checks[name] = (False, repr(exc))
         slo = self.slo.evaluate()
         slo_ok = slo["ok"] or not self.enforce_slo
-        admission = (self._admission.status()
-                     if self._admission is not None else None)
+        admission = (admission_ctl.status()
+                     if admission_ctl is not None else None)
         return {"ok": all(ok for ok, _ in checks.values()) and slo_ok,
                 # Overload-control state (server/admission.py): a DEGRADE
                 # reading here with /health still 200 is deliberate — the
@@ -266,7 +280,9 @@ class ServiceMonitor:
                "counters": process_counters.snapshot(),
                "stageLatencies": process_counters.latency_snapshot(),
                "probes": {}}
-        for name, probe in self.probes.items():
+        with self._probes_lock:
+            probes = list(self.probes.items())
+        for name, probe in probes:
             try:
                 out["probes"][name] = probe()
             except Exception as exc:  # noqa: BLE001
@@ -323,8 +339,10 @@ class ServiceMonitor:
         lines.append("# TYPE fluid_slo_ok gauge")
         lines.append(f'fluid_slo_ok{{stage="{slo["stage"]}"}} '
                      f'{1 if slo["ok"] else 0}')
-        if self._admission is not None:
-            st = self._admission.status()
+        with self._probes_lock:
+            admission_ctl = self._admission
+        if admission_ctl is not None:
+            st = admission_ctl.status()
             lines.append("# TYPE fluid_admission_level gauge")
             lines.append(f'fluid_admission_level{{state="{st["state"]}"}} '
                          f'{st["level"]}')

@@ -415,15 +415,19 @@ class MergeLaneStore:
         pass will actually extract. Snapshots `where` first: monitor
         probes call this from the HTTP thread while the sequencing
         thread admits/drops lanes, and iterating the live dict would
-        raise mid-mutation."""
+        raise mid-mutation. The epoch read rides the summarize guard:
+        an async assembly advances last_summarized_gen from its worker
+        thread under the same lock."""
+        with self._guard_lock:
+            epoch = dict(self.last_summarized_gen)
         return {k for k in list(self.where)
-                if self.change_gen.get(k, 0)
-                > self.last_summarized_gen.get(k, 0)}
+                if self.change_gen.get(k, 0) > epoch.get(k, 0)}
 
     def cached_blob_count(self) -> int:
         """Assembled snapshots currently held by the summarize blob
         cache (the public, monitor-safe view of _snap_cache)."""
-        return len(self._snap_cache)
+        with self._guard_lock:
+            return len(self._snap_cache)
 
     def drop(self, key: tuple) -> None:
         """Mark a channel opaque: an op arrived the server cannot model
@@ -439,13 +443,19 @@ class MergeLaneStore:
 
     def _forget_lane_payloads(self, key: tuple) -> None:
         """The lane's rows are gone: free its fold generation and release
-        every block ref."""
+        every block ref. The blob-cache eviction rides the summarize
+        guard, ordered STRICTLY AFTER the caller popped `where`: an
+        async assembly's adoption (extract_assemble) checks `where` and
+        writes the cache under the same lock, so either interleaving is
+        safe — adopt-then-evict or evict-after-skip — and a dropped
+        lane can never resurrect a cache entry."""
         self.free_payloads(self._fold_payloads.pop(key, ()))
         for block in self._lane_blocks.pop(key, ()):
             self._release_block_ref(block, key)
         self._fold_skip.pop(key, None)
-        self._snap_cache.pop(key, None)
-        self.last_summarized_gen.pop(key, None)
+        with self._guard_lock:
+            self._snap_cache.pop(key, None)
+            self.last_summarized_gen.pop(key, None)
 
     def _free_payload(self, op_id: int) -> None:
         self.free_payloads((op_id,))
@@ -1506,6 +1516,11 @@ class MergeLaneStore:
             return self._extract_dispatch_paged(only, chunk_chars)
         jobs = []
         cached: Dict[tuple, dict] = {}
+        # One lock round for the whole scan: the blob cache is written
+        # from the async-summary worker under the guard, so the dispatch
+        # reads a coherent epoch snapshot instead of the live dict.
+        with self._guard_lock:
+            snap_view = dict(self._snap_cache)
         for bucket in self.buckets:
             lanes = []
             live = 0
@@ -1515,7 +1530,7 @@ class MergeLaneStore:
                 live += 1
                 if only is not None and key not in only:
                     continue
-                hit = self._snap_cache.get(key)
+                hit = snap_view.get(key)
                 if hit is not None and hit[0] == self.change_gen.get(key, 0) \
                         and hit[1] == chunk_chars:
                     cached[key] = hit[2]
@@ -1564,12 +1579,14 @@ class MergeLaneStore:
         jobs = []
         cached: Dict[tuple, dict] = {}
         lanes: List[tuple] = []
+        with self._guard_lock:
+            snap_view = dict(self._snap_cache)
         for key in list(self.where):
             if key not in pg.tables:
                 continue
             if only is not None and key not in only:
                 continue
-            hit = self._snap_cache.get(key)
+            hit = snap_view.get(key)
             if hit is not None and hit[0] == self.change_gen.get(key, 0) \
                     and hit[1] == chunk_chars:
                 cached[key] = hit[2]
@@ -1610,6 +1627,17 @@ class MergeLaneStore:
         generation, advancing the summarize epoch."""
         from ..mergetree.host import assemble_snapshot
 
+        # The payload-table read from the async-summary worker thread
+        # rides the extract-guard protocol, not a mutual-exclusion
+        # lock: summarize_documents_async holds _extract_guards while
+        # this runs, so the sequencing thread DEFERS every free
+        # (free_payloads) instead of recycling an id the assembly is
+        # resolving. fluidlint cannot see that protocol, so the access
+        # is declared safe here and verified at runtime by
+        # testing/lockcheck.py.
+        # fluidlint: disable=SHARED_STATE_NO_LOCK — worker read
+        # protected by the _extract_guards deferred-free protocol
+        table = self.payloads
         out: Dict[tuple, dict] = dict(cached or {})
         for packed, lanes, seq_dev, min_seq_dev, gens in jobs:
             t0 = time.perf_counter()
@@ -1620,23 +1648,33 @@ class MergeLaneStore:
             min_seqs = np.asarray(min_seq_dev)
             for lane, key in lanes:
                 snap = assemble_snapshot(
-                    packed, self.payloads, lane,
+                    packed, table, lane,
                     min_seq=int(min_seqs[lane]), seq=int(seqs[lane]),
                     chunk_chars=chunk_chars)
                 out[key] = snap
-                # Monotone adoption: an async worker finishing LATE must
-                # not clobber a newer-generation entry an interleaved
-                # synchronous summarize already cached, nor resurrect a
-                # cache entry for a lane drop() evicted mid-assembly
-                # (the snapshot would be retained forever for a channel
-                # that no longer exists).
-                if key not in self.where:
-                    continue
-                prev = self._snap_cache.get(key)
-                if prev is None or prev[0] <= gens[key]:
-                    self._snap_cache[key] = (gens[key], chunk_chars, snap)
-                self.last_summarized_gen[key] = max(
-                    self.last_summarized_gen.get(key, 0), gens[key])
+                # Monotone adoption, under the summarize guard: an async
+                # worker finishing LATE must not clobber a newer-
+                # generation entry an interleaved synchronous summarize
+                # already cached, nor resurrect a cache entry for a lane
+                # drop() evicted mid-assembly (the snapshot would be
+                # retained forever for a channel that no longer exists).
+                # drop() pops `where` BEFORE its guarded eviction, so
+                # with the adoption check-and-write atomic under the
+                # same lock, either interleaving is safe.
+                with self._guard_lock:
+                    # fluidlint: disable=SHARED_STATE_NO_LOCK —
+                    # GIL-atomic membership probe: drop() evicts the
+                    # blob cache under _guard_lock strictly after
+                    # popping `where`, so a stale read here only skips
+                    # an adoption the eviction would have undone
+                    if key not in self.where:
+                        continue
+                    prev = self._snap_cache.get(key)
+                    if prev is None or prev[0] <= gens[key]:
+                        self._snap_cache[key] = (gens[key], chunk_chars,
+                                                 snap)
+                    self.last_summarized_gen[key] = max(
+                        self.last_summarized_gen.get(key, 0), gens[key])
             increment("summarize.dirty_docs", len(lanes))
             increment("summarize.blob_cache.misses", len(lanes))
         return out

@@ -1,0 +1,60 @@
+"""Seeded known-bug fixture for fluidlint v3's SHARED_STATE_NO_LOCK.
+
+A stripped-down in-flight ring entry whose daemon fetch thread mutates
+sequencer state WITHOUT the guard lock — the PR 5 quarantine-fixup bug
+shape with ``_guard_lock`` removed from the mutation path. The real
+``tpu_sequencer`` ring keeps fetch-thread results in per-entry dicts
+precisely so the threads never touch shared instance attributes; this
+fixture is what the code would look like if someone "simplified" that
+into direct attribute mutation.
+
+Committed as a must-fire true positive (pinned by
+``tests/test_race_detector.py::TestSeededRingFixture``): if the rule
+ever stops firing here, it has gone vacuous and the gate fails. This
+file is NEVER imported by production code and sits outside the
+analyzer's default package scope — only the pin test feeds it through
+``analyze_source``.
+"""
+
+import threading
+
+import numpy as np
+
+
+class RingSequencer:
+    """The buggy shape: ring bookkeeping shared between the sequencing
+    thread (dispatch/drain) and the daemon fetch threads, with the
+    guard lock declared but NOT taken on the fetch side."""
+
+    def __init__(self):
+        self._guard_lock = threading.Lock()
+        self.ring_entries = {}        # window id -> fetched flat planes
+        self.fetch_errors = []        # surfaced at the next drain
+        self._pending_windows = 0
+
+    def dispatch_window(self, wid, flat_dev):
+        self._pending_windows += 1
+
+        def fetch():
+            try:
+                # BUG: the fetch thread mutates the shared ring tables
+                # directly; the drain thread reads them concurrently
+                # with no common lock (the _guard_lock discipline was
+                # dropped here).
+                self.ring_entries[wid] = np.asarray(flat_dev)
+                self._pending_windows -= 1
+            except Exception as err:  # noqa: BLE001 — surface at join
+                self.fetch_errors.append(err)
+
+        thread = threading.Thread(target=fetch, daemon=True)
+        thread.start()
+        return thread
+
+    def drain(self):
+        if self.fetch_errors:
+            raise self.fetch_errors[0]
+        while self._pending_windows:
+            pass
+        out = dict(self.ring_entries)
+        self.ring_entries.clear()
+        return out

@@ -225,18 +225,30 @@ class TestServingSoak:
         B.pipelined = trial % 2 == 1
         if B.pipelined and rng.random() < 0.25:
             B.defer_risky_windows = True
-        tr = _serving_traffic(rng)
-        for i, (doc, box) in enumerate(tr):
-            A.handler(QueuedMessage("rawdeltas", 0, i, doc, box))
-            B.handler_raw(QueuedMessage("rawdeltas", 0, i, doc,
-                                        boxcar_to_wire(box)))
-            if rng.random() < 0.3:
-                A.flush()
-                B.flush()
-        A.flush()
-        B.flush()
-        A.drain()
-        B.drain()
+        # Runtime lockset verification (fluidlint v3's dynamic half):
+        # the pipelined store runs the soak with the statically inferred
+        # summarize-guard discipline asserted on every access.
+        from fluidframework_tpu.testing.lockcheck import (instrument,
+                                                          static_guards)
+        guards = static_guards(type(B.merge))
+        guards["_deferred_frees"] = "_guard_lock"
+        lockcheck = instrument(B.merge, guards)
+        try:
+            tr = _serving_traffic(rng)
+            for i, (doc, box) in enumerate(tr):
+                A.handler(QueuedMessage("rawdeltas", 0, i, doc, box))
+                B.handler_raw(QueuedMessage("rawdeltas", 0, i, doc,
+                                            boxcar_to_wire(box)))
+                if rng.random() < 0.3:
+                    A.flush()
+                    B.flush()
+            A.flush()
+            B.flush()
+            A.drain()
+            B.drain()
+            lockcheck.assert_clean()
+        finally:
+            lockcheck.uninstrument()
         assert sorted(ea) == sorted(eb)
         assert sorted(na) == sorted(nb)
         for d in {t[0] for t in tr}:
@@ -850,3 +862,93 @@ class TestHotDocumentChaos:
         # Ops from all clients made it through the hot partition.
         assert len({cid for _, cid, _ in a["sequenced"]}) \
             == self.N_CLIENTS
+
+
+class TestAsyncSummaryLockDiscipline:
+    """Fixed-seed serving traffic with async summaries in flight while
+    the sequencing thread keeps flushing — the exact overlap the
+    MergeLaneStore summarize-guard discipline exists for. The store
+    runs instrumented with the locksets fluidlint v3 STATICALLY
+    inferred (testing/lockcheck.py static_guards), so the model and the
+    code cannot drift apart: a new unguarded access to the blob cache /
+    deferred-free state fails here even if its static finding was
+    suppressed. Deterministic (fixed seed, joined workers) — tier-1,
+    no SOAK gate."""
+
+    def test_inferred_locksets_hold_under_async_summaries(self):
+        from fluidframework_tpu.server.log import QueuedMessage
+        from fluidframework_tpu.server.tpu_sequencer import (
+            TpuSequencerLambda)
+        from fluidframework_tpu.testing.lockcheck import (instrument,
+                                                          static_guards)
+
+        class _Ctx:
+            def checkpoint(self, *_):
+                pass
+
+            def error(self, err, restart=False):
+                raise err
+
+        seq = TpuSequencerLambda(_Ctx(), emit=lambda d, m: None,
+                                 nack=lambda d, c, n: None,
+                                 client_timeout_s=0.0)
+        guards = static_guards(type(seq.merge))
+        # The statically inferred guard map must cover the summarize
+        # epoch state — if the model stops seeing the discipline, this
+        # assert (not just the runtime wrap) catches the drift.
+        assert guards.get("_snap_cache") == "_guard_lock"
+        assert guards.get("_extract_guards") == "_guard_lock"
+        assert guards.get("last_summarized_gen") == "_guard_lock"
+        guards["_deferred_frees"] = "_guard_lock"
+        lockcheck = instrument(seq.merge, guards)
+        rng = random.Random(909_090)
+
+        def boxcar(doc, csn, txt=None):
+            if csn == 0:
+                msg = DocumentMessage(
+                    client_sequence_number=0,
+                    reference_sequence_number=-1,
+                    type=MessageType.CLIENT_JOIN,
+                    data=json.dumps({"clientId": f"c-{doc}"}))
+            else:
+                msg = DocumentMessage(
+                    client_sequence_number=csn,
+                    reference_sequence_number=-1,
+                    type=MessageType.OPERATION,
+                    contents={"type": "insert", "pos1": 0,
+                              "seg": {"text": txt}, "channel": "t",
+                              "store": "s"})
+            return Boxcar(tenant_id="t", document_id=doc, client_id=None,
+                          contents=[msg])
+
+        docs = [f"d{i}" for i in range(4)]
+        offset = 0
+        workers = []
+        done = []
+        try:
+            for doc in docs:
+                seq.handler(QueuedMessage("rawdeltas", 0, offset, doc,
+                                          boxcar(doc, 0)))
+                offset += 1
+            for wave in range(6):
+                for k in range(12):
+                    doc = rng.choice(docs)
+                    seq.handler(QueuedMessage(
+                        "rawdeltas", 0, offset, doc,
+                        boxcar(doc, wave * 12 + k + 1,
+                               chr(97 + (offset % 26)))))
+                    offset += 1
+                    if rng.random() < 0.4:
+                        seq.flush()
+                seq.flush()
+                seq.drain()
+                # Async summary dispatched, then MORE sequencing while
+                # the worker assembles — the contended overlap.
+                workers.append(seq.summarize_documents_async(
+                    lambda out: done.append(len(out))))
+            for th in workers:
+                th.join(10)
+            lockcheck.assert_clean()
+        finally:
+            lockcheck.uninstrument()
+        assert len(done) == len(workers)
