@@ -3,7 +3,8 @@
 
 .PHONY: test soak bench dryrun record-corpus historian-smoke \
 	summarize-smoke trace-smoke pipeline-smoke fused-smoke \
-	paged-smoke lint-analysis lint-changed lint-races layer-check check
+	paged-smoke catchup-smoke lint-analysis lint-changed lint-races \
+	layer-check check
 
 test:
 	python -m pytest tests/ -q
@@ -95,6 +96,17 @@ fused-smoke:
 paged-smoke:
 	JAX_PLATFORMS=cpu python bench.py paged-smoke
 
+# CPU smoke of the million-reader read path (docs/read_path.md): a
+# client catching up via `summary + delta` (artifact adoption) must be
+# content- and protocol-identical to scalar tail replay on a ragged
+# contended fleet, warm per-client catch-up p50 must stay under 100 ms,
+# one refresh epoch must cost <= 2 batched device dispatches with ZERO
+# additional dispatches per connecting client, the int16 narrow delta
+# wire must actually narrow, and sharded broadcast fan-out must deliver
+# a hot document to every subscriber in per-doc order.
+catchup-smoke:
+	JAX_PLATFORMS=cpu python bench.py catchup-smoke
+
 # Virtual-clocked open-loop overload harness (docs/overload.md): at 2x
 # sustained overload the admission controller must shed instead of
 # queueing unboundedly (peak queue bounded), hold the admitted-op flush
@@ -108,7 +120,8 @@ overload-smoke:
 # focused race gate) + the summarize/trace/pipeline/fused/overload
 # smokes + the full test suite.
 check: layer-check lint-analysis lint-races summarize-smoke trace-smoke \
-		pipeline-smoke fused-smoke paged-smoke overload-smoke test
+		pipeline-smoke fused-smoke paged-smoke catchup-smoke \
+		overload-smoke test
 
 # The round-end randomized-evidence ritual: 50-trial soaks over every
 # differential surface (bulk catch-up, serving fast path, matrix/

@@ -552,6 +552,37 @@ def _lint_analysis_record() -> dict:
                                     "violations", "baselined")}
 
 
+def _read_path_record(partial_extra: dict) -> dict:
+    """The read-tier block (ISSUE 12): this run's fleet-level device
+    catch-up figures (formerly buried in `extra`) joined with the last
+    `make catchup-smoke` record's per-client delta-path measurements —
+    warm artifact-adoption p50 vs the tail-replay p50 on the same fleet,
+    delta hit/miss/stale counts, refresh dispatch discipline, and the
+    sharded broadcaster's fan-out counters."""
+    rec = {
+        "summary_catchup_p50_ms": partial_extra.get(
+            "summary_catchup_p50_ms"),
+        "summary_catchup_docs": partial_extra.get("summary_catchup_docs"),
+        "summary_catchup_per_doc_ms": partial_extra.get(
+            "summary_catchup_per_doc_ms"),
+        "summary_catchup_warm": partial_extra.get("summary_catchup_warm"),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_CATCHUP_LAST.json")
+    try:
+        with open(path) as f:
+            last = json.load(f)
+    except (OSError, ValueError):
+        last = {}
+    for key in ("catchup_p50_ms", "replay_p50_ms", "catchup_speedup",
+                "refresh_dispatches_per_epoch", "delta_hits",
+                "delta_misses", "delta_stale", "narrow_wire_ratio",
+                "broadcaster_shards", "broadcaster_delivered",
+                "broadcaster_shed"):
+        rec[key] = last.get(key)
+    return rec
+
+
 def _recorded_replay_rate() -> dict:
     """Replay the RECORDED session corpora (tests/corpus/ — real
     multi-client sessions captured through the alfred websocket stack,
@@ -1104,8 +1135,18 @@ def main() -> None:
             # run's wall time, cache effectiveness, and counts, read
             # from the record the CLI drops (BENCH_LINT_LAST.json).
             "lint_analysis": _lint_analysis_record(),
+            # The read tier rides TOP-level (ISSUE 12): the fleet-level
+            # device catch-up figures measured in THIS run (per-doc
+            # normalized — the r07 lesson) plus the per-CLIENT delta
+            # path measured by the last `make catchup-smoke` run
+            # (warm artifact-adoption p50, delta hit/miss/stale, and the
+            # broadcaster shard counters), read from
+            # BENCH_CATCHUP_LAST.json the same way lint_analysis reads
+            # its record.
+            "read_path": _read_path_record(partial_extra),
             "extra": {k: v for k, v in partial_extra.items()
-                      if not k.startswith("_")},
+                      if not k.startswith("_")
+                      and not k.startswith("summary_catchup")},
         }
         if partial:
             rec["partial"] = True
@@ -2136,6 +2177,229 @@ def paged_smoke() -> int:
     return 0 if all(checks.values()) else 1
 
 
+def _catchup_fleet(server, n_key=16, key_ops=24, n_storm=4,
+                   storm_ops=400, seed=11):
+    """A ragged container fleet through the REAL client stack: n_key
+    lightly-edited docs + n_storm deep ones, every op sequenced through
+    the device pipeline. Writers close at the end so the measured
+    read phase sees a quiesced fleet. Returns (loader, doc_ids,
+    reference_texts)."""
+    import random as _random
+
+    from fluidframework_tpu.dds.sequence import SharedString
+    from fluidframework_tpu.loader.container import Loader
+    from fluidframework_tpu.loader.drivers.local import (
+        LocalDocumentServiceFactory)
+
+    rng = _random.Random(seed)
+    loader = Loader(LocalDocumentServiceFactory(server))
+    docs = [(f"k{i}", key_ops) for i in range(n_key)] \
+        + [(f"S{i}", storm_ops) for i in range(n_storm)]
+    texts = {}
+    for doc_id, n_ops in docs:
+        c = loader.create_detached(doc_id)
+        ds = c.runtime.create_datastore("default")
+        t = ds.create_channel("text", SharedString.TYPE)
+        t.insert_text(0, "base")
+        c.attach()
+        for i in range(n_ops):
+            t.insert_text(rng.randrange(t.get_length() + 1), f"w{i} ")
+        texts[doc_id] = t.get_text()
+        c.close()
+    server.pump()
+    return loader, [d for d, _ in docs], texts
+
+
+def _flatten_client_channel(channel):
+    """Per-char (char, props) stream of a client channel's VISIBLE
+    content — the same engine-internal-segmentation normalization
+    flatten_snapshot_content applies server-side."""
+    out = []
+    for e in channel.client.tree.snapshot_segments():
+        if e.get("removedSeq") is not None or e.get("kind", 0) != 0:
+            continue
+        props = tuple(sorted((e.get("props") or {}).items()))
+        for ch in e.get("text", ""):
+            out.append((ch, props))
+    return out
+
+
+def catchup_smoke() -> int:
+    """CPU smoke for the million-reader read path (`make catchup-smoke`,
+    docs/read_path.md). Asserts the acceptance properties:
+
+      * bit-identity: a client catching up via `summary + delta`
+        (artifact adoption) reaches content + protocol state identical
+        to a client replaying the op tail scalar, on a ragged fleet
+        with contended edits — per-char flattened comparison, the same
+        normalization the paged smoke applies (segmentation is
+        engine-internal);
+      * warm per-client catch-up p50 < 100 ms — the figure that was
+        46,096 ms as a whole-fleet replay in BENCH_r08 becomes an O(1)
+        per-client artifact adoption;
+      * batched refresh discipline: one refresh epoch covering every
+        dirty doc costs <= 2 device dispatches (one per capacity
+        bucket), and serving N clients afterwards costs ZERO additional
+        dispatches — server cost scales with dirty docs, not readers;
+      * the narrow int16 delta wire actually narrows (packed artifact
+        bytes < raw JSON entries bytes);
+      * sharded broadcast fan-out delivers a hot document to every
+        subscriber in per-doc order with bounded queues.
+
+    Prints one JSON line (also written to BENCH_CATCHUP_LAST.json);
+    exit 0 iff every check passes."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from fluidframework_tpu.mergetree.catchup import unpack_entries_narrow
+    from fluidframework_tpu.server.local_server import TpuLocalServer
+    from fluidframework_tpu.telemetry import counters
+
+    server = TpuLocalServer()
+    loader, doc_ids, texts = _catchup_fleet(server)
+
+    # One refresh epoch for the WHOLE dirty fleet: the dispatch gate.
+    disp0 = counters.get("catchup.refresh_dispatches")
+    refresh = server.refresh_catchup()
+    epoch_dispatches = counters.get("catchup.refresh_dispatches") - disp0
+
+    # Bit-identity sample: delta-adopted vs scalar tail replay.
+    sample = [doc_ids[0], doc_ids[1], doc_ids[-2], doc_ids[-1]]
+    identical = True
+    adopted0 = counters.get("catchup.client.adopted")
+    for doc_id in sample:
+        c_delta = loader.resolve(doc_id, client_details={"mode": "read"})
+        saved, server.catchup = server.catchup, None
+        c_replay = loader.resolve(doc_id, client_details={"mode": "read"})
+        server.catchup = saved
+        ch_d = c_delta.runtime.get_datastore("default").get_channel("text")
+        ch_r = c_replay.runtime.get_datastore("default").get_channel("text")
+        identical = identical \
+            and ch_d.get_text() == ch_r.get_text() == texts[doc_id] \
+            and _flatten_client_channel(ch_d) \
+            == _flatten_client_channel(ch_r) \
+            and c_delta.protocol.sequence_number \
+            == c_replay.protocol.sequence_number \
+            and c_delta.protocol.quorum.snapshot() \
+            == c_replay.protocol.quorum.snapshot()
+        c_delta.close()
+        c_replay.close()
+    server.pump()
+    delta_used = counters.get("catchup.client.adopted") - adopted0 \
+        >= len(sample)
+
+    # Narrow-wire effectiveness on the deepest doc's artifact.
+    artifact = server.get_catchup(doc_ids[-1])
+    packed_bytes = raw_bytes = 0
+    for _store, _chan, _header, blob in artifact["channels"]:
+        packed_bytes += len(json.dumps(blob))
+        raw_bytes += len(json.dumps(unpack_entries_narrow(blob)))
+    narrow_ratio = packed_bytes / max(1, raw_bytes)
+
+    # Warm per-client catch-up: read-mode loads over random docs with a
+    # warm artifact cache; one unmeasured load absorbs first-touch cost.
+    import random as _random
+    rng = _random.Random(3)
+    loader.resolve(doc_ids[-1], client_details={"mode": "read"}).close()
+    disp1 = counters.get("catchup.refresh_dispatches")
+    trials = []
+    replay_trials = []
+    storm_ids = [d for d in doc_ids if d.startswith("S")]
+    for _ in range(11):
+        # Deep-history docs: the hot-document catch-up the read tier
+        # exists for (a keystroke doc's tail replays in a blink either
+        # way and would only flatter the p50).
+        doc_id = rng.choice(storm_ids)
+        t0 = time.perf_counter()
+        c = loader.resolve(doc_id, client_details={"mode": "read"})
+        ch = c.runtime.get_datastore("default").get_channel("text")
+        ch.get_text()  # materialize: catch-up isn't done until readable
+        trials.append(time.perf_counter() - t0)
+        c.close()
+        # Paired tail-replay load of the same doc (not gated; stamps the
+        # speedup the delta path buys on this very fleet).
+        saved, server.catchup = server.catchup, None
+        t0 = time.perf_counter()
+        c = loader.resolve(doc_id, client_details={"mode": "read"})
+        c.runtime.get_datastore("default").get_channel("text").get_text()
+        replay_trials.append(time.perf_counter() - t0)
+        server.catchup = saved
+        c.close()
+    server.pump()
+    client_dispatches = counters.get("catchup.refresh_dispatches") - disp1
+    catchup_p50_ms = sorted(trials)[len(trials) // 2] * 1000.0
+    replay_p50_ms = sorted(replay_trials)[len(replay_trials) // 2] * 1000.0
+
+    # Hot-document sharded fan-out: every subscriber, per-doc order,
+    # bounded queues (a separate sharded core — the write fleet above
+    # keeps the deterministic inline pump).
+    from fluidframework_tpu.protocol.messages import (DocumentMessage,
+                                                      MessageType)
+
+    class _Cfg(dict):
+        def get(self, k, d=None):
+            return dict.get(self, k, d)
+
+    hot = TpuLocalServer(config=_Cfg({"broadcaster.shards": 4,
+                                      "broadcaster.queueLimit": 256,
+                                      "catchup.enabled": True}))
+    readers = []
+    for _ in range(32):
+        conn = hot.connect("hot", {"mode": "read"})
+        seen = []
+        conn.on("op", lambda m, s=seen: s.append(m.sequence_number))
+        readers.append(seen)
+    writer = hot.connect("hot")
+    hot.pump()
+    for k in range(64):
+        writer.submit([DocumentMessage(
+            client_sequence_number=k + 1, reference_sequence_number=0,
+            type=MessageType.OPERATION, contents={"k": k})])
+    hot.pump()
+    drained = hot.drain_broadcast(20.0)
+    fan_ordered = all(s == sorted(s) for s in readers)
+    fan_complete = all(len(s) >= 64 for s in readers)
+    bstats = hot.broadcasters[0].stats()
+
+    checks = {
+        "delta_replay_bit_identical": identical and delta_used,
+        "warm_catchup_p50_lt_100ms": catchup_p50_ms < 100.0,
+        "refresh_dispatches_le_2_per_epoch": 0 < epoch_dispatches <= 2,
+        "clients_cost_zero_dispatches": client_dispatches == 0,
+        "narrow_wire_narrows": narrow_ratio < 0.9,
+        "sharded_fanout_ordered_complete":
+            drained and fan_ordered and fan_complete,
+    }
+    record = {
+        "metric": "catchup-smoke",
+        "backend": jax.default_backend(),
+        "fleet_docs": len(doc_ids),
+        "refresh": refresh,
+        "refresh_dispatches_per_epoch": epoch_dispatches,
+        "client_loads": len(trials),
+        "client_extra_dispatches": client_dispatches,
+        "catchup_p50_ms": round(catchup_p50_ms, 2),
+        "replay_p50_ms": round(replay_p50_ms, 2),
+        "catchup_speedup": round(replay_p50_ms
+                                 / max(catchup_p50_ms, 1e-6), 2),
+        "narrow_wire_ratio": round(narrow_ratio, 3),
+        "delta_hits": counters.get("catchup.delta_hit"),
+        "delta_misses": counters.get("catchup.delta_miss"),
+        "delta_stale": counters.get("catchup.delta_stale"),
+        "client_adoptions": counters.get("catchup.client.adopted"),
+        "broadcaster_shards": bstats["shards"],
+        "broadcaster_delivered": bstats["delivered"],
+        "broadcaster_shed": bstats["shed"],
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    _write_json_atomic(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_CATCHUP_LAST.json"), record)
+    print(json.dumps(record))
+    return 0 if all(checks.values()) else 1
+
+
 def fused_smoke() -> int:
     """CPU smoke for the fused serving-burst path (`make fused-smoke`,
     docs/serving_pipeline.md R8): drives identical raw-wire waves at the
@@ -2663,6 +2927,8 @@ if __name__ == "__main__":
         sys.exit(fused_smoke())
     if len(sys.argv) > 1 and sys.argv[1] == "paged-smoke":
         sys.exit(paged_smoke())
+    if len(sys.argv) > 1 and sys.argv[1] == "catchup-smoke":
+        sys.exit(catchup_smoke())
     try:
         main()
     except Exception as e:  # noqa: BLE001 - never exit without the JSON line

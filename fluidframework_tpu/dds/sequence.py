@@ -377,6 +377,50 @@ class SharedSegmentSequence(SharedObject):
             return
         self.client.update_client_id(ordinal)
 
+    # -- read-path catch-up adoption (docs/read_path.md) -------------------
+    def can_adopt_catchup(self) -> bool:
+        """Whether this channel's state may be REPLACED wholesale by a
+        server catch-up artifact: nothing local may be live — no pending
+        (unacked) edits, no interval collections (their anchors are live
+        local references that do not survive a state swap), no in-flight
+        interval ops. A lazy, untouched body trivially qualifies."""
+        if self._interval_collections or self._pending_interval_ops:
+            return False
+        if self._lazy is not None:
+            return True  # fresh from snapshot: no local state can exist
+        tree = self._client.tree
+        return not tree.pending_groups \
+            and not any(seg.local_refs for seg in tree.segments)
+
+    def adopt_catchup_core(self, entries: List[dict], seq: int,
+                           min_seq: int, total_length: int) -> None:
+        """Swap in server-materialized snapshot entries at `seq` — the
+        delta half of `summary + delta` catch-up. The swap re-enters the
+        ordinary lazy-load path (a synthetic header + one body chunk in
+        the summarize_core wire format), so payload decoding, ordinal
+        adoption, and the delta-event wiring are EXACTLY the fresh-load
+        code — no second deserialization path to keep conformant. Any
+        remote ops deferred against the previous lazy body are covered
+        by the artifact (their seqs are <= `seq`) and drop."""
+        if not self.can_adopt_catchup():
+            raise ValueError("channel has live local state")
+        if self._lazy is None:
+            # Preserve the materialized body's ordinal adoption across
+            # the swap (bind_to_runtime/adopt_client_ordinal already ran).
+            ordinal = self._client.client_id
+            if ordinal is not None and ordinal >= 0:
+                self._lazy_ordinal = ordinal
+        tree = SummaryTree()
+        tree.add_blob("header", json.dumps({
+            "seq": seq, "minSeq": min_seq, "chunkCount": 1,
+            "totalLength": total_length}))
+        tree.add_blob("body_0", json.dumps(entries))
+        self._client = None
+        self._deferred_remote = []
+        self._lazy = (tree, json.loads(tree.entries["header"].content))
+        self._lazy_len = int(total_length)
+        self.change_epoch += 1  # adopted state is NOT durably summarized
+
     def connect(self) -> None:
         # A lazily-loaded channel is fresh from a snapshot: it cannot have
         # detached edits, so the pending-groups probe must not defeat the

@@ -98,18 +98,33 @@ class Container(TypedEventEmitter):
              registry: Optional[ChannelRegistry] = None,
              code_loader=None,
              client_details: Optional[dict] = None) -> "Container":
-        """Reference Container.load (container.ts:186): summary + op tail.
+        """Reference Container.load (container.ts:186): summary + op tail
+        — with the read tier's fast path layered on top
+        (docs/read_path.md): the storage round trip returns `summary +
+        catch-up delta` together, the delta adopts the summary-to-head
+        gap as a state swap, and connect() then replays only the residue
+        past the artifact's seq instead of the whole tail. An absent,
+        stale, or unadoptable artifact degrades to exactly the old
+        summary + tail-replay behavior.
+
         client_details={"mode": "read"} loads a READ-ONLY observer: it
         follows the live op/signal streams but never joins the quorum,
         never holds back the MSN, and never submits."""
         container = Container(document_id, service, registry, code_loader,
                               client_details)
-        summary = container.storage.get_summary()
+        try:
+            summary, artifact = container.storage.get_catchup()
+        except Exception:  # noqa: BLE001 — a dead read tier must not fail loads
+            from ..telemetry.counters import record_swallow
+            record_swallow("container.get_catchup")
+            summary, artifact = container.storage.get_summary(), None
         if summary is None:
             raise FileNotFoundError(f"document {document_id!r} has no summary")
         container._load_from_summary(summary)
         versions = container.storage.get_versions(1)
         container._last_summary_handle = versions[0] if versions else None
+        if artifact is not None:
+            container._try_adopt_catchup(artifact)
         container.attached = True
         container._instantiate_code(existing=True)
         container.connect()
@@ -164,6 +179,136 @@ class Container(TypedEventEmitter):
                 quorum_snapshot=state["quorum"]))
         self.runtime.load(summary.entries[".app"])
 
+    # -- read-path catch-up adoption (docs/read_path.md) -------------------
+    def _plan_catchup_adoption(self, artifact: dict):
+        """Validate an artifact against the container's current state and
+        return the fully-decoded adoption plan, or None with the fallback
+        counter bumped. NOTHING mutates here — adoption is all-or-nothing
+        (a partial adoption would desync channels against the shared
+        per-doc sequence bookkeeping)."""
+        from ..server.readpath import (quorum_ordinals,
+                                       translate_entry_clients,
+                                       unpack_entries_narrow)
+        from ..telemetry.counters import increment
+
+        seq = int(artifact["seq"])
+        if seq <= self.protocol.sequence_number:
+            # The summary we loaded already covers the artifact's state
+            # (a client summary landed after the last refresh).
+            increment("catchup.client.stale_artifact")
+            return None
+        # wire client id -> quorum ordinal (its join seq), from the SAME
+        # quorum snapshot the adoption installs — protocol state and
+        # perspective math cannot disagree.
+        members = quorum_ordinals(artifact["quorum"])
+        idx_to_ordinal = {}
+        for i, cid in enumerate(artifact.get("clients", [])):
+            if cid in members:
+                idx_to_ordinal[i] = members[cid]
+            else:
+                # A DEPARTED client: its identity is semantically inert —
+                # no op of its can ever arrive again (client ids are
+                # never reused), so contended rows it left behind only
+                # need an ordinal that collides with no live client
+                # (join seqs are >= 1) and no future one. Unique
+                # negatives below -1 satisfy both; the scalar replay
+                # path keeps the real historical join seq here, a
+                # divergence confined to metadata that can never affect
+                # visibility again (docs/read_path.md).
+                idx_to_ordinal[i] = -(i + 2)
+        plan = []
+        try:
+            for store_id, channel_id, header, blob in artifact["channels"]:
+                store = self.runtime.datastores.get(store_id)
+                channel = store.channels.get(channel_id) \
+                    if store is not None else None
+                if channel is None \
+                        or not hasattr(channel, "adopt_catchup_core") \
+                        or not channel.can_adopt_catchup():
+                    increment("catchup.client.unadoptable")
+                    return None
+                entries = unpack_entries_narrow(blob)
+                # KeyError here = a contended row references a client the
+                # quorum no longer knows: untranslatable, fall back.
+                entries = translate_entry_clients(entries, idx_to_ordinal)
+                plan.append((channel, entries, header))
+        except (KeyError, ValueError, TypeError):
+            increment("catchup.client.undecodable")
+            return None
+        return seq, members, plan
+
+    def _try_adopt_catchup(self, artifact: dict) -> bool:
+        """Adopt a catch-up artifact: protocol state + every channel swap
+        to the artifact's seq, so the tail replay that follows covers
+        only the residue past it. Returns False (state untouched) on any
+        validation failure — the tail replay fallback is always
+        correct, just O(tail)."""
+        from ..telemetry.counters import increment
+
+        planned = self._plan_catchup_adoption(artifact)
+        if planned is None:
+            return False
+        seq, members, plan = planned
+        msn = int(artifact.get("msn", 0))
+        self.protocol = ProtocolOpHandler.load(ProtocolState(
+            sequence_number=seq, minimum_sequence_number=msn,
+            quorum_snapshot=artifact["quorum"]))
+        self.runtime.sequence_number = seq
+        self.runtime.minimum_sequence_number = msn
+        # Ordinal table + audience come from the quorum snapshot — the
+        # tail's join/leave ops we skipped are folded into it.
+        self.runtime._ordinals = dict(members)
+        details = {cid: (m.get("details") or {})
+                   for cid, m in artifact["quorum"].get("members", [])}
+        for cid in members:
+            if cid not in self.audience.members:
+                self.audience.add_member(cid, details.get(cid, {}))
+        for channel, entries, header in plan:
+            channel.adopt_catchup_core(
+                entries,
+                seq=int(header.get("sequenceNumber", seq)),
+                min_seq=int(header.get("minimumSequenceNumber", 0)),
+                total_length=int(header.get("totalLength", 0)))
+        increment("catchup.client.adopted")
+        self.emit("catchUpAdopted", seq)
+        return True
+
+    def _reconnect_catchup(self, last_seq: int):
+        """DeltaManager catch-up hook: on (re)connect with a long gap, a
+        clean container (no pending local state) fetches the artifact
+        and adopts instead of replaying the gap. Returns the adopted seq
+        (the delta manager resumes the residue there) or None."""
+        from ..telemetry.counters import increment
+
+        dm = self.delta_manager
+        if self.runtime.pending.count:
+            return None  # unacked local ops need scalar ack pairing
+        if last_seq < self.protocol.sequence_number:
+            return None  # mid-load inconsistency: let the replay settle it
+        try:
+            artifact = self.storage.get_catchup_artifact()
+        except Exception:  # noqa: BLE001 — dead read tier: replay instead
+            increment("catchup.client.fetch_failed")
+            return None
+        if artifact is None:
+            return None
+        gap = int(artifact.get("seq", 0)) - last_seq
+        if gap < dm.bulk_catchup_threshold:
+            return None  # short residue: the ordinary replay is cheaper
+        with dm.lock:
+            # Revalidate under the lock: the reader thread may have
+            # delivered ops (or the runtime submitted) since the probe.
+            if self.runtime.pending.count \
+                    or dm.last_sequence_number != last_seq:
+                return None
+            if not self._try_adopt_catchup(artifact):
+                return None
+            dm.last_sequence_number = self.protocol.sequence_number
+            dm.minimum_sequence_number = \
+                self.protocol.minimum_sequence_number
+            increment("catchup.client.reconnect_adopted")
+            return self.protocol.sequence_number
+
     # -- attach (detached -> live) ----------------------------------------
     def attach(self) -> None:
         """Upload the initial summary and go live (container.ts:543)."""
@@ -186,6 +331,7 @@ class Container(TypedEventEmitter):
         self.delta_manager.attach_op_handler(
             self.protocol.sequence_number, self._process)
         self.delta_manager.attach_bulk_handler(self._process_bulk)
+        self.delta_manager.attach_catchup_fetch(self._reconnect_catchup)
         self.delta_manager.on("disconnect", self._on_disconnect)
         self.delta_manager.on("nack", self._on_nack)
         self.delta_manager.on("connect", self._on_connect_identity)
@@ -420,6 +566,7 @@ class Container(TypedEventEmitter):
             if hi_seq[1] > self.runtime.minimum_sequence_number:
                 self.runtime.minimum_sequence_number = hi_seq[1]
 
+        dm = self.delta_manager
         for msg in tail:
             key = self._bulk_key(msg)
             if key is not None:
@@ -428,6 +575,18 @@ class Container(TypedEventEmitter):
                 continue
             if msg.type != MessageType.NO_OP and buffers:
                 flush()
+            # Keep the delta manager's position current at every scalar
+            # boundary: a resubmission triggered INSIDE this message
+            # (self-join -> _resubmit_all) stamps refSeq from
+            # last_sequence_number, and the bulk path otherwise only
+            # advances it after the WHOLE tail — a pre-gap refSeq below
+            # the server's MSN gets nacked, and the nack's reconnect
+            # re-enters this very path: an unbounded synchronous
+            # recursion (surfaced by the read-tier reconnect tests).
+            if msg.sequence_number > dm.last_sequence_number:
+                dm.last_sequence_number = msg.sequence_number
+            if msg.minimum_sequence_number > dm.minimum_sequence_number:
+                dm.minimum_sequence_number = msg.minimum_sequence_number
             self._process(msg)
         flush()
 

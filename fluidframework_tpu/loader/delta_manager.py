@@ -50,6 +50,12 @@ class DeltaManager(TypedEventEmitter):
         self._bulk_handler: Optional[
             Callable[[List[SequencedDocumentMessage]], None]] = None
         self.bulk_catchup_threshold = 64
+        # Optional artifact catch-up hook (docs/read_path.md): called at
+        # the top of every catch-up with our position; when it adopts a
+        # server catch-up artifact it advances last_sequence_number
+        # itself (under self.lock) and returns the adopted seq — the
+        # fetch loop below then covers only the residue past it.
+        self._catchup_fetch: Optional[Callable[[int], Optional[int]]] = None
         self._inbound: List[SequencedDocumentMessage] = []
         self._processing = False
         # Inside an open inbound batch ({"batch": true} seen, closing
@@ -87,6 +93,10 @@ class DeltaManager(TypedEventEmitter):
     def attach_bulk_handler(self, bulk_handler: Callable[
             [List[SequencedDocumentMessage]], None]) -> None:
         self._bulk_handler = bulk_handler
+
+    def attach_catchup_fetch(self, fn: Callable[[int], Optional[int]]
+                             ) -> None:
+        self._catchup_fetch = fn
 
     def connect(self) -> str:
         self.connection = self.service.connect_to_delta_stream(
@@ -321,6 +331,7 @@ class DeltaManager(TypedEventEmitter):
 
     def _catch_up(self) -> None:
         tail: List[SequencedDocumentMessage] = []
+        tried_artifact = False
         while True:
             from_seq = (tail[-1].sequence_number if tail
                         else self.last_sequence_number)
@@ -328,6 +339,20 @@ class DeltaManager(TypedEventEmitter):
             if not fetched:
                 break
             tail.extend(fetched)
+            if self._catchup_fetch is not None and not tried_artifact \
+                    and len(tail) >= self.bulk_catchup_threshold:
+                # The read-tier fast path (docs/read_path.md), engaged
+                # only once the tail is provably long — short gaps never
+                # pay an artifact round trip. The hook owns its locking
+                # and preconditions; on adoption it advances
+                # last_sequence_number itself and returns the adopted
+                # seq, and everything the artifact covers drops from the
+                # fetched tail (the residue keeps replaying below).
+                tried_artifact = True
+                adopted = self._catchup_fetch(self.last_sequence_number)
+                if adopted:
+                    tail = [m for m in tail
+                            if m.sequence_number > adopted]
         if not tail:
             return
         if (self._bulk_handler is not None

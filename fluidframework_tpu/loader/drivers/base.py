@@ -28,6 +28,20 @@ class IDocumentStorageService:
     def get_versions(self, count: int = 1) -> List[str]:
         raise NotImplementedError
 
+    def get_catchup(self):
+        """`summary + delta` in one round trip (docs/read_path.md):
+        returns (summary_tree, catchup_artifact_or_None). The artifact is
+        the serving tier's per-doc incremental catch-up state
+        (server/readpath.py); drivers without a read tier return the
+        summary with None and the loader tail-replays — the always-
+        correct fallback this default encodes."""
+        return self.get_summary(), None
+
+    def get_catchup_artifact(self):
+        """Artifact-only fetch (the reconnect path: the client already
+        holds a summary-derived state and only wants the delta)."""
+        return None
+
 
 class IDocumentDeltaStorageService:
     def get(self, from_seq: int, to_seq: Optional[int] = None

@@ -63,6 +63,26 @@ class LocalDocumentStorageService(IDocumentStorageService):
     def get_versions(self, count: int = 1) -> List[str]:
         return [c.sha for c in self.store.list_commits(limit=count)]
 
+    def get_catchup(self):
+        """summary + delta in one call (in-process, so the `one round
+        trip` is literal: both halves resolve against the same server
+        under one lock round)."""
+        artifact = self.server.get_catchup(self.document_id)
+        summary = None
+        if artifact is not None and artifact.get("summarySha"):
+            # Load the EXACT summary the artifact was published against:
+            # a client summary committed after the refresh would
+            # otherwise race ahead of the delta's baseline.
+            summary = self.server.historian.read_summary(
+                self.server.tenant_id, self.document_id,
+                commit_sha=artifact["summarySha"], lazy=True)
+        if summary is None:
+            summary = self.get_summary()
+        return summary, artifact
+
+    def get_catchup_artifact(self):
+        return self.server.get_catchup(self.document_id)
+
 
 class LocalDeltaStorageService(IDocumentDeltaStorageService):
     def __init__(self, server: LocalServer, document_id: str):

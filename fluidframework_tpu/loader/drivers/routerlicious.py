@@ -177,6 +177,34 @@ class NetworkDocumentStorageService(IDocumentStorageService):
         return self._call(lambda rest: rest.get(
             self._repo + f"/versions?count={count}"))["versions"]
 
+    def get_catchup(self):
+        """`summary + delta` in ONE historian round trip (the read tier's
+        `/catchup` route, docs/read_path.md). A tier without the route
+        (404), a dead tier, or a tier with no artifact degrades to the
+        plain summary read — the loader then tail-replays."""
+        try:
+            data = self._call(lambda rest: rest.get(
+                self._repo + "/catchup"))
+        except RestError as exc:
+            if exc.status in (404, 501):
+                return self.get_summary(), None
+            raise
+        except OSError:
+            return self.get_summary(), None
+        summary = data.get("summary")
+        artifact = data.get("catchup")
+        if summary is None:
+            return self.get_summary(), artifact
+        return summary_tree_from_dict(summary), artifact
+
+    def get_catchup_artifact(self):
+        try:
+            data = self._call(lambda rest: rest.get(
+                self._repo + "/catchup?artifactOnly=1"))
+        except (RestError, OSError):
+            return None
+        return data.get("catchup")
+
 
 class NetworkDeltaStorageService(IDocumentDeltaStorageService):
     """Catch-up reads over the alfred delta REST route."""

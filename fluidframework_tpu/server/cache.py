@@ -92,18 +92,64 @@ class LruTtlCache:
         ttl = self.ttl_s if ttl_s == -1.0 else ttl_s
         expires_at = (time.monotonic() + ttl) if ttl is not None else None
         with self._lock:
-            old = self._entries.pop(key, None)
+            self._put_locked(key, value, nbytes, expires_at)
+
+    def _put_locked(self, key: Hashable, value: Any, nbytes: int,
+                    expires_at: Optional[float]) -> None:
+        """Entry write + ceiling enforcement; caller holds self._lock
+        (shared by put and put_if_newer so the eviction policy cannot
+        fork between them)."""
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self.bytes -= old[1]
+        self._entries[key] = (value, nbytes, expires_at)
+        self.bytes += nbytes
+        self.puts += 1
+        while (len(self._entries) > self.max_entries
+               or (self.bytes > self.max_bytes
+                   and len(self._entries) > 1)):
+            _, (_, cold_bytes, _) = self._entries.popitem(last=False)
+            self.bytes -= cold_bytes
+            self.evictions += 1
+
+    def put_if_newer(self, key: Hashable, value: Any, version: int,
+                     nbytes: int = 0,
+                     ttl_s: Optional[float] = -1.0) -> bool:
+        """Conditional put keyed on a monotone per-entry version (the
+        catch-up delta-blob profile, server/readpath.py): a publish that
+        lost a race to a FRESHER artifact must never regress the cache,
+        because a reader adopting the older blob would replay a longer
+        residue tail than the one already served. The version rides the
+        entry as (version, value); `get` callers receive the tuple and
+        unwrap. Returns True when the entry was written."""
+        ttl = self.ttl_s if ttl_s == -1.0 else ttl_s
+        expires_at = (time.monotonic() + ttl) if ttl is not None else None
+        with self._lock:
+            old = self._entries.get(key)
             if old is not None:
-                self.bytes -= old[1]
-            self._entries[key] = (value, nbytes, expires_at)
-            self.bytes += nbytes
-            self.puts += 1
-            while (len(self._entries) > self.max_entries
-                   or (self.bytes > self.max_bytes
-                       and len(self._entries) > 1)):
-                _, (_, cold_bytes, _) = self._entries.popitem(last=False)
-                self.bytes -= cold_bytes
-                self.evictions += 1
+                held = old[0]
+                if isinstance(held, tuple) and len(held) == 2 \
+                        and held[0] > version:
+                    return False
+            self._put_locked(key, (version, value), nbytes, expires_at)
+        return True
+
+    def peek_version(self, key: Hashable) -> Optional[int]:
+        """Non-counting version probe for put_if_newer entries (no LRU
+        touch, no hit/miss accounting): freshness gates — e.g. the
+        catch-up refresh-on-read decision — must not skew the hit-rate
+        stats operators alert on."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                return None
+            held, _nbytes, expires_at = entry
+            if expires_at is not None and now >= expires_at:
+                return None
+            if isinstance(held, tuple) and len(held) == 2:
+                return int(held[0])
+            return None
 
     def invalidate(self, key: Hashable) -> bool:
         with self._lock:

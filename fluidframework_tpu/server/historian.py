@@ -50,6 +50,7 @@ from ..telemetry import tracing
 from ..telemetry.counters import increment, record_swallow
 from ..telemetry.logger import PerformanceEvent, TelemetryLogger
 from .cache import LruTtlCache
+from .readpath import CatchupCache
 from .storage import GitBlob, GitCommit, GitTree, Historian
 
 # Marks tier-originated upstream requests; alfred serves them directly
@@ -119,6 +120,23 @@ def notify_summary_commit(historian_url: str, tenant_id: str,
         _request("POST", historian_url.rstrip("/")
                  + f"/historian/invalidate/{_q(tenant_id)}/{_q(document_id)}",
                  body={"sha": sha, "ref": ref}, timeout=timeout)
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+def notify_catchup_refresh(historian_url: str, tenant_id: str,
+                           document_id: str, artifact: dict,
+                           token: Optional[str] = None,
+                           timeout: float = 5.0) -> bool:
+    """Best-effort catch-up artifact push to a historian process (the
+    refresh-epoch analog of notify_summary_commit). A dead historian is
+    fine — its stale artifact still adopts correctly (residue replay),
+    and the next successful push replaces it."""
+    try:
+        url = (historian_url.rstrip("/")
+               + f"/historian/catchup/{_q(tenant_id)}/{_q(document_id)}")
+        _request("POST", url, token=token, body=artifact, timeout=timeout)
         return True
     except (OSError, ValueError):
         return False
@@ -223,6 +241,12 @@ class HistorianTier:
         # never rejects, making this a no-op in the trusted-network
         # deployment shape.
         self.auth = LruTtlCache(max_entries=4096, ttl_s=auth_ttl_s)
+        # Read-path catch-up delta blobs (server/readpath.py): published
+        # by the serving tier on refresh epochs (write-through — a
+        # publish IS the invalidation: put_if_newer replaces the stale
+        # artifact atomically), served beside the summary in one round
+        # trip by the `/catchup` route.
+        self.catchup = CatchupCache()
         self.logger = logger
         self.metrics = metrics
         self.upstream_fetches = 0
@@ -341,6 +365,35 @@ class HistorianTier:
             sha = parents[0] if parents else None
         return out
 
+    # -- read-path catch-up (docs/read_path.md) ----------------------------
+    def publish_catchup(self, tenant_id: str, document_id: str,
+                        artifact: dict) -> bool:
+        """Write-through artifact publish from the serving tier (the
+        refresh-epoch push, same hook shape as summary-commit
+        invalidation). put_if_newer semantics: a racing older publish
+        never regresses the served artifact."""
+        return self.catchup.publish(tenant_id, document_id, artifact)
+
+    def read_catchup(self, tenant_id: str, document_id: str,
+                     token: Optional[str] = None,
+                     artifact_only: bool = False) -> dict:
+        """`summary + delta` in one request: the artifact (when present)
+        plus the summary tree of exactly the commit the artifact was
+        published against — both halves cache-served, so a warm
+        connecting client costs this tier zero upstream traffic."""
+        # The artifact IS full document content: cache-served requests
+        # must prove their token exactly like the object routes do (the
+        # artifact-only path would otherwise never touch upstream).
+        self.ensure_authorized(tenant_id, document_id, token)
+        artifact = self.catchup.get(tenant_id, document_id)
+        out: Dict[str, Any] = {"catchup": artifact}
+        if artifact_only:
+            return out
+        sha = (artifact or {}).get("summarySha")
+        out["summary"] = self.read_summary_dict(
+            tenant_id, document_id, commit_sha=sha, token=token)
+        return out
+
     # -- writes + invalidation ---------------------------------------------
     def upload_summary(self, tenant_id: str, document_id: str, body: dict,
                        token: Optional[str] = None) -> str:
@@ -438,6 +491,7 @@ class HistorianTier:
             "objects": self.objects.stats(),
             "refs": self.refs.stats(),
             "auth": self.auth.stats(),
+            "catchup": self.catchup.stats(),
             "upstreamFetches": self.upstream_fetches,
             "prefetchedObjects": self.prefetched_objects,
             "prefetchSharedTrees": self.prefetch_shared_trees,
@@ -458,6 +512,12 @@ class HistorianService:
         ("POST", re.compile(
             r"^/historian/invalidate/(?P<tenant>[^/]+)/(?P<doc>[^/]+)$"),
          "_r_invalidate"),
+        ("POST", re.compile(
+            r"^/historian/catchup/(?P<tenant>[^/]+)/(?P<doc>[^/]+)$"),
+         "_r_publish_catchup"),
+        ("GET", re.compile(
+            r"^/repos/(?P<tenant>[^/]+)/(?P<doc>[^/]+)/catchup$"),
+         "_r_catchup"),
         ("GET", re.compile(
             r"^/repos/(?P<tenant>[^/]+)/(?P<doc>[^/]+)/summaries/latest$"),
          "_r_latest_summary"),
@@ -620,6 +680,38 @@ class HistorianService:
             record_swallow("historian.invalidate_prefetch_guard")
             return
         self.tier._prefetch(tenant, doc, sha, token)
+
+    def _r_publish_catchup(self, handler, params, tenant: str,
+                           doc: str) -> None:
+        """Serving-tier artifact push (write-through). The body is
+        arbitrary caller-supplied document state, so a READ token must
+        not suffice (any subscriber holds one — a crafted high-seq
+        artifact would poison every later connecting client): the
+        publish requires the tier marker header, the same
+        inside-the-service-boundary trust line the reference draws for
+        internal gitrest traffic, ON TOP of upstream authorization."""
+        body = _read_json(handler)
+        token = self._token(handler)
+        if not isinstance(body, dict) or "seq" not in body:
+            _send_json(handler, 400, {"error": "not a catch-up artifact"})
+            return
+        if not handler.headers.get(TIER_HEADER):
+            _send_json(handler, 403,
+                       {"error": "catch-up publishes are serving-tier "
+                                 "internal (missing tier marker)"})
+            return
+        self.tier.ensure_authorized(tenant, doc, token)
+        wrote = self.tier.publish_catchup(tenant, doc, body)
+        _send_json(handler, 200, {"ok": True, "published": wrote})
+
+    def _r_catchup(self, handler, params, tenant: str, doc: str) -> None:
+        out = self.tier.read_catchup(
+            tenant, doc, token=self._token(handler),
+            artifact_only=bool(params.get("artifactOnly")))
+        if out.get("summary") is None and out.get("catchup") is None:
+            _send_json(handler, 404, {"error": "no summary"})
+            return
+        _send_json(handler, 200, out)
 
     def _r_latest_summary(self, handler, params, tenant: str,
                           doc: str) -> None:

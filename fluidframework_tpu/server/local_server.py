@@ -39,6 +39,7 @@ from ..protocol.messages import (
     SignalMessage,
     op_size,
 )
+from ..telemetry.counters import increment
 from . import admission as admission_mod
 from .admission import AdmissionController, admission_from_config
 from .database import DatabaseManager
@@ -278,6 +279,28 @@ class LocalServer:
         # Broadcaster room membership lives here (not in the lambda) so it
         # survives lambda crash-restarts; the lambda reads it by reference.
         self._rooms: Dict[str, List] = {}
+        # Sharded broadcast fan-out (docs/read_path.md): 0 = inline
+        # (deterministic, the pump delivers synchronously — the default
+        # every in-process test relies on); N > 0 = doc-hash-sharded
+        # worker threads with bounded per-shard queues, so a reconnect
+        # avalanche or one hot document cannot serialize every
+        # subscriber through a single pump thread.
+        self.broadcaster_shards = 0
+        self.broadcaster_queue_limit = 1024
+        if config is not None:
+            self.broadcaster_shards = int(config.get(
+                "broadcaster.shards", 0))
+            self.broadcaster_queue_limit = int(config.get(
+                "broadcaster.queueLimit", self.broadcaster_queue_limit))
+        self.broadcasters: List[BroadcasterLambda] = []
+        # Read-path catch-up artifacts (server/readpath.py): populated by
+        # TpuLocalServer (artifacts are materialized from device lanes);
+        # the scalar pipeline serves None and clients tail-replay.
+        self.catchup = None
+        # Fired on every artifact publish: (tenant_id, document_id,
+        # artifact) — an external historian tier hooks in here the same
+        # way summary_commit_listeners feeds cache invalidation.
+        self.catchup_listeners: List[Callable[[str, str, dict], None]] = []
         # Signal fan-out rooms: transient messages never enter the log, so
         # they get their own listener lists (reference: socket.io room emit
         # straight from alfred, no Kafka hop).
@@ -317,7 +340,7 @@ class LocalServer:
             offload=True))
         self._broadcaster_mgr = self.runner.add(PartitionManager(
             self.log, "broadcaster", DELTAS_TOPIC,
-            lambda ctx: BroadcasterLambda(ctx, rooms=self._rooms)))
+            self._build_broadcaster))
 
         # Overload admission (server/admission.py): the occupancy-driven
         # front door every Connection.submit/submit_signal passes. A
@@ -329,6 +352,33 @@ class LocalServer:
             self._wire_admission()
 
     # -- internal wiring ---------------------------------------------------
+    def _build_broadcaster(self, ctx) -> BroadcasterLambda:
+        # A crash-restart (PartitionPump.restart closes the old lambda,
+        # then re-invokes this factory) must not leave the superseded
+        # instance in the registry: the occupancy feed, drain_broadcast,
+        # and the monitor probe would sum dead shards forever.
+        self.broadcasters = [b for b in self.broadcasters if not b.closed]
+        lam = BroadcasterLambda(ctx, rooms=self._rooms,
+                                shards=self.broadcaster_shards,
+                                queue_limit=self.broadcaster_queue_limit)
+        self.broadcasters.append(lam)
+        return lam
+
+    def broadcast_queue_depth(self) -> int:
+        """Total fan-out backlog across every broadcaster shard (0 in
+        inline mode) — the read tier's occupancy feed for admission."""
+        return sum(lam.queue_depth() for lam in self.broadcasters)
+
+    def drain_broadcast(self, timeout: float = 10.0) -> bool:
+        """Block until every sharded fan-out queue is empty (inline mode
+        returns immediately). Tests and benches that need delivered-after-
+        pump semantics under sharding call this where they used to rely
+        on the pump's synchronous fan-out."""
+        ok = True
+        for lam in self.broadcasters:
+            ok = lam.drain(timeout) and ok
+        return ok
+
     def raw_backlog(self) -> int:
         """Raw-topic ingest backlog: messages appended but not yet
         consumed by the sequencing stage (per partition: end offset minus
@@ -346,6 +396,13 @@ class LocalServer:
         adm = self.admission
         adm.add_source(f"core:{self.tenant_id}",
                        queue_depth=self.raw_backlog)
+        if self.broadcaster_shards:
+            # The read tier's occupancy feed: a fan-out backlog (reconnect
+            # avalanche, hot-document room) pressures the same admission
+            # ladder the write side does, so ingest throttles before the
+            # shard queues have to shed.
+            adm.add_source(f"broadcast:{self.tenant_id}",
+                           queue_depth=self.broadcast_queue_depth)
         # DEGRADE survival mode: pause the archival pumps (copier raw
         # persistence, scribe summaries) so every cycle goes to draining
         # the sequencer. Their consumer offsets hold their place in the
@@ -485,6 +542,12 @@ class LocalServer:
     def storage(self, document_id: str):
         return self.historian.store(self.tenant_id, document_id)
 
+    def get_catchup(self, document_id: str) -> Optional[dict]:
+        """Read-path catch-up artifact for a document, or None (the
+        scalar pipeline materializes no device lanes — clients take the
+        tail-replay fallback; TpuLocalServer overrides)."""
+        return None
+
     def pump(self) -> int:
         """Drive every lambda stage to quiescence (synchronous pipeline)."""
         if self.overlapped:
@@ -534,6 +597,14 @@ class TpuLocalServer(LocalServer):
         self.mesh = mesh
         self.paged_lanes = paged_lanes
         super().__init__(*args, **kwargs)
+        # Read-path catch-up artifacts (server/readpath.py): ON by
+        # default — the cache is empty until a refresh or a read-miss
+        # triggers one, so pure write workloads never pay for it.
+        from .readpath import CatchupCache
+        enabled = True
+        if self.config is not None:
+            enabled = bool(self.config.get("catchup.enabled", True))
+        self.catchup = CatchupCache() if enabled else None
 
     def _build_sequencer(self) -> PartitionManager:
         from .tpu_sequencer import TpuSequencerLambda
@@ -576,6 +647,70 @@ class TpuLocalServer(LocalServer):
 
     def sequence_number(self, document_id: str) -> int:
         return self.sequencer().document_seq(document_id)
+
+    # -- read-path catch-up artifacts (server/readpath.py) -----------------
+    def refresh_catchup(self, only_docs: Optional[set] = None) -> dict:
+        """One read-tier refresh epoch: join the sequencer's batched
+        channel extraction (ONE device dispatch per bucket for every
+        dirty document together) with the scribe's protocol checkpoints
+        and publish per-doc artifacts. A document whose scribe replica
+        has not caught up to the sequencer (DEGRADE pauses scribe) skips
+        this epoch — its previous artifact stays served (stale-but-
+        correct: adoption + residue replay) and the publish retries next
+        refresh. Serialized against the pump (artifact consistency needs
+        the lanes at a flush boundary)."""
+        from .readpath import build_artifact
+
+        if self.catchup is None:
+            return {"published": 0, "skipped": 0, "refreshed": 0}
+        with self._pump_lock:
+            seq_lambda = self.sequencer()
+            bodies = seq_lambda.catchup_snapshot(only_docs)
+            if not bodies:
+                return {"published": 0, "skipped": 0, "refreshed": 0}
+            # One scan of the checkpoint collection for the whole epoch
+            # (a per-doc find_one would make the epoch O(dirty x docs)).
+            by_doc = {row["documentId"]: row
+                      for row in self.scribe_checkpoints.find(
+                          lambda d: d.get("documentId") in bodies)}
+            published = skipped = 0
+            for doc_id, body in bodies.items():
+                row = by_doc.get(doc_id)
+                if row is None \
+                        or int(row["sequenceNumber"]) != body["seq"]:
+                    skipped += 1
+                    increment("catchup.publish_skipped")
+                    continue
+                sha = self.historian.store(
+                    self.tenant_id, doc_id).get_ref("main")
+                artifact = build_artifact(
+                    body, row["minimumSequenceNumber"], row["quorum"], sha)
+                if self.catchup.publish(self.tenant_id, doc_id, artifact):
+                    published += 1
+                    seq_lambda.catchup_mark_published(doc_id, body["gen"])
+                    for listener in list(self.catchup_listeners):
+                        try:
+                            listener(self.tenant_id, doc_id, artifact)
+                        except Exception:  # noqa: BLE001 — observers never break the refresh
+                            record_swallow("server.catchup_listener")
+            return {"published": published, "skipped": skipped,
+                    "refreshed": len(bodies)}
+
+    def get_catchup(self, document_id: str) -> Optional[dict]:
+        """The serving side of `summary + delta in one round trip`: the
+        freshest catch-up artifact for a document, refreshing it first
+        when it is absent or trails the head (cost: one single-doc
+        refresh per document per epoch, amortized over every client that
+        connects before the next flush dirties it)."""
+        if self.catchup is None:
+            return None
+        with self._pump_lock:
+            head = self.sequencer().document_seq(document_id)
+            art_seq = self.catchup.peek_seq(self.tenant_id, document_id)
+            if art_seq is None or art_seq < head:
+                self.refresh_catchup(only_docs={document_id})
+            return self.catchup.get(self.tenant_id, document_id,
+                                    head_seq=head)
 
     def write_materialized_snapshots(self, ref: str = "materialized",
                                      incremental: bool = True

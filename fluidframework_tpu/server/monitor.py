@@ -222,6 +222,46 @@ class ServiceMonitor:
 
         self.add_probe(name, probe)
 
+    def watch_readpath(self, name: str, server) -> None:
+        """Probe over the read tier (docs/read_path.md): the catch-up
+        artifact cache (hit/miss/stale rates, artifact count/bytes) and
+        the sharded broadcaster fan-out (per-shard queue depths — also
+        refreshed into the broadcaster.queue_depth.shard<i> gauges every
+        probe, so /metrics.prom carries them — shed and delivered
+        counts). Works against a LocalServer (catchup + broadcasters
+        attributes) or anything duck-shaped like one; either half may be
+        absent (scalar pipeline, inline fan-out)."""
+
+        def probe() -> dict:
+            out: dict = {}
+            cache = getattr(server, "catchup", None)
+            if cache is not None:
+                out["catchup"] = cache.stats()
+            shards = []
+            shed = delivered = 0
+            for lam in getattr(server, "broadcasters", []):
+                st = lam.stats()
+                shards.extend(st["queueDepths"])
+                shed += st["shed"]
+                delivered += st["delivered"]
+            out["broadcaster"] = {
+                "shards": len(shards),
+                "queueDepths": shards,
+                "queueDepth": sum(shards),
+                "shed": shed,
+                "delivered": delivered,
+            }
+            snap = process_counters.snapshot()
+            out["deltaHits"] = snap.get("catchup.delta_hit", 0.0)
+            out["deltaMisses"] = snap.get("catchup.delta_miss", 0.0)
+            out["deltaStale"] = snap.get("catchup.delta_stale", 0.0)
+            out["refreshDispatches"] = snap.get(
+                "catchup.refresh_dispatches", 0.0)
+            out["clientAdoptions"] = snap.get("catchup.client.adopted", 0.0)
+            return out
+
+        self.add_probe(name, probe)
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ServiceMonitor":
         self._thread = threading.Thread(target=self._httpd.serve_forever,

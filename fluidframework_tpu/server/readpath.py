@@ -1,0 +1,147 @@
+"""Read-tier serving state: the per-document catch-up artifact cache.
+
+The write path got seven PRs of batching; this module is the read tier's
+half of the first one (docs/read_path.md). A connecting client's catch-up
+used to be summary + an op-tail replay — O(tail) work PER CLIENT. The
+serving tier now maintains one constant-size artifact per document:
+
+    { seq, msn, quorum snapshot, summary ref,
+      clients: [wire ids], channels: [(store, channel, header, blob)] }
+
+where each channel blob is the narrow int16 packed entry wire
+(mergetree/catchup.py pack_entries_narrow) of that channel's full-fidelity
+snapshot entries at `seq`. The artifact is refreshed from the per-lane
+change generations at flush boundaries — ONE batched device dispatch per
+refresh epoch covering every dirty document (TpuSequencerLambda
+.catchup_snapshot) — so server cost scales with dirty docs, never with
+connecting clients. Clients fetch summary + artifact in one round trip
+(storage.get_catchup / the historian `/catchup` route), adopt, and replay
+only the residue past `seq`.
+
+Staleness contract (the adopter's side is loader/container.py):
+  - an ABSENT artifact is a miss: the client falls back to tail replay.
+  - a STALE artifact (seq behind the head) is still served and counted:
+    adoption at `seq` plus residue replay is exactly as correct as a
+    fresh artifact, just with a longer residue.
+  - an artifact older than the summary the client loaded is useless and
+    the CLIENT ignores it (the summary already covers more history).
+Publishes ride LruTtlCache.put_if_newer keyed on `seq`, so a racing
+refresh can never regress a fresher artifact.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..mergetree.catchup import (  # noqa: F401 — re-exported: this module
+    pack_entries_narrow,           # OWNS the artifact format, so loader-
+    translate_entry_clients,       # side adopters import the codec from
+    unpack_entries_narrow,         # here (layering: loader may import
+)                                  # server, not mergetree)
+from ..telemetry.counters import increment
+from .cache import LruTtlCache
+
+
+def artifact_nbytes(artifact: dict) -> int:
+    """Cache-accounting size: the dominant term is the packed channel
+    text + columns; JSON length over the whole artifact is close enough
+    and computed once per publish."""
+    try:
+        return len(json.dumps(artifact))
+    except (TypeError, ValueError):
+        return 4096
+
+
+class CatchupCache:
+    """Bounded store of per-(tenant, document) catch-up artifacts.
+
+    Counters (process-wide, /metrics.prom):
+      catchup.delta_hit    reads served an artifact
+      catchup.delta_miss   reads with no artifact (client tail-replays)
+      catchup.delta_stale  hits whose artifact trails the current head
+      catchup.published    artifacts (re)published
+    """
+
+    def __init__(self, max_entries: int = 65536,
+                 max_bytes: int = 256 * 1024 * 1024,
+                 ttl_s: Optional[float] = None):
+        self.blobs = LruTtlCache(max_entries=max_entries,
+                                 max_bytes=max_bytes, ttl_s=ttl_s)
+        self.hits = 0
+        self.misses = 0
+        self.stale_hits = 0
+        self.published = 0
+
+    def publish(self, tenant_id: str, document_id: str,
+                artifact: dict) -> bool:
+        """Write-through publish; loses quietly to a fresher artifact."""
+        wrote = self.blobs.put_if_newer(
+            (tenant_id, document_id), artifact,
+            version=int(artifact["seq"]),
+            nbytes=artifact_nbytes(artifact))
+        if wrote:
+            self.published += 1
+            increment("catchup.published")
+        return wrote
+
+    def get(self, tenant_id: str, document_id: str,
+            head_seq: Optional[int] = None) -> Optional[dict]:
+        """The read path: returns the artifact or None (miss). head_seq,
+        when the caller knows it, classifies the hit as fresh/stale."""
+        held = self.blobs.get((tenant_id, document_id))
+        if held is None:
+            self.misses += 1
+            increment("catchup.delta_miss")
+            return None
+        _version, artifact = held
+        self.hits += 1
+        increment("catchup.delta_hit")
+        if head_seq is not None and int(artifact["seq"]) < head_seq:
+            self.stale_hits += 1
+            increment("catchup.delta_stale")
+        return artifact
+
+    def peek_seq(self, tenant_id: str, document_id: str) -> Optional[int]:
+        """Freshness probe without hit/miss accounting (the refresh-on-
+        read gate must not skew the rates operators alert on)."""
+        return self.blobs.peek_version((tenant_id, document_id))
+
+    def invalidate(self, tenant_id: str, document_id: str) -> bool:
+        return self.blobs.invalidate((tenant_id, document_id))
+
+    def stats(self) -> dict:
+        total = self.hits + self.misses
+        return {
+            "artifacts": len(self.blobs),
+            "bytes": self.blobs.bytes,
+            "hits": self.hits,
+            "misses": self.misses,
+            "staleHits": self.stale_hits,
+            "hitRate": (self.hits / total) if total else 0.0,
+            "published": self.published,
+        }
+
+
+def quorum_ordinals(quorum_snapshot: dict) -> Dict[str, int]:
+    """wire client id -> quorum ordinal (its join sequence number) — the
+    ordinal space a CLIENT's runtime uses for merge perspectives, derived
+    from the same snapshot the artifact carries so the adopter and the
+    protocol state can never disagree."""
+    return {cid: int(m["sequenceNumber"])
+            for cid, m in quorum_snapshot.get("members", [])}
+
+
+def build_artifact(doc_body: dict, msn: int, quorum_snapshot: dict,
+                   summary_sha: Optional[str]) -> dict:
+    """Join a sequencer-side doc body (catchup_snapshot output: seq,
+    clients, channels) with the protocol half into the published shape."""
+    return {
+        "v": 1,
+        "seq": int(doc_body["seq"]),
+        "msn": int(msn),
+        "quorum": quorum_snapshot,
+        "summarySha": summary_sha,
+        "clients": list(doc_body["clients"]),
+        "channels": doc_body["channels"],
+    }

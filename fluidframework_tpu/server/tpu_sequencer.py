@@ -386,6 +386,15 @@ class MergeLaneStore:
         # arena-block aging bound, not by ingest history.
         self._snap_cache: Dict[tuple, tuple] = {}
         self.last_summarized_gen: Dict[tuple, int] = {}
+        # Read-path catch-up safety (server/readpath.py): lanes seeded
+        # from a summary whose entries still carried CONTENDED client
+        # metadata mix two ordinal spaces on device (the summary's
+        # quorum-join ordinals vs this store's interned ones), so the
+        # catch-up artifact publisher cannot translate their client
+        # fields back to wire ids unambiguously. Such lanes exclude
+        # their document from the delta path (clients tail-replay, the
+        # always-correct fallback).
+        self.catchup_unsafe: set = set()
 
     # -- lane admission ----------------------------------------------------
     def lane_for(self, key: tuple) -> Tuple[int, int]:
@@ -453,6 +462,7 @@ class MergeLaneStore:
         for block in self._lane_blocks.pop(key, ()):
             self._release_block_ref(block, key)
         self._fold_skip.pop(key, None)
+        self.catchup_unsafe.discard(key)
         with self._guard_lock:
             self._snap_cache.pop(key, None)
             self.last_summarized_gen.pop(key, None)
@@ -686,11 +696,24 @@ class MergeLaneStore:
         bucket.put_row(lane, row, count_hint=len(cols["length"]))
         self.where[key] = (b, lane)
         self.mark_dirty(key)
+        self._mark_catchup_safety(key, entries)
         # Track the seed generation like a fold's: the first fold (or a
         # drop) frees it instead of stranding the attach-time document
         # text in the shared table forever.
         self._swap_fold_payloads(key, self._seed_ids(cols))
         return True
+
+    def _mark_catchup_safety(self, key: tuple, entries) -> None:
+        """Seed-time gate for the read-path artifact publisher: summary
+        entries still carrying contended client metadata seed quorum-join
+        ordinals into a lane whose ops intern 0,1,2,… — the two spaces
+        alias, so client-field translation back to wire ids is ambiguous
+        for this lane (class docstring at catchup_unsafe)."""
+        if any(e.get("client") is not None
+               or e.get("removedClient") is not None
+               or e.get("removedOverlapClients")
+               for e in entries):
+            self.catchup_unsafe.add(key)
 
     def _seed_paged(self, key: tuple, entries, min_seq: int,
                     current_seq: int) -> bool:
@@ -719,6 +742,7 @@ class MergeLaneStore:
         self.lane_for(key)
         pg.put_row(key, row, count=n)
         self.mark_dirty(key)
+        self._mark_catchup_safety(key, entries)
         self._swap_fold_payloads(key, self._seed_ids(cols))
         return True
 
@@ -2710,6 +2734,12 @@ class TpuSequencerLambda(IPartitionLambda):
         # doc_id -> parsed summary probe result (None = no usable summary);
         # probed at most once per document per process.
         self._summary_probes: Dict[str, Optional["_SummaryProbe"]] = {}
+        # Read-path catch-up watermarks (server/readpath.py): the max
+        # change generation each document's PUBLISHED artifact covers.
+        # Advanced only on confirmed publish (catchup_mark_published) —
+        # a refresh whose protocol half was unavailable must retry, not
+        # silently freeze the artifact at a stale epoch.
+        self._catchup_gen: Dict[str, int] = {}
         # fresh_log=True: this lambda consumes a brand-new MessageLog (a
         # multi-node takeover hands over checkpointed state, not the log);
         # checkpointed offsets index the PREVIOUS core's log and must not
@@ -5540,6 +5570,107 @@ class TpuSequencerLambda(IPartitionLambda):
             return None
         return _nest_directory(snap["entries"] if snap else {},
                                self._dir_paths.get(key, {"/"}))
+
+    # -- read-path catch-up artifacts (server/readpath.py) -----------------
+    def catchup_docs_supported(self) -> Tuple[Dict[str, List[tuple]], set]:
+        """Partition the resident documents for the delta publisher:
+        (doc -> its merge lane keys, unsupported doc ids). A document
+        rides the delta path only when EVERY channel of it is a plain
+        merge-tree sequence lane the publisher can translate — any LWW/
+        matrix/directory lane, any opaque (unmodelable-op) channel, or
+        any catchup_unsafe seed excludes the whole document: a partial
+        artifact would desync the client's per-doc seq bookkeeping, so
+        those documents keep the tail-replay fallback."""
+        by_doc: Dict[str, List[tuple]] = {}
+        unsupported: set = set()
+        for key in list(self.merge.where):
+            by_doc.setdefault(key[0], []).append(key)
+            chan = key[2]
+            if (isinstance(chan, str) and "\x00" in chan) \
+                    or key in self.merge.catchup_unsafe:
+                unsupported.add(key[0])
+        for key in list(self.lww.where):
+            unsupported.add(key[0])
+        for key in list(self.merge.opaque) + list(self.lww.opaque):
+            unsupported.add(key[0])
+        return by_doc, unsupported
+
+    def catchup_snapshot(self, only_docs: Optional[set] = None,
+                         chunk_chars: int = 10000) -> Dict[str, dict]:
+        """One read-tier refresh epoch: extract every supported document
+        whose change generations advanced past its published artifact —
+        ONE batched device dispatch per capacity bucket / page group for
+        ALL of them together (extract_dispatch; clean lanes ride the
+        summarize blob cache) — and return the per-doc artifact bodies
+        {doc_id: {"seq", "gen", "clients", "channels"}}. Channel entries
+        are narrow-wire packed (mergetree.catchup.pack_entries_narrow)
+        with client fields translated from this lambda's interned
+        ordinals to indices into the per-doc wire-client table. Server
+        cost is proportional to DIRTY documents, never to connecting
+        clients; the caller (TpuLocalServer.refresh_catchup / an
+        external publisher) joins in the protocol half and publishes."""
+        from ..mergetree.catchup import (pack_entries_narrow,
+                                         translate_entry_clients)
+
+        self.drain()
+        by_doc, unsupported = self.catchup_docs_supported()
+        refresh: Dict[str, int] = {}  # doc -> gen this epoch covers
+        for doc_id, keys in by_doc.items():
+            if only_docs is not None and doc_id not in only_docs:
+                continue
+            if doc_id in unsupported or doc_id not in self.docs:
+                continue
+            doc_gen = max((self.merge.change_gen.get(k, 0) for k in keys),
+                          default=0)
+            if doc_gen <= self._catchup_gen.get(doc_id, -1):
+                continue  # published artifact already covers this state
+            refresh[doc_id] = doc_gen
+        if not refresh:
+            return {}
+        want = {k for d in refresh for k in by_doc[d]}
+        jobs, cached = self.merge.extract_dispatch(only=want,
+                                                   chunk_chars=chunk_chars)
+        increment("catchup.refresh_dispatches", len(jobs))
+        snaps = self.merge.extract_assemble(jobs, chunk_chars, cached)
+        out: Dict[str, dict] = {}
+        for doc_id, doc_gen in refresh.items():
+            dl = self.docs[doc_id]
+            # Ordinal -> client-table index, via the wire ids this lane
+            # interned; entries fail the translation (KeyError) only for
+            # ordinal spaces the publisher cannot disambiguate, which
+            # excludes the doc this epoch (fallback stays correct).
+            clients = [dl.ordinals[o] for o in sorted(dl.ordinals)]
+            mapping = {o: i for i, o in enumerate(sorted(dl.ordinals))}
+            doc_seq = self.document_seq(doc_id)
+            channels: List[list] = []
+            ok = True
+            for key in by_doc[doc_id]:
+                snap = snaps.get(key)
+                if snap is None:
+                    ok = False
+                    break
+                entries = [e for chunk in snap["chunks"] for e in chunk]
+                try:
+                    entries = translate_entry_clients(entries, mapping)
+                    blob = pack_entries_narrow(entries, base_seq=doc_seq)
+                except (KeyError, ValueError):
+                    ok = False
+                    break
+                channels.append([key[1], key[2], dict(snap["header"]),
+                                 blob])
+            if not ok:
+                increment("catchup.refresh_unsupported")
+                continue
+            out[doc_id] = {"seq": doc_seq, "gen": doc_gen,
+                           "clients": clients, "channels": channels}
+        increment("catchup.refresh_docs", len(out))
+        return out
+
+    def catchup_mark_published(self, doc_id: str, gen: int) -> None:
+        """Advance the publish watermark — called only after the joined
+        artifact actually landed in a CatchupCache."""
+        if gen > self._catchup_gen.get(doc_id, -1):
+            self._catchup_gen[doc_id] = gen
 
     def document_seq(self, doc_id: str) -> int:
         dl = self.docs.get(doc_id)

@@ -1,0 +1,246 @@
+"""Broadcaster fan-out: inline + sharded delivery semantics.
+
+The broadcaster had no dedicated test file through eleven PRs of write-
+side work (it was covered incidentally via LocalServer e2e); the sharded
+read tier (docs/read_path.md) makes its contracts load-bearing:
+per-document delivery order across shards, bounded-queue shedding, and
+subscriber churn while deliveries are in flight.
+"""
+
+import threading
+import time
+
+import pytest
+
+from fluidframework_tpu.protocol.messages import (DocumentMessage,
+                                                  MessageType,
+                                                  SequencedDocumentMessage)
+from fluidframework_tpu.server.lambdas.broadcaster import (BroadcasterLambda,
+                                                           shard_for)
+from fluidframework_tpu.server.local_server import LocalServer
+from fluidframework_tpu.server.log import QueuedMessage
+
+
+class _Ctx:
+    def __init__(self):
+        self.offsets = []
+
+    def checkpoint(self, offset):
+        self.offsets.append(offset)
+
+    def error(self, err, restart=False):
+        raise err
+
+
+def _seq(doc_i: int, n: int) -> SequencedDocumentMessage:
+    return SequencedDocumentMessage(
+        client_id=f"c{doc_i}", sequence_number=n,
+        minimum_sequence_number=0, client_sequence_number=n,
+        reference_sequence_number=0, type=MessageType.OPERATION,
+        contents={"n": n})
+
+
+def _feed(lam, doc_id, messages, offset0=0):
+    for i, m in enumerate(messages):
+        lam.handler(QueuedMessage("deltas", 0, offset0 + i, doc_id,
+                                  (doc_id, m)))
+
+
+class TestShardRouting:
+    def test_routing_is_stable_and_in_range(self):
+        for shards in (1, 2, 7, 16):
+            for d in range(100):
+                s = shard_for(f"doc-{d}", shards)
+                assert 0 <= s < shards
+                assert s == shard_for(f"doc-{d}", shards)
+
+    def test_inline_mode_has_no_threads(self):
+        lam = BroadcasterLambda(_Ctx())
+        assert lam.shards == []
+        assert lam.queue_depth() == 0
+        got = []
+        lam.join_room("d", got.append)
+        _feed(lam, "d", [_seq(0, 1), _seq(0, 2)])
+        # Inline: delivered synchronously, in order.
+        assert [m.sequence_number for m in got] == [1, 2]
+        assert lam.drain(0.1)  # no-op
+
+
+class TestShardedFanOut:
+    def test_per_doc_order_preserved_across_shards(self):
+        lam = BroadcasterLambda(_Ctx(), shards=4, queue_limit=10_000)
+        try:
+            seen = {f"d{i}": [] for i in range(12)}
+            lock = threading.Lock()
+            for d in seen:
+                def listener(m, d=d):
+                    with lock:
+                        seen[d].append(m.sequence_number)
+                lam.join_room(d, listener)
+            offset = 0
+            for n in range(1, 51):
+                for i, d in enumerate(seen):
+                    lam.handler(QueuedMessage("deltas", 0, offset, d,
+                                              (d, _seq(i, n))))
+                    offset += 1
+            assert lam.drain(15.0)
+            for d, seqs in seen.items():
+                assert seqs == list(range(1, 51)), d
+            # Docs actually spread over more than one shard.
+            used = {shard_for(d, 4) for d in seen}
+            assert len(used) > 1
+        finally:
+            lam.close()
+
+    def test_checkpoints_at_enqueue(self):
+        ctx = _Ctx()
+        lam = BroadcasterLambda(ctx, shards=2, queue_limit=64)
+        try:
+            block = threading.Event()
+            lam.join_room("d", lambda m: block.wait(2.0))
+            _feed(lam, "d", [_seq(0, 1), _seq(0, 2), _seq(0, 3)])
+            # Offsets committed without waiting for delivery.
+            assert ctx.offsets == [0, 1, 2]
+            block.set()
+            assert lam.drain(5.0)
+        finally:
+            lam.close()
+
+    def test_bounded_queue_sheds_oldest_and_counts(self):
+        lam = BroadcasterLambda(_Ctx(), shards=1, queue_limit=8)
+        try:
+            gate = threading.Event()
+            got = []
+            first = threading.Event()
+
+            def slow(m):
+                first.set()
+                gate.wait(5.0)
+                got.append(m.sequence_number)
+
+            lam.join_room("d", slow)
+            _feed(lam, "d", [_seq(0, 1)])
+            assert first.wait(2.0)  # worker parked inside delivery
+            # 20 more while the worker is stuck: queue holds 8, rest shed.
+            _feed(lam, "d", [_seq(0, n) for n in range(2, 22)], offset0=1)
+            assert lam.shards[0].depth() == 8
+            assert lam.shed_count() == 20 - 8
+            gate.set()
+            assert lam.drain(5.0)
+            # Shedding drops the OLDEST: the tail (freshest) survives.
+            assert got[-1] == 21
+            assert got == sorted(got)
+        finally:
+            lam.close()
+
+    def test_subscriber_churn_mid_stream(self):
+        lam = BroadcasterLambda(_Ctx(), shards=2, queue_limit=1024)
+        try:
+            stable, churn = [], []
+            lock = threading.Lock()
+
+            def on_stable(m):
+                with lock:
+                    stable.append(m.sequence_number)
+
+            def on_churn(m):
+                with lock:
+                    churn.append(m.sequence_number)
+
+            lam.join_room("d", on_stable)
+            _feed(lam, "d", [_seq(0, n) for n in range(1, 11)])
+            assert lam.drain(5.0)
+            lam.join_room("d", on_churn)
+            _feed(lam, "d", [_seq(0, n) for n in range(11, 21)],
+                  offset0=10)
+            assert lam.drain(5.0)
+            lam.leave_room("d", on_churn)
+            _feed(lam, "d", [_seq(0, n) for n in range(21, 31)],
+                  offset0=20)
+            assert lam.drain(5.0)
+            # The stable subscriber saw everything in order; the churner
+            # exactly its subscribed window.
+            assert stable == list(range(1, 31))
+            assert churn == list(range(11, 21))
+            # Leaving twice / a never-joined listener is a no-op.
+            lam.leave_room("d", on_churn)
+            lam.leave_room("nope", on_churn)
+        finally:
+            lam.close()
+
+    def test_listener_exception_does_not_kill_shard(self):
+        lam = BroadcasterLambda(_Ctx(), shards=1, queue_limit=64)
+        try:
+            got = []
+
+            def bad(m):
+                raise RuntimeError("listener bug")
+
+            lam.join_room("d", bad)
+            lam.join_room("d", lambda m: got.append(m.sequence_number))
+            _feed(lam, "d", [_seq(0, 1), _seq(0, 2)])
+            assert lam.drain(5.0)
+            # The shard survived; the healthy listener got both.
+            _feed(lam, "d", [_seq(0, 3)], offset0=2)
+            assert lam.drain(5.0)
+            assert 3 in got
+        finally:
+            lam.close()
+
+    def test_stats_and_depth_gauges(self):
+        lam = BroadcasterLambda(_Ctx(), shards=3, queue_limit=16)
+        try:
+            st = lam.stats()
+            assert st["shards"] == 3
+            assert st["queueDepths"] == [0, 0, 0]
+            assert st["shed"] == 0
+            from fluidframework_tpu.telemetry import counters
+            lam.queue_depths()
+            snap = counters.snapshot()
+            assert "broadcaster.queue_depth.shard0" in snap
+        finally:
+            lam.close()
+
+
+class TestLocalServerSharding:
+    def test_server_wires_shards_from_config_and_admission(self):
+        class Cfg(dict):
+            def get(self, k, d=None):
+                return dict.get(self, k, d)
+
+        srv = LocalServer(config=Cfg({"broadcaster.shards": 3,
+                                      "broadcaster.queueLimit": 128,
+                                      "admission.enabled": True}))
+        assert srv.broadcaster_shards == 3
+        seen = []
+        conn = srv.connect("doc")
+        conn.on("op", lambda m: seen.append(m.sequence_number))
+        srv.pump()
+        for k in range(5):
+            conn.submit([DocumentMessage(
+                client_sequence_number=k + 1, reference_sequence_number=0,
+                type=MessageType.OPERATION, contents={"k": k})])
+        srv.pump()
+        assert srv.drain_broadcast(10.0)
+        assert seen == sorted(seen) and len(seen) >= 6  # join + 5 ops
+        assert srv.broadcast_queue_depth() == 0
+        # The admission controller polls the broadcast backlog feed.
+        assert any(name.startswith("broadcast:")
+                   for name in srv.admission._sources), \
+            srv.admission._sources
+
+    def test_default_is_inline(self):
+        srv = LocalServer()
+        assert srv.broadcaster_shards == 0
+        conn = srv.connect("doc")
+        got = []
+        conn.on("op", lambda m: got.append(m.sequence_number))
+        srv.pump()
+        conn.submit([DocumentMessage(
+            client_sequence_number=1, reference_sequence_number=0,
+            type=MessageType.OPERATION, contents={})])
+        srv.pump()
+        # Inline: delivery completed synchronously inside pump().
+        assert got
+        for lam in srv.broadcasters:
+            assert lam.shards == []

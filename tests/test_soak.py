@@ -952,3 +952,194 @@ class TestAsyncSummaryLockDiscipline:
         finally:
             lockcheck.uninstrument()
         assert len(done) == len(workers)
+
+
+# ---------------------------------------------------------------------------
+# Fixed-seed READ-PATH chaos (docs/read_path.md): reconnect-avalanche
+# loads through the catch-up delta artifact, and hot-document fan-out
+# through the sharded broadcaster with forced shedding + gap-fill
+# recovery. Deterministic (FaultPlan drives every decision), tier-1.
+# ---------------------------------------------------------------------------
+
+
+def _read_chaos_fleet(server, doc_id="doc", seed_text="base"):
+    from fluidframework_tpu.dds.sequence import SharedString
+    from fluidframework_tpu.loader.container import Loader
+    from fluidframework_tpu.loader.drivers.local import (
+        LocalDocumentServiceFactory)
+
+    loader = Loader(LocalDocumentServiceFactory(server))
+    c = loader.create_detached(doc_id)
+    ds = c.runtime.create_datastore("default")
+    t = ds.create_channel("text", SharedString.TYPE)
+    t.insert_text(0, seed_text)
+    c.attach()
+    return loader, c, t
+
+
+class TestReconnectAvalancheReadChaos:
+    """N reader containers on one document served via the catch-up
+    artifact; every round the plan picks a burst of writer edits and a
+    set of readers to drop, then the WHOLE dropped set reloads at once
+    (the avalanche) against a freshly refreshed artifact. Convergence:
+    every reader ends on the writer's text, the delta path actually
+    carried the avalanche (adoptions counted), the refresh stayed
+    batched (dispatches never scale with reader count), and two
+    same-seed runs reproduce bit-identically."""
+
+    N_READERS = 6
+    ROUNDS = 8
+
+    def _run(self, seed):
+        from fluidframework_tpu.server.local_server import TpuLocalServer
+        from fluidframework_tpu.telemetry import counters
+
+        plan = faultinject.FaultPlan(seed)
+        server = TpuLocalServer()
+        loader, writer, text = _read_chaos_fleet(server)
+        readers = {}
+        for r in range(self.N_READERS):
+            readers[r] = loader.resolve(
+                "doc", client_details={"mode": "read"})
+        trace = []
+        adopted0 = counters.get("catchup.client.adopted")
+        disp0 = counters.get("catchup.refresh_dispatches")
+        for _round in range(self.ROUNDS):
+            burst = 4 + plan.pick(24, site="burst")
+            for i in range(burst):
+                text.insert_text(plan.pick(text.get_length() + 1,
+                                           site="pos"),
+                                 f"r{_round}.{i} ")
+            server.pump()
+            st = server.refresh_catchup()
+            dropped = [r for r in readers
+                       if plan.pick(3, site="drop") == 0]
+            for r in dropped:
+                readers[r].close()
+            server.pump()
+            # The avalanche: every dropped reader reloads at once.
+            for r in dropped:
+                readers[r] = loader.resolve(
+                    "doc", client_details={"mode": "read"})
+            trace.append((burst, tuple(dropped), st["published"]))
+        server.pump()
+        texts = {r: c.runtime.get_datastore("default")
+                 .get_channel("text").get_text()
+                 for r, c in readers.items()}
+        return {
+            "fingerprint": plan.fingerprint(),
+            "trace": trace,
+            "final": text.get_text(),
+            "texts": texts,
+            "adoptions": counters.get("catchup.client.adopted") - adopted0,
+            "dispatches": counters.get("catchup.refresh_dispatches")
+            - disp0,
+        }
+
+    def test_converges_and_reproduces_bit_identically(self):
+        a = self._run(20260804)
+        b = self._run(20260804)
+        assert a["fingerprint"] == b["fingerprint"]
+        assert a["trace"] == b["trace"]
+        assert a["final"] == b["final"]
+        # Convergence: every reader (including every avalanche reload)
+        # sees exactly the writer's document.
+        assert all(t == a["final"] for t in a["texts"].values())
+        # The avalanche actually rode the delta path...
+        assert a["adoptions"] > 0 and a["adoptions"] == b["adoptions"]
+        # ...and refresh work stayed O(dirty docs): bounded by rounds x
+        # buckets, NOT by reader-loads (one doc, one bucket here — at
+        # most one dispatch per round regardless of avalanche size).
+        assert a["dispatches"] <= self.ROUNDS
+        assert a["dispatches"] == b["dispatches"]
+
+    def test_different_seeds_diverge(self):
+        a = self._run(31)
+        b = self._run(32)
+        assert a["fingerprint"] != b["fingerprint"]
+
+
+class TestHotDocumentReadChaos:
+    """One hot document fanned out through the SHARDED broadcaster to a
+    crowd of read-only containers, with plan-chosen rounds running
+    against a deliberately blocked shard so the bounded queue must shed.
+    Readers that missed shed broadcasts recover through DeltaManager gap
+    detection (catch-up fetch against scriptorium) — the read path's own
+    recovery contract — and everyone converges. Shedding is
+    deterministic (the queue fills while the shard is parked), so two
+    same-seed runs reproduce bit-identically."""
+
+    N_READERS = 5
+    ROUNDS = 6
+    QUEUE_LIMIT = 8
+
+    def _run(self, seed):
+        import threading
+
+        from fluidframework_tpu.server.local_server import TpuLocalServer
+
+        class Cfg(dict):
+            def get(self, k, d=None):
+                return dict.get(self, k, d)
+
+        plan = faultinject.FaultPlan(seed)
+        server = TpuLocalServer(config=Cfg({
+            "broadcaster.shards": 2,
+            "broadcaster.queueLimit": self.QUEUE_LIMIT}))
+        loader, writer, text = _read_chaos_fleet(server, doc_id="hot")
+        server.pump()
+        server.drain_broadcast(20.0)
+        readers = [loader.resolve("hot", client_details={"mode": "read"})
+                   for _ in range(self.N_READERS)]
+        lam = server.broadcasters[0]
+        from fluidframework_tpu.server.lambdas.broadcaster import shard_for
+        hot_shard = lam.shards[shard_for("hot", len(lam.shards))]
+        trace = []
+        for _round in range(self.ROUNDS):
+            burst = 6 + plan.pick(18, site="burst")
+            stall = plan.pick(2, site="stall") == 0
+            gate = threading.Event()
+            if stall:
+                # Park the hot shard: one in-flight delivery blocks on
+                # the gate, the burst then overfills the bounded queue
+                # and sheds deterministically.
+                lam.join_room("hot", lambda m: gate.wait(30.0))
+            shed0 = lam.shed_count()
+            for i in range(burst):
+                text.insert_text(text.get_length(), f"h{_round}.{i} ")
+            server.pump()
+            if stall:
+                gate.set()
+                lam.leave_room(
+                    "hot", [l for l in lam.rooms["hot"]][-1])
+            server.drain_broadcast(30.0)
+            trace.append((burst, stall, lam.shed_count() - shed0))
+            assert hot_shard.depth() <= self.QUEUE_LIMIT
+        # Closing edit exposes any shed-induced gap; DeltaManager
+        # gap-fill then recovers every reader.
+        text.insert_text(text.get_length(), "END")
+        server.pump()
+        server.drain_broadcast(30.0)
+        final = text.get_text()
+        reader_texts = [c.runtime.get_datastore("default")
+                        .get_channel("text").get_text()
+                        for c in readers]
+        return {
+            "fingerprint": plan.fingerprint(),
+            "trace": trace,
+            "final": final,
+            "reader_texts": reader_texts,
+            "shed": lam.shed_count(),
+        }
+
+    def test_sheds_recovers_and_reproduces(self):
+        a = self._run(777)
+        b = self._run(777)
+        assert a["fingerprint"] == b["fingerprint"]
+        assert a["trace"] == b["trace"]
+        assert a["final"] == b["final"]
+        # The bounded queue actually shed under the parked shard...
+        assert a["shed"] > 0 and a["shed"] == b["shed"]
+        # ...and every reader still converged on the writer's document
+        # (gap-fill recovery, not broadcast delivery, is the contract).
+        assert all(t == a["final"] for t in a["reader_texts"])
