@@ -3,8 +3,8 @@
 
 .PHONY: test soak bench dryrun record-corpus historian-smoke \
 	summarize-smoke trace-smoke pipeline-smoke fused-smoke \
-	paged-smoke catchup-smoke lint-analysis lint-changed lint-races \
-	layer-check check
+	paged-smoke catchup-smoke obs-smoke bench-trend lint-analysis \
+	lint-changed lint-races layer-check check
 
 test:
 	python -m pytest tests/ -q
@@ -107,6 +107,24 @@ paged-smoke:
 catchup-smoke:
 	JAX_PLATFORMS=cpu python bench.py catchup-smoke
 
+# CPU smoke of the device telemetry planes + compile observatory
+# (docs/observability.md v2): telemetry-on serving must be BIT-IDENTICAL
+# to telemetry-off (emit stream + lane planes), the stats plane must ride
+# the existing readback (0 extra dispatches per window/burst), device-
+# counted op totals must reconcile EXACTLY with the host-side counts,
+# stats overhead must stay < 2% on the warm 512-doc fused shape, and the
+# compile ledger (per-symbol compiles + cumulative compile ms) must be
+# stamped top-level in BENCH_OBS_LAST.json.
+obs-smoke:
+	JAX_PLATFORMS=cpu python bench.py obs-smoke
+
+# Per-metric trajectory over the committed BENCH_r*.json history; exits
+# nonzero on a >20% regression vs the best comparable-host record
+# (tpu/axon records only — CPU-fallback hosts are not comparable to each
+# other, the r05/r06 pin lesson). Report-only inside `make check`.
+bench-trend:
+	python bench.py trend
+
 # Virtual-clocked open-loop overload harness (docs/overload.md): at 2x
 # sustained overload the admission controller must shed instead of
 # queueing unboundedly (peak queue bounded), hold the admitted-op flush
@@ -117,11 +135,13 @@ overload-smoke:
 	JAX_PLATFORMS=cpu python bench.py overload-smoke
 
 # The pre-merge gate: layering/cycles + static analysis (incl. the
-# focused race gate) + the summarize/trace/pipeline/fused/overload
-# smokes + the full test suite.
+# focused race gate) + the summarize/trace/pipeline/fused/paged/catchup/
+# overload/obs smokes + the bench trend (report-only here) + the full
+# test suite.
 check: layer-check lint-analysis lint-races summarize-smoke trace-smoke \
 		pipeline-smoke fused-smoke paged-smoke catchup-smoke \
-		overload-smoke test
+		overload-smoke obs-smoke test
+	python bench.py trend --report-only
 
 # The round-end randomized-evidence ritual: 50-trial soaks over every
 # differential surface (bulk catch-up, serving fast path, matrix/

@@ -169,7 +169,12 @@ _SPAN_PRODUCERS = {"span", "start_span"}
 
 # Span discipline is enforced where spans matter operationally: the op
 # pipeline (client engine, drivers, server stages, telemetry itself).
-# "<memory>" keeps the fixture tests in scope.
+# The server prefix deliberately covers the WHOLE tier — including the
+# read path (server/readpath.py), the lambdas (broadcaster shard
+# workers), and the paged rescue path in tpu_sequencer.py, which all
+# carry spans as of the observability catch-up (docs/observability.md
+# v2) — so a span added anywhere on the serving tier is born under the
+# leak rule. "<memory>" keeps the fixture tests in scope.
 _SPAN_SCOPE_PREFIXES = (
     "fluidframework_tpu/mergetree", "fluidframework_tpu/loader",
     "fluidframework_tpu/server", "fluidframework_tpu/telemetry",

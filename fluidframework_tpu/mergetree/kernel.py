@@ -598,9 +598,28 @@ def scatter_pages(pool: DocState, page_ids: jnp.ndarray,
     )
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 1))
+def paged_stats_vec(ops: PackedOps, out: DocState) -> jnp.ndarray:
+    """The paged apply's device telemetry plane (telemetry/device_stats
+    PAGED_SLOTS order): staged ops by kind, flagged docs, post-apply
+    live rows — counted inside the program, so the host learns the
+    group's facts from the readback it already pays. Padding rows
+    (all-NOOP streams on blank views, zeroed counts) contribute
+    nothing, so host mirrors reconcile exactly."""
+    from .oppack import OpKind as K
+
+    per_kind = [jnp.sum((ops.kind == kv).astype(jnp.int32))
+                for kv in (K.INSERT, K.REMOVE, K.ANNOTATE, K.ACK_INSERT,
+                           K.ACK_REMOVE, K.INSERT_RUN)]
+    return jnp.stack(per_kind + [
+        jnp.sum(out.overflow.astype(jnp.int32)),
+        jnp.sum(out.count.astype(jnp.int32)),
+    ])
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1),
+                   static_argnames=("stats",))
 def apply_ops_paged(pool: DocState, page_ids: jnp.ndarray, counts,
-                    min_seqs, seqs, ops: PackedOps):
+                    min_seqs, seqs, ops: PackedOps, stats: bool = False):
     """One [B, T] op window over paged documents: gather-by-page-id ->
     the unchanged batched apply -> scatter-by-page-id, in ONE jitted
     dispatch with the page pool and page-table plane DONATED (the pool
@@ -609,12 +628,18 @@ def apply_ops_paged(pool: DocState, page_ids: jnp.ndarray, counts,
     pre_view): pre_view is the gathered PRE-window group — the rollback
     the rare unpredicted-overflow recovery (annotate-ring/overlap-slot
     exhaustion) scatters back for flagged docs only, so donation costs
-    one group-view allocation instead of a whole retained pool."""
+    one group-view allocation instead of a whole retained pool.
+    ``stats`` (static) appends the device telemetry plane
+    (paged_stats_vec) as one more element — same dispatch, no extra
+    program, bit-identical lane results either way."""
     pre = gather_pages(pool, page_ids, counts, min_seqs, seqs)
     out = _scan_ops(pre, ops, batched=True)
     pool2 = scatter_pages(pool, page_ids, out)
-    return (pool2, page_ids, out.count, out.min_seq, out.seq,
+    base = (pool2, page_ids, out.count, out.min_seq, out.seq,
             out.overflow, pre)
+    if stats:
+        return base + (paged_stats_vec(ops, out),)
+    return base
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -691,17 +716,27 @@ def extract_visible_batched(state: DocState):
     return jax.vmap(_extract_one)(state)
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=("stats",))
 # fluidlint: disable=MISSING_DONATE — non-donating by design: the serving
 # extract path retains the pre-compaction bucket state until the caller
 # adopts the compacted result (mirrors the *_keep apply family).
-def compact_extract_batched(state: DocState):
+def compact_extract_batched(state: DocState, stats: bool = False):
     """Fused zamboni + snapshot extraction over a [B, ...] batch: returns
     (compacted_state, packed) from ONE jitted dispatch. `packed` has the
     extract_visible_batched layout; `compacted_state` is the post-GC state
     the caller may adopt in place of the input (bit-identical to
-    compact_batched(state), locked by tests/test_narrow_wire.py)."""
-    return jax.vmap(_compact_extract_one)(state)
+    compact_batched(state), locked by tests/test_narrow_wire.py).
+
+    ``stats`` (static) appends the PRE-compaction per-doc live-row
+    counts as a third element: the host derives zamboni reclamation
+    (pre minus post counts) from the dispatch it already pays — the
+    pre counts are device-resident, so without this plane the fact
+    would cost a separate fetch. Results are bit-identical either way
+    (the plane is a pure extra output)."""
+    out = jax.vmap(_compact_extract_one)(state)
+    if stats:
+        return out + (state.count.astype(jnp.int32),)
+    return out
 
 
 def _gather_rows(state, idx):

@@ -68,7 +68,7 @@ class _Shard:
                 self.queue.popleft()
                 self.shed += 1
                 increment("broadcaster.shed")
-            self.queue.append((doc_id, message))
+            self.queue.append((doc_id, message, time.perf_counter()))
             self.cond.notify()
 
     def _run(self) -> None:
@@ -78,7 +78,7 @@ class _Shard:
                     self.cond.wait(timeout=0.5)
                 if self.closed and not self.queue:
                     return
-                doc_id, message = self.queue.popleft()
+                doc_id, message, t_enq = self.queue.popleft()
                 self.busy = True
             try:
                 self.deliver(doc_id, message)
@@ -86,6 +86,17 @@ class _Shard:
                 from ...telemetry.counters import record_swallow
                 record_swallow("broadcaster.shard_deliver")
             finally:
+                # Shard-worker span (docs/observability.md): enqueue →
+                # delivered, so the span measures queue DWELL + fan-out —
+                # the figure a backed-up shard actually adds to reader
+                # latency. Pre-measured record_span joined to the op's
+                # wire context (same pattern as _fan_out); the histogram
+                # fills even with tracing off.
+                tracing.record_span(
+                    "broadcaster.shard", tracing.message_context(message),
+                    t_enq, time.perf_counter(),
+                    hist="broadcaster.shard_dwell", shard=self.index,
+                    document=doc_id)
                 with self.cond:
                     self.busy = False
                     self.delivered += 1

@@ -13,11 +13,20 @@ Observability additions (docs/observability.md):
                  JSON (open in perfetto / chrome://tracing)
   /metrics.prom  Prometheus text exposition: process counters + the
                  per-stage latency histograms (bucket lines carry
-                 trace-id exemplars)
+                 trace-id exemplars) + the compile ledger's per-symbol
+                 gauges
+  /profile       capture a bounded jax.profiler trace window on demand
+                 (?ms=<window>, capped) and return where it landed
   SLO            a declared serving-flush latency budget (default
                  p99 <= 2x p50 over the rolling window) evaluated on
                  every /health; a breach flips /health to 503 with the
                  measured numbers in the `slo` detail
+
+/health additionally carries the compile ledger (telemetry/
+compile_ledger.py — per-symbol compiles, cumulative compile ms,
+warm-vs-cold calls, jit-cache occupancy) and the device telemetry
+snapshot (telemetry/device_stats.py — the device.* / host.* counter
+pairs whose exact reconciliation obs-smoke gates).
 """
 
 from __future__ import annotations
@@ -27,9 +36,13 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, List, Optional, Tuple
+from urllib.parse import parse_qs
 
 from ..telemetry import counters as process_counters
+from ..telemetry import device_stats
 from ..telemetry import tracing
+from ..telemetry.compile_ledger import (install_jax_listener,
+                                        ledger as compile_ledger)
 from ..telemetry.counters import nearest_rank
 
 
@@ -161,6 +174,10 @@ class ServiceMonitor:
         self._httpd.daemon_threads = True
         self.host, self.port = self._httpd.server_address
         self._thread: Optional[threading.Thread] = None
+        # Ground-truth backend-compile time (compile.backend_ms) via
+        # jax.monitoring, where the running jax exposes the hook —
+        # best-effort and idempotent.
+        install_jax_listener()
 
     def add_probe(self, name: str, probe: Callable[[], dict]) -> None:
         with self._probes_lock:
@@ -309,6 +326,14 @@ class ServiceMonitor:
                 # swallowed.* rates (fluidlint CC rules' runtime side) and
                 # kernel.retrace_count (the RETRACE_HAZARD cross-check).
                 "counters": process_counters.snapshot(),
+                # The compile/dispatch observatory: per-symbol compiles,
+                # cumulative compile ms, warm/cold split, cache-key
+                # occupancy (telemetry/compile_ledger.py).
+                "compileLedger": compile_ledger.snapshot(),
+                # Device telemetry planes + their host mirrors; a non-
+                # None `deviceReconcile` names the slots that disagree.
+                "deviceStats": device_stats.snapshot(),
+                "deviceReconcile": device_stats.reconcile(),
                 # The declared-budget verdict (503-with-detail on breach).
                 "slo": slo,
                 "stageLatencies": process_counters.latency_snapshot(),
@@ -375,6 +400,24 @@ class ServiceMonitor:
                          f'{h["sum"]:g}')
             lines.append(f'fluid_stage_latency_ms_count{{stage="{name}"}} '
                          f'{h["count"]}')
+        # Compile/dispatch observatory: per-symbol gauges. Symbol
+        # cardinality is the fixed probe/watch set (no per-tenant/doc
+        # labels), so this block never needs the cardinality guard.
+        led = compile_ledger.snapshot()
+        if led["symbols"]:
+            for metric in ("compiles", "compile_ms", "cache_size",
+                           "retraces"):
+                lines.append(f"# TYPE fluid_compile_{metric} gauge")
+                src = {"compiles": "compiles", "compile_ms": "compileMs",
+                       "cache_size": "cacheSize",
+                       "retraces": "retraces"}[metric]
+                for name, sym in led["symbols"].items():
+                    lines.append(
+                        f'fluid_compile_{metric}{{symbol="{name}"}} '
+                        f'{sym[src]:g}')
+            lines.append("# TYPE fluid_compile_total_ms gauge")
+            lines.append(
+                f'fluid_compile_total_ms {led["totals"]["compileMs"]:g}')
         slo = self.slo.evaluate()
         lines.append("# TYPE fluid_slo_ok gauge")
         lines.append(f'fluid_slo_ok{{stage="{slo["stage"]}"}} '
@@ -393,8 +436,48 @@ class ServiceMonitor:
         lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
+    # -- on-demand profiler capture -----------------------------------------
+    # One capture at a time; the window is bounded so a stray request can
+    # never wedge a request thread for long or leave the profiler running.
+    _PROFILE_MAX_MS = 5000.0
+    _profile_lock = threading.Lock()
+
+    def profile(self, ms: float = 200.0) -> dict:
+        """Capture a bounded jax.profiler trace window into a fresh
+        directory and return where it landed (open with TensorBoard or
+        perfetto). Returns {"ok": False, ...} — never raises — when jax
+        or its profiler is unavailable, or a capture is already
+        running."""
+        import os
+        import tempfile
+
+        ms = max(10.0, min(float(ms), self._PROFILE_MAX_MS))
+        if not self._profile_lock.acquire(blocking=False):
+            return {"ok": False, "error": "profile capture already "
+                                          "in progress"}
+        try:
+            import jax
+
+            out_dir = tempfile.mkdtemp(prefix="fluid_profile_")
+            jax.profiler.start_trace(out_dir)
+            try:
+                time.sleep(ms / 1000.0)
+            finally:
+                jax.profiler.stop_trace()
+            files = []
+            for root, _dirs, names in os.walk(out_dir):
+                for name in names:
+                    files.append(os.path.relpath(
+                        os.path.join(root, name), out_dir))
+            return {"ok": True, "dir": out_dir, "durationMs": ms,
+                    "files": sorted(files)}
+        except Exception as exc:  # noqa: BLE001 — surface, never crash the monitor
+            return {"ok": False, "error": repr(exc)}
+        finally:
+            self._profile_lock.release()
+
     def _route(self, handler) -> None:
-        path = handler.path.partition("?")[0]
+        path, _, query = handler.path.partition("?")
         if path == "/healthz":  # k8s-style alias
             path = "/health"
         content_type = "application/json"
@@ -417,6 +500,15 @@ class ServiceMonitor:
             body = json.dumps(tracing.chrome_trace(
                 tracing.recorder.drain())).encode()
             status = 200
+        elif path == "/profile":
+            params = parse_qs(query)
+            try:
+                ms = float(params.get("ms", ["200"])[0])
+            except ValueError:
+                ms = 200.0
+            payload = self.profile(ms)
+            body = json.dumps(payload).encode()
+            status = 200 if payload["ok"] else 503
         else:
             body = json.dumps({"error": f"no route {path}"}).encode()
             status = 404

@@ -39,6 +39,7 @@ from ..mergetree.catchup import (  # noqa: F401 — re-exported: this module
     translate_entry_clients,       # side adopters import the codec from
     unpack_entries_narrow,         # here (layering: loader may import
 )                                  # server, not mergetree)
+from ..telemetry import tracing
 from ..telemetry.counters import increment
 from .cache import LruTtlCache
 
@@ -75,31 +76,42 @@ class CatchupCache:
 
     def publish(self, tenant_id: str, document_id: str,
                 artifact: dict) -> bool:
-        """Write-through publish; loses quietly to a fresher artifact."""
-        wrote = self.blobs.put_if_newer(
-            (tenant_id, document_id), artifact,
-            version=int(artifact["seq"]),
-            nbytes=artifact_nbytes(artifact))
-        if wrote:
-            self.published += 1
-            increment("catchup.published")
+        """Write-through publish; loses quietly to a fresher artifact.
+        Spanned (catchup.publish + the always-on histogram): the
+        refresh epoch's per-doc publish cost attributes to a stage
+        instead of hiding inside the epoch total."""
+        with tracing.span("catchup.publish", hist="catchup.publish",
+                          document=document_id) as sp:
+            wrote = self.blobs.put_if_newer(
+                (tenant_id, document_id), artifact,
+                version=int(artifact["seq"]),
+                nbytes=artifact_nbytes(artifact))
+            if wrote:
+                self.published += 1
+                increment("catchup.published")
+            else:
+                sp.set(lost_to_fresher=True)
         return wrote
 
     def get(self, tenant_id: str, document_id: str,
             head_seq: Optional[int] = None) -> Optional[dict]:
         """The read path: returns the artifact or None (miss). head_seq,
         when the caller knows it, classifies the hit as fresh/stale."""
-        held = self.blobs.get((tenant_id, document_id))
-        if held is None:
-            self.misses += 1
-            increment("catchup.delta_miss")
-            return None
-        _version, artifact = held
-        self.hits += 1
-        increment("catchup.delta_hit")
-        if head_seq is not None and int(artifact["seq"]) < head_seq:
-            self.stale_hits += 1
-            increment("catchup.delta_stale")
+        with tracing.span("catchup.get", hist="catchup.get",
+                          document=document_id) as sp:
+            held = self.blobs.get((tenant_id, document_id))
+            if held is None:
+                self.misses += 1
+                increment("catchup.delta_miss")
+                sp.set(miss=True)
+                return None
+            _version, artifact = held
+            self.hits += 1
+            increment("catchup.delta_hit")
+            if head_seq is not None and int(artifact["seq"]) < head_seq:
+                self.stale_hits += 1
+                increment("catchup.delta_stale")
+                sp.set(stale=True)
         return artifact
 
     def peek_seq(self, tenant_id: str, document_id: str) -> Optional[int]:

@@ -38,7 +38,7 @@ from . import ticket_kernel as tk
 
 def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
                        lww_states, lww_cols, fused=False, merge_runs=None,
-                       noop_skip=False):
+                       noop_skip=False, stats=False):
     """The traced body shared by ``serve_window`` (one jitted window),
     ``serve_window_keep`` (the non-donating recovery variant), and
     ``serve_burst``'s scan step (K windows in one program).
@@ -63,10 +63,21 @@ def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
     [seq_delta B*T | msn_delta B*T | flags B*T | next_seq as (lo B, hi B)
     | msn_base as (lo B, hi B) | msn_ok bit | overflow-any bits |
     per-lane overflow planes (merge then LWW, lanes each) | per-lane
-    occupancy planes (same order)], decoded by
-    tpu_sequencer._finish_window; msn32 is the exact int32 msn plane,
-    fetched ONLY when the window's msn span overflows the delta (msn_ok
-    == 0; one global bit for the whole window)."""
+    occupancy planes (same order) | (stats=True only) the device
+    telemetry plane: device_stats.N_SERVE int32 slots as (lo, hi)
+    int16 halves], decoded by tpu_sequencer._finish_window; msn32 is
+    the exact int32 msn plane, fetched ONLY when the window's msn span
+    overflows the delta (msn_ok == 0; one global bit for the whole
+    window).
+
+    ``stats`` (static) appends the device-resident telemetry plane
+    (telemetry/device_stats.py SERVE_SLOTS): admitted ops by kind,
+    ticket admissions/nacks, overflow-lane and noop-skip counts, and
+    post-window lane fill — counted INSIDE the program from the same
+    masks the applies use, so a K-window burst reports exact per-window
+    facts with zero extra dispatches and zero extra host round-trips
+    (the plane rides this same flat16). Pure output: the op phases
+    never read it, so results are bit-identical with it on or off."""
     raw = tk.RawOps(client=ticket_cols[1], client_seq=ticket_cols[2],
                     ref_seq=ticket_cols[3], kind=ticket_cols[0])
     tstate, ticketed = tk._scan_tickets(tstate, raw, batched=True,
@@ -75,6 +86,13 @@ def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
 
     if merge_runs is None:
         merge_runs = [None] * len(merge_cols)
+    # Device telemetry accumulators (stats=True): counted from the SAME
+    # ok-masks the applies consume, so the host mirror derived from the
+    # decoded ticket results reconciles exactly.
+    zero = jnp.zeros((), jnp.int32)
+    st_kind = [zero] * 6  # INSERT..INSERT_RUN admitted counts
+    st_lww = zero
+    st_skips = zero
     new_merge = []
     # fluidlint: disable=RETRACE_HAZARD — deliberate bounded unroll: one
     # iteration per capacity bucket (≤3 in production; docstring), fused
@@ -112,6 +130,13 @@ def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
             kind=jnp.where(ok, packed.kind, OpKind.NOOP),
             seq=jnp.where(ok, seq_g, 0),
             msn=jnp.where(ok, msn_g, 0))
+        if stats:
+            for ki, kv in enumerate((OpKind.INSERT, OpKind.REMOVE,
+                                     OpKind.ANNOTATE, OpKind.ACK_INSERT,
+                                     OpKind.ACK_REMOVE,
+                                     OpKind.INSERT_RUN)):
+                st_kind[ki] = st_kind[ki] + jnp.sum(
+                    (ops2.kind == kv).astype(jnp.int32))
         from ..mergetree.pallas_apply import (FUSED_MAX_CAPACITY,
                                              apply_ops_fused_pallas)
         use_fused = fused and mstate.capacity <= FUSED_MAX_CAPACITY
@@ -138,8 +163,10 @@ def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
             def apply_m(s, o=ops2):
                 return kernel._scan_ops(s, o, batched=True)
         if noop_skip:
-            out = kernel.apply_if_any(apply_m, mstate,
-                                      jnp.any(ops2.kind != OpKind.NOOP))
+            active = jnp.any(ops2.kind != OpKind.NOOP)
+            if stats:
+                st_skips = st_skips + (~active).astype(jnp.int32)
+            out = kernel.apply_if_any(apply_m, mstate, active)
         else:
             out = apply_m(mstate)
         if over_extra is not None:
@@ -155,12 +182,17 @@ def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
         ops = lk.LwwOps(kind=jnp.where(ok, lc[0], lk.LwwKind.NOOP),
                         key=lc[1], val=lc[2], delta=lc[3],
                         seq=jnp.where(ok, seq_g, 0))
+        if stats:
+            st_lww = st_lww + jnp.sum(
+                (ops.kind != lk.LwwKind.NOOP).astype(jnp.int32))
 
         def apply_l(s, o=ops):
             return lk._scan(s, o, batched=True)
         if noop_skip:
-            new_lww.append(kernel.apply_if_any(
-                apply_l, lstate, jnp.any(ops.kind != lk.LwwKind.NOOP)))
+            active_l = jnp.any(ops.kind != lk.LwwKind.NOOP)
+            if stats:
+                st_skips = st_skips + (~active_l).astype(jnp.int32)
+            new_lww.append(kernel.apply_if_any(apply_l, lstate, active_l))
         else:
             new_lww.append(apply_l(lstate))
 
@@ -217,6 +249,28 @@ def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
         return [(x32 & 0xFFFF).astype(jnp.int16),
                 (x32 >> 16).astype(jnp.int16)]
 
+    stats_tail = []
+    if stats:
+        # The device telemetry plane (telemetry/device_stats.SERVE_SLOTS
+        # order): int32 facts as (lo, hi) int16 halves riding the SAME
+        # flat16 readback — no extra output, no extra RPC.
+        st_vec = jnp.stack(st_kind + [
+            st_lww,
+            jnp.sum(admitted.astype(jnp.int32)),
+            jnp.sum(ticketed.nacked.astype(jnp.int32)),
+            jnp.sum(ticketed.not_joined.astype(jnp.int32)),
+            sum((s.overflow.astype(jnp.int32).sum() for s in new_merge),
+                zero),
+            sum((s.overflow.astype(jnp.int32).sum() for s in new_lww),
+                zero),
+            st_skips,
+            sum((s.count.astype(jnp.int32).sum() for s in new_merge),
+                zero),
+            sum(((s.key >= 0).astype(jnp.int32).sum() for s in new_lww),
+                zero),
+        ])
+        stats_tail = halves(st_vec)
+
     flat16 = jnp.concatenate(
         [seq_d.ravel().astype(jnp.int16),
          msn_d.ravel().astype(jnp.int16),
@@ -226,20 +280,22 @@ def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
         # flat16 is the NARROW result plane (docstring); decoded by
         # tpu_sequencer._finish_window.
         + [jnp.concatenate([msn_ok[None]] + bits).astype(jnp.int16)]
-        + planes)
+        + planes + stats_tail)
     # Fetched ONLY when msn_ok == 0 (second RPC on the rare path).
     return tstate, new_merge, new_lww, flat16, msn_bt
 
 
-@functools.partial(jax.jit, donate_argnums=(0, 2, 4), static_argnums=(6,))
+@functools.partial(jax.jit, donate_argnums=(0, 2, 4),
+                   static_argnums=(6, 8))
 def serve_window(tstate, ticket_cols, merge_states, merge_cols,
-                 lww_states, lww_cols, fused=False, merge_runs=None):
+                 lww_states, lww_cols, fused=False, merge_runs=None,
+                 stats=False):
     """One fast window, donating: the jitted single-window entry point
     over ``_serve_window_impl`` (docstring there carries the full
     contract and the flat16 layout)."""
     return _serve_window_impl(tstate, ticket_cols, merge_states,
                               merge_cols, lww_states, lww_cols, fused,
-                              merge_runs)
+                              merge_runs, stats=stats)
 
 
 # The non-donating recovery-replay variant: identical traced body, but the
@@ -251,12 +307,12 @@ def serve_window(tstate, ticket_cols, merge_states, merge_cols,
 # takes the donating `serve_window` above and never allocates a second
 # copy of the lane planes.
 serve_window_keep = functools.partial(
-    jax.jit, donate_argnums=(0,), static_argnums=(6,))(
+    jax.jit, donate_argnums=(0,), static_argnums=(6, 8))(
         serve_window.__wrapped__)
 
 
 def _serve_burst(tstate, merge_states, lww_states, ticket_xs, merge_xs,
-                 lww_xs, runs_xs, fused=False):
+                 lww_xs, runs_xs, fused=False, stats=False):
     """K serving windows in ONE scanned device program (the fused
     serving burst, docs/serving_pipeline.md R8).
 
@@ -298,7 +354,7 @@ def _serve_burst(tstate, merge_states, lww_states, ticket_xs, merge_xs,
         tc, mc, lc, rc = xs
         ts2, nm, nl, flat16, msn32 = _serve_window_impl(
             ts, tc, list(ms), list(mc), list(ls), list(lc), fused,
-            list(rc), noop_skip=True)
+            list(rc), noop_skip=True, stats=stats)
         return (ts2, tuple(nm), tuple(nl)), (flat16, msn32)
 
     carry, ys = jax.lax.scan(
@@ -309,10 +365,12 @@ def _serve_burst(tstate, merge_states, lww_states, ticket_xs, merge_xs,
 
 
 serve_burst = functools.partial(
-    jax.jit, donate_argnums=(0, 1, 2), static_argnums=(7,))(_serve_burst)
+    jax.jit, donate_argnums=(0, 1, 2),
+    static_argnums=(7, 8))(_serve_burst)
 
 
-def _serve_paged_burst(pool, page_ids, counts, min_seqs, seqs, ops_xs):
+def _serve_paged_burst(pool, page_ids, counts, min_seqs, seqs, ops_xs,
+                       stats=False):
     """K op windows over PAGED documents in ONE scanned device program
     (the paged serving burst, docs/paged_memory.md): gather each doc's
     pages once, scan the K stacked [B, T] op planes with the gathered
@@ -330,23 +388,33 @@ def _serve_paged_burst(pool, page_ids, counts, min_seqs, seqs, ops_xs):
     -> the host rolls the flagged docs back from pre_view and runs the
     host rescue with the FULL stream, mirroring the bucketed recovery
     contract); pre_view is the gathered pre-burst group view that makes
-    that rollback possible under donation."""
+    that rollback possible under donation. ``stats`` (static) appends
+    the per-chunk device telemetry plane [K, N_PAGED]
+    (kernel.paged_stats_vec riding the scan ys — per-chunk facts from
+    the one dispatch the burst already is)."""
     from ..mergetree import kernel
 
     pre = kernel.gather_pages(pool, page_ids, counts, min_seqs, seqs)
 
     def body(view, ops):
         out = kernel._scan_ops(view, ops, batched=True)
+        if stats:
+            return out, (out.overflow, kernel.paged_stats_vec(ops, out))
         return out, out.overflow
 
-    out, over_k = jax.lax.scan(body, pre, ops_xs)
+    out, ys = jax.lax.scan(body, pre, ops_xs)
+    over_k = ys[0] if stats else ys
     pool2 = kernel.scatter_pages(pool, page_ids, out)
     # page_ids pass straight through as an output (identity), which is
     # what lets XLA alias the donated plane; tables are immutable for
     # the whole burst, so they carry no per-step scan leg.
-    return (pool2, page_ids, out.count, out.min_seq, out.seq,
+    base = (pool2, page_ids, out.count, out.min_seq, out.seq,
             out.overflow, over_k, pre)
+    if stats:
+        return base + (ys[1],)
+    return base
 
 
 serve_paged_burst = functools.partial(
-    jax.jit, donate_argnums=(0, 1))(_serve_paged_burst)
+    jax.jit, donate_argnums=(0, 1), static_argnums=(6,))(
+        _serve_paged_burst)

@@ -55,7 +55,8 @@ from ..protocol.messages import (
     NACK_BAD_REF_SEQ,
     SequencedDocumentMessage,
 )
-from ..telemetry import tracing
+from ..telemetry import device_stats, tracing
+from ..telemetry.compile_ledger import ledger as compile_ledger
 from ..telemetry.counters import (JitRetraceProbe, gauge, get as counter_get,
                                   increment, latency_window, nearest_rank,
                                   record_swallow)
@@ -922,17 +923,25 @@ class MergeLaneStore:
                 staged = PackedOps(*[
                     jnp.stack([getattr(c, f) for c in chunks])
                     for f in PackedOps._fields])
+        stats_on = device_stats.enabled()
         with tracing.span("serving.dispatch", hist="serving.dispatch",
                           stage="paged-apply", pages=p2):
             args = (pg.pool, jnp.asarray(pids), jnp.asarray(counts),
                     jnp.asarray(mins), jnp.asarray(seqs), staged)
+            st_dev = None
             if k_chunks == 1:
-                (pool2, _pids2, c2, m2, s2, over, pre) = \
-                    _apply_paged_probe(*args)
+                res = _apply_paged_probe(*args, stats=stats_on)
+                (pool2, _pids2, c2, m2, s2, over, pre) = res[:7]
+                if stats_on:
+                    st_dev = res[7]
             else:
                 from . import serve_step
-                (pool2, _pids2, c2, m2, s2, over, _over_k, pre) = \
-                    serve_step.serve_paged_burst(*args)
+                with compile_ledger.track("serve.paged_burst",
+                                          serve_step.serve_paged_burst):
+                    res = serve_step.serve_paged_burst(*args, stats_on)
+                (pool2, _pids2, c2, m2, s2, over, _over_k, pre) = res[:8]
+                if stats_on:
+                    st_dev = res[8]
             pg.pool = pool2
         with tracing.span("serving.readback", hist="serving.readback",
                           stage="paged-overflow", pages=p2):
@@ -940,6 +949,24 @@ class MergeLaneStore:
             c2n = np.asarray(c2)[:n]
             m2n = np.asarray(m2)[:n]
             s2n = np.asarray(s2)[:n]
+            if st_dev is not None:
+                # The device telemetry plane rides the same join; a
+                # K-chunk burst stacks per-chunk vectors — op kinds sum
+                # across chunks, overflow/rows-live are final-state
+                # facts (sticky carry flags), so the last chunk's values
+                # are the group's.
+                st_np = np.asarray(st_dev)
+                if st_np.ndim == 2:
+                    st_np = np.concatenate(
+                        [st_np[:, :6].sum(0), st_np[-1, 6:]])
+                host_vec = np.zeros(device_stats.N_PAGED, np.int64)
+                all_kinds = np.fromiter(
+                    (op.kind for _, ops in items for op in ops),
+                    np.int64)
+                host_vec[:6] = np.bincount(all_kinds, minlength=7)[1:7]
+                host_vec[6] = int(over_np.sum())
+                host_vec[7] = int(st_np[7])  # fill: device-only fact
+                device_stats.fold_paged(st_np, host_vec)
         with tracing.span("serving.fold_rescue",
                           hist="serving.fold_rescue", pages=p2):
             good = np.flatnonzero(~over_np)
@@ -970,25 +997,39 @@ class MergeLaneStore:
         tm = jax.tree_util.tree_map
         self.fold_rescue_dispatches += 1
         k = len(flagged)
-        k_pad = pow2_pages(k)
-        sel = np.asarray(flagged + [flagged[0]] * (k_pad - k), np.int64)
-        sub_pids = pids[sel].copy()
-        sub_pids[k:] = -1  # padding rows scatter out of bounds -> drop
-        sub_pre = tm(lambda x: x[jnp.asarray(sel)]
-                     if getattr(x, "ndim", 0) else x, pre)
-        pg.pool = kernel.rollback_pages(pg.pool, jnp.asarray(sub_pids),
-                                        sub_pre)
-        for j in flagged:
-            key = keys[j]
-            row = tm(lambda x: x[j] if getattr(x, "ndim", 0) else x, pre)
-            self.paged_rescues += 1
-            if self._rescue_paged(key, row, items[j][1]):
-                continue
-            self.where.pop(key, None)
-            pg.free_all(key)
-            self._forget_lane_payloads(key)
-            self.opaque.add(key)
-            self.overflow_drops += 1
+        # Span coverage (docs/observability.md): the paged rescue is the
+        # one fold/rescue-class event left on the paged path — always
+        # spanned + histogrammed so a rescue storm attributes to a stage
+        # instead of hiding inside serving.fold_rescue's tail.
+        with tracing.span("serving.paged_rescue",
+                          hist="serving.paged_rescue", flagged=k) as _sp:
+            k_pad = pow2_pages(k)
+            sel = np.asarray(flagged + [flagged[0]] * (k_pad - k),
+                             np.int64)
+            sub_pids = pids[sel].copy()
+            sub_pids[k:] = -1  # padding rows scatter OOB -> drop
+            sub_pre = tm(lambda x: x[jnp.asarray(sel)]
+                         if getattr(x, "ndim", 0) else x, pre)
+            pg.pool = kernel.rollback_pages(pg.pool,
+                                            jnp.asarray(sub_pids),
+                                            sub_pre)
+            dropped = 0
+            for j in flagged:
+                key = keys[j]
+                row = tm(lambda x: x[j] if getattr(x, "ndim", 0) else x,
+                         pre)
+                self.paged_rescues += 1
+                increment("serving.paged_rescues")
+                if self._rescue_paged(key, row, items[j][1]):
+                    continue
+                self.where.pop(key, None)
+                pg.free_all(key)
+                self._forget_lane_payloads(key)
+                self.opaque.add(key)
+                self.overflow_drops += 1
+                dropped += 1
+            if dropped:
+                _sp.set(dropped=dropped)
 
     def _rescue_paged(self, key: tuple, row: DocState, ops) -> bool:
         """_rescue_lane's contract, page-backed: fold the pre-window row
@@ -1059,6 +1100,16 @@ class MergeLaneStore:
                 jnp.asarray(mins), jnp.asarray(seqs))
             pg.pool = pool2
             c2n = np.asarray(c2)[:n]
+            # Zamboni reclamation from the host count mirrors (the pre
+            # counts) vs the compacted counts — gated with the rest of
+            # the device-stats surface so the counter means the same
+            # thing whatever path fed it (extract-path reclaim lands in
+            # device.extract.rows_reclaimed; this defrag-tick counter is
+            # disjoint from it).
+            if device_stats.enabled():
+                increment("zamboni.rows_reclaimed",
+                          int((counts[:n].astype(np.int64)
+                               - c2n.astype(np.int64)).sum()))
             pg.adopt_scalars(keys, c2n, mins[:n], seqs[:n])
             pg.release_trailing_many(keys)
             for key in keys:
@@ -1566,24 +1617,39 @@ class MergeLaneStore:
             # assembly is in flight advance change_gen past these, so the
             # cache entry written later correctly reads as stale.
             gens = {key: self.change_gen.get(key, 0) for _, key in lanes}
+            # Device telemetry (static at dispatch): the fused zamboni+
+            # extract also returns its PRE-compaction per-doc row counts
+            # so the host can report zamboni reclamation without a
+            # separate fetch of the device-resident pre state (the
+            # counts ride the assemble join's existing transfers).
+            stats_on = device_stats.enabled()
             if len(lanes) == live:
                 # Every live lane extracts: fuse over the whole bucket
                 # state and adopt the compacted result (the summarize
                 # pass IS this tick's zamboni for these lanes).
-                new_state, packed = kernel.compact_extract_batched(
-                    bucket.state)
+                with compile_ledger.track("kernel.compact_extract",
+                                          kernel.compact_extract_batched):
+                    res = kernel.compact_extract_batched(
+                        bucket.state, stats=stats_on)
+                new_state, packed = res[0], res[1]
+                pre_counts = res[2] if stats_on else None
                 bucket.state = new_state
                 jobs.append((packed, lanes, new_state.seq,
-                             new_state.min_seq, gens))
+                             new_state.min_seq, gens, pre_counts))
             else:
                 sub, _n = kernel.gather_rows_pow2(
                     bucket.state, [i for i, _ in lanes])
-                _, packed = kernel.compact_extract_batched(sub)
+                with compile_ledger.track("kernel.compact_extract",
+                                          kernel.compact_extract_batched):
+                    res = kernel.compact_extract_batched(
+                        sub, stats=stats_on)
+                packed = res[1]
+                pre_counts = res[2] if stats_on else None
                 # Lane indices become sub-batch rows.
                 jobs.append((packed,
                              [(j, key) for j, (_, key)
                               in enumerate(lanes)],
-                             sub.seq, sub.min_seq, gens))
+                             sub.seq, sub.min_seq, gens, pre_counts))
         if cached:
             increment("summarize.blob_cache.hits", len(cached))
         return jobs, cached
@@ -1625,16 +1691,29 @@ class MergeLaneStore:
             n = len(keys)
             _n_pad, pids, counts, mins, seqs = \
                 self._stage_paged_group(keys)
-            pool2, _, c2, packed = kernel.compact_extract_paged(
-                pg.pool, jnp.asarray(pids), jnp.asarray(counts),
-                jnp.asarray(mins), jnp.asarray(seqs))
+            with compile_ledger.track("kernel.compact_extract_paged",
+                                      kernel.compact_extract_paged):
+                pool2, _, c2, packed = kernel.compact_extract_paged(
+                    pg.pool, jnp.asarray(pids), jnp.asarray(counts),
+                    jnp.asarray(mins), jnp.asarray(seqs))
             pg.pool = pool2
             c2n = np.asarray(c2)[:n]
+            if device_stats.enabled():
+                # Paged zamboni reclamation needs no device plane: the
+                # host count mirrors ARE the pre counts. (Extract-path
+                # reclaim lands ONLY in device.extract.rows_reclaimed;
+                # zamboni.rows_reclaimed is the defrag tick's counter —
+                # disjoint, so the flush span can sum the pair.)
+                reclaimed = int((counts[:n].astype(np.int64)
+                                 - c2n.astype(np.int64)).sum())
+                device_stats.fold_extract(
+                    [n, int(c2n.sum()), reclaimed])
             pg.adopt_scalars(keys, c2n, mins[:n], seqs[:n])
             pg.release_trailing_many(keys)
             for key in keys:
                 pg.ops_since_compact.pop(key, None)
-            jobs.append((packed, list(enumerate(keys)), seqs, mins, gens))
+            jobs.append((packed, list(enumerate(keys)), seqs, mins, gens,
+                         None))
         if cached:
             increment("summarize.blob_cache.hits", len(cached))
         return jobs, cached
@@ -1663,13 +1742,25 @@ class MergeLaneStore:
         # protected by the _extract_guards deferred-free protocol
         table = self.payloads
         out: Dict[tuple, dict] = dict(cached or {})
-        for packed, lanes, seq_dev, min_seq_dev, gens in jobs:
+        for packed, lanes, seq_dev, min_seq_dev, gens, *tail in jobs:
+            pre_counts = tail[0] if tail else None
             t0 = time.perf_counter()
             packed = kernel.fetch_extracted(packed)
             increment("summarize.extract_ms",
                            (time.perf_counter() - t0) * 1000.0)
             seqs = np.asarray(seq_dev)
             min_seqs = np.asarray(min_seq_dev)
+            if pre_counts is not None:
+                # Zamboni reclamation from the device telemetry plane
+                # (pre-compaction counts) vs the fetched post counts —
+                # restricted to the job's REAL lanes (pow2 padding rows
+                # duplicate row 0 and must not multi-count its reclaim).
+                pre_np = np.asarray(pre_counts).astype(np.int64)
+                post_np = np.asarray(packed[-1]).astype(np.int64)
+                rows = [lane for lane, _ in lanes]
+                reclaimed = int((pre_np[rows] - post_np[rows]).sum())
+                device_stats.fold_extract(
+                    [len(lanes), int(post_np[rows].sum()), reclaimed])
             for lane, key in lanes:
                 snap = assemble_snapshot(
                     packed, table, lane,
@@ -3236,8 +3327,19 @@ class TpuSequencerLambda(IPartitionLambda):
         if self.stall_hook is not None:
             self.stall_hook()
         with tracing.span("serving.flush", parent=self._flush_parent(),
-                          root=True, hist="serving.flush"):
+                          root=True, hist="serving.flush") as _fsp:
+            # Device-measured sub-facts enrich the flush span: the
+            # deltas of the device.* telemetry counters across this
+            # flush (windows retired during it — including deferred
+            # windows from earlier flushes draining now — attribute
+            # here, mirroring the deferred-readback convention).
+            tok = device_stats.begin_flush() \
+                if device_stats.enabled() else None
             self._flush_traced()
+            if tok is not None:
+                facts = device_stats.flush_facts(tok)
+                if facts:
+                    _fsp.set(**facts)
 
     def occupancy_hints(self) -> dict:
         """Live occupancy for the admission controller (server/
@@ -4111,6 +4213,12 @@ class TpuSequencerLambda(IPartitionLambda):
                   else "serving.ring_kept_windows")
         increment("serving.window_dispatches")
 
+        # Device telemetry plane (telemetry/device_stats.py): static at
+        # dispatch, stamped on the window so _finish_window decodes the
+        # flat16 tail only when this window actually carried it.
+        stats_on = device_stats.enabled()
+        wd["stats"] = stats_on
+
         # ONE fused device program for the whole window (every extra
         # dispatch is a serialized tunnel RPC), then ONE host sync of the
         # narrow int16 result (msn32_dev is fetched only on the rare
@@ -4118,16 +4226,21 @@ class TpuSequencerLambda(IPartitionLambda):
         def dispatch(fused):
             step = serve_step.serve_window if donate \
                 else serve_step.serve_window_keep
-            return step(
-                self.tstate, self._place_cols(ticket_cols),
-                [self.merge.buckets[j["bucket"]].state
-                 for j in merge_jobs],
-                [self._place_cols(j["cols"]) for j in merge_jobs],
-                [self.lww.buckets[j["bucket"]].state for j in lww_jobs],
-                [self._place_cols(j["cols"]) for j in lww_jobs],
-                fused,
-                [None if j["runs"] is None else self._place_cols(j["runs"])
-                 for j in merge_jobs])
+            ledger_name = "serve.window" if donate else "serve.window_keep"
+            with compile_ledger.track(ledger_name, step):
+                return step(
+                    self.tstate, self._place_cols(ticket_cols),
+                    [self.merge.buckets[j["bucket"]].state
+                     for j in merge_jobs],
+                    [self._place_cols(j["cols"]) for j in merge_jobs],
+                    [self.lww.buckets[j["bucket"]].state
+                     for j in lww_jobs],
+                    [self._place_cols(j["cols"]) for j in lww_jobs],
+                    fused,
+                    [None if j["runs"] is None
+                     else self._place_cols(j["runs"])
+                     for j in merge_jobs],
+                    stats_on)
 
         with tracing.span("serving.dispatch", hist="serving.dispatch"):
             try:
@@ -4323,13 +4436,18 @@ class TpuSequencerLambda(IPartitionLambda):
                 [wd["lww_jobs"] for wd in wins], self.lww.buckets,
                 6, ((1, -1), (2, -1)))
 
+        stats_on = device_stats.enabled()
         with tracing.span("serving.dispatch", hist="serving.dispatch"):
             try:
-                (self.tstate, new_merge, new_lww, flats_dev,
-                 msns_dev) = serve_step.serve_burst(
-                    self.tstate, tuple(merge_states), tuple(lww_states),
-                    self._place_cols(tx, lane_axis=2), tuple(merge_xs),
-                    tuple(lww_xs), tuple(runs_xs), self._fused_serve)
+                with compile_ledger.track("serve.burst",
+                                          serve_step.serve_burst):
+                    (self.tstate, new_merge, new_lww, flats_dev,
+                     msns_dev) = serve_step.serve_burst(
+                        self.tstate, tuple(merge_states),
+                        tuple(lww_states),
+                        self._place_cols(tx, lane_axis=2),
+                        tuple(merge_xs), tuple(lww_xs), tuple(runs_xs),
+                        self._fused_serve, stats_on)
             except Exception as err:  # noqa: BLE001 — degrade, never crash
                 # Lowering failures leave the donated buffers intact
                 # (same contract as the per-window degrade ladder); the
@@ -4361,6 +4479,11 @@ class TpuSequencerLambda(IPartitionLambda):
             # order — placeholders for buckets it never staged.
             wd["merge_jobs"] = m_aligned[k]
             wd["lww_jobs"] = l_aligned[k]
+            wd["stats"] = stats_on
+            # The scan body runs with noop_skip: the host mirror of the
+            # device skip counter needs to know (solo windows never
+            # count skips).
+            wd["noop_skip"] = True
         for b, post in zip(m_ids, new_merge):
             self.merge.buckets[b].state = post
         for b, post in zip(l_ids, new_lww):
@@ -4515,7 +4638,12 @@ class TpuSequencerLambda(IPartitionLambda):
         plane_total = sum(j["lanes_n"] for j in merge_jobs) \
             + sum(j["lanes_n"] for j in lww_jobs)
         planes = tailbits[2 + nm + nl:2 + nm + nl + plane_total]
-        cnt_planes = tailbits[2 + nm + nl + plane_total:]
+        cnt_planes = tailbits[2 + nm + nl + plane_total:
+                              2 + nm + nl + 2 * plane_total]
+        # The device telemetry plane (present only when this window
+        # dispatched with stats): N_SERVE int32 slots as lo/hi halves.
+        stats16 = tailbits[2 + nm + nl + 2 * plane_total:] \
+            if ctx.get("stats") else None
 
         q_m = np.fromiter(self._ring_fixup, np.int64,
                           len(self._ring_fixup)) \
@@ -4598,6 +4726,14 @@ class TpuSequencerLambda(IPartitionLambda):
         if bits[0]:
             raise RuntimeError("ticket client table overflow despite "
                                "pre-flush growth — invariant violation")
+
+        if stats16 is not None:
+            s_n = device_stats.N_SERVE
+            dev_vec = u32(stats16[:s_n], stats16[s_n:2 * s_n])
+            host_vec = self._mirror_window_stats(
+                ctx, seq_bt, fl_bt, admitted, planes,
+                cnt_planes, merge_jobs, lww_jobs)
+            device_stats.fold_serve(dev_vec, host_vec)
 
         ctx["row_seq"][ctx["idx"]] = seq_bt[lanes, slot]
         ctx["row_msn"][ctx["idx"]] = msn_bt[lanes, slot]
@@ -4697,6 +4833,68 @@ class TpuSequencerLambda(IPartitionLambda):
                 self.lww._apply_window(fixup_lww)
             if recovered:
                 _frsp.set(recovered_jobs=recovered)
+
+    def _mirror_window_stats(self, ctx, seq_bt, fl_bt, admitted,
+                             planes, cnt_planes, merge_jobs, lww_jobs):
+        """The HOST-derived mirror of one window's device telemetry
+        plane (telemetry/device_stats.SERVE_SLOTS order), re-deriving
+        every countable slot from the staged op columns + the decoded
+        ticket results with exactly the admission logic the device
+        program applies (nack masking, INSERT_RUN mispredict voiding,
+        burst padding skips). device-vs-host reconciliation is then an
+        exact counter diff — the obs-smoke gate. Vectorized numpy over
+        the window's staged columns: microseconds against the device
+        program it mirrors."""
+        noop_skip = bool(ctx.get("noop_skip"))
+        kinds = np.zeros(6, np.int64)  # INSERT..INSERT_RUN
+        skips = 0
+        for job in merge_jobs:
+            c = job.get("cols")
+            if c is None:
+                if noop_skip:
+                    skips += 1  # union-bucket padding: all-NOOP plane
+                continue
+            kind = c[0]
+            ok = (kind != OpKind.NOOP) & (seq_bt[c[10], c[11]] > 0)
+            r = job.get("runs")
+            if r is not None:
+                expected = r[0] > 0
+                sub_ok = seq_bt[r[2], r[3]] > 0
+                mispredict = (kind == OpKind.INSERT_RUN) & np.any(
+                    expected & ~sub_ok, axis=-1)
+                ok &= ~mispredict
+            kk = kind[ok]
+            kinds += np.bincount(kk, minlength=7)[1:7]
+            if noop_skip and kk.size == 0:
+                skips += 1
+        lww_n = 0
+        for job in lww_jobs:
+            c = job.get("cols")
+            if c is None:
+                if noop_skip:
+                    skips += 1
+                continue
+            # LwwKind.NOOP == 0 (server/lww_kernel.py)
+            ok = (c[0] != 0) & (seq_bt[c[4], c[5]] > 0)
+            n_ok = int(ok.sum())
+            lww_n += n_ok
+            if noop_skip and n_ok == 0:
+                skips += 1
+        merge_total = sum(j["lanes_n"] for j in merge_jobs)
+        host_vec = np.array(list(kinds) + [
+            lww_n,
+            int(admitted.sum()),
+            int((fl_bt & 1).astype(bool).sum()),
+            int(((fl_bt >> 1) & 1).astype(bool).sum()),
+            int((planes[:merge_total] != 0).sum()),
+            int((planes[merge_total:] != 0).sum()),
+            skips,
+            # Lane-fill gauges: the device sums the same count planes
+            # that ride this result, so the mirror is the plane sum.
+            int(cnt_planes[:merge_total].astype(np.int64).sum()),
+            int(cnt_planes[merge_total:].astype(np.int64).sum()),
+        ], np.int64)
+        return host_vec
 
     def _build_merge(self, parsed, rows, lanes, slot,
                      mbase, chan_ok, chan_b, chan_l, flush_rows=None):
@@ -5609,6 +5807,12 @@ class TpuSequencerLambda(IPartitionLambda):
         cost is proportional to DIRTY documents, never to connecting
         clients; the caller (TpuLocalServer.refresh_catchup / an
         external publisher) joins in the protocol half and publishes."""
+        with tracing.span("catchup.refresh", root=True,
+                          hist="catchup.refresh"):
+            return self._catchup_snapshot_traced(only_docs, chunk_chars)
+
+    def _catchup_snapshot_traced(self, only_docs: Optional[set],
+                                 chunk_chars: int) -> Dict[str, dict]:
         from ..mergetree.catchup import (pack_entries_narrow,
                                          translate_entry_clients)
 

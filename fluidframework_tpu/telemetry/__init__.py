@@ -15,7 +15,9 @@ from .logger import (
     TelemetryLogger,
 )
 from .mock import MockLogger
+from . import compile_ledger
 from . import counters
+from . import device_stats
 from . import tracing
 from .counters import JitRetraceProbe, record_swallow
 
@@ -23,6 +25,6 @@ __all__ = [
     "ERROR", "GENERIC", "PERFORMANCE",
     "ChildLogger", "DebugLogger", "MultiSinkLogger",
     "OpRoundTripTelemetry", "PerformanceEvent", "TelemetryLogger",
-    "MockLogger", "JitRetraceProbe", "counters", "record_swallow",
-    "tracing",
+    "MockLogger", "JitRetraceProbe", "compile_ledger", "counters",
+    "device_stats", "record_swallow", "tracing",
 ]

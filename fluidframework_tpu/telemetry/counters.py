@@ -15,15 +15,62 @@ server — can import it without cycles.
 from __future__ import annotations
 
 import math
+import os
 import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 _lock = threading.Lock()
 _counters: Dict[str, float] = {}
 
+# -- cardinality guard -------------------------------------------------------
+# /metrics.prom renders every counter name as a sample line, so a name
+# minted per tenant/shard/document grows the exposition text
+# monotonically under churn (the UNBOUNDED_QUEUE class, for metrics).
+# Two bounds: `bounded()` caps each declared dynamic family at
+# FAMILY_CAP distinct labels (overflow collapses into one
+# `<family>.__other__` bucket), and a global name cap backstops any
+# site that mints names directly — past it, new names collapse into
+# their two-segment family's overflow bucket. Every collapse counts in
+# telemetry.metrics_dropped so the condition is visible, not silent.
+
+FAMILY_CAP = int(os.environ.get("FLUID_METRIC_FAMILY_CAP", "64"))
+MAX_COUNTER_NAMES = int(os.environ.get("FLUID_METRIC_NAME_CAP", "4096"))
+OVERFLOW_LABEL = "__other__"
+_families: Dict[str, set] = {}
+
+
+def _guarded_name(name: str) -> str:
+    """Global-cap backstop; call with _lock held."""
+    if name in _counters or name == "telemetry.metrics_dropped" \
+            or len(_counters) < MAX_COUNTER_NAMES:
+        return name
+    _counters["telemetry.metrics_dropped"] = \
+        _counters.get("telemetry.metrics_dropped", 0.0) + 1.0
+    family = ".".join(name.split(".")[:2])
+    return f"{family}.{OVERFLOW_LABEL}"
+
+
+def bounded(family: str, label) -> str:
+    """The bounded name for a dynamic-label counter family: the first
+    FAMILY_CAP distinct labels get their own `<family>.<label>` name;
+    later labels share `<family>.__other__` (and count a drop). Use for
+    any per-tenant / per-shard / per-document metric."""
+    label = str(label)
+    with _lock:
+        seen = _families.setdefault(family, set())
+        if label in seen:
+            return f"{family}.{label}"
+        if len(seen) < FAMILY_CAP:
+            seen.add(label)
+            return f"{family}.{label}"
+        _counters["telemetry.metrics_dropped"] = \
+            _counters.get("telemetry.metrics_dropped", 0.0) + 1.0
+    return f"{family}.{OVERFLOW_LABEL}"
+
 
 def increment(name: str, by: float = 1.0) -> float:
     with _lock:
+        name = _guarded_name(name)
         _counters[name] = value = _counters.get(name, 0.0) + by
         return value
 
@@ -32,7 +79,7 @@ def gauge(name: str, value: float) -> None:
     """Set an absolute reading (probe outputs like decay_probe's
     per-wave rate — the LAST observation is the signal, not a sum)."""
     with _lock:
-        _counters[name] = float(value)
+        _counters[_guarded_name(name)] = float(value)
 
 
 def get(name: str) -> float:
@@ -162,6 +209,7 @@ def reset() -> None:
     with _lock:
         _counters.clear()
         _hists.clear()
+        _families.clear()
 
 
 def record_swallow(site: str) -> None:
@@ -186,6 +234,12 @@ class JitRetraceProbe:
     misread as retraces. Growth caused by a concurrent other-caller
     compile during one of our calls is still attributed here — the
     counter is an operational rate signal, not an exact ledger.
+
+    Every call also feeds the process-wide compile ledger
+    (telemetry/compile_ledger.py) with the call's wall time and the
+    observed cache growth — warm-vs-cold attribution and cumulative
+    compile ms per symbol ride /health, /metrics.prom, and bench
+    records from there.
     """
 
     def __init__(self, fn: Callable, name: str):
@@ -208,11 +262,18 @@ class JitRetraceProbe:
             return -1
 
     def __call__(self, *args, **kwargs):
+        import time as _time
+
+        from . import compile_ledger as _ledger  # lazy: avoids a cycle
+
         with self._probe_lock:
             if self._last is None:  # lazy baseline: first probed call
                 self._last = self._cache_size()
+        t0 = _time.perf_counter()
         out = self._fn(*args, **kwargs)
+        dur_ms = (_time.perf_counter() - t0) * 1000.0
         size = self._cache_size()
+        grew = 0
         with self._probe_lock:
             if size >= 0 and self._last >= 0 and size > self._last:
                 grew = size - self._last
@@ -223,6 +284,8 @@ class JitRetraceProbe:
                 self._seen_compile = True
             if size >= 0:
                 self._last = size
+        _ledger.ledger.watch(self.name, self._fn)
+        _ledger.ledger.note_call(self.name, dur_ms, grew=grew)
         return out
 
     def __getattr__(self, item):
