@@ -3,7 +3,8 @@
 
 .PHONY: test soak bench dryrun record-corpus historian-smoke \
 	summarize-smoke trace-smoke pipeline-smoke fused-smoke \
-	paged-smoke catchup-smoke obs-smoke bench-trend lint-analysis \
+	paged-smoke catchup-smoke obs-smoke ingest-smoke bench-trend \
+	lint-analysis \
 	lint-changed lint-races layer-check check
 
 test:
@@ -134,13 +135,25 @@ bench-trend:
 overload-smoke:
 	JAX_PLATFORMS=cpu python bench.py overload-smoke
 
+# Open-loop load generator over the sharded multi-partition ingest tier
+# (docs/ingest_sharding.md): 4 logical partitions must compose — the
+# per-partition busy-time service rates must sum to >= 2.5x the paired
+# single-partition run (the artifact that lets per-process ops/s compose
+# toward the ROADMAP's 1M/s story) — with every document's emit stream
+# ORDER-identical to the single-partition path, partition queues bounded
+# under 2x open-loop overload, sibling partitions unstarved when one
+# partition runs hot, and latency percentiles + per-partition goodput
+# stamped into BENCH_INGEST_LAST.json.
+ingest-smoke:
+	JAX_PLATFORMS=cpu python bench.py ingest-smoke
+
 # The pre-merge gate: layering/cycles + static analysis (incl. the
 # focused race gate) + the summarize/trace/pipeline/fused/paged/catchup/
-# overload/obs smokes + the bench trend (report-only here) + the full
-# test suite.
+# overload/obs/ingest smokes + the bench trend (report-only here) + the
+# full test suite.
 check: layer-check lint-analysis lint-races summarize-smoke trace-smoke \
 		pipeline-smoke fused-smoke paged-smoke catchup-smoke \
-		overload-smoke obs-smoke test
+		overload-smoke obs-smoke ingest-smoke test
 	python bench.py trend --report-only
 
 # The round-end randomized-evidence ritual: 50-trial soaks over every

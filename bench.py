@@ -2928,6 +2928,377 @@ def overload_smoke() -> int:
     return 0 if all(checks.values()) else 1
 
 
+def ingest_smoke() -> int:
+    """Open-loop load-generator harness for the sharded ingest tier
+    (`make ingest-smoke`, docs/ingest_sharding.md). Three graded
+    sections, one JSON record (BENCH_INGEST_LAST.json):
+
+    1. COMPOSITION (the 1M/s artifact): the same contended fleet +
+       deterministic op schedule runs through a 1-partition and a
+       4-partition LocalServer. Each partition's service rate is
+       ops drained / busy wall-clock spent inside ITS pump — the figure
+       that composes when each partition worker owns a core, which is
+       the deployment shape (this container has ONE core, so the
+       workers interleave; the gate therefore grades PARTITIONING
+       EFFICIENCY — per-partition sequencing at fleet/4 scale must not
+       lose the single-partition rate — not host parallelism, which a
+       1-core host cannot exhibit). Gate: aggregate >= 2.5x the paired
+       single-partition run.
+    2. ORDER: every document's emit stream (type, writer, clientSeq,
+       seq, msn) from the 4-partition run must be IDENTICAL, in order,
+       to the single-partition run's — sharding may never reorder a
+       document.
+    3. OVERLOAD (open loop, virtual clock — wall time never enters a
+       graded figure): a fixed-rate arrival schedule at 2x the drain
+       budget must leave every partition queue bounded (per-partition
+       soft limit + global hard limit) with latency percentiles for
+       admitted ops stamped; then a hot-partition schedule (one
+       partition offered 4x its budget, siblings underloaded) must
+       throttle ONLY the hot partition — sibling shed rate ~0 with the
+       global ladder still in ACCEPT.
+
+    Exit 0 iff every check passes."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import json as _json
+
+    from fluidframework_tpu.protocol.messages import (DocumentMessage,
+                                                      MessageType)
+    from fluidframework_tpu.server.admission import (ACCEPT,
+                                                     AdmissionController,
+                                                     THROTTLE)
+    from fluidframework_tpu.server.local_server import LocalServer
+    from fluidframework_tpu.server.routing import doc_shard
+    from fluidframework_tpu.telemetry import counters as _counters
+
+    _counters.reset()
+    n_parts = 4
+    n_docs = 48
+    writers = 2
+    ops_per_batch = 8
+    warm_waves, measured_waves = 3, 8
+    doc_ids = [f"ingest-doc-{i}" for i in range(n_docs)]
+
+    # ---- sections 1+2: paired composition run + order identity ----------
+    def run_fleet(partitions):
+        # Checkpoint batching pushed past the measured region (the scalar
+        # deli otherwise dumps EVERY doc state per message — an O(docs^2)
+        # term that shrinks superlinearly under sharding and would
+        # flatter the scaling figure); admission off so the paired runs
+        # measure pure sequencing. The sharded run still exercises the
+        # tier's batched-ack path (auto_commit off => AckBatcher).
+        config = {"deli.checkpointBatchSize": 1_000_000,
+                  "admission.enabled": False}
+        server = LocalServer(auto_pump=False, partitions=partitions,
+                             config=config)
+        tier = server.ingest
+        streams = {d: [] for d in doc_ids}
+        conns = {}
+        widx = {}
+        for d in doc_ids:
+            conns[d] = []
+            for w in range(writers):
+                c = server.connect(d)
+                widx[c.client_id] = w
+                conns[d].append(c)
+            conns[d][0].on("op", lambda m, d=d: streams[d].append((
+                str(m.type), widx.get(m.client_id, -1),
+                m.client_sequence_number, m.sequence_number,
+                m.minimum_sequence_number)))
+        last_seq = {d: 0 for d in doc_ids}
+        for d in doc_ids:
+            conns[d][0].on("op", lambda m, d=d:
+                           last_seq.__setitem__(d, m.sequence_number))
+
+        def drain(timed):
+            # Deli drains through the tier (per-partition busy-time
+            # accounting); downstream stages pump untimed — their cost
+            # is not the sequencing figure. Progress-based loop: with
+            # batched checkpoints the committed offsets (and so
+            # raw_backlog) lag the pump cursor by design.
+            while True:
+                if timed:
+                    n = tier.pump_round()
+                else:
+                    n = sum(tier.manager.pumps[p].pump()
+                            for p in sorted(tier.manager.pumps))
+                    tier.flush_acks()
+                for mgr in (server._broadcaster_mgr,
+                            server._scriptorium_mgr,
+                            server._copier_mgr, server._scribe_mgr):
+                    mgr.pump_all()
+                if n == 0:
+                    break
+
+        csn = {(d, w): 0 for d in doc_ids for w in range(writers)}
+
+        def wave(timed):
+            for d in doc_ids:
+                for w in range(writers):
+                    msgs = []
+                    for _ in range(ops_per_batch):
+                        csn[(d, w)] += 1
+                        msgs.append(DocumentMessage(
+                            client_sequence_number=csn[(d, w)],
+                            reference_sequence_number=last_seq[d],
+                            type=MessageType.OPERATION,
+                            contents={"n": csn[(d, w)], "w": w}))
+                    conns[d][w].submit(msgs)
+            drain(timed)
+
+        drain(timed=False)  # settle the joins outside the measured region
+        for _ in range(warm_waves):
+            wave(timed=False)
+        ops_by_part = {p: 0 for p in range(partitions)}
+        for d in doc_ids:
+            ops_by_part[doc_shard(d, partitions)] += \
+                measured_waves * writers * ops_per_batch
+        # Median of 3 measured rounds after one discarded warm round
+        # (the repo's paired-measurement convention): the first round
+        # consistently pays allocator/cache warm-up, and a single
+        # scheduler pause landing inside one partition's small busy
+        # window would otherwise swing the aggregate by 2-3x on a
+        # loaded CI host.
+        rounds = []
+        for round_i in range(4):
+            stats0 = {p: (st.records, st.busy_s)
+                      for p, st in tier.stats.items()}
+            t0 = time.perf_counter()
+            for _ in range(measured_waves):
+                wave(timed=True)
+            wall_s = time.perf_counter() - t0
+            per_part = []
+            aggregate = 0.0
+            for p in sorted(tier.stats):
+                busy = tier.stats[p].busy_s - stats0[p][1]
+                ops = ops_by_part.get(p, 0)
+                rate = ops / busy if busy > 0 and ops else 0.0
+                aggregate += rate
+                per_part.append({"partition": p, "ops": ops,
+                                 "records": tier.stats[p].records
+                                 - stats0[p][0],
+                                 "busy_s": round(busy, 6),
+                                 "ops_per_sec": round(rate, 1)})
+            if round_i == 0:
+                continue  # discarded warm round
+            rounds.append({"aggregate": aggregate, "per_part": per_part,
+                           "wall_s": wall_s})
+        rounds.sort(key=lambda r: r["aggregate"])
+        mid = rounds[len(rounds) // 2]
+        return {"server": server, "streams": streams,
+                "per_partition": mid["per_part"],
+                "aggregate_ops_per_sec": round(mid["aggregate"], 1),
+                "round_aggregates": [round(r["aggregate"], 1)
+                                     for r in rounds],
+                "measured_ops_per_round": sum(ops_by_part.values()),
+                "wall_s": round(mid["wall_s"], 4),
+                "wall_ops_per_sec": round(
+                    sum(ops_by_part.values()) / mid["wall_s"], 1)}
+
+    single = run_fleet(1)
+    sharded = run_fleet(n_parts)
+    scaling = (sharded["aggregate_ops_per_sec"]
+               / max(1e-9, single["aggregate_ops_per_sec"]))
+    order_identical = all(
+        single["streams"][d] == sharded["streams"][d] for d in doc_ids)
+    mismatched = [d for d in doc_ids
+                  if single["streams"][d] != sharded["streams"][d]]
+    del single["server"], sharded["server"]
+    del single["streams"], sharded["streams"]
+
+    # ---- section 3: open-loop overload on the sharded tier ---------------
+    tick_s = 0.02
+    budget_p = 64                # drain budget per partition per tick
+
+    def overload_run(queue_limit, partition_limit, offered_per_part,
+                     ticks, settle_ticks):
+        """Fixed-rate open-loop schedule: offered_per_part[p] submissions
+        per tick arrive at evenly spaced VIRTUAL times whether or not the
+        server keeps up; drain is budgeted per partition per tick.
+        Returns queue peaks, shed counts, and admitted-op latency
+        percentiles over the post-settle steady window."""
+        vnow = {"t": 0.0}
+        adm = AdmissionController(queue_limit=queue_limit,
+                                  partition_limit=partition_limit,
+                                  recover_after_s=0.5,
+                                  interval_s=tick_s / 2,
+                                  clock=lambda: vnow["t"])
+        server = LocalServer(auto_pump=False, partitions=n_parts,
+                             admission=adm)
+        tier = server.ingest
+        # One writer per doc, 4 docs per partition, homes verified.
+        docs_by_part = {p: [] for p in range(n_parts)}
+        for i in range(1000):
+            d = f"ov-doc-{i}"
+            p = doc_shard(d, n_parts)
+            if len(docs_by_part[p]) < 4:
+                docs_by_part[p].append(d)
+            if all(len(v) == 4 for v in docs_by_part.values()):
+                break
+        conns = {}
+        submit_vt = {}
+        flushed = []            # (partition, submit_vt, flush_vt)
+        sheds = {p: 0 for p in range(n_parts)}
+        csn = {}
+        last_seq = {}
+        for p, docs in docs_by_part.items():
+            for d in docs:
+                c = server.connect(d)
+                conns[d] = c
+                csn[d] = 0
+                last_seq[d] = 0
+
+                def on_op(m, d=d, p=p):
+                    last_seq[d] = m.sequence_number
+                    t0 = submit_vt.pop((d, m.client_sequence_number),
+                                       None)
+                    if t0 is not None:
+                        flushed.append((p, t0, vnow["t"]))
+
+                def on_nack(n, d=d, p=p):
+                    sheds[p] += 1
+                    if n.operation is not None:
+                        submit_vt.pop(
+                            (d, n.operation.client_sequence_number), None)
+
+                c.on("op", on_op)
+                c.on("nack", on_nack)
+        server.pump()  # settle joins
+        peak_part = {p: 0 for p in range(n_parts)}
+        peak_global = {"n": 0}
+        states = set()
+        t_settled = settle_ticks * tick_s
+
+        def run_tick():
+            start = vnow["t"]
+            offered_total = sum(offered_per_part.values())
+            sent = 0
+            # Interleave arrivals and budgeted drain in sub-slots, like
+            # the overload smoke: continuous service, not tick-edge
+            # bursts that alias the capacity estimator.
+            for s in range(4):
+                for p, docs in docs_by_part.items():
+                    n = (offered_per_part[p] * (s + 1)) // 4 \
+                        - (offered_per_part[p] * s) // 4
+                    for i in range(n):
+                        vnow["t"] = start + tick_s * (sent / max(
+                            1, offered_total))
+                        sent += 1
+                        d = docs[i % len(docs)]
+                        csn[d] += 1
+                        submit_vt[(d, csn[d])] = vnow["t"]
+                        try:
+                            conns[d].submit([DocumentMessage(
+                                client_sequence_number=csn[d],
+                                reference_sequence_number=last_seq[d],
+                                type=MessageType.OPERATION,
+                                contents={"n": csn[d]})])
+                        except ConnectionError:
+                            pass
+                backlogs = tier.raw_backlog_by_partition()
+                for p, b in backlogs.items():
+                    peak_part[p] = max(peak_part[p], b)
+                peak_global["n"] = max(peak_global["n"],
+                                       sum(backlogs.values()))
+                for p in sorted(tier.manager.pumps):
+                    tier.pump_partition(p, (budget_p * (s + 1)) // 4
+                                        - (budget_p * s) // 4)
+                tier.flush_acks()
+                for mgr in (server._broadcaster_mgr,
+                            server._scriptorium_mgr,
+                            server._copier_mgr, server._scribe_mgr):
+                    mgr.pump_all()
+            vnow["t"] = start + tick_s
+            adm.observe(force=True)
+            states.add(adm.state)
+
+        for _ in range(ticks):
+            run_tick()
+        steady = sorted((f[2] - f[1]) * 1000.0 for f in flushed
+                        if f[1] >= t_settled)
+        out = {
+            "ticks": ticks,
+            "offered_per_tick": sum(offered_per_part.values()),
+            "drain_budget_per_tick": budget_p * n_parts,
+            "flushed": len(flushed),
+            "shed_by_partition": dict(sheds),
+            "peak_backlog_by_partition": dict(peak_part),
+            "peak_backlog_global": peak_global["n"],
+            "partition_limit": adm.partition_limit(),
+            "queue_limit": queue_limit,
+            "states": sorted(states),
+            "goodput_by_partition": {
+                p: round(sum(1 for f in flushed if f[0] == p)
+                         / (ticks * tick_s), 1)
+                for p in range(n_parts)},
+        }
+        if steady:
+            out["steady_p50_ms"] = round(
+                _counters.nearest_rank(steady, 0.50), 3)
+            out["steady_p99_ms"] = round(
+                _counters.nearest_rank(steady, 0.99), 3)
+        return out
+
+    # Uniform 2x overload: every partition offered twice its budget.
+    uniform = overload_run(
+        queue_limit=1024, partition_limit=None,
+        offered_per_part={p: 2 * budget_p for p in range(n_parts)},
+        ticks=80, settle_ticks=15)
+    # Hot partition: p_hot offered 4x its budget, siblings at 40% —
+    # fairness means ONLY the hot partition throttles.
+    hot = 0
+    fairness = overload_run(
+        queue_limit=4096, partition_limit=192,
+        offered_per_part={p: (4 * budget_p if p == hot
+                              else (2 * budget_p) // 5)
+                          for p in range(n_parts)},
+        ticks=60, settle_ticks=10)
+    sib_offered = sum(v for p, v in {
+        p: (4 * budget_p if p == hot else (2 * budget_p) // 5)
+        for p in range(n_parts)}.items() if p != hot) * 60
+    sib_shed = sum(v for p, v in fairness["shed_by_partition"].items()
+                   if p != hot)
+
+    checks = {
+        "aggregate_scaling_2_5x": scaling >= 2.5,
+        "order_identical": order_identical,
+        "partition_queues_bounded": (
+            max(uniform["peak_backlog_by_partition"].values())
+            <= uniform["partition_limit"]
+            and uniform["peak_backlog_global"] <= uniform["queue_limit"]
+            and max(fairness["peak_backlog_by_partition"].values())
+            <= fairness["partition_limit"]),
+        "overload_latency_stamped": "steady_p99_ms" in uniform,
+        "fairness_hot_partition_only": (
+            fairness["shed_by_partition"][hot] > 0
+            and sib_shed / max(1, sib_offered) <= 0.01
+            and all(s in (ACCEPT, THROTTLE)
+                    for s in fairness["states"])),
+    }
+    record = {
+        "metric": "ingest-smoke",
+        "backend": "cpu",
+        "comparable": False,
+        "partitions": n_parts,
+        "fleet": {"docs": n_docs, "writers_per_doc": writers,
+                  "ops_per_batch": ops_per_batch,
+                  "measured_waves": measured_waves},
+        "single_partition": single,
+        "sharded": sharded,
+        "aggregate_ops_per_sec": sharded["aggregate_ops_per_sec"],
+        "aggregate_scaling": round(scaling, 3),
+        "order_mismatched_docs": mismatched,
+        "overload_2x": uniform,
+        "fairness_hot": fairness,
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    _write_json_atomic(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_INGEST_LAST.json"), record)
+    print(_json.dumps(record))
+    return 0 if all(checks.values()) else 1
+
+
 def obs_smoke() -> int:
     """CPU smoke for the device-resident telemetry planes + compile
     observatory (`make obs-smoke`, docs/observability.md v2). Drives
@@ -3282,6 +3653,8 @@ if __name__ == "__main__":
         sys.exit(catchup_smoke())
     if len(sys.argv) > 1 and sys.argv[1] == "obs-smoke":
         sys.exit(obs_smoke())
+    if len(sys.argv) > 1 and sys.argv[1] == "ingest-smoke":
+        sys.exit(ingest_smoke())
     if len(sys.argv) > 1 and sys.argv[1] == "trend":
         sys.exit(bench_trend(strict="--report-only" not in sys.argv))
     try:

@@ -155,6 +155,23 @@ class NativeMessageLog:
             fn(msg)
         return msg
 
+    def send_to(self, topic: str, partition: int, key: str,
+                value: Any) -> QueuedMessage:
+        """Produce to an EXPLICIT partition (MessageLog.send_to parity):
+        the sharded ingest tier routes documents itself (server/
+        routing.py md5 scheme) and must bypass the engine's key hash."""
+        view = self.topic(topic)
+        del view  # ensure the topic exists engine-side
+        kb = key.encode()
+        vb = pickle.dumps(value)
+        offset = self._lib.oplog_append(self._h, topic.encode(),
+                                        int(partition), kb, len(kb),
+                                        vb, len(vb))
+        msg = QueuedMessage(topic, int(partition), offset, key, value)
+        for fn in list(self._listeners.get((topic, int(partition)), [])):
+            fn(msg)
+        return msg
+
     # -- consumer ----------------------------------------------------------
     def poll(self, group: str, topic: str, partition: int = 0,
              limit: int = 1000) -> List[QueuedMessage]:
@@ -196,6 +213,14 @@ class NativeMessageLog:
                offset: int) -> None:
         self._lib.oplog_commit(self._h, group.encode(), topic.encode(),
                                partition, offset)
+
+    def commit_many(self, group: str, topic: str,
+                    offsets: Dict[int, int]) -> None:
+        """Batched cross-partition ack (MessageLog.commit_many parity).
+        The engine's commit is already monotonic per partition; batching
+        here saves the per-call Python/ctypes overhead, not a lock."""
+        for partition, offset in offsets.items():
+            self.commit(group, topic, partition, offset)
 
     def committed(self, group: str, topic: str, partition: int) -> int:
         return self._lib.oplog_committed(self._h, group.encode(),

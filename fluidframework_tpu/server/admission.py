@@ -39,6 +39,17 @@ The controller is deliberately deterministic and clock-injectable: the
 fault-injection harness (testing/faultinject.py SkewedClock) and the
 admission unit tests drive it with scripted signals and virtual time.
 
+Sharded ingest (server/sharding.py) adds a PARTITION channel: the tier
+registers one occupancy source per partition (`add_partition_source`)
+and submits carry the document's home partition. Those feeds never join
+the global queue depth — the aggregate core source already counts every
+partition's backlog, and double-counting would re-introduce the PR 6
+phantom-drain inflation N-fold. Instead they drive a per-partition soft
+bound (default 2x the fair share of the queue limit): a submit to a HOT
+partition throttles (429 + retry_after) even while the global ladder
+sits in ACCEPT, so one storming partition cannot queue unboundedly nor
+starve its siblings' admission (docs/ingest_sharding.md).
+
 Config keys (nconf slice, all optional):
   admission.enabled      (default true)
   admission.queueLimit   hard backlog bound in queued units — broker
@@ -49,6 +60,8 @@ Config keys (nconf slice, all optional):
   admission.recoverAfterS  calm seconds per de-escalation step (0.5)
   admission.sloStage     latency histogram feeding the pressure term
                          (default serving.flush)
+  admission.partitionLimit  per-partition soft record bound (default
+                         2x queueLimit / registered partitions)
 
 See docs/overload.md for the full state machine and credit accounting.
 """
@@ -59,8 +72,9 @@ import threading
 import time
 from typing import Callable, Dict, List, NamedTuple, Optional
 
-from ..telemetry.counters import (gauge, increment, latency_window,
-                                  nearest_rank, observe, record_swallow)
+from ..telemetry.counters import (bounded, gauge, increment,
+                                  latency_window, nearest_rank, observe,
+                                  record_swallow)
 
 # -- states (ordered ladder) -------------------------------------------------
 ACCEPT = "accept"
@@ -131,7 +145,9 @@ class AdmissionController:
                  slo_ratio: float = 2.0,
                  slo_min_samples: int = 64,
                  clock: Callable[[], float] = time.monotonic,
+                 partition_limit: Optional[int] = None,
                  config=None):
+        self._partition_limit_cfg = partition_limit
         if config is not None:
             queue_limit = int(config.get("admission.queueLimit",
                                          queue_limit))
@@ -143,6 +159,8 @@ class AdmissionController:
             recover_after_s = float(config.get("admission.recoverAfterS",
                                                recover_after_s))
             slo_stage = config.get("admission.sloStage", slo_stage)
+            self._partition_limit_cfg = config.get(
+                "admission.partitionLimit", self._partition_limit_cfg)
         self.queue_limit = int(queue_limit)
         self.throttle_at = float(throttle_at)
         self.shed_at = float(shed_at)
@@ -158,6 +176,12 @@ class AdmissionController:
         self._state = ACCEPT
         self._forced: Optional[str] = None
         self._sources: Dict[str, dict] = {}
+        # Per-partition fairness channel (sharded ingest): occupancy
+        # feeds keyed by partition index, and the cached per-partition
+        # depths (polled on the observe cadence, bumped optimistically
+        # between polls exactly like the global cache).
+        self._partition_sources: Dict[int, dict] = {}
+        self._partition_depth: Dict[int, int] = {}
         self._tenants: Dict[str, _TenantBucket] = {}
         self._degrade_enter: List[Callable[[], None]] = []
         self._degrade_exit: List[Callable[[], None]] = []
@@ -194,6 +218,57 @@ class AdmissionController:
     def remove_source(self, name: str) -> None:
         with self._lock:
             self._sources.pop(name, None)
+
+    def add_partition_source(self, partition: int,
+                             queue_depth: Optional[Callable[[], int]] = None,
+                             hints: Optional[Callable[[], dict]] = None,
+                             scope: Optional[str] = None) -> None:
+        """Register one ingest partition's occupancy feed for the
+        FAIRNESS channel (module docstring): `queue_depth` returns the
+        partition's raw-record backlog, `hints` the owning sequencer's
+        occupancy dict (staged ops count toward the partition's depth).
+        Deliberately NOT summed into the global queue depth — the
+        aggregate source already counts it (double-count audit,
+        docs/ingest_sharding.md).
+
+        `scope` namespaces the channel on a SHARED controller: alfred
+        runs one controller across every tenant core, and each core's
+        tier registers its partitions under its tenant id — without the
+        scope, core B's feeds would silently replace core A's. A
+        scope-less registration (single-core deployments, direct
+        controller tests) matches any tenant."""
+        with self._lock:
+            key = (scope, int(partition))
+            self._partition_sources[key] = {
+                "queue_depth": queue_depth, "hints": hints}
+            self._partition_depth.setdefault(key, 0)
+
+    def _partition_key(self, tenant: str,
+                       partition: int) -> Optional[tuple]:
+        """The registered feed a (tenant, partition) admit maps to:
+        tenant-scoped first, then the scope-less fallback."""
+        if (tenant, partition) in self._partition_sources:
+            return (tenant, partition)
+        if (None, partition) in self._partition_sources:
+            return (None, partition)
+        return None
+
+    def partition_limit(self, scope: Optional[str] = None) -> int:
+        """The per-partition soft record bound: configured, or 2x the
+        fair share of the hard queue limit over the scope's partition
+        count — enough headroom for benign skew, far below the point
+        one partition could exhaust the global budget."""
+        if self._partition_limit_cfg is not None:
+            return int(self._partition_limit_cfg)
+        n = sum(1 for (s, _p) in self._partition_sources if s == scope)
+        if n == 0:
+            # No feeds under this scope (introspection with the default
+            # scope on a tenant-scoped controller): fall back to the
+            # distinct partition indices across every scope.
+            n = len({p for (_s, p) in self._partition_sources})
+        n = max(1, n)
+        return max(1, min(self.queue_limit,
+                          (2 * self.queue_limit) // n))
 
     def add_degrade_hooks(self, enter: Callable[[], None],
                           exit: Callable[[], None]) -> None:
@@ -248,6 +323,18 @@ class AdmissionController:
         self._ring_frac = ring_frac
         if self._queue_depth > self.peak_queue_depth:
             self.peak_queue_depth = self._queue_depth
+        # Fairness channel: refresh each partition's cached depth (raw
+        # records + the owning sequencer's staged ops). Kept OUT of the
+        # global depth above — see add_partition_source.
+        for key, src in list(self._partition_sources.items()):
+            try:
+                d = int(src["queue_depth"]()) \
+                    if src["queue_depth"] is not None else 0
+                if src["hints"] is not None:
+                    d += int((src["hints"]() or {}).get("staged_ops", 0))
+                self._partition_depth[key] = d
+            except Exception:  # noqa: BLE001 — a probe must not block ingest
+                record_swallow("admission.partition_source")
 
     def _latency_pressure(self) -> float:
         window = latency_window(self.slo_stage)
@@ -340,6 +427,12 @@ class AdmissionController:
             gauge("admission.level", STATE_LEVEL[self._state])
             gauge("admission.queue_depth", self._queue_depth)
             gauge("admission.peak_queue_depth", self.peak_queue_depth)
+            for (scope, p) in sorted(
+                    self._partition_sources,
+                    key=lambda k: (k[0] or "", k[1])):
+                label = f"p{p}" if scope is None else f"{scope}.p{p}"
+                gauge(bounded("admission.partition_depth", label),
+                      self._partition_depth.get((scope, p), 0))
 
     # -- the ladder ---------------------------------------------------------
     def _target_level(self) -> int:
@@ -449,6 +542,7 @@ class AdmissionController:
     # -- the decision -------------------------------------------------------
     def admit(self, tenant: str = "local", kind: str = CLASS_OP,
               count: int = 1, records: Optional[int] = None,
+              partition: Optional[int] = None,
               trace_id: Optional[str] = None) -> Decision:
         """One admission decision for `count` ops of class `kind` from
         `tenant`, arriving as `records` broker records (a multi-op
@@ -457,8 +551,9 @@ class AdmissionController:
         the hard bound, credits, and the drain estimator all account in
         records so the cached depth stays calibrated against the polled
         backlog; the admission.* counters keep op units for
-        observability. Thread-safe; O(1) beyond the rate-limited
-        observe."""
+        observability. `partition` (sharded ingest) additionally applies
+        the per-partition fairness bound. Thread-safe; O(1) beyond the
+        rate-limited observe."""
         recs = count if records is None else records
         self.observe()
         with self._lock:
@@ -486,8 +581,31 @@ class AdmissionController:
                 self._note_reject(retry, trace_id)
                 return Decision(False, state if state != ACCEPT else SHED,
                                 retry, "queue full")
+            # Per-partition fairness bound (sharded ingest): a HOT
+            # partition's documents throttle — 429 + retry_after, the
+            # ladder's THROTTLE contract — while the GLOBAL state stays
+            # wherever pressure puts it, so siblings keep their
+            # admission untouched. Records-unit accounting, same
+            # optimistic-bump/re-poll discipline as the hard bound.
+            pkey = self._partition_key(tenant, partition) \
+                if partition is not None else None
+            if kind != CLASS_SIGNAL and pkey is not None:
+                limit = self.partition_limit(pkey[0])
+                if self._partition_depth.get(pkey, 0) + recs > limit:
+                    self.observe(force=True)
+                if self._partition_depth.get(pkey, 0) + recs > limit:
+                    increment("admission.rejected.partition_hot", count)
+                    # Bounded family (PR 12 cardinality guard): per-
+                    # partition labels are few, but the guard is the
+                    # contract for any dynamic-label family.
+                    increment(bounded("admission.partition_hot",
+                                      f"p{partition}"), count)
+                    retry = self._retry_after(recs, now)
+                    self._note_reject(retry, trace_id)
+                    return Decision(False, THROTTLE, retry,
+                                    f"partition {partition} hot")
             if state == ACCEPT:
-                return self._admitted(kind, count, recs)
+                return self._admitted(kind, count, recs, pkey)
             if state == DEGRADE:
                 if kind == CLASS_SIGNAL:
                     increment("admission.shed_signals", count)
@@ -510,7 +628,7 @@ class AdmissionController:
                 allowance = self.queue_limit * (0.75 if state == THROTTLE
                                                 else 0.5)
                 if self._queue_depth + recs <= allowance:
-                    return self._admitted(kind, count, recs)
+                    return self._admitted(kind, count, recs, pkey)
                 increment(f"admission.rejected.{state}", count)
                 self._credit_reject(recs)
                 retry = self._retry_after(recs, now)
@@ -518,14 +636,16 @@ class AdmissionController:
                 return Decision(False, state, retry, "no headroom")
             if bucket.tokens >= recs:
                 bucket.tokens -= recs
-                return self._admitted(kind, count, recs)
+                return self._admitted(kind, count, recs, pkey)
             increment(f"admission.rejected.{state}", count)
             self._credit_reject(recs)
             retry = self._retry_after(recs - bucket.tokens, now)
             self._note_reject(retry, trace_id)
             return Decision(False, state, retry, "over credit share")
 
-    def retract(self, count: int = 1, records: Optional[int] = None) -> None:
+    def retract(self, count: int = 1, records: Optional[int] = None,
+                partition: Optional[int] = None,
+                tenant: str = "local") -> None:
         """Undo an `admit` whose batch never reached the queue (a LATER
         gate — e.g. the per-document token bucket — nacked it). Without
         this the phantom records read as drained at the next observe,
@@ -537,6 +657,11 @@ class AdmissionController:
         with self._lock:
             self._queue_depth = max(0, self._queue_depth - recs)
             self._admitted_since -= recs
+            pkey = self._partition_key(tenant, partition) \
+                if partition is not None else None
+            if pkey is not None:
+                self._partition_depth[pkey] = max(
+                    0, self._partition_depth.get(pkey, 0) - recs)
             increment("admission.retracted", count)
 
     def _credit_reject(self, count: int) -> None:
@@ -549,7 +674,8 @@ class AdmissionController:
             self._calm_since = None
 
     def _admitted(self, kind: str, count: int,
-                  records: Optional[int] = None) -> Decision:
+                  records: Optional[int] = None,
+                  pkey: Optional[tuple] = None) -> Decision:
         increment("admission.admitted", count)
         if kind != CLASS_SIGNAL:
             # Signals never enter the sequencer queue. Depth is bumped
@@ -557,6 +683,11 @@ class AdmissionController:
             recs = count if records is None else records
             self._admitted_since += recs
             self._queue_depth += recs
+            if pkey is not None:
+                # Optimistic per-partition bump, replaced by the next
+                # poll (same discipline as the global cache).
+                self._partition_depth[pkey] = \
+                    self._partition_depth.get(pkey, 0) + recs
             if self._queue_depth > self.peak_queue_depth:
                 self.peak_queue_depth = self._queue_depth
         return _ADMITTED if self._state == ACCEPT else Decision(
@@ -605,6 +736,14 @@ class AdmissionController:
                     t: {"credits": round(b.tokens, 2),
                         "idleS": round(now - b.last_seen, 3)}
                     for t, b in self._tenants.items()},
+                "partitions": {
+                    (str(p) if scope is None else f"{scope}:{p}"): {
+                        "depth": self._partition_depth.get((scope, p), 0),
+                        "limit": self.partition_limit(scope)}
+                    for (scope, p) in sorted(
+                        self._partition_sources,
+                        key=lambda k: (k[0] or "", k[1]))
+                } if self._partition_sources else None,
             }
 
 

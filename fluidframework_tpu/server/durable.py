@@ -201,9 +201,19 @@ class DurableMessageLog(MessageLog):
         return handle
 
     def send(self, topic: str, key: str, value: Any):
+        part = self.topic(topic).partition_for(key)
+        return self._send_durable(topic, part, key, value)
+
+    def send_to(self, topic: str, partition: int, key: str, value: Any):
+        # Explicit-partition produce (the sharded ingest tier's md5
+        # routing) must hit the SAME disk-first path as keyed sends — the
+        # inherited in-memory send_to would silently drop durability.
+        part = self.topic(topic).partitions[partition]
+        return self._send_durable(topic, part, key, value)
+
+    def _send_durable(self, topic: str, part, key: str, value: Any):
         import pickle
         import struct
-        part = self.topic(topic).partition_for(key)
         with self._io_lock:
             # Disk first, memory second: a crash between the two replays
             # the message from disk; the reverse order would lose it.
@@ -217,6 +227,16 @@ class DurableMessageLog(MessageLog):
     def commit(self, group: str, topic: str, partition: int,
                offset: int) -> None:
         super().commit(group, topic, partition, offset)
+        self._persist_offsets()
+
+    def commit_many(self, group: str, topic: str, offsets) -> None:
+        # Batched cross-partition ack: ONE offsets-file rewrite for the
+        # whole batch (the per-commit fsync'd rewrite is the expensive
+        # half on this engine).
+        super().commit_many(group, topic, offsets)
+        self._persist_offsets()
+
+    def _persist_offsets(self) -> None:
         with self._io_lock:
             dump = {f"{g}|{t}|{p}": off
                     for (g, t, p), off in self.checkpoints.items()}

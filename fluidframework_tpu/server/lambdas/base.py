@@ -19,8 +19,18 @@ class LambdaContext:
         self.topic = topic
         self.partition = partition
         self._on_error = on_error
+        # Batched cross-partition acks (server/sharding.py AckBatcher):
+        # when the hosting tier installs a batcher, checkpoint() NOTES
+        # the offset instead of committing it, and the tier flushes a
+        # whole pump round's per-partition offsets in one commit_many.
+        # Deferring an ack only WIDENS the crash-replay window (at-least-
+        # once preserved); None (the default) keeps the eager commit.
+        self.ack_batcher = None
 
     def checkpoint(self, offset: int) -> None:
+        if self.ack_batcher is not None:
+            self.ack_batcher.note(self.partition, offset)
+            return
         self.log.commit(self.group, self.topic, self.partition, offset)
 
     def error(self, err: Exception, restart: bool) -> None:

@@ -18,7 +18,6 @@ condition is visible instead of silent.
 
 from __future__ import annotations
 
-import hashlib
 import threading
 import time
 from collections import deque
@@ -28,15 +27,18 @@ from ...protocol.messages import SequencedDocumentMessage
 from ...telemetry import tracing
 from ...telemetry.counters import gauge, increment
 from ..log import QueuedMessage
+from ..routing import doc_shard
 from .base import IPartitionLambda, LambdaContext
 
 
 def shard_for(document_id: str, shards: int) -> int:
-    """Stable doc -> shard routing (md5, not hash(): per-process seed
-    randomization would re-shard every restart and break run-twice
-    determinism in the soak suite)."""
-    digest = hashlib.md5(str(document_id).encode()).digest()
-    return int.from_bytes(digest[:4], "little") % shards
+    """Stable doc -> shard routing: the SHARED md5 scheme
+    (server/routing.py doc_shard) the ingest partition router also uses,
+    so the broadcast shard and the sequencing partition of a document
+    can never disagree. md5, not hash(): per-process seed randomization
+    would re-shard every restart and break run-twice determinism in the
+    soak suite."""
+    return doc_shard(document_id, shards)
 
 
 class _Shard:

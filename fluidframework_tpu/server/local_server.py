@@ -54,6 +54,7 @@ from .lambdas.scriptorium import delta_key, query_deltas
 from .log import MessageLog, make_message_log
 from .partition import (LambdaRunner, OverlappedLambdaRunner,
                         PartitionManager)
+from .sharding import SequencerShardSet
 from .storage import Historian
 
 RAW_TOPIC = "rawdeltas"
@@ -140,6 +141,11 @@ class Connection(TypedEventEmitter):
         # local rate nit is pointless to evaluate on traffic the process
         # cannot absorb at all).
         adm = self.server.admission
+        # The document's home partition (sharded ingest tier): admission
+        # applies its per-partition fairness bound on top of the global
+        # ladder, so one hot partition throttles without starving
+        # siblings. None on a single-partition core (no gate to apply).
+        part = self.server.ingest_partition(self.document_id)
         if adm is not None and messages:
             ctx = tracing.first_message_context(messages)
             # The whole batch rides ONE boxcar record — the unit
@@ -149,6 +155,7 @@ class Connection(TypedEventEmitter):
             decision = adm.admit(
                 self.tenant_id, kind=admission_mod.CLASS_OP,
                 count=len(messages), records=1,
+                partition=part,
                 trace_id=getattr(ctx, "trace_id", None))
             if not decision.admitted:
                 code = NACK_SERVICE_UNAVAILABLE \
@@ -169,7 +176,8 @@ class Connection(TypedEventEmitter):
                 # never reaches the queue — retract it so the phantom
                 # record doesn't read as drained at the next observe.
                 if adm is not None and messages:
-                    adm.retract(len(messages), records=1)
+                    adm.retract(len(messages), records=1, partition=part,
+                                tenant=self.tenant_id)
                 self.emit("nack", Nack(
                     messages[0] if messages else None, -1,
                     NackContent(NACK_THROTTLED, "op rate limit",
@@ -181,9 +189,12 @@ class Connection(TypedEventEmitter):
         with tracing.span("server.ingest",
                           parent=tracing.first_message_context(messages),
                           document=self.document_id):
+            # The home partition computed for the admission gate above
+            # rides through so the produce path never hashes twice.
             self.server._submit_boxcar(Boxcar(
                 tenant_id=self.tenant_id, document_id=self.document_id,
-                client_id=self.client_id, contents=list(messages)))
+                client_id=self.client_id, contents=list(messages)),
+                partition=part)
 
     def submit_signal(self, content: Any) -> None:
         """Transient broadcast: the signal fans out to every connection in
@@ -323,7 +334,15 @@ class LocalServer:
         # requires the pump's eager offset commit OFF so the replay window
         # matches the saved state.
         self.config = config
-        self._deli_mgr = self.runner.add(self._build_sequencer())
+        # The sequencing stage lives in the sharded ingest tier
+        # (server/sharding.py): one sequencer lambda per raw-topic
+        # partition, restart-stable md5 document routing, per-partition
+        # checkpoint scoping, batched cross-partition acks, and the
+        # per-partition pump accounting the monitor and the ingest bench
+        # read. Partition state is the TIER's, not this class's — the
+        # decoupling refactor the ROADMAP's million-ops item counts.
+        self.ingest = self._build_ingest_tier()
+        self._deli_mgr = self.runner.add(self.ingest.manager)
         self._copier_mgr = self.runner.add(PartitionManager(
             self.log, "copier", RAW_TOPIC,
             lambda ctx: CopierLambda(ctx, self.raw_deltas), offload=True))
@@ -384,18 +403,35 @@ class LocalServer:
         consumed by the sequencing stage (per partition: end offset minus
         the deli group's committed offset). Counts broker records
         (boxcars), the unit the partition pumps drain in — the admission
-        controller's primary occupancy feed."""
-        topic = self.log.topic(RAW_TOPIC)
-        total = 0
-        for p, part in enumerate(topic.partitions):
-            total += max(0, part.end_offset
-                         - self.log.committed("deli", RAW_TOPIC, p))
-        return total
+        controller's primary occupancy feed. Multi-partition audit
+        (docs/ingest_sharding.md): a submit batch is ONE boxcar on ONE
+        partition, so `admit(count=N, records=1)` stays calibrated
+        against this sum for any partition count — per-partition feeds
+        go through the controller's SEPARATE partition channel and are
+        never added into the global depth (that double-count would
+        re-introduce the PR 6 phantom-drain inflation, N-fold)."""
+        return self.ingest.raw_backlog()
+
+    def raw_backlog_by_partition(self) -> Dict[int, int]:
+        """Per-partition record backlog (monitor watch_partitions)."""
+        return self.ingest.raw_backlog_by_partition()
+
+    def ingest_partition(self, document_id: str) -> Optional[int]:
+        """A document's home partition, or None on a single-partition
+        core (admission then skips the per-partition fairness gate)."""
+        if self.ingest.partitions <= 1:
+            return None
+        return self.ingest.partition_for(document_id)
 
     def _wire_admission(self) -> None:
         adm = self.admission
         adm.add_source(f"core:{self.tenant_id}",
                        queue_depth=self.raw_backlog)
+        if self.ingest.partitions > 1:
+            # Per-partition occupancy feeds the fairness gate only (see
+            # raw_backlog docstring for why they must not join the
+            # global sum).
+            self.ingest.register_admission(adm, self.tenant_id)
         if self.broadcaster_shards:
             # The read tier's occupancy feed: a fan-out backlog (reconnect
             # avalanche, hot-document room) pressures the same admission
@@ -421,24 +457,39 @@ class LocalServer:
 
         adm.add_degrade_hooks(pause, resume)
 
-    def _build_sequencer(self) -> PartitionManager:
-        """The sequencing stage (scalar DeliLambda here; TpuLocalServer
-        overrides with the device-batched TpuSequencerLambda)."""
+    def _build_ingest_tier(self) -> SequencerShardSet:
+        """The sequencing stage as a sharded tier (server/sharding.py):
+        one lambda per raw-topic partition via _sequencer_factory
+        (scalar DeliLambda here; TpuLocalServer overrides with the
+        device-batched TpuSequencerLambda)."""
+        return SequencerShardSet(
+            self.log, RAW_TOPIC, "deli", self._sequencer_factory,
+            checkpoints=self.deli_checkpoints,
+            auto_commit=self._sequencer_auto_commit())
+
+    def _sequencer_factory(self, ctx, checkpoints):
+        return DeliLambda(ctx, emit=self._emit_sequenced,
+                          nack=self._emit_nack,
+                          checkpoints=checkpoints,
+                          fresh_log=True,
+                          config=self.config,
+                          send_system=self._send_system)
+
+    def _sequencer_auto_commit(self) -> bool:
         deli_batched = bool(self.config is not None and int(
             self.config.get("deli.checkpointBatchSize", 1)) > 1)
-        return PartitionManager(
-            self.log, "deli", RAW_TOPIC,
-            lambda ctx: DeliLambda(ctx, emit=self._emit_sequenced,
-                                   nack=self._emit_nack,
-                                   checkpoints=self.deli_checkpoints,
-                                   fresh_log=True,
-                                   config=self.config,
-                                   send_system=self._send_system),
-            auto_commit=not deli_batched)
+        return not deli_batched
 
     def _emit_sequenced(self, doc_id: str,
                         sequenced: SequencedDocumentMessage) -> None:
-        self.log.send(DELTAS_TOPIC, doc_id, (doc_id, sequenced))
+        # Explicit-partition produce through the shared md5 router: the
+        # deltas topic mirrors the raw topic's partitioning, so every
+        # downstream per-partition consumer (scriptorium/scribe/
+        # broadcaster pumps) inherits the ingest tier's document homes
+        # instead of the broker's own key hash.
+        self.log.send_to(DELTAS_TOPIC,
+                         self.ingest.partition_for(doc_id),
+                         doc_id, (doc_id, sequenced))
 
     def _emit_nack(self, doc_id: str, client_id: str, nack: Nack) -> None:
         for conn in self._connections.get(doc_id, []):
@@ -456,12 +507,22 @@ class LocalServer:
                 record_swallow("server.summary_commit_listener")
 
     def _send_system(self, doc_id: str, message: DocumentMessage) -> None:
-        self.log.send(RAW_TOPIC, doc_id, Boxcar(
+        self.log.send_to(RAW_TOPIC, self.ingest.partition_for(doc_id),
+                         doc_id, Boxcar(
             tenant_id=self.tenant_id, document_id=doc_id, client_id=None,
             contents=[message]))
 
-    def _submit_boxcar(self, boxcar: Boxcar) -> None:
-        self.log.send(RAW_TOPIC, boxcar.document_id, boxcar)
+    def _submit_boxcar(self, boxcar: Boxcar,
+                       partition: Optional[int] = None) -> None:
+        # Explicit md5-routed produce (server/routing.py): the document's
+        # home partition is the tier's decision, never the broker's key
+        # hash — restart-stable and shared with the broadcaster shards.
+        # Callers that already routed (the admission gate) pass the home
+        # through; None recomputes (free on a single-partition core).
+        if partition is None:
+            partition = self.ingest.partition_for(boxcar.document_id)
+        self.log.send_to(RAW_TOPIC, partition,
+                         boxcar.document_id, boxcar)
         if self.auto_pump:
             self.pump()
 
@@ -606,47 +667,64 @@ class TpuLocalServer(LocalServer):
             enabled = bool(self.config.get("catchup.enabled", True))
         self.catchup = CatchupCache() if enabled else None
 
-    def _build_sequencer(self) -> PartitionManager:
+    def _build_ingest_tier(self) -> SequencerShardSet:
+        self.tpu_sequencers = []
+        return super()._build_ingest_tier()
+
+    def _sequencer_factory(self, ctx, checkpoints):
         from .tpu_sequencer import TpuSequencerLambda
 
-        def factory(ctx):
-            lam = TpuSequencerLambda(
-                ctx, emit=self._emit_sequenced, nack=self._emit_nack,
-                checkpoints=self.deli_checkpoints, deltas=self.deltas,
-                fresh_log=True, mesh=getattr(self, "mesh", None),
-                # Snapshot seeding: lanes for channels whose base content
-                # shipped in the attach/client summary bootstrap from the
-                # historian instead of overflowing on their first op.
-                storage=lambda doc_id: self.historian.read_summary(
-                    self.tenant_id, doc_id),
-                config=self.config,
-                send_system=self._send_system,
-                paged_lanes=getattr(self, "paged_lanes", False))
-            self.tpu_sequencers.append(lam)
-            return lam
+        lam = TpuSequencerLambda(
+            ctx, emit=self._emit_sequenced, nack=self._emit_nack,
+            checkpoints=checkpoints, deltas=self.deltas,
+            fresh_log=True, mesh=getattr(self, "mesh", None),
+            # Snapshot seeding: lanes for channels whose base content
+            # shipped in the attach/client summary bootstrap from the
+            # historian instead of overflowing on their first op.
+            storage=lambda doc_id: self.historian.read_summary(
+                self.tenant_id, doc_id),
+            config=self.config,
+            send_system=self._send_system,
+            paged_lanes=getattr(self, "paged_lanes", False))
+        self.tpu_sequencers.append(lam)
+        return lam
 
-        self.tpu_sequencers = []
-        # auto_commit off: offsets commit only at the lambda's flush
-        # checkpoint, so a crash replays the whole unflushed window.
-        return PartitionManager(self.log, "deli", RAW_TOPIC, factory,
-                                auto_commit=False)
+    def _sequencer_auto_commit(self) -> bool:
+        # Off: offsets commit only at the lambda's flush checkpoint, so
+        # a crash replays the whole unflushed window.
+        return False
 
     def sequencer(self):
-        """The live TpuSequencerLambda (single-partition default)."""
-        return self.tpu_sequencers[-1]
+        """The live TpuSequencerLambda of partition 0 — THE sequencer on
+        a single-partition core (the default every in-process test
+        drives). On a sharded core, document-scoped paths must route via
+        sequencer_for()/the tier instead; this accessor stays for
+        whole-process introspection that treats partition 0 as
+        representative."""
+        return self.ingest.live(0)
+
+    def sequencer_for(self, document_id: str):
+        """The live sequencer lambda owning a document's home partition
+        (== sequencer() on a single-partition core)."""
+        return self.ingest.sequencer_for(document_id)
 
     def _wire_admission(self) -> None:
         super()._wire_admission()
         # The device pipeline's occupancy hints: staged ops count toward
         # queue depth; the in-flight ring's fill feeds the (damped)
-        # utilization term. Resolved through sequencer() so a crash-
-        # restarted lambda keeps feeding the controller.
-        self.admission.add_source(
-            f"ring:{self.tenant_id}",
-            hints=lambda: self.sequencer().occupancy_hints())
+        # utilization term. Resolved through the tier's live() so a
+        # crash-restarted lambda keeps feeding the controller; one
+        # source per partition so a sharded core's staged work counts
+        # exactly once.
+        for p in range(self.ingest.partitions):
+            name = f"ring:{self.tenant_id}" if self.ingest.partitions == 1 \
+                else f"ring:{self.tenant_id}:p{p}"
+            self.admission.add_source(
+                name,
+                hints=lambda p=p: self.ingest.live(p).occupancy_hints())
 
     def sequence_number(self, document_id: str) -> int:
-        return self.sequencer().document_seq(document_id)
+        return self.sequencer_for(document_id).document_seq(document_id)
 
     # -- read-path catch-up artifacts (server/readpath.py) -----------------
     def refresh_catchup(self, only_docs: Optional[set] = None) -> dict:
@@ -664,8 +742,17 @@ class TpuLocalServer(LocalServer):
         if self.catchup is None:
             return {"published": 0, "skipped": 0, "refreshed": 0}
         with self._pump_lock:
-            seq_lambda = self.sequencer()
-            bodies = seq_lambda.catchup_snapshot(only_docs)
+            # One epoch spans every partition's sequencer: documents are
+            # partition-disjoint (md5 homes), so the per-partition bodies
+            # merge without collision; each publish advances the OWNING
+            # lambda's watermark.
+            bodies: Dict[str, dict] = {}
+            owner: Dict[str, Any] = {}
+            for seq_lambda in self.ingest.sequencers():
+                for doc_id, body in seq_lambda.catchup_snapshot(
+                        only_docs).items():
+                    bodies[doc_id] = body
+                    owner[doc_id] = seq_lambda
             if not bodies:
                 return {"published": 0, "skipped": 0, "refreshed": 0}
             # One scan of the checkpoint collection for the whole epoch
@@ -687,7 +774,8 @@ class TpuLocalServer(LocalServer):
                     body, row["minimumSequenceNumber"], row["quorum"], sha)
                 if self.catchup.publish(self.tenant_id, doc_id, artifact):
                     published += 1
-                    seq_lambda.catchup_mark_published(doc_id, body["gen"])
+                    owner[doc_id].catchup_mark_published(doc_id,
+                                                         body["gen"])
                     for listener in list(self.catchup_listeners):
                         try:
                             listener(self.tenant_id, doc_id, artifact)
@@ -705,7 +793,8 @@ class TpuLocalServer(LocalServer):
         if self.catchup is None:
             return None
         with self._pump_lock:
-            head = self.sequencer().document_seq(document_id)
+            head = self.sequencer_for(document_id).document_seq(
+                document_id)
             art_seq = self.catchup.peek_seq(self.tenant_id, document_id)
             if art_seq is None or art_seq < head:
                 self.refresh_catchup(only_docs={document_id})
@@ -727,13 +816,21 @@ class TpuLocalServer(LocalServer):
         dirty channels skip the write entirely — extraction compute, D2H
         traffic, and blob uploads all scale with the changed set
         (reference trackState/SummaryTracker, server-side)."""
+        out: Dict[str, str] = {}
+        # Per-partition sequencers hold disjoint document sets (md5
+        # homes), so the per-sequencer maps merge without collision.
+        for seq in self.ingest.sequencers():
+            out.update(self._write_materialized_for(seq, ref, incremental))
+        return out
+
+    def _write_materialized_for(self, seq, ref: str,
+                                incremental: bool) -> Dict[str, str]:
         import json as _json
 
         from ..protocol.summary import SummaryHandle, SummaryTree
 
         from .tpu_sequencer import lane_base_key
 
-        seq = self.sequencer()
         seq.drain()
         merge_keys = set(seq.merge.where)
         lww_keys = set(seq.lww.where)

@@ -114,6 +114,19 @@ class MessageLog:
             if offset + 1 > self.checkpoints.get(key, 0):
                 self.checkpoints[key] = offset + 1
 
+    def commit_many(self, group: str, topic: str,
+                    offsets: Dict[int, int]) -> None:
+        """Batched cross-partition ack: commit {partition: offset} for a
+        whole consumer group in ONE lock acquisition — the sharded
+        ingest tier (server/sharding.py AckBatcher) flushes a pump
+        round's per-partition checkpoints through here instead of N
+        broker round-trips. Same never-regress semantics as commit()."""
+        with self._lock:
+            for partition, offset in offsets.items():
+                key = (group, topic, partition)
+                if offset + 1 > self.checkpoints.get(key, 0):
+                    self.checkpoints[key] = offset + 1
+
     def committed(self, group: str, topic: str, partition: int) -> int:
         return self.checkpoints.get((group, topic, partition), 0)
 

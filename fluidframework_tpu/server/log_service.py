@@ -19,7 +19,7 @@ from __future__ import annotations
 import pickle
 import threading
 from concurrent import futures
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ..telemetry.counters import record_swallow
 from .log import MessageLog, QueuedMessage
@@ -39,8 +39,10 @@ class LogServiceServer:
 
         handlers = {
             f"/{SERVICE}/Send": method(service._send),
+            f"/{SERVICE}/SendTo": method(service._send_to),
             f"/{SERVICE}/Read": method(service._read),
             f"/{SERVICE}/Commit": method(service._commit),
+            f"/{SERVICE}/CommitMany": method(service._commit_many),
             f"/{SERVICE}/Committed": method(service._committed),
             f"/{SERVICE}/Topic": method(service._topic),
         }
@@ -71,6 +73,11 @@ class LogServiceServer:
         msg = self.log.send(topic, key, value)
         return pickle.dumps(msg.offset)
 
+    def _send_to(self, request: bytes, context) -> bytes:
+        topic, partition, key, value = pickle.loads(request)
+        msg = self.log.send_to(topic, partition, key, value)
+        return pickle.dumps(msg.offset)
+
     def _read(self, request: bytes, context) -> bytes:
         topic, partition, offset, limit = pickle.loads(request)
         msgs = self.log.topic(topic).partitions[partition].read(offset, limit)
@@ -79,6 +86,11 @@ class LogServiceServer:
     def _commit(self, request: bytes, context) -> bytes:
         group, topic, partition, offset = pickle.loads(request)
         self.log.commit(group, topic, partition, offset)
+        return pickle.dumps(True)
+
+    def _commit_many(self, request: bytes, context) -> bytes:
+        group, topic, offsets = pickle.loads(request)
+        self.log.commit_many(group, topic, offsets)
         return pickle.dumps(True)
 
     def _committed(self, request: bytes, context) -> bytes:
@@ -192,6 +204,22 @@ class RemoteMessageLog:
     def send(self, topic: str, key: str, value) -> QueuedMessage:
         offset = self._call("Send", (topic, key, value))
         return QueuedMessage(topic, 0, offset, key, value)
+
+    def send_to(self, topic: str, partition: int, key: str,
+                value) -> QueuedMessage:
+        """Produce to an EXPLICIT partition (MessageLog.send_to parity)
+        — the sharded ingest tier's md5 document routing must override
+        the broker's own key hash."""
+        offset = self._call("SendTo", (topic, partition, key, value))
+        return QueuedMessage(topic, partition, offset, key, value)
+
+    def commit_many(self, group: str, topic: str,
+                    offsets: Dict[int, int]) -> None:
+        """Batched cross-partition ack: ONE round trip commits a whole
+        pump round's per-partition offsets (the win that matters on this
+        networked deployment shape — N partitions stop costing N gRPC
+        calls per checkpoint flush)."""
+        self._call("CommitMany", (group, topic, dict(offsets)))
 
     def poll(self, group: str, topic: str, partition: int = 0,
              limit: int = 1000) -> List[QueuedMessage]:

@@ -159,6 +159,7 @@ def build_worker(cfg: dict, stages: List[str]):
     from .lambdas.scriptorium import delta_key
     from .log_service import RemoteMessageLog
     from .partition import LambdaRunner, PartitionManager
+    from .routing import doc_shard
     from ..protocol.messages import Boxcar
 
     bcfg = cfg["broker"]
@@ -174,27 +175,45 @@ def build_worker(cfg: dict, stages: List[str]):
     scribe_ckpt = db.collection("scribeCheckpoints")
     view = _ConfigView(cfg)
 
+    # Explicit-partition produce through the shared md5 router
+    # (server/routing.py): a worker's system messages (deli ghost
+    # evictions, scribe acks) and sequenced emits must land on the SAME
+    # partition the front door routes the document to — the broker's
+    # own key hash is never consulted on a sharded topology.
+    n_parts = int(bcfg.get("partitions", 1))
+
     def emit_sequenced(doc_id, sequenced):
-        log.send(DELTAS_TOPIC, doc_id, (doc_id, sequenced))
+        log.send_to(DELTAS_TOPIC, doc_shard(doc_id, n_parts), doc_id,
+                    (doc_id, sequenced))
 
     def emit_nack(doc_id, client_id, nack):
-        log.send(NACKS_TOPIC, doc_id, (doc_id, client_id, nack))
+        log.send_to(NACKS_TOPIC, doc_shard(doc_id, n_parts), doc_id,
+                    (doc_id, client_id, nack))
 
     def send_system(doc_id, message):
-        log.send(RAW_TOPIC, doc_id, Boxcar(
+        log.send_to(RAW_TOPIC, doc_shard(doc_id, n_parts), doc_id, Boxcar(
             tenant_id=tenant, document_id=doc_id, client_id=None,
             contents=[message]))
+
+    # Sequencer checkpoints are PARTITION-SCOPED (server/sharding.py
+    # PartitionCheckpoints): with partitions > 1, N lambdas over one
+    # raw collection would clobber each other's tpu-sequencer row, and
+    # every scalar deli restart would adopt every OTHER partition's
+    # documents.
+    from .sharding import PartitionCheckpoints
 
     runner = LambdaRunner()
     for stage in stages:
         if stage == "deli":
             runner.add(PartitionManager(
                 log, "deli", RAW_TOPIC,
-                lambda ctx: DeliLambda(ctx, emit=emit_sequenced,
-                                       nack=emit_nack,
-                                       checkpoints=deli_ckpt,
-                                       fresh_log=False, config=view,
-                                       send_system=send_system),
+                lambda ctx: DeliLambda(
+                    ctx, emit=emit_sequenced,
+                    nack=emit_nack,
+                    checkpoints=PartitionCheckpoints(deli_ckpt,
+                                                     ctx.partition),
+                    fresh_log=False, config=view,
+                    send_system=send_system),
                 auto_commit=False))
         elif stage == "tpu-deli":
             from .tpu_sequencer import TpuSequencerLambda
@@ -202,7 +221,9 @@ def build_worker(cfg: dict, stages: List[str]):
             def make_tpu_deli(ctx):
                 lam = TpuSequencerLambda(
                     ctx, emit=emit_sequenced, nack=emit_nack,
-                    checkpoints=deli_ckpt, deltas=deltas,
+                    checkpoints=PartitionCheckpoints(deli_ckpt,
+                                                     ctx.partition),
+                    deltas=deltas,
                     config=view, send_system=send_system)
                 # Batched emit: ONE deltas-topic produce per fast flush
                 # window (downstream lambdas fan it out), matching the

@@ -279,6 +279,45 @@ class ServiceMonitor:
 
         self.add_probe(name, probe)
 
+    def watch_partitions(self, name: str, server) -> None:
+        """Probe over the sharded ingest tier (server/sharding.py,
+        docs/ingest_sharding.md): per-partition committed offset / end
+        offset / record lag, the owning sequencer's staged work, and the
+        pump accounting (records drained, busy seconds, restarts). Each
+        probe also refreshes per-partition lag/depth gauges — through
+        the PR 12 `bounded()` cardinality guard — so /metrics.prom
+        carries `fluid_ingest_partition_lag_p<i>` without per-partition
+        label cardinality ever growing unbounded."""
+
+        def probe() -> dict:
+            tier = getattr(server, "ingest", None)
+            if tier is None:
+                return {"partitions": []}
+            rows = tier.partition_stats()
+            for row in rows:
+                p = row["partition"]
+                process_counters.gauge(
+                    process_counters.bounded("ingest.partition_lag",
+                                             f"p{p}"), row["lag"])
+                process_counters.gauge(
+                    process_counters.bounded("ingest.partition_committed",
+                                             f"p{p}"),
+                    row["committedOffset"])
+                if "stagedOps" in row:
+                    process_counters.gauge(
+                        process_counters.bounded("ingest.partition_staged",
+                                                 f"p{p}"),
+                        row["stagedOps"])
+            total_lag = sum(r["lag"] for r in rows)
+            hottest = max(rows, key=lambda r: r["lag"])["partition"] \
+                if rows else None
+            return {"partitions": rows, "totalLag": total_lag,
+                    "hottest": hottest,
+                    "router": {"scheme": "md5",
+                               "partitions": tier.partitions}}
+
+        self.add_probe(name, probe)
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ServiceMonitor":
         self._thread = threading.Thread(target=self._httpd.serve_forever,

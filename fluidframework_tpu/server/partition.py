@@ -82,6 +82,17 @@ class PartitionPump:
         """Crash recovery: rebuild the lambda; the next pump replays from
         the last committed offset (idempotent handlers absorb the replay)."""
         self.lambda_.close()
+        # With batched acks (server/sharding.py) the lambda's checkpoint
+        # STATE may be ahead of the committed offset (the ack is noted,
+        # not yet flushed). The rebuilt lambda restores that state with
+        # its per-doc replay guards reset (fresh_log), so an unflushed
+        # ack would make the replay window overlap the restored state —
+        # already-sequenced joins would re-sequence. Flush AFTER close()
+        # (close's own checkpoint notes one more ack) so state and
+        # offset agree again, exactly like the eager-commit pipeline.
+        batcher = getattr(self.context, "ack_batcher", None)
+        if batcher is not None:
+            batcher.flush()
         self.lambda_ = self.lambda_factory(self.context)
         self._cursor = self.log.committed(self.group, self.topic,
                                           self.partition)

@@ -1,0 +1,65 @@
+"""Restart-stable document routing shared by every sharded tier.
+
+One hash, two consumers: the broadcaster's fan-out shards
+(server/lambdas/broadcaster.py) and the ingest tier's partition router
+(server/sharding.py) both assign a document a "home" by the SAME md5
+scheme, so the two tiers can never disagree about where a document's
+traffic lives — the broadcast shard draining a document's deliveries is
+always derivable from the partition sequencing it (and vice versa) by
+taking the digest modulo the respective shard count.
+
+md5 rather than ``hash()`` because Python's string hash is seeded per
+process: a restart would re-home every document, breaking per-document
+ordering for durable logs and run-twice determinism in the soak suite.
+md5 rather than crc32 (the broker's internal key hash) because the md5
+scheme is the one the broadcaster shipped with (docs/read_path.md) and
+re-homing broadcast shards to match the broker would invalidate
+existing shard-affinity expectations; the ingest tier instead produces
+to EXPLICIT partitions (`MessageLog.send_to`) so the broker's own key
+hash never routes a sharded tenant's documents.
+
+Dependency-free (stdlib only): imported by lambdas and the server tier
+alike without cycles.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def doc_shard(document_id: str, shards: int) -> int:
+    """The stable home of a document among ``shards`` slots.
+
+    Little-endian first 4 digest bytes, modulo the slot count — byte
+    order is pinned so the mapping is identical across hosts and
+    restarts (run-twice determinism; see the broadcaster's routing
+    stability tests in tests/test_broadcaster.py)."""
+    if shards <= 1:
+        return 0
+    digest = hashlib.md5(str(document_id).encode()).digest()
+    return int.from_bytes(digest[:4], "little") % shards
+
+
+class PartitionRouter:
+    """Doc -> ingest-partition routing for one topic's partition count.
+
+    Restart-stable by construction (pure function of the document id and
+    the partition count); rebalancing therefore means CHANGING the
+    partition count, which re-homes (1 - 1/N) of documents — the
+    rebalance contract (docs/ingest_sharding.md) requires draining the
+    old topology to a checkpoint barrier first, exactly like a Kafka
+    repartition."""
+
+    def __init__(self, partitions: int):
+        self.partitions = max(1, int(partitions))
+
+    def partition_for(self, document_id: str) -> int:
+        return doc_shard(document_id, self.partitions)
+
+    def assignment(self, document_ids) -> dict:
+        """{partition: [document_id, ...]} for a document set (bench &
+        monitor convenience; deterministic order preserved)."""
+        out: dict = {p: [] for p in range(self.partitions)}
+        for doc_id in document_ids:
+            out[self.partition_for(doc_id)].append(doc_id)
+        return out

@@ -1,0 +1,448 @@
+"""Sharded multi-partition ingest tier: per-partition sequencer workers.
+
+The serving ring sustains a measured per-process ingest rate (BENCH
+r06+: ~24.5k ops/s on the pipelined CPU shape), but alfred -> deli was
+effectively ONE logical partition, so that figure never composed — the
+million-ops/s story (ROADMAP; the Pulsar benchmarking bar in PAPERS.md)
+needs N partitions, each owned by its own deli/sequencer worker, whose
+per-partition service rates ADD.
+
+This module owns everything per-partition that used to live implicitly
+in ``LocalServer`` (the decoupling refactor the ROADMAP counts):
+
+  * ``SequencerShardSet`` — the tier. One ``PartitionManager`` over the
+    raw-op topic whose factory builds ONE sequencer lambda per
+    partition (scalar ``DeliLambda`` or the device-batched
+    ``TpuSequencerLambda`` — the host is agnostic), a restart-stable
+    md5 document router (server/routing.py — the SAME scheme the
+    broadcaster's fan-out shards use, so the two tiers can never
+    disagree on a document's home), per-partition checkpoint/offset
+    state, per-partition pump/busy accounting (the composition figure
+    `bench.py ingest-smoke` grades), and optional per-partition worker
+    threads.
+
+  * ``PartitionCheckpoints`` — a partition-scoped view over the shared
+    deli checkpoint collection. Without it, N ``TpuSequencerLambda``
+    instances would clobber one another's single ``kind ==
+    "tpu-sequencer"`` row (the scalar deli's per-document rows collide
+    more subtly: every partition's restart would adopt every OTHER
+    partition's documents). Rows carry an ``ingestPartition`` field;
+    missing means partition 0, so pre-sharding checkpoints restore
+    unchanged.
+
+  * ``AckBatcher`` — batched cross-partition acks. Sequencer lambdas
+    checkpoint through their ``LambdaContext``; with a batcher
+    installed, a pump round's per-partition offset commits coalesce
+    into ONE ``MessageLog.commit_many`` (one lock acquisition
+    in-process; one gRPC round trip against the remote broker).
+    Deferring an ack within a round only WIDENS the crash-replay
+    window, so at-least-once semantics are untouched.
+
+Admission interplay (server/admission.py): the tier registers one
+occupancy source per partition (raw-record backlog + the sequencer's
+occupancy hints), and ``AdmissionController.admit(partition=...)``
+enforces a per-partition soft bound on top of the global ladder — one
+hot partition throttles ITS documents without starving siblings, and
+without the global ladder ever leaving ACCEPT (docs/ingest_sharding.md,
+docs/overload.md).
+
+Thread model: by default nothing here spawns threads — the tier pumps
+on the caller's thread exactly like the pre-sharding pipeline, which is
+what every deterministic in-process test relies on. ``start_workers``
+opts into one daemon worker per partition (the deployment shape: one
+worker per core); while workers run, a partition is only ever pumped by
+its one owner: ``pump_round`` refuses outright, and runner rounds
+(``LocalServer.pump`` / auto_pump drive every registered manager,
+including this tier's) skip the ingest stage while still pumping the
+downstream stages on the caller's thread.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..telemetry.counters import increment
+from .lambdas.base import IPartitionLambda, LambdaContext
+from .partition import PartitionManager
+from .routing import PartitionRouter
+
+
+class AckBatcher:
+    """Collects per-partition checkpoint offsets and flushes them as one
+    batched cross-partition commit (``MessageLog.commit_many``).
+
+    note() keeps only the max offset per partition (commits are
+    monotonic); flush() is idempotent and cheap when empty. The batch
+    is swapped out under AckBatcher._lock but committed OUTSIDE it, so
+    the lock is never held across broker I/O — no ordering against the
+    log's own locks exists at all."""
+
+    def __init__(self, log, group: str, topic: str):
+        self.log = log
+        self.group = group
+        self.topic = topic
+        self._pending: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def note(self, partition: int, offset: int) -> None:
+        with self._lock:
+            held = self._pending.get(partition)
+            if held is None or offset > held:
+                self._pending[partition] = offset
+
+    def flush(self) -> int:
+        """Commit every noted offset in one batch; returns the number of
+        partitions acked."""
+        with self._lock:
+            if not self._pending:
+                return 0
+            pending, self._pending = self._pending, {}
+        # Commit OUTSIDE the lock: on the durable engine commit_many is
+        # an fsync'd offsets-file rewrite, and holding _lock across it
+        # would stall every other partition worker's note(). Safe
+        # because commit_many is never-regress per partition on every
+        # engine, so a racing higher-offset flush cannot be regressed
+        # by this batch landing late.
+        self.log.commit_many(self.group, self.topic, pending)
+        increment("ingest.ack_batches")
+        increment("ingest.acked_partitions", len(pending))
+        return len(pending)
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+
+class PartitionCheckpoints:
+    """Partition-scoped view over a shared checkpoint Collection: every
+    row this view writes carries ``ingestPartition``, and every read
+    filters on it (missing == partition 0, so checkpoints written before
+    sharding restore into partition 0 unchanged). Presents exactly the
+    find/find_one/upsert surface the sequencer lambdas use."""
+
+    def __init__(self, inner, partition: int):
+        self.inner = inner
+        self.partition = int(partition)
+
+    def _scope(self, predicate: Callable[[dict], bool]):
+        p = self.partition
+        return lambda d: (int(d.get("ingestPartition", 0)) == p
+                          and predicate(d))
+
+    def find(self, predicate: Callable[[dict], bool]) -> List[dict]:
+        return self.inner.find(self._scope(predicate))
+
+    def find_one(self, predicate: Callable[[dict], bool]) -> Optional[dict]:
+        return self.inner.find_one(self._scope(predicate))
+
+    def upsert(self, match: Callable[[dict], bool], doc: dict) -> None:
+        doc = dict(doc)
+        doc["ingestPartition"] = self.partition
+        self.inner.upsert(self._scope(match), doc)
+
+    def __len__(self) -> int:
+        return len(self.find(lambda d: True))
+
+
+class _PartitionStats:
+    """Per-partition pump accounting (mutated only under the tier's
+    stats lock): broker records drained, pump calls that made progress,
+    and the busy wall-clock the partition's worker spent inside its
+    pump — the denominator of the per-partition service rate the
+    ingest-smoke composition figure sums."""
+
+    __slots__ = ("records", "pump_calls", "busy_s", "restarts")
+
+    def __init__(self):
+        self.records = 0
+        self.pump_calls = 0
+        self.busy_s = 0.0
+        self.restarts = 0
+
+    def as_dict(self) -> dict:
+        return {"records": self.records, "pumpCalls": self.pump_calls,
+                "busyS": round(self.busy_s, 6), "restarts": self.restarts}
+
+
+class _ShardedPartitionManager(PartitionManager):
+    """PartitionManager whose pump round ends with a batched ack flush:
+    every driver that pumps through the manager surface (LambdaRunner
+    rounds, direct pump_all) keeps the committed offsets current at
+    round granularity without N per-partition broker commits.
+
+    ``workers_owned`` tells the manager the tier's per-partition worker
+    threads currently own the pumps: runner rounds (``LocalServer.pump``
+    drives every registered manager, this one included) SKIP the ingest
+    stage instead of becoming a second concurrent driver of the same
+    non-thread-safe pumps, while downstream stages keep pumping on the
+    caller's thread."""
+
+    def __init__(self, *args, acks: Optional[AckBatcher] = None,
+                 workers_owned: Optional[Callable[[], bool]] = None,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.acks = acks
+        self.workers_owned = workers_owned
+
+    def pump_all(self) -> int:
+        if self.workers_owned is not None and self.workers_owned():
+            return 0
+        n = super().pump_all()
+        if self.acks is not None:
+            self.acks.flush()
+        return n
+
+    def restart(self) -> None:
+        # Flush first: the rebuilt lambdas' pumps reset their cursor to
+        # the committed offset, and a pending (noted, unflushed) ack
+        # would needlessly widen the replay window.
+        if self.acks is not None:
+            self.acks.flush()
+        super().restart()
+
+
+class SequencerShardSet:
+    """The horizontally-sharded ingest tier (module docstring).
+
+    ``lambda_factory(ctx, checkpoints)`` builds one sequencer lambda for
+    a partition; ``checkpoints`` is that partition's scoped view (or
+    None when the tier has no checkpoint store)."""
+
+    def __init__(self, log, topic: str, group: str,
+                 lambda_factory: Callable[..., IPartitionLambda],
+                 checkpoints=None, auto_commit: bool = True,
+                 batch_acks: Optional[bool] = None):
+        self.log = log
+        self.topic = topic
+        self.group = group
+        self.checkpoints = checkpoints
+        topic_obj = log.topic(topic)
+        self.partitions = len(topic_obj.partitions)
+        self.router = PartitionRouter(self.partitions)
+        # Batched acks engage for self-checkpointing lambdas on a truly
+        # sharded topic; the single-partition pipeline keeps today's
+        # eager per-checkpoint commit timing bit-for-bit.
+        if batch_acks is None:
+            batch_acks = (not auto_commit) and self.partitions > 1
+        self.acks = AckBatcher(log, group, topic) if batch_acks else None
+
+        def build(ctx: LambdaContext) -> IPartitionLambda:
+            scoped = None if checkpoints is None else \
+                PartitionCheckpoints(checkpoints, ctx.partition)
+            lam = lambda_factory(ctx, scoped)
+            if self.acks is not None:
+                ctx.ack_batcher = self.acks
+            return lam
+
+        self.manager = _ShardedPartitionManager(
+            log, group, topic, build, auto_commit=auto_commit,
+            acks=self.acks,
+            workers_owned=lambda: self.workers_running)
+        # Guards the per-partition stats against concurrent workers; the
+        # worker-lifecycle flags below are only written under it too.
+        self._stats_lock = threading.Lock()
+        self.stats: Dict[int, _PartitionStats] = {
+            p: _PartitionStats() for p in self.manager.pumps}
+        self._workers: List[threading.Thread] = []
+        self._workers_run = False
+
+    # -- partition access ---------------------------------------------------
+    def live(self, partition: int) -> IPartitionLambda:
+        """The LIVE lambda owning a partition (post-crash-restart this
+        is the rebuilt instance — never cache it across restarts)."""
+        return self.manager.pumps[partition].lambda_
+
+    def partition_for(self, document_id: str) -> int:
+        return self.router.partition_for(document_id)
+
+    def sequencer_for(self, document_id: str) -> IPartitionLambda:
+        """The live sequencer lambda owning a document's home partition."""
+        return self.live(self.partition_for(document_id))
+
+    def sequencers(self) -> List[IPartitionLambda]:
+        return [self.live(p) for p in sorted(self.manager.pumps)]
+
+    # -- pumping ------------------------------------------------------------
+    def pump_partition(self, partition: int, limit: int = 10 ** 9) -> int:
+        """Drain one partition (busy-time accounted). Does NOT flush
+        batched acks — round drivers flush once per round; workers flush
+        after each call (their rounds are per-partition)."""
+        pump = self.manager.pumps[partition]
+        t0 = time.perf_counter()
+        n = pump.pump(limit=limit)
+        dt = time.perf_counter() - t0
+        if n:
+            with self._stats_lock:
+                st = self.stats[partition]
+                st.records += n
+                st.pump_calls += 1
+                st.busy_s += dt
+        return n
+
+    def pump_round(self, limit_per_partition: int = 10 ** 9) -> int:
+        """One round-robin pass over every partition + one batched ack
+        flush — the single-threaded drive loop (benches, tests). Refuses
+        to run while workers own the partitions."""
+        with self._stats_lock:
+            workers_running = self._workers_run
+        if workers_running:
+            raise RuntimeError(
+                "pump_round while partition workers are running: a "
+                "partition must only ever be pumped by its one owner")
+        total = 0
+        for p in sorted(self.manager.pumps):
+            total += self.pump_partition(p, limit_per_partition)
+        self.flush_acks()
+        return total
+
+    def flush_acks(self) -> int:
+        return self.acks.flush() if self.acks is not None else 0
+
+    # -- per-partition worker threads ----------------------------------------
+    def start_workers(self, idle_sleep_s: float = 0.0005) -> None:
+        """One daemon worker per partition — the deployment shape (one
+        worker per core). The hosting server must stop driving the deli
+        stage itself (auto_pump off / round pumps refused) while workers
+        run; downstream stages (scriptorium/scribe/broadcaster) still
+        pump wherever they always did."""
+        with self._stats_lock:
+            if self._workers_run:
+                return
+            self._workers_run = True
+        self._workers = [
+            threading.Thread(target=self._worker, args=(p, idle_sleep_s),
+                             name=f"ingest-partition-{p}", daemon=True)
+            for p in sorted(self.manager.pumps)]
+        for t in self._workers:
+            t.start()
+
+    def stop_workers(self, timeout: float = 5.0) -> None:
+        with self._stats_lock:
+            self._workers_run = False
+        stuck = []
+        for t in self._workers:
+            t.join(timeout=timeout)
+            if t.is_alive():
+                stuck.append(t.name)
+        if stuck:
+            # A worker wedged inside its pump (device compile, stalled
+            # lambda) still OWNS its partition: silently returning would
+            # let the caller's pump_round become a second concurrent
+            # driver of the same non-thread-safe sequencer. Re-flag and
+            # refuse.
+            with self._stats_lock:
+                self._workers_run = True
+            raise RuntimeError(
+                f"partition workers still alive after {timeout}s: "
+                f"{stuck} — the partitions stay worker-owned; retry "
+                "stop_workers with a longer timeout")
+        self._workers = []
+        self.flush_acks()
+
+    @property
+    def workers_running(self) -> bool:
+        with self._stats_lock:
+            return self._workers_run
+
+    def _worker(self, partition: int, idle_sleep_s: float) -> None:
+        while True:
+            with self._stats_lock:
+                if not self._workers_run:
+                    return
+            n = self.pump_partition(partition)
+            if n:
+                self.flush_acks()
+            else:
+                time.sleep(idle_sleep_s)
+
+    # -- occupancy / introspection -------------------------------------------
+    def raw_backlog_partition(self, partition: int) -> int:
+        """One partition's un-pumped broker-record backlog (end offset
+        minus the group's committed offset) — the unit admission's queue
+        accounting polls (one submit batch == one boxcar record; see the
+        PR 6 phantom-drain fix)."""
+        part = self.log.topic(self.topic).partitions[partition]
+        return max(0, part.end_offset
+                   - self.log.committed(self.group, self.topic, partition))
+
+    def raw_backlog_by_partition(self) -> Dict[int, int]:
+        return {p: self.raw_backlog_partition(p)
+                for p in sorted(self.manager.pumps)}
+
+    def raw_backlog(self) -> int:
+        return sum(self.raw_backlog_by_partition().values())
+
+    def occupancy_partition(self, partition: int) -> dict:
+        """Raw backlog + the owning sequencer's occupancy hints for one
+        partition (hints absent for lambdas that publish none)."""
+        out = {"partition": partition,
+               "backlog": self.raw_backlog_partition(partition)}
+        lam = self.live(partition)
+        hints = getattr(lam, "occupancy_hints", None)
+        if hints is not None:
+            out["hints"] = hints()
+        return out
+
+    def partition_stats(self) -> List[dict]:
+        """Per-partition health/metrics block (monitor watch_partitions):
+        offsets, lag, staged work, and the pump accounting."""
+        topic_obj = self.log.topic(self.topic)
+        out = []
+        with self._stats_lock:
+            pump_stats = {p: st.as_dict() for p, st in self.stats.items()}
+        for p in sorted(self.manager.pumps):
+            end = topic_obj.partitions[p].end_offset
+            committed = self.log.committed(self.group, self.topic, p)
+            row = {"partition": p, "endOffset": end,
+                   "committedOffset": committed,
+                   "lag": max(0, end - committed)}
+            lam = self.live(p)
+            hints = getattr(lam, "occupancy_hints", None)
+            if hints is not None:
+                h = hints()
+                row["stagedOps"] = int(h.get("staged_ops", 0))
+                row["ringOccupancy"] = int(h.get("ring_occupancy", 0))
+            row.update(pump_stats.get(p, {}))
+            out.append(row)
+        return out
+
+    # -- admission wiring ----------------------------------------------------
+    def register_admission(self, controller, tenant_id: str) -> None:
+        """Register one occupancy source per partition with the
+        admission controller's PARTITION channel (fairness gate). These
+        feeds do NOT add into the controller's global queue depth — the
+        hosting server's aggregate ``core:<tenant>`` source already
+        counts every partition's backlog, and summing both would
+        re-introduce exactly the phantom-depth inflation the PR 6
+        record accounting removed (regression-tested in
+        tests/test_sharded_ingest.py)."""
+        for p in sorted(self.manager.pumps):
+            lam = self.live(p)
+            has_hints = getattr(lam, "occupancy_hints", None) is not None
+            controller.add_partition_source(
+                p,
+                queue_depth=lambda p=p: self.raw_backlog_partition(p),
+                # Resolve the lambda at poll time: a crash-restart swaps
+                # the instance and a captured handle would go stale.
+                hints=(lambda p=p: self.live(p).occupancy_hints())
+                if has_hints else None,
+                # Tenant-scoped: alfred shares ONE controller across
+                # tenant cores, and each core's feeds must coexist.
+                scope=tenant_id)
+
+    # -- crash / restart ------------------------------------------------------
+    def restart_partition(self, partition: int) -> None:
+        """Crash-restart one partition's lambda (rebuilt from its scoped
+        checkpoints; the pump replays from the last committed offset)."""
+        self.flush_acks()
+        self.manager.pumps[partition].restart()
+        with self._stats_lock:
+            self.stats[partition].restarts += 1
+
+    def restart_all(self) -> None:
+        self.manager.restart()
+        with self._stats_lock:
+            for st in self.stats.values():
+                st.restarts += 1

@@ -33,7 +33,7 @@ from __future__ import annotations
 import hashlib
 import random
 import time
-from typing import Callable, List, Tuple
+from typing import Callable, List, Optional, Tuple
 
 from ..telemetry.counters import increment
 
@@ -132,27 +132,46 @@ class FaultyMessageLog:
         self.inner = inner
         self.plan = plan
         self.fault_topics = frozenset(topics)
-        # Delayed deliveries: (due_send_ordinal, topic, key, value),
-        # released in due order before later sends (deterministic).
-        self._held: List[Tuple[int, str, str, object]] = []
+        # Delayed deliveries: (due_send_ordinal, topic, partition, key,
+        # value) with partition None for keyed sends, released in due
+        # order before later sends (deterministic).
+        self._held: List[Tuple[int, str, Optional[int], str, object]] = []
         self._sends = 0
 
     # -- producer (the injection point) -------------------------------------
     def send(self, topic: str, key: str, value):
+        return self._faulty_send(topic, None, key, value)
+
+    def send_to(self, topic: str, partition: int, key: str, value):
+        """Explicit-partition produce rides the SAME fault schedule as
+        keyed sends — the sharded ingest tier (server/sharding.py)
+        routes documents itself, and its traffic must stay inside the
+        chaos envelope, not silently bypass it via __getattr__."""
+        return self._faulty_send(topic, int(partition), key, value)
+
+    def _faulty_send(self, topic: str, partition: Optional[int], key: str,
+                     value):
         if topic not in self.fault_topics:
-            return self.inner.send(topic, key, value)
+            return self._deliver(topic, partition, key, value)
         self._sends += 1
         self._release_due()
         action, k = self.plan.delivery()
         if action == DROP:
             return None
         if action == DUP:
-            self.inner.send(topic, key, value)
-            return self.inner.send(topic, key, value)
+            self._deliver(topic, partition, key, value)
+            return self._deliver(topic, partition, key, value)
         if action == DELAY:
-            self._held.append((self._sends + k, topic, key, value))
+            self._held.append((self._sends + k, topic, partition, key,
+                               value))
             return None
-        return self.inner.send(topic, key, value)
+        return self._deliver(topic, partition, key, value)
+
+    def _deliver(self, topic: str, partition: Optional[int], key: str,
+                 value):
+        if partition is None:
+            return self.inner.send(topic, key, value)
+        return self.inner.send_to(topic, partition, key, value)
 
     def _release_due(self) -> None:
         if not self._held:
@@ -161,15 +180,15 @@ class FaultyMessageLog:
         if not due:
             return
         self._held = [h for h in self._held if h[0] > self._sends]
-        for _, topic, key, value in due:
-            self.inner.send(topic, key, value)
+        for _, topic, partition, key, value in due:
+            self._deliver(topic, partition, key, value)
 
     def flush_delayed(self) -> int:
         """Deliver every still-held message (scenario teardown: nothing
         may stay lost-in-flight before the convergence assert)."""
         held, self._held = self._held, []
-        for _, topic, key, value in held:
-            self.inner.send(topic, key, value)
+        for _, topic, partition, key, value in held:
+            self._deliver(topic, partition, key, value)
         return len(held)
 
     @property
@@ -197,6 +216,24 @@ class SkewedClock:
     def __call__(self) -> float:
         t = self.base()
         return t + self.skew_s + self.drift * (t - self._t0)
+
+
+def crash_partition(plan: FaultPlan, manager,
+                    site: str = "partition-crash"):
+    """Partition-worker crash chaos: deterministically pick one of a
+    PartitionManager's pumps (or none) from the plan and crash-restart
+    it — the lambda is rebuilt from its checkpoint store and the pump
+    replays from the last committed offset, exactly the recovery the
+    sharded ingest tier promises (docs/ingest_sharding.md). The draw is
+    recorded in the plan trace, so run-twice fingerprints pin both WHEN
+    a crash happened and WHICH partition it hit. Returns the crashed
+    partition index, or None for the no-crash draw."""
+    pumps = sorted(manager.pumps)
+    idx = plan.pick(len(pumps) + 1, site=site)
+    if idx == len(pumps):
+        return None  # the no-crash slot — crashes stay occasional
+    manager.pumps[pumps[idx]].restart()
+    return pumps[idx]
 
 
 def stall(plan: FaultPlan,
