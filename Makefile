@@ -3,7 +3,8 @@
 
 .PHONY: test soak bench dryrun record-corpus historian-smoke \
 	summarize-smoke trace-smoke pipeline-smoke fused-smoke \
-	paged-smoke catchup-smoke obs-smoke ingest-smoke bench-trend \
+	paged-smoke catchup-smoke obs-smoke ingest-smoke e2e-smoke \
+	bench-trend \
 	lint-analysis \
 	lint-changed lint-races layer-check check
 
@@ -147,13 +148,26 @@ overload-smoke:
 ingest-smoke:
 	JAX_PLATFORMS=cpu python bench.py ingest-smoke
 
+# Fleet-scale capacity soak over the WHOLE pipeline (docs/capacity.md):
+# a seeded open-loop workload (Poisson writers over a Zipf fleet +
+# catch-up readers) drives sharded ingest + sharded broadcast + scribe
+# + the read path at once, chaos (partition crashes + reconnect
+# avalanches) inside the measured envelope. The grader binary-searches
+# the sustained admitted rate at which the admission ladder stays
+# <= THROTTLE and the flush/reader SLOs hold, attributes the binding
+# bottleneck per tier, and requires the capacity point to reproduce
+# bit-identically run-twice. Stamps BENCH_E2E_LAST.json (the record
+# `bench.py trend` gates between comparable hosts).
+e2e-smoke:
+	JAX_PLATFORMS=cpu python bench.py e2e-smoke
+
 # The pre-merge gate: layering/cycles + static analysis (incl. the
 # focused race gate) + the summarize/trace/pipeline/fused/paged/catchup/
-# overload/obs/ingest smokes + the bench trend (report-only here) + the
-# full test suite.
+# overload/obs/ingest/e2e smokes + the bench trend (report-only here) +
+# the full test suite.
 check: layer-check lint-analysis lint-races summarize-smoke trace-smoke \
 		pipeline-smoke fused-smoke paged-smoke catchup-smoke \
-		overload-smoke obs-smoke ingest-smoke test
+		overload-smoke obs-smoke ingest-smoke e2e-smoke test
 	python bench.py trend --report-only
 
 # The round-end randomized-evidence ritual: 50-trial soaks over every

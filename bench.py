@@ -3299,6 +3299,149 @@ def ingest_smoke() -> int:
     return 0 if all(checks.values()) else 1
 
 
+def e2e_smoke() -> int:
+    """Whole-pipeline capacity soak (`make e2e-smoke`, docs/capacity.md):
+    the first smoke that grades alfred→deli→broadcast→scribe→readers as
+    ONE system. An open-loop seeded workload (capacity/workload.py —
+    Poisson writer arrivals over a Zipf-popular fleet + a catch-up
+    reader stream) drives a TpuLocalServer with sharded ingest, sharded
+    broadcast, scribe summarization, and the catch-up read path all
+    live, with plan-driven chaos (partition crash-restarts + reconnect
+    avalanches) INSIDE the measured envelope. The grader binary-searches
+    the offered-rate axis for the sustained admitted rate at which the
+    admission ladder stays <= THROTTLE, flush p99 (virtual) holds
+    budget, and readers adopt artifacts — then runs the capacity point
+    TWICE and requires bit-identical fingerprints + end state.
+
+    Stamps BENCH_E2E_LAST.json with the capacity figure (sustained
+    ops/s and readers/s at SLO) and the per-tier bottleneck attribution
+    `bench.py trend` consumes. Figures are VIRTUAL-clock and
+    budget-normalized (drain budget in records/tick), so they grade
+    pipeline behavior under overload — docs/capacity.md carries the
+    honesty notes for 1-host CPU-fallback runs. Exit 0 iff every check
+    passes."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    from fluidframework_tpu.capacity import (CapacityGrader, FleetSoak,
+                                             FleetSpec, WorkloadModel,
+                                             WorkloadSpec)
+    from fluidframework_tpu.server.local_server import TpuLocalServer
+    from fluidframework_tpu.testing.faultinject import FaultPlan
+    from fluidframework_tpu.telemetry import counters as _counters
+
+    _counters.reset()
+
+    class _Cfg(dict):
+        def get(self, k, d=None):
+            return dict.get(self, k, d)
+
+    base = WorkloadSpec(documents=12, writers_per_document=2, seed=29,
+                        writer_rate_per_s=600.0, reader_rate_per_s=150.0,
+                        zipf_s=1.0, tick_s=0.02)
+    spec = FleetSpec(partitions=2, broadcaster_shards=2,
+                     broadcast_queue_limit=4096,
+                     subscribers_per_document=2, ticks=40,
+                     settle_ticks=10, drain_budget_per_partition=24,
+                     queue_limit=512, crash_every=16,
+                     avalanche_readers=16)
+
+    def factory(sp, adm):
+        return TpuLocalServer(
+            auto_pump=False, partitions=sp.partitions, admission=adm,
+            config=_Cfg({"broadcaster.shards": sp.broadcaster_shards,
+                         "broadcaster.queueLimit": sp.broadcast_queue_limit,
+                         "catchup.enabled": True}))
+
+    def run_soak(mult):
+        # Fresh seeded model + plan per probe: same mult => the same
+        # run, bit for bit — the grader's determinism contract.
+        model = WorkloadModel(base.scaled(mult))
+        plan = FaultPlan(seed=31, reset=0.06)
+        return FleetSoak(model, spec, plan=plan,
+                         server_factory=factory).run()
+
+    def probe(mult):
+        soak = run_soak(mult)
+        slo = soak.slo()
+        return {"ok": slo["ok"], "pressures": soak.tier_pressures(),
+                "slo": slo,
+                "sustained_ops_per_sec": round(
+                    soak.sustained_ops_per_sec, 1),
+                "readers_per_sec": round(soak.readers_per_sec, 1)}
+
+    grade = CapacityGrader(probe, lo=0.5, hi=6.0, iters=4).search()
+    cap_mult = grade.capacity_mult
+
+    # The acceptance leg: the CAPACITY point run twice, chaos on, must
+    # converge to an identical end state (fingerprint equality).
+    final_a = run_soak(cap_mult)
+    final_b = run_soak(cap_mult)
+    slo_final = final_a.slo()
+
+    chaos_on = bool(final_a.partition_restarts) and final_a.avalanches > 0
+    checks = {
+        "capacity_found": cap_mult > 0 and grade.passing is not None,
+        "capacity_slo_holds": bool(slo_final["ok"]),
+        "ladder_le_throttle_at_capacity": slo_final["ladder_le_throttle"],
+        "chaos_inside_envelope": chaos_on,
+        "run_twice_fingerprint_identical":
+            final_a.fingerprint() == final_b.fingerprint(),
+        "converged_end_state_identical":
+            final_a.final_seq == final_b.final_seq
+            and final_a.stream_digests == final_b.stream_digests,
+        "readers_adopt_artifacts": slo_final["reader_adoption_ok"]
+            and final_a.readers_adopted > 0,
+        "refresh_cost_scales_with_epochs": final_a.refresh_dispatches
+            <= 4 * max(1, final_a.refresh_epochs),
+        "bottleneck_attributed": grade.bottleneck is not None,
+    }
+    record = {
+        "metric": "e2e-smoke",
+        "backend": jax.default_backend(),
+        "comparable": jax.default_backend() not in ("cpu",),
+        "workload": {"documents": base.documents,
+                     "writers_per_document": base.writers_per_document,
+                     "arrival": base.arrival,
+                     "base_writer_rate_per_s": base.writer_rate_per_s,
+                     "base_reader_rate_per_s": base.reader_rate_per_s,
+                     "zipf_s": base.zipf_s, "tick_s": base.tick_s,
+                     "seed": base.seed},
+        "fleet": {"partitions": spec.partitions,
+                  "broadcaster_shards": spec.broadcaster_shards,
+                  "ticks": spec.ticks, "settle_ticks": spec.settle_ticks,
+                  "drain_budget_per_partition":
+                      spec.drain_budget_per_partition,
+                  "queue_limit": spec.queue_limit,
+                  "crash_every": spec.crash_every,
+                  "avalanche_readers": spec.avalanche_readers},
+        "grade": grade.as_dict(),
+        "capacity": {
+            "rate_mult": round(cap_mult, 4),
+            "offered_ops_per_sec": round(
+                base.writer_rate_per_s * cap_mult, 1),
+            "sustained_ops_per_sec": round(
+                final_a.sustained_ops_per_sec, 1),
+            "readers_per_sec": round(final_a.readers_per_sec, 1),
+            "reader_adoption": round(final_a.reader_adoption, 4),
+            "saturated": grade.saturated,
+            "bottleneck": grade.bottleneck,
+            "pressure_ranking": [[t, round(v, 4)]
+                                 for t, v in grade.pressure_ranking],
+        },
+        "final_run": final_a.as_dict(),
+        "fingerprints": {"run_a": final_a.fingerprint(),
+                         "run_b": final_b.fingerprint()},
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    _write_json_atomic(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_E2E_LAST.json"), record)
+    print(json.dumps(record))
+    return 0 if all(checks.values()) else 1
+
+
 def obs_smoke() -> int:
     """CPU smoke for the device-resident telemetry planes + compile
     observatory (`make obs-smoke`, docs/observability.md v2). Drives
@@ -3561,33 +3704,81 @@ def bench_trend(strict: bool = True) -> int:
     import glob as _glob
 
     repo = os.path.dirname(os.path.abspath(__file__))
-    paths = sorted(_glob.glob(os.path.join(repo, "BENCH_r*.json")))
-    records = []
-    for path in paths:
-        try:
-            with open(path) as f:
-                records.append((os.path.basename(path), json.load(f)))
-        except (OSError, ValueError) as err:
-            print(f"# skipping {os.path.basename(path)}: {err}")
-    if len(records) < 2:
-        print(json.dumps({"metric": "bench-trend", "records": len(records),
-                          "ok": True, "note": "need >= 2 records"}))
-        return 0
 
+    def load_records(pattern, last_name=None):
+        out = []
+        names = sorted(_glob.glob(os.path.join(repo, pattern)))
+        if last_name:
+            last_path = os.path.join(repo, last_name)
+            if os.path.exists(last_path):
+                names.append(last_path)
+        for path in names:
+            try:
+                with open(path) as f:
+                    out.append((os.path.basename(path), json.load(f)))
+            except (OSError, ValueError) as err:
+                print(f"# skipping {os.path.basename(path)}: {err}")
+        return out
+
+    # The e2e capacity gate rides the SAME policy over its own history
+    # (BENCH_E2E_r*.json committed records, BENCH_E2E_LAST.json as the
+    # latest candidate): sustained ops/s and readers/s at SLO regress
+    # > 20% only between comparable-host records; CPU-fallback figures
+    # stay report-only trajectories.
+    e2e_lines, e2e_regressions, e2e_count = _trend_gate(
+        load_records("BENCH_E2E_r*.json", "BENCH_E2E_LAST.json"),
+        lambda m: "ops_per_sec" in m or m.endswith("per_sec"))
+
+    records = load_records("BENCH_r*.json")
+    if len(records) < 2:
+        for line in e2e_lines:
+            print(line)
+        summary = {"metric": "bench-trend", "records": len(records),
+                   "e2e_records": e2e_count,
+                   "metrics_tracked": len(e2e_lines),
+                   "regressions": e2e_regressions, "strict": strict,
+                   "ok": not (strict and e2e_regressions),
+                   "note": "need >= 2 records"}
+        print(json.dumps(summary))
+        return 0 if summary["ok"] else 1
+
+    lines, regressions, _ = _trend_gate(
+        records, lambda m: "ops_per_sec" in m)
+    regressions = regressions + e2e_regressions
+    for line in lines + e2e_lines:
+        print(line)
+    latest_name, latest = records[-1]
+    latest_key = (latest.get("backend"), bool(latest.get("comparable")))
+    summary = {"metric": "bench-trend", "records": len(records),
+               "e2e_records": e2e_count,
+               "latest": latest_name, "latest_host": list(latest_key),
+               "metrics_tracked": len(lines) + len(e2e_lines),
+               "regressions": regressions,
+               "strict": strict,
+               "ok": not (strict and regressions)}
+    print(json.dumps(summary))
+    return 0 if summary["ok"] else 1
+
+
+def _trend_gate(records, metric_filter):
+    """One trend-gate pass over a record series: trajectories for every
+    metric passing the filter, regressions where the LATEST record
+    drops > 20% against the best prior comparable-host record.
+    Trajectories print for every matching metric seen in ANY record — a
+    metric that VANISHED from (or collapsed to 0 in) the latest record
+    is the worst regression shape and must not slip the gate by
+    absence; the hard verdict applies only where a comparable-host
+    prior exists. Returns (lines, regressions, record_count)."""
+    if len(records) < 2:
+        return [], [], len(records)
     latest_name, latest = records[-1]
     latest_key = (latest.get("backend"), bool(latest.get("comparable")))
     flat = [(name, _flatten_metrics(rec),
              (rec.get("backend"), bool(rec.get("comparable"))))
             for name, rec in records]
     latest_flat = flat[-1][1]
-
-    # Trajectories print for every ops_per_sec-style metric seen in ANY
-    # record — a metric that VANISHED from (or collapsed to 0 in) the
-    # latest record is the worst regression shape and must not slip the
-    # gate by absence. The hard verdict applies only where a
-    # comparable-host prior exists.
     all_metrics = sorted({m for _, vals, _ in flat for m in vals
-                          if "ops_per_sec" in m})
+                          if metric_filter(m)})
     regressions = []
     lines = []
     for metric in all_metrics:
@@ -3624,16 +3815,7 @@ def bench_trend(strict: bool = True) -> int:
                     verdict += "  (drop on non-comparable host: "\
                                "report-only)"
         lines.append(f"{metric}: {traj}{verdict}")
-    for line in lines:
-        print(line)
-    summary = {"metric": "bench-trend", "records": len(records),
-               "latest": latest_name, "latest_host": list(latest_key),
-               "metrics_tracked": len(lines),
-               "regressions": regressions,
-               "strict": strict,
-               "ok": not (strict and regressions)}
-    print(json.dumps(summary))
-    return 0 if summary["ok"] else 1
+    return lines, regressions, len(records)
 
 
 if __name__ == "__main__":
@@ -3655,6 +3837,8 @@ if __name__ == "__main__":
         sys.exit(obs_smoke())
     if len(sys.argv) > 1 and sys.argv[1] == "ingest-smoke":
         sys.exit(ingest_smoke())
+    if len(sys.argv) > 1 and sys.argv[1] == "e2e-smoke":
+        sys.exit(e2e_smoke())
     if len(sys.argv) > 1 and sys.argv[1] == "trend":
         sys.exit(bench_trend(strict="--report-only" not in sys.argv))
     try:

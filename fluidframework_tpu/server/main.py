@@ -57,6 +57,13 @@ DEFAULT_CONFIG = {
     "historian": {"host": "127.0.0.1", "port": 7081, "upstream": None,
                   "url": None, "refTtlS": 2.0,
                   "maxBytes": 256 * 1024 * 1024, "monitorPort": 0},
+    # Read-tier catch-up artifact push-through (server/readpath.py
+    # ArtifactPushThrough, docs/read_path.md): a `tpu-deli` worker with a
+    # configured historian url pushes refreshed artifacts to the tier's
+    # /historian/catchup route on this cadence — default ON; connecting
+    # clients then get summary + artifact in one round trip without the
+    # worker in the path.
+    "catchup": {"push": True, "intervalS": 0.25},
 }
 
 
@@ -236,8 +243,32 @@ def build_worker(cfg: dict, stages: List[str]):
                     DELTAS_TOPIC, p, "__window__", w)
                 return lam
 
-            runner.add(PartitionManager(
+            deli_mgr = runner.add(PartitionManager(
                 log, "deli", RAW_TOPIC, make_tpu_deli, auto_commit=False))
+
+            # Catch-up artifact push-through (default-on): refreshed
+            # artifacts land in the historian tier's catch-up cache so
+            # clients connecting through the historian adopt `summary +
+            # delta` in one round trip (docs/read_path.md). The supplier
+            # reads LIVE lambdas from the manager's pumps — a crashed/
+            # restarted partition's replacement lambda is picked up, the
+            # dead one dropped. A worker without a historian url (or
+            # with catchup.push=false) runs exactly as before.
+            historian_url = cfg.get("historian", {}).get("url")
+            if historian_url and view.get("catchup.push", True):
+                from .historian import notify_catchup_refresh
+                from .readpath import ArtifactPushThrough
+
+                push = ArtifactPushThrough(
+                    sequencers=lambda m=deli_mgr: [
+                        p.lambda_ for p in m.pumps.values()],
+                    scribe_checkpoints=scribe_ckpt,
+                    historian=historian,
+                    tenant_id=tenant,
+                    publish=lambda t, d, a, _url=historian_url:
+                        notify_catchup_refresh(_url, t, d, a),
+                    interval_s=float(view.get("catchup.intervalS", 0.25)))
+                runner.add_ticker(push.pump)
         elif stage == "scriptorium":
             runner.add(PartitionManager(
                 log, "scriptorium", DELTAS_TOPIC,

@@ -318,6 +318,68 @@ class ServiceMonitor:
 
         self.add_probe(name, probe)
 
+    def watch_capacity(self, name: str, source) -> None:
+        """Probe over the last fleet-scale capacity soak (capacity/,
+        docs/capacity.md): loads the stamped record — `source` is a
+        BENCH_E2E_LAST.json path or a callable returning the record
+        dict — and surfaces the graded capacity figure plus the
+        binding-bottleneck attribution in /health. Each probe also
+        refreshes per-tier pressure gauges through the PR 12 `bounded()`
+        cardinality guard, so /metrics.prom carries
+        `fluid_capacity_tier_pressure_<tier>` with the tier set fixed by
+        the soak, never growing per-label. A host that has never run the
+        soak (missing/unreadable record) reports {"available": False}
+        without failing health."""
+
+        def probe() -> dict:
+            rec = None
+            if callable(source):
+                rec = source()
+            else:
+                try:
+                    with open(source, "r", encoding="utf-8") as fh:
+                        rec = json.load(fh)
+                except (OSError, ValueError):
+                    process_counters.record_swallow(
+                        "monitor.capacity_record")
+            if not isinstance(rec, dict):
+                return {"available": False}
+            cap = rec.get("capacity") or {}
+            soak = rec.get("final_run") or {}
+            # The at-fail pressure ranking is what named the bottleneck;
+            # a bare SoakResult dict (no grade wrapper) falls back to
+            # its own tier pressures.
+            pressures = (dict(cap.get("pressure_ranking") or [])
+                         or dict(soak.get("tier_pressures")
+                                 or rec.get("tier_pressures") or {}))
+            out = {
+                "available": True,
+                "ok": rec.get("ok"),
+                "backend": rec.get("backend"),
+                "capacityMult": (rec.get("grade") or {}).get(
+                    "capacity_mult"),
+                "offeredOpsPerSec": cap.get("offered_ops_per_sec"),
+                "sustainedOpsPerSec": (cap.get("sustained_ops_per_sec")
+                                       or soak.get("sustained_ops_per_sec")
+                                       or rec.get("sustained_ops_per_sec")),
+                "readersPerSec": cap.get("readers_per_sec"),
+                "bottleneck": (cap.get("bottleneck")
+                               or (max(pressures, key=pressures.get)
+                                   if pressures else None)),
+                "tierPressures": {t: round(float(v), 4)
+                                  for t, v in pressures.items()},
+            }
+            if out["sustainedOpsPerSec"] is not None:
+                process_counters.gauge("capacity.sustained_ops_per_sec",
+                                       float(out["sustainedOpsPerSec"]))
+            for tier, value in pressures.items():
+                process_counters.gauge(
+                    process_counters.bounded("capacity.tier_pressure",
+                                             tier), float(value))
+            return out
+
+        self.add_probe(name, probe)
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "ServiceMonitor":
         self._thread = threading.Thread(target=self._httpd.serve_forever,

@@ -145,10 +145,23 @@ class LambdaRunner:
 
     def __init__(self):
         self.managers: List[PartitionManager] = []
+        # Epoch-cadence side work that rides the pump loop without being
+        # a consumer-group stage (the read-tier artifact push-through):
+        # each ticker is a callable returning work done; tickers run at
+        # quiescence so they see flush-boundary state, and they rate-
+        # limit themselves (a ticker firing every pump would turn the
+        # idle poll loop busy).
+        self.tickers: List[Callable[[], int]] = []
 
     def add(self, manager: PartitionManager) -> PartitionManager:
         self.managers.append(manager)
         return manager
+
+    def add_ticker(self, ticker: Callable[[], int]) -> None:
+        self.tickers.append(ticker)
+
+    def _tick(self) -> int:
+        return sum(t() for t in self.tickers)
 
     def pump(self) -> int:
         total = 0
@@ -156,7 +169,7 @@ class LambdaRunner:
             n = sum(m.pump_all() for m in self.managers)
             total += n
             if n == 0:
-                return total
+                return total + self._tick()
 
     def close(self) -> None:
         pass
@@ -199,7 +212,7 @@ class OverlappedLambdaRunner(LambdaRunner):
             n += sum(f.result() for f in futures)
             total += n
             if n == 0:
-                return total
+                return total + self._tick()
 
     def close(self) -> None:
         if self._pool is not None:

@@ -135,6 +135,92 @@ class CatchupCache:
         }
 
 
+class ArtifactPushThrough:
+    """Worker-side catch-up refresh epochs for the MULTI-PROCESS
+    topology (server/main.py `tpu-deli` stage). The in-process
+    LocalServer joins sequencer snapshots with scribe checkpoints inside
+    its own refresh_catchup; a deployed worker has no CatchupCache of
+    its own — it builds the same artifacts and PUSHES them to the
+    historian tier's `/historian/catchup` route, where connecting
+    clients fetch summary + artifact in one round trip (the
+    docs/read_path.md contract, now spanning processes).
+
+    Epochs ride the worker's pump loop as a runner ticker (rate-limited
+    to `interval_s`), each costing one batched device extraction over
+    every dirty document together (TpuSequencerLambda.catchup_snapshot)
+    — push cost scales with dirty docs per epoch, never with connecting
+    clients. A doc whose scribe checkpoint trails the sequencer skips
+    the epoch (stale-but-correct: its previous artifact keeps serving).
+    The change generation is marked published ONLY after the publish
+    callback reports success, so a dead historian leaves the doc dirty
+    and the artifact retries next epoch instead of silently dropping."""
+
+    def __init__(self, sequencers, scribe_checkpoints, historian,
+                 tenant_id: str, publish, interval_s: float = 0.25,
+                 clock=None):
+        import time as _time
+
+        self.sequencers = sequencers          # () -> live sequencer lambdas
+        self.scribe_checkpoints = scribe_checkpoints
+        self.historian = historian            # summary-ref source (get_ref)
+        self.tenant_id = tenant_id
+        self.publish = publish                # (tenant, doc, artifact) -> bool
+        self.interval_s = float(interval_s)
+        self.clock = clock or _time.monotonic
+        self._last: Optional[float] = None
+        self.epochs = 0
+        self.published = 0
+        self.skipped = 0
+        self.failed = 0
+
+    def pump(self, force: bool = False) -> int:
+        """One rate-limited refresh epoch; returns artifacts pushed."""
+        now = self.clock()
+        if not force and self._last is not None \
+                and now - self._last < self.interval_s:
+            return 0
+        self._last = now
+        bodies: Dict[str, dict] = {}
+        owner: Dict[str, Any] = {}
+        for lam in self.sequencers():
+            snap = getattr(lam, "catchup_snapshot", None)
+            if snap is None:
+                continue  # scalar deli: no lane state to extract from
+            for doc_id, body in snap().items():
+                bodies[doc_id] = body
+                owner[doc_id] = lam
+        if not bodies:
+            return 0
+        self.epochs += 1
+        by_doc = {row["documentId"]: row
+                  for row in self.scribe_checkpoints.find(
+                      lambda d: d.get("documentId") in bodies)}
+        pushed = 0
+        for doc_id, body in bodies.items():
+            row = by_doc.get(doc_id)
+            if row is None or int(row["sequenceNumber"]) != body["seq"]:
+                self.skipped += 1
+                increment("catchup.publish_skipped")
+                continue
+            sha = self.historian.store(self.tenant_id,
+                                       doc_id).get_ref("main")
+            artifact = build_artifact(body, row["minimumSequenceNumber"],
+                                      row["quorum"], sha)
+            if self.publish(self.tenant_id, doc_id, artifact):
+                pushed += 1
+                self.published += 1
+                increment("catchup.pushed")
+                owner[doc_id].catchup_mark_published(doc_id, body["gen"])
+            else:
+                self.failed += 1
+                increment("catchup.push_failed")
+        return pushed
+
+    def stats(self) -> dict:
+        return {"epochs": self.epochs, "published": self.published,
+                "skipped": self.skipped, "failed": self.failed}
+
+
 def quorum_ordinals(quorum_snapshot: dict) -> Dict[str, int]:
     """wire client id -> quorum ordinal (its join sequence number) — the
     ordinal space a CLIENT's runtime uses for merge perspectives, derived

@@ -204,6 +204,15 @@ def reset_histograms() -> None:
         _hists.clear()
 
 
+def reset_stage(name: str) -> None:
+    """Drop ONE stage's latency histogram (window + buckets). Harnesses
+    that feed a private SLO stage (the capacity soak's virtual-clock
+    flush window) clear it per run so back-to-back runs in one process
+    grade on their own samples, not the previous run's residue."""
+    with _lock:
+        _hists.pop(name, None)
+
+
 def reset() -> None:
     """Test isolation only."""
     with _lock:

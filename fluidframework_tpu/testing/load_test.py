@@ -15,6 +15,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from ..capacity.workload import OpMix, closed_loop_schedule
 from ..dds.counter import SharedCounter
 from ..dds.map import SharedMap
 from ..dds.sequence import SharedString
@@ -80,8 +81,10 @@ class LoadRunner:
     def _one_op(self, rng: random.Random, client_index: int, op_index: int,
                 container: Container, profile: LoadProfile) -> None:
         ds = container.runtime.get_datastore("load")
-        kind = rng.choices(("map", "insert", "remove", "counter"),
-                           weights=profile.weights)[0]
+        # The one op-mix implementation in the tree (capacity/workload.py):
+        # consumes the profile RNG exactly as the historical inline
+        # rng.choices did, so seeded replays pick identical kinds.
+        kind = OpMix(profile.weights).draw(rng)
         if kind == "map":
             # JSON-canonical values only: the writer keeps the submitted
             # object while replicas see its wire round-trip (a tuple would
@@ -156,18 +159,24 @@ class LoadRunner:
             docs[doc_id] = self._setup_document(
                 doc_id, profile.clients_per_document)
         started = time.perf_counter()
-        for doc_id, containers in docs.items():
-            for op_index in range(profile.ops_per_client):
-                for client_index, container in enumerate(containers):
-                    if (profile.reconnect_probability
-                            and rng.random() < profile.reconnect_probability):
-                        container.reconnect()
-                    if profile.keystroke_trace:
-                        self._trace_op(rng, doc_id, client_index, container)
-                    else:
-                        self._one_op(rng, client_index, op_index, container,
-                                     profile)
-                    result.total_ops += 1
+        # The shared closed-loop schedule (capacity/workload.py): the
+        # same (doc, op, client) nesting order this rig has always
+        # driven, now defined once for rig and soak alike.
+        doc_list = list(docs.items())
+        for d, op_index, client_index in closed_loop_schedule(
+                profile.documents, profile.clients_per_document,
+                profile.ops_per_client):
+            doc_id, containers = doc_list[d]
+            container = containers[client_index]
+            if (profile.reconnect_probability
+                    and rng.random() < profile.reconnect_probability):
+                container.reconnect()
+            if profile.keystroke_trace:
+                self._trace_op(rng, doc_id, client_index, container)
+            else:
+                self._one_op(rng, client_index, op_index, container,
+                             profile)
+            result.total_ops += 1
         result.elapsed_s = time.perf_counter() - started
         # -- convergence audit (the race detector role) ---------------------
         for doc_id, containers in docs.items():

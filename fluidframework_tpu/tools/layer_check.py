@@ -45,12 +45,19 @@ ALLOWED: Dict[str, Set[str]] = {
     # reference's local-driver -> local-server edge, SURVEY.md §1).
     "loader": {"core", "protocol", "runtime", "telemetry", "server", "dds"},
     "framework": {"core", "protocol", "dds", "runtime"},
+    # capacity is the fleet-soak subsystem: open-loop workload models +
+    # the whole-pipeline grader. It drives the server stack directly and
+    # sits BELOW testing (the load rig folds its op-mix/schedule onto
+    # capacity.workload); chaos plans are injected duck-typed, so the
+    # edge to testing/faultinject never exists at import time.
+    "capacity": {"core", "protocol", "mergetree", "telemetry", "server"},
     # testing hosts the load rig + snapshot corpus, which drive the full
     # stack like the reference's test-utils/localLoader does; the fault
     # injector counts its injected faults (telemetry sits below server,
-    # which testing already imports).
+    # which testing already imports); the load rig's op mix + schedule
+    # live in capacity.workload (one arrival-process implementation).
     "testing": {"core", "protocol", "dds", "runtime", "loader", "server",
-                "telemetry"},
+                "telemetry", "capacity"},
     "hosts": {"core", "loader", "runtime", "framework"},
     "client_api": {"core", "dds", "loader"},
     "agents": {"core", "dds", "loader", "framework"},

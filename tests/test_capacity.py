@@ -1,0 +1,376 @@
+"""Fleet-scale capacity soak (fluidframework_tpu/capacity/,
+docs/capacity.md): arrival-model determinism and shape pins, the shared
+op-mix/schedule fold consumed by testing/load_test.py, grader
+convergence on a synthetic known-capacity probe, chaos-on run-twice
+bit-identity of a whole-pipeline soak on the scalar server, bottleneck
+attribution, the watch_capacity monitor probe, and the multi-process
+ArtifactPushThrough epochs."""
+
+import json
+import random
+import urllib.request
+
+from fluidframework_tpu.capacity import (
+    BURSTY,
+    CapacityGrader,
+    FleetSoak,
+    FleetSpec,
+    OnOffArrivals,
+    OpMix,
+    PoissonArrivals,
+    WorkloadModel,
+    WorkloadSpec,
+    ZipfPopularity,
+    attribute_bottleneck,
+    closed_loop_schedule,
+)
+from fluidframework_tpu.server.monitor import ServiceMonitor
+from fluidframework_tpu.server.readpath import ArtifactPushThrough
+from fluidframework_tpu.testing.faultinject import FaultPlan
+
+
+def _drain(model: WorkloadModel, ticks: int):
+    return [model.tick() for _ in range(ticks)]
+
+
+class TestWorkloadDeterminism:
+    def test_same_seed_same_stream_and_fingerprint(self):
+        a = WorkloadModel(WorkloadSpec(seed=7))
+        b = WorkloadModel(WorkloadSpec(seed=7))
+        pa, pb = _drain(a, 30), _drain(b, 30)
+        assert [(p.writes, p.reads) for p in pa] \
+            == [(p.writes, p.reads) for p in pb]
+        assert a.trace == b.trace
+        assert a.fingerprint() == b.fingerprint()
+
+    def test_seed_sensitivity(self):
+        a = WorkloadModel(WorkloadSpec(seed=7))
+        b = WorkloadModel(WorkloadSpec(seed=8))
+        _drain(a, 30), _drain(b, 30)
+        assert a.fingerprint() != b.fingerprint()
+
+    def test_bursty_model_is_deterministic_too(self):
+        a = WorkloadModel(WorkloadSpec(seed=3, arrival=BURSTY))
+        b = WorkloadModel(WorkloadSpec(seed=3, arrival=BURSTY))
+        _drain(a, 40), _drain(b, 40)
+        assert a.fingerprint() == b.fingerprint()
+
+    def test_scaled_changes_rate_not_shape(self):
+        spec = WorkloadSpec(seed=1, writer_rate_per_s=100.0)
+        up = spec.scaled(3.0)
+        assert up.writer_rate_per_s == 300.0
+        assert up.reader_rate_per_s == spec.reader_rate_per_s * 3.0
+        assert (up.documents, up.seed, up.tick_s) \
+            == (spec.documents, spec.seed, spec.tick_s)
+
+
+class TestArrivalShapes:
+    def test_poisson_mean_tracks_rate(self):
+        rng = random.Random(11)
+        arr = PoissonArrivals(rate_per_s=400.0)
+        n = sum(arr.draw_count(rng, 0.02) for _ in range(2000))
+        mean = n / 2000.0
+        assert 7.0 <= mean <= 9.0  # lam = 8 per tick
+
+    def test_onoff_long_run_mean_tracks_rate(self):
+        rng = random.Random(13)
+        arr = OnOffArrivals(rate_per_s=400.0)
+        n = sum(arr.draw_count(rng, 0.02) for _ in range(6000))
+        mean = n / 6000.0
+        assert 6.5 <= mean <= 9.5  # duty-normalized back to lam = 8
+
+    def test_onoff_actually_bursts(self):
+        rng = random.Random(13)
+        arr = OnOffArrivals(rate_per_s=400.0)
+        counts = [arr.draw_count(rng, 0.02) for _ in range(2000)]
+        assert counts.count(0) > 300         # real off periods
+        assert max(counts) > 12              # on-period rate > mean rate
+
+    def test_zipf_is_monotone_and_hot_headed(self):
+        rng = random.Random(5)
+        pop = ZipfPopularity(16, 1.0)
+        counts = [0] * 16
+        for _ in range(20000):
+            counts[pop.draw(rng)] += 1
+        # Rank 0 carries ~1/H(16) = ~29.6% of draws under s=1.
+        assert 0.25 <= counts[0] / 20000.0 <= 0.35
+        # Head dominates tail (allow sampling noise between neighbors).
+        assert counts[0] > counts[4] > counts[12]
+
+    def test_zipf_s0_is_uniform(self):
+        rng = random.Random(5)
+        pop = ZipfPopularity(8, 0.0)
+        counts = [0] * 8
+        for _ in range(16000):
+            counts[pop.draw(rng)] += 1
+        for c in counts:
+            assert 1700 <= c <= 2300
+
+
+class TestLoadTestFold:
+    def test_opmix_matches_inline_choices_consumption(self):
+        # The stress rig folded onto OpMix; a seeded replay must pick
+        # identical kinds in identical order to the historical inline
+        # rng.choices call.
+        weights = (4, 3, 1, 2)
+        a, b = random.Random(21), random.Random(21)
+        mix = OpMix(weights)
+        kinds_new = [mix.draw(a) for _ in range(200)]
+        kinds_old = [b.choices(("map", "insert", "remove", "counter"),
+                               weights=weights)[0] for _ in range(200)]
+        assert kinds_new == kinds_old
+
+    def test_closed_loop_schedule_nesting_order(self):
+        triples = list(closed_loop_schedule(2, 2, 2))
+        assert triples == [
+            (0, 0, 0), (0, 0, 1), (0, 1, 0), (0, 1, 1),
+            (1, 0, 0), (1, 0, 1), (1, 1, 0), (1, 1, 1)]
+
+
+SMALL_WORKLOAD = WorkloadSpec(documents=4, writers_per_document=2, seed=17,
+                              writer_rate_per_s=300.0,
+                              reader_rate_per_s=80.0, tick_s=0.02)
+SMALL_FLEET = FleetSpec(partitions=2, broadcaster_shards=2,
+                        subscribers_per_document=1, ticks=24,
+                        settle_ticks=6, drain_budget_per_partition=16,
+                        queue_limit=256, crash_every=8,
+                        avalanche_readers=6)
+
+
+def _small_soak(seed=17, reset=0.08):
+    return FleetSoak(
+        WorkloadModel(
+            WorkloadSpec(**{**SMALL_WORKLOAD.__dict__, "seed": seed})),
+        SMALL_FLEET, plan=FaultPlan(seed=31, reset=reset))
+
+
+class TestFleetSoak:
+    def test_chaos_on_run_twice_is_bit_identical(self):
+        ra = _small_soak().run()
+        rb = _small_soak().run()
+        assert ra.fingerprint() == rb.fingerprint()
+        assert ra.final_seq == rb.final_seq
+        assert ra.stream_digests == rb.stream_digests
+        # Chaos actually ran inside the measured envelope.
+        assert sum(ra.partition_restarts) >= 1
+        assert ra.avalanches >= 1
+
+    def test_workload_seed_changes_the_run(self):
+        ra = _small_soak(seed=17).run()
+        rb = _small_soak(seed=18).run()
+        assert ra.fingerprint() != rb.fingerprint()
+
+    def test_soak_flushes_what_it_admits(self):
+        r = _small_soak().run()
+        assert r.submitted > 0
+        assert r.flushed == r.admitted > 0
+        # Scalar LocalServer has no catch-up artifact cache, so readers
+        # are not graded here (the bench grades them on TpuLocalServer).
+        assert r.slo(grade_readers=False)["ok"]
+
+    def test_goodput_collapses_under_saturation(self):
+        soak = FleetSoak(
+            WorkloadModel(SMALL_WORKLOAD.scaled(24.0)),
+            SMALL_FLEET, plan=FaultPlan(seed=31, reset=0.08))
+        r = soak.run()
+        s = r.slo(grade_readers=False)
+        assert s["goodput"] < 0.95
+        assert not s["ok"]
+
+    def test_single_use(self):
+        soak = _small_soak()
+        soak.run()
+        try:
+            soak.run()
+        except RuntimeError:
+            pass
+        else:
+            raise AssertionError("second run() must refuse")
+
+    def test_tiny_partition_limit_attributes_ingest(self):
+        spec = FleetSpec(**{**SMALL_FLEET.__dict__, "partition_limit": 2,
+                            "crash_every": 0, "avalanche_readers": 0})
+        r = FleetSoak(WorkloadModel(SMALL_WORKLOAD.scaled(4.0)),
+                      spec).run()
+        pressures = r.tier_pressures()
+        tier, ranking = attribute_bottleneck(pressures)
+        assert ranking[0][0] == tier
+        # With 2 credits per partition the gate paces (admission) or the
+        # per-partition backlog binds (ingest) — either way the binding
+        # tier is at the gate side of the pipeline, not the read side.
+        assert tier in ("admission", "ingest")
+        assert pressures[tier] > pressures["broadcast"]
+
+
+class TestGrader:
+    @staticmethod
+    def _probe(true_capacity):
+        def probe(mult):
+            ok = mult <= true_capacity
+            return {"ok": ok,
+                    "pressures": {"ingest": mult / true_capacity,
+                                  "serving": 0.1}}
+        return probe
+
+    def test_converges_to_known_capacity(self):
+        g = CapacityGrader(self._probe(2.7), lo=0.5, hi=8.0, iters=8)
+        res = g.search()
+        assert res.saturated
+        assert abs(res.capacity_mult - 2.7) < 0.1
+        assert res.bottleneck == "ingest"
+
+    def test_lo_failing_grades_zero(self):
+        res = CapacityGrader(self._probe(0.1), lo=0.5, hi=8.0).search()
+        assert res.capacity_mult == 0.0
+        assert res.saturated
+
+    def test_hi_passing_reports_unsaturated(self):
+        res = CapacityGrader(self._probe(100.0), lo=0.5, hi=8.0).search()
+        assert not res.saturated
+        assert res.capacity_mult == 8.0
+
+    def test_attribute_bottleneck_ranking(self):
+        tier, ranking = attribute_bottleneck(
+            {"a": 0.2, "b": 0.9, "c": 0.9, "d": 0.1})
+        assert tier == "b"  # value tie broken by name
+        assert [t for t, _ in ranking] == ["b", "c", "a", "d"]
+
+
+class TestWatchCapacity:
+    RECORD = {
+        "ok": True, "backend": "cpu",
+        "grade": {"capacity_mult": 2.5},
+        "capacity": {"offered_ops_per_sec": 1500.0,
+                     "sustained_ops_per_sec": 1480.0,
+                     "readers_per_sec": 400.0,
+                     "bottleneck": "serving",
+                     "pressure_ranking": [["serving", 0.9],
+                                          ["ingest", 0.4]]},
+        "final_run": {"tier_pressures": {"serving": 0.9, "ingest": 0.4}},
+    }
+
+    def test_surfaces_record_and_gauges(self, tmp_path):
+        path = tmp_path / "BENCH_E2E_LAST.json"
+        path.write_text(json.dumps(self.RECORD))
+        mon = ServiceMonitor().start()
+        try:
+            mon.watch_capacity("capacity", str(path))
+            health = json.load(urllib.request.urlopen(
+                mon.url + "/health"))
+            assert health["checks"]["capacity"]["ok"]
+            report = json.load(urllib.request.urlopen(
+                mon.url + "/metrics"))
+            probe = report["probes"]["capacity"]
+            assert probe["available"]
+            assert probe["capacityMult"] == 2.5
+            assert probe["bottleneck"] == "serving"
+            assert probe["tierPressures"]["serving"] == 0.9
+            prom = urllib.request.urlopen(
+                mon.url + "/metrics.prom").read().decode()
+            assert "fluid_capacity_tier_pressure_serving 0.9" in prom
+            assert "fluid_capacity_sustained_ops_per_sec 1480" in prom
+        finally:
+            mon.stop()
+
+    def test_missing_record_is_not_unhealthy(self, tmp_path):
+        mon = ServiceMonitor().start()
+        try:
+            mon.watch_capacity("capacity",
+                               str(tmp_path / "never-written.json"))
+            health = json.load(urllib.request.urlopen(
+                mon.url + "/health"))
+            assert health["ok"]
+            report = json.load(urllib.request.urlopen(
+                mon.url + "/metrics"))
+            assert report["probes"]["capacity"] == {"available": False}
+        finally:
+            mon.stop()
+
+    def test_callable_source(self):
+        mon = ServiceMonitor()
+        mon.watch_capacity("capacity", lambda: self.RECORD)
+        probe = mon.report()["probes"]["capacity"]
+        assert probe["available"] and probe["bottleneck"] == "serving"
+
+
+class _StubLam:
+    def __init__(self, seq=7, gen=3):
+        self.bodies = {"doc-a": {"seq": seq, "gen": gen,
+                                 "clients": [], "channels": []}}
+        self.marked = []
+
+    def catchup_snapshot(self, only_docs=None):
+        return dict(self.bodies)
+
+    def catchup_mark_published(self, doc_id, gen):
+        self.marked.append((doc_id, gen))
+
+
+class _StubCheckpoints:
+    def __init__(self, rows):
+        self.rows = rows
+
+    def find(self, pred):
+        return [r for r in self.rows if pred(r)]
+
+
+class _StubHistorian:
+    class _Store:
+        def get_ref(self, ref):
+            return "sha-main"
+
+    def store(self, tenant_id, document_id):
+        return self._Store()
+
+
+class TestArtifactPushThrough:
+    def _push(self, lam, rows, publish, clock):
+        return ArtifactPushThrough(
+            lambda: [lam], _StubCheckpoints(rows), _StubHistorian(),
+            "local", publish, interval_s=0.25, clock=clock)
+
+    def test_dead_historian_retries_next_epoch(self):
+        lam = _StubLam()
+        rows = [{"documentId": "doc-a", "sequenceNumber": 7,
+                 "minimumSequenceNumber": 5, "quorum": {"members": []}}]
+        alive = {"v": False}
+        sent = []
+
+        def publish(t, d, a):
+            sent.append(a)
+            return alive["v"]
+
+        vt = {"t": 0.0}
+        push = self._push(lam, rows, publish, lambda: vt["t"])
+        assert push.pump() == 0            # dead tier: not marked
+        assert lam.marked == []
+        vt["t"] = 0.1
+        assert push.pump() == 0            # rate-limited, no epoch
+        assert push.epochs == 1
+        alive["v"] = True
+        vt["t"] = 0.3
+        assert push.pump() == 1            # retried and confirmed
+        assert lam.marked == [("doc-a", 3)]
+        art = sent[-1]
+        assert (art["v"], art["seq"], art["msn"], art["summarySha"]) \
+            == (1, 7, 5, "sha-main")
+
+    def test_scribe_lag_skips_stale_but_correct(self):
+        lam = _StubLam(seq=9)              # checkpoint row still at 7
+        rows = [{"documentId": "doc-a", "sequenceNumber": 7,
+                 "minimumSequenceNumber": 5, "quorum": {"members": []}}]
+        push = self._push(lam, rows, lambda t, d, a: True,
+                          lambda: 0.0)
+        assert push.pump(force=True) == 0
+        assert push.stats()["skipped"] == 1
+        assert lam.marked == []
+
+    def test_scalar_deli_without_snapshot_is_a_noop(self):
+        class Scalar:
+            pass
+
+        push = ArtifactPushThrough(
+            lambda: [Scalar()], _StubCheckpoints([]), _StubHistorian(),
+            "local", lambda t, d, a: True, clock=lambda: 0.0)
+        assert push.pump(force=True) == 0
+        assert push.epochs == 0
