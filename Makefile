@@ -6,7 +6,7 @@
 	paged-smoke catchup-smoke obs-smoke ingest-smoke e2e-smoke \
 	bench-trend \
 	lint-analysis \
-	lint-changed lint-races layer-check check
+	lint-changed lint-races lint-placement layer-check check
 
 test:
 	python -m pytest tests/ -q
@@ -46,6 +46,23 @@ lint-races:
 		fluidframework_tpu/telemetry \
 		--rule SHARED_STATE_NO_LOCK --rule ATOMICITY_CHECK_THEN_ACT \
 		--rule LOCK_ORDER_INVERSION --rule SIGNAL_WITHOUT_LOCK
+
+# fluidlint v4's placement & sharding lattice, focused on the mesh tier
+# (docs/static_analysis.md "fluidlint v4"): per-binding placement
+# dataflow over mergetree/server/parallel behind MESH_DONATION_GATE /
+# UNSPECCED_POOL / PSPEC_MISMATCH / HOST_READ_OF_SHARDED /
+# SHARD_AXIS_DRIFT, proven against the partition-rule table
+# (mergetree/partition_rules.py) that the runtime actually places with
+# (testing/shardcheck.py verifies the same table at dispatch time).
+# Exits non-zero on any unbaselined finding; the full rule set also
+# runs under lint-analysis — this is the focused gate and its trend
+# line (placement_rules_wall_ms rides the lint bench record).
+lint-placement:
+	python -m fluidframework_tpu.analysis fluidframework_tpu/mergetree \
+		fluidframework_tpu/server fluidframework_tpu/parallel \
+		--rule MESH_DONATION_GATE --rule UNSPECCED_POOL \
+		--rule PSPEC_MISMATCH --rule HOST_READ_OF_SHARDED \
+		--rule SHARD_AXIS_DRIFT
 
 # Machine-enforced layering + import-time cycle detection
 # (tools/layer_check.py): the dependency-DAG gate the reference repo
@@ -162,10 +179,11 @@ e2e-smoke:
 	JAX_PLATFORMS=cpu python bench.py e2e-smoke
 
 # The pre-merge gate: layering/cycles + static analysis (incl. the
-# focused race gate) + the summarize/trace/pipeline/fused/paged/catchup/
+# focused race and placement gates) + the summarize/trace/pipeline/fused/paged/catchup/
 # overload/obs/ingest/e2e smokes + the bench trend (report-only here) +
 # the full test suite.
-check: layer-check lint-analysis lint-races summarize-smoke trace-smoke \
+check: layer-check lint-analysis lint-races lint-placement \
+		summarize-smoke trace-smoke \
 		pipeline-smoke fused-smoke paged-smoke catchup-smoke \
 		overload-smoke obs-smoke ingest-smoke e2e-smoke test
 	python bench.py trend --report-only

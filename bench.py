@@ -553,9 +553,11 @@ def _lint_analysis_record() -> dict:
             rec = json.load(f)
     except (OSError, ValueError):
         return {"wall_ms": None, "race_rules_wall_ms": None,
+                "placement_rules_wall_ms": None,
                 "cache_hits": None, "cache_misses": None,
                 "violations": None, "baselined": None}
     return {k: rec.get(k) for k in ("wall_ms", "race_rules_wall_ms",
+                                    "placement_rules_wall_ms",
                                     "cache_hits", "cache_misses",
                                     "violations", "baselined")}
 

@@ -20,6 +20,14 @@ Three rule families guard the silent failure modes of the system
   ATOMICITY_CHECK_THEN_ACT, LOCK_ORDER_INVERSION, and
   SIGNAL_WITHOUT_LOCK (race_rules.py), with a runtime verifier in
   testing/lockcheck.py.
+* Placement & sharding dataflow (v4, whole-program): a per-binding
+  placement lattice (host < replicated < mesh-sharded(PartitionSpec) <
+  donated-gone) over the mergetree/server/parallel tiers
+  (placement_model.py) backs MESH_DONATION_GATE, UNSPECCED_POOL,
+  PSPEC_MISMATCH, HOST_READ_OF_SHARDED, and SHARD_AXIS_DRIFT
+  (placement_rules.py), proven against the partition-rule table
+  (mergetree/partition_rules.py) with a runtime verifier in
+  testing/shardcheck.py.
 
 Run it with ``python -m fluidframework_tpu.analysis [paths]``
 (``--changed-only`` for the git-diff-scoped pre-commit pass; warm runs
@@ -42,6 +50,7 @@ from . import jax_rules as _jax_rules  # noqa: F401
 from . import concurrency_rules as _concurrency_rules  # noqa: F401
 from . import lifecycle_rules as _lifecycle_rules  # noqa: F401
 from . import race_rules as _race_rules  # noqa: F401
+from . import placement_rules as _placement_rules  # noqa: F401
 
 __all__ = [
     "AnalysisResult", "Baseline", "DEFAULT_BASELINE_PATH", "ModuleContext",

@@ -20,8 +20,28 @@ from pathlib import Path
 
 from .baseline import Baseline, DEFAULT_BASELINE_PATH
 from .engine import REPO_ROOT, analyze_paths
-from .registry import RULES, all_rules
+from .registry import (RULES, all_rules, rules_help_text,
+                       rules_markdown_table)
 from .reporters import render_human, render_json
+
+# Markers bounding the generated rule table in docs/static_analysis.md
+# (--write-rule-docs rewrites the block; a test pins it against drift).
+RULE_DOCS_PATH = REPO_ROOT / "docs" / "static_analysis.md"
+RULE_DOCS_BEGIN = "<!-- rule-table:begin (generated; run " \
+    "`python -m fluidframework_tpu.analysis --write-rule-docs`) -->"
+RULE_DOCS_END = "<!-- rule-table:end -->"
+
+
+def rewrite_rule_docs(path: Path = RULE_DOCS_PATH) -> str:
+    """Replace the marker-bounded rule table with the registry's
+    current one; returns the updated document text (written in place)."""
+    text = path.read_text()
+    begin = text.index(RULE_DOCS_BEGIN) + len(RULE_DOCS_BEGIN)
+    end = text.index(RULE_DOCS_END)
+    updated = (text[:begin] + "\n" + rules_markdown_table() + "\n"
+               + text[end:])
+    path.write_text(updated)
+    return updated
 
 
 def _git_changed_paths() -> set:
@@ -44,7 +64,10 @@ def _git_changed_paths() -> set:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m fluidframework_tpu.analysis",
-        description="fluidlint: JAX-kernel & server-concurrency analyzer")
+        description="fluidlint: JAX-kernel, concurrency & placement "
+                    "analyzer",
+        epilog=rules_help_text(),
+        formatter_class=argparse.RawDescriptionHelpFormatter)
     parser.add_argument("paths", nargs="*",
                         default=[str(REPO_ROOT / "fluidframework_tpu")],
                         help="files/dirs to analyze (default: the package)")
@@ -61,8 +84,14 @@ def main(argv=None) -> int:
     parser.add_argument("--show-baselined", action="store_true",
                         help="also list baselined findings (human format)")
     parser.add_argument("--rule", action="append", default=[],
-                        metavar="RULE_ID", help="run only these rule ids")
+                        metavar="RULE_ID",
+                        help="run only these rule ids (registry-listed "
+                             "below)")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--write-rule-docs", action="store_true",
+                        help="regenerate the rule table in "
+                             "docs/static_analysis.md from the registry "
+                             "and exit")
     parser.add_argument("--changed-only", action="store_true",
                         help="report only on files git sees as changed "
                              "(worktree vs HEAD + untracked); the "
@@ -83,6 +112,16 @@ def main(argv=None) -> int:
     if args.list_rules:
         for r in all_rules():
             print(f"{r.id:22s} [{r.family}] {r.summary}")
+        return 0
+
+    if args.write_rule_docs:
+        try:
+            rewrite_rule_docs()
+        except (OSError, ValueError) as exc:
+            print(f"error: could not rewrite rule docs: {exc}",
+                  file=sys.stderr)
+            return 2
+        print(f"wrote {len(RULES)} rules to {RULE_DOCS_PATH}")
         return 0
 
     unknown = set(args.rule) - set(RULES)

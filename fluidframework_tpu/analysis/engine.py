@@ -294,6 +294,7 @@ class AnalysisResult:
     cache_hits: int = 0                  # modules served from the cache
     cache_misses: int = 0                # modules actually re-analyzed
     race_rules_wall_ms: float = 0.0      # lockset model build + findings
+    placement_rules_wall_ms: float = 0.0  # placement model build + findings
 
     @property
     def summary(self) -> dict:
@@ -308,6 +309,8 @@ class AnalysisResult:
                 "cache_hits": self.cache_hits,
                 "cache_misses": self.cache_misses,
                 "race_rules_wall_ms": round(self.race_rules_wall_ms, 3),
+                "placement_rules_wall_ms":
+                    round(self.placement_rules_wall_ms, 3),
                 "suppressed": self.suppressed, **self.summary}
 
 
@@ -329,6 +332,8 @@ class ProgramContext:
         self.summaries = compute_summaries(self.index)
         self._concurrency = None
         self.race_wall_ms = 0.0
+        self._placement = None
+        self.placement_wall_ms = 0.0
 
     def concurrency(self):
         """The whole-program lockset model (concurrency_model.py),
@@ -345,18 +350,34 @@ class ProgramContext:
             self.race_wall_ms += (time.perf_counter() - t0) * 1000.0
         return self._concurrency
 
-    def digest(self, include_concurrency: bool = True) -> str:
+    def placement(self):
+        """The whole-program placement lattice (placement_model.py),
+        built lazily once per run and shared by the placement rules,
+        the --changed-only reach expansion, and the cache digest. Build
+        time accumulates into ``placement_wall_ms`` (stamped into the
+        BENCH_LINT record as ``placement_rules_wall_ms``)."""
+        if self._placement is None:
+            import time
+            t0 = time.perf_counter()
+            from .placement_model import PlacementModel
+            self._placement = PlacementModel(self.index, self.contexts)
+            self.placement_wall_ms += (time.perf_counter() - t0) * 1000.0
+        return self._placement
+
+    def digest(self, include_concurrency: bool = True,
+               include_placement: bool = True) -> str:
         """Interface digest for the result cache: any change to a
-        donation signature, transitive summary, or concurrency fact
-        (lock decl, thread root, race finding) anywhere invalidates
-        every module's cached result (a caller two modules away may
-        now be donating — or racing — where it wasn't).
-        ``include_concurrency=False`` skips the lockset-model facts for
-        runs whose rule filter excludes the race family — their cached
-        results contain no race findings, so concurrency drift is
-        irrelevant to them (the rule filter is part of the cache key),
-        and skipping avoids both the model-build cost and spurious
-        invalidation."""
+        donation signature, transitive summary, concurrency fact
+        (lock decl, thread root, race finding), or placement fact
+        (mesh axes, partition-rule table, placement finding) anywhere
+        invalidates every module's cached result (a caller two modules
+        away may now be donating — or racing, or resharding — where it
+        wasn't). ``include_concurrency=False`` / ``include_placement=
+        False`` skip the family's model facts for runs whose rule
+        filter excludes it — their cached results contain no findings
+        of that family, so its drift is irrelevant to them (the rule
+        filter is part of the cache key), and skipping avoids both the
+        model-build cost and spurious invalidation."""
         items = list(self.index.signature_digest_items())
         for q in sorted(self.summaries):
             s = self.summaries[q]
@@ -365,6 +386,8 @@ class ProgramContext:
                              f"{sorted(s.metadata_only_params)}")
         if include_concurrency:
             items.extend(self.concurrency().digest_items())
+        if include_placement:
+            items.extend(self.placement().digest_items())
         return hashlib.sha1("\n".join(items).encode()).hexdigest()[:20]
 
 
@@ -447,11 +470,13 @@ def analyze_paths(paths: Sequence[str], baseline=None,
     # not: a donation signature lives wherever it lives.
     program = ProgramContext(contexts)
     race_active = any(r.family == "race" for r in rules)
-    # The digest (and the lockset-model build inside it) is a cache
-    # concern: a cacheless run pays the model only if a race rule
-    # actually checks a module in scope.
+    placement_active = any(r.family == "placement" for r in rules)
+    # The digest (and the family-model builds inside it) is a cache
+    # concern: a cacheless run pays a model only if a rule of that
+    # family actually checks a module in scope.
     program_dig = "" if cache is None else \
-        program.digest(include_concurrency=race_active)
+        program.digest(include_concurrency=race_active,
+                       include_placement=placement_active)
     rules_dig = ""
     if cache is not None:
         from .cache import rules_digest
@@ -461,15 +486,23 @@ def analyze_paths(paths: Sequence[str], baseline=None,
     # lint-races) and the full run (make lint-analysis) share the cache
     # file without evicting each other's entries.
     slot_suffix = ("#" + ",".join(only_key)) if only_key else ""
-    # Race findings are whole-program: a change to any file in a thread
-    # root's reach can alter that root's findings in OTHER files, so
-    # --changed-only additionally re-reports the RACE rules on every
-    # file sharing a root's reach with a changed file.
-    race_extra: Set[str] = set()
-    race_rules = [r for r in rules if r.family == "race"]
-    if restrict is not None and race_rules:
-        race_extra = program.concurrency().reach_expansion(
-            set(restrict)) - set(restrict)
+    # Race and placement findings are whole-program: a change to any
+    # file in a thread root's reach (or a placement group — mesh axes
+    # and the partition-rule table span modules) can alter that
+    # family's findings in OTHER files, so --changed-only additionally
+    # re-reports the family's rules on every file its model's reach
+    # expansion ties to a changed file.
+    extra_rules: Dict[str, List] = {}
+    if restrict is not None:
+        for family, model_of in (
+                ("race", lambda: program.concurrency()),
+                ("placement", lambda: program.placement())):
+            family_rules = [r for r in rules if r.family == family]
+            if not family_rules:
+                continue
+            for path in model_of().reach_expansion(set(restrict)) \
+                    - set(restrict):
+                extra_rules.setdefault(path, []).extend(family_rules)
     def split_baseline(module_violations):
         for v in module_violations:
             if baseline is not None and baseline.contains(v):
@@ -491,10 +524,10 @@ def analyze_paths(paths: Sequence[str], baseline=None,
     for ctx in contexts:
         ctx.program = program
         if restrict is not None and ctx.path not in restrict:
-            if ctx.path in race_extra:
+            if ctx.path in extra_rules:
                 files += 1
                 module_violations, module_suppressed = \
-                    run_rules(ctx, race_rules)
+                    run_rules(ctx, extra_rules[ctx.path])
                 suppressed += module_suppressed
                 split_baseline(module_violations)
             continue
@@ -525,4 +558,5 @@ def analyze_paths(paths: Sequence[str], baseline=None,
         files=files, wall_ms=(time.perf_counter() - t0) * 1000.0,
         cache_hits=cache.hits if cache is not None else 0,
         cache_misses=cache.misses if cache is not None else 0,
-        race_rules_wall_ms=program.race_wall_ms)
+        race_rules_wall_ms=program.race_wall_ms,
+        placement_rules_wall_ms=program.placement_wall_ms)

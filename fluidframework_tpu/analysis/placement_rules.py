@@ -1,0 +1,152 @@
+"""PL* rules: whole-program placement & sharding dataflow (fluidlint v4).
+
+Five rule families over the placement model (placement_model.py),
+guarding the mesh discipline of the mergetree/server/parallel tiers the
+way race_rules.py guards the thread/lock discipline. The model tracks
+every binding through the placement lattice (host < replicated <
+mesh-sharded(PartitionSpec) < donated-gone), indexing mesh
+construction, spec literals, ``device_put``/``with_sharding_constraint``
+transfers, the house placement helpers, and jit dispatch boundaries
+(``donate_argnums``/``in_shardings``):
+
+* ``MESH_DONATION_GATE`` — a donated argument that is definitely
+  mesh-sharded (the R6 warm-reload corruption, pinned by the seeded
+  fixture from the test_mesh_serving repro);
+* ``UNSPECCED_POOL`` — a lane/page-pool pytree reaching a mesh dispatch
+  with no matching partition rule (silently replicated onto every
+  device);
+* ``PSPEC_MISMATCH`` — spec axis names absent from every mesh the
+  program builds, or spec arity exceeding the target's known rank;
+* ``HOST_READ_OF_SHARDED`` — ``.item()``/``int()``/``np.asarray`` on a
+  mesh-sharded binding outside the sanctioned gather helpers;
+* ``SHARD_AXIS_DRIFT`` — one pytree placed or dispatched under two
+  different specs with no explicit reshard.
+
+Rules fire on DEFINITE placements only (straight-line code); the
+conditional single-chip/mesh dual-mode paths stay quiet and are covered
+dynamically by ``testing/shardcheck.py`` against the same rule table
+(``mergetree/partition_rules.py``) — static prediction and runtime
+``.sharding`` cannot silently drift apart.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from .engine import ModuleContext, Violation
+from .registry import rule
+
+#: Rule ids of this family — the engine uses this set to let placement
+#: findings participate in --changed-only reach expansion.
+PLACEMENT_RULE_IDS = frozenset({
+    "MESH_DONATION_GATE", "UNSPECCED_POOL", "PSPEC_MISMATCH",
+    "HOST_READ_OF_SHARDED", "SHARD_AXIS_DRIFT",
+})
+
+
+def _model_for(ctx: ModuleContext):
+    """The whole-program placement model: analyze_paths attaches a
+    ProgramContext spanning every analyzed module; analyze_source
+    (fixtures) builds a single-module one on demand."""
+    from .lifecycle_rules import _program_for
+    return _program_for(ctx).placement()
+
+
+def _emit(ctx: ModuleContext, rule_id: str) -> Iterator[Violation]:
+    from .placement_model import in_scope
+    if not in_scope(ctx.path):
+        return
+    model = _model_for(ctx)
+    seen: Set[tuple] = set()
+    for f in model.findings_for(ctx.path):
+        if f.rule_id != rule_id:
+            continue
+        key = (getattr(f.node, "lineno", 0),
+               getattr(f.node, "col_offset", 0), f.message)
+        if key in seen:
+            continue
+        seen.add(key)
+        yield _violation(ctx, f)
+
+
+def _violation(ctx: ModuleContext, finding) -> Violation:
+    node = finding.node
+    if not isinstance(node, ast.AST):  # pragma: no cover - defensive
+        node = ast.Pass()
+        node.lineno, node.col_offset = 1, 0
+    return ctx.violation(finding.rule_id, node, finding.message)
+
+
+@rule("MESH_DONATION_GATE",
+      "Mesh-sharded buffer donated across a jit dispatch boundary",
+      family="placement",
+      rationale="Donating a dp-sharded plane corrupts it on warm reload "
+                "through the persistent compile cache (R6, "
+                "docs/serving_pipeline.md): the reloaded executable "
+                "aliases the donated buffer before the restore path "
+                "re-places it. Every paged pool entry point carries a "
+                "non-donating keep twin selected at construction "
+                "(mergetree/paging.py) — dispatch through it on meshes, "
+                "never the donating form.")
+def mesh_donation_gate(ctx: ModuleContext) -> Iterator[Violation]:
+    yield from _emit(ctx, "MESH_DONATION_GATE")
+
+
+@rule("UNSPECCED_POOL",
+      "Lane/page-pool pytree reaching a mesh dispatch with no matching "
+      "partition rule",
+      family="placement",
+      rationale="A pool that never went through "
+                "match_partition_rules/place_with_rules "
+                "(mergetree/partition_rules.py) gets replicated onto "
+                "every device at the first mesh dispatch: page capacity "
+                "stops scaling with the mesh and the replication "
+                "transfer lands on the serving path. Place the pool "
+                "under the rule table before dispatching it.")
+def unspecced_pool(ctx: ModuleContext) -> Iterator[Violation]:
+    yield from _emit(ctx, "UNSPECCED_POOL")
+
+
+@rule("PSPEC_MISMATCH",
+      "PartitionSpec naming an axis no mesh has, or exceeding the "
+      "target's rank",
+      family="placement",
+      rationale="A spec axis absent from the mesh (or more spec entries "
+                "than the array has dimensions) raises inside jax at "
+                "dispatch time — but only on the first mesh-shaped run, "
+                "which for dual-mode code means in production, not in "
+                "single-chip CI. The model checks every literal spec "
+                "against the union of axes any mesh in the program "
+                "declares.")
+def pspec_mismatch(ctx: ModuleContext) -> Iterator[Violation]:
+    yield from _emit(ctx, "PSPEC_MISMATCH")
+
+
+@rule("HOST_READ_OF_SHARDED",
+      "Scalar/host read of a mesh-sharded binding outside the gather "
+      "helpers",
+      family="placement",
+      rationale=".item()/int()/np.asarray on a mesh-sharded array "
+                "gathers every shard through a blocking device-to-host "
+                "transfer — a serving-path stall that grows with the "
+                "mesh. Route host reads through a sanctioned gather "
+                "helper (*gather*/*to_host*/*fetch* functions), or keep "
+                "the reduction on-device and read the replicated "
+                "scalar.")
+def host_read_of_sharded(ctx: ModuleContext) -> Iterator[Violation]:
+    yield from _emit(ctx, "HOST_READ_OF_SHARDED")
+
+
+@rule("SHARD_AXIS_DRIFT",
+      "One pytree placed or dispatched under two different specs with "
+      "no explicit reshard",
+      family="placement",
+      rationale="Two consumers pinning the same buffer to different "
+                "specs makes GSPMD insert a full cross-device reshard "
+                "on every call — silent all-to-all traffic that "
+                "profiles as 'mysterious collective'. Rebind through an "
+                "explicit reshard (`x = device_put(x, ...)`) or unify "
+                "the consumers on one spec in the rule table.")
+def shard_axis_drift(ctx: ModuleContext) -> Iterator[Violation]:
+    yield from _emit(ctx, "SHARD_AXIS_DRIFT")

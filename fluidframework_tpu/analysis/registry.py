@@ -50,6 +50,25 @@ def get_rule(rule_id: str) -> Rule:
     return RULES[rule_id]
 
 
+def rules_help_text() -> str:
+    """The rule list for the CLI epilog — generated from the registry
+    so ``--rule`` help can never drift from the registered rules."""
+    lines = ["rule ids (pass to --rule; see docs/static_analysis.md):"]
+    lines.extend(f"  {r.id:24s} [{r.family}] {r.summary}"
+                 for r in all_rules())
+    return "\n".join(lines)
+
+
+def rules_markdown_table() -> str:
+    """The docs rule table — the generated block in
+    docs/static_analysis.md (``--write-rule-docs`` rewrites it, a test
+    pins it against drift)."""
+    lines = ["| Rule | Family | Summary |", "| --- | --- | --- |"]
+    lines.extend(f"| `{r.id}` | {r.family} | {r.summary} |"
+                 for r in all_rules())
+    return "\n".join(lines)
+
+
 def iter_checks(only: Iterable[str] = ()) -> List[Rule]:
     wanted = set(only)
     rules = all_rules()

@@ -46,6 +46,8 @@ def render_json(result: AnalysisResult, stream: IO[str]) -> None:
         # verifiable from the report alone.
         "wall_ms": round(result.wall_ms, 3),
         "race_rules_wall_ms": round(result.race_rules_wall_ms, 3),
+        "placement_rules_wall_ms":
+            round(result.placement_rules_wall_ms, 3),
         "cache": {"hits": result.cache_hits,
                   "misses": result.cache_misses},
         "summary": result.summary,

@@ -677,6 +677,19 @@ def compact_extract_paged(pool: DocState, page_ids: jnp.ndarray, counts,
     return scatter_pages(pool, page_ids, g), page_ids, g.count, packed
 
 
+# Non-donating variants of every paged-pool entry point, for MESH-placed
+# pools: donating a dp-sharded plane through the persistent XLA compile
+# cache corrupts it on warm reload (jax 0.4.37 — docs/serving_pipeline.md
+# R6, lint-enforced by MESH_DONATION_GATE). PagedMergeStore selects the
+# dispatch once at construction (donate = mesh is None); the single-chip
+# path keeps the donated fast forms above.
+apply_ops_paged_keep = functools.partial(
+    jax.jit, static_argnames=("stats",))(apply_ops_paged.__wrapped__)
+rollback_pages_keep = jax.jit(rollback_pages.__wrapped__)
+compact_pages_keep = jax.jit(compact_pages.__wrapped__)
+compact_extract_paged_keep = jax.jit(compact_extract_paged.__wrapped__)
+
+
 # ---------------------------------------------------------------------------
 # batched summary extraction
 # ---------------------------------------------------------------------------

@@ -74,6 +74,15 @@ def _put_pool_pages(pool: DocState, idx: jnp.ndarray,
     return jax.tree_util.tree_map(s, pool, row)
 
 
+# Non-donating variants for MESH-placed pools: donating a dp-sharded
+# plane through the persistent XLA compile cache corrupts it on warm
+# reload (jax 0.4.37 — docs/serving_pipeline.md R6, now lint-enforced
+# by MESH_DONATION_GATE). A mesh store dispatches through THESE; the
+# single-chip store keeps the donated fast path above.
+_zero_pool_pages_keep = jax.jit(_zero_pool_pages.__wrapped__)
+_put_pool_pages_keep = jax.jit(_put_pool_pages.__wrapped__)
+
+
 class PageAllocator:
     """Host-side refcounted free-list allocator over the page pool.
 
@@ -170,12 +179,37 @@ class PagedMergeStore:
 
     def __init__(self, page_rows: int = PAGE_ROWS, pages: int = 64,
                  anno_slots: int = DEFAULT_ANNO_SLOTS,
-                 overlap_slots: int = MAX_OVERLAP_CLIENTS):
+                 overlap_slots: int = MAX_OVERLAP_CLIENTS,
+                 mesh=None):
         self.page_rows = page_rows
         self.anno_slots = anno_slots
         self.overlap_slots = overlap_slots
+        # Mesh placement rides the partition-rule table
+        # (partition_rules.POOL_PARTITION_RULES: page axis over 'dp',
+        # rows/slots replicated) — pool capacity scales with the mesh.
+        # The page count rounds up to a dp multiple so the sharded axis
+        # divides; doubling growth preserves divisibility afterwards.
+        self.mesh = mesh
+        # R6: donation is gated OFF on meshes (warm-compile-cache
+        # reload corrupts donated sharded planes; MESH_DONATION_GATE
+        # enforces this statically). Dispatch selection happens once
+        # here, not per call site.
+        self.donate = mesh is None
+        self._zero_dispatch = _zero_pool_pages if self.donate \
+            else _zero_pool_pages_keep
+        self._put_dispatch = _put_pool_pages if self.donate \
+            else _put_pool_pages_keep
+        if mesh is not None:
+            dp = int(mesh.shape.get("dp", 1))
+            pages = ((pages + dp - 1) // dp) * dp
         self.pool: DocState = make_state(page_rows, anno_slots,
                                          overlap_slots, batch=pages)
+        if mesh is not None:
+            from .partition_rules import (POOL_PARTITION_RULES,
+                                          place_with_rules)
+            self.pool = place_with_rules(mesh, self.pool,
+                                         POOL_PARTITION_RULES)
+        self.pool_replacements = 0  # leaves re-placed after spec drift
         self.allocator = PageAllocator(pages)
         self.tables: Dict[tuple, List[int]] = {}
         self.counts: Dict[tuple, int] = {}
@@ -205,11 +239,25 @@ class PagedMergeStore:
         grown = make_state(self.page_rows, self.anno_slots,
                            self.overlap_slots, batch=new_cap)
         old = self.allocator.capacity
-        self.pool = jax.tree_util.tree_map(
+        self.adopt_pool(jax.tree_util.tree_map(
             lambda g, s: g.at[:old].set(s) if g.ndim else s,
-            grown, self.pool)
+            grown, self.pool))
         self.allocator.grow(new_cap)
         self.pool_grows += 1
+
+    def adopt_pool(self, new_pool: DocState) -> None:
+        """Adopt a dispatch-returned pool. On a mesh, verify every
+        leaf still matches its rule-table spec and re-place drifted
+        leaves (counted in ``pool_replacements``) — GSPMD usually
+        preserves input shardings through the scatter-shaped paged
+        dispatches, but 'usually' is not a placement contract."""
+        if self.mesh is not None:
+            from .partition_rules import (POOL_PARTITION_RULES,
+                                          ensure_placement)
+            new_pool, replaced = ensure_placement(
+                self.mesh, new_pool, POOL_PARTITION_RULES)
+            self.pool_replacements += replaced
+        self.pool = new_pool
 
     def zero_pages(self, pids: List[int]) -> None:
         """Blank freed pages in ONE batched, pool-DONATED scatter, so
@@ -221,7 +269,8 @@ class PagedMergeStore:
         k_pad = pow2_pages(len(pids))
         padded = list(pids) + [pids[0]] * (k_pad - len(pids))
         idx = jnp.asarray(np.asarray(padded, np.int32))
-        self.pool = _zero_pool_pages(self.pool, idx, self._blank())
+        self.adopt_pool(self._zero_dispatch(self.pool, idx,
+                                            self._blank()))
 
     # -- per-doc tables ----------------------------------------------------
     def ensure(self, key: tuple) -> None:
@@ -377,11 +426,20 @@ class PagedMergeStore:
             rem_clients=pv(row.rem_clients),
             origin_op=pv(row.origin_op), origin_off=pv(row.origin_off),
             anno=pv(row.anno))
-        self.pool = _put_pool_pages(self.pool, idx, paged)
+        self.adopt_pool(self._put_dispatch(self.pool, idx, paged))
         self.counts[key] = count
         self.min_seqs[key] = int(np.asarray(row.min_seq))
         self.seqs[key] = int(np.asarray(row.seq))
         self.release_trailing(key)
+
+    # -- placement ---------------------------------------------------------
+    def placement_spec_table(self) -> Dict[str, str]:
+        """Leaf name -> rule-resolved PartitionSpec string for the pool
+        (partition_rules.resolved_spec_table) — the table
+        dryrun_multichip stamps and testing/shardcheck verifies."""
+        from .partition_rules import (POOL_PARTITION_RULES,
+                                      resolved_spec_table)
+        return resolved_spec_table(self.pool, POOL_PARTITION_RULES)
 
     # -- stats -------------------------------------------------------------
     @property

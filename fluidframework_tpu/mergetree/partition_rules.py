@@ -1,0 +1,175 @@
+"""Named partition rules: leaf-name regexes -> PartitionSpecs.
+
+The single source of truth for how serving pytrees land on a mesh
+(ROADMAP "multi-host sharded serving fleet"; exemplar: fmengine's
+``match_partition_rules``, SNIPPETS.md [2]). A rule table is an ordered
+list of ``(leaf_name_regex, PartitionSpec)`` pairs; the first match
+wins, scalars always replicate, and an unmatched non-scalar leaf RAISES
+— silence here is exactly the hole the paged store's old
+NotImplementedError papered over, so the engine refuses to guess.
+
+Three consumers share the tables:
+
+* the runtime (``PagedMergeStore``/``MergeLaneStore`` mesh placement
+  via ``place_with_rules``),
+* the runtime verifier (``testing/shardcheck.py`` asserts actual
+  ``.sharding`` against ``resolved_spec_table`` at dispatch time),
+* the static analyzer (``analysis/placement_model.py`` folds an
+  AST-level digest of the tables into the fingerprint-cache program
+  digest, so a rule edit invalidates cached lint results while pure
+  line drift elsewhere stays warm).
+
+Leaf names join the pytree path with ``/`` (dict keys, NamedTuple field
+names, sequence indices), e.g. ``pool/rem_clients`` for
+``{"pool": DocState(...)}.rem_clients``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Sequence, Tuple
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+#: One rule: (regex over the '/'-joined leaf name, spec for matches).
+PartitionRule = Tuple[str, P]
+
+#: The page pool (PagedMergeStore.pool): every column is batched over
+#: the PAGE axis ([n_pages, page_rows, ...] segment planes and the
+#: [n_pages] per-page scalar padding fields), so the page axis shards
+#: over 'dp' — pool *capacity* scales with the mesh — and the row /
+#: anno / overlap-slot axes replicate. Gathers-by-page-id cross shards
+#: (GSPMD inserts the collectives); page ownership stays a host-side
+#: allocator concern.
+POOL_PARTITION_RULES: List[PartitionRule] = [
+    (r"(^|/)(length|ins_seq|ins_client|local_seq|rem_seq|rem_local_seq"
+     r"|rem_clients|origin_op|origin_off|anno)$", P("dp")),
+    (r"(^|/)(count|min_seq|seq|overflow)$", P("dp")),
+]
+
+#: Batched lane/bucket states (ticket state, merge/LWW bucket grids):
+#: leading lane axis over 'dp', everything else replicated — the rule
+#: form of what parallel/mesh.shard_docs computes structurally.
+LANE_PARTITION_RULES: List[PartitionRule] = [
+    (r".*", P("dp")),
+]
+
+
+def named_leaves(tree: Any, prefix: str = "",
+                 sep: str = "/") -> List[Tuple[str, Any]]:
+    """(name, leaf) pairs in deterministic order. Dicts join keys,
+    NamedTuples join field names, lists/tuples join indices; anything
+    else is a leaf. ``None`` leaves are skipped (jax treats them as
+    empty subtrees)."""
+    out: List[Tuple[str, Any]] = []
+
+    def walk(name: str, node: Any) -> None:
+        if node is None:
+            return
+        if isinstance(node, dict):
+            for k in node:
+                walk(f"{name}{sep}{k}" if name else str(k), node[k])
+        elif isinstance(node, tuple) and hasattr(node, "_fields"):
+            for f, v in zip(node._fields, node):
+                walk(f"{name}{sep}{f}" if name else f, v)
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                walk(f"{name}{sep}{i}" if name else str(i), v)
+        else:
+            out.append((name, node))
+
+    walk(prefix, tree)
+    return out
+
+
+def _spec_for(rules: Sequence[PartitionRule], name: str, leaf: Any) -> P:
+    shape = getattr(leaf, "shape", ())
+    if len(shape) == 0 or int(np.prod(shape)) == 1:
+        return P()  # scalars/singletons always replicate
+    for pattern, spec in rules:
+        if re.search(pattern, name) is not None:
+            return spec
+    raise ValueError(
+        f"no partition rule matches leaf {name!r} "
+        f"(shape {tuple(shape)}); add a rule to the table — an "
+        f"unspecced leaf on a mesh is the UNSPECCED_POOL hazard")
+
+
+def match_partition_rules(rules: Sequence[PartitionRule],
+                          tree: Any) -> Dict[str, P]:
+    """Leaf name -> PartitionSpec for every leaf of ``tree``. First
+    matching rule wins; scalar leaves get ``P()``; a non-scalar leaf no
+    rule matches raises ValueError (never guess a placement)."""
+    return {name: _spec_for(rules, name, leaf)
+            for name, leaf in named_leaves(tree)}
+
+
+def resolved_spec_table(tree: Any,
+                        rules: Sequence[PartitionRule]) -> Dict[str, str]:
+    """The JSON-friendly per-leaf spec table dryrun_multichip stamps:
+    leaf name -> str(PartitionSpec)."""
+    return {name: str(spec)
+            for name, spec in match_partition_rules(rules, tree).items()}
+
+
+def _map_named(tree: Any, fn: Callable[[str, Any], Any],
+               prefix: str = "", sep: str = "/") -> Any:
+    if tree is None:
+        return None
+    if isinstance(tree, dict):
+        return {k: _map_named(v, fn, f"{prefix}{sep}{k}" if prefix
+                              else str(k), sep)
+                for k, v in tree.items()}
+    if isinstance(tree, tuple) and hasattr(tree, "_fields"):
+        return type(tree)(*[
+            _map_named(v, fn, f"{prefix}{sep}{f}" if prefix else f, sep)
+            for f, v in zip(tree._fields, tree)])
+    if isinstance(tree, (list, tuple)):
+        mapped = [_map_named(v, fn, f"{prefix}{sep}{i}" if prefix
+                             else str(i), sep)
+                  for i, v in enumerate(tree)]
+        return type(tree)(mapped) if isinstance(tree, list) \
+            else tuple(mapped)
+    return fn(prefix, tree)
+
+
+def place_with_rules(mesh: Mesh, tree: Any,
+                     rules: Sequence[PartitionRule]) -> Any:
+    """device_put every leaf under its rule-resolved NamedSharding.
+    The explicit placement entry point the mesh stores construct
+    through — and the shape the placement lint recognizes as 'specced'."""
+    import jax
+
+    def place(name: str, leaf: Any):
+        spec = _spec_for(rules, name, leaf)
+        return jax.device_put(leaf, NamedSharding(mesh, spec))
+
+    return _map_named(tree, place)
+
+
+def ensure_placement(mesh: Mesh, tree: Any,
+                     rules: Sequence[PartitionRule]) -> Tuple[Any, int]:
+    """Re-place only the leaves whose actual sharding drifted from the
+    rule table; returns (tree, n_replaced). Zero-cost when a dispatch
+    preserved placements (the common GSPMD case) — the adopt-side
+    guard PagedMergeStore runs after every pool-returning dispatch."""
+    import jax
+    replaced = 0
+
+    def check(name: str, leaf: Any):
+        nonlocal replaced
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None:
+            return leaf
+        expected = NamedSharding(mesh, _spec_for(rules, name, leaf))
+        try:
+            ok = sharding.is_equivalent_to(expected, leaf.ndim)
+        except (TypeError, ValueError):  # foreign sharding type
+            ok = False
+        if ok:
+            return leaf
+        replaced += 1
+        return jax.device_put(leaf, expected)
+
+    return _map_named(tree, check), replaced

@@ -418,3 +418,9 @@ def _serve_paged_burst(pool, page_ids, counts, min_seqs, seqs, ops_xs,
 serve_paged_burst = functools.partial(
     jax.jit, donate_argnums=(0, 1), static_argnums=(6,))(
         _serve_paged_burst)
+
+# Non-donating K-chunk burst for MESH-placed pools (serving_pipeline.md
+# R6: donation never reaches a mesh-placed dispatch; MESH_DONATION_GATE
+# is the lint half of the same contract).
+serve_paged_burst_keep = functools.partial(
+    jax.jit, static_argnums=(6,))(_serve_paged_burst)

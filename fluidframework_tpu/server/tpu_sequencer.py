@@ -273,6 +273,12 @@ _apply_keep_batched = JitRetraceProbe(kernel.apply_ops_batched_keep,
 # warmup is a leaked signature, same contract as the bucketed probe.
 _apply_paged_probe = JitRetraceProbe(kernel.apply_ops_paged,
                                      name="kernel.paged_apply")
+# The non-donating twin for MESH-placed pools (serving_pipeline.md R6:
+# donation never reaches a mesh-placed dispatch — warm-compile-cache
+# reload corrupts donated sharded planes; MESH_DONATION_GATE lint-
+# enforces the same contract). PagedMergeStore.donate picks the probe.
+_apply_paged_keep_probe = JitRetraceProbe(kernel.apply_ops_paged_keep,
+                                          name="kernel.paged_apply_keep")
 
 
 class MergeLaneStore:
@@ -282,9 +288,14 @@ class MergeLaneStore:
                  lanes_per_bucket: int = 8,
                  t_buckets: Tuple[int, ...] = DEFAULT_T_BUCKETS,
                  paged: bool = False,
-                 page_rows: Optional[int] = None):
+                 page_rows: Optional[int] = None,
+                 mesh=None):
         self.capacities = tuple(capacities)
         self.t_buckets = tuple(t_buckets)
+        # dp-mesh placement for the paged pool rides the partition-rule
+        # table (mergetree/partition_rules.py); bucketed lane grids are
+        # placed by the sequencer's bucket.placer instead.
+        self.mesh = mesh
         # Paged lane memory (docs/paged_memory.md): segment rows live in
         # a refcounted page pool with per-doc page tables instead of the
         # capacity-bucket grid — growth is "append a page", so the whole
@@ -295,7 +306,8 @@ class MergeLaneStore:
         self.paged = bool(paged)
         self.pages: Optional[PagedMergeStore] = None
         if self.paged:
-            self.pages = PagedMergeStore(page_rows=page_rows or PAGE_ROWS)
+            self.pages = PagedMergeStore(page_rows=page_rows or PAGE_ROWS,
+                                         mesh=mesh)
         self.buckets = [] if self.paged else [
             _MergeBucket(c, lanes_per_bucket) for c in self.capacities]
         # Paged-mode telemetry: host rescues (the only fold/rescue-class
@@ -590,9 +602,9 @@ class MergeLaneStore:
 
         if pool_planes is not None:
             op_np, an_np = pool_planes
-            self.pages.pool = self.pages.pool._replace(
+            self.pages.adopt_pool(self.pages.pool._replace(
                 origin_op=jnp.asarray(renumber(op_np)),
-                anno=jnp.asarray(renumber(an_np)))
+                anno=jnp.asarray(renumber(an_np))))
         for bucket, host in zip(self.buckets, per_bucket):
             if host is None:
                 continue
@@ -930,19 +942,22 @@ class MergeLaneStore:
                     jnp.asarray(mins), jnp.asarray(seqs), staged)
             st_dev = None
             if k_chunks == 1:
-                res = _apply_paged_probe(*args, stats=stats_on)
+                probe = _apply_paged_probe if pg.donate \
+                    else _apply_paged_keep_probe
+                res = probe(*args, stats=stats_on)
                 (pool2, _pids2, c2, m2, s2, over, pre) = res[:7]
                 if stats_on:
                     st_dev = res[7]
             else:
                 from . import serve_step
-                with compile_ledger.track("serve.paged_burst",
-                                          serve_step.serve_paged_burst):
-                    res = serve_step.serve_paged_burst(*args, stats_on)
+                burst = serve_step.serve_paged_burst if pg.donate \
+                    else serve_step.serve_paged_burst_keep
+                with compile_ledger.track("serve.paged_burst", burst):
+                    res = burst(*args, stats_on)
                 (pool2, _pids2, c2, m2, s2, over, _over_k, pre) = res[:8]
                 if stats_on:
                     st_dev = res[8]
-            pg.pool = pool2
+            pg.adopt_pool(pool2)
         with tracing.span("serving.readback", hist="serving.readback",
                           stage="paged-overflow", pages=p2):
             over_np = np.asarray(over)[:n]
@@ -1010,9 +1025,10 @@ class MergeLaneStore:
             sub_pids[k:] = -1  # padding rows scatter OOB -> drop
             sub_pre = tm(lambda x: x[jnp.asarray(sel)]
                          if getattr(x, "ndim", 0) else x, pre)
-            pg.pool = kernel.rollback_pages(pg.pool,
-                                            jnp.asarray(sub_pids),
-                                            sub_pre)
+            rollback = kernel.rollback_pages if pg.donate \
+                else kernel.rollback_pages_keep
+            pg.adopt_pool(rollback(pg.pool, jnp.asarray(sub_pids),
+                                   sub_pre))
             dropped = 0
             for j in flagged:
                 key = keys[j]
@@ -1095,10 +1111,12 @@ class MergeLaneStore:
             n = len(keys)
             _n_pad, pids, counts, mins, seqs = \
                 self._stage_paged_group(keys)
-            pool2, _, c2 = kernel.compact_pages(
+            compact = kernel.compact_pages if pg.donate \
+                else kernel.compact_pages_keep
+            pool2, _, c2 = compact(
                 pg.pool, jnp.asarray(pids), jnp.asarray(counts),
                 jnp.asarray(mins), jnp.asarray(seqs))
-            pg.pool = pool2
+            pg.adopt_pool(pool2)
             c2n = np.asarray(c2)[:n]
             # Zamboni reclamation from the host count mirrors (the pre
             # counts) vs the compacted counts — gated with the rest of
@@ -1691,12 +1709,14 @@ class MergeLaneStore:
             n = len(keys)
             _n_pad, pids, counts, mins, seqs = \
                 self._stage_paged_group(keys)
+            cextract = kernel.compact_extract_paged if pg.donate \
+                else kernel.compact_extract_paged_keep
             with compile_ledger.track("kernel.compact_extract_paged",
-                                      kernel.compact_extract_paged):
-                pool2, _, c2, packed = kernel.compact_extract_paged(
+                                      cextract):
+                pool2, _, c2, packed = cextract(
                     pg.pool, jnp.asarray(pids), jnp.asarray(counts),
                     jnp.asarray(mins), jnp.asarray(seqs))
-            pg.pool = pool2
+            pg.adopt_pool(pool2)
             c2n = np.asarray(c2)[:n]
             if device_stats.enabled():
                 # Paged zamboni reclamation needs no device plane: the
@@ -2857,17 +2877,22 @@ class TpuSequencerLambda(IPartitionLambda):
         self.pending: Dict[str, List[_Pending]] = {}
         self.materialize = materialize
         self.merge = merge_store if merge_store is not None else \
-            MergeLaneStore(t_buckets=t_buckets, paged=paged_lanes)
+            MergeLaneStore(t_buckets=t_buckets, paged=paged_lanes,
+                           mesh=mesh)
         self.lww = LwwLaneStore(t_buckets=t_buckets)
-        if getattr(self.merge, "paged", False) and mesh is not None:
-            raise NotImplementedError(
-                "MergeLaneStore(paged=True) cannot be placed on a dp "
-                "mesh: the page pool has no PartitionSpec rule yet — "
-                "pages would need a lane-axis sharding over the 'dp' "
-                "mesh axis plus a replicated page-table plane "
-                "(ROADMAP 'Paged lane memory: finish the takeover'; "
-                "docs/paged_memory.md). Use paged_lanes=False on "
-                "meshes, or a single-chip placement for paged lanes.")
+        if getattr(self.merge, "paged", False) and mesh is not None \
+                and getattr(self.merge.pages, "mesh", None) is None:
+            # An externally provided paged store must already carry the
+            # mesh placement: the pool's dispatch selection (donate vs
+            # keep — R6) is fixed at ITS construction, and silently
+            # serving a single-chip pool under a mesh would re-donate a
+            # sharded plane exactly where MESH_DONATION_GATE forbids it.
+            raise ValueError(
+                "paged merge_store was constructed without the mesh: "
+                "pass mesh= to MergeLaneStore/PagedMergeStore so the "
+                "pool places via partition_rules.POOL_PARTITION_RULES "
+                "and dispatches through the non-donating variants "
+                "(docs/serving_pipeline.md R6).")
         if mesh is not None:
             dp = int(mesh.shape.get("dp", 1))
             for bucket in self.merge.buckets + self.lww.buckets:

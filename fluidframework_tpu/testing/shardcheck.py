@@ -1,0 +1,133 @@
+"""Runtime sharding verification — fluidlint v4's dynamic half.
+
+The static placement model (analysis/placement_model.py) PROVES the
+placements it can see and goes quiet where placement is conditional
+(``mesh is None`` gates, cross-module pool adoption) — a documented
+soundness trade. This module closes the loop the way
+``testing/lockcheck.py`` closes the race-detector's: assert at dispatch
+time that the ACTUAL ``.sharding`` of every serving pytree leaf matches
+the spec the partition-rule table
+(``mergetree/partition_rules.py``) statically predicts, while the real
+code runs under the mesh tests and soak — so the rule table and the
+runtime cannot silently drift apart.
+
+Usage::
+
+    from fluidframework_tpu.testing import shardcheck
+
+    shardcheck.assert_placement(store.pool, mesh,
+                                POOL_PARTITION_RULES, where="pool")
+    checked = shardcheck.verify_store(lam.merge, mesh)   # whole store
+
+    step = shardcheck.instrument(step, mesh, POOL_PARTITION_RULES)
+    step(pool, ids)          # raises ShardingMismatch before dispatch
+    step.checks              # how many leaves were actually verified
+
+Everything here is import-cheap and debug-only: production code never
+imports this module; the mesh tests, the SOAK trials, and
+``dryrun_multichip`` (which stamps the verdict into MULTICHIP_LAST.json)
+do.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence
+
+from jax.sharding import NamedSharding
+
+from ..mergetree.partition_rules import (LANE_PARTITION_RULES,
+                                         POOL_PARTITION_RULES,
+                                         PartitionRule, _spec_for,
+                                         named_leaves)
+
+
+class ShardingMismatch(AssertionError):
+    """A leaf's actual sharding diverged from its rule-table spec."""
+
+
+def assert_placement(tree: Any, mesh, rules: Sequence[PartitionRule],
+                     where: str = "") -> int:
+    """Assert every jax-array leaf of ``tree`` is placed exactly as the
+    rule table predicts on ``mesh``; returns the number of leaves
+    checked. Leaves without a ``.sharding`` (numpy staging planes, host
+    scalars) are skipped — the table governs device placement only."""
+    failures: List[str] = []
+    checked = 0
+    for name, leaf in named_leaves(tree):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None:
+            continue
+        expected = NamedSharding(mesh, _spec_for(rules, name, leaf))
+        checked += 1
+        try:
+            ok = sharding.is_equivalent_to(expected, leaf.ndim)
+        except (TypeError, ValueError):
+            ok = False
+        if not ok:
+            label = f"{where}/{name}" if where else name
+            failures.append(f"  {label}: actual {sharding} != "
+                            f"predicted {expected}")
+    if failures:
+        raise ShardingMismatch(
+            "sharding drifted from the partition-rule table "
+            f"({len(failures)}/{checked} leaves):\n"
+            + "\n".join(failures))
+    return checked
+
+
+def verify_store(merge_store, mesh=None) -> int:
+    """Verify a MergeLaneStore's device-resident planes against the
+    rule tables: the paged pool under POOL_PARTITION_RULES, every
+    bucket grid under LANE_PARTITION_RULES. Returns leaves checked
+    (0 when the store carries no mesh — nothing to predict)."""
+    mesh = mesh if mesh is not None else getattr(merge_store, "mesh",
+                                                 None)
+    if mesh is None:
+        return 0
+    checked = 0
+    pages = getattr(merge_store, "pages", None)
+    if pages is not None:
+        checked += assert_placement(pages.pool, mesh,
+                                    POOL_PARTITION_RULES, where="pool")
+    for bucket in getattr(merge_store, "buckets", []):
+        checked += assert_placement(
+            bucket.state, mesh, LANE_PARTITION_RULES,
+            where=f"bucket{bucket.capacity}")
+    return checked
+
+
+def instrument(fn, mesh, rules: Sequence[PartitionRule],
+               tree_args: Sequence[int] = (0,)):
+    """Wrap a dispatch callable so the pytree arguments at positions
+    ``tree_args`` are verified against ``rules`` BEFORE every call —
+    the statically predicted spec meets the actual input ``.sharding``
+    exactly where a wrong placement would compile into silent
+    collectives. The wrapper counts verified leaves in ``.checks``."""
+
+    @functools.wraps(fn)
+    def checked(*args, **kwargs):
+        for pos in tree_args:
+            if pos < len(args):
+                checked.checks += assert_placement(
+                    args[pos], mesh, rules, where=f"arg{pos}")
+        return fn(*args, **kwargs)
+
+    checked.checks = 0
+    return checked
+
+
+def placement_report(merge_store, mesh=None) -> Dict[str, Any]:
+    """The machine-readable verdict dryrun_multichip stamps:
+    {"ok": bool, "checked": N, "error": str|None} plus the resolved
+    spec table for the paged pool when one exists."""
+    report: Dict[str, Any] = {"ok": True, "checked": 0, "error": None}
+    try:
+        report["checked"] = verify_store(merge_store, mesh)
+    except (ShardingMismatch, ValueError) as exc:
+        report["ok"] = False
+        report["error"] = str(exc).splitlines()[0]
+    pages = getattr(merge_store, "pages", None)
+    if pages is not None and getattr(pages, "mesh", None) is not None:
+        report["pool_specs"] = pages.placement_spec_table()
+    return report

@@ -146,15 +146,33 @@ class TestMeshServing:
         assert len(server.sequencer().tstate.next_seq
                    .sharding.device_set) == 8
 
-    def test_paged_lanes_on_mesh_refuse_with_missing_partition_spec(self):
-        """MergeLaneStore(paged=True) has no PartitionSpec rule for the
-        page pool yet (ROADMAP 'finish the takeover'): constructing a
-        paged sequencer on a dp mesh must refuse LOUDLY with a
-        NotImplementedError that names the missing placement rule, not
-        die on a bare assert deep in placement code."""
-        with pytest.raises(NotImplementedError,
-                           match="PartitionSpec"):
-            TpuLocalServer(mesh=make_mesh(sp=1), paged_lanes=True)
+    def test_paged_lanes_on_mesh_place_via_partition_rules(self):
+        """The pool-partition takeover (was: NotImplementedError
+        refusal): a paged sequencer CONSTRUCTS on a dp mesh — the page
+        pool placed leaf-by-leaf via
+        mergetree/partition_rules.POOL_PARTITION_RULES — serves real
+        traffic with donation gated off (R6: donated dp-sharded planes
+        corrupt on warm reload through the persistent compile cache),
+        and the runtime shardcheck proves every device-resident plane
+        sits exactly where the rule table predicts."""
+        from fluidframework_tpu.testing import shardcheck
+        mesh = make_mesh(sp=1)
+        server = TpuLocalServer(mesh=mesh, paged_lanes=True)
+        loader, c, ds = make_doc(server, "pgm")
+        t = ds.create_channel("text", SharedString.TYPE)
+        c.attach()
+        t.insert_text(0, "paged ")
+        t.insert_text(6, "mesh")
+        lam = server.sequencer()
+        assert lam.channel_text("pgm", "default", "text") == "paged mesh"
+        # R6: mesh construction selects the non-donating dispatches.
+        assert lam.merge.pages.mesh is mesh
+        assert lam.merge.pages.donate is False
+        # The pool really spans the mesh, exactly as the table says.
+        checked = shardcheck.verify_store(lam.merge, mesh)
+        assert checked > 0
+        specs = lam.merge.pages.placement_spec_table()
+        assert specs["length"] == "PartitionSpec('dp',)"
 
     def test_materialized_not_stale_after_sequencer_restart(self):
         """A crash-restart replaces the lambda (generation counters reset

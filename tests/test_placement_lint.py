@@ -1,0 +1,784 @@
+"""fluidlint v4: whole-program placement & sharding dataflow.
+
+Covers the layers ISSUE 17 added:
+
+* the placement model (analysis/placement_model.py) — the per-binding
+  lattice (host < replicated < sharded(spec) < donated), mesh-axes
+  union across construction sites, PartitionSpec literal resolution
+  through the import alias table, placement-transfer tracking
+  (device_put / with_sharding_constraint / shard_docs /
+  place_with_rules), and jit dispatch boundaries (function-local wraps
+  AND module-level wraps through callgraph.ProgramIndex);
+* the five rules (analysis/placement_rules.py) — MESH_DONATION_GATE
+  (R6), UNSPECCED_POOL, PSPEC_MISMATCH (axis + arity forms),
+  HOST_READ_OF_SHARDED, SHARD_AXIS_DRIFT;
+* the seeded R6 regression fixture
+  (tests/fixtures/mesh_donation_reload.py), pinned must-fire;
+* the runtime verifier (testing/shardcheck.py) — the dynamic half that
+  covers the MAY placements the static pass deliberately skips;
+* engine integration — the whole-tree gate (0 unbaselined findings),
+  the fingerprint cache (rule-table edits invalidate, line drift stays
+  warm, warm < cold), --changed-only mesh-reach expansion, the
+  placement_rules_wall_ms stamp, and the registry-generated rule docs.
+
+House convention: one true-positive fixture per shape the rule exists
+for, one false-positive guard per sanctioned idiom it must stay quiet
+on. Definite-vs-may is the documented soundness trade: conditional
+placements never fire statically and are covered by shardcheck at
+runtime instead.
+"""
+
+import textwrap
+from pathlib import Path
+
+import jax
+import pytest
+
+from fluidframework_tpu.analysis import analyze_paths, analyze_source
+
+PACKAGE_DIR = Path(__file__).resolve().parents[1] / "fluidframework_tpu"
+FIXTURE = Path(__file__).resolve().parent / "fixtures" / \
+    "mesh_donation_reload.py"
+
+PLACEMENT_RULES = ["HOST_READ_OF_SHARDED", "MESH_DONATION_GATE",
+                   "PSPEC_MISMATCH", "SHARD_AXIS_DRIFT",
+                   "UNSPECCED_POOL"]
+
+#: The mesh tier the placement layer scopes to (= make lint-placement).
+SCOPE_DIRS = [str(PACKAGE_DIR / d)
+              for d in ("mergetree", "server", "parallel")]
+
+
+def lint(src, rule):
+    return [v.rule_id for v in
+            analyze_source(textwrap.dedent(src), only=[rule])]
+
+
+def findings(src, rule):
+    return [v for v in analyze_source(textwrap.dedent(src), only=[rule])]
+
+
+# ---------------------------------------------------------------------------
+# MESH_DONATION_GATE
+# ---------------------------------------------------------------------------
+
+class TestMeshDonationGate:
+    def test_true_positive_local_donating_jit_on_sharded_state(self):
+        vs = findings("""
+            import jax
+            from fluidframework_tpu.parallel.mesh import make_mesh, \\
+                shard_docs
+
+            def serve_impl(state, ops):
+                return state
+
+            def step(state, ops):
+                mesh = make_mesh(dp=8)
+                state = shard_docs(mesh, state)
+                serve = jax.jit(serve_impl, donate_argnums=(0,))
+                return serve(state, ops)
+        """, "MESH_DONATION_GATE")
+        assert [v.rule_id for v in vs] == ["MESH_DONATION_GATE"]
+        assert "warm reload" in vs[0].message
+
+    def test_true_positive_module_level_partial_wrap(self):
+        """The R6 bug shape exactly: a module-level
+        functools.partial(jax.jit, donate_argnums=...) callee resolved
+        through the whole-program call graph, not a local binding."""
+        assert lint("""
+            import functools
+            import jax
+            from fluidframework_tpu.parallel.mesh import make_mesh, \\
+                shard_docs
+
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def serve(state, ops):
+                return state
+
+            def warm_reload_step(state, ops):
+                mesh = make_mesh(dp=8)
+                state = shard_docs(mesh, state)
+                return serve(state, ops)
+        """, "MESH_DONATION_GATE") == ["MESH_DONATION_GATE"]
+
+    def test_guard_keep_dispatch_quiet(self):
+        """No donation, no gate — the keep variant IS the sanctioned
+        mesh dispatch (mergetree/paging.py's `_keep` twins)."""
+        assert lint("""
+            import jax
+            from fluidframework_tpu.parallel.mesh import make_mesh, \\
+                shard_docs
+
+            def serve_impl(state, ops):
+                return state
+
+            def step(state, ops):
+                mesh = make_mesh(dp=8)
+                state = shard_docs(mesh, state)
+                serve = jax.jit(serve_impl)
+                return serve(state, ops)
+        """, "MESH_DONATION_GATE") == []
+
+    def test_guard_conditional_placement_is_may(self):
+        """The production dual-mode idiom (`if mesh is not None:`)
+        records a MAY placement — never fires; shardcheck covers it
+        dynamically instead."""
+        assert lint("""
+            import jax
+            from fluidframework_tpu.parallel.mesh import make_mesh, \\
+                shard_docs
+
+            def serve_impl(state, ops):
+                return state
+
+            def step(state, ops, use_mesh):
+                if use_mesh:
+                    mesh = make_mesh(dp=8)
+                    state = shard_docs(mesh, state)
+                serve = jax.jit(serve_impl, donate_argnums=(0,))
+                return serve(state, ops)
+        """, "MESH_DONATION_GATE") == []
+
+    def test_guard_unsharded_donation_quiet(self):
+        """Single-chip donation is the whole point of the serving fast
+        path — only mesh-sharded donations gate."""
+        assert lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def serve_impl(state, ops):
+                return state
+
+            def step(ops):
+                state = jnp.zeros((8, 4))
+                serve = jax.jit(serve_impl, donate_argnums=(0,))
+                return serve(state, ops)
+        """, "MESH_DONATION_GATE") == []
+
+
+# ---------------------------------------------------------------------------
+# UNSPECCED_POOL
+# ---------------------------------------------------------------------------
+
+class TestUnspeccedPool:
+    def test_true_positive_host_pool_into_mesh_dispatch(self):
+        vs = findings("""
+            import jax
+            import jax.numpy as jnp
+            from fluidframework_tpu.parallel.mesh import make_mesh, \\
+                shard_docs
+
+            def step_impl(pool, docs):
+                return pool
+
+            def run(docs):
+                mesh = make_mesh(dp=8)
+                docs = shard_docs(mesh, docs)
+                page_pool = jnp.zeros((64, 128))
+                step = jax.jit(step_impl)
+                return step(page_pool, docs)
+        """, "UNSPECCED_POOL")
+        assert [v.rule_id for v in vs] == ["UNSPECCED_POOL"]
+        assert "page_pool" in vs[0].message
+        assert "place_with_rules" in vs[0].message
+
+    def test_guard_pool_placed_with_rules_quiet(self):
+        """The fix the finding prescribes: route the pool through the
+        partition-rule table first."""
+        assert lint("""
+            import jax
+            import jax.numpy as jnp
+            from fluidframework_tpu.mergetree.partition_rules import (
+                POOL_PARTITION_RULES, place_with_rules)
+            from fluidframework_tpu.parallel.mesh import make_mesh, \\
+                shard_docs
+
+            def step_impl(pool, docs):
+                return pool
+
+            def run(docs):
+                mesh = make_mesh(dp=8)
+                docs = shard_docs(mesh, docs)
+                page_pool = jnp.zeros((64, 128))
+                page_pool = place_with_rules(mesh, page_pool,
+                                             POOL_PARTITION_RULES)
+                step = jax.jit(step_impl)
+                return step(page_pool, docs)
+        """, "UNSPECCED_POOL") == []
+
+    def test_guard_placement_helper_itself_is_not_a_dispatch(self):
+        """`place_with_rules(mesh, pool, RULES)` takes the host pool BY
+        DESIGN — the placement helpers can never fire the rule they
+        exist to satisfy."""
+        assert lint("""
+            import jax.numpy as jnp
+            from fluidframework_tpu.mergetree.partition_rules import (
+                POOL_PARTITION_RULES, match_partition_rules,
+                place_with_rules)
+            from fluidframework_tpu.parallel.mesh import make_mesh
+
+            def build():
+                mesh = make_mesh(dp=8)
+                page_pool = jnp.zeros((64, 128))
+                specs = match_partition_rules(POOL_PARTITION_RULES,
+                                              page_pool)
+                return place_with_rules(mesh, page_pool,
+                                        POOL_PARTITION_RULES), specs
+        """, "UNSPECCED_POOL") == []
+
+    def test_guard_no_mesh_involvement_quiet(self):
+        """A host pool into a host dispatch (no sharded co-arguments,
+        no donation, no in_shardings) is single-chip code."""
+        assert lint("""
+            import jax
+            import jax.numpy as jnp
+
+            def step_impl(pool, docs):
+                return pool
+
+            def run(docs):
+                page_pool = jnp.zeros((64, 128))
+                step = jax.jit(step_impl)
+                return step(page_pool, docs)
+        """, "UNSPECCED_POOL") == []
+
+
+# ---------------------------------------------------------------------------
+# PSPEC_MISMATCH
+# ---------------------------------------------------------------------------
+
+class TestPspecMismatch:
+    def test_true_positive_unknown_axis(self):
+        vs = findings("""
+            from jax.sharding import Mesh, NamedSharding, \\
+                PartitionSpec as P
+            import jax
+
+            def place(x, mesh):
+                return jax.device_put(x, NamedSharding(mesh, P("model")))
+        """, "PSPEC_MISMATCH")
+        assert [v.rule_id for v in vs] == ["PSPEC_MISMATCH"]
+        assert "'model'" in vs[0].message
+
+    def test_true_positive_arity_exceeds_rank(self):
+        vs = findings("""
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            import jax, jax.numpy as jnp
+
+            def arity(mesh):
+                x = jnp.zeros((4, 8))
+                return jax.device_put(
+                    x, NamedSharding(mesh, P("dp", None, "sp")))
+        """, "PSPEC_MISMATCH")
+        assert any("rank 2" in v.message for v in vs)
+
+    def test_guard_known_axes_quiet(self):
+        assert lint("""
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            import jax, jax.numpy as jnp
+
+            def place(x, mesh):
+                x = jax.device_put(x, NamedSharding(mesh, P("dp")))
+                return jax.device_put(
+                    x, NamedSharding(mesh, P("dp", "sp")))
+        """, "PSPEC_MISMATCH") == []
+
+    def test_guard_starred_spec_unknowable_quiet(self):
+        """`P(*spec)` (parallel/mesh.py's generic placement helper)
+        resolves to an unknown spec — never a mismatch claim."""
+        assert lint("""
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            import jax
+
+            def expand(x, mesh, spec):
+                return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+        """, "PSPEC_MISMATCH") == []
+
+    def test_guard_unrelated_local_P_is_not_a_spec(self):
+        """A bare `P` only counts as PartitionSpec when the module's
+        import table maps it there — a local helper named P stays
+        invisible."""
+        assert lint("""
+            def P(*parts):
+                return "/".join(parts)
+
+            def route():
+                return P("model")
+        """, "PSPEC_MISMATCH") == []
+
+
+# ---------------------------------------------------------------------------
+# HOST_READ_OF_SHARDED
+# ---------------------------------------------------------------------------
+
+class TestHostReadOfSharded:
+    def test_true_positive_item_on_sharded(self):
+        vs = findings("""
+            import jax
+            from fluidframework_tpu.parallel.mesh import make_mesh, \\
+                shard_docs
+
+            def poll(counts):
+                mesh = make_mesh(dp=8)
+                counts = shard_docs(mesh, counts)
+                return counts.item()
+        """, "HOST_READ_OF_SHARDED")
+        assert [v.rule_id for v in vs] == ["HOST_READ_OF_SHARDED"]
+        assert "blocking host transfer" in vs[0].message
+
+    def test_true_positive_np_asarray_on_sharded(self):
+        assert lint("""
+            import numpy as np
+            from fluidframework_tpu.parallel.mesh import make_mesh, \\
+                shard_docs
+
+            def poll_lengths(counts):
+                mesh = make_mesh(dp=8)
+                counts = shard_docs(mesh, counts)
+                return np.asarray(counts)
+        """, "HOST_READ_OF_SHARDED") == ["HOST_READ_OF_SHARDED"]
+
+    def test_guard_sanctioned_gather_helper_quiet(self):
+        """*gather*/*to_host*/... helper names are the sanctioned
+        host-read sites (the serving tier's naming convention)."""
+        assert lint("""
+            import numpy as np
+            from fluidframework_tpu.parallel.mesh import make_mesh, \\
+                shard_docs
+
+            def gather_counts(counts):
+                mesh = make_mesh(dp=8)
+                counts = shard_docs(mesh, counts)
+                return np.asarray(counts)
+        """, "HOST_READ_OF_SHARDED") == []
+
+    def test_guard_host_array_read_quiet(self):
+        assert lint("""
+            import jax.numpy as jnp
+
+            def count():
+                x = jnp.zeros((4,))
+                return x.item()
+        """, "HOST_READ_OF_SHARDED") == []
+
+
+# ---------------------------------------------------------------------------
+# SHARD_AXIS_DRIFT
+# ---------------------------------------------------------------------------
+
+class TestShardAxisDrift:
+    def test_true_positive_discarded_conflicting_constraint(self):
+        vs = findings("""
+            import jax, jax.numpy as jnp
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from fluidframework_tpu.parallel.mesh import make_mesh
+
+            def two_specs(mesh, x):
+                x = jax.device_put(x, NamedSharding(mesh, P("dp")))
+                jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, P("sp")))
+                return x
+        """, "SHARD_AXIS_DRIFT")
+        assert [v.rule_id for v in vs] == ["SHARD_AXIS_DRIFT"]
+        assert "no-op" in vs[0].message  # pure call, result discarded
+
+    def test_true_positive_in_shardings_disagree(self):
+        """One binding crossing two jit boundaries whose in_shardings
+        conflict: GSPMD inserts a silent full reshard every call."""
+        vs = findings("""
+            import jax, jax.numpy as jnp
+            from jax.sharding import PartitionSpec as P
+
+            def impl(x):
+                return x
+
+            def cross():
+                x = jnp.zeros((8, 4))
+                a = jax.jit(impl, in_shardings=P("dp"))
+                b = jax.jit(impl, in_shardings=P("sp"))
+                ya = a(x)
+                yb = b(x)
+                return ya, yb
+        """, "SHARD_AXIS_DRIFT")
+        assert [v.rule_id for v in vs] == ["SHARD_AXIS_DRIFT"]
+        assert "silent full reshard" in vs[0].message
+
+    def test_guard_rebind_is_the_sanctioned_reshard(self):
+        """`x = device_put(x, ...)` under a new spec IS the explicit
+        reshard idiom — quiet by design."""
+        assert lint("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def ok_reshard(mesh, x):
+                x = jax.device_put(x, NamedSharding(mesh, P("dp")))
+                x = jax.device_put(x, NamedSharding(mesh, P("sp")))
+                return x
+        """, "SHARD_AXIS_DRIFT") == []
+
+    def test_guard_same_spec_quiet(self):
+        assert lint("""
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def same(mesh, x):
+                x = jax.device_put(x, NamedSharding(mesh, P("dp")))
+                jax.lax.with_sharding_constraint(
+                    x, NamedSharding(mesh, P("dp")))
+                return x
+        """, "SHARD_AXIS_DRIFT") == []
+
+
+# ---------------------------------------------------------------------------
+# the seeded R6 fixture
+# ---------------------------------------------------------------------------
+
+class TestSeededMeshDonationFixture:
+    def test_mesh_donation_fixture_must_fire(self):
+        """The tests/test_mesh_serving.py warm-reload repro shape,
+        committed under tests/fixtures — MESH_DONATION_GATE can never
+        regress to vacuous while this pin holds."""
+        vs = [v for v in analyze_source(FIXTURE.read_text(),
+                                        only=["MESH_DONATION_GATE"])]
+        assert len(vs) == 1, "seeded mesh-donation fixture no longer " \
+            "fires exactly once"
+        v = vs[0]
+        assert v.rule_id == "MESH_DONATION_GATE"
+        assert "`state`" in v.message and "`serve`" in v.message
+        assert "R6" in v.message and "warm reload" in v.message
+
+
+# ---------------------------------------------------------------------------
+# whole-tree gate
+# ---------------------------------------------------------------------------
+
+class TestWholeTreeGate:
+    def test_no_unbaselined_placement_findings(self):
+        """The make lint-placement acceptance: the real mesh tier
+        (mergetree/ + server/ + parallel/) carries ZERO unbaselined
+        placement findings — no suppressions were needed either, the
+        definite/may split absorbs the dual-mode construction paths."""
+        from fluidframework_tpu.analysis.baseline import Baseline
+        result = analyze_paths(SCOPE_DIRS, baseline=Baseline.load(),
+                               only=PLACEMENT_RULES)
+        assert result.violations == [], "\n".join(
+            v.render() for v in result.violations)
+
+    def test_real_tree_model_facts(self):
+        """The model sees the tier's actual mesh architecture: the
+        dp/sp axes union and the mesh.py construction site."""
+        import ast
+        from fluidframework_tpu.analysis.engine import (
+            ModuleContext, ProgramContext, _rel_path, iter_python_files)
+        contexts = []
+        for f in iter_python_files(SCOPE_DIRS):
+            src = f.read_text()
+            contexts.append(ModuleContext(_rel_path(f), src,
+                                          ast.parse(src)))
+        model = ProgramContext(contexts).placement()
+        assert model.mesh_axes == {"dp", "sp"}
+        assert "fluidframework_tpu/parallel/mesh.py" in model.fact_files
+        # the rule table digested out of the real partition_rules.py
+        assert model.table_digest not in ("absent", "unparsable")
+
+
+# ---------------------------------------------------------------------------
+# fingerprint cache: rule-table digest semantics
+# ---------------------------------------------------------------------------
+
+TABLE = '''
+from jax.sharding import PartitionSpec as P
+
+POOL_PARTITION_RULES = [
+    (r"length", P("dp")),
+]
+'''
+
+SERVE = '''
+from fluidframework_tpu.parallel.mesh import make_mesh, shard_docs
+
+
+def poll(counts):
+    mesh = make_mesh(dp=8)
+    counts = shard_docs(mesh, counts)
+    return counts.item()
+'''
+
+
+class TestPlacementCache:
+    def _write_pkg(self, tmp_path):
+        pkg = tmp_path / "fluidframework_tpu"
+        (pkg / "mergetree").mkdir(parents=True)
+        (pkg / "server").mkdir()
+        (pkg / "mergetree" / "partition_rules.py").write_text(TABLE)
+        (pkg / "server" / "serve.py").write_text(SERVE)
+        return pkg
+
+    def test_cold_then_warm(self, tmp_path):
+        from fluidframework_tpu.analysis.cache import ResultCache
+        pkg = self._write_pkg(tmp_path)
+        cold = analyze_paths([str(pkg)], only=PLACEMENT_RULES,
+                             cache=ResultCache(tmp_path / "c.json"))
+        assert [v.rule_id for v in cold.violations] == \
+            ["HOST_READ_OF_SHARDED"]
+        warm = analyze_paths([str(pkg)], only=PLACEMENT_RULES,
+                             cache=ResultCache(tmp_path / "c.json"))
+        assert warm.cache_hits == 2 and warm.cache_misses == 0
+        assert [v.rule_id for v in warm.violations] == \
+            ["HOST_READ_OF_SHARDED"]
+
+    def test_rule_table_edit_invalidates_every_module(self, tmp_path):
+        """A semantic edit to a ``*_RULES`` assignment changes the
+        program digest: EVERY module re-analyzes, byte-identical or
+        not — the placement twist on the v3 concurrency-edit test."""
+        from fluidframework_tpu.analysis.cache import ResultCache
+        pkg = self._write_pkg(tmp_path)
+        analyze_paths([str(pkg)], only=PLACEMENT_RULES,
+                      cache=ResultCache(tmp_path / "c.json"))
+        (pkg / "mergetree" / "partition_rules.py").write_text(
+            TABLE.replace('P("dp")', 'P("sp")'))
+        warm = analyze_paths([str(pkg)], only=PLACEMENT_RULES,
+                             cache=ResultCache(tmp_path / "c.json"))
+        assert warm.cache_misses == 2 and warm.cache_hits == 0
+
+    def test_rule_table_line_drift_stays_warm(self, tmp_path):
+        """The digest is ``ast.dump``-based (line-number-free): a
+        comment prepended to the rule table re-analyzes only the table
+        module itself; everything downstream stays cached."""
+        from fluidframework_tpu.analysis.cache import ResultCache
+        pkg = self._write_pkg(tmp_path)
+        analyze_paths([str(pkg)], only=PLACEMENT_RULES,
+                      cache=ResultCache(tmp_path / "c.json"))
+        (pkg / "mergetree" / "partition_rules.py").write_text(
+            "# table moved down one line\n" + TABLE)
+        warm = analyze_paths([str(pkg)], only=PLACEMENT_RULES,
+                             cache=ResultCache(tmp_path / "c.json"))
+        assert warm.cache_hits == 1 and warm.cache_misses == 1
+
+    def test_warm_full_tier_run_is_faster(self, tmp_path):
+        """The make lint-placement perf contract over the real tier:
+        the second (cached) run completes faster than the cold one and
+        the stamped stats prove the cache did it."""
+        from fluidframework_tpu.analysis.cache import ResultCache
+        cache_path = tmp_path / "c.json"
+        cold = analyze_paths(SCOPE_DIRS, cache=ResultCache(cache_path))
+        warm = analyze_paths(SCOPE_DIRS, cache=ResultCache(cache_path))
+        assert warm.cache_hits == warm.files and warm.cache_misses == 0
+        assert warm.wall_ms < cold.wall_ms, (
+            f"cached run not faster: {warm.wall_ms:.0f}ms vs cold "
+            f"{cold.wall_ms:.0f}ms")
+
+    def test_placement_wall_ms_stamped(self, tmp_path):
+        pkg = self._write_pkg(tmp_path)
+        result = analyze_paths([str(pkg)], only=PLACEMENT_RULES)
+        assert result.placement_rules_wall_ms > 0
+        assert "placement_rules_wall_ms" in result.stats
+
+    def test_non_placement_filtered_run_skips_the_model(self, tmp_path):
+        """A rule filter excluding the placement family must not pay
+        the placement-model build — neither for the rules nor for the
+        cache digest."""
+        from fluidframework_tpu.analysis.cache import ResultCache
+        pkg = self._write_pkg(tmp_path)
+        result = analyze_paths([str(pkg)], only=["MUTABLE_DEFAULT"],
+                               cache=ResultCache(tmp_path / "c.json"))
+        assert result.placement_rules_wall_ms == 0
+
+
+# ---------------------------------------------------------------------------
+# --changed-only mesh-reach expansion
+# ---------------------------------------------------------------------------
+
+FEED = '''
+from fluidframework_tpu.parallel.mesh import make_mesh
+
+
+def build():
+    return make_mesh(dp=8)
+'''
+
+
+class TestChangedOnlyMeshReach:
+    def _write_pkg(self, tmp_path):
+        pkg = tmp_path / "fluidframework_tpu" / "server"
+        pkg.mkdir(parents=True)
+        (pkg / "serve.py").write_text(SERVE)
+        (pkg / "feed.py").write_text(FEED)
+        (pkg / "island.py").write_text("X = 1\n")
+        return pkg
+
+    def test_mesh_fact_change_expands_to_the_group(self, tmp_path):
+        """Placement is whole-program through the mesh-axes union and
+        the rule table: restricting reporting to a file carrying a
+        mesh construction site still re-reports the OTHER fact files'
+        placement findings."""
+        from fluidframework_tpu.analysis.engine import _rel_path
+        pkg = self._write_pkg(tmp_path)
+        restrict = {_rel_path(pkg / "feed.py")}
+        result = analyze_paths([str(pkg)], restrict=restrict,
+                               only=PLACEMENT_RULES)
+        assert any(v.path.endswith("serve.py")
+                   for v in result.violations), \
+            "placement finding in serve.py must re-report when " \
+            "feed.py (a mesh fact file) changed"
+
+    def test_changed_outside_group_stays_scoped(self, tmp_path):
+        """A changed file with no placement facts must not drag the
+        group's findings into the report."""
+        from fluidframework_tpu.analysis.engine import _rel_path
+        pkg = self._write_pkg(tmp_path)
+        restrict = {_rel_path(pkg / "island.py")}
+        result = analyze_paths([str(pkg)], restrict=restrict,
+                               only=PLACEMENT_RULES)
+        assert result.violations == []
+
+    def test_real_mesh_helper_change_expands(self):
+        """parallel/mesh.py is a helper file of the placement layer: a
+        change there re-reports placement rules across the whole
+        fact-file group, not just mesh.py itself."""
+        result = analyze_paths(
+            SCOPE_DIRS, only=PLACEMENT_RULES,
+            restrict={"fluidframework_tpu/parallel/mesh.py"})
+        assert result.files > 1, \
+            "mesh.py change must expand over its placement reach"
+
+    def test_real_factless_change_stays_scoped(self):
+        result = analyze_paths(
+            SCOPE_DIRS, only=PLACEMENT_RULES,
+            restrict={"fluidframework_tpu/mergetree/oppack.py"})
+        assert result.files == 1
+
+
+# ---------------------------------------------------------------------------
+# runtime shardcheck (the dynamic half)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs the 8-virtual-device mesh")
+class TestRuntimeShardcheck:
+    def _mesh_and_pool(self):
+        import jax.numpy as jnp
+        from fluidframework_tpu.mergetree.partition_rules import (
+            POOL_PARTITION_RULES, place_with_rules)
+        from fluidframework_tpu.parallel.mesh import make_mesh
+        mesh = make_mesh(sp=1)
+        pool = {"length": jnp.zeros((8, 4), jnp.int32),
+                "count": jnp.ones((8,), jnp.int32)}
+        placed = place_with_rules(mesh, pool, POOL_PARTITION_RULES)
+        return mesh, pool, placed
+
+    def test_rule_placed_pool_verifies(self):
+        from fluidframework_tpu.mergetree.partition_rules import (
+            POOL_PARTITION_RULES)
+        from fluidframework_tpu.testing import shardcheck
+        mesh, _, placed = self._mesh_and_pool()
+        assert shardcheck.assert_placement(
+            placed, mesh, POOL_PARTITION_RULES, where="pool") == 2
+
+    def test_drifted_pool_raises_with_leaf_names(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from fluidframework_tpu.mergetree.partition_rules import (
+            POOL_PARTITION_RULES)
+        from fluidframework_tpu.testing import shardcheck
+        mesh, _, placed = self._mesh_and_pool()
+        placed["length"] = jax.device_put(
+            placed["length"], NamedSharding(mesh, P()))  # replicated!
+        with pytest.raises(shardcheck.ShardingMismatch,
+                           match="pool/length"):
+            shardcheck.assert_placement(placed, mesh,
+                                        POOL_PARTITION_RULES,
+                                        where="pool")
+
+    def test_instrument_checks_before_dispatch(self):
+        """The wrap asserts the statically predicted spec against the
+        ACTUAL input sharding at the dispatch boundary — this is how a
+        suppressed/MAY placement still gets caught when it runs."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from fluidframework_tpu.mergetree.partition_rules import (
+            POOL_PARTITION_RULES)
+        from fluidframework_tpu.testing import shardcheck
+        mesh, _, placed = self._mesh_and_pool()
+        step = shardcheck.instrument(lambda pool: pool, mesh,
+                                     POOL_PARTITION_RULES)
+        step(placed)
+        assert step.checks == 2
+        bad = dict(placed)
+        bad["count"] = jax.device_put(bad["count"],
+                                      NamedSharding(mesh, P()))
+        with pytest.raises(shardcheck.ShardingMismatch):
+            step(bad)
+
+    def test_unmatched_leaf_refuses_to_guess(self):
+        """An unspecced non-scalar leaf RAISES (naming the
+        UNSPECCED_POOL hazard) — the old NotImplementedError hole must
+        never silently come back as a default placement."""
+        import jax.numpy as jnp
+        from fluidframework_tpu.mergetree.partition_rules import (
+            POOL_PARTITION_RULES, match_partition_rules)
+        with pytest.raises(ValueError, match="UNSPECCED_POOL"):
+            match_partition_rules(POOL_PARTITION_RULES,
+                                  {"mystery": jnp.zeros((4, 4))})
+
+    def test_placement_report_shapes_the_dryrun_stamp(self):
+        from types import SimpleNamespace
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from fluidframework_tpu.mergetree.partition_rules import (
+            POOL_PARTITION_RULES, resolved_spec_table)
+        from fluidframework_tpu.testing import shardcheck
+        mesh, _, placed = self._mesh_and_pool()
+        store = SimpleNamespace(
+            mesh=mesh, buckets=[],
+            pages=SimpleNamespace(
+                pool=placed, mesh=mesh,
+                placement_spec_table=lambda: resolved_spec_table(
+                    placed, POOL_PARTITION_RULES)))
+        report = shardcheck.placement_report(store, mesh)
+        assert report["ok"] and report["checked"] == 2
+        assert report["pool_specs"]["length"] == "PartitionSpec('dp',)"
+        store.pages.pool = dict(
+            placed, length=jax.device_put(placed["length"],
+                                          NamedSharding(mesh, P())))
+        report = shardcheck.placement_report(store, mesh)
+        assert not report["ok"]
+        assert "drifted" in report["error"]
+
+
+# ---------------------------------------------------------------------------
+# registry-generated rule docs
+# ---------------------------------------------------------------------------
+
+class TestRuleDocs:
+    def test_docs_table_matches_registry(self):
+        """The drift gate: the marker-bounded table in
+        docs/static_analysis.md must equal the registry's generated
+        one — run --write-rule-docs after adding a rule."""
+        from fluidframework_tpu.analysis.__main__ import (
+            RULE_DOCS_BEGIN, RULE_DOCS_END, RULE_DOCS_PATH)
+        from fluidframework_tpu.analysis.registry import \
+            rules_markdown_table
+        text = RULE_DOCS_PATH.read_text()
+        begin = text.index(RULE_DOCS_BEGIN) + len(RULE_DOCS_BEGIN)
+        end = text.index(RULE_DOCS_END)
+        assert text[begin:end].strip() == rules_markdown_table().strip(), \
+            "docs rule table drifted from the registry; run " \
+            "python -m fluidframework_tpu.analysis --write-rule-docs"
+
+    def test_help_epilog_lists_every_rule(self):
+        from fluidframework_tpu.analysis.registry import (
+            RULES, rules_help_text)
+        text = rules_help_text()
+        for rule_id, rule in RULES.items():
+            assert rule_id in text
+            assert rule.summary in text
+
+    def test_write_rule_docs_is_idempotent(self, tmp_path):
+        from fluidframework_tpu.analysis.__main__ import (
+            RULE_DOCS_PATH, rewrite_rule_docs)
+        copy = tmp_path / "static_analysis.md"
+        copy.write_text(RULE_DOCS_PATH.read_text())
+        first = rewrite_rule_docs(copy)
+        assert first == copy.read_text()
+        assert rewrite_rule_docs(copy) == first
