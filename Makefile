@@ -4,7 +4,7 @@
 .PHONY: test soak bench dryrun record-corpus historian-smoke \
 	summarize-smoke trace-smoke pipeline-smoke fused-smoke \
 	paged-smoke catchup-smoke obs-smoke ingest-smoke e2e-smoke \
-	bench-trend \
+	mega-smoke bench-trend \
 	lint-analysis \
 	lint-changed lint-races lint-placement layer-check check
 
@@ -178,14 +178,24 @@ ingest-smoke:
 e2e-smoke:
 	JAX_PLATFORMS=cpu python bench.py e2e-smoke
 
+# The R10 serving megakernel (docs/serving_pipeline.md): a ragged
+# contended fleet through the paged native pump must emit
+# ORDER-identically to the per-window scan path, amortize dispatch to
+# < 0.25 per served fast window with zero lowering fallbacks, and
+# clear 2x the r08 paged pin min()'d against a paired in-process run
+# of the r08 object-path serving architecture (the host-drift rule).
+# Stamps BENCH_MEGA_LAST.json (gated by `bench.py trend`).
+mega-smoke:
+	JAX_PLATFORMS=cpu python bench.py mega-smoke
+
 # The pre-merge gate: layering/cycles + static analysis (incl. the
 # focused race and placement gates) + the summarize/trace/pipeline/fused/paged/catchup/
-# overload/obs/ingest/e2e smokes + the bench trend (report-only here) +
-# the full test suite.
+# overload/obs/ingest/e2e/mega smokes + the bench trend (report-only
+# here) + the full test suite.
 check: layer-check lint-analysis lint-races lint-placement \
 		summarize-smoke trace-smoke \
 		pipeline-smoke fused-smoke paged-smoke catchup-smoke \
-		overload-smoke obs-smoke ingest-smoke e2e-smoke test
+		overload-smoke obs-smoke ingest-smoke e2e-smoke mega-smoke test
 	python bench.py trend --report-only
 
 # The round-end randomized-evidence ritual: 50-trial soaks over every

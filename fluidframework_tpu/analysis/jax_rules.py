@@ -254,12 +254,22 @@ def dtype_drift(ctx: ModuleContext) -> Iterator[Violation]:
 # an io_callback/debug.callback re-entering the host per scan step (or a
 # block_until_ready forcing a device sync at trace/staging time) would
 # silently reintroduce the serialized RPC the fusion exists to delete,
-# K times per burst.
+# K times per burst. The R10 megakernel raises the stakes: a
+# pl.pallas_call kernel body IS the persistent device program — a host
+# callback there cannot lower at all on TPU (the fallback would eat the
+# whole kernel, silently), and a sync at trace time stalls the one-in-
+# flight megakernel ring.
 _SCAN_DRIVER_BODY_ARGS = {
     "scan": (0,),          # lax.scan(body, init, xs)
     "while_loop": (0, 1),  # lax.while_loop(cond_fun, body_fun, init)
     "fori_loop": (2,),     # lax.fori_loop(lo, hi, body_fun, init)
+    "pallas_call": (0,),   # pl.pallas_call(kernel, out_shape=..., ...)
 }
+
+# pallas_call is not a lax symbol; it arrives as pl.pallas_call /
+# pallas.pallas_call / fully qualified. Bare "scan" is too generic to
+# match unqualified; "pallas_call" is not.
+_PALLAS_HEADS = ("pl", "pallas", "jax.experimental.pallas", "")
 
 _HOST_CALLBACK_NAMES = {
     "io_callback", "jax.experimental.io_callback",
@@ -287,6 +297,8 @@ def _scan_driver(call: ast.Call) -> Optional[Tuple[str, tuple]]:
     if not fn:
         return None
     head, _, tail = fn.rpartition(".")
+    if tail == "pallas_call" and head in _PALLAS_HEADS:
+        return tail, _SCAN_DRIVER_BODY_ARGS[tail]
     if tail in _SCAN_DRIVER_BODY_ARGS and head in ("lax", "jax.lax", ""):
         # Bare names ("scan") only count when qualified — too generic
         # otherwise.
@@ -311,7 +323,7 @@ def _body_functions(ctx: ModuleContext, call: ast.Call,
         if pos < len(call.args):
             exprs.append(call.args[pos])
     for kw in call.keywords:
-        if kw.arg in ("f", "body_fun", "cond_fun") \
+        if kw.arg in ("f", "body_fun", "cond_fun", "kernel") \
                 and kw.value not in exprs:
             exprs.append(kw.value)
     for expr in exprs:
@@ -341,14 +353,17 @@ def _host_callback_hazards(body: ast.AST):
 
 
 @rule("SCAN_HOST_CALLBACK",
-      "Host callback / device sync inside a lax.scan or while_loop body",
+      "Host callback / device sync inside a scanned or pallas kernel body",
       family="jax",
       rationale="A scanned body re-entering the host (io_callback, "
                 "debug.callback, pure_callback) or forcing a sync "
                 "(.block_until_ready()) serializes every scan step on a "
                 "host round-trip — exactly the per-window RPC the fused "
-                "serving burst exists to remove. Move the host work to "
-                "the carry/ys boundary, or keep the value device-side.")
+                "serving burst exists to remove. Inside a pl.pallas_call "
+                "kernel the same constructs cannot lower at all: the "
+                "megakernel would silently fall back to the scan path "
+                "every ring. Move the host work to the carry/ys "
+                "boundary, or keep the value device-side.")
 def scan_host_callback(ctx: ModuleContext) -> Iterator[Violation]:
     if not _scan_scope(ctx):
         return
@@ -366,11 +381,20 @@ def scan_host_callback(ctx: ModuleContext) -> Iterator[Violation]:
                 if key in seen:
                     continue
                 seen.add(key)
-                yield ctx.violation(
-                    "SCAN_HOST_CALLBACK", hazard,
-                    f"`{what}` inside `{body_name}`, the body of a "
-                    f"`lax.{name}`: every step pays a host round-trip, "
-                    f"serializing the scanned program")
+                if name == "pallas_call":
+                    yield ctx.violation(
+                        "SCAN_HOST_CALLBACK", hazard,
+                        f"`{what}` inside `{body_name}`, a "
+                        f"`pl.pallas_call` kernel body: the kernel is a "
+                        f"persistent device program — host re-entry "
+                        f"cannot lower, and a sync stalls the "
+                        f"megakernel ring")
+                else:
+                    yield ctx.violation(
+                        "SCAN_HOST_CALLBACK", hazard,
+                        f"`{what}` inside `{body_name}`, the body of a "
+                        f"`lax.{name}`: every step pays a host "
+                        f"round-trip, serializing the scanned program")
 
 
 # serve/window joined step/apply when serve_window gained lane-state

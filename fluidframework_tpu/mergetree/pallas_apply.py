@@ -460,14 +460,19 @@ def apply_ops_fused_ref(state: DocState, ops: PackedOps) -> DocState:
 
 
 def _kernel(n_state: int, k: int, a: int, names, op3d: bool,
-            op_fields=None):
+            op_fields=None, extract: bool = False):
     """Grid = (doc_tiles, T). The state planes' block index is constant in
     t, so Mosaic keeps them VMEM-resident across the whole op stream
     (revisited-block accumulator pattern); each grid step applies ONE op
     whose scalars arrive as [TILE, 1] blocks — no dynamic slicing.
 
     op_fields extends the per-step scalars with the INSERT_RUN sub
-    columns (rl*/rs*/ri*) when run packing is active."""
+    columns (rl*/rs*/ri*) when run packing is active.
+
+    extract adds four narrow outputs past the state planes — overflow
+    (int16), count, min_seq, seq — written from the VMEM-resident result
+    on the LAST op step, so the serving drain can read the narrow planes
+    without a second extraction dispatch (the megakernel contract)."""
     op_fields = tuple(op_fields) if op_fields is not None else _OP_FIELDS
     with_runs = len(op_fields) > len(_OP_FIELDS)
 
@@ -505,12 +510,22 @@ def _kernel(n_state: int, k: int, a: int, names, op3d: bool,
         out = _apply_one_batched(st, op, k, a, ln, with_runs=with_runs)
         for i, name in enumerate(names):
             out_refs[i][:] = out[name]
+
+        if extract:
+            ex = out_refs[n_state:]
+
+            @pl.when(t == pl.num_programs(1) - 1)
+            def _extract():
+                ex[0][:] = out["overflow"].astype(jnp.int16)
+                ex[1][:] = out["count"]
+                ex[2][:] = out["min_seq"]
+                ex[3][:] = out["seq"]
     return kern
 
 
 def apply_ops_fused_pallas(state: DocState, ops: PackedOps,
                            interpret: bool = False,
-                           runs=None) -> DocState:
+                           runs=None, extract: bool = False):
     from jax.experimental import pallas as pl
 
     st, k, a = _to_planes(state)
@@ -548,18 +563,35 @@ def apply_ops_fused_pallas(state: DocState, ops: PackedOps,
     grid = (padded // tile, t_steps)
     out_shapes = [jax.ShapeDtypeStruct((padded, x.shape[1]), x.dtype)
                   for x in st_in]
+    if extract:
+        # Narrow planes past the states: overflow(int16), count, min_seq,
+        # seq — written in-kernel on the last op step (no aliasing; fresh
+        # outputs).
+        out_shapes = out_shapes + [
+            jax.ShapeDtypeStruct((padded, 1), jnp.int16),
+            jax.ShapeDtypeStruct((padded, 1), jnp.int32),
+            jax.ShapeDtypeStruct((padded, 1), jnp.int32),
+            jax.ShapeDtypeStruct((padded, 1), jnp.int32),
+        ]
+    out_specs = [state_block(x.shape[1]) for x in st_in]
+    if extract:
+        out_specs = out_specs + [state_block(1)] * 4
     outs = pl.pallas_call(
-        _kernel(len(names), k, a, names, op3d, op_fields),
+        _kernel(len(names), k, a, names, op3d, op_fields, extract=extract),
         out_shape=out_shapes,
         grid=grid,
         in_specs=[state_block(x.shape[1]) for x in st_in]
         + [op_block for _ in op_in],
-        out_specs=[state_block(x.shape[1]) for x in st_in],
+        out_specs=out_specs,
         input_output_aliases={i: i for i in range(len(st_in))},
         interpret=interpret,
     )(*st_in, *op_in)
     result = {name: outs[i][:b] for i, name in enumerate(names)}
-    return _from_planes(result, k, a)
+    out_state = _from_planes(result, k, a)
+    if extract:
+        narrow = tuple(outs[len(names) + i][:b, 0] for i in range(4))
+        return out_state, narrow
+    return out_state
 
 
 _FUSED_OK = None
@@ -620,6 +652,38 @@ def fused_runs_available() -> bool:
             record_swallow("pallas.fused_runs_unavailable")
             _FUSED_RUNS_OK = False
     return _FUSED_RUNS_OK
+
+
+_FUSED_EXTRACT_OK = None
+
+
+def fused_extract_available() -> bool:
+    """Probe the megakernel variant (in-kernel narrow extraction on the
+    last op step) separately: its Mosaic lowering adds the int16 store
+    and the four single-column output windows."""
+    global _FUSED_EXTRACT_OK
+    if _FUSED_EXTRACT_OK is None:
+        try:
+            from .oppack import HostOp, pack_ops
+            from .state import make_state
+
+            if not fused_available():
+                _FUSED_EXTRACT_OK = False
+                return False
+            tiny = make_state(8, 1, batch=1)
+            op = HostOp(kind=OpKind.INSERT, seq=1, ref_seq=0, client=0,
+                        pos1=0, op_id=0, new_len=3)
+            out, narrow = apply_ops_fused_pallas(tiny, pack_ops([[op]]),
+                                                 extract=True)
+            jax.block_until_ready(out.length)
+            _FUSED_EXTRACT_OK = (
+                int(jax.device_get(narrow[1])[0]) == 1
+                and int(jax.device_get(narrow[3])[0]) == 1)
+        except Exception:  # noqa: BLE001 — any Mosaic failure => fallback
+            from ..telemetry.counters import record_swallow
+            record_swallow("pallas.megakernel_unavailable")
+            _FUSED_EXTRACT_OK = False
+    return _FUSED_EXTRACT_OK
 
 
 def apply_ops_fused(state: DocState, ops: PackedOps) -> DocState:

@@ -38,7 +38,7 @@ from . import ticket_kernel as tk
 
 def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
                        lww_states, lww_cols, fused=False, merge_runs=None,
-                       noop_skip=False, stats=False):
+                       noop_skip=False, stats=False, paged_scalars=False):
     """The traced body shared by ``serve_window`` (one jitted window),
     ``serve_window_keep`` (the non-donating recovery variant), and
     ``serve_burst``'s scan step (K windows in one program).
@@ -77,7 +77,20 @@ def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
     masks the applies use, so a K-window burst reports exact per-window
     facts with zero extra dispatches and zero extra host round-trips
     (the plane rides this same flat16). Pure output: the op phases
-    never read it, so results are bit-identical with it on or off."""
+    never read it, so results are bit-identical with it on or off.
+
+    ``paged_scalars`` (static) is the MEGAKERNEL mode
+    (docs/serving_pipeline.md R10): the merge "buckets" are gathered
+    page-group views whose post scalars the host must adopt (paged
+    scalars are host-authoritative between flushes), so each merge
+    apply also produces the narrow tuple (overflow int16, count,
+    min_seq, seq) — extracted IN-KERNEL by the fused pallas program on
+    its last op step, or derived identically by the scan fallback —
+    and flat16 grows a per-group int32-halves scalar tail (see the
+    ``paged_tail`` packing below). ``fused == "interpret"`` runs the
+    SAME pallas program through the pallas interpreter so CPU tier-1
+    exercises the identical kernel; any other truthy ``fused`` means
+    Mosaic-lowered."""
     raw = tk.RawOps(client=ticket_cols[1], client_seq=ticket_cols[2],
                     ref_seq=ticket_cols[3], kind=ticket_cols[0])
     tstate, ticketed = tk._scan_tickets(tstate, raw, batched=True,
@@ -94,6 +107,7 @@ def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
     st_lww = zero
     st_skips = zero
     new_merge = []
+    merge_narrow = []  # paged_scalars only: per-group narrow tuples
     # fluidlint: disable=RETRACE_HAZARD — deliberate bounded unroll: one
     # iteration per capacity bucket (≤3 in production; docstring), fused
     # so the whole window stays a single device program.
@@ -139,7 +153,47 @@ def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
                     (ops2.kind == kv).astype(jnp.int32))
         from ..mergetree.pallas_apply import (FUSED_MAX_CAPACITY,
                                              apply_ops_fused_pallas)
-        use_fused = fused and mstate.capacity <= FUSED_MAX_CAPACITY
+        interp = fused == "interpret"
+        use_fused = bool(fused) and mstate.capacity <= FUSED_MAX_CAPACITY
+        if paged_scalars:
+            # Megakernel mode: every merge apply also yields the narrow
+            # scalar tuple the host adopts. The fused kernel extracts it
+            # on its last op step (one pallas invocation per group per
+            # window: gather view in, ops applied, narrow planes out);
+            # the scan fallback derives the bit-identical tuple.
+            def _narrow(s):
+                # fluidlint: disable=DTYPE_DRIFT — deliberate 16-bit
+                # wire packing (the overflow plane rides flat16).
+                return (s.overflow.astype(jnp.int16), s.count,
+                        s.min_seq, s.seq)
+            if use_fused:
+                def apply_m(s, o=ops2, r=runs):
+                    return apply_ops_fused_pallas(s, o, interpret=interp,
+                                                  runs=r, extract=True)
+            else:
+                def apply_m(s, o=ops2, r=runs):
+                    out = kernel._scan_ops(s, o, batched=True, runs=r)
+                    return out, _narrow(out)
+            if noop_skip:
+                active = jnp.any(ops2.kind != OpKind.NOOP)
+                if stats:
+                    st_skips = st_skips + (~active).astype(jnp.int32)
+                # kernel.apply_if_any carries state only; the megakernel
+                # body also threads the narrow tuple, so the pad-skip
+                # cond is inlined with a derived-narrow identity arm.
+                out, nr = jax.lax.cond(
+                    active, apply_m, lambda s: (s, _narrow(s)), mstate)
+            else:
+                out, nr = apply_m(mstate)
+            if over_extra is not None:
+                # A nacked INSERT_RUN member voids the slot host-side:
+                # the flag must reach BOTH the carried state and the
+                # narrow plane the host actually reads.
+                out = out._replace(overflow=out.overflow | over_extra)
+                nr = (nr[0] | over_extra.astype(jnp.int16),) + nr[1:]
+            new_merge.append(out)
+            merge_narrow.append(nr)
+            continue
         if runs is not None:
             # Run-bearing buckets: the fused kernel's INSERT_RUN variant
             # when Mosaic lowers it (fused == "both probes passed", see
@@ -147,7 +201,8 @@ def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
             # the packing itself collapses.
             if use_fused:
                 def apply_m(s, o=ops2, r=runs):
-                    return apply_ops_fused_pallas(s, o, runs=r)
+                    return apply_ops_fused_pallas(s, o, interpret=interp,
+                                                  runs=r)
             else:
                 def apply_m(s, o=ops2, r=runs):
                     return kernel._scan_ops(s, o, batched=True, runs=r)
@@ -158,7 +213,7 @@ def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
             # dominant cost) collapses to one read + one write.
             # Bit-identical to the scan kernel (tests/test_pallas_apply).
             def apply_m(s, o=ops2):
-                return apply_ops_fused_pallas(s, o)
+                return apply_ops_fused_pallas(s, o, interpret=interp)
         else:
             def apply_m(s, o=ops2):
                 return kernel._scan_ops(s, o, batched=True)
@@ -209,7 +264,13 @@ def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
     # per-bucket `overflow` D2H the rare recovery path used to pay.
     # fluidlint: disable=DTYPE_DRIFT — deliberate 16-bit wire packing:
     # the planes ride flat16, the narrow result plane (docstring).
-    planes = [s.overflow.astype(jnp.int16) for s in new_merge]
+    if paged_scalars:
+        # Megakernel: the overflow planes come from the narrow tuples
+        # (in-kernel extracted under fused; bit-identical derivation
+        # under the scan fallback — over_extra already OR'd in).
+        planes = [nr[0] for nr in merge_narrow]
+    else:
+        planes = [s.overflow.astype(jnp.int16) for s in new_merge]
     # fluidlint: disable=DTYPE_DRIFT — deliberate 16-bit wire packing
     # (same flat16 plane as the merge overflow planes above).
     planes += [s.overflow.astype(jnp.int16) for s in new_lww]
@@ -220,7 +281,13 @@ def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
     # until a compact-tick refresh — no extra device round-trip.
     # fluidlint: disable=DTYPE_DRIFT — deliberate 16-bit wire packing
     # (rides the same flat16 narrow result plane).
-    planes += [s.count.astype(jnp.int16) for s in new_merge]
+    if paged_scalars:
+        # int16 view of the group counts keeps the flat16 layout uniform
+        # with the bucketed wire; a large page group can wrap it, so the
+        # host adopts from the exact int32 paged_tail below instead.
+        planes += [nr[1].astype(jnp.int16) for nr in merge_narrow]
+    else:
+        planes += [s.count.astype(jnp.int16) for s in new_merge]
     # fluidlint: disable=DTYPE_DRIFT — deliberate 16-bit wire packing
     # (rides the same flat16 narrow result plane).
     planes += [(s.key >= 0).sum(-1).astype(jnp.int16) for s in new_lww]
@@ -248,6 +315,17 @@ def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
         # lo may land negative in int16 (bit 15): host re-masks & 0xFFFF.
         return [(x32 & 0xFFFF).astype(jnp.int16),
                 (x32 >> 16).astype(jnp.int16)]
+
+    paged_tail = []
+    if paged_scalars:
+        # Megakernel scalar-adoption plane: each page group's post
+        # count/min_seq/seq as EXACT int32 (lo, hi) halves — the host's
+        # paged scalars are authoritative between flushes, and the int16
+        # occupancy planes above can wrap for a large group, so every
+        # window's finish adopts these (the last window's adoption is
+        # the post-burst truth). Rides the same one flat16 readback.
+        for nr in merge_narrow:
+            paged_tail += halves(nr[1]) + halves(nr[2]) + halves(nr[3])
 
     stats_tail = []
     if stats:
@@ -280,7 +358,7 @@ def _serve_window_impl(tstate, ticket_cols, merge_states, merge_cols,
         # flat16 is the NARROW result plane (docstring); decoded by
         # tpu_sequencer._finish_window.
         + [jnp.concatenate([msn_ok[None]] + bits).astype(jnp.int16)]
-        + planes + stats_tail)
+        + planes + paged_tail + stats_tail)
     # Fetched ONLY when msn_ok == 0 (second RPC on the rare path).
     return tstate, new_merge, new_lww, flat16, msn_bt
 
@@ -424,3 +502,87 @@ serve_paged_burst = functools.partial(
 # is the lint half of the same contract).
 serve_paged_burst_keep = functools.partial(
     jax.jit, static_argnums=(6,))(_serve_paged_burst)
+
+
+def _serve_megakernel(tstate, pool, lww_states, ticket_xs, page_ids,
+                      counts, min_seqs, seqs, merge_xs, lww_xs, runs_xs,
+                      fused=False, stats=False):
+    """K fast serving windows over PAGED merge lanes in ONE device
+    program — the serving megakernel (docs/serving_pipeline.md R10).
+
+    This is the paged twin of ``_serve_burst``: the native pump's fast
+    flush stages its merge rows as PAGE-GROUP jobs (one group per pow2
+    page-count class, tpu_sequencer.MergeLaneStore paged mode) instead
+    of capacity buckets, and the whole pre-staged ring drains as one
+    dispatch. The program:
+
+      1. gathers each group's documents ONCE by page id
+         (kernel.gather_pages — view capacity is the GROUP's page
+         bucket, never a fleet-wide padded plane),
+      2. scans the K stacked windows with ``_serve_window_impl`` as the
+         body (ticketing + op applies + narrow extraction), the gathered
+         group views + LWW bucket states + ticket state as the carry —
+         under ``fused`` each group×window apply is one pallas kernel
+         invocation that applies the op phases VMEM-resident and
+         EXTRACTS the narrow planes (overflow int16, count/min_seq/seq
+         int32) on its own last op step (``fused == "interpret"`` runs
+         the identical program through the pallas interpreter for CPU
+         tier-1; ``fused=False`` is the counted scan-path fallback,
+         bit-identical by construction),
+      3. scatters each group's post view back through its immutable
+         page table.
+
+    xs layout:
+      ticket_xs: [K, 4, B, T]
+      page_ids/counts/min_seqs/seqs: per group, the dispatch-time paged
+        staging ([n_pad, p2] int32 tables + [n_pad] scalars; pid -1 =
+        padding) — immutable for the whole ring, NOT scanned over.
+      merge_xs:  per group [K, 12, n_pad, Tm] (NOOP-padded where a
+                 window staged nothing for the group)
+      lww_xs:    per LWW union bucket [K, 6, lanes, Tm]
+      runs_xs:   per group [K, 4, n_pad, Tm, RUN_K] or None
+
+    Returns (tstate', pool', lww_states', flat16_k [K, flat], msn_k
+    [K, B, T], pre_views): flat16 here carries the R10 paged scalar
+    tail (``paged_scalars`` in ``_serve_window_impl``) so the host
+    adopts exact post int32 scalars per window with no extra readback;
+    pre_views are the gathered pre-ring group views that make the
+    overflow rollback possible under donation (the paged analog of the
+    bucketed ``pre`` job states — rollback_pages + host rescue, same
+    recovery contract as ``_serve_paged_burst``).
+
+    One ring = one dispatch = one readback: dispatches/burst amortizes
+    toward 0 as the ring deepens, and the jit signature depends only on
+    (K, group shapes, B, T) — scan length does not fragment the grid
+    beyond the K axis, which the sequencer quantizes exactly like burst
+    k (``_burst_k_grid``)."""
+    pre = tuple(kernel.gather_pages(pool, p, c, m, s)
+                for p, c, m, s in zip(page_ids, counts, min_seqs, seqs))
+
+    def body(carry, xs):
+        ts, ms, ls = carry
+        tc, mc, lc, rc = xs
+        ts2, nm, nl, flat16, msn32 = _serve_window_impl(
+            ts, tc, list(ms), list(mc), list(ls), list(lc), fused,
+            list(rc), noop_skip=True, stats=stats, paged_scalars=True)
+        return (ts2, tuple(nm), tuple(nl)), (flat16, msn32)
+
+    carry, ys = jax.lax.scan(
+        body, (tstate, pre, tuple(lww_states)),
+        (ticket_xs, tuple(merge_xs), tuple(lww_xs), tuple(runs_xs)))
+    ts, ms, ls = carry
+    pool2 = pool
+    for p, out in zip(page_ids, ms):
+        pool2 = kernel.scatter_pages(pool2, p, out)
+    return ts, pool2, list(ls), ys[0], ys[1], pre
+
+
+serve_megakernel = functools.partial(
+    jax.jit, donate_argnums=(0, 1, 2), static_argnums=(11, 12))(
+        _serve_megakernel)
+
+# Non-donating twin for MESH-placed pools (serving_pipeline.md R6, same
+# contract as serve_paged_burst_keep: donation never reaches a
+# mesh-placed dispatch).
+serve_megakernel_keep = functools.partial(
+    jax.jit, static_argnums=(11, 12))(_serve_megakernel)

@@ -281,6 +281,35 @@ _apply_paged_keep_probe = JitRetraceProbe(kernel.apply_ops_paged_keep,
                                           name="kernel.paged_apply_keep")
 
 
+class _PagedFlushGroup:
+    """One paged fast flush's virtual merge bucket (R10): every channel
+    whose page table rounds to the same pow2 page-count class this
+    flush. The megakernel gathers each group as one [lanes, p2*rows]
+    view, so `lanes` pow2-pads the member count — the plane width every
+    window of the flush stages against. Rebuilt per flush by
+    MergeLaneStore.begin_flush_groups; coordinates live only in the
+    flush's job dicts (cross-flush placement stays the page table)."""
+
+    __slots__ = ("p2", "keys", "lane_of")
+
+    def __init__(self, p2: int):
+        self.p2 = p2
+        self.keys: List[tuple] = []
+        self.lane_of: Dict[tuple, int] = {}
+
+    def admit(self, key: tuple) -> int:
+        lane = self.lane_of.get(key)
+        if lane is None:
+            lane = len(self.keys)
+            self.lane_of[key] = lane
+            self.keys.append(key)
+        return lane
+
+    @property
+    def lanes(self) -> int:
+        return pow2_pages(max(1, len(self.keys)))
+
+
 class MergeLaneStore:
     """All merge lanes across capacity buckets + the shared payload table."""
 
@@ -317,6 +346,10 @@ class MergeLaneStore:
         self.paged_rescues = 0
         self.page_compactions = 0
         self.fold_rescue_dispatches = 0  # device recovery dispatches
+        # Per-flush page-group directory (R10 fast flush): virtual
+        # buckets keyed by pow2 page-count class; see begin_flush_groups.
+        self.flush_groups: List[_PagedFlushGroup] = []
+        self._flush_group_of: Dict[int, int] = {}
         self.payloads = PayloadTable()
         self.builder = OpBuilder(self.payloads)
         self.where: Dict[tuple, Tuple[int, int]] = {}  # key -> (bucket, lane)
@@ -426,6 +459,35 @@ class MergeLaneStore:
                 lane = self.buckets[bucket].alloc(key)
                 self.where[key] = (bucket, lane)
         return self.where[key]
+
+    # -- paged fast-flush group directory (R10) ----------------------------
+    def begin_flush_groups(self) -> None:
+        """Reset the per-flush page-group directory. Called at the top
+        of every paged lane resolution (including mid-flush re-resolves
+        after a rescue moved pages): staged windows always dispatch
+        before any recovery runs, and in-flight ring entries snapshot
+        their group info at dispatch, so rebuilding never orphans a
+        live coordinate."""
+        self.flush_groups: List[_PagedFlushGroup] = []
+        self._flush_group_of: Dict[int, int] = {}
+
+    def flush_lane_for(self, key: tuple, n_ops: int) -> Tuple[int, int]:
+        """Paged fast-flush admission: returns the channel's (group,
+        lane) coordinate for THIS flush. Pre-grows the doc's pages for
+        the flush's worst case (2 rows per op + slack — the same bound
+        the slow paged apply proves), so mid-kernel row overflow is
+        structurally impossible and the doc's pow2 page class (its
+        virtual bucket) is stable until the megakernel dispatches."""
+        pg = self.pages
+        self.lane_for(key)  # page-table + `where` sentinel admission
+        pg.ensure_rows(key, pg.counts.get(key, 0) + 2 * n_ops + 8)
+        p2 = pow2_pages(len(pg.tables[key]))
+        g = self._flush_group_of.get(p2)
+        if g is None:
+            g = len(self.flush_groups)
+            self._flush_group_of[p2] = g
+            self.flush_groups.append(_PagedFlushGroup(p2))
+        return g, self.flush_groups[g].admit(key)
 
     def mark_dirty(self, key: tuple) -> None:
         self._gen_counter += 1
@@ -3004,29 +3066,28 @@ class TpuSequencerLambda(IPartitionLambda):
         # Directory lanes: lane key -> set of existing subdirectory paths
         # (host structure; rebuilt by replay, seeded from summaries).
         self._dir_paths: Dict[tuple, set] = {}
-        if getattr(self.merge, "paged", False):
-            # Paged lane memory serves through the OBJECT path: raw wire
-            # frames decode per message (handler_raw's pump-less branch)
-            # and every merge apply runs gather-by-page-id windows /
-            # scanned paged bursts via MergeLaneStore.apply. The
-            # bucket-grid fast-flush machinery (_flush_raw staging,
-            # per-bucket donated windows) never engages — it indexes
-            # merge.buckets, which a paged store doesn't have. Don't
-            # even construct the pump (loading the native toolchain to
-            # throw it away), and don't record the pump_unavailable
-            # health swallow for a config that never wanted one.
-            pass
-        else:
-            try:
-                from . import pump as _pump_mod
-                if _pump_mod.available():
-                    self._pump = _pump_mod.WirePump()
-            except (ImportError, OSError, RuntimeError):
-                # No toolchain: object path only. Counted so a fleet
-                # that SHOULD be on the native pump shows the
-                # regression on /healthz instead of just running slow.
-                record_swallow("sequencer.pump_unavailable")
-                self._pump = None
+        # R10: the native pump runs paged too — paged fast flushes stage
+        # page-group jobs and dispatch the serving megakernel
+        # (serve_step.serve_megakernel), so there is no bucket-grid
+        # dependency left in the hot path and no reason to gate the
+        # toolchain on the storage layout.
+        try:
+            from . import pump as _pump_mod
+            if _pump_mod.available():
+                self._pump = _pump_mod.WirePump()
+        except (ImportError, OSError, RuntimeError):
+            # No toolchain: object path only. Counted so a fleet
+            # that SHOULD be on the native pump shows the
+            # regression on /healthz instead of just running slow.
+            record_swallow("sequencer.pump_unavailable")
+            self._pump = None
+        # Megakernel fused-phase mode for paged rings on CPU backends:
+        # False dispatches the scan op-phase INSIDE serve_megakernel
+        # (still one device program per ring); True ("interpret") runs
+        # the pallas megakernel body under the pallas interpreter so
+        # tier-1 exercises the identical program the TPU lowers. The
+        # TPU/axon probe (self._fused_serve) takes precedence.
+        self.megakernel_interpret = False
         self._restore()
 
     # -- checkpoint/restore ------------------------------------------------
@@ -3483,6 +3544,19 @@ class TpuSequencerLambda(IPartitionLambda):
         from . import pump as P
         from .wire import boxcar_from_wire
 
+        if self.merge.paged:
+            # R10 one-in-flight: the previous flush's megakernel ring
+            # drains before ANY of this flush's work — the slow-path
+            # fallback routing below and the staging's flush_lane_for
+            # both read the host page scalars that the drain adopts.
+            # Lane GC that came due mid-ring runs at this now-empty
+            # boundary (the _flush_traced boundary only fires when the
+            # ring is ALREADY empty, which a one-in-flight tail ride
+            # would otherwise starve).
+            self.drain()
+            if self._gc_due:
+                self._run_fast_gc()
+
         backlog = self._raw_backlog
         self._raw_backlog = []
         bufs = [b for _, _, b in backlog]
@@ -3632,6 +3706,46 @@ class TpuSequencerLambda(IPartitionLambda):
             parsed, n_windows, merge_all, win_m, chan_ok, chan_b, chan_l,
             win_l, lchan_ok, lchan_b, lchan_l)
         gen_seen = self._recovery_gen
+        if self.merge.paged:
+            # --- paged fast flush (R10) -----------------------------------
+            # Every window stages a page-group job set into the
+            # megakernel ring; the whole flush leaves as ONE
+            # serve_megakernel dispatch below (the next flush's
+            # top-of-flush drain joins it — depth-1 pipelining). Risky
+            # windows (non-insert merge traffic whose overlap/anno rings
+            # may exhaust, or LWW fit risk) flush the staged ring and
+            # drain immediately so their likely rescue runs with nothing
+            # behind it; a rescue moves pages, so the flush re-resolves
+            # its group directory before staging more windows (invariant
+            # R3, paged form).
+            w = 0
+            while w < n_windows:
+                sel = win == w
+                wd = self._stage_fast_window(
+                    parsed, rows[sel], lanes_r[sel], slot[sel], T,
+                    mbase, chan_ok, chan_b, chan_l,
+                    vbase, lchan_ok, lchan_b, lchan_l,
+                    row_seq, sel, row_msn,
+                    donate=self.merge.pages.donate)
+                self._staged.append(wd)
+                increment("serving.ring_windows_deferred")
+                wd["counted_deferred"] = True
+                if risky[w] or not defer_ok:
+                    self._dispatch_staged_megakernel()
+                    self.drain()
+                    if self._recovery_gen != gen_seen:
+                        gen_seen = self._recovery_gen
+                        chan_ok, chan_b, chan_l = \
+                            self._resolve_merge_lanes(
+                                cols[P.CHAN, merge_all])
+                        lchan_ok, lchan_b, lchan_l = \
+                            self._resolve_lww_lanes(cols[P.CHAN, lww_all])
+                        risky, donate_ok = self._assess_windows(
+                            parsed, n_windows, merge_all, win_m, chan_ok,
+                            chan_b, chan_l, win_l, lchan_ok, lchan_b,
+                            lchan_l, start_w=w + 1)
+                w += 1
+            n_windows = 0  # staging done; the bucketed loop must not run
         burst_on = (defer_ok and self.fused_bursts
                     and self.donate_lane_states)
         w = 0
@@ -3727,7 +3841,11 @@ class TpuSequencerLambda(IPartitionLambda):
         # per window shrinks precisely when dispatch pressure is the
         # bottleneck). The burst_depth cap above bounds staging memory
         # and emit latency either way.
-        if self._staged and not self._device_busy():
+        if self._staged and self.merge.paged:
+            # R10: the flush's staged windows leave as ONE megakernel
+            # dispatch; the NEXT flush's top-of-flush drain joins it.
+            self._dispatch_staged_megakernel()
+        elif self._staged and not self._device_busy():
             self._dispatch_staged_burst()
         occ = self._in_flight_windows()
         gauge("serving.ring_occupancy", float(occ))
@@ -3787,7 +3905,7 @@ class TpuSequencerLambda(IPartitionLambda):
         quarantine: every window that could carry a quarantined
         channel's ops has re-applied them."""
         if self._staged:
-            self._dispatch_staged_burst()
+            self._dispatch_staged()
         while self._ring:
             self._drain_one()
         if self._ring_fixup or self._ring_fixup_lww:
@@ -3802,7 +3920,7 @@ class TpuSequencerLambda(IPartitionLambda):
         before any move (their results then ride the same quarantine
         fixup every later in-flight window does)."""
         if self._staged:
-            self._dispatch_staged_burst()
+            self._dispatch_staged()
         ctx = self._ring.popleft()
         increment("serving.ring_drains")
         _t0 = time.perf_counter()
@@ -3968,11 +4086,20 @@ class TpuSequencerLambda(IPartitionLambda):
         """Resolve each merge row's channel to its CURRENT (bucket, lane),
         seeding new channels from stored summaries exactly as the slow
         path does. Idempotent — re-run after a mid-ring recovery moved
-        channels (promotion/fold) to refresh a flush's staging."""
+        channels (promotion/fold/page rescue) to refresh a flush's
+        staging. Paged stores resolve to per-flush (group, lane)
+        coordinates in a directory rebuilt here (R10): the group is the
+        channel's pow2 page-count class after pre-growing its pages for
+        this flush's op count, so the megakernel's gathered views fit
+        by construction."""
         uniq, inv = np.unique(chans, return_inverse=True)
         ok_u = np.zeros(uniq.size, bool)
         b_u = np.zeros(uniq.size, np.int32)
         l_u = np.zeros(uniq.size, np.int32)
+        paged = self.merge.paged
+        if paged:
+            self.merge.begin_flush_groups()
+            n_by_u = np.bincount(inv, minlength=uniq.size)
         for j, ch in enumerate(uniq.tolist()):
             key = self._pump_chan[ch]
             if key in self.merge.opaque:
@@ -3985,7 +4112,10 @@ class TpuSequencerLambda(IPartitionLambda):
                         self.merge.seed(key, *payload)
                         if key in self.merge.opaque:
                             continue
-            bb, ll = self.merge.lane_for(key)
+            if paged:
+                bb, ll = self.merge.flush_lane_for(key, int(n_by_u[j]))
+            else:
+                bb, ll = self.merge.lane_for(key)
             self.merge.mark_dirty(key)
             ok_u[j] = True
             b_u[j] = bb
@@ -4142,10 +4272,12 @@ class TpuSequencerLambda(IPartitionLambda):
         # In-flight occupancy bound: each staged merge op adds at most 2
         # rows, each LWW op at most one key slot; confirmed exactly (and
         # removed from pending) when this window's occupancy plane comes
-        # back at its drain.
-        for j in merge_jobs:
-            np.add.at(self.merge.buckets[j["bucket"]].hint_pending,
-                      j["lanes"], 2)
+        # back at its drain. Paged merge needs no charge — flush_lane_for
+        # pre-grew every member's pages for the whole flush's op count.
+        if not self.merge.paged:
+            for j in merge_jobs:
+                np.add.at(self.merge.buckets[j["bucket"]].hint_pending,
+                          j["lanes"], 2)
         for j in lww_jobs:
             np.add.at(self.lww.buckets[j["bucket"]].hint_pending,
                       j["lanes"], 1)
@@ -4195,19 +4327,25 @@ class TpuSequencerLambda(IPartitionLambda):
             wd["ticket_cols"] = grown
         wd["B"] = B
         for j in wd["merge_jobs"]:
-            bucket = self.merge.buckets[j["bucket"]]
+            if self.merge.paged:
+                # R10: pad to the flush group's CURRENT pow2 width —
+                # later windows of the same flush may have admitted more
+                # members into the group.
+                width = self.merge.flush_groups[j["bucket"]].lanes
+            else:
+                width = self.merge.buckets[j["bucket"]].lanes
             c = j["cols"]
-            if c is not None and c.shape[1] < bucket.lanes:
-                grown = np.zeros((12, bucket.lanes, c.shape[2]), np.int32)
+            if c is not None and c.shape[1] < width:
+                grown = np.zeros((12, width, c.shape[2]), np.int32)
                 grown[:, :c.shape[1], :] = c
                 j["cols"] = grown
                 if j["runs"] is not None:
                     r = j["runs"]
-                    rg = np.zeros((4, bucket.lanes) + r.shape[2:],
+                    rg = np.zeros((4, width) + r.shape[2:],
                                   np.int32)
                     rg[:, :r.shape[1]] = r
                     j["runs"] = rg
-            j["lanes_n"] = bucket.lanes
+            j["lanes_n"] = width
         for j in wd["lww_jobs"]:
             bucket = self.lww.buckets[j["bucket"]]
             c = j["cols"]
@@ -4550,6 +4688,236 @@ class TpuSequencerLambda(IPartitionLambda):
                 "lanes_n": lanes_n, "chan": z, "rows": z, "lanes": z,
                 "op_ids": z, "val_ids": z, "doc_lane": z, "slot": z}
 
+    def _dispatch_staged(self) -> None:
+        """Route the staged queue to its storage layout's dispatcher."""
+        if self.merge.paged:
+            self._dispatch_staged_megakernel()
+        else:
+            self._dispatch_staged_burst()
+
+    def _mega_fused_mode(self):
+        """Op-phase mode for the megakernel scan body: True runs the
+        pallas fused apply+extract (TPU/axon, probed), "interpret" runs
+        the IDENTICAL pallas program under the interpreter (how CPU
+        tier-1 exercises the kernel), False runs the scan op-phase
+        inside the megakernel — still one device program per ring."""
+        self._probe_fused()
+        if self._fused_serve:
+            from ..mergetree.pallas_apply import fused_extract_available
+            if fused_extract_available():
+                return True
+        if self.megakernel_interpret:
+            return "interpret"
+        return False
+
+    def _dispatch_staged_megakernel(self) -> None:
+        """Dispatch EVERY staged window, oldest first, as serving
+        megakernels (R10): consecutive windows sharing a ticket depth T
+        chunk into scan lengths from the fixed burst grid (the jit
+        cache sees only grid-quantized signatures, never the raw
+        backlog length). Always empties the staged queue — same
+        contract as _dispatch_staged_burst."""
+        staged, self._staged = self._staged, []
+        i = 0
+        while i < len(staged):
+            run = i + 1
+            while (run < len(staged)
+                   and staged[run]["T"] == staged[i]["T"]):
+                run += 1
+            while i < run:
+                left = run - i
+                k = 1
+                for cand in self._burst_k_grid:
+                    if cand <= left:
+                        k = cand
+                self._dispatch_megakernel_chunk(staged[i:i + k])
+                i += k
+
+    def _dispatch_megakernel_chunk(self, wins: List[dict]) -> None:
+        """ONE persistent device program for K staged paged windows
+        (R10): gather every flush group's pages into views, scan the K
+        windows' op planes over them (pallas fused apply+extract, its
+        interpreted twin, or the scan kernel — _mega_fused_mode),
+        scatter the views back, and enter the ring as a single entry
+        whose drain finishes all K windows off the stacked narrow
+        flat16 result. Group page ids and pre-ring scalars are staged
+        HERE, at dispatch time: the ring is one-in-flight, so the host
+        scalars are authoritative until this entry drains."""
+        from . import serve_step
+        K = len(wins)
+        for wd in wins:
+            self._pad_staged_window(wd)
+        B, T = self.lanes, wins[0]["T"]
+
+        with tracing.span("serving.pack", hist="serving.pack",
+                          stage="megakernel-stack"):
+            tx = np.empty((K, 4, B, T), np.int32)
+            for k, wd in enumerate(wins):
+                tx[k] = wd["ticket_cols"]
+
+            def stack_group_jobs(job_lists):
+                """Union-group stacking — _dispatch_burst_chunk's
+                stack_jobs with flush groups as the bucket axis and no
+                pre states (the megakernel's readback carries the
+                gathered pre views instead)."""
+                ids = sorted({j["bucket"] for jl in job_lists
+                              for j in jl})
+                xs, rxs = [], []
+                aligned: List[List[dict]] = [[] for _ in wins]
+                for g in ids:
+                    width = self.merge.flush_groups[g].lanes
+                    jobs = [next((j for j in jl if j["bucket"] == g),
+                                 None) for jl in job_lists]
+                    tm = max(j["cols"].shape[2] for j in jobs
+                             if j is not None)
+                    arr = np.zeros((K, 12, width, tm), np.int32)
+                    has_runs = any(j is not None and j.get("runs")
+                                   is not None for j in jobs)
+                    rarr = None
+                    if has_runs:
+                        from ..mergetree.oppack import RUN_K
+                        rarr = np.zeros((K, 4, width, tm, RUN_K),
+                                        np.int32)
+                    for k, j in enumerate(jobs):
+                        if j is None:
+                            aligned[k].append(self._empty_job(g, width))
+                            continue
+                        c = j["cols"]
+                        arr[k, :, :c.shape[1], :c.shape[2]] = c
+                        if rarr is not None and j.get("runs") is not None:
+                            r = j["runs"]
+                            rarr[k, :, :r.shape[1], :r.shape[2], :] = r
+                        aligned[k].append(j)
+                    xs.append(self._place_cols(arr, lane_axis=2))
+                    rxs.append(None if rarr is None else
+                               self._place_cols(rarr, lane_axis=2))
+                return ids, xs, rxs, aligned
+
+            def stack_lww_jobs(job_lists):
+                ids = sorted({j["bucket"] for jl in job_lists
+                              for j in jl})
+                xs, states = [], []
+                aligned: List[List[dict]] = [[] for _ in wins]
+                for b in ids:
+                    bucket = self.lww.buckets[b]
+                    jobs = [next((j for j in jl if j["bucket"] == b),
+                                 None) for jl in job_lists]
+                    tm = max(j["cols"].shape[2] for j in jobs
+                             if j is not None)
+                    arr = np.zeros((K, 6, bucket.lanes, tm), np.int32)
+                    arr[:, 1] = -1
+                    arr[:, 2] = -1
+                    for k, j in enumerate(jobs):
+                        if j is None:
+                            aligned[k].append(self._empty_job(
+                                b, bucket.lanes))
+                            continue
+                        c = j["cols"]
+                        arr[k, :, :c.shape[1], :c.shape[2]] = c
+                        aligned[k].append(j)
+                    xs.append(self._place_cols(arr, lane_axis=2))
+                    states.append(bucket.state)
+                return ids, xs, states, aligned
+
+            m_ids, merge_xs, runs_xs, m_aligned = stack_group_jobs(
+                [wd["merge_jobs"] for wd in wins])
+            l_ids, lww_xs, lww_states, l_aligned = stack_lww_jobs(
+                [wd["lww_jobs"] for wd in wins])
+            # Page-id tables + pre-ring scalars per union group, staged
+            # at dispatch (host-authoritative under one-in-flight).
+            pg = self.merge.pages
+            group_info: Dict[int, dict] = {}
+            pids_l, counts_l, mins_l, seqs_l = [], [], [], []
+            for gi, g in enumerate(m_ids):
+                grp = self.merge.flush_groups[g]
+                n_pad, pids, counts, mins, seqs = \
+                    self.merge._stage_paged_group(grp.keys)
+                assert n_pad == self.merge.flush_groups[g].lanes
+                group_info[g] = {"keys": list(grp.keys), "pids": pids}
+                pids_l.append(pids)
+                counts_l.append(counts)
+                mins_l.append(mins)
+                seqs_l.append(seqs)
+
+        fused = self._mega_fused_mode()
+        stats_on = device_stats.enabled()
+        donate = pg.donate
+        fn = serve_step.serve_megakernel if donate \
+            else serve_step.serve_megakernel_keep
+        name = "serve.megakernel" if donate else "serve.megakernel_keep"
+        tx_dev = self._place_cols(tx, lane_axis=2)
+
+        def _dispatch(mode):
+            with compile_ledger.track(name, fn):
+                return fn(self.tstate, pg.pool, tuple(lww_states),
+                          tx_dev, tuple(pids_l), tuple(counts_l),
+                          tuple(mins_l), tuple(seqs_l), tuple(merge_xs),
+                          tuple(lww_xs), tuple(runs_xs), mode, stats_on)
+
+        with tracing.span("serving.dispatch", hist="serving.dispatch"):
+            try:
+                (self.tstate, pool2, new_lww, flats_dev, msns_dev,
+                 pre_views) = _dispatch(fused)
+            except Exception as err:  # noqa: BLE001 — degrade, not crash
+                # The pallas phases failed to lower: fall back to the
+                # scan op-phase INSIDE the same megakernel (still one
+                # dispatch per ring). A post-lowering failure may have
+                # consumed the donated carry — probe and re-raise, as
+                # in _dispatch_burst_chunk.
+                def _gone(tree):
+                    leaf = jax.tree_util.tree_leaves(tree)
+                    return bool(leaf) and bool(
+                        getattr(leaf[0], "is_deleted", bool)())
+                if (not fused or _gone(self.tstate) or _gone(pg.pool)
+                        or any(map(_gone, lww_states))):
+                    raise
+                import logging
+                increment("serving.megakernel_fallbacks")
+                logging.getLogger(__name__).warning(
+                    "megakernel pallas phases failed at K=%d; degrading "
+                    "to the in-kernel scan op-phase (%r)", K, err)
+                self._fused_serve = False
+                self.megakernel_interpret = False
+                (self.tstate, pool2, new_lww, flats_dev, msns_dev,
+                 pre_views) = _dispatch(False)
+
+        pg.adopt_pool(pool2)
+        for b, post in zip(l_ids, new_lww):
+            self.lww.buckets[b].state = post
+        shared = {"wins": wins, "pre": list(pre_views),
+                  "groups": group_info, "order": list(m_ids)}
+        for k, wd in enumerate(wins):
+            wd["merge_jobs"] = m_aligned[k]
+            wd["lww_jobs"] = l_aligned[k]
+            wd["stats"] = stats_on
+            wd["noop_skip"] = True
+            wd["paged"] = True
+            wd["paged_shared"] = shared
+            for j in wd["merge_jobs"] + wd["lww_jobs"]:
+                # The carry was donated (or the keep twin holds the pre
+                # views in its readback): per-window bucket pre states
+                # never exist on this path.
+                j["pre"] = None
+            wd["msn32_dev"] = msns_dev[k]
+        increment("serving.megakernel_rings")
+        increment("serving.megakernel_windows", K)
+        increment("serving.bursts")
+        increment("serving.burst_windows", K)
+
+        entry = {"burst": wins, "n_windows": K,
+                 "trace_ctx": wins[-1]["trace_ctx"]}
+        import threading
+
+        def fetch():
+            try:
+                entry["flat"] = np.asarray(flats_dev)  # [K, flat] D2H
+            except Exception as err:  # noqa: BLE001 — surface at join
+                entry["error"] = err
+
+        entry["thread"] = threading.Thread(target=fetch, daemon=True)
+        entry["thread"].start()
+        self._ring.append(entry)
+
     def _assess_windows(self, parsed, n_windows: int,
                         merge_all, win_m, chan_ok, chan_b, chan_l,
                         win_l, lchan_ok, lchan_b, lchan_l,
@@ -4583,8 +4951,22 @@ class TpuSequencerLambda(IPartitionLambda):
         acc_m: Dict[int, np.ndarray] = {}
         acc_l: Dict[int, np.ndarray] = {}
         mk = cols[P.MKIND, merge_all] if merge_all.size else None
+        paged = self.merge.paged
         for w in range(start_w, n_windows):
-            if mk is not None:
+            if mk is not None and paged:
+                # R10: paged merge has no row-fit risk (flush_lane_for
+                # pre-grew pages for the flush's worst case) but
+                # non-insert traffic still forfeits donation — removes
+                # and annotates touch the overlap/anno rings, whose
+                # exhaustion needs the pre views for rollback. The
+                # megakernel keeps pre views in its own readback, so
+                # this only routes the window to an immediate
+                # dispatch+drain (nothing stacks behind a likely
+                # rescue).
+                ws = chan_ok & (win_m == w)
+                if ws.any() and np.any(mk[ws] != 1):
+                    risky[w] = True
+            elif mk is not None:
                 ws = chan_ok & (win_m == w)
                 if ws.any():
                     if np.any(mk[ws] != 1):
@@ -4665,10 +5047,28 @@ class TpuSequencerLambda(IPartitionLambda):
         planes = tailbits[2 + nm + nl:2 + nm + nl + plane_total]
         cnt_planes = tailbits[2 + nm + nl + plane_total:
                               2 + nm + nl + 2 * plane_total]
+        tail_base = 2 + nm + nl + 2 * plane_total
+        if ctx.get("paged"):
+            # Megakernel scalar-adoption plane (R10): each page group's
+            # post count/min_seq/seq as exact int32 halves — the int16
+            # occupancy planes above can wrap for a large group, so
+            # scalar adoption and the stats mirror read these.
+            m_tot = sum(j["lanes_n"] for j in merge_jobs)
+            paged16 = tailbits[tail_base:tail_base + 6 * m_tot]
+            tail_base += 6 * m_tot
+            ctx["_paged_scalars"] = []
+            off = 0
+            for job in merge_jobs:
+                n = job["lanes_n"]
+                seg = paged16[off:off + 6 * n]
+                off += 6 * n
+                ctx["_paged_scalars"].append(
+                    (u32(seg[:n], seg[n:2 * n]),
+                     u32(seg[2 * n:3 * n], seg[3 * n:4 * n]),
+                     u32(seg[4 * n:5 * n], seg[5 * n:6 * n])))
         # The device telemetry plane (present only when this window
         # dispatched with stats): N_SERVE int32 slots as lo/hi halves.
-        stats16 = tailbits[2 + nm + nl + 2 * plane_total:] \
-            if ctx.get("stats") else None
+        stats16 = tailbits[tail_base:] if ctx.get("stats") else None
 
         q_m = np.fromiter(self._ring_fixup, np.int64,
                           len(self._ring_fixup)) \
@@ -4703,6 +5103,12 @@ class TpuSequencerLambda(IPartitionLambda):
         cnt_off = 0
         for job in merge_jobs:
             n = job["lanes_n"]
+            if ctx.get("paged"):
+                # R10: no bucket hints to refresh — paged occupancy is
+                # the host page scalars, adopted at the ring's LAST
+                # window from the exact paged16 plane below.
+                cnt_off += n
+                continue
             bucket = self.merge.buckets[job["bucket"]]
             fresh = cnt_planes[cnt_off:cnt_off + n].astype(np.int64)
             cnt_off += n
@@ -4748,6 +5154,13 @@ class TpuSequencerLambda(IPartitionLambda):
             # exact int32 plane (rare second RPC).
             msn_bt = np.asarray(ctx["msn32_dev"]).astype(np.int64)
             msn_bt = np.where(admitted, msn_bt, 0)
+        if ctx.get("paged"):
+            # The megakernel ring's LAST window settles every page
+            # group; it rebuilds flagged docs' op streams from ALL K
+            # windows, so each window stashes its decoded seq/msn
+            # planes here.
+            ctx["_seq_bt"] = seq_bt
+            ctx["_msn_bt"] = msn_bt
         if bits[0]:
             raise RuntimeError("ticket client table overflow despite "
                                "pre-flush growth — invariant violation")
@@ -4806,10 +5219,23 @@ class TpuSequencerLambda(IPartitionLambda):
                 or bool(ctx.get("burst_more"))
             fixup_merge: Dict[tuple, List[HostOp]] = {}
             fixup_lww: Dict[tuple, List[tuple]] = {}
-            for job in merge_jobs:
+            for gi, job in enumerate(merge_jobs):
                 n = job["lanes_n"]
                 over = planes[plane_off:plane_off + n] != 0
                 plane_off += n
+                if ctx.get("paged"):
+                    # R10: overflow is sticky in the megakernel's scan
+                    # carry, so the LAST window's plane is the union of
+                    # every flagged doc and all settlement (scalar
+                    # adoption, trailing-page release, rollback+rescue)
+                    # happens there — with nothing in flight behind it
+                    # (one-in-flight ring), so no quarantine direction
+                    # exists on this path.
+                    bit_i += 1
+                    if not ctx.get("burst_more"):
+                        recovered += self._finish_paged_group(
+                            ctx, gi, job, over)
+                    continue
                 qsel = np.isin(job["chan"], q_m) if q_m is not None \
                     else None
                 if bits[bit_i]:
@@ -4859,6 +5285,85 @@ class TpuSequencerLambda(IPartitionLambda):
             if recovered:
                 _frsp.set(recovered_jobs=recovered)
 
+    def _finish_paged_group(self, ctx, gi: int, job: dict,
+                            over: np.ndarray) -> int:
+        """Settle one page group at its megakernel ring's LAST window
+        (R10): adopt the exact post scalars for clean docs (the host
+        page scalars are authoritative between flushes), release their
+        dead trailing pages, and roll back + host-rescue flagged docs
+        with their ops from ALL K windows — overflow is sticky in the
+        scan carry, so a doc flagged at window k has every later
+        window's device rows voided too, and the rescue replays the
+        whole ring's stream against the rolled-back pre-ring view.
+        Returns the number of rescue passes run (0 or 1)."""
+        shared = ctx.get("paged_shared")
+        info = None if shared is None \
+            else shared["groups"].get(job["bucket"])
+        if info is None:
+            return 0
+        pg = self.merge.pages
+        keys = info["keys"]
+        n = len(keys)
+        counts, mins, seqs = ctx["_paged_scalars"][gi]
+        over_n = over[:n]
+        good = np.flatnonzero(~over_n)
+        if good.size:
+            gkeys = [keys[j] for j in good.tolist()]
+            pg.adopt_scalars(gkeys, counts[good].astype(np.int32),
+                             mins[good].astype(np.int32),
+                             seqs[good].astype(np.int32))
+            ops_per = np.zeros(n, np.int64)
+            for wd in shared["wins"]:
+                jw = wd["merge_jobs"][gi]
+                if jw["lanes"].size:
+                    np.add.at(ops_per, jw["lanes"], 1)
+            for j in good.tolist():
+                key = keys[j]
+                pg.ops_since_compact[key] = \
+                    pg.ops_since_compact.get(key, 0) + int(ops_per[j])
+            pg.release_trailing_many(gkeys)
+        flagged = np.flatnonzero(over_n).tolist()
+        if not flagged:
+            return 0
+        items = self._collect_paged_ring_ops(shared, gi, keys)
+        self._recovery_gen += 1
+        increment("serving.recovery_dispatches")
+        self.merge._recover_paged(keys, items, info["pids"],
+                                  shared["pre"][gi], flagged)
+        return 1
+
+    def _collect_paged_ring_ops(self, shared, gi: int, keys):
+        """HostOp streams for one page group across its megakernel
+        ring's K windows, in window order — _recover_fast_merge's
+        stream rebuild, widened to the whole ring entry."""
+        from . import pump as P
+        ops_by: Dict[int, List[HostOp]] = {}
+        for wd in shared["wins"]:
+            job = wd["merge_jobs"][gi]
+            rows_j = job["rows"]
+            if rows_j is None or not len(rows_j):
+                continue
+            cols = wd["parsed"].cols
+            seq_bt = wd["_seq_bt"]
+            msn_bt = wd["_msn_bt"]
+            for k, lane in enumerate(job["lanes"].tolist()):
+                r = int(rows_j[k])
+                # seq/msn were assigned by the ticket pass regardless
+                # of the merge overflow; reuse them for the re-run.
+                seq = int(seq_bt[job["doc_lane"][k], job["slot"][k]])
+                msn = int(msn_bt[job["doc_lane"][k], job["slot"][k]])
+                if seq <= 0:
+                    continue
+                ops_by.setdefault(int(lane), []).append(HostOp(
+                    kind=int(cols[P.MKIND, r]), seq=seq,
+                    ref_seq=int(cols[P.REFSEQ, r]),
+                    client=int(cols[P.CLIENT, r]),
+                    pos1=int(cols[P.POS1, r]), pos2=int(cols[P.POS2, r]),
+                    op_id=int(job["op_ids"][k]),
+                    new_len=int(cols[P.CHARLEN, r]),
+                    local_seq=0, msn=msn))
+        return [(key, ops_by.get(j, [])) for j, key in enumerate(keys)]
+
     def _mirror_window_stats(self, ctx, seq_bt, fl_bt, admitted,
                              planes, cnt_planes, merge_jobs, lww_jobs):
         """The HOST-derived mirror of one window's device telemetry
@@ -4906,6 +5411,15 @@ class TpuSequencerLambda(IPartitionLambda):
             if noop_skip and n_ok == 0:
                 skips += 1
         merge_total = sum(j["lanes_n"] for j in merge_jobs)
+        if ctx.get("_paged_scalars") is not None:
+            # R10: the device sums the EXACT int32 group counts; the
+            # int16 count planes can wrap for a large page group, so
+            # the mirror reads the decoded paged16 scalars instead.
+            merge_cnt = sum(int(c.sum())
+                            for c, _m, _s in ctx["_paged_scalars"])
+        else:
+            merge_cnt = int(cnt_planes[:merge_total].astype(np.int64)
+                            .sum())
         host_vec = np.array(list(kinds) + [
             lww_n,
             int(admitted.sum()),
@@ -4916,7 +5430,7 @@ class TpuSequencerLambda(IPartitionLambda):
             skips,
             # Lane-fill gauges: the device sums the same count planes
             # that ride this result, so the mirror is the plane sum.
-            int(cnt_planes[:merge_total].astype(np.int64).sum()),
+            merge_cnt,
             int(cnt_planes[merge_total:].astype(np.int64).sum()),
         ], np.int64)
         return host_vec
@@ -4945,9 +5459,20 @@ class TpuSequencerLambda(IPartitionLambda):
         op_ids = mbase + np.flatnonzero(sel)
         # Window-local position of each selected merge row (rows sorted).
         wrow = np.searchsorted(rows, mrows)
+        paged = self.merge.paged
         for b in np.unique(mb).tolist():
             bsel = mb == b
-            bucket = self.merge.buckets[b]
+            if paged:
+                # R10: b is a flush-group id; the plane width is the
+                # group's pow2-padded member count and there is no pre
+                # state to snapshot — the megakernel returns the
+                # gathered pre views in its own readback.
+                group_lanes = self.merge.flush_groups[b].lanes
+                pre_state = None
+            else:
+                bucket = self.merge.buckets[b]
+                group_lanes = bucket.lanes
+                pre_state = bucket.state
             rl = ml[bsel]
             rr = mrows[bsel]
             doc_lane = lanes[wrow[bsel]]
@@ -4972,7 +5497,7 @@ class TpuSequencerLambda(IPartitionLambda):
                 is_member = np.zeros(rr.size, bool)
             Tm = _bucket(int(rp.max()) + 1 if rr.size else 1,
                          self.t_buckets)
-            mc = np.zeros((12, bucket.lanes, Tm), np.int32)
+            mc = np.zeros((12, group_lanes, Tm), np.int32)
             # Layout matches serve_step.serve_window: kind seq ref client
             # pos1 pos2 op_id new_len local_seq msn doc_idx t_idx.
             # Run slots: the stream-FIRST member provides pos1/ref/client
@@ -4993,7 +5518,7 @@ class TpuSequencerLambda(IPartitionLambda):
             if is_member.any():
                 # total member length per (lane, slot), read back per row
                 key = rl * Tm + rp
-                sums = np.zeros(bucket.lanes * Tm, np.int64)
+                sums = np.zeros(group_lanes * Tm, np.int64)
                 np.add.at(sums, key[is_member], b_len[is_member])
                 run_total = sums[key]
             mc[7, rl[hsel], rp[hsel]] = np.where(
@@ -5002,15 +5527,15 @@ class TpuSequencerLambda(IPartitionLambda):
             mc[10, rl[tsel], rp[tsel]] = doc_lane[tsel]
             mc[11, rl[tsel], rp[tsel]] = tslot[tsel]
             if is_member.any():
-                rc = np.zeros((4, bucket.lanes, Tm, RUN_K), np.int32)
+                rc = np.zeros((4, group_lanes, Tm, RUN_K), np.int32)
                 msel = is_member
                 rc[0, rl[msel], rp[msel], sub[msel]] = b_len[msel]
                 rc[1, rl[msel], rp[msel], sub[msel]] = op_ids[bsel][msel]
                 rc[2, rl[msel], rp[msel], sub[msel]] = doc_lane[msel]
                 rc[3, rl[msel], rp[msel], sub[msel]] = tslot[msel]
                 runs_rc = rc
-            jobs.append({"bucket": b, "pre": bucket.state, "cols": mc,
-                         "runs": runs_rc, "lanes_n": bucket.lanes,
+            jobs.append({"bucket": b, "pre": pre_state, "cols": mc,
+                         "runs": runs_rc, "lanes_n": group_lanes,
                          "chan": cols[P.CHAN, rr],
                          "rows": rr, "lanes": rl, "op_ids": op_ids[bsel],
                          "doc_lane": doc_lane, "slot": tslot})

@@ -456,3 +456,152 @@ class TestPagedServing:
         assert emits_a == emits_b
         assert texts_a == texts_b
         assert stats_a == stats_b
+
+
+# ---------------------------------------------------------------------------
+# R10 megakernel: the paged fast flush dispatches ONE serve_megakernel
+# ring per flush (page-group jobs, no bucket grid), and the pallas
+# program must be indistinguishable from the scan op-phase it replaces.
+# ---------------------------------------------------------------------------
+
+def _annotate(csn, start, end, props):
+    from fluidframework_tpu.mergetree.client import OP_ANNOTATE
+    return DocumentMessage(
+        client_sequence_number=csn, reference_sequence_number=csn - 1,
+        type=MessageType.OPERATION,
+        contents={"address": "s", "contents": {
+            "address": "t", "contents": {
+                "type": OP_ANNOTATE, "pos1": start, "pos2": end,
+                "props": props}}})
+
+
+def _drive_mega(interpret, waves=None):
+    """Paged raw-wire drive with the megakernel op-phase mode pinned:
+    interpret=True runs the pallas program (interpret mode on CPU),
+    False the counted scan fallback path inside the same dispatch."""
+    emits = []
+    lam = _lam(lambda doc, m: emits.append(_emit_key(doc, m)), True)
+    lam.megakernel_interpret = interpret
+    off = 0
+    for wave in (waves if waves is not None else _waves()):
+        for doc, box in wave:
+            lam.handler_raw(_qm(off, doc, box))
+            off += 1
+        lam.flush()
+    lam.drain()
+    docs = sorted({doc for wave in (waves or _waves())
+                   for doc, _ in wave})
+    texts = {d: lam.channel_text(d, "s", "t") for d in docs}
+    return lam, emits, texts
+
+
+class TestMegakernelServing:
+    def test_interpret_megakernel_planes_bit_identical_to_scan(self):
+        """The acceptance gate: on a contended ragged fleet every ring
+        the pallas program emits — the full narrow int16 plane AND the
+        msn plane — must be bit-identical to the scan op-phase run on
+        the very same staged inputs, and the final emit stream/channel
+        text must match a scan-mode drive."""
+        from fluidframework_tpu.server import serve_step
+
+        real = serve_step.serve_megakernel
+        keep = serve_step.serve_megakernel_keep
+        modes, plane_ok = [], []
+
+        def paired(tstate, pool, lww, tx, pids, cts, mns, sqs,
+                   mxs, lxs, rxs, fused, stats):
+            # Non-donating scan reference FIRST so the real call can
+            # still consume its buffers.
+            ref = keep(tstate, pool, lww, tx, pids, cts, mns, sqs,
+                       mxs, lxs, rxs, False, stats)
+            out = real(tstate, pool, lww, tx, pids, cts, mns, sqs,
+                       mxs, lxs, rxs, fused, stats)
+            modes.append(fused)
+            plane_ok.append(
+                np.array_equal(np.asarray(ref[3]), np.asarray(out[3]))
+                and np.array_equal(np.asarray(ref[4]),
+                                   np.asarray(out[4])))
+            return out
+
+        serve_step.serve_megakernel = paired
+        try:
+            _, emits_i, texts_i = _drive_mega(interpret=True)
+        finally:
+            serve_step.serve_megakernel = real
+        _, emits_s, texts_s = _drive_mega(interpret=False)
+
+        assert modes and all(m == "interpret" for m in modes)
+        assert all(plane_ok)
+        assert emits_i == emits_s
+        assert texts_i == texts_s
+
+    def test_megakernel_overflow_rolls_back_and_rescues(self):
+        """Annotate-ring exhaustion inside a megakernel ring — the one
+        overflow class page pre-growth cannot prevent: the flagged doc
+        rolls back to its retained pre-ring view, the host rescue
+        re-applies the op stream, and the run stays bit-identical to
+        the bucketed engine."""
+        def waves():
+            out = []
+            csn = {d: 0 for d in range(3)}
+            for w in range(3):
+                wave = []
+                for d in range(3):
+                    doc = f"a{d}"
+                    msgs = [] if w else [_join(f"c{d}")]
+                    csn[d] += 1
+                    msgs.append(_insert(csn[d], 0, "abcdef"))
+                    if d == 0 and w == 1:
+                        for i in range(6):  # DEFAULT_ANNO_SLOTS=4
+                            csn[d] += 1
+                            msgs.append(
+                                _annotate(csn[d], 0, 6, {f"k{i}": i}))
+                    wave.append((doc, Boxcar("t", doc, f"c{d}", msgs)))
+                out.append(wave)
+            return out
+
+        counters.reset()
+        lam_p, emits_p, texts_p = _drive_mega(interpret=False,
+                                              waves=waves())
+        assert counters.get("serving.recovery_dispatches") >= 1
+        assert lam_p.merge.paged_rescues >= 1
+
+        emits_ref = []
+        lam_b = _lam(lambda doc, m: emits_ref.append(_emit_key(doc, m)),
+                     False)
+        off = 0
+        for wave in waves():
+            for doc, box in wave:
+                lam_b.handler_raw(_qm(off, doc, box))
+                off += 1
+            lam_b.flush()
+        lam_b.drain()
+        texts_ref = {d: lam_b.channel_text(d, "s", "t")
+                     for d in ("a0", "a1", "a2")}
+        assert emits_p == emits_ref
+        assert texts_p == texts_ref
+
+    def test_device_stats_reconcile_exactly_on_megakernel_path(self):
+        """PR 12's contract carried into R10: the stats plane rides
+        the megakernel readback and every countable device slot equals
+        its host mirror EXACTLY — including the merge op count, which
+        the paged tail reports in int32 halves (the int16 occupancy
+        plane may wrap on deep groups)."""
+        from fluidframework_tpu.telemetry import device_stats
+
+        prev = device_stats.enabled()
+        device_stats.set_enabled(True)
+        counters.reset()
+        try:
+            _, emits, _ = _drive_mega(interpret=False)
+            assert emits
+            assert counters.get("serving.megakernel_rings") >= 1
+            assert device_stats.reconcile() is None
+            snap = counters.snapshot()
+            for slot in device_stats.SERVE_SLOTS:
+                dev = snap.get(f"device.serving.{slot}")
+                host = snap.get(f"host.serving.{slot}")
+                assert dev == host, (slot, dev, host)
+        finally:
+            device_stats.set_enabled(prev)
+            counters.reset()

@@ -506,3 +506,86 @@ class TestFusedBursts:
         lam.drain()
         assert lam.occupancy_hints()["ring_occupancy"] == 0
         assert not lam._staged and not lam._ring
+
+
+class TestMegakernelRing:
+    """R10 (docs/serving_pipeline.md): the paged fast flush stages every
+    window into page-group jobs and leaves as ONE serve_megakernel ring
+    per flush. These tests pin the ring's fallback contract and its
+    jit-signature discipline."""
+
+    def _run_paged(self, waves, interpret=False, **lam_kw):
+        emits = []
+        lam = _lam(lambda d, m: emits.append(_emit_key(d, m)),
+                   paged_lanes=True, **lam_kw)
+        lam.pipelined = True
+        lam.megakernel_interpret = interpret
+        _drive(lam, waves, emits)
+        return lam, emits
+
+    def test_megakernel_lowering_failure_degrades_sticky_and_counted(
+            self, monkeypatch):
+        """A pallas lowering failure mid-dispatch must degrade INSIDE
+        the same ring (retry with the scan op-phase — still one
+        dispatch), count serving.megakernel_fallbacks, pin the degrade
+        sticky so later rings skip the doomed mode, and leave the
+        stream identical to the bucketed engine."""
+        from fluidframework_tpu.server import serve_step
+        waves = _keystroke_waves(n_waves=8)
+        b_emits = []
+        bucketed = _lam(lambda d, m: b_emits.append(_emit_key(d, m)))
+        _drive(bucketed, waves, b_emits)
+
+        real = serve_step.serve_megakernel
+
+        def refuse_pallas(tstate, pool, lww, tx, *rest):
+            fused = rest[-2]
+            if fused:  # the pallas op-phase modes; scan retry passes False
+                raise RuntimeError("pallas lowering refused")
+            return real(tstate, pool, lww, tx, *rest)
+
+        monkeypatch.setattr(serve_step, "serve_megakernel",
+                            refuse_pallas)
+        counters.reset()
+        _, emits = self._run_paged(waves, interpret=True)
+        assert counters.get("serving.megakernel_rings") >= 2
+        # Sticky: exactly the first ring attempted pallas and fell back.
+        assert counters.get("serving.megakernel_fallbacks") == 1
+        assert emits == b_emits  # order included
+
+    def test_megakernel_k_grid_pins_jit_signatures(self):
+        """The ring length K is quantized to the burst grid so the
+        megakernel's jit cache CANNOT fragment on scan length — and a
+        repeat of the same workload must add zero compiles."""
+        from fluidframework_tpu.server import serve_step
+        from fluidframework_tpu.telemetry.compile_ledger import ledger
+
+        waves = _deep_ragged_waves(n_waves=8, deep_ops=8)
+        real = serve_step.serve_megakernel
+        ks = []
+
+        def record(tstate, pool, lww, tx, *rest):
+            ks.append(int(tx.shape[0]))
+            return real(tstate, pool, lww, tx, *rest)
+
+        serve_step.serve_megakernel = record
+        try:
+            counters.reset()
+            lam, _ = self._run_paged(waves, t_buckets=(1, 4))
+            grid = set(lam._burst_k_grid) | {1}
+            assert ks and set(ks) <= grid
+            # Amortization: rings carried more windows than dispatches.
+            assert counters.get("serving.megakernel_windows") > \
+                counters.get("serving.megakernel_rings")
+
+            def mega_compiles():
+                sym = ledger.snapshot().get("symbols", {})
+                return sum(v.get("compiles", 0)
+                           for k, v in sym.items()
+                           if k.startswith("serve.megakernel"))
+
+            warm = mega_compiles()
+            self._run_paged(waves, t_buckets=(1, 4))
+            assert mega_compiles() == warm
+        finally:
+            serve_step.serve_megakernel = real

@@ -423,6 +423,73 @@ class TestScanHostCallback:
                               only=["SCAN_HOST_CALLBACK"])
         assert hits == []
 
+    def test_true_positive_callback_in_pallas_kernel_body(self):
+        """R10: the megakernel body is a persistent device program — a
+        host callback there cannot lower and would silently eat the
+        whole pallas path (fallback every ring)."""
+        src = """
+            import jax
+            from jax.experimental import pallas as pl
+            from jax import debug
+
+            def apply_megakernel(ops, pool):
+                def kernel(ops_ref, pool_ref, out_ref):
+                    debug.callback(print, ops_ref[0])
+                    out_ref[...] = pool_ref[...]
+                return pl.pallas_call(
+                    kernel,
+                    out_shape=jax.ShapeDtypeStruct(pool.shape,
+                                                   pool.dtype))(ops, pool)
+        """
+        assert rule_ids(src, "SCAN_HOST_CALLBACK") == \
+            ["SCAN_HOST_CALLBACK"]
+
+    def test_true_positive_block_until_ready_in_pallas_kernel(self):
+        src = """
+            from jax.experimental import pallas as pl
+
+            def gather(pool, pids, out_shape):
+                def kernel(pool_ref, pids_ref, out_ref):
+                    pool_ref[...].block_until_ready()
+                    out_ref[...] = pool_ref[...]
+                return pl.pallas_call(kernel, out_shape=out_shape)(
+                    pool, pids)
+        """
+        assert rule_ids(src, "SCAN_HOST_CALLBACK") == \
+            ["SCAN_HOST_CALLBACK"]
+
+    def test_guard_pure_pallas_kernel_body(self):
+        """The shipped megakernel shape: ref loads/stores and lax ops
+        only — must stay quiet."""
+        src = """
+            import jax.numpy as jnp
+            from jax.experimental import pallas as pl
+
+            def apply_megakernel(ops, pool, out_shape):
+                def kernel(ops_ref, pool_ref, out_ref):
+                    rows = pool_ref[...]
+                    out_ref[...] = rows + jnp.int32(1)
+                return pl.pallas_call(kernel, out_shape=out_shape)(
+                    ops, pool)
+        """
+        assert rule_ids(src, "SCAN_HOST_CALLBACK") == []
+
+    def test_guard_callback_in_staging_around_pallas_call(self):
+        """Host work AROUND the pallas dispatch (staging, fetch) is the
+        normal drain pattern — only the kernel body is in scope."""
+        src = """
+            from jax.experimental import pallas as pl
+            from jax.experimental import io_callback
+
+            def drain(pool, out_shape):
+                def kernel(pool_ref, out_ref):
+                    out_ref[...] = pool_ref[...]
+                out = pl.pallas_call(kernel, out_shape=out_shape)(pool)
+                io_callback(print, None, out)
+                return out
+        """
+        assert rule_ids(src, "SCAN_HOST_CALLBACK") == []
+
 
 class TestPageIdDtype:
     def test_true_positive_int64_page_table(self):
