@@ -4,7 +4,7 @@
 .PHONY: test soak bench dryrun record-corpus historian-smoke \
 	summarize-smoke trace-smoke pipeline-smoke fused-smoke \
 	paged-smoke catchup-smoke obs-smoke ingest-smoke e2e-smoke \
-	mega-smoke bench-trend \
+	mega-smoke fleet-smoke bench-trend \
 	lint-analysis \
 	lint-changed lint-races lint-placement layer-check check
 
@@ -188,14 +188,28 @@ e2e-smoke:
 mega-smoke:
 	JAX_PLATFORMS=cpu python bench.py mega-smoke
 
+# The fleet observability surface (docs/observability.md v3): a real
+# broker + deli-worker topology (separate OS processes) scraped by the
+# FleetObservatory must yield /fleet/trace timelines whose spans come
+# from BOTH processes (wire-propagated trace contexts) with process
+# identity on every span, the worker's scraped broadcast-edge lag must
+# equal the final persisted sequence number exactly, a chaos-on fleet
+# soak's watermark marks must be bit-identical run twice with ingest
+# lag drained to zero, and observability-on (sample=1 + a 20 Hz
+# scraper) overhead on the live local pipeline must stay under 2%.
+# Stamps BENCH_FLEET_LAST.json (folded into `bench.py trend`).
+fleet-smoke:
+	JAX_PLATFORMS=cpu python bench.py fleet-smoke
+
 # The pre-merge gate: layering/cycles + static analysis (incl. the
 # focused race and placement gates) + the summarize/trace/pipeline/fused/paged/catchup/
-# overload/obs/ingest/e2e/mega smokes + the bench trend (report-only
-# here) + the full test suite.
+# overload/obs/ingest/e2e/mega/fleet smokes + the bench trend
+# (report-only here) + the full test suite.
 check: layer-check lint-analysis lint-races lint-placement \
 		summarize-smoke trace-smoke \
 		pipeline-smoke fused-smoke paged-smoke catchup-smoke \
-		overload-smoke obs-smoke ingest-smoke e2e-smoke mega-smoke test
+		overload-smoke obs-smoke ingest-smoke e2e-smoke mega-smoke \
+		fleet-smoke test
 	python bench.py trend --report-only
 
 # The round-end randomized-evidence ritual: 50-trial soaks over every

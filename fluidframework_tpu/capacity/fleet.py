@@ -38,6 +38,14 @@ from ..server.lambdas.base import IPartitionLambda
 from ..server.local_server import DELTAS_TOPIC, LocalServer
 from ..server.partition import PartitionManager
 from ..telemetry import counters as _counters
+from ..telemetry import watermarks
+from ..telemetry.slo import BurnRateEngine, Objective
+
+# Watermark lag edge -> soak tier name (the attribution vocabulary of
+# tier_pressures): both read-side edges fold into "readpath".
+LAG_EDGE_TIER = {"ingest": "ingest", "broadcast": "broadcast",
+                 "summarize": "scribe", "catchup": "readpath",
+                 "adopt": "readpath"}
 
 OK_STATES = (admission_mod.ACCEPT, admission_mod.THROTTLE)
 
@@ -119,6 +127,14 @@ class SoakResult:
     broadcaster_shed: int = 0
     effective_partition_limit: int = 0
     wall_s: float = 0.0
+    # Peak watermark lag per tier over the run (telemetry/watermarks
+    # edges folded through LAG_EDGE_TIER) — the grader cites the losing
+    # tier's figure — and the multi-window burn-rate verdict evaluated
+    # on the virtual clock at the end of the measured envelope. Peaks
+    # sample the threaded broadcast fan-out mid-flight, so they are
+    # advisory citations, NOT part of the bit-identity fingerprint.
+    tier_lags: Dict[str, float] = field(default_factory=dict)
+    burn: Optional[dict] = None
 
     # -- graded figures ------------------------------------------------------
     @property
@@ -164,6 +180,10 @@ class SoakResult:
         adoption_ok = (not readers_graded
                        or self.reader_adoption >= spec.slo_reader_adoption)
         goodput_ok = self.goodput >= spec.slo_goodput
+        # Burn-rate term: a breach needs BOTH windows hot (slo.py), so
+        # a run the point checks above pass cannot newly fail here —
+        # sustained budget burn only confirms an overload verdict.
+        burn_ok = self.burn is None or bool(self.burn.get("ok", True))
         return {
             "ladder_le_throttle": ladder_ok,
             "bad_states": bad_states,
@@ -175,7 +195,10 @@ class SoakResult:
             "readers_graded": readers_graded,
             "reader_adoption": round(self.reader_adoption, 4),
             "reader_adoption_ok": adoption_ok,
-            "ok": ladder_ok and latency_ok and goodput_ok and adoption_ok,
+            "burn_ok": burn_ok,
+            "burn_attribution": (self.burn or {}).get("attribution"),
+            "ok": (ladder_ok and latency_ok and goodput_ok
+                   and adoption_ok and burn_ok),
         }
 
     # -- bottleneck attribution feed ----------------------------------------
@@ -248,6 +271,9 @@ class SoakResult:
             "slo": self.slo(),
             "tier_pressures": {k: round(v, 4)
                                for k, v in self.tier_pressures().items()},
+            "tier_lags": {k: round(v, 1)
+                          for k, v in sorted(self.tier_lags.items())},
+            "burn": self.burn,
             "fingerprint": self.fingerprint(),
             "wall_s": round(self.wall_s, 3),
         }
@@ -310,6 +336,25 @@ class FleetSoak:
         tick_s = wspec.tick_s
         vnow = {"t": 0.0}
         _counters.reset_stage(FLUSH_STAGE)
+        # Fresh watermark table on the soak's virtual clock: the lag
+        # pipeline is itself part of the graded surface (run-twice marks
+        # fold into the fingerprint), and op-ages grade in virtual
+        # seconds, never wall time.
+        watermarks.reset()
+        watermarks.set_clock(lambda: vnow["t"])
+        burn = BurnRateEngine(
+            [Objective("flush_latency", 0.99,
+                       "admitted-op flush latency inside the virtual "
+                       "p99 budget"),
+             Objective("ingest_lag", 0.95,
+                       "raw-log ingest lag stays under the global "
+                       "queue limit")],
+            clock=lambda: vnow["t"],
+            fast_window_s=8 * tick_s,
+            slow_window_s=max(8 * tick_s, spec.ticks * tick_s))
+        flush_gb = {"good": 0, "bad": 0}
+        budget_ms = spec.slo_flush_p99_s * 1000.0
+        peak_lag: Dict[str, float] = {}
         # slo_ratio=4.0: virtual latencies land on the sub-slot grid
         # (tick_s/4 resolution), so a healthy same-tick flush already
         # shows p99/p50 up to 4x as a quantization artifact. 4.0 puts
@@ -357,7 +402,9 @@ class FleetSoak:
             if t0 is not None:
                 result.flushed += 1
                 flushed_lat.append((t0, vnow["t"]))
-                _counters.observe(FLUSH_STAGE, (vnow["t"] - t0) * 1000.0)
+                lat_ms = (vnow["t"] - t0) * 1000.0
+                _counters.observe(FLUSH_STAGE, lat_ms)
+                flush_gb["good" if lat_ms <= budget_ms else "bad"] += 1
 
         tap = PartitionManager(server.log, "capacity-tap", DELTAS_TOPIC,
                                lambda ctx: _TapLambda(ctx, tap_sink))
@@ -474,6 +521,16 @@ class FleetSoak:
                           - server.log.committed("scribe", DELTAS_TOPIC, p))
                       for p in range(spec.partitions))
             result.peak_scribe_lag = max(result.peak_scribe_lag, lag)
+            # Pull-model watermark refresh (raw offsets + ticketed seqs)
+            # at the same boundaries the peaks sample, then fold each
+            # edge's total into the per-tier peak-lag citation.
+            refresh = getattr(tier, "refresh_watermarks", None)
+            if refresh is not None:
+                refresh()
+            for edge, per in watermarks.lags().items():
+                t_name = LAG_EDGE_TIER[edge]
+                peak_lag[t_name] = max(peak_lag.get(t_name, 0.0),
+                                       float(sum(per.values())))
 
         budget = spec.drain_budget_per_partition
         wall0 = time.perf_counter()
@@ -534,6 +591,16 @@ class FleetSoak:
                 result.refresh_epochs += 1
             adm.observe(force=True)
             result.states.append((t, adm.state))
+            # Burn-rate feed, once per tick on the virtual clock: the
+            # tick's flush good/bad split and whether ingest lag stayed
+            # under the global queue limit.
+            burn.record("flush_latency", good=flush_gb["good"],
+                        bad=flush_gb["bad"])
+            flush_gb["good"] = flush_gb["bad"] = 0
+            ingest_lag = watermarks.total_lag("ingest")
+            ok_lag = ingest_lag <= spec.queue_limit
+            burn.record("ingest_lag", good=1 if ok_lag else 0,
+                        bad=0 if ok_lag else 1)
 
         # -- converge: drain everything left, chaos off ----------------------
         drain_all()
@@ -544,6 +611,20 @@ class FleetSoak:
         result.refresh_dispatches = (_counters.get(
             "catchup.refresh_dispatches") - disp0)
         result.wall_s = time.perf_counter() - wall0
+        # Final watermark refresh so the exported lag surface reconciles
+        # with the drained pipeline; the burn verdict is evaluated at
+        # virtual end-of-run, then the table's clock goes back to wall
+        # time for whoever scrapes it next.
+        refresh = getattr(tier, "refresh_watermarks", None)
+        if refresh is not None:
+            refresh()
+        for edge, per in watermarks.lags().items():
+            t_name = LAG_EDGE_TIER[edge]
+            peak_lag[t_name] = max(peak_lag.get(t_name, 0.0),
+                                   float(sum(per.values())))
+        result.tier_lags = dict(peak_lag)
+        result.burn = burn.evaluate(now=vnow["t"])
+        watermarks.set_clock(time.monotonic)
 
         # -- figures ---------------------------------------------------------
         steady_lat = sorted((f1 - f0) * 1000.0

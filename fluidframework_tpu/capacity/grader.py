@@ -24,14 +24,20 @@ from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 
-def attribute_bottleneck(pressures: Dict[str, float]
+def attribute_bottleneck(pressures: Dict[str, float],
+                         lags: Optional[Dict[str, float]] = None
                          ) -> Tuple[Optional[str], List[Tuple[str, float]]]:
     """Name the binding tier: the argmax of the normalized pressure
     feed, with the full ranking returned for the record (ties break
-    alphabetically so attribution is deterministic)."""
+    alphabetically so attribution is deterministic). When the probe
+    also reports per-tier watermark lags (SoakResult.tier_lags), a
+    pressure tie breaks toward the tier with the larger peak lag —
+    lag is the direct consumer-side evidence, pressure the proxy."""
     if not pressures:
         return None, []
-    ranked = sorted(pressures.items(), key=lambda kv: (-kv[1], kv[0]))
+    lags = lags or {}
+    ranked = sorted(pressures.items(),
+                    key=lambda kv: (-kv[1], -lags.get(kv[0], 0.0), kv[0]))
     return ranked[0][0], ranked
 
 
@@ -54,6 +60,7 @@ class GradeResult:
     saturated: bool
     bottleneck: Optional[str]
     pressure_ranking: List[Tuple[str, float]] = field(default_factory=list)
+    bottleneck_lag: Optional[float] = None
     passing: Optional[GradeSample] = None
     failing: Optional[GradeSample] = None
     history: List[GradeSample] = field(default_factory=list)
@@ -63,6 +70,12 @@ class GradeResult:
             "capacity_mult": round(self.capacity_mult, 4),
             "saturated": self.saturated,
             "bottleneck": self.bottleneck,
+            # The losing tier's peak watermark lag from the attributed
+            # sample (ops or records behind, per the edge) — the direct
+            # consumer-side evidence beside the normalized pressure.
+            "bottleneck_lag": (round(self.bottleneck_lag, 1)
+                               if self.bottleneck_lag is not None
+                               else None),
             "pressure_ranking": [[t, round(v, 4)]
                                  for t, v in self.pressure_ranking],
             "probes": [{"rate_mult": round(s.rate_mult, 4), "ok": s.ok}
@@ -96,21 +109,31 @@ class CapacityGrader:
         history.append(s)
         return s
 
+    @staticmethod
+    def _attribute(sample: GradeSample):
+        """Bottleneck + ranking + the named tier's watermark lag from
+        one sample (probes without a lag feed cite None)."""
+        lags = sample.sample.get("tier_lags") or {}
+        tier, ranking = attribute_bottleneck(
+            sample.sample.get("pressures", {}), lags)
+        lag = lags.get(tier) if tier is not None else None
+        return tier, ranking, lag
+
     def search(self) -> GradeResult:
         history: List[GradeSample] = []
         lo_s = self._sample(self.lo, history)
         if not lo_s.ok:
-            tier, ranking = attribute_bottleneck(
-                lo_s.sample.get("pressures", {}))
+            tier, ranking, lag = self._attribute(lo_s)
             return GradeResult(capacity_mult=0.0, saturated=True,
                                bottleneck=tier, pressure_ranking=ranking,
+                               bottleneck_lag=lag,
                                failing=lo_s, history=history)
         hi_s = self._sample(self.hi, history)
         if hi_s.ok:
-            tier, ranking = attribute_bottleneck(
-                hi_s.sample.get("pressures", {}))
+            tier, ranking, lag = self._attribute(hi_s)
             return GradeResult(capacity_mult=self.hi, saturated=False,
                                bottleneck=tier, pressure_ranking=ranking,
+                               bottleneck_lag=lag,
                                passing=hi_s, history=history)
         best_pass, first_fail = lo_s, hi_s
         for _ in range(self.iters):
@@ -120,9 +143,9 @@ class CapacityGrader:
                 best_pass = mid_s
             else:
                 first_fail = mid_s
-        tier, ranking = attribute_bottleneck(
-            first_fail.sample.get("pressures", {}))
+        tier, ranking, lag = self._attribute(first_fail)
         return GradeResult(capacity_mult=best_pass.rate_mult,
                            saturated=True, bottleneck=tier,
-                           pressure_ranking=ranking, passing=best_pass,
+                           pressure_ranking=ranking, bottleneck_lag=lag,
+                           passing=best_pass,
                            failing=first_fail, history=history)

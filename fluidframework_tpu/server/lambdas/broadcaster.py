@@ -25,6 +25,7 @@ from typing import Callable, Dict, List, Optional
 
 from ...protocol.messages import SequencedDocumentMessage
 from ...telemetry import tracing
+from ...telemetry import watermarks
 from ...telemetry.counters import gauge, increment
 from ..log import QueuedMessage
 from ..routing import doc_shard
@@ -184,6 +185,7 @@ class BroadcasterLambda(IPartitionLambda):
         ctx = tracing.message_context(sequenced)
         if ctx is None:
             self._deliver_room(doc_id, sequenced)
+            self._mark_delivered(doc_id, sequenced)
             return
         t0 = time.perf_counter()
         self._deliver_room(doc_id, sequenced)
@@ -192,6 +194,19 @@ class BroadcasterLambda(IPartitionLambda):
                             seq=sequenced.sequence_number,
                             shard=(shard_for(doc_id, len(self.shards))
                                    if self.shards else -1))
+        self._mark_delivered(doc_id, sequenced)
+
+    def _mark_delivered(self, doc_id: str,
+                        sequenced: SequencedDocumentMessage) -> None:
+        # `broadcast` watermark (telemetry/watermarks.py): per-doc seq
+        # high-water, so replays and shed-then-covered gaps fold to the
+        # honest delivered frontier. One guarded dict update per op —
+        # inside the fan-out path's existing per-op budget.
+        # Embedder/test contexts are single-partition and carry no
+        # partition id; fold their marks to p0.
+        watermarks.advance_doc(watermarks.BROADCAST,
+                               getattr(self.context, "partition", 0),
+                               doc_id, sequenced.sequence_number)
 
     def _deliver_room(self, doc_id: str,
                       sequenced: SequencedDocumentMessage) -> None:

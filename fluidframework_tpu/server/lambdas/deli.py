@@ -177,6 +177,13 @@ class DeliLambda(IPartitionLambda):
         # exactly the reference's at-least-once window.
         self.flush_checkpoint()
 
+    def doc_sequence_numbers(self) -> Dict[str, int]:
+        """Per-document head sequence number: the `ticketed` watermark
+        feed (telemetry/watermarks.py). Pulled at scrape time by the
+        sharding tier, never per op."""
+        return {doc_id: state.sequence_number
+                for doc_id, state in self.docs.items()}
+
     def _dump(self, state: DocumentDeliState) -> dict:
         return {
             "sequenceNumber": state.sequence_number,
@@ -278,6 +285,10 @@ class DeliLambda(IPartitionLambda):
                                   "evicted": True}))
             if self.send_system is not None:
                 in_flight.add(client_id)
+                # System messages enter the raw log with no client edit
+                # to inherit a trace from — stamp a head-sampled root so
+                # the eviction's journey joins the fleet timeline.
+                tracing.stamp_message(leave, tracing.root_context())
                 self.send_system(doc_id, leave)
             else:
                 self._ticket(doc_id, state, None, leave)

@@ -16,6 +16,7 @@ from ...protocol.messages import DocumentMessage, MessageType, \
     SequencedDocumentMessage
 from ...protocol.protocol_handler import ProtocolOpHandler, ProtocolState
 from ...telemetry import tracing
+from ...telemetry import watermarks
 from ...telemetry.counters import increment, record_swallow
 from ..database import Collection
 from ..log import QueuedMessage
@@ -116,13 +117,16 @@ class ScribeLambda(IPartitionLambda):
         commit_sha = contents.get("handle")
         commit = store.get(commit_sha) if commit_sha else None
         if commit is None:
-            self.send_system(doc_id, DocumentMessage(
+            nack = DocumentMessage(
                 client_sequence_number=0,
                 reference_sequence_number=sequenced.sequence_number,
                 type=MessageType.SUMMARY_NACK,
                 contents={"summaryProposal": {
                     "summarySequenceNumber": sequenced.sequence_number},
-                    "errorMessage": f"unknown summary commit {commit_sha!r}"}))
+                    "errorMessage": f"unknown summary commit {commit_sha!r}"})
+            tracing.stamp_message(nack, tracing.current()
+                                  or tracing.root_context())
+            self.send_system(doc_id, nack)
             return
         # Valid: advance the main ref and ack with the commit handle.
         store.set_ref("main", commit_sha)
@@ -130,17 +134,28 @@ class ScribeLambda(IPartitionLambda):
         # incremental-summary regression shows up as bytes/commit (or
         # blob-cache hit rate) drifting, not as a single number.
         increment("summarize.commits")
+        # `summarized` watermark: ops up to the proposal's seq are now
+        # covered by a committed summary (replay folds to zero).
+        watermarks.advance_doc(watermarks.SUMMARIZED,
+                               getattr(self.context, "partition", 0),
+                               doc_id, sequenced.sequence_number)
         if self.on_commit is not None:
             try:
                 self.on_commit(doc_id, commit_sha)
             except Exception:  # noqa: BLE001 — observers never break scribe
                 record_swallow("scribe.commit_observer")
-        self.send_system(doc_id, DocumentMessage(
+        ack = DocumentMessage(
             client_sequence_number=0,
             reference_sequence_number=sequenced.sequence_number,
             type=MessageType.SUMMARY_ACK,
             contents={"handle": commit_sha, "summaryProposal": {
-                "summarySequenceNumber": sequenced.sequence_number}}))
+                "summarySequenceNumber": sequenced.sequence_number}})
+        # The ack re-enters the raw log as a system message: carry the
+        # summarize span's context (or a fresh root) so the round trip
+        # stays one joined timeline instead of going dark at the ack.
+        tracing.stamp_message(ack, tracing.current()
+                              or tracing.root_context())
+        self.send_system(doc_id, ack)
 
     def load_checkpoint(self, doc_id: str, dump: dict) -> None:
         self.handlers[doc_id] = ProtocolOpHandler.load(ProtocolState(

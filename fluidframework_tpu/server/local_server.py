@@ -665,7 +665,11 @@ class TpuLocalServer(LocalServer):
         enabled = True
         if self.config is not None:
             enabled = bool(self.config.get("catchup.enabled", True))
-        self.catchup = CatchupCache() if enabled else None
+        # partition_of routes the catchup/adopted watermark stamps to the
+        # document's ingest home (telemetry/watermarks.py).
+        self.catchup = CatchupCache(
+            partition_of=lambda doc: self.ingest.partition_for(doc)) \
+            if enabled else None
 
     def _build_ingest_tier(self) -> SequencerShardSet:
         self.tpu_sequencers = []

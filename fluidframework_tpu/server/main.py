@@ -46,8 +46,19 @@ DEFAULT_CONFIG = {
     "broker": {"host": "127.0.0.1", "port": 7080, "native": False,
                "partitions": 1},
     "storage": {"db": "var/fluid.sqlite", "git": "var/git"},
+    # monitorPort > 0 serves /health + /metrics.prom + /trace for the
+    # fleet observatory to scrape; `name` tags every exported span with
+    # this process identity (default worker:<stages>); traceSample > 0
+    # head-samples 1-in-N op traces in this worker.
     "worker": {"stages": ["deli", "scriptorium", "scribe", "copier"],
-               "poll_ms": 10, "tenant": "local"},
+               "poll_ms": 10, "tenant": "local", "monitorPort": 0,
+               "name": None, "traceSample": 0},
+    # The fleet observatory (server/observatory.py): scrapes each
+    # worker's monitor endpoints on intervalS, merges /fleet/health,
+    # /fleet/metrics.prom, /fleet/lag, and joins drained trace rings by
+    # traceId into /fleet/trace. `workers` lists monitor base URLs.
+    "observatory": {"host": "127.0.0.1", "port": 7090, "workers": [],
+                    "intervalS": 2.0},
     "deli": {"checkpointBatchSize": 8, "checkpointTimeIntervalMsec": 500},
     # The summary-cache tier (server/historian.py). `historian` service:
     # host/port to serve on; upstream (alfred URL) switches store mode ->
@@ -316,11 +327,54 @@ def build_worker(cfg: dict, stages: List[str]):
 
 
 def run_worker(cfg: dict, stages: List[str]) -> None:
+    from ..telemetry import tracing
+
+    wcfg = cfg.get("worker", {})
+    # Fleet identity BEFORE any span records: every span this process
+    # exports carries the name the observatory joins timelines by.
+    tracing.set_process_name(wcfg.get("name")
+                             or f"worker:{'+'.join(stages)}")
+    sample = int(wcfg.get("traceSample", 0) or 0)
+    if sample:
+        tracing.configure(sample=sample)
     runner, close = build_worker(cfg, stages)
     poll_s = cfg["worker"].get("poll_ms", 10) / 1000.0
     print(f"worker: stages={stages} broker="
           f"{cfg['broker'].get('host')}:{cfg['broker'].get('port')}",
           flush=True)
+    monitor = None
+    if wcfg.get("monitorPort"):
+        from ..telemetry import watermarks
+        from .monitor import ServiceMonitor
+
+        # Worker-side scrape surface for the observatory. SLO
+        # enforcement stays fleet-level (observatory) — a worker whose
+        # stages never observe the policy stage must not 503.
+        monitor = ServiceMonitor(host=cfg["broker"].get("host",
+                                                        "127.0.0.1"),
+                                 port=int(wcfg["monitorPort"]),
+                                 enforce_slo=False)
+
+        def watermark_probe() -> dict:
+            # Pull-model `ticketed` refresh from the live sequencer
+            # lambdas (crash-restarted replacements included); the
+            # raw_end mark needs broker-side end offsets and is the
+            # single-process/broker monitor's job (known limit:
+            # docs/observability.md v3).
+            for manager in runner.managers:
+                for p, pump in manager.pumps.items():
+                    seqs = getattr(pump.lambda_, "doc_sequence_numbers",
+                                   None)
+                    if seqs is None:
+                        continue
+                    for doc, seq in seqs().items():
+                        watermarks.advance_doc(watermarks.TICKETED, p,
+                                               doc, seq)
+            return {"stages": stages}
+
+        monitor.add_probe("worker", watermark_probe)
+        monitor.start()
+        print(f"worker: monitor on {monitor.url}", flush=True)
     stop = {"flag": False}
 
     def on_signal(*_):
@@ -343,15 +397,34 @@ def run_worker(cfg: dict, stages: List[str]) -> None:
             continue
         if n == 0:
             time.sleep(poll_s)
+    if monitor is not None:
+        monitor.stop()
     close()
     print("worker: stopped", flush=True)
+
+
+def run_observatory(cfg: dict) -> None:
+    from .observatory import FleetObservatory
+
+    ocfg = cfg.get("observatory", {})
+    targets = ocfg.get("workers") or []
+    obs = FleetObservatory(workers=targets,
+                           host=ocfg.get("host", "127.0.0.1"),
+                           port=int(ocfg.get("port", 7090)),
+                           interval_s=float(ocfg.get("intervalS", 2.0)))
+    obs.start()
+    print(f"observatory: aggregating {len(targets)} workers on "
+          f"{obs.url}", flush=True)
+    _wait_for_signal()
+    obs.stop()
 
 
 def main(argv=None) -> None:
     parser = argparse.ArgumentParser(
         prog="fluidframework_tpu.server.main",
         description="Run one service of the ordering pipeline")
-    parser.add_argument("service", choices=["broker", "worker", "historian"])
+    parser.add_argument("service", choices=["broker", "worker",
+                                            "historian", "observatory"])
     parser.add_argument("--config", default=None,
                         help="path to deploy config JSON")
     parser.add_argument("--stages", default=None,
@@ -362,6 +435,8 @@ def main(argv=None) -> None:
         run_broker(cfg)
     elif args.service == "historian":
         run_historian(cfg)
+    elif args.service == "observatory":
+        run_observatory(cfg)
     else:
         stages = (args.stages.split(",") if args.stages
                   else cfg["worker"]["stages"])

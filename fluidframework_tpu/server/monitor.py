@@ -41,6 +41,7 @@ from urllib.parse import parse_qs
 from ..telemetry import counters as process_counters
 from ..telemetry import device_stats
 from ..telemetry import tracing
+from ..telemetry import watermarks
 from ..telemetry.compile_ledger import (install_jax_listener,
                                         ledger as compile_ledger)
 from ..telemetry.counters import nearest_rank
@@ -144,9 +145,15 @@ class ServiceMonitor:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  metrics: Optional[MetricClient] = None,
                  slo: Optional[SloPolicy] = None,
-                 enforce_slo: bool = True):
+                 enforce_slo: bool = True,
+                 burn: Optional[object] = None):
         self.metrics = metrics or MetricClient()
         self.slo = slo or SloPolicy()
+        # Optional multi-window burn-rate engine (telemetry/slo.py
+        # BurnRateEngine): its verdict rides /health as `burnRate`,
+        # report-only at the worker level — fleet-level enforcement
+        # belongs to the observatory, which sees every worker.
+        self.burn = burn
         # enforce_slo=False keeps the verdict in /health without letting
         # a breach flip the status code (report-only rollout mode).
         self.enforce_slo = enforce_slo
@@ -293,6 +300,12 @@ class ServiceMonitor:
             tier = getattr(server, "ingest", None)
             if tier is None:
                 return {"partitions": []}
+            # Pull-model watermark refresh (telemetry/watermarks.py):
+            # raw_end/raw_ingested/ticketed advance at probe time so the
+            # fluid_lag_* gauges track the live tier with zero op cost.
+            refresh = getattr(tier, "refresh_watermarks", None)
+            if refresh is not None:
+                refresh()
             rows = tier.partition_stats()
             for row in rows:
                 p = row["partition"]
@@ -416,6 +429,9 @@ class ServiceMonitor:
         slo_ok = slo["ok"] or not self.enforce_slo
         admission = (admission_ctl.status()
                      if admission_ctl is not None else None)
+        # Freshen the fluid_lag_* gauges so the counters snapshot below
+        # (and any scrape racing it) reads current watermark deltas.
+        watermarks.export_gauges()
         return {"ok": all(ok for ok, _ in checks.values()) and slo_ok,
                 # Overload-control state (server/admission.py): a DEGRADE
                 # reading here with /health still 200 is deliberate — the
@@ -437,6 +453,14 @@ class ServiceMonitor:
                 "deviceReconcile": device_stats.reconcile(),
                 # The declared-budget verdict (503-with-detail on breach).
                 "slo": slo,
+                # Per-tier watermark/lag pipeline (telemetry/
+                # watermarks.py): raw tier marks + per-edge consumer lag
+                # — the observatory's /fleet/lag merges these per worker.
+                "watermarks": watermarks.snapshot(),
+                # Multi-window burn-rate verdict when an engine is wired
+                # (report-only here; the observatory enforces fleet-wide).
+                "burnRate": (self.burn.evaluate()
+                             if self.burn is not None else None),
                 "stageLatencies": process_counters.latency_snapshot(),
                 "checks": {n: {"ok": ok, "detail": d}
                            for n, (ok, d) in checks.items()}}
@@ -466,40 +490,60 @@ class ServiceMonitor:
             sanitized = "_" + sanitized
         return "fluid_" + sanitized
 
+    @staticmethod
+    def _prom_label(value) -> str:
+        """Label-VALUE escaping per the exposition format: backslash,
+        double-quote, and newline must be escaped inside the quotes —
+        a stage or symbol name containing any of them otherwise
+        produces a line no conformant parser accepts."""
+        return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
     def prometheus(self) -> str:
         """Prometheus/OpenMetrics-style text exposition: every process
-        counter as an untyped sample, every stage latency histogram with
-        cumulative bucket lines (le in milliseconds) — bucket lines carry
-        the last trace id observed in that bucket as an exemplar, so a
-        latency spike on a dashboard links straight to its flight-recorder
-        trace."""
+        counter as a gauge sample with HELP/TYPE metadata, every stage
+        latency histogram with cumulative bucket lines (le in
+        milliseconds) — bucket lines carry the last trace id observed in
+        that bucket as an exemplar, so a latency spike on a dashboard
+        links straight to its flight-recorder trace. Label values are
+        escaped per the exposition grammar."""
+        esc = self._prom_label
+        # Freshen the fluid_lag_* surface so a scrape reads current
+        # watermark deltas rather than the last /health's.
+        watermarks.export_gauges()
         lines: List[str] = []
         for name, value in sorted(process_counters.snapshot().items()):
             metric = self._prom_name(name)
+            lines.append(f"# HELP {metric} process counter {esc(name)}")
             lines.append(f"# TYPE {metric} gauge")
             lines.append(f"{metric} {value:g}")
         for name, value in sorted(self.metrics.snapshot()
                                   ["counters"].items()):
             metric = self._prom_name("metric." + name)
+            lines.append(f"# HELP {metric} metric client counter "
+                         f"{esc(name)}")
             lines.append(f"# TYPE {metric} gauge")
             lines.append(f"{metric} {value:g}")
         hists = process_counters.histogram_export()
         if hists:
+            lines.append("# HELP fluid_stage_latency_ms per-stage "
+                         "latency histogram (milliseconds)")
             lines.append("# TYPE fluid_stage_latency_ms histogram")
         for name in sorted(hists):
             h = hists[name]
+            stage = esc(name)
             for le, cum, exemplar in h["buckets"]:
                 le_s = "+Inf" if le == float("inf") else f"{le:g}"
                 line = (f'fluid_stage_latency_ms_bucket'
-                        f'{{stage="{name}",le="{le_s}"}} {cum}')
+                        f'{{stage="{stage}",le="{le_s}"}} {cum}')
                 if exemplar is not None:
                     trace_id, value = exemplar
-                    line += (f' # {{trace_id="{trace_id}"}} '
+                    line += (f' # {{trace_id="{esc(trace_id)}"}} '
                              f'{value:g}')
                 lines.append(line)
-            lines.append(f'fluid_stage_latency_ms_sum{{stage="{name}"}} '
+            lines.append(f'fluid_stage_latency_ms_sum{{stage="{stage}"}} '
                          f'{h["sum"]:g}')
-            lines.append(f'fluid_stage_latency_ms_count{{stage="{name}"}} '
+            lines.append(f'fluid_stage_latency_ms_count{{stage="{stage}"}} '
                          f'{h["count"]}')
         # Compile/dispatch observatory: per-symbol gauges. Symbol
         # cardinality is the fixed probe/watch set (no per-tenant/doc
@@ -508,28 +552,46 @@ class ServiceMonitor:
         if led["symbols"]:
             for metric in ("compiles", "compile_ms", "cache_size",
                            "retraces"):
+                lines.append(f"# HELP fluid_compile_{metric} compile "
+                             f"ledger per-symbol {metric}")
                 lines.append(f"# TYPE fluid_compile_{metric} gauge")
                 src = {"compiles": "compiles", "compile_ms": "compileMs",
                        "cache_size": "cacheSize",
                        "retraces": "retraces"}[metric]
                 for name, sym in led["symbols"].items():
                     lines.append(
-                        f'fluid_compile_{metric}{{symbol="{name}"}} '
+                        f'fluid_compile_{metric}{{symbol="{esc(name)}"}} '
                         f'{sym[src]:g}')
+            lines.append("# HELP fluid_compile_total_ms cumulative "
+                         "process compile milliseconds")
             lines.append("# TYPE fluid_compile_total_ms gauge")
             lines.append(
                 f'fluid_compile_total_ms {led["totals"]["compileMs"]:g}')
         slo = self.slo.evaluate()
+        lines.append("# HELP fluid_slo_ok declared latency budget "
+                     "verdict (1 ok / 0 breach)")
         lines.append("# TYPE fluid_slo_ok gauge")
-        lines.append(f'fluid_slo_ok{{stage="{slo["stage"]}"}} '
+        lines.append(f'fluid_slo_ok{{stage="{esc(slo["stage"])}"}} '
                      f'{1 if slo["ok"] else 0}')
+        if self.burn is not None:
+            burn = self.burn.evaluate()
+            lines.append("# HELP fluid_slo_burn_breach multi-window "
+                         "burn-rate breach per objective (1 breach)")
+            lines.append("# TYPE fluid_slo_burn_breach gauge")
+            for name, obj in sorted(burn["objectives"].items()):
+                lines.append(
+                    f'fluid_slo_burn_breach{{objective="{esc(name)}"}} '
+                    f'{1 if obj["breach"] else 0}')
         with self._probes_lock:
             admission_ctl = self._admission
         if admission_ctl is not None:
             st = admission_ctl.status()
+            lines.append("# HELP fluid_admission_level overload ladder "
+                         "level (0 accept .. 3 shed)")
             lines.append("# TYPE fluid_admission_level gauge")
-            lines.append(f'fluid_admission_level{{state="{st["state"]}"}} '
-                         f'{st["level"]}')
+            lines.append(
+                f'fluid_admission_level{{state="{esc(st["state"])}"}} '
+                f'{st["level"]}')
         # OpenMetrics terminator — exemplars are OpenMetrics syntax, so
         # the exposition declares (and terminates as) OpenMetrics rather
         # than the 0.0.4 text format, whose parsers reject the '# {...}'

@@ -40,6 +40,7 @@ from ..mergetree.catchup import (  # noqa: F401 — re-exported: this module
     unpack_entries_narrow,         # here (layering: loader may import
 )                                  # server, not mergetree)
 from ..telemetry import tracing
+from ..telemetry import watermarks
 from ..telemetry.counters import increment
 from .cache import LruTtlCache
 
@@ -66,13 +67,20 @@ class CatchupCache:
 
     def __init__(self, max_entries: int = 65536,
                  max_bytes: int = 256 * 1024 * 1024,
-                 ttl_s: Optional[float] = None):
+                 ttl_s: Optional[float] = None,
+                 partition_of=None):
         self.blobs = LruTtlCache(max_entries=max_entries,
                                  max_bytes=max_bytes, ttl_s=ttl_s)
         self.hits = 0
         self.misses = 0
         self.stale_hits = 0
         self.published = 0
+        # doc_id -> ingest partition, for the catchup/adopted watermark
+        # stamps (telemetry/watermarks.py). The cache itself is
+        # partition-agnostic; owners that know the routing pass the
+        # tier's partition_for so lag attributes to the right partition,
+        # everyone else folds into partition 0.
+        self.partition_of = partition_of or (lambda _doc: 0)
 
     def publish(self, tenant_id: str, document_id: str,
                 artifact: dict) -> bool:
@@ -89,6 +97,14 @@ class CatchupCache:
             if wrote:
                 self.published += 1
                 increment("catchup.published")
+                # `catchup` watermark: ops up to the artifact's seq are
+                # now adoptable in O(1) (per-doc high-water, replay-safe).
+                # Default tenant key on purpose: every tier must stamp
+                # the SAME tenant axis or the lag edges split — process
+                # identity is the observatory's dimension, not tenant.
+                watermarks.advance_doc(
+                    watermarks.CATCHUP, self.partition_of(document_id),
+                    document_id, int(artifact["seq"]))
             else:
                 sp.set(lost_to_fresher=True)
         return wrote
@@ -108,6 +124,12 @@ class CatchupCache:
             _version, artifact = held
             self.hits += 1
             increment("catchup.delta_hit")
+            # `adopted` watermark: a served artifact is the adoption
+            # frontier the read tier can vouch for (the loader-side swap
+            # is client-local; the serve is the last server-visible hop).
+            watermarks.advance_doc(
+                watermarks.ADOPTED, self.partition_of(document_id),
+                document_id, int(artifact["seq"]))
             if head_seq is not None and int(artifact["seq"]) < head_seq:
                 self.stale_hits += 1
                 increment("catchup.delta_stale")

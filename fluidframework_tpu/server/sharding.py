@@ -63,6 +63,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from ..telemetry import watermarks
 from ..telemetry.counters import increment
 from .lambdas.base import IPartitionLambda, LambdaContext
 from .partition import PartitionManager
@@ -407,6 +408,27 @@ class SequencerShardSet:
             row.update(pump_stats.get(p, {}))
             out.append(row)
         return out
+
+    def refresh_watermarks(self, tenant: str = "local") -> None:
+        """Stamp the ingest tier's watermarks (telemetry/watermarks.py)
+        from live state: raw_end/raw_ingested from the partition
+        offsets, ticketed from each sequencer's per-doc head sequence
+        numbers. Pull model — called at scrape/probe/soak-tick time, so
+        the op path pays nothing; replayed offsets and sequence numbers
+        fold to zero inside the monotonic table."""
+        topic_obj = self.log.topic(self.topic)
+        for p in sorted(self.manager.pumps):
+            watermarks.advance(watermarks.RAW_END, p,
+                               topic_obj.partitions[p].end_offset,
+                               tenant=tenant)
+            committed = self.log.committed(self.group, self.topic, p)
+            watermarks.advance(watermarks.RAW_INGESTED, p,
+                               max(0, committed or 0), tenant=tenant)
+            seqs = getattr(self.live(p), "doc_sequence_numbers", None)
+            if seqs is not None:
+                for doc, seq in seqs().items():
+                    watermarks.advance_doc(watermarks.TICKETED, p,
+                                           doc, seq, tenant=tenant)
 
     # -- admission wiring ----------------------------------------------------
     def register_admission(self, controller, tenant_id: str) -> None:

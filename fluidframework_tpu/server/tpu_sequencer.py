@@ -5233,8 +5233,19 @@ class TpuSequencerLambda(IPartitionLambda):
                     # exists on this path.
                     bit_i += 1
                     if not ctx.get("burst_more"):
-                        recovered += self._finish_paged_group(
-                            ctx, gi, job, over)
+                        # Named settle stage: the megakernel ring's
+                        # scalar adoption + page release + rescue is
+                        # the path's fourth serving sub-span (pack /
+                        # dispatch / readback / settle), so ring
+                        # captures attribute settlement cost instead
+                        # of folding it into fold_rescue.
+                        with tracing.span("serving.settle",
+                                          hist="serving.settle") as _ssp:
+                            got = self._finish_paged_group(
+                                ctx, gi, job, over)
+                            if got:
+                                _ssp.set(rescued=True)
+                            recovered += got
                     continue
                 qsel = np.isin(job["chan"], q_m) if q_m is not None \
                     else None
@@ -6431,6 +6442,16 @@ class TpuSequencerLambda(IPartitionLambda):
         if dl is None:
             return 0
         return int(np.asarray(self.tstate.next_seq)[dl.lane]) - 1
+
+    def doc_sequence_numbers(self) -> Dict[str, int]:
+        """Per-document head sequence number: the `ticketed` watermark
+        feed (telemetry/watermarks.py), pulled at scrape time — one
+        next_seq read for the whole fleet, zero per-op cost."""
+        if not self.docs:
+            return {}
+        next_seq = np.asarray(self.tstate.next_seq)
+        return {doc: int(next_seq[dl.lane]) - 1
+                for doc, dl in self.docs.items()}
 
     def close(self) -> None:
         # Graceful close persists progress; pending (unflushed) messages are

@@ -99,6 +99,11 @@ class _Config:
 
 
 _cfg = _Config()
+# Fleet identity: every exported span names the process that recorded
+# it, so the observatory's cross-process join can tell alfred's ingest
+# span from the tpu-deli worker's ticket span. Workers set this at
+# startup (server/main.py); unset falls back to the OS pid.
+_process_name: Optional[str] = None
 # Sampling counters are PER SITE FAMILY (op roots vs stage roots): one
 # shared modulo counter phase-locks against a steady submit->flush
 # cadence and can systematically over- or never-sample one family.
@@ -131,6 +136,16 @@ def configure(sample: Optional[int] = None,
 
 def enabled() -> bool:
     return _cfg.sample > 0
+
+
+def set_process_name(name: Optional[str]) -> None:
+    """Tag every span exported from this process (fleet join identity)."""
+    global _process_name
+    _process_name = name
+
+
+def process_name() -> str:
+    return _process_name or f"pid{os.getpid()}"
 
 
 def _new_trace_id() -> str:
@@ -459,6 +474,17 @@ def ensure_op_context() -> Optional[TraceContext]:
     return TraceContext(_new_trace_id(), _new_span_id(), sampled=True)
 
 
+def root_context() -> Optional[TraceContext]:
+    """Head-sample a fresh root for a system-initiated message (ghost
+    evictions, scribe acks outside any ambient span): these enter the
+    raw log without a client edit, and an unstamped system message is a
+    hole in the fleet-joined timeline. Uses the stage-root sampling
+    counter so op sampling phase stays undisturbed."""
+    if not enabled() or not _root_sampled_now():
+        return None
+    return TraceContext(_new_trace_id(), _new_span_id(), sampled=True)
+
+
 # -- wire propagation -------------------------------------------------------
 
 def stamp_message(msg, ctx: Optional[TraceContext]) -> None:
@@ -500,8 +526,12 @@ def chrome_trace(spans: Optional[List[dict]] = None) -> dict:
     """Chrome trace-event JSON (the ``/trace`` payload): one complete
     ("ph": "X") event per span; perfetto and chrome://tracing open it
     as-is. Span identity rides in args so a capture can be re-grouped
-    by trace_id offline."""
+    by trace_id offline; process identity (pid + args.proc) lets the
+    fleet observatory join rings drained from several workers into one
+    timeline without ambiguity."""
     events = []
+    pid = os.getpid()
+    proc = process_name()
     for s in (recorder.snapshot() if spans is None else spans):
         events.append({
             "name": s["name"],
@@ -509,11 +539,12 @@ def chrome_trace(spans: Optional[List[dict]] = None) -> dict:
             "ph": "X",
             "ts": s["ts"],
             "dur": s["dur"],
-            "pid": 1,
+            "pid": s.get("pid", pid),
             "tid": s.get("tid", 0),
             "args": dict(s.get("attrs") or {},
                          trace_id=s["trace_id"], span_id=s["span_id"],
-                         parent_id=s.get("parent_id")),
+                         parent_id=s.get("parent_id"),
+                         proc=s.get("proc", proc)),
         })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
@@ -524,7 +555,9 @@ def chrome_trace_json(spans: Optional[List[dict]] = None) -> str:
 
 def reset() -> None:
     """Test isolation only: drop recorded spans and disable tracing."""
+    global _process_name
     _cfg.sample = 0
     _cfg.slow_ms = 50.0
     recorder.resize(len(recorder._buf))
     _tls.op_ctx = None
+    _process_name = None

@@ -8,6 +8,7 @@ connection per call) but fatal for real scrapers."""
 
 import http.client
 import json
+import threading
 import urllib.error
 import urllib.request
 
@@ -217,6 +218,73 @@ class TestSlo:
             assert status == 200 and body["slo"]["ok"]
         finally:
             mon.stop()
+
+
+class TestProbeConcurrency:
+    """The probe registry is shared state: watch_*() registration on
+    the operator thread races /health's iteration on request threads.
+    An unguarded dict dies with RuntimeError mid-iteration; the lock
+    (snapshot-then-probe-outside-it) must keep every request whole."""
+
+    def test_watch_registration_races_health(self, monitor):
+        stop = threading.Event()
+        errors = []
+
+        def register():
+            i = 0
+            while not stop.is_set():
+                # Both registration paths: raw add_probe and a watch_*
+                # convenience (they share the guarded registry).
+                monitor.add_probe(f"p{i % 20}", lambda: {"n": 1})
+                monitor.watch_local_server(f"ls{i % 20}", object())
+                i += 1
+
+        def health_loop():
+            try:
+                for _ in range(25):
+                    with urllib.request.urlopen(
+                            monitor.url + "/health") as resp:
+                        body = json.load(resp)
+                        assert body["ok"] is True
+            except Exception as exc:  # noqa: BLE001 — the assertion
+                errors.append(exc)
+
+        writer = threading.Thread(target=register, daemon=True)
+        readers = [threading.Thread(target=health_loop, daemon=True)
+                   for _ in range(3)]
+        writer.start()
+        for r in readers:
+            r.start()
+        for r in readers:
+            r.join(timeout=30)
+        stop.set()
+        writer.join(timeout=5)
+        assert not errors, errors
+
+    def test_raising_probe_does_not_starve_the_others(self, monitor):
+        ran = {"good": 0}
+
+        def good():
+            ran["good"] += 1
+            return {"n": ran["good"]}
+
+        monitor.add_probe("boom", lambda: 1 / 0)
+        monitor.add_probe("good", good)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(monitor.url + "/health")
+        assert err.value.code == 503
+        body = json.load(err.value)
+        # The crash is isolated to its own checks entry; every other
+        # probe still ran and reported.
+        assert body["checks"]["boom"]["ok"] is False
+        assert body["checks"]["good"]["ok"] is True
+        assert ran["good"] == 1
+        # /metrics report-mode isolation too: the error is inlined,
+        # never raised through the route.
+        with urllib.request.urlopen(monitor.url + "/metrics") as resp:
+            report = json.load(resp)
+        assert "ZeroDivisionError" in report["probes"]["boom"]["error"]
+        assert report["probes"]["good"] == {"n": 2}
 
 
 class TestTraceEndpoint:

@@ -581,6 +581,25 @@ class TestMegakernelServing:
         assert emits_p == emits_ref
         assert texts_p == texts_ref
 
+    def test_megakernel_path_fills_every_serving_sub_span(self):
+        """Span coverage parity (docs/observability.md v3): the
+        megakernel ring path must attribute its flush cost to the same
+        named serving sub-spans as the scan path — pack, dispatch,
+        readback — plus its own settle stage (paged-group scalar
+        adoption/rescue), so ring captures never hide a stage inside
+        the flush total. The hist= histograms are always-on, so
+        coverage is assertable without enabling trace sampling."""
+        counters.reset()
+        try:
+            _, emits, _ = _drive_mega(interpret=False)
+            assert emits
+            assert counters.get("serving.megakernel_rings") >= 1
+            for stage in ("serving.pack", "serving.dispatch",
+                          "serving.readback", "serving.settle"):
+                assert counters.latency_window(stage), stage
+        finally:
+            counters.reset()
+
     def test_device_stats_reconcile_exactly_on_megakernel_path(self):
         """PR 12's contract carried into R10: the stats plane rides
         the megakernel readback and every countable device slot equals

@@ -1,0 +1,166 @@
+"""Multi-window SLO burn-rate engine (telemetry/slo.py): objective
+validation, burn-rate arithmetic against hand-computed fractions, the
+two-window AND (spike-only and stale-incident cases both stay quiet),
+no-data handling, bucket pruning, attribution, and virtual-clock
+determinism."""
+
+import pytest
+
+from fluidframework_tpu.telemetry.slo import (BurnRateEngine, Objective)
+
+
+def _engine(clock, **kw):
+    kw.setdefault("fast_window_s", 60.0)
+    kw.setdefault("slow_window_s", 600.0)
+    return BurnRateEngine(
+        [Objective("flush", 0.99, "flush latency inside budget"),
+         Objective("lag", 0.95)],
+        clock=lambda: clock["t"], **kw)
+
+
+class TestObjective:
+    def test_error_budget(self):
+        assert Objective("x", 0.99).error_budget == pytest.approx(0.01)
+
+    @pytest.mark.parametrize("target", [0.0, 1.0, -0.5, 1.5])
+    def test_target_out_of_range_rejected(self, target):
+        with pytest.raises(ValueError):
+            Objective("x", target)
+
+    def test_window_ordering_rejected(self):
+        with pytest.raises(ValueError):
+            BurnRateEngine([Objective("x", 0.9)], fast_window_s=100.0,
+                           slow_window_s=50.0)
+
+
+class TestBurnRates:
+    def test_burn_is_bad_fraction_over_error_budget(self):
+        clock = {"t": 1000.0}
+        e = _engine(clock)
+        # 2% bad on a 1% budget => burn 2.0 in both windows.
+        e.record("flush", good=98, bad=2)
+        fast, slow = e.burn_rates("flush")
+        assert fast == pytest.approx(2.0)
+        assert slow == pytest.approx(2.0)
+
+    def test_no_data_is_none_not_breach(self):
+        clock = {"t": 1000.0}
+        e = _engine(clock)
+        assert e.burn_rates("flush") == (None, None)
+        verdict = e.evaluate()
+        assert verdict["ok"] is True
+        assert verdict["objectives"]["flush"]["breach"] is False
+
+    def test_zero_events_record_is_ignored(self):
+        clock = {"t": 1000.0}
+        e = _engine(clock)
+        e.record("flush", good=0, bad=0)
+        assert e.burn_rates("flush") == (None, None)
+
+    def test_unknown_objective_raises(self):
+        e = _engine({"t": 0.0})
+        with pytest.raises(KeyError):
+            e.record("nope", good=1)
+
+    def test_old_buckets_age_out_of_fast_window(self):
+        clock = {"t": 1000.0}
+        e = _engine(clock)
+        e.record("flush", bad=10)           # all-bad burst
+        clock["t"] = 1000.0 + 120.0         # past the 60s fast window
+        e.record("flush", good=100)
+        fast, slow = e.burn_rates("flush")
+        assert fast == pytest.approx(0.0)   # burst left the fast window
+        assert slow == pytest.approx((10 / 110) / 0.01)
+
+    def test_pruned_past_slow_window(self):
+        clock = {"t": 0.0}
+        e = _engine(clock)
+        e.record("flush", bad=50)
+        clock["t"] = 700.0                  # past the 600s slow window
+        e.record("flush", good=1)
+        fast, slow = e.burn_rates("flush")
+        assert fast == pytest.approx(0.0)
+        assert slow == pytest.approx(0.0)
+
+
+class TestTwoWindowAnd:
+    def test_sustained_burn_breaches(self):
+        clock = {"t": 0.0}
+        e = _engine(clock)
+        # Sustained 50% bad on a 1% budget: burn 50 in both windows.
+        for step in range(20):
+            clock["t"] = step * 30.0
+            e.record("flush", good=1, bad=1)
+        verdict = e.evaluate()
+        assert verdict["objectives"]["flush"]["breach"] is True
+        assert verdict["ok"] is False
+        assert verdict["attribution"] == "flush"
+
+    def test_brief_spike_fast_only_stays_quiet(self):
+        clock = {"t": 0.0}
+        e = _engine(clock)
+        # Long healthy history fills the slow window...
+        for step in range(19):
+            clock["t"] = step * 30.0
+            e.record("flush", good=100)
+        # ...then one hot fast window: fast burns, slow does not.
+        # (the 60s fast window still holds ~200 good events from the
+        # healthy steps, so the spike must outweigh them)
+        clock["t"] = 19 * 30.0
+        e.record("flush", bad=60)
+        fast, slow = e.burn_rates("flush")
+        assert fast >= 14.4
+        assert slow < 6.0
+        assert e.evaluate()["objectives"]["flush"]["breach"] is False
+
+    def test_stale_incident_slow_only_stays_quiet(self):
+        clock = {"t": 0.0}
+        e = _engine(clock)
+        e.record("flush", bad=500)          # old incident
+        # Recovered: the fast window sees only good events now.
+        clock["t"] = 500.0
+        e.record("flush", good=100)
+        fast, slow = e.burn_rates("flush")
+        assert fast < 14.4
+        assert slow >= 6.0
+        assert e.evaluate()["objectives"]["flush"]["breach"] is False
+
+
+class TestEvaluate:
+    def test_attribution_is_worst_breached_objective(self):
+        clock = {"t": 0.0}
+        e = _engine(clock)
+        for step in range(20):
+            clock["t"] = step * 30.0
+            e.record("flush", good=1, bad=1)    # burn 50 on 1% budget
+            e.record("lag", good=1, bad=1)      # burn 10 on 5% budget
+        clock["t"] = 20 * 30.0
+        verdict = e.evaluate()
+        assert verdict["objectives"]["flush"]["breach"]
+        # lag burns 10 < 14.4 fast threshold: not breached.
+        assert not verdict["objectives"]["lag"]["breach"]
+        assert verdict["attribution"] == "flush"
+
+    def test_description_rides_verdict(self):
+        e = _engine({"t": 0.0})
+        v = e.evaluate()
+        assert v["objectives"]["flush"]["description"] \
+            == "flush latency inside budget"
+        assert "description" not in v["objectives"]["lag"]
+
+    def test_virtual_clock_determinism(self):
+        def run():
+            clock = {"t": 0.0}
+            e = _engine(clock)
+            for step in range(30):
+                clock["t"] = step * 13.0
+                e.record("flush", good=9, bad=step % 3)
+            return e.evaluate(now=clock["t"])
+        assert run() == run()
+
+    def test_reset_clears_history(self):
+        clock = {"t": 0.0}
+        e = _engine(clock)
+        e.record("flush", bad=100)
+        e.reset()
+        assert e.burn_rates("flush") == (None, None)

@@ -217,8 +217,10 @@ class TestChromeExport:
         assert out["displayTimeUnit"] == "ms"
         by_name = {e["name"]: e for e in out["traceEvents"]}
         assert set(by_name) == {"parent", "child"}
+        import os
         for e in out["traceEvents"]:
-            assert e["ph"] == "X" and e["pid"] == 1
+            assert e["ph"] == "X" and e["pid"] == os.getpid()
+            assert e["args"]["proc"] == tracing.process_name()
             assert e["dur"] >= 0
         assert (by_name["child"]["args"]["parent_id"]
                 == by_name["parent"]["args"]["span_id"])
