@@ -3453,9 +3453,139 @@ def ingest_smoke() -> int:
     sib_shed = sum(v for p, v in fairness["shed_by_partition"].items()
                    if p != hot)
 
+    # ---- section 4: durable broker engine (group commit, wall-clock) ------
+    # Unlike sections 1-3, every figure here is WALL-CLOCK: the durable
+    # engine's win is fsync amortization, and fsyncs happen in real time
+    # whether or not a busy-time accountant is watching. Three paired
+    # runs on fresh on-disk logs:
+    #   fsync_baseline  1 partition, one send_to per record — group
+    #                   commit degrades to ONE FSYNC PER RECORD, which
+    #                   is exactly the pre-segment-engine durability
+    #                   cost (the 10x denominator).
+    #   group_commit    1 partition, send_to_many batches — one
+    #                   write+fsync per batch. Gate: >= 10x baseline.
+    #   sixteen_part    16 partitions, 16 concurrent producers, batched
+    #                   — the composition shape. Gates: >= 8x baseline
+    #                   and >= 0.6x of the single-partition batched run.
+    #                   This container has ONE core, so the gate grades
+    #                   partitioning efficiency of the shared group-
+    #                   commit drain (16 producers contending on the
+    #                   GIL + 16 segment files), not host parallelism —
+    #                   the hard 10x durability contract is the
+    #                   group_commit gate above.
+    import tempfile as _tempfile
+    import threading as _threading
+
+    from fluidframework_tpu.server.durable import DurableMessageLog
+
+    def _durable_section():
+        batch = 64
+        base_msgs = int(os.environ.get("BENCH_DURABLE_BASE_MSGS", 200))
+        gc_msgs = int(os.environ.get("BENCH_DURABLE_GC_MSGS", 6400))
+        per_part = int(os.environ.get("BENCH_DURABLE_16P_MSGS", 2048))
+        rounds = int(os.environ.get("BENCH_DURABLE_ROUNDS", 2))
+        payload = {"op": "x" * 16}
+        out = {}
+
+        def run_base(droot):
+            fsyncs0 = _counters.snapshot().get("durable.fsyncs_total", 0)
+            log = DurableMessageLog(droot)
+            log.topic("raw", 1)
+            t0 = time.perf_counter()
+            for i in range(base_msgs):
+                log.send_to("raw", 0, "k", payload)
+            base_s = time.perf_counter() - t0
+            log.close()
+            base_fsyncs = _counters.snapshot().get(
+                "durable.fsyncs_total", 0) - fsyncs0
+            return {"msgs": base_msgs, "wall_s": round(base_s, 4),
+                    "fsyncs": int(base_fsyncs),
+                    "msgs_per_sec": round(base_msgs / base_s, 1)}
+
+        def run_gc(droot):
+            fsyncs0 = _counters.snapshot().get("durable.fsyncs_total", 0)
+            log = DurableMessageLog(droot)
+            log.topic("raw", 1)
+            t0 = time.perf_counter()
+            for b in range(gc_msgs // batch):
+                log.send_to_many("raw", 0,
+                                 [("k", payload)] * batch)
+            gc_s = time.perf_counter() - t0
+            log.close()
+            gc_fsyncs = _counters.snapshot().get(
+                "durable.fsyncs_total", 0) - fsyncs0
+            return {"msgs": gc_msgs, "wall_s": round(gc_s, 4),
+                    "fsyncs": int(gc_fsyncs), "batch": batch,
+                    "msgs_per_sec": round(gc_msgs / gc_s, 1)}
+
+        def run_p16(droot):
+            fsyncs0 = _counters.snapshot().get("durable.fsyncs_total", 0)
+            log = DurableMessageLog(droot)
+            log.topic("raw", 16)
+
+            def produce(p):
+                for b in range(per_part // batch):
+                    log.send_to_many("raw", p, [("k", payload)] * batch)
+
+            workers = [_threading.Thread(target=produce, args=(p,))
+                       for p in range(16)]
+            t0 = time.perf_counter()
+            for w in workers:
+                w.start()
+            for w in workers:
+                w.join()
+            p16_s = time.perf_counter() - t0
+            stats = log.durable_stats()
+            log.close()
+            p16_fsyncs = _counters.snapshot().get(
+                "durable.fsyncs_total", 0) - fsyncs0
+            total16 = 16 * per_part
+            return {"partitions": 16, "producers": 16,
+                    "msgs": total16, "wall_s": round(p16_s, 4),
+                    "fsyncs": int(p16_fsyncs), "batch": batch,
+                    "segments": stats["segments"],
+                    "msgs_per_sec": round(total16 / p16_s, 1)}
+
+        # Best-of-N per sub-benchmark (fresh on-disk log each round):
+        # fsync wall time on a shared container is at the mercy of
+        # whoever else is hitting the disk, and the baseline run is
+        # short enough that one background flush can halve its rate —
+        # which shows up as a PHANTOM speedup swing in the paired
+        # ratios. Best-of-N grades the engine, not the neighbors.
+        def best_of(fn, name):
+            runs = []
+            with _tempfile.TemporaryDirectory() as droot:
+                for r in range(max(1, rounds)):
+                    runs.append(fn(os.path.join(droot, f"{name}{r}")))
+            return max(runs, key=lambda x: x["msgs_per_sec"])
+
+        out["fsync_baseline"] = best_of(run_base, "base")
+        out["group_commit"] = best_of(run_gc, "gc")
+        out["sixteen_part"] = best_of(run_p16, "p16")
+        out["group_commit_speedup"] = round(
+            out["group_commit"]["msgs_per_sec"]
+            / max(1e-9, out["fsync_baseline"]["msgs_per_sec"]), 2)
+        out["sixteen_part_speedup"] = round(
+            out["sixteen_part"]["msgs_per_sec"]
+            / max(1e-9, out["fsync_baseline"]["msgs_per_sec"]), 2)
+        out["sixteen_part_vs_one"] = round(
+            out["sixteen_part"]["msgs_per_sec"]
+            / max(1e-9, out["group_commit"]["msgs_per_sec"]), 3)
+        return out
+
+    durable = _durable_section()
+
     checks = {
         "aggregate_scaling_2_5x": scaling >= 2.5,
         "order_identical": order_identical,
+        "durable_group_commit_10x": durable["group_commit_speedup"] >= 10.0,
+        "durable_16p_wall_8x": durable["sixteen_part_speedup"] >= 8.0,
+        "durable_16p_composes": durable["sixteen_part_vs_one"] >= 0.6,
+        "durable_fsyncs_amortized": (
+            durable["group_commit"]["fsyncs"]
+            <= durable["group_commit"]["msgs"] // 32
+            and durable["fsync_baseline"]["fsyncs"]
+            >= durable["fsync_baseline"]["msgs"]),
         "partition_queues_bounded": (
             max(uniform["peak_backlog_by_partition"].values())
             <= uniform["partition_limit"]
@@ -3484,6 +3614,7 @@ def ingest_smoke() -> int:
         "order_mismatched_docs": mismatched,
         "overload_2x": uniform,
         "fairness_hot": fairness,
+        "durable": durable,
         "checks": checks,
         "ok": all(checks.values()),
     }
@@ -4340,9 +4471,42 @@ def bench_trend(strict: bool = True) -> int:
     fleet_lines, fleet_regressions, fleet_count = _trend_gate(
         load_records("BENCH_FLEET_r*.json", "BENCH_FLEET_LAST.json"),
         lambda m: m == "pipeline_ops_per_sec")
-    e2e_lines = e2e_lines + mega_lines + fleet_lines
+    # The durable broker smoke rides the same history policy
+    # (BENCH_INGEST_r*.json committed records, BENCH_INGEST_LAST.json
+    # as the latest candidate): group-commit / 16-partition wall-clock
+    # rates are host-speed trajectories (report-only on CPU hosts, like
+    # every other wall-clock figure here). The SPEEDUP ratios are
+    # different: each is a paired same-host, same-run ratio against the
+    # per-message-fsync baseline, so host speed divides out and the
+    # >= 10x contract from the group-commit work is a hard floor on
+    # ANY host — a latest record stamped under 10x fails trend even
+    # with no comparable prior.
+    ingest_records = load_records("BENCH_INGEST_r*.json",
+                                  "BENCH_INGEST_LAST.json")
+    ingest_lines, ingest_regressions, ingest_count = _trend_gate(
+        ingest_records,
+        lambda m: m in ("durable.group_commit.msgs_per_sec",
+                        "durable.sixteen_part.msgs_per_sec",
+                        "durable.group_commit_speedup",
+                        "durable.sixteen_part_speedup"))
+    if ingest_records:
+        _ing_name, _ing_latest = ingest_records[-1]
+        _dur = _ing_latest.get("durable") or {}
+        for _floor_metric, _floor in (("group_commit_speedup", 10.0),
+                                      ("sixteen_part_speedup", 8.0)):
+            _v = _dur.get(_floor_metric)
+            if _v is not None and _v < _floor:
+                ingest_regressions.append(
+                    {"metric": f"durable.{_floor_metric}",
+                     "latest": _v, "best": _floor,
+                     "change_pct": round((_v - _floor) / _floor * 100,
+                                         1)})
+                ingest_lines.append(
+                    f"durable.{_floor_metric}: {_v:.1f}x < "
+                    f"{_floor:.0f}x floor ({_ing_name})  REGRESSION")
+    e2e_lines = e2e_lines + mega_lines + fleet_lines + ingest_lines
     e2e_regressions = (e2e_regressions + mega_regressions
-                       + fleet_regressions)
+                       + fleet_regressions + ingest_regressions)
 
     records = load_records("BENCH_r*.json")
     if len(records) < 2:
@@ -4352,6 +4516,7 @@ def bench_trend(strict: bool = True) -> int:
                    "e2e_records": e2e_count,
                    "mega_records": mega_count,
                    "fleet_records": fleet_count,
+                   "ingest_records": ingest_count,
                    "metrics_tracked": len(e2e_lines),
                    "regressions": e2e_regressions, "strict": strict,
                    "ok": not (strict and e2e_regressions),
@@ -4370,6 +4535,7 @@ def bench_trend(strict: bool = True) -> int:
                "e2e_records": e2e_count,
                "mega_records": mega_count,
                "fleet_records": fleet_count,
+               "ingest_records": ingest_count,
                "latest": latest_name, "latest_host": list(latest_key),
                "metrics_tracked": len(lines) + len(e2e_lines),
                "regressions": regressions,

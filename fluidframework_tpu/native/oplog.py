@@ -172,6 +172,16 @@ class NativeMessageLog:
             fn(msg)
         return msg
 
+    def send_to_many(self, topic: str, partition: int,
+                     items) -> List[QueuedMessage]:
+        """Batched explicit-partition produce (MessageLog.send_to_many
+        parity). The C++ engine appends are already memory-speed, so this
+        loops oplog_append — the batch shape exists so callers written
+        against the durable engine's one-group-commit-per-batch path run
+        unchanged here."""
+        return [self.send_to(topic, partition, key, value)
+                for key, value in items]
+
     # -- consumer ----------------------------------------------------------
     def poll(self, group: str, topic: str, partition: int = 0,
              limit: int = 1000) -> List[QueuedMessage]:
@@ -180,6 +190,13 @@ class NativeMessageLog:
     def _read(self, topic: str, partition: int, offset: int,
               limit: int = 1000) -> List[QueuedMessage]:
         return self._poll("", topic, partition, limit, start=offset)
+
+    def read_from(self, topic: str, partition: int, offset: int,
+                  limit: int = 1000) -> List[QueuedMessage]:
+        """Group-independent explicit-offset read (MessageLog.read_from
+        parity) — the C++ ring keeps full history in memory, so this is
+        the same O(limit) copy-out as any read."""
+        return self._read(topic, partition, offset, limit)
 
     def _poll(self, group: str, topic: str, partition: int, limit: int,
               start: int) -> List[QueuedMessage]:

@@ -17,6 +17,12 @@ class CopierLambda(IPartitionLambda):
 
     def handler(self, message: QueuedMessage) -> None:
         boxcar = message.value
+        if isinstance(boxcar, dict):
+            # Rebalance control records (server/sharding.py handoff/
+            # adopt) ride the raw topic as plain dicts — sequencer
+            # control plane, not client traffic; nothing to archive.
+            self.context.checkpoint(message.offset)
+            return
         self.raw_deltas.insert_one({
             "documentId": boxcar.document_id,
             "clientId": boxcar.client_id,

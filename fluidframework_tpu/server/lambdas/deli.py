@@ -120,6 +120,11 @@ class DeliLambda(IPartitionLambda):
                 "deli.clientTimeoutMsec", 300_000)) / 1000.0
         if checkpoints is not None:
             for row in checkpoints.find(lambda d: "documentId" in d):
+                if row.get("handedOff") or "state" not in row:
+                    # Rebalance tombstone (export_doc/drop_doc): this
+                    # partition handed the document to another owner —
+                    # restoring it here would re-adopt a moved document.
+                    continue
                 state = self.load_state(row["state"])
                 if fresh_log:
                     state.log_offset = -1
@@ -195,6 +200,52 @@ class DeliLambda(IPartitionLambda):
                  "canEvict": c.can_evict}
                 for c in state.clients.values()],
         }
+
+    # -- live rebalance hooks (server/sharding.py handoff wrapper) ---------
+    def export_doc(self, doc_id: str) -> Optional[dict]:
+        """Serialize one document's live sequencing state for an epoch
+        handoff (the same dump format checkpoints use). None when the
+        document is not owned here — the idempotence a replayed handoff
+        marker relies on."""
+        state = self.docs.get(doc_id)
+        if state is None:
+            return None
+        return self._dump(state)
+
+    def drop_doc(self, doc_id: str, epoch: int = 0) -> None:
+        """Release a handed-off document: forget the live state and
+        TOMBSTONE its checkpoint row (handedOff=epoch) so a crash-restart
+        of this partition does not re-adopt a document that now lives
+        elsewhere. Called only after the adopt record is durably on the
+        target partition."""
+        self.docs.pop(doc_id, None)
+        self._evicting.pop(doc_id, None)
+        if self.checkpoints is not None:
+            self.checkpoints.upsert(
+                lambda d, _id=doc_id: d.get("documentId") == _id,
+                {"documentId": doc_id, "handedOff": int(epoch)})
+
+    def adopt_doc(self, doc_id: str, dump: dict) -> bool:
+        """Install a handed-off document's state. The dump's logOffset
+        indexes the SOURCE partition's log, so the replay guard resets
+        (fresh_log semantics) — nothing on this partition predates the
+        adoption. Idempotent: a duplicate adopt record (replayed marker
+        on the source) is ignored once the document is owned."""
+        if doc_id in self.docs:
+            return False
+        state = self.load_state(dump)
+        state.log_offset = -1
+        self.docs[doc_id] = state
+        if self.checkpoints is not None:
+            # Persist the adopted state NOW, not at the next flush
+            # cadence: the source's row is already a tombstone, and
+            # between adopt and the next checkpoint every cross-
+            # partition reader (sequence_number introspection, node
+            # takeover) would otherwise see no live row at all.
+            self.checkpoints.upsert(
+                lambda d, _id=doc_id: d.get("documentId") == _id,
+                {"documentId": doc_id, "state": self._dump(state)})
+        return True
 
     @staticmethod
     def load_state(dump: dict) -> DocumentDeliState:

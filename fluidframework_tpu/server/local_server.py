@@ -423,6 +423,17 @@ class LocalServer:
             return None
         return self.ingest.partition_for(document_id)
 
+    def rebalance_document(self, document_id: str, target: int) -> int:
+        """Live-rebalance one document's sequencing to ``target`` with
+        no fleet drain (server/sharding.py rebalance_doc): routing-epoch
+        bump + handoff marker on the raw topic itself. Returns the new
+        routing epoch. Per-doc emit order is provably identical across
+        the move (docs/ingest_sharding.md)."""
+        epoch = self.ingest.rebalance_doc(document_id, target)
+        if self.auto_pump:
+            self.pump()
+        return epoch
+
     def _wire_admission(self) -> None:
         adm = self.admission
         adm.add_source(f"core:{self.tenant_id}",
@@ -486,9 +497,12 @@ class LocalServer:
         # deltas topic mirrors the raw topic's partitioning, so every
         # downstream per-partition consumer (scriptorium/scribe/
         # broadcaster pumps) inherits the ingest tier's document homes
-        # instead of the broker's own key hash.
+        # instead of the broker's own key hash. BASE routing on purpose:
+        # a live rebalance re-homes only the RAW (sequencing-input)
+        # side; the document's output stream never changes partitions,
+        # so per-doc delivery order stays total across a handoff.
         self.log.send_to(DELTAS_TOPIC,
-                         self.ingest.partition_for(doc_id),
+                         self.ingest.delta_partition_for(doc_id),
                          doc_id, (doc_id, sequenced))
 
     def _emit_nack(self, doc_id: str, client_id: str, nack: Nack) -> None:
@@ -631,8 +645,10 @@ class LocalServer:
 
     # -- introspection ----------------------------------------------------
     def sequence_number(self, document_id: str) -> int:
+        # "state" in d: skip handed-off tombstones (a live-rebalanced
+        # document leaves one on its old partition's scoped view).
         row = self.deli_checkpoints.find_one(
-            lambda d: d.get("documentId") == document_id)
+            lambda d: d.get("documentId") == document_id and "state" in d)
         return row["state"]["sequenceNumber"] if row else 0
 
 

@@ -31,16 +31,22 @@ class QueuedMessage:
 
 
 class Partition:
-    def __init__(self, topic: str, index: int):
+    def __init__(self, topic: str, index: int, base_offset: int = 0):
         self.topic = topic
         self.index = index
+        # First offset held in memory. Always 0 for the in-memory engines;
+        # a durable log opened in replay="committed" mode keeps only the
+        # uncheckpointed suffix resident and serves older offsets from its
+        # segment files (server/durable.py poll override).
+        self.base_offset = base_offset
         self.messages: List[QueuedMessage] = []
         self.lock = threading.Lock()
         self.listeners: List[Callable[[QueuedMessage], None]] = []
 
     def append(self, key: str, value: Any) -> QueuedMessage:
         with self.lock:
-            msg = QueuedMessage(self.topic, self.index, len(self.messages),
+            msg = QueuedMessage(self.topic, self.index,
+                                self.base_offset + len(self.messages),
                                 key, value)
             self.messages.append(msg)
             listeners = list(self.listeners)
@@ -50,12 +56,13 @@ class Partition:
 
     def read(self, offset: int, limit: int = 1000) -> List[QueuedMessage]:
         with self.lock:
-            return self.messages[offset:offset + limit]
+            lo = max(offset - self.base_offset, 0)
+            return self.messages[lo:lo + limit]
 
     @property
     def end_offset(self) -> int:
         with self.lock:
-            return len(self.messages)
+            return self.base_offset + len(self.messages)
 
 
 class Topic:
@@ -100,11 +107,32 @@ class MessageLog:
         land on the partition its source documents hash to."""
         return self.topic(topic).partitions[partition].append(key, value)
 
+    def send_to_many(self, topic: str, partition: int,
+                     items: List[tuple]) -> List[QueuedMessage]:
+        """Batched explicit-partition produce: append [(key, value), ...]
+        to one partition in order. On this engine it is a convenience
+        loop; on the durable engine the whole batch rides ONE group
+        commit (one write+fsync), and on the gRPC engine it is one round
+        trip — the producer-side half of the million-msgs/s broker path.
+        Per-partition order is the list order, exactly as if the caller
+        had issued send_to() per item."""
+        part = self.topic(topic).partitions[partition]
+        return [part.append(key, value) for key, value in items]
+
     # -- consumer ----------------------------------------------------------
     def poll(self, group: str, topic: str, partition: int = 0,
              limit: int = 1000) -> List[QueuedMessage]:
         start = self.committed(group, topic, partition)
         return self.topic(topic).partitions[partition].read(start, limit)
+
+    def read_from(self, topic: str, partition: int, offset: int,
+                  limit: int = 1000) -> List[QueuedMessage]:
+        """Group-independent read from an explicit offset — the replay
+        surface crash recovery uses when it must re-read records BELOW a
+        group's committed offset (rebalance buffer recovery in
+        server/sharding.py). The durable engine overrides this to serve
+        offsets below the resident window from its segment index."""
+        return self.topic(topic).partitions[partition].read(offset, limit)
 
     def commit(self, group: str, topic: str, partition: int,
                offset: int) -> None:

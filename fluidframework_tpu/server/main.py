@@ -43,16 +43,27 @@ DELTAS_TOPIC = "deltas"
 NACKS_TOPIC = "nacks"
 
 DEFAULT_CONFIG = {
+    # monitorPort > 0 serves /health + /metrics.prom on the broker
+    # process — the durable engine's group-commit counters
+    # (fluid_durable_fsyncs_total, fluid_durable_batch_bytes, the
+    # durable.group_commit latency histogram) live HERE, not in the
+    # workers, so the observatory must scrape the broker to see them.
     "broker": {"host": "127.0.0.1", "port": 7080, "native": False,
-               "partitions": 1},
+               "partitions": 1, "monitorPort": 0},
     "storage": {"db": "var/fluid.sqlite", "git": "var/git"},
     # monitorPort > 0 serves /health + /metrics.prom + /trace for the
     # fleet observatory to scrape; `name` tags every exported span with
     # this process identity (default worker:<stages>); traceSample > 0
     # head-samples 1-in-N op traces in this worker.
+    # `partitions`: null pumps every broker partition (single-host
+    # shape); a list like [0,1,2,3] makes this worker pump ONLY those
+    # raw-topic partitions — the cross-host placement config (two
+    # workers owning [0..7] and [8..15] against one remote broker ARE
+    # the 16-partition ingest tier; deploy/RUNBOOK.md multi-host
+    # recipe). Applies to the sequencing stage (deli/tpu-deli).
     "worker": {"stages": ["deli", "scriptorium", "scribe", "copier"],
                "poll_ms": 10, "tenant": "local", "monitorPort": 0,
-               "name": None, "traceSample": 0},
+               "name": None, "traceSample": 0, "partitions": None},
     # The fleet observatory (server/observatory.py): scrapes each
     # worker's monitor endpoints on intervalS, merges /fleet/health,
     # /fleet/metrics.prom, /fleet/lag, and joins drained trace rings by
@@ -128,7 +139,17 @@ def run_broker(cfg: dict) -> None:
     server = LogServiceServer(log, port=bcfg.get("port", 7080))
     server.start()
     print(f"broker: serving ordered log on {server.address}", flush=True)
+    monitor = None
+    if bcfg.get("monitorPort"):
+        from .monitor import ServiceMonitor
+        monitor = ServiceMonitor(host=bcfg.get("host", "127.0.0.1"),
+                                 port=bcfg["monitorPort"])
+        monitor.watch_durable("broker", log)
+        monitor.start()
+        print(f"broker: monitor on {monitor.url}", flush=True)
     _wait_for_signal()
+    if monitor is not None:
+        monitor.stop()
     server.stop()
 
 
@@ -220,6 +241,12 @@ def build_worker(cfg: dict, stages: List[str]):
     # documents.
     from .sharding import PartitionCheckpoints
 
+    # Cross-host placement: a worker owning a partition subset pumps
+    # only ITS slice of the raw topic against the shared remote broker.
+    owned_partitions = cfg["worker"].get("partitions")
+    if owned_partitions is not None:
+        owned_partitions = [int(p) for p in owned_partitions]
+
     runner = LambdaRunner()
     for stage in stages:
         if stage == "deli":
@@ -232,7 +259,7 @@ def build_worker(cfg: dict, stages: List[str]):
                                                      ctx.partition),
                     fresh_log=False, config=view,
                     send_system=send_system),
-                auto_commit=False))
+                auto_commit=False, partitions=owned_partitions))
         elif stage == "tpu-deli":
             from .tpu_sequencer import TpuSequencerLambda
 
@@ -255,7 +282,8 @@ def build_worker(cfg: dict, stages: List[str]):
                 return lam
 
             deli_mgr = runner.add(PartitionManager(
-                log, "deli", RAW_TOPIC, make_tpu_deli, auto_commit=False))
+                log, "deli", RAW_TOPIC, make_tpu_deli, auto_commit=False,
+                partitions=owned_partitions))
 
             # Catch-up artifact push-through (default-on): refreshed
             # artifacts land in the historian tier's catch-up cache so

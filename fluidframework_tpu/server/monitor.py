@@ -331,6 +331,34 @@ class ServiceMonitor:
 
         self.add_probe(name, probe)
 
+    def watch_durable(self, name: str, log) -> None:
+        """Probe over a durable broker engine (server/durable.py
+        DurableMessageLog): group-commit backlog, segment count, and
+        torn-tail truncation. The group-commit COUNTERS
+        (fluid_durable_fsyncs_total — per-topic split through the PR 12
+        `bounded()` cardinality guard —, fluid_durable_batch_bytes,
+        fluid_stage_latency_ms{stage="durable.group_commit"}) flow
+        through telemetry/counters.py on the op path; this probe adds
+        the gauges a scrape can't derive from counters."""
+
+        def probe() -> dict:
+            stats_fn = getattr(log, "durable_stats", None)
+            if stats_fn is None:
+                return {"available": False}
+            stats = stats_fn()
+            process_counters.gauge("durable.pending_appends",
+                                   stats.get("pendingAppends", 0))
+            process_counters.gauge("durable.torn_bytes_truncated",
+                                   stats.get("tornBytesTruncated", 0))
+            process_counters.gauge("durable.segments",
+                                   stats.get("segments", 0))
+            process_counters.gauge("durable.partitions",
+                                   stats.get("partitions", 0))
+            stats["available"] = True
+            return stats
+
+        self.add_probe(name, probe)
+
     def watch_capacity(self, name: str, source) -> None:
         """Probe over the last fleet-scale capacity soak (capacity/,
         docs/capacity.md): loads the stamped record — `source` is a

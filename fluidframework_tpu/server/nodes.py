@@ -309,7 +309,10 @@ class Cluster:
         scalar deli's per-doc row or the TPU sequencer's consolidated dump
         (server/tpu_sequencer.py _checkpoint)."""
         ckpts = self.db.collection("deliCheckpoints")
-        row = ckpts.find_one(lambda d: d.get("documentId") == document_id)
+        # "state" in d: skip handed-off tombstones (live rebalancing
+        # leaves one on the document's old partition; server/sharding.py).
+        row = ckpts.find_one(
+            lambda d: d.get("documentId") == document_id and "state" in d)
         if row:
             return row["state"]
         tpu = ckpts.find_one(lambda d: d.get("kind") == "tpu-sequencer")

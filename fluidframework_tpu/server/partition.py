@@ -109,7 +109,8 @@ class PartitionManager:
 
     def __init__(self, log: MessageLog, group: str, topic: str,
                  lambda_factory: Callable[[LambdaContext], IPartitionLambda],
-                 auto_commit: bool = True, offload: bool = False):
+                 auto_commit: bool = True, offload: bool = False,
+                 partitions: Optional[List[int]] = None):
         self.log = log
         # offload=True marks a pure-persistence stage (scriptorium/scribe/
         # copier): safe to pump on a worker thread because it never calls
@@ -119,7 +120,18 @@ class PartitionManager:
         self.offload = offload
         self.pumps: Dict[int, PartitionPump] = {}
         topic_obj = log.topic(topic)
-        for p in range(len(topic_obj.partitions)):
+        # partitions=None owns the whole topic (the single-host shape);
+        # an explicit subset is the cross-host placement config — each
+        # worker process pumps only ITS partitions against the shared
+        # remote broker (deploy/RUNBOOK.md multi-host recipe).
+        owned = range(len(topic_obj.partitions)) if partitions is None \
+            else sorted({int(p) for p in partitions})
+        for p in owned:
+            if not 0 <= p < len(topic_obj.partitions):
+                raise ValueError(
+                    f"owned partition {p} out of range for topic "
+                    f"{topic!r} with {len(topic_obj.partitions)} "
+                    "partitions")
             self.pumps[p] = PartitionPump(log, group, topic, p,
                                           lambda_factory,
                                           auto_commit=auto_commit)

@@ -25,6 +25,8 @@ alike without cycles.
 from __future__ import annotations
 
 import hashlib
+import threading
+from typing import Dict
 
 
 def doc_shard(document_id: str, shards: int) -> int:
@@ -43,18 +45,77 @@ def doc_shard(document_id: str, shards: int) -> int:
 class PartitionRouter:
     """Doc -> ingest-partition routing for one topic's partition count.
 
-    Restart-stable by construction (pure function of the document id and
-    the partition count); rebalancing therefore means CHANGING the
-    partition count, which re-homes (1 - 1/N) of documents — the
-    rebalance contract (docs/ingest_sharding.md) requires draining the
-    old topology to a checkpoint barrier first, exactly like a Kafka
-    repartition."""
+    The BASE mapping is restart-stable by construction (pure function of
+    the document id and the partition count). On top of it sits a
+    routing-EPOCH override table for live rebalancing
+    (docs/ingest_sharding.md): `install_override(doc, partition)` bumps
+    the epoch and re-homes ONE document's raw-topic traffic without
+    touching anything else — the sharded ingest tier
+    (server/sharding.py SequencerShardSet.rebalance_doc) pairs the bump
+    with an explicit handoff record on the source partition, so
+    ownership transfers with no drain-to-barrier fleet restart.
+    Overrides apply to the RAW (sequencing-input) side only; emit-side
+    routing (deltas/broadcast) stays on `base_partition_for`, so a
+    document's output stream never changes partitions and per-doc
+    delivery order is total within one partition by construction.
+
+    Changing the partition COUNT still re-homes (1 - 1/N) of documents
+    and keeps the drain-to-a-checkpoint-barrier procedure, exactly like
+    a Kafka repartition."""
 
     def __init__(self, partitions: int):
         self.partitions = max(1, int(partitions))
+        self.epoch = 0
+        self._overrides: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def base_partition_for(self, document_id: str) -> int:
+        """The epoch-0 md5 home — rebalance-invariant; the emit-side
+        (deltas/broadcast) routing anchor."""
+        return doc_shard(document_id, self.partitions)
 
     def partition_for(self, document_id: str) -> int:
+        """The document's CURRENT raw-side owner (override-aware)."""
+        with self._lock:
+            override = self._overrides.get(document_id)
+        if override is not None:
+            return override
         return doc_shard(document_id, self.partitions)
+
+    def install_override(self, document_id: str, partition: int) -> int:
+        """Re-home one document's raw traffic; returns the new routing
+        epoch. Atomic w.r.t. partition_for: a submit either routes by
+        the old owner (and is sequenced before the handoff marker the
+        tier appends AFTER this bump) or by the new one."""
+        if not 0 <= int(partition) < self.partitions:
+            raise ValueError(
+                f"override partition {partition} out of range "
+                f"[0, {self.partitions})")
+        with self._lock:
+            self.epoch += 1
+            self._overrides[str(document_id)] = int(partition)
+            return self.epoch
+
+    def overrides_targeting(self, partition: int) -> list:
+        """Documents whose CURRENT override homes them on `partition` —
+        the build-time seed for a partition's awaiting-adoption set."""
+        with self._lock:
+            return sorted(doc for doc, p in self._overrides.items()
+                          if p == int(partition))
+
+    def snapshot(self) -> dict:
+        """Persistable override state (the tier stores it in the shared
+        checkpoint collection so a restarted process re-derives the same
+        routes — restart stability now includes live-rebalance moves)."""
+        with self._lock:
+            return {"epoch": self.epoch, "overrides": dict(self._overrides)}
+
+    def restore(self, dump: dict) -> None:
+        with self._lock:
+            self.epoch = max(self.epoch, int(dump.get("epoch", 0)))
+            for doc, p in dict(dump.get("overrides", {})).items():
+                if 0 <= int(p) < self.partitions:
+                    self._overrides[str(doc)] = int(p)
 
     def assignment(self, document_ids) -> dict:
         """{partition: [document_id, ...]} for a document set (bench &

@@ -64,10 +64,13 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from ..telemetry import watermarks
-from ..telemetry.counters import increment
+from ..telemetry.counters import increment, record_swallow
 from .lambdas.base import IPartitionLambda, LambdaContext
 from .partition import PartitionManager
 from .routing import PartitionRouter
+
+REBALANCE_KEY = "__rebalance__"
+_ROUTING_ROW_KIND = "routingEpochs"
 
 
 class AckBatcher:
@@ -204,17 +207,196 @@ class _ShardedPartitionManager(PartitionManager):
         super().restart()
 
 
+class _RebalancingSequencer(IPartitionLambda):
+    """Per-partition handoff shim around the real sequencer lambda: it
+    intercepts the two rebalance control records riding the raw topic
+    and buffers a re-homed document's traffic until its state arrives.
+
+      handoff marker (on the SOURCE partition): export the document's
+        live state through the inner lambda's ``export_doc``, durably
+        produce the adopt record onto the TARGET partition, then
+        ``drop_doc`` (tombstoned checkpoint row). Replay-idempotent:
+        once dropped, export returns None and the marker is a no-op.
+
+      adopt record (on the TARGET partition): ``adopt_doc`` installs the
+        state (dup adopts ignored), then the shim drains every record it
+        buffered for the document IN ARRIVAL ORDER — so the document's
+        op stream is processed exactly as: everything before the marker
+        by the old owner, everything after the adopt by the new one,
+        with nothing lost, duplicated, or reordered in between.
+
+    The awaiting set re-derives on (re)build from the router's override
+    table minus the documents the inner lambda restored — a crashed
+    target partition comes back buffering for exactly the adoptions its
+    checkpoint has not absorbed yet. Buffered records survive the crash
+    too: the shim durably notes the FIRST buffered offset per document
+    (one upsert per handoff, not per record) and on rebuild re-reads
+    [fromOffset, committed) from the log (``MessageLog.read_from`` —
+    offset-indexed on the durable engine), because the pump has already
+    committed past those records and will never replay them. Everything
+    else (occupancy hints, doc_sequence_numbers, ``docs``...) delegates
+    to the inner lambda."""
+
+    def __init__(self, inner: IPartitionLambda, tier: "SequencerShardSet",
+                 partition: int, checkpoints=None):
+        self.inner = inner
+        self.tier = tier
+        self.partition = int(partition)
+        self.checkpoints = checkpoints
+        self.buffered: Dict[str, List] = {}
+        owned = getattr(inner, "docs", {})
+        self.awaiting = {
+            doc for doc in tier.router.overrides_targeting(self.partition)
+            if doc not in owned}
+        self._recover_buffered()
+
+    def _recover_buffered(self) -> None:
+        """Re-read pre-crash buffered records from the log: their offsets
+        were committed when they were buffered (the pump's cursor must
+        advance), so replay will never re-deliver them — the durable
+        fromOffset note is what makes buffering crash-safe."""
+        if self.checkpoints is None or not self.awaiting:
+            return
+        committed = self.tier.log.committed(
+            self.tier.group, self.tier.topic, self.partition)
+        for row in self.checkpoints.find(
+                lambda d: d.get("kind") == "rebalanceBuffer"):
+            doc = row.get("documentId")
+            start = int(row.get("fromOffset", -1))
+            if doc not in self.awaiting or start < 0 or committed <= start:
+                continue
+            for msg in self.tier.log.read_from(
+                    self.tier.topic, self.partition, start,
+                    committed - start):
+                if msg.key == doc and not (
+                        isinstance(msg.value, dict)
+                        and REBALANCE_KEY in msg.value):
+                    self.buffered.setdefault(doc, []).append(msg)
+                    increment("ingest.rebalance_buffer_recovered")
+
+    def _note_buffering(self, doc_id: str, offset: int) -> None:
+        if self.checkpoints is None:
+            return
+        self.checkpoints.upsert(
+            lambda d, _id=doc_id: (d.get("kind") == "rebalanceBuffer"
+                                   and d.get("documentId") == _id),
+            {"kind": "rebalanceBuffer", "documentId": doc_id,
+             "fromOffset": int(offset)})
+
+    # -- control-plane ------------------------------------------------------
+    def expect(self, doc_id: str) -> None:
+        """Arm buffering for a document whose adoption is in flight (the
+        tier calls this BEFORE installing the routing override, so no
+        post-bump record can reach the inner lambda unowned)."""
+        self.awaiting.add(doc_id)
+
+    def _mark_offset(self, message) -> None:
+        # Control records must advance the inner lambda's checkpoint
+        # cursor like any other handled record, or a marker at the head
+        # of a quiet partition would replay forever under batched acks.
+        if hasattr(self.inner, "_pending_offset"):
+            self.inner._pending_offset = message.offset
+
+    def _handoff(self, message, record: dict) -> None:
+        doc_id = record["doc"]
+        target = int(record["target"])
+        epoch = int(record.get("epoch", 0))
+        dump = self.inner.export_doc(doc_id)
+        if dump is None:
+            # Replayed marker after the drop, or a document this
+            # partition never sequenced: the adopt record is already
+            # durably on the target (or there is no state to move).
+            record_swallow("ingest.rebalance_marker_noop")
+            return
+        # Durably publish the state BEFORE dropping it: a crash between
+        # the two replays this marker and re-exports; the target dedups
+        # duplicate adopts. The reverse order could lose the document.
+        self.tier.log.send_to(
+            self.tier.topic, target, doc_id,
+            {REBALANCE_KEY: "adopt", "doc": doc_id, "state": dump,
+             "epoch": epoch, "source": self.partition})
+        self.inner.drop_doc(doc_id, epoch)
+        increment("ingest.rebalance_handoffs")
+
+    def _adopt(self, message, record: dict) -> None:
+        doc_id = record["doc"]
+        if self.inner.adopt_doc(doc_id, record["state"]):
+            increment("ingest.rebalance_adoptions")
+        else:
+            record_swallow("ingest.rebalance_adopt_dup")
+        self.awaiting.discard(doc_id)
+        self._note_buffering(doc_id, -1)  # retire the recovery note
+        for held in self.buffered.pop(doc_id, []):
+            self.inner.handler(held)
+
+    # -- IPartitionLambda ---------------------------------------------------
+    def handler(self, message) -> None:
+        value = message.value
+        if isinstance(value, dict) and REBALANCE_KEY in value:
+            if value[REBALANCE_KEY] == "handoff":
+                self._handoff(message, value)
+            else:
+                self._adopt(message, value)
+            self._mark_offset(message)
+            return
+        if message.key in self.awaiting:
+            # The document's state is still in flight from its old
+            # owner: hold the record and replay it after adoption — per-
+            # doc order across the handoff is arrival order, bit-for-bit.
+            if message.key not in self.buffered:
+                # Durable note BEFORE the pump can commit this offset:
+                # a crash while awaiting re-reads from here.
+                self._note_buffering(message.key, message.offset)
+            self.buffered.setdefault(message.key, []).append(message)
+            self._mark_offset(message)
+            increment("ingest.rebalance_buffered")
+            return
+        self.inner.handler(message)
+
+    def flush(self) -> None:
+        self.inner.flush()
+
+    def close(self) -> None:
+        self.inner.close()
+
+    def __getattr__(self, item):
+        return getattr(self.inner, item)
+
+    # The shim's OWN state is this fixed set; everything else — reads
+    # above, and writes here — belongs to the wrapped sequencer. Without
+    # write-through, `tier.live(p).client_timeout_s = ...` (and every
+    # other knob callers poke on "the sequencer") would silently land on
+    # the shim and never reach the lambda that reads it.
+    _OWN_ATTRS = frozenset(
+        {"inner", "tier", "partition", "checkpoints", "buffered",
+         "awaiting"})
+
+    def __setattr__(self, name, value):
+        if name in _RebalancingSequencer._OWN_ATTRS:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self.inner, name, value)
+
+
 class SequencerShardSet:
     """The horizontally-sharded ingest tier (module docstring).
 
     ``lambda_factory(ctx, checkpoints)`` builds one sequencer lambda for
     a partition; ``checkpoints`` is that partition's scoped view (or
-    None when the tier has no checkpoint store)."""
+    None when the tier has no checkpoint store).
+
+    ``partitions_owned`` (default: all of the topic's partitions) is the
+    cross-host placement config: a worker process that owns a subset
+    pumps ONLY those partitions against the shared remote broker, so
+    scaling out is deploy/RUNBOOK.md config — two hosts owning [0..7]
+    and [8..15] ARE the 16-partition tier. Routing (partition_for) still
+    spans the full partition count on every host."""
 
     def __init__(self, log, topic: str, group: str,
                  lambda_factory: Callable[..., IPartitionLambda],
                  checkpoints=None, auto_commit: bool = True,
-                 batch_acks: Optional[bool] = None):
+                 batch_acks: Optional[bool] = None,
+                 partitions_owned: Optional[List[int]] = None):
         self.log = log
         self.topic = topic
         self.group = group
@@ -222,6 +404,7 @@ class SequencerShardSet:
         topic_obj = log.topic(topic)
         self.partitions = len(topic_obj.partitions)
         self.router = PartitionRouter(self.partitions)
+        self._load_routing()
         # Batched acks engage for self-checkpointing lambdas on a truly
         # sharded topic; the single-partition pipeline keeps today's
         # eager per-checkpoint commit timing bit-for-bit.
@@ -235,12 +418,14 @@ class SequencerShardSet:
             lam = lambda_factory(ctx, scoped)
             if self.acks is not None:
                 ctx.ack_batcher = self.acks
-            return lam
+            return _RebalancingSequencer(lam, self, ctx.partition,
+                                         checkpoints=scoped)
 
         self.manager = _ShardedPartitionManager(
             log, group, topic, build, auto_commit=auto_commit,
             acks=self.acks,
-            workers_owned=lambda: self.workers_running)
+            workers_owned=lambda: self.workers_running,
+            partitions=partitions_owned)
         # Guards the per-partition stats against concurrent workers; the
         # worker-lifecycle flags below are only written under it too.
         self._stats_lock = threading.Lock()
@@ -258,12 +443,98 @@ class SequencerShardSet:
     def partition_for(self, document_id: str) -> int:
         return self.router.partition_for(document_id)
 
+    def delta_partition_for(self, document_id: str) -> int:
+        """EMIT-side (deltas/broadcast) routing anchor: always the base
+        md5 home, never a rebalance override — a document's output
+        stream stays on one partition forever, which is what makes
+        per-doc delivery order across a live rebalance total within one
+        partition by construction (no consumer-side reordering gate)."""
+        return self.router.base_partition_for(document_id)
+
     def sequencer_for(self, document_id: str) -> IPartitionLambda:
         """The live sequencer lambda owning a document's home partition."""
         return self.live(self.partition_for(document_id))
 
     def sequencers(self) -> List[IPartitionLambda]:
         return [self.live(p) for p in sorted(self.manager.pumps)]
+
+    # -- live rebalancing ----------------------------------------------------
+    def rebalance_doc(self, document_id: str, target: int) -> int:
+        """Re-home one document's raw-topic sequencing to ``target``
+        with NO fleet drain — returns the new routing epoch.
+
+        Protocol (every step crash-replayable, docs/ingest_sharding.md):
+
+          1. arm the target partition's buffering (``expect``) so a
+             post-bump submit can never reach its sequencer unowned;
+          2. persist the override (epoch bump) — submits now route to
+             the target, where they buffer behind the in-flight state;
+          3. append the handoff marker on the SOURCE partition; when the
+             old owner pumps it, it exports the document's state,
+             durably produces the adopt record onto the target, and
+             drops the document (tombstoned checkpoint row).
+
+        Because the marker rides the raw topic itself, everything the
+        old owner sequenced BEFORE the override keeps its order, and
+        everything after drains on the target after adoption — per-doc
+        emit order is identical to the no-rebalance run."""
+        document_id = str(document_id)
+        source = self.router.partition_for(document_id)
+        target = int(target)
+        if target == source:
+            return self.router.epoch
+        if not 0 <= target < self.partitions:
+            raise ValueError(
+                f"rebalance target {target} out of range "
+                f"[0, {self.partitions})")
+        # Hook validation up front: the TPU-batched sequencer checkpoints
+        # whole-lane state (one kind=="tpu-sequencer" row) and has no
+        # per-document export surface — fail BEFORE any state changes.
+        for p, role in ((source, "source"), (target, "target")):
+            if p not in self.manager.pumps:
+                raise RuntimeError(
+                    f"rebalance_doc: {role} partition {p} is not owned "
+                    "by this process (partitions_owned subset) — invoke "
+                    "the rebalance on a host owning both partitions")
+            lam = self.live(p)
+            for hook in ("export_doc", "adopt_doc", "drop_doc"):
+                if not callable(getattr(lam, hook, None)):
+                    raise RuntimeError(
+                        f"rebalance_doc: {role} partition {p} lambda "
+                        f"({type(getattr(lam, 'inner', lam)).__name__}) "
+                        f"has no {hook}() — live per-document handoff "
+                        "requires the scalar DeliLambda sequencer")
+        wrapper = self.manager.pumps[target].lambda_
+        if isinstance(wrapper, _RebalancingSequencer):
+            wrapper.expect(document_id)
+        epoch = self.router.install_override(document_id, target)
+        self._persist_routing()
+        self.log.send_to(
+            self.topic, source, document_id,
+            {REBALANCE_KEY: "handoff", "doc": document_id,
+             "target": target, "epoch": epoch})
+        increment("ingest.rebalance_requests")
+        return epoch
+
+    def _persist_routing(self) -> None:
+        """Durably record the override table in the shared checkpoint
+        collection (ingestPartition=-1 keeps the row out of every
+        partition's scoped view) — a restarted tier re-derives the same
+        routes, so restart stability now includes live-rebalance moves."""
+        if self.checkpoints is None:
+            return
+        row = {"kind": _ROUTING_ROW_KIND, "ingestPartition": -1}
+        row.update(self.router.snapshot())
+        self.checkpoints.upsert(
+            lambda d: d.get("kind") == _ROUTING_ROW_KIND, row)
+
+    def _load_routing(self) -> None:
+        if self.checkpoints is None:
+            return
+        row = self.checkpoints.find_one(
+            lambda d: d.get("kind") == _ROUTING_ROW_KIND)
+        if row is not None:
+            self.router.restore(row)
 
     # -- pumping ------------------------------------------------------------
     def pump_partition(self, partition: int, limit: int = 10 ** 9) -> int:

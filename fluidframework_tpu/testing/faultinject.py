@@ -149,6 +149,15 @@ class FaultyMessageLog:
         chaos envelope, not silently bypass it via __getattr__."""
         return self._faulty_send(topic, int(partition), key, value)
 
+    def send_to_many(self, topic: str, partition: int, items):
+        """Batched produce decomposes to one fault draw PER record —
+        send_to_many(t, p, xs) must sit in exactly the same chaos
+        envelope as len(xs) send_to calls, or batch-path callers would
+        silently dodge injected drops/dups/delays (and break run-twice
+        fingerprint identity between batched and unbatched drivers)."""
+        return [self._faulty_send(topic, int(partition), key, value)
+                for key, value in items]
+
     def _faulty_send(self, topic: str, partition: Optional[int], key: str,
                      value):
         if topic not in self.fault_topics:

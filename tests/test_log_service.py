@@ -88,6 +88,32 @@ class TestRemoteLog:
         finally:
             server.stop()
 
+    def test_send_to_many_and_read_from_over_wire(self):
+        """The batched produce and explicit-offset read both cross the
+        wire: one SendToMany RPC acks the whole batch with dense
+        offsets, and Read serves arbitrary (cold) offsets — the
+        rebalance wrapper's buffered-record recovery path against a
+        remote broker."""
+        backing = MessageLog()
+        server = LogServiceServer(backing).start()
+        try:
+            remote = RemoteMessageLog(server.address)
+            remote.topic("t", 2)
+            msgs = remote.send_to_many(
+                "t", 1, [(f"k{i}", {"n": i}) for i in range(6)])
+            assert [m.offset for m in msgs] == list(range(6))
+            assert [m.partition for m in msgs] == [1] * 6
+            # Matches a local batched produce on the backing log.
+            local = backing.topic("t").partitions[1].read(0, 10)
+            assert [x.value for x in local] == [m.value for m in msgs]
+            got = remote.read_from("t", 1, 2, limit=3)
+            assert [m.value["n"] for m in got] == [2, 3, 4]
+            assert [m.offset for m in got] == [2, 3, 4]
+            assert remote.read_from("t", 0, 0) == []
+            remote.close()
+        finally:
+            server.stop()
+
     def test_boxcar_payloads_survive_wire(self):
         server = LogServiceServer().start()
         try:

@@ -1,0 +1,300 @@
+"""Live partition rebalancing (server/sharding.py, server/routing.py):
+routing-epoch handoff on the raw topic itself — checkpoint export, epoch
+bump, adopt on the target — with no fleet drain, per-doc emit order
+identical to the no-rebalance run, buffered racing submits, crash-safe
+buffering (the persisted rebalanceBuffer watermark + read_from replay),
+and chaos determinism under partition crashes."""
+
+import hashlib
+
+import pytest
+
+from fluidframework_tpu.protocol.messages import (DocumentMessage,
+                                                  MessageType)
+from fluidframework_tpu.server.local_server import (LocalServer,
+                                                    TpuLocalServer)
+from fluidframework_tpu.server.routing import doc_shard
+from fluidframework_tpu.testing import faultinject
+
+
+def _op(csn: int, ref: int = 0) -> DocumentMessage:
+    return DocumentMessage(
+        client_sequence_number=csn, reference_sequence_number=ref,
+        type=MessageType.OPERATION,
+        contents={"pos": 0, "text": "x", "kind": "insert",
+                  "channel": "t"})
+
+
+def _server(partitions: int = 4) -> LocalServer:
+    return LocalServer(partitions=partitions, auto_pump=False)
+
+
+DOC = "rb-doc"
+
+
+class TestLiveRebalance:
+    def test_handoff_roundtrip_preserves_sequencing(self):
+        """Full lifecycle: move out, sequence, restart the whole tier,
+        move back — every submit sequences exactly once, deltas stay in
+        order, and the router's answer survives the restart (the
+        persisted routingEpochs row)."""
+        server = _server()
+        home = doc_shard(DOC, 4)
+        target = (home + 1) % 4
+        conn = server.connect(DOC)
+        received = []
+        conn.on("op", lambda m: received.append(m.sequence_number))
+        conn.submit([_op(1)])
+        server.pump()
+        seq0 = server.sequence_number(DOC)
+
+        epoch = server.rebalance_document(DOC, target)
+        server.pump()
+        assert epoch >= 1
+        assert server.ingest.partition_for(DOC) == target
+        # Emits stay anchored on the BASE mapping: a doc's sequenced
+        # stream never changes partitions, no matter where raw
+        # sequencing currently lives.
+        assert server.ingest.delta_partition_for(DOC) == home
+        assert DOC not in server.ingest.live(home).docs
+        assert DOC in server.ingest.live(target).docs
+        # The adopted checkpoint row is visible IMMEDIATELY (the source
+        # row is tombstoned; a flush-cadence gap here would report 0).
+        assert server.sequence_number(DOC) == seq0
+
+        conn.submit([_op(2, ref=seq0)])
+        server.pump()
+        conn.submit([_op(3, ref=seq0)])
+        server.pump()
+        assert server.sequence_number(DOC) > seq0
+        assert received == sorted(received) and received
+
+        server.ingest.restart_all()
+        server.pump()
+        assert server.ingest.partition_for(DOC) == target
+        conn.submit([_op(4, ref=server.sequence_number(DOC))])
+        server.pump()
+        s1 = server.sequence_number(DOC)
+
+        server.rebalance_document(DOC, home)
+        server.pump()
+        assert server.ingest.partition_for(DOC) == home
+        assert server.sequence_number(DOC) == s1
+        conn.submit([_op(5, ref=s1)])
+        server.pump()
+        assert server.sequence_number(DOC) > s1
+        assert received == sorted(received)
+        assert len(received) == len(set(received))
+
+    def test_no_fleet_drain(self):
+        """The handoff never restarts a pump: sibling partitions keep
+        their live lambda objects (and their in-memory state) across the
+        move, and a sibling doc sequences DURING the in-flight handoff
+        with only its own partition pumped."""
+        server = _server()
+        home = doc_shard(DOC, 4)
+        target = (home + 1) % 4
+        conn = server.connect(DOC)
+        conn.submit([_op(1)])
+        server.pump()
+        # A sibling doc homed on neither source nor target.
+        sib = next(f"sib-{i}" for i in range(100)
+                   if doc_shard(f"sib-{i}", 4) not in (home, target))
+        sib_home = doc_shard(sib, 4)
+        sconn = server.connect(sib)
+        server.ingest.pump_partition(sib_home)
+        before = {p: server.ingest.manager.pumps[p].lambda_
+                  for p in range(4)}
+
+        server.ingest.rebalance_doc(DOC, target)  # marker only, no pump
+        sconn.submit([_op(1)])
+        server.ingest.pump_partition(sib_home)  # fleet keeps moving
+        assert server.sequence_number(sib) >= 2  # join + op landed
+        server.pump()  # handoff completes
+        after = {p: server.ingest.manager.pumps[p].lambda_
+                 for p in range(4)}
+        assert before == after  # same live lambdas: zero restarts
+
+    def test_racing_submits_buffer_until_adoption(self):
+        """Submits that land on the target between the epoch bump and
+        the adopt record must buffer (not crash, not sequence against a
+        doc the target doesn't own yet) and drain in arrival order."""
+        server = _server()
+        home = doc_shard(DOC, 4)
+        target = (home + 1) % 4
+        conn = server.connect(DOC)
+        received = []
+        conn.on("op", lambda m: received.append(m.sequence_number))
+        conn.submit([_op(1)])
+        server.pump()
+        seq0 = server.sequence_number(DOC)
+        received.clear()  # only the post-handoff deliveries matter below
+
+        server.ingest.rebalance_doc(DOC, target)
+        conn.submit([_op(2, ref=seq0)])
+        conn.submit([_op(3, ref=seq0)])
+        # Pump ONLY the target: the source hasn't processed the marker,
+        # so the wrapper must hold both ops behind the pending adoption.
+        server.ingest.pump_partition(target)
+        wrapper = server.ingest.manager.pumps[target].lambda_
+        assert DOC in wrapper.awaiting
+        assert len(wrapper.buffered.get(DOC, [])) == 2
+        assert server.sequence_number(DOC) == seq0  # nothing early
+        server.pump()
+        assert not wrapper.awaiting and not wrapper.buffered
+        assert server.sequence_number(DOC) == seq0 + 2
+        assert received == [seq0 + 1, seq0 + 2]
+
+    def test_target_crash_recovers_buffered_records(self):
+        """The pump COMMITS offsets past buffered records, so a target
+        crash mid-buffering cannot rely on replay — the wrapper's
+        persisted rebalanceBuffer watermark re-reads them via
+        read_from() on rebuild. Nothing acked is lost."""
+        server = _server()
+        target = (doc_shard(DOC, 4) + 1) % 4
+        conn = server.connect(DOC)
+        received = []
+        conn.on("op", lambda m: received.append(m.sequence_number))
+        conn.submit([_op(1)])
+        server.pump()
+        seq0 = server.sequence_number(DOC)
+        received.clear()  # only the post-handoff deliveries matter below
+
+        server.ingest.rebalance_doc(DOC, target)
+        conn.submit([_op(2, ref=seq0)])
+        conn.submit([_op(3, ref=seq0)])
+        server.ingest.pump_partition(target)  # buffers + commits offsets
+        wrapper = server.ingest.manager.pumps[target].lambda_
+        assert len(wrapper.buffered[DOC]) == 2
+        server.ingest.restart_partition(target)  # crash before adoption
+        fresh = server.ingest.manager.pumps[target].lambda_
+        assert fresh is not wrapper
+        assert DOC in fresh.awaiting
+        assert len(fresh.buffered.get(DOC, [])) == 2  # re-read from log
+        server.pump()
+        assert server.sequence_number(DOC) == seq0 + 2
+        assert received == [seq0 + 1, seq0 + 2]
+
+    def test_rebalance_validation(self):
+        server = _server()
+        conn = server.connect(DOC)
+        conn.submit([_op(1)])
+        server.pump()
+        home = doc_shard(DOC, 4)
+        # No-op move returns the current epoch without a marker.
+        assert server.ingest.rebalance_doc(DOC, home) \
+            == server.ingest.router.epoch
+        with pytest.raises(ValueError):
+            server.ingest.rebalance_doc(DOC, 7)
+
+    def test_tpu_tier_rejects_per_doc_handoff(self):
+        """The TPU-batched sequencer checkpoints whole-lane state and
+        has no per-document export surface: rebalance_doc must fail
+        up-front, before any routing state changes."""
+        server = TpuLocalServer(partitions=4, auto_pump=False)
+        conn = server.connect(DOC)
+        conn.submit([_op(1)])
+        server.pump()
+        target = (doc_shard(DOC, 4) + 1) % 4
+        epoch_before = server.ingest.router.epoch
+        with pytest.raises(RuntimeError, match="export_doc"):
+            server.ingest.rebalance_doc(DOC, target)
+        assert server.ingest.router.epoch == epoch_before
+        assert server.ingest.partition_for(DOC) == doc_shard(DOC, 4)
+
+
+class TestEmitOrderIdentity:
+    """The acceptance bar: a run WITH live rebalances delivers every
+    doc's stream in exactly the order the no-rebalance run does."""
+
+    def _run(self, rebalance: bool):
+        server = _server()
+        docs = [f"eo-{i}" for i in range(6)]
+        streams = {d: [] for d in docs}
+        conns = {}
+        last = {d: 0 for d in docs}
+        for d in docs:
+            c = server.connect(d)
+            conns[d] = c
+            c.on("op", lambda m, d=d: (
+                streams[d].append((str(m.type), m.client_sequence_number,
+                                   m.sequence_number,
+                                   m.minimum_sequence_number)),
+                last.__setitem__(d, m.sequence_number)))
+        server.pump()
+        csn = {d: 0 for d in docs}
+        for i in range(12):
+            for d in docs:
+                csn[d] += 1
+                conns[d].submit([_op(csn[d], ref=last[d])])
+            server.pump()
+            if rebalance and i % 4 == 1:
+                # Bounce a different doc each round; one round later,
+                # move it back — mid-traffic, no drain.
+                d = docs[(i // 4) % len(docs)]
+                cur = server.ingest.partition_for(d)
+                server.rebalance_document(d, (cur + 1) % 4)
+            if rebalance and i % 4 == 3:
+                d = docs[(i // 4) % len(docs)]
+                server.rebalance_document(d, doc_shard(d, 4))
+        server.pump()
+        return streams, {d: server.sequence_number(d) for d in docs}
+
+    def test_streams_identical_with_and_without_rebalance(self):
+        plain, seq_plain = self._run(rebalance=False)
+        moved, seq_moved = self._run(rebalance=True)
+        assert seq_plain == seq_moved
+        for d in plain:
+            assert plain[d], f"no deliveries for {d}"
+            assert plain[d] == moved[d], \
+                f"per-doc emit order diverged under rebalance for {d}"
+
+
+class TestRebalanceChaos:
+    """Determinism under faults: partition crashes interleaved with
+    live handoffs, run twice with the same plan, bit-identical
+    fingerprints. drop=0 — the handoff marker and adopt record ride the
+    raw topic durably; a *delivery-fault* drop of either is a different
+    failure class (producer retry), not silent loss."""
+
+    def _run(self, seed: int):
+        plan = faultinject.FaultPlan(seed, drop=0.0, dup=0.05,
+                                     delay=0.1)
+        server = _server()
+        server.log = faultinject.FaultyMessageLog(server.log, plan)
+        server.ingest.log = server.log
+        docs = [f"rc-{i}" for i in range(5)]
+        digest = hashlib.sha256()
+        conns = {}
+        last = {d: 0 for d in docs}
+        for d in docs:
+            c = server.connect(d)
+            conns[d] = c
+            c.on("op", lambda m, d=d: (
+                digest.update(f"{d}:{m.sequence_number}:"
+                              f"{m.client_sequence_number};".encode()),
+                last.__setitem__(d, m.sequence_number)))
+        server.pump()
+        csn = {d: 0 for d in docs}
+        for i in range(24):
+            for d in docs:
+                csn[d] += 1
+                conns[d].submit([_op(csn[d], ref=last[d])])
+            server.pump()
+            if i % 6 == 2:
+                d = docs[(i // 6) % len(docs)]
+                cur = server.ingest.partition_for(d)
+                server.rebalance_document(d, (cur + 1) % 4)
+            if i % 7 == 4:
+                faultinject.crash_partition(plan, server.ingest.manager)
+                server.pump()
+        server.log.flush_delayed()
+        server.pump()
+        seqs = tuple(server.sequence_number(d) for d in docs)
+        return plan.fingerprint(), digest.hexdigest(), seqs
+
+    def test_run_twice_bit_identical(self):
+        assert self._run(4242) == self._run(4242)
+
+    def test_different_seed_differs(self):
+        assert self._run(4242)[0] != self._run(4243)[0]
